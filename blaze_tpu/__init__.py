@@ -1,0 +1,46 @@
+"""blaze-tpu: a TPU-native columnar query execution framework.
+
+A brand-new framework with the capabilities of blaze-init/blaze (a Spark SQL
+accelerator: protobuf plan-serde boundary -> vectorized native operators over
+Arrow columnar batches -> segmented Arrow-IPC columnar shuffle), re-designed
+TPU-first:
+
+- Columnar batches are fixed-capacity device arrays (one array per column plus
+  a validity bitmask), padded into shape buckets so XLA compiles once per
+  (plan fingerprint, bucket).
+- Operators are pure functions over batch pytrees, composed per pipeline and
+  `jax.jit`-compiled; elementwise expressions fuse straight into XLA.
+- Hash partitioning is bit-exact Spark murmur3 (seed 42) evaluated on-device
+  for fixed-width columns and in the C++ host runtime for strings.
+- Exchange (shuffle / broadcast) spills to the reference-compatible segmented
+  Arrow-IPC format (8-byte LE length + zstd Arrow IPC stream per segment,
+  little-endian i64 offsets index), so a Spark executor can fetch our output.
+- Multi-chip scaling uses `jax.sharding.Mesh` + `shard_map` with XLA
+  collectives (all_to_all for repartition, all_gather for broadcast) over ICI.
+
+Reference layer map: /root/reference SURVEY.md section 1; this package provides
+TPU-native equivalents of native-engine/{blaze,datafusion-ext,plan-serde}.
+"""
+
+import jax as _jax
+
+# SQL semantics need real 64-bit integers (bigint sums, timestamps, decimal
+# unscaled values); JAX's default 32-bit mode would silently truncate them.
+_jax.config.update("jax_enable_x64", True)
+
+from blaze_tpu.config import EngineConfig, get_config, set_config
+from blaze_tpu.types import DataType, Field, Schema
+from blaze_tpu.batch import Column, ColumnBatch
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "EngineConfig",
+    "get_config",
+    "set_config",
+    "DataType",
+    "Field",
+    "Schema",
+    "Column",
+    "ColumnBatch",
+]

@@ -1,0 +1,316 @@
+"""Columnar batch substrate.
+
+A `ColumnBatch` is the engine's unit of data flow - the TPU-native equivalent
+of the reference's Arrow `RecordBatch` streaming through DataFusion operators
+(reference exec.rs:196-255 hot loop). Differences, by design (SURVEY 7):
+
+- Every column is a fixed-capacity device array padded up to a shape bucket,
+  so XLA compiles one kernel per (pipeline, bucket) rather than per batch.
+  The live row count is carried separately (`num_rows`); rows past it are
+  padding with unspecified contents that kernels mask out.
+- SQL NULLs are a separate bool validity array per column (None == all
+  valid), matching Arrow validity semantics without bit-packing (TPU
+  vectorizes bool arrays fine; bit-unpacking would serialize).
+- utf8/binary columns are dictionary-encoded at the host boundary: int32
+  codes on device + a host-side pyarrow dictionary. All device compute
+  (group-by, join keys, comparisons) happens on codes or on 32-bit hashes
+  computed from the real bytes by the host runtime.
+
+`ColumnBatch` itself is a host object, NOT a pytree: jitted pipelines receive
+the flat list of device arrays (`device_buffers()`) plus the row count, and
+the host wrapper reassembles. This keeps non-traceable state (dictionaries,
+schema) out of jit caching keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.config import get_config
+from blaze_tpu.types import (
+    DataType,
+    Field,
+    Schema,
+    TypeId,
+    from_arrow_schema,
+    to_arrow_type,
+)
+
+
+@dataclasses.dataclass
+class Column:
+    """One column: padded device values + optional validity + host dict."""
+
+    dtype: DataType
+    values: jax.Array  # physical dtype, shape (capacity,)
+    validity: Optional[jax.Array] = None  # bool, shape (capacity,) or None
+    dictionary: Optional[object] = None  # pyarrow Array for utf8/binary
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    def valid_mask(self, capacity: Optional[int] = None) -> jax.Array:
+        if self.validity is not None:
+            return self.validity
+        return jnp.ones(capacity or self.capacity, dtype=jnp.bool_)
+
+
+@dataclasses.dataclass
+class ColumnBatch:
+    schema: Schema
+    columns: List[Column]
+    num_rows: int
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return self.columns[0].capacity
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name_or_index) -> Column:
+        if isinstance(name_or_index, int):
+            return self.columns[name_or_index]
+        return self.columns[self.schema.index_of(name_or_index)]
+
+    # ------------------------------------------------------------------
+    # flat device-buffer view for jitted pipelines
+    # ------------------------------------------------------------------
+    def device_buffers(self) -> List[jax.Array]:
+        """Flat list of device arrays: [v0, m0?, v1, m1?, ...].
+
+        The layout (which columns carry validity) is part of the batch's
+        `layout()` descriptor, which jit-compiled pipelines key on.
+        """
+        bufs: List[jax.Array] = []
+        for c in self.columns:
+            bufs.append(c.values)
+            if c.validity is not None:
+                bufs.append(c.validity)
+        return bufs
+
+    def layout(self) -> Tuple:
+        """Hashable descriptor of the device-buffer layout (jit cache key)."""
+        return (
+            self.capacity,
+            tuple(
+                (c.dtype.id.value, c.dtype.precision, c.dtype.scale,
+                 c.validity is not None)
+                for c in self.columns
+            ),
+        )
+
+    @staticmethod
+    def from_device_buffers(
+        schema: Schema,
+        layout: Tuple,
+        bufs: Sequence[jax.Array],
+        num_rows: int,
+        dictionaries: Optional[Sequence[Optional[object]]] = None,
+    ) -> "ColumnBatch":
+        _, col_layout = layout
+        cols: List[Column] = []
+        it = iter(bufs)
+        for i, (tid, prec, scale, has_mask) in enumerate(col_layout):
+            dt = DataType(TypeId(tid), prec, scale)
+            values = next(it)
+            validity = next(it) if has_mask else None
+            d = dictionaries[i] if dictionaries else None
+            cols.append(Column(dt, values, validity, d))
+        return ColumnBatch(schema, cols, num_rows)
+
+    def dictionaries(self) -> List[Optional[object]]:
+        return [c.dictionary for c in self.columns]
+
+    # ------------------------------------------------------------------
+    # host boundary: pyarrow interop
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_arrow(rb, capacity: Optional[int] = None) -> "ColumnBatch":
+        """Build from a pyarrow RecordBatch (dictionary-encode strings,
+        pad to a shape bucket, move to device)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        schema = from_arrow_schema(rb.schema)
+        n = rb.num_rows
+        cap = capacity or get_config().bucket_for(n)
+        cols: List[Column] = []
+        for i, field in enumerate(schema):
+            arr = rb.column(i)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            dt = field.dtype
+            has_nulls = arr.null_count > 0
+            null_np = np.asarray(arr.is_null()) if has_nulls else None
+            dictionary = None
+            if dt.is_dictionary_encoded:
+                if not pa.types.is_dictionary(arr.type):
+                    arr = pc.dictionary_encode(arr)
+                dictionary = arr.dictionary
+                np_vals = arr.indices.fill_null(0).to_numpy(
+                    zero_copy_only=False)
+                np_vals = np.ascontiguousarray(np_vals, dtype=np.int32)
+            elif dt.id is TypeId.DECIMAL:
+                np_vals = _decimal_unscaled_i64(arr)
+            elif dt.id is TypeId.TIMESTAMP_US:
+                arr = arr.cast(pa.timestamp("us"))
+                np_vals = arr.to_numpy(zero_copy_only=False).astype(
+                    "datetime64[us]").view(np.int64)
+            elif dt.id is TypeId.DATE32:
+                np_vals = arr.to_numpy(zero_copy_only=False).astype(
+                    "datetime64[D]").view(np.int64).astype(np.int32)
+            elif dt.id is TypeId.NULL:
+                np_vals = np.zeros(n, dtype=np.int8)
+            else:
+                if has_nulls:
+                    # pyarrow surfaces nullable ints as float64 with NaN;
+                    # fill first (nulls are tracked in validity anyway).
+                    arr = arr.fill_null(
+                        False if dt.id is TypeId.BOOL else 0)
+                np_vals = arr.to_numpy(zero_copy_only=False)
+            phys = dt.physical_dtype()
+            if np_vals.dtype != phys:
+                np_vals = np_vals.astype(phys)
+            padded = np.zeros(cap, dtype=phys)
+            padded[:n] = np_vals
+            validity = None
+            if has_nulls or dt.id is TypeId.NULL:
+                vmask = np.ones(cap, dtype=bool)
+                if dt.id is TypeId.NULL:
+                    vmask[:] = False
+                else:
+                    vmask[:n] = ~null_np
+                validity = jnp.asarray(vmask)
+            cols.append(Column(dt, jnp.asarray(padded), validity, dictionary))
+        return ColumnBatch(schema, cols, n)
+
+    def to_arrow(self):
+        """Materialize the live rows back to a pyarrow RecordBatch."""
+        import pyarrow as pa
+
+        n = self.num_rows
+        arrays = []
+        fields = []
+        for field, col in zip(self.schema, self.columns):
+            vals = np.asarray(col.values)[:n]
+            mask = None
+            if col.validity is not None:
+                mask = ~np.asarray(col.validity)[:n]
+            dt = field.dtype
+            if dt.is_dictionary_encoded:
+                codes = vals.astype(np.int32)
+                if mask is not None:
+                    codes = np.where(mask, 0, codes)
+                dict_arr = col.dictionary
+                if dict_arr is None:
+                    dict_arr = pa.array([], type=to_arrow_type(dt))
+                indices = pa.array(codes, mask=mask)
+                arr = pa.DictionaryArray.from_arrays(
+                    indices, dict_arr
+                ).cast(to_arrow_type(dt))
+            elif dt.id is TypeId.DECIMAL:
+                arr = _decimal_from_unscaled_i64(
+                    vals.astype(np.int64), mask, dt.precision, dt.scale
+                )
+            elif dt.id is TypeId.DATE32:
+                arr = pa.array(
+                    vals.astype(np.int32), mask=mask, type=pa.int32()
+                ).cast(pa.date32())
+            elif dt.id is TypeId.TIMESTAMP_US:
+                arr = pa.array(
+                    vals.astype(np.int64), mask=mask, type=pa.int64()
+                ).cast(pa.timestamp("us"))
+            elif dt.id is TypeId.NULL:
+                arr = pa.nulls(n)
+            else:
+                arr = pa.array(vals, mask=mask, type=to_arrow_type(dt))
+            arrays.append(arr)
+            fields.append(pa.field(field.name, arr.type, field.nullable))
+        return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+    @staticmethod
+    def from_pydict(data: dict, schema: Optional[Schema] = None,
+                    capacity: Optional[int] = None) -> "ColumnBatch":
+        """Test/interop helper: build from {name: list} via pyarrow."""
+        import pyarrow as pa
+
+        if schema is not None:
+            from blaze_tpu.types import to_arrow_schema
+
+            rb = pa.RecordBatch.from_pydict(
+                data, schema=to_arrow_schema(schema)
+            )
+        else:
+            rb = pa.RecordBatch.from_pydict(data)
+        return ColumnBatch.from_arrow(rb, capacity=capacity)
+
+    def to_pydict(self) -> dict:
+        return self.to_arrow().to_pydict()
+
+    # ------------------------------------------------------------------
+    def slice_host(self, start: int, length: int) -> "ColumnBatch":
+        """Host-side row slice (used by spill/IPC writers)."""
+        rb = self.to_arrow().slice(start, length)
+        return ColumnBatch.from_arrow(rb)
+
+
+def _decimal_unscaled_i64(arr) -> np.ndarray:
+    """Extract decimal128 unscaled values that fit in i64 (the engine's
+    decimal representation; matches the reference's i64-only decimals,
+    plan.proto:598-601)."""
+    import pyarrow as pa
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    buf = arr.buffers()[1]
+    if buf is None:
+        return np.zeros(len(arr), dtype=np.int64)
+    raw = np.frombuffer(buf, dtype=np.int64)
+    # decimal128 is 16 bytes little-endian; low limb is the i64 value for
+    # anything within i64 range.
+    lo = raw[arr.offset * 2::2][: len(arr)]
+    return np.ascontiguousarray(lo)
+
+
+def _decimal_from_unscaled_i64(vals: np.ndarray, mask, precision: int,
+                               scale: int):
+    """Inverse of _decimal_unscaled_i64: i64 unscaled -> Decimal128Array."""
+    import pyarrow as pa
+
+    n = len(vals)
+    limbs = np.zeros(2 * n, dtype=np.int64)
+    limbs[0::2] = vals  # low limb, little-endian
+    limbs[1::2] = np.where(vals < 0, -1, 0)  # sign extension
+    data = pa.py_buffer(limbs.tobytes())
+    if mask is not None:
+        validity = pa.array(~mask).buffers()[1]
+    else:
+        validity = None
+    return pa.Array.from_buffers(
+        pa.decimal128(precision, scale), n, [validity, data]
+    )
+
+
+def empty_batch(schema: Schema, capacity: Optional[int] = None) -> ColumnBatch:
+    cap = capacity if capacity is not None else get_config().shape_buckets[0]
+    cols = []
+    for f in schema:
+        phys = f.dtype.physical_dtype()
+        cols.append(Column(f.dtype, jnp.zeros(cap, dtype=phys), None, None))
+    return ColumnBatch(schema, cols, 0)
+
+
+def row_mask(num_rows, capacity: int) -> jax.Array:
+    """Mask of live rows for a padded batch; `num_rows` may be traced."""
+    return jnp.arange(capacity) < num_rows

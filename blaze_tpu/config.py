@@ -1,0 +1,67 @@
+"""Engine configuration.
+
+Mirrors the reference's engine-sizing knobs (spark.blaze.batchSize, memory
+fraction, tmp dirs: reference NativeSupports.scala:241-253 -> exec.rs:53-107)
+plus TPU-specific sizing (shape buckets, device memory budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    # Max rows per device batch (reference default 16384, exec.rs:105).
+    batch_size: int = 16384
+    # Fraction of the memory budget the engine may use before spilling
+    # (reference MemoryManagerConfig memory_fraction, exec.rs:79-94).
+    memory_fraction: float = 0.75
+    # Total host-side memory budget in bytes for buffered shuffle/agg state.
+    max_memory: int = 4 << 30
+    # Device (HBM) budget for resident partition buffers before host spill.
+    device_memory_budget: int = 8 << 30
+    # Spill directories (reference DiskManagerConfig::NewSpecified tmp_dirs).
+    tmp_dirs: Sequence[str] = dataclasses.field(
+        default_factory=lambda: [tempfile.gettempdir()]
+    )
+    # Row-count buckets for padding batches to static shapes. Each batch is
+    # padded up to the smallest bucket >= its row count so XLA compiles one
+    # kernel per (pipeline, bucket) instead of per exact shape.
+    shape_buckets: Sequence[int] = (256, 1024, 4096, 16384)
+    # zstd level for segmented-IPC shuffle segments (reference uses level 1,
+    # util/ipc.rs:20-49).
+    ipc_compression_level: int = 1
+    # Default shuffle partition count when a plan does not specify one.
+    default_shuffle_partitions: int = 200
+    # Enable per-operator timing metrics.
+    collect_metrics: bool = True
+
+    def bucket_for(self, num_rows: int) -> int:
+        for b in self.shape_buckets:
+            if num_rows <= b:
+                return b
+        # Round up to a multiple of the largest bucket for oversized batches.
+        top = self.shape_buckets[-1]
+        return ((num_rows + top - 1) // top) * top
+
+    def spill_dir(self) -> str:
+        d = self.tmp_dirs[0]
+        os.makedirs(d, exist_ok=True)
+        return d
+
+
+_CONFIG: EngineConfig = EngineConfig()
+
+
+def get_config() -> EngineConfig:
+    return _CONFIG
+
+
+def set_config(cfg: EngineConfig) -> EngineConfig:
+    global _CONFIG
+    _CONFIG = cfg
+    return cfg

@@ -1,0 +1,85 @@
+"""ColumnBatch substrate round-trip tests (host <-> device boundary)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.types import DataType, Field, Schema, from_arrow_schema
+
+
+def test_roundtrip_fixed_width():
+    rb = pa.RecordBatch.from_pydict(
+        {
+            "a": pa.array([1, 2, 3, None], type=pa.int64()),
+            "b": pa.array([1.5, None, 3.0, 4.0], type=pa.float64()),
+            "c": pa.array([True, False, None, True]),
+        }
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    assert cb.num_rows == 4
+    assert cb.capacity >= 4
+    out = cb.to_arrow()
+    assert out.to_pydict() == rb.to_pydict()
+
+
+def test_roundtrip_strings_dictionary():
+    rb = pa.RecordBatch.from_pydict(
+        {"s": pa.array(["x", "y", None, "x", "zz"], type=pa.utf8())}
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    col = cb.column("s")
+    assert col.dictionary is not None
+    assert np.asarray(col.values).dtype == np.int32
+    assert cb.to_arrow().to_pydict() == rb.to_pydict()
+
+
+def test_roundtrip_date_timestamp_decimal():
+    rb = pa.RecordBatch.from_pydict(
+        {
+            "d": pa.array([18000, None, 18002], type=pa.int32()).cast(
+                pa.date32()
+            ),
+            "t": pa.array([1_600_000_000_000_000, 5, None]).cast(
+                pa.timestamp("us")
+            ),
+            "m": pa.array(
+                [Decimal("12.34"), None, Decimal("-5.67")],
+                type=pa.decimal128(10, 2),
+            ),
+        }
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    out = cb.to_arrow()
+    assert out.to_pydict() == rb.to_pydict()
+
+
+def test_padding_and_layout():
+    cb = ColumnBatch.from_pydict({"a": list(range(10))})
+    assert cb.capacity == 256  # smallest shape bucket
+    assert cb.num_rows == 10
+    layout = cb.layout()
+    bufs = cb.device_buffers()
+    cb2 = ColumnBatch.from_device_buffers(
+        cb.schema, layout, bufs, cb.num_rows, cb.dictionaries()
+    )
+    assert cb2.to_pydict() == cb.to_pydict()
+
+
+def test_schema_helpers():
+    s = Schema([Field("a", DataType.int64()), Field("b", DataType.utf8())])
+    assert s.index_of("b") == 1
+    assert s.rename(["x", "y"]).names() == ("x", "y")
+    ps = from_arrow_schema(
+        pa.schema([("a", pa.int64()), ("b", pa.string())])
+    )
+    assert ps.field("a").dtype == DataType.int64()
+    assert ps.field("b").dtype == DataType.utf8()
+
+
+def test_int64_not_truncated():
+    big = 2**40 + 7
+    cb = ColumnBatch.from_pydict({"a": [big]})
+    assert cb.to_pydict()["a"] == [big]
