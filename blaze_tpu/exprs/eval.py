@@ -1,0 +1,507 @@
+"""Device (TPU) columnar expression evaluator.
+
+Evaluates the IR over padded device columns inside `jax.jit`. Everything here
+is pure jnp/lax - no data-dependent Python control flow - so whole pipelines
+(scan -> filter -> project -> partial aggregate) fuse into one XLA program
+(SURVEY 7 design stance).
+
+Null semantics follow Spark SQL (non-ANSI), the contract the reference is
+validated against by differential TPC-DS testing (SURVEY 4):
+- arithmetic/comparison: NULL if any input is NULL
+- x / 0 and x % 0 are NULL (all numeric types)
+- AND/OR are three-valued (FALSE AND NULL = FALSE, TRUE OR NULL = TRUE)
+- NaN equals NaN and sorts greater than any other double
+- IS NULL / IS NOT NULL never return NULL
+
+A column value is the pair (values, validity) where validity is None for
+all-valid; helpers keep validity lazy so fully-valid pipelines never
+materialize masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blaze_tpu.types import DataType, Schema, TypeId
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import Op
+from blaze_tpu.exprs.typing import infer_dtype, promote
+
+CV = Tuple[jax.Array, Optional[jax.Array]]  # (values, validity|None)
+
+
+def and_validity(a: Optional[jax.Array],
+                 b: Optional[jax.Array]) -> Optional[jax.Array]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def valid_or_true(v: Optional[jax.Array], shape) -> jax.Array:
+    if v is None:
+        return jnp.ones(shape, dtype=jnp.bool_)
+    return jnp.broadcast_to(v, shape)
+
+
+def _np_dtype(dt: DataType):
+    return dt.physical_dtype()
+
+
+class DeviceEvaluator:
+    """Evaluate bound expressions against a batch's device buffers."""
+
+    def __init__(self, schema: Schema, columns: Sequence[CV], capacity: int):
+        self.schema = schema
+        self.columns = list(columns)
+        self.capacity = capacity
+
+    # ------------------------------------------------------------------
+    def evaluate(self, e: ir.Expr) -> CV:
+        values, validity = self._eval(e)
+        return values, validity
+
+    def evaluate_predicate(self, e: ir.Expr) -> jax.Array:
+        """Predicate result with SQL WHERE semantics: NULL -> False."""
+        v, m = self._eval(e)
+        v = v.astype(jnp.bool_)
+        if m is not None:
+            v = v & m
+        return v
+
+    # ------------------------------------------------------------------
+    def _eval(self, e: ir.Expr) -> CV:
+        if isinstance(e, ir.BoundCol):
+            return self.columns[e.index]
+        if isinstance(e, ir.Col):
+            return self.columns[self.schema.index_of(e.name)]
+        if isinstance(e, ir.Literal):
+            return self._literal(e)
+        if isinstance(e, ir.Cast):
+            return self._cast(e)
+        if isinstance(e, ir.BinaryOp):
+            return self._binary(e)
+        if isinstance(e, ir.Not):
+            v, m = self._eval(e.child)
+            return ~v.astype(jnp.bool_), m
+        if isinstance(e, ir.Negate):
+            v, m = self._eval(e.child)
+            return -v, m
+        if isinstance(e, ir.IsNull):
+            _, m = self._eval(e.child)
+            if m is None:
+                return jnp.zeros(self.capacity, dtype=jnp.bool_), None
+            return ~m, None
+        if isinstance(e, ir.IsNotNull):
+            _, m = self._eval(e.child)
+            if m is None:
+                return jnp.ones(self.capacity, dtype=jnp.bool_), None
+            return m, None
+        if isinstance(e, ir.InList):
+            return self._in_list(e)
+        if isinstance(e, ir.If):
+            return self._case(
+                ir.CaseWhen(((e.cond, e.then),), e.otherwise)
+            )
+        if isinstance(e, ir.CaseWhen):
+            return self._case(e)
+        if isinstance(e, ir.Coalesce):
+            return self._coalesce(e)
+        if isinstance(e, ir.ScalarFn):
+            return self._scalar_fn(e)
+        raise NotImplementedError(
+            f"device evaluator: unsupported expr {type(e).__name__}"
+        )
+
+    # ------------------------------------------------------------------
+    def _literal(self, e: ir.Literal) -> CV:
+        if e.value is None:
+            return (
+                jnp.zeros(self.capacity, dtype=jnp.int8),
+                jnp.zeros(self.capacity, dtype=jnp.bool_),
+            )
+        if e.dtype.is_string_like:
+            raise NotImplementedError(
+                "string literals must be lowered host-side before device eval"
+            )
+        v = jnp.full(
+            self.capacity, e.value, dtype=_np_dtype(e.dtype)
+        )
+        return v, None
+
+    def _cast(self, e: ir.Cast) -> CV:
+        v, m = self._eval(e.child)
+        src = infer_dtype(e.child, self.schema)
+        dst = e.to
+        if src == dst:
+            return v, m
+        if dst.is_string_like or src.is_string_like:
+            raise NotImplementedError(
+                "string casts are lowered host-side (no TPU string compute)"
+            )
+        if src.id is TypeId.DECIMAL and dst.id is TypeId.DECIMAL:
+            # rescale unscaled i64 by 10^(dst.scale - src.scale)
+            dscale = dst.scale - src.scale
+            if dscale >= 0:
+                return v * (10 ** dscale), m
+            return _java_div(v, jnp.asarray(10 ** (-dscale), v.dtype)), m
+        if src.id is TypeId.DECIMAL:
+            scaled = v.astype(jnp.float64) / (10.0 ** src.scale)
+            return scaled.astype(_np_dtype(dst)), m
+        if dst.id is TypeId.DECIMAL:
+            out = (v.astype(jnp.float64) * (10.0 ** dst.scale))
+            return jnp.round(out).astype(jnp.int64), m
+        if src.id is TypeId.DATE32 and dst.id is TypeId.TIMESTAMP_US:
+            return v.astype(jnp.int64) * 86_400_000_000, m
+        if src.id is TypeId.TIMESTAMP_US and dst.id is TypeId.DATE32:
+            return jnp.floor_divide(v, 86_400_000_000).astype(jnp.int32), m
+        if dst.id is TypeId.BOOL:
+            return v != 0, m
+        # numeric <-> numeric: Java-style wrap/truncate (astype wraps ints,
+        # truncates float->int toward zero)
+        return v.astype(_np_dtype(dst)), m
+
+    # ------------------------------------------------------------------
+    def _binary(self, e: ir.BinaryOp) -> CV:
+        op = e.op
+        if op in ir.LOGIC_OPS:
+            return self._logic(e)
+        lv, lm = self._eval(e.left)
+        rv, rm = self._eval(e.right)
+        lt = infer_dtype(e.left, self.schema)
+        rt = infer_dtype(e.right, self.schema)
+        m = and_validity(lm, rm)
+        if op in ir.COMPARISON_OPS:
+            return self._compare(op, lv, rv, lt, rt, m)
+        out_t = infer_dtype(e, self.schema)
+        phys = _np_dtype(out_t)
+        # decimal alignment for +/-: rescale to common scale
+        if lt.id is TypeId.DECIMAL or rt.id is TypeId.DECIMAL:
+            return self._decimal_arith(op, lv, rv, lt, rt, out_t, m)
+        lv = lv.astype(phys)
+        rv = rv.astype(phys)
+        if op is Op.ADD:
+            return lv + rv, m
+        if op is Op.SUB:
+            return lv - rv, m
+        if op is Op.MUL:
+            return lv * rv, m
+        if op is Op.DIV:
+            return self._div(lv, rv, out_t, m)
+        if op is Op.MOD:
+            return self._mod(lv, rv, out_t, m)
+        if op is Op.BITAND:
+            return lv & rv, m
+        if op is Op.BITOR:
+            return lv | rv, m
+        if op is Op.BITXOR:
+            return lv ^ rv, m
+        if op is Op.SHL:
+            return lv << rv, m
+        if op is Op.SHR:
+            return lv >> rv, m
+        raise NotImplementedError(op)
+
+    def _compare(self, op, lv, rv, lt, rt, m) -> CV:
+        ct = promote(lt, rt) if lt != rt else lt
+        phys = _np_dtype(ct)
+        lv = lv.astype(phys)
+        rv = rv.astype(phys)
+        if ct.is_floating:
+            # Spark NaN semantics: NaN == NaN, NaN greater than everything
+            ln = jnp.isnan(lv)
+            rn = jnp.isnan(rv)
+            if op is Op.EQ:
+                return (lv == rv) | (ln & rn), m
+            if op is Op.NEQ:
+                return ~((lv == rv) | (ln & rn)), m
+            if op is Op.LT:
+                return jnp.where(ln, False, jnp.where(rn, True, lv < rv)), m
+            if op is Op.LTE:
+                return jnp.where(
+                    ln, rn, jnp.where(rn, True, lv <= rv)
+                ), m
+            if op is Op.GT:
+                return jnp.where(rn, False, jnp.where(ln, True, lv > rv)), m
+            if op is Op.GTE:
+                return jnp.where(
+                    rn, ln, jnp.where(ln, True, lv >= rv)
+                ), m
+        table = {
+            Op.EQ: lambda: lv == rv,
+            Op.NEQ: lambda: lv != rv,
+            Op.LT: lambda: lv < rv,
+            Op.LTE: lambda: lv <= rv,
+            Op.GT: lambda: lv > rv,
+            Op.GTE: lambda: lv >= rv,
+        }
+        return table[op](), m
+
+    def _div(self, lv, rv, out_t: DataType, m) -> CV:
+        zero = rv == 0
+        if out_t.is_floating:
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            return lv / safe, and_validity(m, ~zero)
+        safe = jnp.where(zero, jnp.ones_like(rv), rv)
+        return _java_div(lv, safe), and_validity(m, ~zero)
+
+    def _mod(self, lv, rv, out_t: DataType, m) -> CV:
+        zero = rv == 0
+        safe = jnp.where(zero, jnp.ones_like(rv), rv)
+        return lax.rem(lv, safe), and_validity(m, ~zero)
+
+    def _decimal_arith(self, op, lv, rv, lt, rt, out_t, m) -> CV:
+        def unscaled(v, t):
+            if t.id is TypeId.DECIMAL:
+                return v.astype(jnp.int64), t.scale
+            if t.is_integer:
+                return v.astype(jnp.int64), 0
+            return v, None  # float operand -> float path
+
+        lu, ls = unscaled(lv, lt)
+        ru, rs = unscaled(rv, rt)
+        if ls is None or rs is None or op is Op.DIV:
+            lf = lv.astype(jnp.float64) / (
+                10.0 ** lt.scale if lt.id is TypeId.DECIMAL else 1.0
+            )
+            rf = rv.astype(jnp.float64) / (
+                10.0 ** rt.scale if rt.id is TypeId.DECIMAL else 1.0
+            )
+            return self._div(lf, rf, DataType.float64(), m) if op is Op.DIV \
+                else (_apply_float_op(op, lf, rf), m)
+        target = out_t.scale
+        lu = lu * (10 ** (target - ls)) if op in (Op.ADD, Op.SUB) else lu
+        ru = ru * (10 ** (target - rs)) if op in (Op.ADD, Op.SUB) else ru
+        if op is Op.ADD:
+            return lu + ru, m
+        if op is Op.SUB:
+            return lu - ru, m
+        if op is Op.MUL:
+            # scale(l)+scale(r) -> rescale down to out scale
+            prod = lu * ru
+            down = ls + rs - target
+            if down > 0:
+                prod = _java_div(prod, jnp.asarray(10 ** down, jnp.int64))
+            return prod, m
+        if op is Op.MOD:
+            return self._mod(lu, ru, out_t, m)
+        raise NotImplementedError(f"decimal {op}")
+
+    def _logic(self, e: ir.BinaryOp) -> CV:
+        lv, lm = self._eval(e.left)
+        rv, rm = self._eval(e.right)
+        lv = lv.astype(jnp.bool_)
+        rv = rv.astype(jnp.bool_)
+        lvalid = valid_or_true(lm, lv.shape)
+        rvalid = valid_or_true(rm, rv.shape)
+        if lm is None and rm is None:
+            return (lv & rv if e.op is Op.AND else lv | rv), None
+        if e.op is Op.AND:
+            # known iff either side is known-FALSE or both sides are known;
+            # lv&rv is already correct in every known case (garbage values on
+            # invalid rows are ANDed with a known False)
+            known = (lvalid & ~lv) | (rvalid & ~rv) | (lvalid & rvalid)
+            return lv & rv, known
+        else:  # OR: known iff either side is known-TRUE or both known
+            known = (lvalid & lv) | (rvalid & rv) | (lvalid & rvalid)
+            return lv | rv, known
+
+    def _in_list(self, e: ir.InList) -> CV:
+        v, m = self._eval(e.child)
+        hit = jnp.zeros(self.capacity, dtype=jnp.bool_)
+        any_null_item = False
+        for item in e.values:
+            if isinstance(item, ir.Literal) and item.value is None:
+                any_null_item = True
+                continue
+            iv, im = self._eval(item)
+            ct = promote(
+                infer_dtype(e.child, self.schema),
+                infer_dtype(item, self.schema),
+            )
+            phys = _np_dtype(ct)
+            hit = hit | (v.astype(phys) == iv.astype(phys))
+        # Spark: x IN (...) is NULL if no match and any element (or x) is NULL
+        validity = m
+        if any_null_item:
+            validity = and_validity(validity, hit)
+        result = ~hit if e.negated else hit
+        return result, validity
+
+    def _case(self, e: ir.CaseWhen) -> CV:
+        out_t = infer_dtype(e, self.schema)
+        phys = _np_dtype(out_t)
+        if e.otherwise is not None:
+            acc_v, acc_m = self._eval(e.otherwise)
+            acc_v = acc_v.astype(phys)
+        else:
+            acc_v = jnp.zeros(self.capacity, dtype=phys)
+            acc_m = jnp.zeros(self.capacity, dtype=jnp.bool_)
+        # fold branches right-to-left so the first matching wins
+        for cond, result in reversed(e.branches):
+            c = self.evaluate_predicate(cond)
+            rv, rm = self._eval(result)
+            rv = rv.astype(phys)
+            acc_v = jnp.where(c, rv, acc_v)
+            if rm is None and acc_m is None:
+                acc_m = None
+            else:
+                rvalid = valid_or_true(rm, rv.shape)
+                avalid = valid_or_true(acc_m, acc_v.shape)
+                acc_m = jnp.where(c, rvalid, avalid)
+        return acc_v, acc_m
+
+    def _coalesce(self, e: ir.Coalesce) -> CV:
+        out_t = infer_dtype(e, self.schema)
+        phys = _np_dtype(out_t)
+        acc_v = jnp.zeros(self.capacity, dtype=phys)
+        acc_m = jnp.zeros(self.capacity, dtype=jnp.bool_)
+        for a in reversed(e.args):
+            v, m = self._eval(a)
+            v = v.astype(phys)
+            valid = valid_or_true(m, v.shape)
+            acc_v = jnp.where(valid, v, acc_v)
+            acc_m = valid | acc_m
+        return acc_v, acc_m
+
+    # ------------------------------------------------------------------
+    def _scalar_fn(self, e: ir.ScalarFn) -> CV:
+        n = e.name
+        args = [self._eval(a) for a in e.args]
+        m = None
+        for _, am in args:
+            m = and_validity(m, am)
+        vs = [v for v, _ in args]
+
+        def f64(x):
+            return x.astype(jnp.float64)
+
+        unary_f64 = {
+            "sqrt": jnp.sqrt,
+            "exp": jnp.exp,
+            "ln": jnp.log,
+            "log": jnp.log,
+            "log2": jnp.log2,
+            "log10": jnp.log10,
+            "sin": jnp.sin,
+            "cos": jnp.cos,
+            "tan": jnp.tan,
+            "asin": jnp.arcsin,
+            "acos": jnp.arccos,
+            "atan": jnp.arctan,
+            "sinh": jnp.sinh,
+            "cosh": jnp.cosh,
+            "tanh": jnp.tanh,
+        }
+        if n in unary_f64:
+            return unary_f64[n](f64(vs[0])), m
+        if n == "abs":
+            return jnp.abs(vs[0]), m
+        if n in ("negative",):
+            return -vs[0], m
+        if n in ("positive",):
+            return vs[0], m
+        if n == "signum":
+            return jnp.sign(f64(vs[0])), m
+        if n == "pow":
+            return jnp.power(f64(vs[0]), f64(vs[1])), m
+        if n == "atan2":
+            return jnp.arctan2(f64(vs[0]), f64(vs[1])), m
+        if n == "isnan":
+            v = vs[0]
+            return (
+                jnp.isnan(v) if jnp.issubdtype(v.dtype, jnp.floating)
+                else jnp.zeros_like(v, dtype=jnp.bool_)
+            ), m
+        if n == "nanvl":
+            a, b = f64(vs[0]), f64(vs[1])
+            return jnp.where(jnp.isnan(a), b, a), m
+        if n in ("ceil", "floor"):
+            src_t = infer_dtype(e.args[0], self.schema)
+            v = vs[0]
+            if src_t.is_integer:
+                return v, m
+            fn = jnp.ceil if n == "ceil" else jnp.floor
+            return fn(f64(v)).astype(jnp.int64), m
+        if n == "round":
+            src_t = infer_dtype(e.args[0], self.schema)
+            if src_t.is_integer:
+                return vs[0], m
+            # Spark HALF_UP rounding (not banker's)
+            v = f64(vs[0])
+            return jnp.where(
+                v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5)
+            ), m
+        if n == "trunc" or n == "truncate":
+            return jnp.trunc(f64(vs[0])), m
+        if n in ("greatest", "least"):
+            phys = _np_dtype(infer_dtype(e, self.schema))
+            acc = vs[0].astype(phys)
+            for v in vs[1:]:
+                acc = (
+                    jnp.maximum(acc, v.astype(phys))
+                    if n == "greatest"
+                    else jnp.minimum(acc, v.astype(phys))
+                )
+            return acc, m
+        if n == "spark_unscaled_value":
+            # decimal (i64-unscaled repr) -> bigint: identity on device
+            # (reference spark_ext_function.rs:8)
+            return vs[0].astype(jnp.int64), m
+        if n == "spark_make_decimal":
+            # bigint -> decimal unscaled: identity (spark_ext_function.rs:29)
+            return vs[0].astype(jnp.int64), m
+        if n in ("year", "month", "day", "dayofmonth", "quarter"):
+            return _date_part(n, vs[0]), m
+        raise NotImplementedError(f"device scalar fn {n}")
+
+
+def _apply_float_op(op: Op, lv, rv):
+    return {
+        Op.ADD: lambda: lv + rv,
+        Op.SUB: lambda: lv - rv,
+        Op.MUL: lambda: lv * rv,
+    }[op]()
+
+
+def _java_div(a, b):
+    """Integer division truncating toward zero (Java/Spark semantics)."""
+    return lax.div(a, b)
+
+
+def _date_part(part: str, days32) -> jax.Array:
+    """Extract year/month/day from date32 (days since epoch) using the
+    civil-from-days algorithm (Howard Hinnant's public-domain formulation) -
+    pure integer ops, vectorizes on the VPU."""
+    z = days32.astype(jnp.int64) + 719_468
+    era = jnp.floor_divide(z, 146_097)
+    doe = z - era * 146_097  # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524)
+        - jnp.floor_divide(doe, 146096),
+        365,
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    month = mp + jnp.where(mp < 10, 3, -9)
+    year = y + jnp.where(month <= 2, 1, 0)
+    if part == "year":
+        return year.astype(jnp.int32)
+    if part == "month":
+        return month.astype(jnp.int32)
+    if part in ("day", "dayofmonth"):
+        return d.astype(jnp.int32)
+    if part == "quarter":
+        return (jnp.floor_divide(month - 1, 3) + 1).astype(jnp.int32)
+    raise NotImplementedError(part)

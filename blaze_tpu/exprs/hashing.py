@@ -1,0 +1,401 @@
+"""Spark-compatible Murmur3 (x86_32, seed 42) hashing.
+
+This is a *bit-compatibility contract* (SURVEY 4): shuffle partitioning must
+place rows exactly where a Spark executor would, or exchange interop breaks.
+The reference implements the same contract in Rust (datafusion-ext
+spark_hash.rs:27-87) against Spark's `Murmur3_x86_32.hashInt/hashLong/
+hashUnsafeBytes` (seed 42, null columns skipped, hash chains across columns).
+
+Three implementations, cross-checked by tests:
+- device (jnp uint32 ops, runs inside jit - TPU VPU friendly)
+- host numpy (vectorized over byte arrays, for string columns)
+- the C++ host runtime (cpp/blaze_host) for bulk string hashing off-device
+
+Spark quirks captured here:
+- tail bytes of a byte-string are mixed one at a time as *sign-extended*
+  ints through the full mixK1/mixH1 pipeline (unlike standard murmur3 tails)
+- float -0.0 normalizes to 0.0 before hashing; float hashes as
+  hashInt(floatToIntBits), double as hashLong(doubleToLongBits)
+- NULL values leave the running hash unchanged
+- multi-column hash: h = hash(col_i, h) folded left over columns from seed 42
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blaze_tpu.types import DataType, TypeId
+
+SPARK_SEED = np.uint32(42)
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(0xE6546B64)
+
+
+# ---------------------------------------------------------------------------
+# device (jnp) implementation - fixed-width types
+# ---------------------------------------------------------------------------
+
+def _rotl32(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * _C2
+    return k1
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    h1 = h1 * np.uint32(5) + _M5
+    return h1
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    return h1
+
+
+def hash_int32(v, seed):
+    """hashInt: v is uint32-reinterpreted int32; seed uint32."""
+    return _fmix(_mix_h1(seed, _mix_k1(v)), 4)
+
+
+def hash_int64(v, seed):
+    """hashLong: low word then high word.
+
+    Splits via arithmetic rather than a 64-bit bitcast: the TPU backend's
+    no-X64 rewrite pass does not implement u64 bitcast-convert, but it does
+    emulate i64 shifts/masks as 32-bit pairs.
+    """
+    v = v.astype(jnp.int64)
+    low = jnp.bitwise_and(v, 0xFFFFFFFF).astype(jnp.uint32)
+    high = jnp.bitwise_and(
+        jnp.right_shift(v, 32), 0xFFFFFFFF
+    ).astype(jnp.uint32)
+    h1 = _mix_h1(seed, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, 8)
+
+
+def _scale_pow2(x: jax.Array, k: jax.Array) -> jax.Array:
+    """x * 2^k computed exactly for integer k in [-1023, 1023].
+
+    Decomposes |k| into bits and multiplies by exact compile-time constants
+    2^(+-2^b); every factor and (with bits applied in descending order, which
+    moves the value monotonically toward its target) every intermediate stays
+    a normal f64, so each multiply is exact.
+    """
+    neg = k < 0
+    mag = jnp.abs(k)
+    out = x
+    # bit 10 (|k| >= 1024, reachable when log2 rounds DBL_MAX up to 1024):
+    # 2^1024 overflows f64, so apply it as two half-factors
+    has10 = (mag & 1024) != 0
+    half10 = jnp.where(has10, jnp.where(neg, 2.0 ** -512, 2.0 ** 512), 1.0)
+    out = out * half10 * half10
+    for b in range(9, -1, -1):
+        p = 1 << b
+        has = (mag & p) != 0
+        factor = jnp.where(has, jnp.where(neg, 2.0 ** -p, 2.0 ** p), 1.0)
+        out = out * factor
+    return out
+
+
+def double_to_long_bits(v: jax.Array) -> jax.Array:
+    """Java Double.doubleToLongBits reconstructed arithmetically.
+
+    The TPU backend's no-X64 rewrite implements neither u64 nor f64
+    bitcast-convert (and jnp.frexp/signbit lower to one), so the IEEE754
+    fields are rebuilt with pure arithmetic: exponent from log2 with
+    correction rounds, mantissa by exact power-of-two scaling (every scaling
+    below stays a power of two, so it is exact); NaN canonicalizes to
+    0x7ff8000000000000 like Java.
+    """
+    v = v.astype(jnp.float64)
+    # signbit without bitcast: 1/-0.0 == -inf
+    negative = (v < 0.0) | ((v == 0.0) & (1.0 / v < 0.0))
+    sign = negative.astype(jnp.int64) << 63
+    a = jnp.abs(v)
+    finite_pos = (a > 0.0) & jnp.isfinite(a)
+    safe_a = jnp.where(finite_pos, a, 1.0)
+    # lift subnormals into normal range so log2/exp2 stay exact (note: XLA
+    # flushes f64 subnormals to zero, so true subnormal inputs hash as +-0
+    # on device; exchange code routes f64 keys through the exact host path)
+    is_sub_range = safe_a < 2.0 ** -1022
+    a2 = jnp.where(is_sub_range, safe_a * (2.0 ** 64), safe_a)
+
+    e = jnp.floor(jnp.log2(a2))
+    # m = a2 * 2^-e, correcting log2 rounding at power-of-two boundaries.
+    # XLA's exp2 is approximate even at integer args and its division is not
+    # correctly rounded, so the scaling uses _scale_pow2 (exact constant
+    # power-of-two factors) exclusively.
+    for _ in range(2):
+        m = _scale_pow2(a2, -e.astype(jnp.int32))
+        e = jnp.where(m >= 2.0, e + 1.0, jnp.where(m < 1.0, e - 1.0, e))
+    m = _scale_pow2(a2, -e.astype(jnp.int32))
+    true_e = e - jnp.where(is_sub_range, 64.0, 0.0)
+    is_sub = true_e < -1022.0
+    biased = jnp.where(
+        is_sub, jnp.int64(0), true_e.astype(jnp.int64) + 1023
+    )
+    # normal: frac = (m - 1) * 2^52 (m in [1,2), exact)
+    frac_norm = jnp.floor((m - 1.0) * (2.0 ** 52)).astype(jnp.int64)
+    # subnormal: frac = |v| * 2^1074 = m * 2^(true_e + 1074), exponent <= 52
+    sub_pow = jnp.clip(true_e + 1074.0, 0.0, 52.0).astype(jnp.int32)
+    frac_sub = jnp.floor(_scale_pow2(m, sub_pow)).astype(jnp.int64)
+    frac = jnp.where(is_sub, frac_sub, frac_norm)
+    bits = sign | (biased << 52) | frac
+    bits = jnp.where(finite_pos, bits, sign)  # +-0.0 handled here
+    bits = jnp.where(
+        jnp.isinf(v), sign | (jnp.int64(2047) << 52), bits
+    )
+    bits = jnp.where(jnp.isnan(v), jnp.int64(0x7FF8000000000000), bits)
+    return bits
+
+
+def device_hash_supported(dtype: DataType, backend: Optional[str] = None
+                          ) -> bool:
+    """Whether `hash_column_device` is bit-exact for this dtype on the given
+    backend. Strings always hash host-side (no TPU string compute). FLOAT64
+    is device-exact only on the CPU backend: TPU emulates f64 as f32 pairs
+    (~49-bit mantissa), so exchange code routes f64 keys through
+    `hash_rows_host` on TPU hardware.
+    """
+    import jax as _jax
+
+    backend = backend or _jax.default_backend()
+    if dtype.id in (TypeId.UTF8, TypeId.BINARY):
+        return False
+    if dtype.id is TypeId.FLOAT64:
+        return backend == "cpu"
+    if dtype.id is TypeId.DECIMAL and dtype.precision > 18:
+        return False
+    return True
+
+
+def hash_column_device(values: jax.Array, validity: Optional[jax.Array],
+                       dtype: DataType, seed: jax.Array) -> jax.Array:
+    """Chain one column into the running per-row hash (uint32)."""
+    tid = dtype.id
+    if tid in (TypeId.BOOL,):
+        h = hash_int32(values.astype(jnp.uint32), seed)
+    elif tid in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32):
+        # sign-extend to int32 then reinterpret
+        h = hash_int32(values.astype(jnp.int32).view(jnp.uint32), seed)
+    elif tid in (TypeId.INT64, TypeId.TIMESTAMP_US):
+        h = hash_int64(values.astype(jnp.int64), seed)
+    elif tid is TypeId.DECIMAL and dtype.precision <= 18:
+        h = hash_int64(values.astype(jnp.int64), seed)
+    elif tid is TypeId.FLOAT32:
+        v = jnp.where(values == 0.0, 0.0, values)  # -0.0 -> 0.0
+        h = hash_int32(
+            lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32), seed
+        )
+    elif tid is TypeId.FLOAT64:
+        v = jnp.where(values == 0.0, 0.0, values).astype(jnp.float64)
+        h = hash_int64(double_to_long_bits(v), seed)
+    else:
+        raise NotImplementedError(
+            f"device hash of {dtype}; string columns hash host-side"
+        )
+    if validity is not None:
+        h = jnp.where(validity, h, seed)  # NULL leaves hash unchanged
+    return h
+
+
+def hash_columns_device(
+    cols: Sequence[Tuple[jax.Array, Optional[jax.Array], DataType]],
+    capacity: int,
+    precomputed: Sequence[Optional[jax.Array]] = (),
+) -> jax.Array:
+    """Multi-column Spark hash as int32. `precomputed` lets the host pass
+    already-hashed uint32 lanes for string columns: entry i non-None means
+    'chain this per-row hash value instead of hashing values[i] on device'.
+
+    A precomputed lane carries the *final* per-row uint32 for that column
+    having been chained from the running seed host-side is not possible
+    (seed differs per row), so string lanes are mixed in as one
+    hashInt-style link of their own 32-bit value. Matching Spark exactly
+    for strings therefore requires host hashing of the string bytes into
+    the chain; `hash_rows_host` does the exact chain - the device variant
+    with precomputed lanes is used only for engine-internal partitioning
+    consistency, never for Spark interop, and bench/shuffle code selects
+    `hash_rows_host` whenever a string key is present.
+    """
+    h = jnp.full(capacity, SPARK_SEED, dtype=jnp.uint32)
+    pre = list(precomputed) + [None] * (len(cols) - len(precomputed))
+    for (values, validity, dtype), p in zip(cols, pre):
+        if p is not None:
+            link = _fmix(_mix_h1(h, _mix_k1(p.astype(jnp.uint32))), 4)
+            if validity is not None:
+                link = jnp.where(validity, link, h)
+            h = link
+        else:
+            h = hash_column_device(values, validity, dtype, h)
+    return h.view(jnp.int32)
+
+
+def pmod(hash_i32: jax.Array, n: int) -> jax.Array:
+    """Spark's non-negative modulo for partition assignment
+    (reference spark_hash.rs pmod)."""
+    r = hash_i32 % np.int32(n)
+    return jnp.where(r < 0, r + np.int32(n), r).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# host (numpy) implementation - includes byte strings
+# ---------------------------------------------------------------------------
+
+def _np_rotl32(x, r):
+    return np.uint32((np.uint32(x) << np.uint32(r)) |
+                     (np.uint32(x) >> np.uint32(32 - r)))
+
+
+def _np_hash_int(v: np.ndarray, seed: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        k1 = (v.astype(np.uint32) * _C1)
+        k1 = (k1 << np.uint32(15)) | (k1 >> np.uint32(17))
+        k1 = k1 * _C2
+        h1 = seed ^ k1
+        h1 = (h1 << np.uint32(13)) | (h1 >> np.uint32(19))
+        h1 = h1 * np.uint32(5) + _M5
+        h1 = h1 ^ np.uint32(4)
+        h1 ^= h1 >> np.uint32(16)
+        h1 = h1 * np.uint32(0x85EBCA6B)
+        h1 ^= h1 >> np.uint32(13)
+        h1 = h1 * np.uint32(0xC2B2AE35)
+        h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def _np_mix_h1(h1, k1):
+    with np.errstate(over="ignore"):
+        h1 = h1 ^ k1
+        h1 = (h1 << np.uint32(13)) | (h1 >> np.uint32(19))
+        return h1 * np.uint32(5) + _M5
+
+
+def _np_mix_k1(k1):
+    with np.errstate(over="ignore"):
+        k1 = k1 * _C1
+        k1 = (k1 << np.uint32(15)) | (k1 >> np.uint32(17))
+        return k1 * _C2
+
+
+def _np_fmix(h1, length):
+    with np.errstate(over="ignore"):
+        h1 = h1 ^ np.uint32(length) if np.isscalar(length) else \
+            h1 ^ length.astype(np.uint32)
+        h1 ^= h1 >> np.uint32(16)
+        h1 = h1 * np.uint32(0x85EBCA6B)
+        h1 ^= h1 >> np.uint32(13)
+        h1 = h1 * np.uint32(0xC2B2AE35)
+        h1 ^= h1 >> np.uint32(16)
+    return h1
+
+
+def hash_bytes_host(data: bytes, seed: int = 42) -> int:
+    """Spark Murmur3_x86_32.hashUnsafeBytes of one byte string (scalar)."""
+    h1 = np.uint32(seed)
+    n = len(data)
+    aligned = n - n % 4
+    for i in range(0, aligned, 4):
+        word = np.uint32(int.from_bytes(data[i:i + 4], "little"))
+        h1 = _np_mix_h1(h1, _np_mix_k1(word))
+    for i in range(aligned, n):
+        b = data[i]
+        sb = b - 256 if b >= 128 else b  # sign-extended java byte
+        h1 = _np_mix_h1(h1, _np_mix_k1(np.uint32(np.int32(sb))))
+    return int(_np_fmix(h1, n))
+
+
+def hash_long_host(v: int, seed: int = 42) -> int:
+    u = np.uint64(np.int64(v).view(np.uint64) if hasattr(v, "view")
+                  else np.int64(v).astype(np.uint64))
+    low = np.uint32(u & np.uint64(0xFFFFFFFF))
+    high = np.uint32(u >> np.uint64(32))
+    h1 = _np_mix_h1(np.uint32(seed), _np_mix_k1(low))
+    h1 = _np_mix_h1(h1, _np_mix_k1(high))
+    return int(_np_fmix(h1, 8))
+
+
+def hash_int_host(v: int, seed: int = 42) -> int:
+    return int(_np_hash_int(
+        np.array(np.int32(v)).view(np.uint32), np.uint32(seed)
+    ))
+
+
+def hash_rows_host(columns, num_rows: int) -> np.ndarray:
+    """Exact Spark multi-column hash on host, as int32 per row.
+
+    `columns` is a list of (numpy_values, numpy_validity|None, DataType,
+    dictionary|None) - the host mirror of a batch. Strings are hashed from
+    their real utf8 bytes (dictionary lookup), everything else through the
+    same int paths as the device version. The differential reference for
+    hash_columns_device and the interop path for string shuffle keys.
+    """
+    h = np.full(num_rows, SPARK_SEED, dtype=np.uint32)
+    for values, validity, dtype, dictionary in columns:
+        tid = dtype.id
+        if tid in (TypeId.UTF8, TypeId.BINARY):
+            assert dictionary is not None
+            dvals = dictionary.to_pylist()
+            per_row = np.empty(num_rows, dtype=np.uint32)
+            codes = values[:num_rows].astype(np.int64)
+            # hash per distinct dictionary value per distinct running seed
+            # would be quadratic; do row-wise (C++ runtime does this in bulk)
+            for i in range(num_rows):
+                s = dvals[codes[i]]
+                b = s if isinstance(s, bytes) else str(s).encode("utf-8")
+                per_row[i] = np.uint32(hash_bytes_host(b, int(h[i])))
+            link = per_row
+        elif tid in (TypeId.BOOL,):
+            link = _np_hash_int(values[:num_rows].astype(np.uint32), h)
+        elif tid in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32):
+            link = _np_hash_int(
+                values[:num_rows].astype(np.int32).view(np.uint32), h
+            )
+        elif tid in (TypeId.INT64, TypeId.TIMESTAMP_US) or (
+            tid is TypeId.DECIMAL and dtype.precision <= 18
+        ):
+            u = values[:num_rows].astype(np.int64).view(np.uint64)
+            low = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            high = (u >> np.uint64(32)).astype(np.uint32)
+            h1 = _np_mix_h1(h, _np_mix_k1(low))
+            h1 = _np_mix_h1(h1, _np_mix_k1(high))
+            link = _np_fmix(h1, 8)
+        elif tid is TypeId.FLOAT32:
+            v = values[:num_rows].astype(np.float32)
+            v = np.where(v == 0.0, np.float32(0.0), v)
+            link = _np_hash_int(v.view(np.uint32), h)
+        elif tid is TypeId.FLOAT64:
+            v = values[:num_rows].astype(np.float64)
+            v = np.where(v == 0.0, 0.0, v)
+            u = v.view(np.uint64)
+            low = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            high = (u >> np.uint64(32)).astype(np.uint32)
+            h1 = _np_mix_h1(h, _np_mix_k1(low))
+            h1 = _np_mix_h1(h1, _np_mix_k1(high))
+            link = _np_fmix(h1, 8)
+        else:
+            raise NotImplementedError(f"host hash of {dtype}")
+        if validity is not None:
+            link = np.where(validity[:num_rows], link, h)
+        h = link
+    return h.view(np.int32)

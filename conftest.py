@@ -3,14 +3,21 @@
 Multi-chip TPU hardware is not available in this environment; sharding and
 collective paths are validated on XLA's host platform with 8 virtual devices
 (the driver separately dry-runs the multi-chip path via __graft_entry__).
-Must run before the first `import jax`.
+
+The axon sitecustomize pre-registers the TPU backend and pins
+jax_platforms="axon,cpu", so the env var alone is not enough - override the
+config after import, before any computation runs.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

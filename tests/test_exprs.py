@@ -1,0 +1,179 @@
+"""Device expression evaluator tests: Spark null/NaN/overflow semantics."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.types import DataType
+from blaze_tpu.exprs import (
+    CaseWhen,
+    Coalesce,
+    Col,
+    If,
+    Literal,
+    ScalarFn,
+)
+from blaze_tpu.exprs.ir import bind
+from blaze_tpu.exprs.eval import DeviceEvaluator
+
+
+def run_expr(expr, data: dict, schema=None):
+    cb = ColumnBatch.from_pydict(data, schema=schema)
+    bound = bind(expr, cb.schema)
+    ev = DeviceEvaluator(
+        cb.schema,
+        [(c.values, c.validity) for c in cb.columns],
+        cb.capacity,
+    )
+    v, m = ev.evaluate(bound)
+    n = cb.num_rows
+    vals = np.asarray(v)[:n]
+    mask = np.asarray(m)[:n] if m is not None else np.ones(n, dtype=bool)
+    return [
+        (vals[i].item() if mask[i] else None) for i in range(n)
+    ]
+
+
+def test_arithmetic_null_propagation():
+    out = run_expr(
+        Col("a") + Col("b"),
+        {"a": [1, None, 3], "b": [10, 20, None]},
+    )
+    assert out == [11, None, None]
+
+
+def test_division_by_zero_is_null():
+    out = run_expr(Col("a") / Col("b"), {"a": [10, 7], "b": [0, 2]})
+    assert out == [None, 3]  # integer division truncates
+    out = run_expr(
+        Col("a") / Col("b"), {"a": [10.0, 7.0], "b": [0.0, 2.0]}
+    )
+    assert out == [None, 3.5]
+
+
+def test_modulo_java_sign():
+    out = run_expr(Col("a") % Col("b"), {"a": [-7, 7, -7], "b": [3, -3, 0]})
+    assert out == [-1, 1, None]  # sign of dividend, x%0 -> NULL
+
+
+def test_three_valued_logic():
+    data = {
+        "a": [True, True, False, None, None, False],
+        "b": [True, None, None, False, None, False],
+    }
+    assert run_expr(Col("a") & Col("b"), data) == [
+        True, None, False, False, None, False,
+    ]
+    assert run_expr(Col("a") | Col("b"), data) == [
+        True, True, None, None, None, False,
+    ]
+
+
+def test_comparisons_and_nan():
+    nan = float("nan")
+    data = {"a": [1.0, nan, nan, 2.0], "b": [1.0, nan, 2.0, nan]}
+    assert run_expr(Col("a") == Col("b"), data) == [
+        True, True, False, False,
+    ]
+    assert run_expr(Col("a") > Col("b"), data) == [
+        False, False, True, False,
+    ]
+    assert run_expr(Col("a") < Col("b"), data) == [
+        False, False, False, True,
+    ]
+
+
+def test_case_when_and_if():
+    e = CaseWhen(
+        (
+            (Col("x") < 0, Literal.infer(-1)),
+            (Col("x") == 0, Literal.infer(0)),
+        ),
+        Literal.infer(1),
+    )
+    assert run_expr(e, {"x": [-5, 0, 9, None]}) == [-1, 0, 1, 1]
+    # Spark: a NULL condition is simply not matched (falls through to else)
+    e2 = If(Col("x") > 0, Col("x") * 2, Col("x") - 1)
+    assert run_expr(e2, {"x": [3, -1, None]}) == [6, -2, None]
+
+
+def test_coalesce():
+    e = Coalesce((Col("a"), Col("b"), Literal.infer(0)))
+    out = run_expr(e, {"a": [None, 1, None], "b": [7, 8, None]})
+    assert out == [7, 1, 0]
+
+
+def test_is_null_in_list():
+    assert run_expr(Col("a").is_null(), {"a": [1, None]}) == [True is False, True][::-1] or True
+    out = run_expr(Col("a").is_null(), {"a": [1, None]})
+    assert out == [False, True]
+    out = run_expr(Col("a").isin([1, 3]), {"a": [1, 2, 3, None]})
+    assert out == [True, False, True, None]
+
+
+def test_cast_truncation_and_overflow_wrap():
+    e = Col("a").cast(DataType.int32())
+    out = run_expr(e, {"a": [2**31 + 5, -1, 100]})
+    assert out == [np.int64(2**31 + 5).astype(np.int32).item(), -1, 100]
+    e2 = Col("f").cast(DataType.int64())
+    out = run_expr(e2, {"f": [2.9, -2.9]})
+    assert out == [2, -2]  # truncation toward zero
+
+
+def test_scalar_fns():
+    out = run_expr(ScalarFn("sqrt", (Col("a"),)), {"a": [4.0, 9.0, None]})
+    assert out == [2.0, 3.0, None]
+    out = run_expr(ScalarFn("abs", (Col("a"),)), {"a": [-3, 4]})
+    assert out == [3, 4]
+    out = run_expr(
+        ScalarFn("round", (Col("a"),)), {"a": [2.5, -2.5, 2.4]}
+    )
+    assert out == [3.0, -3.0, 2.0]  # HALF_UP, not banker's
+
+
+def test_date_parts():
+    import pyarrow as pa
+
+    rb = pa.RecordBatch.from_pydict(
+        {"d": pa.array([0, 19723, -1], type=pa.int32()).cast(pa.date32())}
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    ev = DeviceEvaluator(
+        cb.schema,
+        [(c.values, c.validity) for c in cb.columns],
+        cb.capacity,
+    )
+    bound = bind(ScalarFn("year", (Col("d"),)), cb.schema)
+    v, _ = ev.evaluate(bound)
+    # 1970-01-01, 2024-01-01, 1969-12-31
+    assert np.asarray(v)[:3].tolist() == [1970, 2024, 1969]
+    bound = bind(ScalarFn("month", (Col("d"),)), cb.schema)
+    v, _ = ev.evaluate(bound)
+    assert np.asarray(v)[:3].tolist() == [1, 1, 12]
+    bound = bind(ScalarFn("day", (Col("d"),)), cb.schema)
+    v, _ = ev.evaluate(bound)
+    assert np.asarray(v)[:3].tolist() == [1, 1, 31]
+
+
+def test_eval_inside_jit():
+    """The evaluator must trace cleanly under jax.jit."""
+    cb = ColumnBatch.from_pydict({"a": [1, 2, None, 4], "b": [2, 2, 2, 2]})
+    bound = bind((Col("a") * Col("b")) + 1, cb.schema)
+
+    @jax.jit
+    def f(bufs):
+        ev = DeviceEvaluator(
+            cb.schema,
+            [(bufs[0], bufs[1]), (bufs[2], None)],
+            cb.capacity,
+        )
+        return ev.evaluate(bound)
+
+    a = cb.columns[0]
+    b = cb.columns[1]
+    v, m = f([a.values, a.validity, b.values])
+    out = np.asarray(v)[:4]
+    mask = np.asarray(m)[:4]
+    assert out[mask].tolist() == [3, 5, 9]
