@@ -64,9 +64,19 @@ class Column:
 
 @dataclasses.dataclass
 class ColumnBatch:
+    """Batch of padded device columns.
+
+    `selection` is an optional device-resident row mask (the deferred
+    selection vector of SURVEY 7): a row is live iff its index < num_rows
+    AND selection[i]. Filters set it lazily so no host sync happens
+    mid-pipeline; pipeline breakers (sort/aggregate/join/exchange) and the
+    host boundary compact it away.
+    """
+
     schema: Schema
     columns: List[Column]
     num_rows: int
+    selection: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
@@ -195,18 +205,32 @@ class ColumnBatch:
             cols.append(Column(dt, jnp.asarray(padded), validity, dictionary))
         return ColumnBatch(schema, cols, n)
 
+    def live_mask(self) -> jax.Array:
+        m = row_mask(self.num_rows, self.capacity)
+        if self.selection is not None:
+            m = m & self.selection
+        return m
+
     def to_arrow(self):
         """Materialize the live rows back to a pyarrow RecordBatch."""
         import pyarrow as pa
 
         n = self.num_rows
+        sel = None
+        if self.selection is not None:
+            sel = np.asarray(self.selection)[:n]
+            n = int(sel.sum())
         arrays = []
         fields = []
         for field, col in zip(self.schema, self.columns):
-            vals = np.asarray(col.values)[:n]
+            vals = np.asarray(col.values)[: self.num_rows]
             mask = None
             if col.validity is not None:
-                mask = ~np.asarray(col.validity)[:n]
+                mask = ~np.asarray(col.validity)[: self.num_rows]
+            if sel is not None:
+                vals = vals[sel]
+                if mask is not None:
+                    mask = mask[sel]
             dt = field.dtype
             if dt.is_dictionary_encoded:
                 codes = vals.astype(np.int32)

@@ -1,0 +1,171 @@
+"""Host (pyarrow) expression evaluator for string-typed subtrees.
+
+TPUs have no string compute, so the pipeline compiler splits each expression
+tree at the type boundary (SURVEY 7 design stance): any node with a direct
+string-typed input is evaluated here, over the batch's real utf8 data, and
+re-enters the device pipeline as a precomputed column. Null propagation
+comes from pyarrow compute kernels natively (matching Spark for the ops
+used). Also serves as the engine-independent differential reference for
+device results in tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.types import DataType, Schema, TypeId, to_arrow_type
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import Op
+
+
+class HostEvaluator:
+    """Evaluates bound expressions against positional pyarrow arrays
+    (full batch rows, no selection applied - alignment matters)."""
+
+    def __init__(self, schema: Schema, arrays: List[pa.Array]):
+        self.schema = schema
+        self.arrays = arrays
+        self.length = len(arrays[0]) if arrays else 0
+
+    def evaluate(self, e: ir.Expr) -> pa.Array:
+        if isinstance(e, ir.BoundCol):
+            return self.arrays[e.index]
+        if isinstance(e, ir.Literal):
+            if e.value is None:
+                return pa.nulls(self.length)
+            return pa.array(
+                [e.value] * self.length, type=to_arrow_type(e.dtype)
+            )
+        if isinstance(e, ir.Cast):
+            child = self.evaluate(e.child)
+            return pc.cast(child, to_arrow_type(e.to), safe=False)
+        if isinstance(e, ir.BinaryOp):
+            return self._binary(e)
+        if isinstance(e, ir.Not):
+            return pc.invert(self.evaluate(e.child))
+        if isinstance(e, ir.IsNull):
+            return pc.is_null(self.evaluate(e.child))
+        if isinstance(e, ir.IsNotNull):
+            return pc.is_valid(self.evaluate(e.child))
+        if isinstance(e, ir.InList):
+            v = self.evaluate(e.child)
+            items = [
+                x.value for x in e.values
+                if isinstance(x, ir.Literal) and x.value is not None
+            ]
+            out = pc.is_in(v, value_set=pa.array(items))
+            if e.negated:
+                out = pc.invert(out)
+            # propagate child nulls (pc.is_in treats null as not-found)
+            return pc.if_else(pc.is_valid(v), out, pa.nulls(self.length))
+        if isinstance(e, ir.If):
+            return pc.if_else(
+                self.evaluate(e.cond),
+                self.evaluate(e.then),
+                self.evaluate(e.otherwise),
+            )
+        if isinstance(e, ir.CaseWhen):
+            acc = (
+                self.evaluate(e.otherwise)
+                if e.otherwise is not None
+                else pa.nulls(self.length)
+            )
+            for cond, res in reversed(e.branches):
+                c = self.evaluate(cond)
+                c = pc.fill_null(c, False)
+                acc = pc.if_else(c, self.evaluate(res), acc)
+            return acc
+        if isinstance(e, ir.Coalesce):
+            return pc.coalesce(*[self.evaluate(a) for a in e.args])
+        if isinstance(e, ir.ScalarFn):
+            return self._scalar_fn(e)
+        raise NotImplementedError(f"host eval: {type(e).__name__}")
+
+    def _binary(self, e: ir.BinaryOp) -> pa.Array:
+        l = self.evaluate(e.left)
+        r = self.evaluate(e.right)
+        cmp = {
+            Op.EQ: pc.equal,
+            Op.NEQ: pc.not_equal,
+            Op.LT: pc.less,
+            Op.LTE: pc.less_equal,
+            Op.GT: pc.greater,
+            Op.GTE: pc.greater_equal,
+        }
+        if e.op in cmp:
+            return cmp[e.op](l, r)
+        if e.op is Op.AND:
+            return pc.and_kleene(l, r)
+        if e.op is Op.OR:
+            return pc.or_kleene(l, r)
+        arith = {
+            Op.ADD: pc.add,
+            Op.SUB: pc.subtract,
+            Op.MUL: pc.multiply,
+        }
+        if e.op in arith:
+            return arith[e.op](l, r)
+        if e.op is Op.DIV:
+            # Spark: divide-by-zero -> NULL
+            zero = pc.equal(r, pa.scalar(0, type=r.type))
+            safe = pc.if_else(zero, pa.scalar(1, type=r.type), r)
+            out = pc.divide(l, safe)
+            return pc.if_else(zero, pa.nulls(self.length, out.type), out)
+        raise NotImplementedError(f"host binary {e.op}")
+
+    def _scalar_fn(self, e: ir.ScalarFn) -> pa.Array:
+        n = e.name
+        args = [self.evaluate(a) for a in e.args]
+        if n == "lower":
+            return pc.utf8_lower(args[0])
+        if n == "upper":
+            return pc.utf8_upper(args[0])
+        if n == "trim":
+            return pc.utf8_trim_whitespace(args[0])
+        if n == "ltrim":
+            return pc.utf8_ltrim_whitespace(args[0])
+        if n == "rtrim":
+            return pc.utf8_rtrim_whitespace(args[0])
+        if n in ("length", "char_length"):
+            return pc.cast(pc.utf8_length(args[0]), pa.int32())
+        if n == "reverse":
+            return pc.utf8_reverse(args[0])
+        if n == "starts_with":
+            return pc.starts_with(args[0], pattern=_pat(e.args[1]))
+        if n == "ends_with":
+            return pc.ends_with(args[0], pattern=_pat(e.args[1]))
+        if n == "contains":
+            return pc.match_substring(args[0], pattern=_pat(e.args[1]))
+        if n == "like":
+            return pc.match_like(args[0], pattern=_pat(e.args[1]))
+        if n == "substring":
+            # Spark 1-based start; 0 behaves like 1
+            start = _int_lit(e.args[1])
+            length = _int_lit(e.args[2]) if len(e.args) > 2 else None
+            start0 = start - 1 if start > 0 else max(start, 0)
+            stop = None if length is None else start0 + length
+            return pc.utf8_slice_codeunits(args[0], start0, stop)
+        if n == "concat":
+            return pc.binary_join_element_wise(
+                *args, "", null_handling="emit_null"
+            )
+        if n == "replace":
+            return pc.replace_substring(
+                args[0], pattern=_pat(e.args[1]),
+                replacement=_pat(e.args[2]),
+            )
+        raise NotImplementedError(f"host scalar fn {n}")
+
+
+def _pat(e: ir.Expr) -> str:
+    assert isinstance(e, ir.Literal), "pattern must be a literal"
+    return e.value
+
+
+def _int_lit(e: ir.Expr) -> int:
+    assert isinstance(e, ir.Literal), "argument must be a literal"
+    return int(e.value)

@@ -307,6 +307,44 @@ def children(e: Expr) -> Tuple[Expr, ...]:
     raise TypeError(f"unknown expr {type(e)}")
 
 
+def with_children(e: Expr, kids) -> Expr:
+    """Shallow rebuild of a node with replacement children (same arity and
+    order as `children(e)`)."""
+    kids = list(kids)
+    if isinstance(e, (Literal, Col, BoundCol)):
+        return e
+    if isinstance(e, Cast):
+        return Cast(kids[0], e.to)
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, kids[0], kids[1])
+    if isinstance(e, Not):
+        return Not(kids[0])
+    if isinstance(e, Negate):
+        return Negate(kids[0])
+    if isinstance(e, IsNull):
+        return IsNull(kids[0])
+    if isinstance(e, IsNotNull):
+        return IsNotNull(kids[0])
+    if isinstance(e, InList):
+        return InList(kids[0], tuple(kids[1:]), e.negated)
+    if isinstance(e, If):
+        return If(kids[0], kids[1], kids[2])
+    if isinstance(e, CaseWhen):
+        nb = len(e.branches)
+        branches = tuple(
+            (kids[2 * i], kids[2 * i + 1]) for i in range(nb)
+        )
+        otherwise = kids[2 * nb] if e.otherwise is not None else None
+        return CaseWhen(branches, otherwise)
+    if isinstance(e, ScalarFn):
+        return ScalarFn(e.name, tuple(kids))
+    if isinstance(e, Coalesce):
+        return Coalesce(tuple(kids))
+    if isinstance(e, AggExpr):
+        return AggExpr(e.fn, kids[0] if kids else None)
+    raise TypeError(f"unknown expr {type(e)}")
+
+
 def transform(e: Expr, fn) -> Expr:
     """Bottom-up rewrite."""
     if isinstance(e, Cast):

@@ -1,0 +1,47 @@
+"""Physical operators.
+
+TPU-native equivalents of the reference's native execution tier: the custom
+datafusion-ext operators (ShuffleWriter, SortMergeJoin, IpcReader/Writer,
+RenameColumns, Debug, EmptyPartitions - SURVEY 2.1) plus the DataFusion
+operators the reference reuses (Scan, Filter, Project, Sort, Union, HashJoin,
+HashAggregate - SURVEY 2.1 note).
+
+Execution model: a host-side stream of ColumnBatch per partition (the
+reference streams Arrow RecordBatches through tokio, exec.rs:196-255); device
+compute is jit-compiled per (operator fingerprint, shape bucket). Stateless
+chains fuse into one XLA program via ops.pipeline; pipeline breakers
+materialize device-resident state.
+"""
+
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.memory_scan import MemoryScanExec
+from blaze_tpu.ops.project import ProjectExec
+from blaze_tpu.ops.filter import FilterExec
+from blaze_tpu.ops.sort import SortExec, SortKey
+from blaze_tpu.ops.union import UnionExec
+from blaze_tpu.ops.limit import LimitExec
+from blaze_tpu.ops.rename import RenameColumnsExec
+from blaze_tpu.ops.empty import EmptyPartitionsExec
+from blaze_tpu.ops.debug import DebugExec
+from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
+from blaze_tpu.ops.joins import HashJoinExec, JoinType, SortMergeJoinExec
+
+__all__ = [
+    "ExecContext",
+    "PhysicalOp",
+    "MemoryScanExec",
+    "ProjectExec",
+    "FilterExec",
+    "SortExec",
+    "SortKey",
+    "UnionExec",
+    "LimitExec",
+    "RenameColumnsExec",
+    "EmptyPartitionsExec",
+    "DebugExec",
+    "AggMode",
+    "HashAggregateExec",
+    "HashJoinExec",
+    "JoinType",
+    "SortMergeJoinExec",
+]

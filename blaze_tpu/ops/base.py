@@ -1,0 +1,101 @@
+"""Operator base: execution context, metrics, the PhysicalOp protocol."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterator, List, Optional
+
+from blaze_tpu.config import EngineConfig, get_config
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+
+
+class MetricNode:
+    """Per-operator metric tree mirroring the plan, like the reference's
+    MetricNode mirrored into Spark SQLMetrics (NativeSupports.scala:215-228,
+    native side metrics.rs:32-56). Collected after a partition's stream is
+    drained."""
+
+    def __init__(self, name: str, children: Optional[List["MetricNode"]] = None):
+        self.name = name
+        self.children = children or []
+        self.counters: Dict[str, int] = {}
+
+    def add(self, key: str, value: int) -> None:
+        self.counters[key] = self.counters.get(key, 0) + int(value)
+
+    def child(self, i: int) -> "MetricNode":
+        while len(self.children) <= i:
+            self.children.append(MetricNode(f"{self.name}.{len(self.children)}"))
+        return self.children[i]
+
+    def flatten(self) -> Dict[str, Dict[str, int]]:
+        out = {self.name: dict(self.counters)}
+        for c in self.children:
+            out.update(c.flatten())
+        return out
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """Per-task execution context (the reference's TaskDefinition partition
+    context + SessionContext config, exec.rs:137-165)."""
+
+    partition_id: int = 0
+    num_partitions: int = 1
+    task_id: str = "task-0"
+    config: EngineConfig = dataclasses.field(default_factory=get_config)
+    metrics: MetricNode = dataclasses.field(
+        default_factory=lambda: MetricNode("root")
+    )
+    # resource registry: shuffle readers/writers, broadcast values, etc.
+    # (the reference's JniBridge.resourcesMap, JniBridge.java:31)
+    resources: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+class PhysicalOp:
+    """A node in the physical plan.
+
+    `execute(partition, ctx)` yields ColumnBatches for one partition -
+    the host-side analog of DataFusion's ExecutionPlan::execute returning a
+    RecordBatch stream (reference from_proto.rs:162-560 builds these).
+    """
+
+    children: List["PhysicalOp"] = []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def partition_count(self) -> int:
+        if self.children:
+            return self.children[0].partition_count
+        return 1
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def fingerprint(self) -> str:
+        """Stable id for jit-cache keying; subclasses append params."""
+        parts = [type(self).__name__]
+        parts += [c.fingerprint() for c in self.children]
+        return f"{'/'.join(parts)}@{id(self):x}"
+
+    def timed(self, metrics: MetricNode, it: Iterator[ColumnBatch]
+              ) -> Iterator[ColumnBatch]:
+        """Wrap a batch stream with elapsed_compute / row metrics (the
+        reference's BaselineMetrics, SURVEY 5.1)."""
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            metrics.add("elapsed_compute", time.perf_counter_ns() - t0)
+            metrics.add("output_rows", b.num_rows)
+            metrics.add("output_batches", 1)
+            yield b

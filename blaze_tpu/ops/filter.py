@@ -1,0 +1,61 @@
+"""Filter: predicate -> deferred selection vector.
+
+Reference counterpart: DataFusion FilterExec (from_proto.rs:193-201; wrapper
+NativeFilterExec.scala). TPU-first difference (SURVEY 7): instead of eagerly
+compacting (dynamic output shape -> recompile), the predicate result is
+ANDed into the batch's selection mask and compaction is deferred to the next
+pipeline breaker, so shapes stay static and no host sync occurs per batch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.host_lower import lower_strings_host
+from blaze_tpu.ops.project import _unflatten_cvs
+
+
+class FilterExec(PhysicalOp):
+    def __init__(self, child: PhysicalOp, predicate: ir.Expr):
+        self.children = [child]
+        self.predicate = ir.bind(predicate, child.schema)
+        self._jit_cache = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        for cb in self.children[0].execute(partition, ctx):
+            yield self._filter(cb)
+
+    def _filter(self, cb: ColumnBatch) -> ColumnBatch:
+        exprs, _, aug = lower_strings_host([self.predicate], cb)
+        pred = exprs[0]
+        key = (pred, aug.layout())
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            in_schema = aug.schema
+            cap = aug.capacity
+
+            def run(bufs, sel, layout=aug.layout()):
+                cols = _unflatten_cvs(layout, bufs)
+                ev = DeviceEvaluator(in_schema, cols, cap)
+                keep = ev.evaluate_predicate(pred)
+                if sel is not None:
+                    keep = keep & sel
+                return keep
+
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        sel = fn(aug.device_buffers(), aug.selection)
+        return ColumnBatch(cb.schema, cb.columns, cb.num_rows, sel)

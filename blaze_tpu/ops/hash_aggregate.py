@@ -1,0 +1,547 @@
+"""Hash aggregate with PARTIAL / FINAL / COMPLETE modes.
+
+Reference counterpart: DataFusion AggregateExec built from proto
+(from_proto.rs:452-545) with Spark's two-phase mode mapping
+(NativeHashAggregateExec.scala:98-161). Supported functions mirror the
+reference's converter surface: MIN/MAX/SUM/AVG/COUNT/VAR/STDDEV
+(NativeConverters.scala:491-501) plus FIRST/LAST.
+
+TPU-first design (SURVEY 7): instead of a row-at-a-time hash table, grouping
+is a sort-based segmented reduction - one stable multi-key sort pass, group
+boundaries by comparing adjacent sorted keys (SQL semantics: NULL groups
+with NULL), then `jax.ops.segment_*` reductions with a static segment count
+(the batch capacity), so every step is one fused XLA program with static
+shapes. Variance/stddev state is (count, sum, sum-of-squares) so every
+merge is a plain segment_sum.
+
+PARTIAL mode streams: each input batch aggregates independently (bounded
+state, like the reference's partial aggregation). FINAL/COMPLETE are
+pipeline breakers that materialize the partition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.config import get_config
+from blaze_tpu.types import DataType, Field, Schema, TypeId
+from blaze_tpu.batch import Column, ColumnBatch, row_mask
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import AggExpr, AggFn
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.host_lower import lower_strings_host
+from blaze_tpu.ops.project import _unflatten_cvs
+from blaze_tpu.ops.util import concat_batches, sort_indices
+
+
+class AggMode(enum.Enum):
+    PARTIAL = "partial"
+    FINAL = "final"
+    COMPLETE = "complete"
+
+
+@dataclasses.dataclass(frozen=True)
+class NamedAgg:
+    agg: AggExpr
+    name: str
+
+
+def _state_fields(agg: AggExpr, name: str, in_schema: Schema) -> List[Field]:
+    fn = agg.fn
+    if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+        return [Field(f"{name}#count", DataType.int64(), False)]
+    ct = infer_dtype(agg.child, in_schema)
+    if fn is AggFn.SUM:
+        return [Field(f"{name}#sum", _sum_type(ct), True)]
+    if fn in (AggFn.MIN, AggFn.MAX, AggFn.FIRST, AggFn.LAST):
+        return [Field(f"{name}#{fn.value}", ct, True)]
+    if fn is AggFn.AVG:
+        return [
+            Field(f"{name}#sum", _sum_type(ct), True),
+            Field(f"{name}#count", DataType.int64(), False),
+        ]
+    # var/stddev family: plain-summable moments
+    return [
+        Field(f"{name}#n", DataType.float64(), False),
+        Field(f"{name}#s1", DataType.float64(), False),
+        Field(f"{name}#s2", DataType.float64(), False),
+    ]
+
+
+def _sum_type(ct: DataType) -> DataType:
+    if ct.is_integer:
+        return DataType.int64()
+    if ct.id is TypeId.DECIMAL:
+        return DataType.decimal(38, ct.scale)
+    return DataType.float64()
+
+
+class HashAggregateExec(PhysicalOp):
+    def __init__(
+        self,
+        child: PhysicalOp,
+        keys: Sequence[Tuple[ir.Expr, str]],
+        aggs: Sequence[Tuple[AggExpr, str]],
+        mode: AggMode = AggMode.COMPLETE,
+    ):
+        self.children = [child]
+        self.mode = mode
+        in_schema = child.schema
+        self.keys = [(ir.bind(e, in_schema), n) for e, n in keys]
+        if mode is AggMode.FINAL:
+            # child refs are ignored in FINAL mode; states are located
+            # positionally in the partial output (keys first, then states
+            # in agg order) - mirror of the reference's partial/final
+            # column splice (NativeHashAggregateExec.scala:98-161)
+            self.aggs = []
+            pos = len(self.keys)
+            for a, n in aggs:
+                first_state = in_schema.fields[pos]
+                self.aggs.append(
+                    (AggExpr(a.fn, ir.BoundCol(pos, first_state.dtype)), n)
+                )
+                pos += _state_width(a)
+        else:
+            self.aggs = [
+                (
+                    AggExpr(
+                        a.fn,
+                        ir.bind(a.child, in_schema)
+                        if a.child is not None
+                        else None,
+                    ),
+                    n,
+                )
+                for a, n in aggs
+            ]
+        for a, n in self.aggs:
+            if a.fn in (AggFn.MIN, AggFn.MAX) and a.child is not None:
+                if infer_dtype(a.child, in_schema).is_string_like:
+                    raise NotImplementedError(
+                        "MIN/MAX over strings is host-tier work (planned)"
+                    )
+        key_fields = [
+            Field(n, infer_dtype(e, in_schema), True) for e, n in self.keys
+        ]
+        if mode is AggMode.PARTIAL:
+            state_fields: List[Field] = []
+            for a, n in self.aggs:
+                state_fields += _state_fields(a, n, in_schema)
+            self._schema = Schema(key_fields + state_fields)
+        else:
+            self._schema = Schema(
+                key_fields
+                + [
+                    Field(n, _result_type(a, in_schema, mode), True)
+                    for a, n in self.aggs
+                ]
+            )
+        self._jit_cache = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    # ------------------------------------------------------------------
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        child_it = self.children[0].execute(partition, ctx)
+        if self.mode is AggMode.PARTIAL:
+            for cb in child_it:
+                out = self._aggregate_batch(cb)
+                if out.num_rows > 0:
+                    yield out
+        else:
+            batches = list(child_it)
+            cb = concat_batches(batches, schema=self.children[0].schema)
+            if cb.num_rows == 0 and self.keys:
+                return
+            out = self._aggregate_batch(cb)
+            if cb.num_rows == 0 and not self.keys:
+                # global aggregate over empty input still emits one row
+                yield _empty_global_row(self)
+                return
+            yield out
+
+    # ------------------------------------------------------------------
+    def _aggregate_batch(self, cb: ColumnBatch) -> ColumnBatch:
+        merging = self.mode is AggMode.FINAL
+        key_exprs = [e for e, _ in self.keys]
+        child_exprs: List[ir.Expr] = []
+        for a, _ in self.aggs:
+            if merging:
+                continue
+            if a.child is not None:
+                child_exprs.append(a.child)
+        exprs, _, aug = lower_strings_host(key_exprs + child_exprs, cb)
+        key_exprs_l = exprs[: len(key_exprs)]
+        child_map = {}
+        if not merging:
+            it = iter(exprs[len(key_exprs):])
+            for i, (a, _) in enumerate(self.aggs):
+                if a.child is not None:
+                    child_map[i] = next(it)
+
+        key = (tuple(key_exprs_l), tuple(child_map.items()),
+               aug.layout(), merging)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                self._build_kernel(aug.schema, aug.capacity,
+                                   key_exprs_l, child_map, merging,
+                                   aug.layout())
+            )
+            self._jit_cache[key] = fn
+        outs, n_groups = fn(
+            aug.device_buffers(), aug.selection, aug.num_rows
+        )
+        n = int(n_groups)
+        cols: List[Column] = []
+        # recover dictionaries for string key passthroughs
+        for (v, m), field, e in zip(
+            outs[: len(self.keys)],
+            self._schema.fields[: len(self.keys)],
+            key_exprs_l,
+        ):
+            dictionary = None
+            if field.dtype.is_dictionary_encoded and isinstance(
+                e, ir.BoundCol
+            ):
+                dictionary = aug.columns[e.index].dictionary
+            cols.append(Column(field.dtype, v, m, dictionary))
+        for (v, m), field in zip(
+            outs[len(self.keys):], self._schema.fields[len(self.keys):]
+        ):
+            cols.append(Column(field.dtype, v, m, None))
+        return ColumnBatch(self._schema, cols, n)
+
+    # ------------------------------------------------------------------
+    def _build_kernel(self, in_schema, capacity, key_exprs, child_map,
+                      merging, layout):
+        aggs = self.aggs
+        n_keys = len(key_exprs)
+        state_offsets = self._state_offsets(in_schema) if merging else None
+
+        def kernel(bufs, selection, num_rows):
+            cols = _unflatten_cvs(layout, bufs)
+            ev = DeviceEvaluator(in_schema, cols, capacity)
+            live = jnp.arange(capacity) < num_rows
+            if selection is not None:
+                live = live & selection
+
+            keys_cv = [ev.evaluate(e) for e in key_exprs]
+            # ---- group ids by stable sort + boundary detection ----
+            if n_keys:
+                # sort priority: live rows first, then per key a (validity,
+                # value) pair so NULL forms its own ordering class and never
+                # interleaves with the dtype-extreme sentinel values
+                priority = [jnp.where(live, 0, 1).astype(jnp.int8)]
+                for v, m in keys_cv:
+                    if m is not None:
+                        priority.append(
+                            jnp.where(m, jnp.int8(1), jnp.int8(0))
+                        )
+                    priority.append(_null_last_key(v, m))
+                # jnp.lexsort: last key is the primary -> reverse
+                order = jnp.lexsort(tuple(reversed(priority)))
+                idx = order
+                s_live = jnp.take(live, idx)
+                boundary = jnp.zeros(capacity, dtype=jnp.bool_)
+                first_live = s_live & ~jnp.concatenate(
+                    [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
+                )
+                diff = jnp.zeros(capacity, dtype=jnp.bool_)
+                for v, m in keys_cv:
+                    sv = jnp.take(v, idx)
+                    svp = jnp.concatenate([sv[:1], sv[:-1]])
+                    neq = sv != svp
+                    if m is not None:
+                        sm = jnp.take(m, idx)
+                        smp = jnp.concatenate([sm[:1], sm[:-1]])
+                        neq = jnp.where(
+                            sm & smp, neq, sm != smp
+                        )
+                    diff = diff | neq
+                boundary = s_live & (diff | first_live)
+                gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+                gid_sorted = jnp.where(s_live, gid_sorted, capacity - 1)
+                n_groups = jnp.sum(boundary.astype(jnp.int32))
+                # boundary row index per group, padded
+                bpos = jnp.nonzero(
+                    boundary, size=capacity, fill_value=0
+                )[0]
+            else:
+                idx = jnp.arange(capacity)
+                s_live = live
+                gid_sorted = jnp.where(live, 0, capacity - 1)
+                n_groups = jnp.asarray(1, jnp.int32)
+                bpos = jnp.zeros(capacity, dtype=jnp.int32)
+
+            outs = []
+            for (v, m) in keys_cv:
+                sv = jnp.take(v, idx)
+                kv = jnp.take(sv, bpos)
+                km = None
+                if m is not None:
+                    km = jnp.take(jnp.take(m, idx), bpos)
+                outs.append((kv, km))
+
+            for i, (a, name) in enumerate(aggs):
+                outs.extend(
+                    self._agg_state(
+                        a, i, ev, idx, s_live, gid_sorted, capacity,
+                        child_map, merging, state_offsets, cols,
+                    )
+                )
+            return outs, n_groups
+
+        return kernel
+
+    def _state_offsets(self, in_schema: Schema):
+        """In FINAL mode, locate each agg's state columns positionally:
+        keys first, then state columns in agg order."""
+        offs = {}
+        pos = len(self.keys)
+        for i, (a, n) in enumerate(self.aggs):
+            width = _state_width(a)
+            offs[i] = (pos, width)
+            pos += width
+        return offs
+
+    def _agg_state(self, a, i, ev, idx, s_live, gid, capacity,
+                   child_map, merging, state_offsets, cols):
+        """Emit the output (value, validity) columns for one aggregate."""
+        fn = a.fn
+        seg = lambda x: jax.ops.segment_sum(x, gid, num_segments=capacity)
+        live_f = s_live
+
+        if merging:
+            pos, width = state_offsets[i]
+            states = [
+                (jnp.take(cols[pos + k][0], idx),
+                 jnp.take(cols[pos + k][1], idx)
+                 if cols[pos + k][1] is not None else None)
+                for k in range(width)
+            ]
+            return self._merge_states(a, states, seg, live_f, gid, capacity)
+
+        # raw input -> state/result
+        if fn is AggFn.COUNT_STAR:
+            c = seg(live_f.astype(jnp.int64))
+            return [(c, None)]
+        cv, cm = ev.evaluate(child_map[i])
+        cv = jnp.take(cv, idx)
+        cm_s = jnp.take(cm, idx) if cm is not None else None
+        contrib = live_f if cm_s is None else (live_f & cm_s)
+        if fn is AggFn.COUNT:
+            return [(seg(contrib.astype(jnp.int64)), None)]
+        if fn in (AggFn.SUM, AggFn.AVG):
+            st = _sum_type(infer_dtype_of(a, ev.schema))
+            acc = jnp.where(contrib, cv, jnp.zeros_like(cv)).astype(
+                st.physical_dtype()
+            )
+            s = seg(acc)
+            any_v = seg(contrib.astype(jnp.int64)) > 0
+            if fn is AggFn.SUM:
+                return [(s, any_v)]
+            cnt = seg(contrib.astype(jnp.int64))
+            if self.mode is AggMode.PARTIAL:
+                return [(s, any_v), (cnt, None)]
+            safe = jnp.maximum(cnt, 1)
+            if st.id is TypeId.DECIMAL:
+                avg = jnp.asarray(s, jnp.int64) * 10000 // safe  # scale+4
+                return [(avg, any_v)]
+            return [(s / safe.astype(jnp.float64), any_v)]
+        if fn in (AggFn.MIN, AggFn.MAX):
+            phys = cv.dtype
+            if jnp.issubdtype(phys, jnp.floating):
+                neutral = jnp.inf if fn is AggFn.MIN else -jnp.inf
+            elif phys == jnp.bool_:
+                cv = cv.astype(jnp.int8)
+                neutral = 1 if fn is AggFn.MIN else 0
+                phys = jnp.int8
+            else:
+                info = jnp.iinfo(phys)
+                neutral = info.max if fn is AggFn.MIN else info.min
+            acc = jnp.where(contrib, cv, jnp.asarray(neutral, phys))
+            red = (
+                jax.ops.segment_min
+                if fn is AggFn.MIN
+                else jax.ops.segment_max
+            )
+            m = red(acc, gid, num_segments=capacity)
+            any_v = seg(contrib.astype(jnp.int64)) > 0
+            return [(m, any_v)]
+        if fn in (AggFn.FIRST, AggFn.LAST):
+            pos_in = jnp.arange(capacity)
+            big = capacity + 1
+            if fn is AggFn.FIRST:
+                rank = jnp.where(contrib, pos_in, big)
+                best = jax.ops.segment_min(
+                    rank, gid, num_segments=capacity
+                )
+            else:
+                rank = jnp.where(contrib, pos_in, -1)
+                best = jax.ops.segment_max(
+                    rank, gid, num_segments=capacity
+                )
+            has = (best >= 0) & (best < big)
+            safe_best = jnp.clip(best, 0, capacity - 1)
+            vals = jnp.take(cv, safe_best)
+            return [(vals, has)]
+        # var/stddev family: moments
+        x = jnp.where(contrib, cv, jnp.zeros_like(cv)).astype(jnp.float64)
+        n = seg(contrib.astype(jnp.float64))
+        s1 = seg(x)
+        s2 = seg(x * x)
+        if self.mode is AggMode.PARTIAL:
+            return [(n, None), (s1, None), (s2, None)]
+        return [_finalize_var(a.fn, n, s1, s2)]
+
+    def _merge_states(self, a, states, seg, live_f, gid, capacity):
+        fn = a.fn
+        if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+            v, _ = states[0]
+            return [(seg(jnp.where(live_f, v, 0)), None)]
+        if fn is AggFn.SUM:
+            v, m = states[0]
+            contrib = live_f if m is None else (live_f & m)
+            s = seg(jnp.where(contrib, v, jnp.zeros_like(v)))
+            any_v = seg(contrib.astype(jnp.int64)) > 0
+            return [(s, any_v)]
+        if fn in (AggFn.MIN, AggFn.MAX):
+            v, m = states[0]
+            contrib = live_f if m is None else (live_f & m)
+            phys = v.dtype
+            if jnp.issubdtype(phys, jnp.floating):
+                neutral = jnp.inf if fn is AggFn.MIN else -jnp.inf
+            else:
+                info = jnp.iinfo(phys)
+                neutral = info.max if fn is AggFn.MIN else info.min
+            acc = jnp.where(contrib, v, jnp.asarray(neutral, phys))
+            red = (
+                jax.ops.segment_min
+                if fn is AggFn.MIN
+                else jax.ops.segment_max
+            )
+            out = red(acc, gid, num_segments=capacity)
+            any_v = seg(contrib.astype(jnp.int64)) > 0
+            return [(out, any_v)]
+        if fn is AggFn.AVG:
+            (sv, sm), (cv2, _) = states
+            contrib = live_f if sm is None else (live_f & sm)
+            s = seg(jnp.where(contrib, sv, jnp.zeros_like(sv)))
+            c = seg(jnp.where(live_f, cv2, jnp.zeros_like(cv2)))
+            any_v = c > 0
+            safe = jnp.maximum(c, 1)
+            if jnp.issubdtype(sv.dtype, jnp.integer):
+                avg = s * 10000 // safe
+                return [(avg, any_v)]
+            return [(s / safe.astype(jnp.float64), any_v)]
+        if fn in (AggFn.FIRST, AggFn.LAST):
+            v, m = states[0]
+            contrib = live_f if m is None else (live_f & m)
+            pos_in = jnp.arange(capacity)
+            big = capacity + 1
+            if fn is AggFn.FIRST:
+                rank = jnp.where(contrib, pos_in, big)
+                best = jax.ops.segment_min(rank, gid, num_segments=capacity)
+            else:
+                rank = jnp.where(contrib, pos_in, -1)
+                best = jax.ops.segment_max(rank, gid, num_segments=capacity)
+            has = (best >= 0) & (best < big)
+            vals = jnp.take(v, jnp.clip(best, 0, capacity - 1))
+            return [(vals, has)]
+        # moments merge
+        (nv, _), (s1v, _), (s2v, _) = states
+        n = seg(jnp.where(live_f, nv, 0.0))
+        s1 = seg(jnp.where(live_f, s1v, 0.0))
+        s2 = seg(jnp.where(live_f, s2v, 0.0))
+        return [_finalize_var(fn, n, s1, s2)]
+
+
+def _null_last_key(v, m):
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jnp.where(jnp.isnan(v), jnp.inf, v)
+    if m is None:
+        return v
+    # nulls group first: shift valid values up by using a rank pair trick -
+    # lexsort handles composite keys, so encode null rank into the value
+    # domain where possible; use where() with dtype extremes
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return jnp.where(m, v, -jnp.inf)
+    if v.dtype == jnp.bool_:
+        return jnp.where(m, v.astype(jnp.int8), jnp.int8(-1))
+    info = jnp.iinfo(v.dtype)
+    return jnp.where(m, v, info.min)
+
+
+def _finalize_var(fn: AggFn, n, s1, s2):
+    mean = s1 / jnp.maximum(n, 1.0)
+    m2 = s2 - s1 * mean  # sum((x-mean)^2) = s2 - s1^2/n
+    pop = fn in (AggFn.VAR_POP, AggFn.STDDEV_POP)
+    denom = jnp.maximum(n if pop else n - 1.0, 1.0)
+    var = jnp.maximum(m2, 0.0) / denom
+    valid = n > (0.0 if pop else 1.0)
+    out = var
+    if fn in (AggFn.STDDEV_SAMP, AggFn.STDDEV_POP):
+        out = jnp.sqrt(var)
+    return (out, valid)
+
+
+def _state_width(a: AggExpr) -> int:
+    if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR, AggFn.SUM, AggFn.MIN,
+                AggFn.MAX, AggFn.FIRST, AggFn.LAST):
+        return 1
+    if a.fn is AggFn.AVG:
+        return 2
+    return 3
+
+
+def _result_type(a: AggExpr, in_schema: Schema, mode: AggMode) -> DataType:
+    if mode is AggMode.FINAL:
+        # child is a BoundCol at the first state column (see __init__)
+        if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+            return DataType.int64()
+        st = a.child.dtype
+        if a.fn is AggFn.SUM or a.fn in (
+            AggFn.MIN, AggFn.MAX, AggFn.FIRST, AggFn.LAST
+        ):
+            return st
+        if a.fn is AggFn.AVG:
+            if st.id is TypeId.DECIMAL:
+                return DataType.decimal(38, min(st.scale + 4, 38))
+            return DataType.float64()
+        return DataType.float64()  # var/stddev
+    return infer_dtype(a, in_schema)
+
+
+def infer_dtype_of(a: AggExpr, schema: Schema) -> DataType:
+    return infer_dtype(a.child, schema)
+
+
+def _empty_global_row(op: HashAggregateExec) -> ColumnBatch:
+    """Global aggregate of an empty stream: COUNT=0, others NULL."""
+    cols = []
+    cap = get_config().shape_buckets[0]
+    for field, (a, _) in zip(op.schema.fields, op.aggs):
+        phys = field.dtype.physical_dtype()
+        v = jnp.zeros(cap, dtype=phys)
+        if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+            cols.append(Column(field.dtype, v, None, None))
+        else:
+            cols.append(
+                Column(
+                    field.dtype, v, jnp.zeros(cap, dtype=jnp.bool_), None
+                )
+            )
+    return ColumnBatch(op.schema, cols, 1)

@@ -1,0 +1,117 @@
+"""Split expression trees at the string-type boundary.
+
+`lower_strings_host(exprs, batch)` rewrites each bound expression so that
+any node with a direct string-typed input is evaluated host-side (pyarrow)
+over the batch and replaced by a reference to a new precomputed column
+appended to an augmented batch. Device pipelines then never see string
+semantics - only int32 codes passing through untouched, plus host-computed
+bool/int/string-result columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.config import get_config
+from blaze_tpu.types import (
+    DataType,
+    Field,
+    Schema,
+    TypeId,
+    from_arrow_type,
+)
+from blaze_tpu.batch import Column, ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.host_eval import HostEvaluator
+from blaze_tpu.exprs.typing import infer_dtype
+
+
+def _positional_arrays(cb: ColumnBatch) -> List[pa.Array]:
+    """Full-capacity-aligned pyarrow arrays of the first num_rows rows,
+    ignoring selection (alignment with device buffers matters)."""
+    full = ColumnBatch(cb.schema, cb.columns, cb.num_rows, None)
+    rb = full.to_arrow()
+    return [rb.column(i) for i in range(rb.num_columns)]
+
+
+class _Lowerer:
+    def __init__(self, cb: ColumnBatch):
+        self.cb = cb
+        self.schema = cb.schema
+        self.new_fields: List[Field] = []
+        self.new_columns: List[Column] = []
+        self._arrays: Optional[List[pa.Array]] = None
+        self._cache = {}
+
+    def arrays(self) -> List[pa.Array]:
+        if self._arrays is None:
+            self._arrays = _positional_arrays(self.cb)
+        return self._arrays
+
+    def aug_schema(self) -> Schema:
+        return Schema(list(self.schema.fields) + self.new_fields)
+
+    def lower(self, e: ir.Expr) -> ir.Expr:
+        e = self._lower_children(e)
+        if isinstance(e, (ir.BoundCol, ir.Literal)):
+            return e
+        if any(
+            infer_dtype(c, self.aug_schema()).is_string_like
+            for c in ir.children(e)
+        ):
+            return self._hoist(e)
+        return e
+
+    def _lower_children(self, e: ir.Expr) -> ir.Expr:
+        return _rebuild_with_children(
+            e, [self.lower(c) for c in ir.children(e)]
+        )
+
+    def _hoist(self, e: ir.Expr) -> ir.Expr:
+        if e in self._cache:
+            return self._cache[e]
+        ev = HostEvaluator(self.aug_schema(), self.arrays())
+        arr = ev.evaluate(e)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        dt = from_arrow_type(arr.type)
+        cap = self.cb.capacity
+        tmp = ColumnBatch.from_arrow(
+            pa.RecordBatch.from_arrays([arr], names=["x"]), capacity=cap
+        )
+        col = tmp.columns[0]
+        idx = len(self.schema) + len(self.new_fields)
+        self.new_fields.append(Field(f"__host_{idx}", dt, True))
+        self.new_columns.append(col)
+        self._arrays = self.arrays() + [arr]
+        ref = ir.BoundCol(idx, dt)
+        self._cache[e] = ref
+        return ref
+
+
+def _rebuild_with_children(e: ir.Expr, kids: List[ir.Expr]) -> ir.Expr:
+    return ir.with_children(e, kids)
+
+
+def lower_strings_host(
+    exprs: Sequence[ir.Expr], cb: ColumnBatch
+) -> Tuple[List[ir.Expr], int, ColumnBatch]:
+    """Returns (rewritten exprs, n new columns, augmented batch)."""
+    lw = _Lowerer(cb)
+    out = [lw.lower(e) for e in exprs]
+    if not lw.new_columns:
+        return list(out), 0, cb
+    aug = ColumnBatch(
+        lw.aug_schema(),
+        list(cb.columns) + lw.new_columns,
+        cb.num_rows,
+        cb.selection,
+    )
+    return list(out), len(lw.new_columns), aug

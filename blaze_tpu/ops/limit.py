@@ -1,0 +1,34 @@
+"""Per-partition limit (Spark CollectLimit/LocalLimit analog)."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.util import ensure_compacted
+
+
+class LimitExec(PhysicalOp):
+    def __init__(self, child: PhysicalOp, limit: int):
+        self.children = [child]
+        self.limit = limit
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        remaining = self.limit
+        for cb in self.children[0].execute(partition, ctx):
+            if remaining <= 0:
+                return
+            cb = ensure_compacted(cb)
+            if cb.num_rows > remaining:
+                cb = ColumnBatch(
+                    cb.schema, cb.columns, remaining, None
+                )
+            remaining -= cb.num_rows
+            yield cb

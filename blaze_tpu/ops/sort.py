@@ -1,0 +1,110 @@
+"""Sort: pipeline-breaking multi-key sort.
+
+Reference counterpart: DataFusion SortExec, partition-preserving
+(from_proto.rs:306-348; wrapper NativeSortExec.scala). TPU design: collect
+the partition into one padded device buffer, one XLA sort pass per key
+(iterated stable lexsort, ops/util.sort_indices), then re-slice into
+bucket-sized batches. String keys become comparable by sorting against a
+lexicographically-ordered unified dictionary (host) and remapping codes, so
+the device compares int32 codes only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import Column, ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.util import (
+    concat_batches,
+    slice_to_batches,
+    sort_indices,
+    take_batch,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    expr: ir.Expr
+    ascending: bool = True
+    nulls_first: bool = True
+
+
+class SortExec(PhysicalOp):
+    def __init__(self, child: PhysicalOp, keys: List[SortKey],
+                 fetch: Optional[int] = None):
+        self.children = [child]
+        self.keys = [
+            SortKey(ir.bind(k.expr, child.schema), k.ascending,
+                    k.nulls_first)
+            for k in keys
+        ]
+        self.fetch = fetch
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        batches = list(self.children[0].execute(partition, ctx))
+        cb = concat_batches(batches, schema=self.schema)
+        if cb.num_rows == 0:
+            return iter(())
+        cb = sort_batch(cb, self.keys)
+        if self.fetch is not None and cb.num_rows > self.fetch:
+            cb = ColumnBatch(
+                cb.schema, cb.columns, self.fetch, cb.selection
+            )
+        return iter(slice_to_batches(cb, ctx.config.batch_size))
+
+
+def sort_batch(cb: ColumnBatch, keys: List[SortKey]) -> ColumnBatch:
+    """Sort one compacted batch by the given keys."""
+    key_cols = []
+    for k in keys:
+        col = _key_column(cb, k.expr)
+        values = col.values
+        if col.dtype.is_dictionary_encoded and col.dictionary is not None:
+            values = _lexicographic_codes(col)
+        key_cols.append((values, col.validity, k.ascending, k.nulls_first))
+    idx = sort_indices(key_cols, cb.num_rows, cb.capacity)
+    return take_batch(cb, idx, cb.num_rows)
+
+
+def _key_column(cb: ColumnBatch, e: ir.Expr) -> Column:
+    if isinstance(e, ir.BoundCol):
+        return cb.columns[e.index]
+    if isinstance(e, ir.Col):
+        return cb.column(e.name)
+    # general expression keys: evaluate through the device evaluator
+    from blaze_tpu.exprs.eval import DeviceEvaluator
+    from blaze_tpu.exprs.typing import infer_dtype
+
+    ev = DeviceEvaluator(
+        cb.schema, [(c.values, c.validity) for c in cb.columns], cb.capacity
+    )
+    v, m = ev.evaluate(e)
+    return Column(infer_dtype(e, cb.schema), v, m, None)
+
+
+def _lexicographic_codes(col: Column) -> jnp.ndarray:
+    """Remap dictionary codes to ranks in lexicographic dictionary order so
+    integer comparison == string comparison."""
+    import pyarrow.compute as pc
+
+    order = np.asarray(pc.sort_indices(col.dictionary))
+    rank = np.empty(len(order), dtype=np.int32)
+    rank[order] = np.arange(len(order), dtype=np.int32)
+    return jnp.take(
+        jnp.asarray(rank),
+        jnp.clip(col.values, 0, len(rank) - 1),
+        axis=0,
+    )

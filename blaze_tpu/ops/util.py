@@ -1,0 +1,218 @@
+"""Shared batch utilities for operators: device gather/compact, host-side
+dictionary unification, batch concatenation."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.config import get_config
+from blaze_tpu.types import Schema, TypeId
+from blaze_tpu.batch import Column, ColumnBatch, row_mask
+
+
+def take_batch(cb: ColumnBatch, indices: jax.Array, num_rows: int
+               ) -> ColumnBatch:
+    """Gather rows by index (device). `indices` length defines capacity."""
+    cols = []
+    for c in cb.columns:
+        v = jnp.take(c.values, indices, axis=0)
+        m = jnp.take(c.validity, indices, axis=0) if c.validity is not None \
+            else None
+        cols.append(Column(c.dtype, v, m, c.dictionary))
+    return ColumnBatch(cb.schema, cols, num_rows)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _compact_indices(mask: jax.Array, capacity: int):
+    idx = jnp.nonzero(mask, size=capacity, fill_value=0)[0]
+    return idx, jnp.sum(mask.astype(jnp.int32))
+
+
+def compact(cb: ColumnBatch, mask: Optional[jax.Array] = None) -> ColumnBatch:
+    """Keep rows where mask (AND the batch's own selection) is True, packed
+    to the front (one D2H sync for the surviving row count)."""
+    live = cb.live_mask()
+    if mask is not None:
+        live = live & mask
+    idx, n = _compact_indices(live, cb.capacity)
+    return take_batch(cb, idx, int(n))
+
+
+def ensure_compacted(cb: ColumnBatch) -> ColumnBatch:
+    """Materialize a pending selection vector (no-op when none)."""
+    if cb.selection is None:
+        return cb
+    return compact(cb)
+
+
+def unify_dictionaries(batches: List[ColumnBatch]) -> List[ColumnBatch]:
+    """Rewrite all batches so every string column shares one dictionary.
+
+    Host-side (pyarrow) dictionary merge + device-side code remap via
+    jnp.take of the old->new mapping. Required before any cross-batch
+    compute on string codes (sort, group-by, join): per-batch dictionaries
+    are not comparable. TPU-first normalization per SURVEY 7: all device
+    string compute happens on unified int32 codes.
+    """
+    import pyarrow as pa
+
+    if not batches:
+        return batches
+    schema = batches[0].schema
+    string_cols = [
+        i for i, f in enumerate(schema)
+        if f.dtype.is_dictionary_encoded
+    ]
+    if not string_cols:
+        return batches
+    out = [list(b.columns) for b in batches]
+    for ci in string_cols:
+        dicts = []
+        for b in batches:
+            d = b.columns[ci].dictionary
+            dicts.append(d if d is not None else pa.array([], type=pa.utf8()))
+        unified = pa.concat_arrays(
+            [d.cast(dicts[0].type) for d in dicts]
+        ).unique()
+        # old-code -> new-code mapping per batch
+        for bi, b in enumerate(batches):
+            old = dicts[bi]
+            if len(old) == 0:
+                mapping = np.zeros(1, dtype=np.int32)
+            else:
+                mapping = np.asarray(
+                    pa.compute.index_in(old, value_set=unified).fill_null(0)
+                ).astype(np.int32)
+            c = b.columns[ci]
+            new_codes = jnp.take(
+                jnp.asarray(mapping),
+                jnp.clip(c.values, 0, len(mapping) - 1),
+                axis=0,
+            )
+            out[bi][ci] = Column(c.dtype, new_codes, c.validity, unified)
+    return [
+        ColumnBatch(b.schema, cols, b.num_rows)
+        for b, cols in zip(batches, out)
+    ]
+
+
+def concat_batches(batches: List[ColumnBatch],
+                   schema: Optional[Schema] = None) -> ColumnBatch:
+    """Concatenate live rows of many batches into one padded batch
+    (pipeline-breaker materialization). Unifies string dictionaries."""
+    batches = [ensure_compacted(b) for b in batches]
+    batches = [b for b in batches if b.num_rows > 0]
+    if not batches:
+        from blaze_tpu.batch import empty_batch
+
+        assert schema is not None, "empty concat needs an explicit schema"
+        return empty_batch(schema)
+    batches = unify_dictionaries(batches)
+    schema = batches[0].schema
+    total = sum(b.num_rows for b in batches)
+    cap = get_config().bucket_for(total)
+    ncols = len(schema)
+    cols: List[Column] = []
+    for ci in range(ncols):
+        ref = batches[0].columns[ci]
+        parts_v = []
+        parts_m = []
+        any_mask = any(b.columns[ci].validity is not None for b in batches)
+        for b in batches:
+            c = b.columns[ci]
+            parts_v.append(c.values[: b.num_rows])
+            if any_mask:
+                parts_m.append(
+                    c.validity[: b.num_rows]
+                    if c.validity is not None
+                    else jnp.ones(b.num_rows, dtype=jnp.bool_)
+                )
+        pad = cap - total
+        v = jnp.concatenate(
+            parts_v + ([jnp.zeros(pad, dtype=ref.values.dtype)] if pad else [])
+        )
+        m = None
+        if any_mask:
+            m = jnp.concatenate(
+                parts_m + ([jnp.zeros(pad, dtype=jnp.bool_)] if pad else [])
+            )
+        cols.append(Column(ref.dtype, v, m, ref.dictionary))
+    return ColumnBatch(schema, cols, total)
+
+
+def slice_to_batches(cb: ColumnBatch, batch_size: int) -> List[ColumnBatch]:
+    """Split a large materialized batch back into bucket-sized batches."""
+    if cb.num_rows <= batch_size:
+        return [cb]
+    out = []
+    for start in range(0, cb.num_rows, batch_size):
+        n = min(batch_size, cb.num_rows - start)
+        cap = get_config().bucket_for(n)
+        cols = []
+        for c in cb.columns:
+            v = jax.lax.dynamic_slice_in_dim(c.values, start, cap) \
+                if start + cap <= c.capacity else \
+                jnp.pad(c.values[start:start + n], (0, cap - n))
+            m = None
+            if c.validity is not None:
+                m = jax.lax.dynamic_slice_in_dim(c.validity, start, cap) \
+                    if start + cap <= c.capacity else \
+                    jnp.pad(c.validity[start:start + n], (0, cap - n))
+            cols.append(Column(c.dtype, v, m, c.dictionary))
+        out.append(ColumnBatch(cb.schema, cols, n))
+    return out
+
+
+def sort_indices(
+    keys: Sequence[Tuple[jax.Array, Optional[jax.Array], bool, bool]],
+    num_rows,
+    capacity: int,
+) -> jax.Array:
+    """Stable multi-key argsort. keys = [(values, validity, ascending,
+    nulls_first)]; padding rows always sort last.
+
+    Uses iterated stable sorts from the least-significant key (classic
+    radix-style lexsort) - every pass is one XLA sort op.
+    """
+    idx = jnp.arange(capacity)
+    live = jnp.arange(capacity) < num_rows
+    for values, validity, asc, nulls_first in reversed(list(keys)):
+        v = jnp.take(values, idx, axis=0)
+        lv = jnp.take(live.astype(jnp.int8), idx, axis=0)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            # Spark ordering: NaN sorts greater than any value
+            nan = jnp.isnan(v)
+            v = jnp.where(nan, jnp.inf, v)
+            tie = nan.astype(jnp.int8)
+        else:
+            tie = jnp.zeros_like(v, dtype=jnp.int8)
+        if not asc:
+            v = _invert_order(v)
+            tie = -tie
+        # null ranking: 0 = nulls first, 2 = nulls last, live padding > all
+        if validity is not None:
+            mv = jnp.take(validity, idx, axis=0)
+            rank = jnp.where(mv, 1, 0 if nulls_first else 2)
+        else:
+            rank = jnp.ones_like(v, dtype=jnp.int32)
+        rank = jnp.where(lv.astype(bool), rank, 3)
+        order = jnp.lexsort((tie, v, rank))
+        idx = jnp.take(idx, order, axis=0)
+    # final pass: push padding to the end while keeping everything stable
+    lv = jnp.take(live.astype(jnp.int8), idx, axis=0)
+    order = jnp.argsort(-lv, stable=True)
+    return jnp.take(idx, order, axis=0)
+
+
+def _invert_order(v: jax.Array) -> jax.Array:
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return -v
+    if v.dtype == jnp.bool_:
+        return ~v
+    return -v.astype(jnp.int64) if v.dtype != jnp.int64 else -v

@@ -1,0 +1,256 @@
+"""Operator tests: project/filter/sort/union/limit/rename + aggregates."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col, ScalarFn
+from blaze_tpu.ops import (
+    AggMode,
+    DebugExec,
+    EmptyPartitionsExec,
+    ExecContext,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+    SortExec,
+    SortKey,
+    UnionExec,
+)
+
+
+def scan_of(data: dict, **kw) -> MemoryScanExec:
+    cb = ColumnBatch.from_pydict(data, **kw)
+    return MemoryScanExec.from_batches([cb])
+
+
+def collect(op, partition=0):
+    ctx = ExecContext()
+    out = [b.to_arrow() for b in op.execute(partition, ctx)]
+    out = [b for b in out if b.num_rows >= 0]
+    if not out:
+        return {}
+    tbl = pa.Table.from_batches(out)
+    return tbl.to_pydict()
+
+
+def test_project_expressions():
+    op = ProjectExec(
+        scan_of({"a": [1, 2, 3], "b": [10.0, 20.0, 30.0]}),
+        [(Col("a") * 2, "a2"), (Col("b") + Col("a"), "s")],
+    )
+    assert collect(op) == {"a2": [2, 4, 6], "s": [11.0, 22.0, 33.0]}
+
+
+def test_project_string_passthrough_and_host_fn():
+    op = ProjectExec(
+        scan_of({"s": ["ab", "CD", None]}),
+        [(Col("s"), "s"), (ScalarFn("upper", (Col("s"),)), "u")],
+    )
+    assert collect(op) == {"s": ["ab", "CD", None], "u": ["AB", "CD", None]}
+
+
+def test_filter_defers_then_compacts():
+    op = FilterExec(
+        scan_of({"a": [1, 2, 3, 4, 5], "b": [1, 0, 1, 0, 1]}),
+        Col("b") == 1,
+    )
+    assert collect(op) == {"a": [1, 3, 5], "b": [1, 1, 1]}
+
+
+def test_filter_string_predicate():
+    op = FilterExec(
+        scan_of({"s": ["x", "yy", "x", None], "v": [1, 2, 3, 4]}),
+        Col("s") == "x",
+    )
+    assert collect(op) == {"s": ["x", "x"], "v": [1, 3]}
+
+
+def test_sort_multi_key_nulls():
+    op = SortExec(
+        scan_of(
+            {"a": [2, 1, 2, None, 1], "b": [5.0, 4.0, 3.0, 2.0, 1.0]}
+        ),
+        [SortKey(Col("a"), ascending=True, nulls_first=True),
+         SortKey(Col("b"), ascending=False)],
+    )
+    out = collect(op)
+    assert out["a"] == [None, 1, 1, 2, 2]
+    assert out["b"] == [2.0, 4.0, 1.0, 5.0, 3.0]
+
+
+def test_sort_strings():
+    op = SortExec(
+        scan_of({"s": ["pear", "apple", "fig", "apple"]}),
+        [SortKey(Col("s"))],
+    )
+    assert collect(op)["s"] == ["apple", "apple", "fig", "pear"]
+
+
+def test_sort_desc_nulls_last_fetch():
+    op = SortExec(
+        scan_of({"a": [3, None, 5, 1]}),
+        [SortKey(Col("a"), ascending=False, nulls_first=False)],
+        fetch=2,
+    )
+    assert collect(op)["a"] == [5, 3]
+
+
+def test_union_and_rename():
+    s1 = scan_of({"a": [1, 2]})
+    s2 = scan_of({"a": [3]})
+    u = UnionExec([s1, s2])
+    assert u.partition_count == 2
+    got = collect(u, 0)["a"] + collect(u, 1)["a"]
+    assert got == [1, 2, 3]
+    r = RenameColumnsExec(u, ["x"])
+    assert collect(r, 0) == {"x": [1, 2]}
+
+
+def test_limit():
+    op = LimitExec(scan_of({"a": list(range(10))}), 4)
+    assert collect(op)["a"] == [0, 1, 2, 3]
+
+
+def test_empty_partitions():
+    from blaze_tpu.types import DataType, Field, Schema
+
+    op = EmptyPartitionsExec(
+        Schema([Field("a", DataType.int64())]), 3
+    )
+    assert op.partition_count == 3
+    assert collect(op, 1) == {}
+
+
+# ---------------------------------------------------------------------------
+# aggregates
+# ---------------------------------------------------------------------------
+
+def agg(fn, col=None):
+    return AggExpr(fn, Col(col) if col else None)
+
+
+def test_complete_aggregate_grouped():
+    op = HashAggregateExec(
+        scan_of(
+            {
+                "k": [1, 2, 1, 2, 1],
+                "v": [10, 20, 30, None, 50],
+            }
+        ),
+        keys=[(Col("k"), "k")],
+        aggs=[
+            (agg(AggFn.SUM, "v"), "s"),
+            (agg(AggFn.COUNT, "v"), "c"),
+            (agg(AggFn.COUNT_STAR), "n"),
+            (agg(AggFn.MIN, "v"), "mn"),
+            (agg(AggFn.MAX, "v"), "mx"),
+            (agg(AggFn.AVG, "v"), "av"),
+        ],
+        mode=AggMode.COMPLETE,
+    )
+    out = collect(op)
+    rows = sorted(zip(*(out[k] for k in ["k", "s", "c", "n", "mn", "mx", "av"])))
+    assert rows == [
+        (1, 90, 3, 3, 10, 50, 30.0),
+        (2, 20, 1, 2, 20, 20, 20.0),
+    ]
+
+
+def test_group_by_with_null_key():
+    op = HashAggregateExec(
+        scan_of({"k": [1, None, 1, None], "v": [1, 2, 3, 4]}),
+        keys=[(Col("k"), "k")],
+        aggs=[(agg(AggFn.SUM, "v"), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = collect(op)
+    got = {k: s for k, s in zip(out["k"], out["s"])}
+    assert got == {1: 4, None: 6}
+
+
+def test_group_by_strings():
+    op = HashAggregateExec(
+        scan_of({"k": ["a", "b", "a", "c", "b"], "v": [1, 2, 3, 4, 5]}),
+        keys=[(Col("k"), "k")],
+        aggs=[(agg(AggFn.SUM, "v"), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = collect(op)
+    got = dict(zip(out["k"], out["s"]))
+    assert got == {"a": 4, "b": 7, "c": 4}
+
+
+def test_partial_final_two_phase():
+    scan = MemoryScanExec(
+        [
+            [ColumnBatch.from_pydict({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})],
+            [ColumnBatch.from_pydict({"k": [2, 3], "v": [4.0, 5.0]})],
+        ],
+        ColumnBatch.from_pydict({"k": [1], "v": [1.0]}).schema,
+    )
+    partial = HashAggregateExec(
+        scan,
+        keys=[(Col("k"), "k")],
+        aggs=[
+            (agg(AggFn.SUM, "v"), "s"),
+            (agg(AggFn.AVG, "v"), "a"),
+            (agg(AggFn.VAR_SAMP, "v"), "var"),
+        ],
+        mode=AggMode.PARTIAL,
+    )
+    # exchange elided: merge both partial partitions in one final
+    merged = MemoryScanExec(
+        [
+            [b for p in range(2) for b in partial.execute(p, ExecContext())]
+        ],
+        partial.schema,
+    )
+    final = HashAggregateExec(
+        merged,
+        keys=[(Col("k"), "k")],
+        aggs=[
+            (agg(AggFn.SUM, "v"), "s"),
+            (agg(AggFn.AVG, "v"), "a"),
+            (agg(AggFn.VAR_SAMP, "v"), "var"),
+        ],
+        mode=AggMode.FINAL,
+    )
+    out = collect(final)
+    rows = {k: (s, a, v) for k, s, a, v in
+            zip(out["k"], out["s"], out["a"], out["var"])}
+    assert rows[1][0] == 4.0 and rows[1][1] == 2.0
+    assert rows[2][0] == 6.0 and rows[2][1] == 3.0
+    assert rows[3][0] == 5.0 and rows[3][1] == 5.0
+    np.testing.assert_allclose(rows[1][2], np.var([1.0, 3.0], ddof=1))
+    np.testing.assert_allclose(rows[2][2], np.var([2.0, 4.0], ddof=1))
+    assert rows[3][2] is None  # var_samp of 1 sample is NULL
+
+
+def test_global_aggregate_no_keys():
+    op = HashAggregateExec(
+        scan_of({"v": [1, 2, 3, 4]}),
+        keys=[],
+        aggs=[(agg(AggFn.SUM, "v"), "s"), (agg(AggFn.COUNT_STAR), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    assert collect(op) == {"s": [10], "n": [4]}
+
+
+def test_aggregate_after_filter_uses_selection():
+    f = FilterExec(
+        scan_of({"k": [1, 1, 2, 2], "v": [1, 100, 2, 200]}),
+        Col("v") < 100,
+    )
+    op = HashAggregateExec(
+        f,
+        keys=[(Col("k"), "k")],
+        aggs=[(agg(AggFn.SUM, "v"), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = collect(op)
+    assert dict(zip(out["k"], out["s"])) == {1: 1, 2: 2}
