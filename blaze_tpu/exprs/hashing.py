@@ -355,16 +355,14 @@ def hash_rows_host(columns, num_rows: int) -> np.ndarray:
         tid = dtype.id
         if tid in (TypeId.UTF8, TypeId.BINARY):
             assert dictionary is not None
-            dvals = dictionary.to_pylist()
-            per_row = np.empty(num_rows, dtype=np.uint32)
-            codes = values[:num_rows].astype(np.int64)
-            # hash per distinct dictionary value per distinct running seed
-            # would be quadratic; do row-wise (C++ runtime does this in bulk)
-            for i in range(num_rows):
-                s = dvals[codes[i]]
-                b = s if isinstance(s, bytes) else str(s).encode("utf-8")
-                per_row[i] = np.uint32(hash_bytes_host(b, int(h[i])))
-            link = per_row
+            from blaze_tpu.runtime import native
+
+            link = native.murmur3_dict_strings_chain(
+                dictionary,
+                np.ascontiguousarray(values[:num_rows], dtype=np.int32),
+                validity[:num_rows] if validity is not None else None,
+                h.copy(),
+            )
         elif tid in (TypeId.BOOL,):
             link = _np_hash_int(values[:num_rows].astype(np.uint32), h)
         elif tid in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32):

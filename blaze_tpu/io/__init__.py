@@ -1,0 +1,1 @@
+"""Host IO: segmented Arrow-IPC exchange format, shuffle files."""

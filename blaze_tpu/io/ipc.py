@@ -1,0 +1,83 @@
+"""Segmented Arrow-IPC exchange format.
+
+Bit-compatible with the reference's on-disk/wire format so a Spark executor
+can exchange shuffle and broadcast bytes with this engine (SURVEY 4 calls
+this a bit-compatibility contract):
+
+  part     := [u64 LE length][zstd(Arrow IPC stream)]      (util/ipc.rs:20-49)
+  segment  := part*                                        (per partition)
+  data     := segment per partition, concatenated
+  index    := (num_partitions + 1) LE i64 start offsets
+              (shuffle_writer_exec.rs:437-506, architectural_overview.md)
+
+Empty batches write nothing (write_ipc_compressed returns 0). Readers skip
+zero-length parts (IpcInputStreamIterator.scala:54-100 does the same).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+import pyarrow as pa
+
+from blaze_tpu.runtime import native
+
+
+def encode_ipc_segment(rb: pa.RecordBatch, level: int = 1) -> bytes:
+    """One length-prefixed zstd Arrow-IPC part. Empty batch -> b''."""
+    if rb.num_rows == 0:
+        return b""
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as writer:
+        writer.write_batch(rb)
+    compressed = native.zstd_compress(sink.getvalue(), level)
+    return struct.pack("<Q", len(compressed)) + compressed
+
+
+def decode_ipc_parts(buf: bytes) -> Iterator[pa.RecordBatch]:
+    """Iterate RecordBatches out of a concatenated parts buffer."""
+    pos = 0
+    n = len(buf)
+    while pos + 8 <= n:
+        (length,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        if length == 0:
+            continue
+        frame = buf[pos: pos + length]
+        pos += length
+        raw = native.zstd_decompress(frame)
+        if not raw:
+            continue
+        with pa.ipc.open_stream(raw) as reader:
+            for rb in reader:
+                if rb.num_rows > 0:
+                    yield rb
+
+
+def read_file_segment(path: str, offset: int, length: int
+                      ) -> Iterator[pa.RecordBatch]:
+    """Zero-copy-ish read of one partition's byte range from a .data file
+    (the reference's local FileSegment fast path,
+    ArrowBlockStoreShuffleReader301.scala:83-123)."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        buf = f.read(length)
+    yield from decode_ipc_parts(buf)
+
+
+def read_index_file(path: str) -> List[int]:
+    with open(path, "rb") as f:
+        raw = f.read()
+    count = len(raw) // 8
+    return list(struct.unpack(f"<{count}q", raw))
+
+
+def partition_ranges(index_path: str) -> List[Tuple[int, int]]:
+    """(offset, length) per partition from an .index file."""
+    offs = read_index_file(index_path)
+    return [
+        (offs[i], offs[i + 1] - offs[i]) for i in range(len(offs) - 1)
+    ]

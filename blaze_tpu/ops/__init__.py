@@ -25,6 +25,9 @@ from blaze_tpu.ops.empty import EmptyPartitionsExec
 from blaze_tpu.ops.debug import DebugExec
 from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
 from blaze_tpu.ops.joins import HashJoinExec, JoinType, SortMergeJoinExec
+from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
+from blaze_tpu.ops.ipc_reader import FileSegment, IpcReaderExec, IpcReadMode
+from blaze_tpu.ops.ipc_writer import IpcWriterExec, collect_ipc
 
 __all__ = [
     "ExecContext",
@@ -44,4 +47,10 @@ __all__ = [
     "HashJoinExec",
     "JoinType",
     "SortMergeJoinExec",
+    "ShuffleWriterExec",
+    "FileSegment",
+    "IpcReaderExec",
+    "IpcReadMode",
+    "IpcWriterExec",
+    "collect_ipc",
 ]

@@ -1,0 +1,85 @@
+"""IPC reader: the exchange-consuming leaf.
+
+Reference counterpart: IpcReaderExec (ipc_reader_exec.rs, 384 LoC) with its
+three modes (rs:83-93): CHANNEL_UNCOMPRESSED (row-conversion input), CHANNEL
+(broadcast bytes), CHANNEL_AND_FILE_SEGMENT (shuffle read - local segments
+read straight from the .data file by (path, offset, length), remote ones
+streamed). Sources are handed over through the context resource registry,
+the analog of the reference's JniBridge resource map (JniBridge.java:31).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Iterator, List, Union
+
+import pyarrow as pa
+
+from blaze_tpu.types import Schema, from_arrow_schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.io.ipc import decode_ipc_parts, read_file_segment
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+
+
+class IpcReadMode(enum.Enum):
+    CHANNEL_UNCOMPRESSED = "channel_uncompressed"
+    CHANNEL = "channel"
+    CHANNEL_AND_FILE_SEGMENT = "channel_and_file_segment"
+
+
+class FileSegment:
+    def __init__(self, path: str, offset: int, length: int):
+        self.path = path
+        self.offset = offset
+        self.length = length
+
+
+Source = Union[bytes, FileSegment, pa.RecordBatch]
+
+
+class IpcReaderExec(PhysicalOp):
+    """Leaf reading IPC sources for each partition.
+
+    `ctx.resources[resource_id]` must hold either a list-of-lists (sources
+    per partition) or a callable partition -> list of sources. A source is
+    compressed part bytes, a FileSegment, or an already-decoded
+    RecordBatch (uncompressed channel)."""
+
+    def __init__(self, resource_id: str, schema: Schema,
+                 num_partitions: int,
+                 mode: IpcReadMode = IpcReadMode.CHANNEL):
+        self.children = []
+        self.resource_id = resource_id
+        self._schema = schema
+        self._n = num_partitions
+        self.mode = mode
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return self._n
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        provider = ctx.resources[self.resource_id]
+        sources = (
+            provider(partition) if callable(provider)
+            else provider[partition]
+        )
+        rows = 0
+        for src in sources:
+            if isinstance(src, FileSegment):
+                it = read_file_segment(src.path, src.offset, src.length)
+            elif isinstance(src, (bytes, bytearray, memoryview)):
+                it = decode_ipc_parts(bytes(src))
+            elif isinstance(src, pa.RecordBatch):
+                it = iter((src,))
+            else:
+                raise TypeError(f"bad IPC source {type(src)}")
+            for rb in it:
+                rows += rb.num_rows
+                yield ColumnBatch.from_arrow(rb)
+        ctx.metrics.add("ipc_rows_read", rows)

@@ -1,0 +1,290 @@
+"""Shuffle write: hash-repartition batches into a .data/.index file pair.
+
+Reference counterpart: the native ShuffleWriterExec (shuffle_writer_exec.rs,
+780 LoC): spark-murmur3 pmod bucketing, per-partition buffers with
+spill-to-disk under memory pressure, final merge into one data file + LE
+i64 offsets index, committed by Spark (ArrowShuffleExchangeExec301.scala:
+531-602). Single-partition (no-key) and round-robin variants cover the
+JVM fallback paths' semantics.
+
+TPU-first layout (SURVEY 7 step 5): partition ids are computed on-device
+(bit-exact Spark murmur3 over the key columns) and the row scatter is ONE
+stable device argsort by partition id - the counting-sort scatter of the
+reference (rs:349-371) becomes an XLA sort - followed by a single D2H
+transfer of the already-partition-contiguous batch. String/f64 keys hash
+through the C++ host runtime instead (TPU has no string compute; its f64
+is not bit-exact - exprs/hashing.device_hash_supported).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.config import get_config
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.exprs.hashing import (
+    device_hash_supported,
+    hash_columns_device,
+    pmod,
+)
+from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.io.ipc import encode_ipc_segment
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.host_lower import lower_strings_host
+from blaze_tpu.ops.util import ensure_compacted, take_batch
+from blaze_tpu.runtime import native
+from blaze_tpu.runtime.memory import get_pool
+
+
+class PartitionBuffers:
+    """Per-partition compressed segment buffers with the reference's
+    buffer->spill->merge ladder (PartitionBuffer/spill_into,
+    shuffle_writer_exec.rs:66-194, :522-556)."""
+
+    def __init__(self, num_partitions: int, spill_dir: str):
+        self.num_partitions = num_partitions
+        self.buffers: List[bytearray] = [
+            bytearray() for _ in range(num_partitions)
+        ]
+        self.spills: List[Tuple[str, List[int]]] = []
+        self.spill_dir = spill_dir
+        self.mem_used = 0
+        self._pool = get_pool()
+        self._pool.register(id(self), self.spill)
+
+    def append(self, partition: int, part: bytes) -> None:
+        self.buffers[partition] += part
+        self.mem_used += len(part)
+        self._pool.grow(id(self), len(part))
+
+    def spill(self) -> int:
+        """Write current buffers to a spill file; returns bytes released."""
+        if self.mem_used == 0:
+            return 0
+        path = os.path.join(
+            self.spill_dir,
+            f"blz-spill-{id(self):x}-{len(self.spills)}.tmp",
+        )
+        offsets = [0] * (self.num_partitions + 1)
+        pos = 0
+        with open(path, "wb") as f:
+            for p in range(self.num_partitions):
+                offsets[p] = pos
+                f.write(self.buffers[p])
+                pos += len(self.buffers[p])
+                self.buffers[p] = bytearray()
+        offsets[self.num_partitions] = pos
+        self.spills.append((path, offsets))
+        released = self.mem_used
+        self.mem_used = 0
+        return released
+
+    def finalize(self, data_path: str, index_path: str) -> List[int]:
+        """Assemble .data/.index (native C++ fast path); returns partition
+        lengths. Cleans up spill files."""
+        native.shuffle_assemble(
+            data_path, index_path,
+            [bytes(b) for b in self.buffers],
+            self.num_partitions, self.spills,
+        )
+        self._pool.shrink(id(self), self.mem_used)
+        self._pool.unregister(id(self))
+        self.mem_used = 0
+        for path, _ in self.spills:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        from blaze_tpu.io.ipc import partition_ranges
+
+        return [length for _, length in partition_ranges(index_path)]
+
+
+def spark_partition_ids(cb: ColumnBatch, key_exprs: Sequence[ir.Expr],
+                        num_partitions: int) -> np.ndarray:
+    """Spark-murmur3 pmod partition id per live row (batch must be
+    compacted). Device fast path when all key dtypes hash bit-exactly
+    there; C++/numpy host path otherwise."""
+    schema = cb.schema
+    dtypes = [infer_dtype(e, schema) for e in key_exprs]
+    if all(device_hash_supported(dt) for dt in dtypes):
+        cols = []
+        ev = DeviceEvaluator(
+            schema, [(c.values, c.validity) for c in cb.columns],
+            cb.capacity,
+        )
+        for e, dt in zip(key_exprs, dtypes):
+            v, m = ev.evaluate(e)
+            cols.append((v, m, dt))
+        h = hash_columns_device(cols, cb.capacity)
+        pids = pmod(h, num_partitions)
+        return np.asarray(pids)[: cb.num_rows]
+    # host path: exact Spark chain incl. utf8 bytes via the C++ runtime
+    n = cb.num_rows
+    h = np.full(n, 42, dtype=np.uint32)
+    ev = DeviceEvaluator(
+        schema, [(c.values, c.validity) for c in cb.columns], cb.capacity
+    )
+    for e, dt in zip(key_exprs, dtypes):
+        if dt.is_dictionary_encoded:
+            # string keys are plain columns after host lowering
+            assert isinstance(e, ir.BoundCol), "string key must be a column"
+            col = cb.columns[e.index]
+            validity = (
+                np.asarray(col.validity)[:n]
+                if col.validity is not None
+                else None
+            )
+            h = native.murmur3_dict_strings_chain(
+                col.dictionary,
+                np.ascontiguousarray(np.asarray(col.values)[:n],
+                                     dtype=np.int32),
+                validity, h,
+            )
+        else:
+            v, m = ev.evaluate(e)
+            validity = np.asarray(m)[:n] if m is not None else None
+            h = _chain_fixed(np.asarray(v)[:n], validity, dt, h)
+    return native.pmod_np(h, num_partitions)
+
+
+def _chain_fixed(values, validity, dt, h):
+    """Chain one fixed-width column into running hashes (numpy)."""
+    from blaze_tpu.exprs import hashing as H
+    from blaze_tpu.types import TypeId
+
+    tid = dt.id
+    if tid in (TypeId.INT8, TypeId.INT16, TypeId.INT32, TypeId.DATE32,
+               TypeId.BOOL):
+        link = H._np_hash_int(values.astype(np.int32).view(np.uint32)
+                              if tid is not TypeId.BOOL
+                              else values.astype(np.uint32), h)
+    elif tid in (TypeId.INT64, TypeId.TIMESTAMP_US) or (
+        tid is TypeId.DECIMAL and dt.precision <= 18
+    ):
+        u = values.astype(np.int64).view(np.uint64)
+        low = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        high = (u >> np.uint64(32)).astype(np.uint32)
+        h1 = H._np_mix_h1(h, H._np_mix_k1(low))
+        h1 = H._np_mix_h1(h1, H._np_mix_k1(high))
+        link = H._np_fmix(h1, 8)
+    elif tid is TypeId.FLOAT32:
+        v = values.astype(np.float32)
+        v = np.where(v == 0.0, np.float32(0.0), v)
+        link = H._np_hash_int(v.view(np.uint32), h)
+    elif tid is TypeId.FLOAT64:
+        v = values.astype(np.float64)
+        v = np.where(v == 0.0, 0.0, v)
+        u = v.view(np.uint64)
+        low = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        high = (u >> np.uint64(32)).astype(np.uint32)
+        h1 = H._np_mix_h1(h, H._np_mix_k1(low))
+        h1 = H._np_mix_h1(h1, H._np_mix_k1(high))
+        link = H._np_fmix(h1, 8)
+    else:
+        raise NotImplementedError(f"hash of {dt}")
+    if validity is not None:
+        link = np.where(validity, link, h)
+    return link
+
+
+class ShuffleWriterExec(PhysicalOp):
+    """Writes one map task's shuffle output; the output stream is empty
+    (lengths land in the index file), matching the reference
+    (external_shuffle, shuffle_writer_exec.rs:753-780)."""
+
+    def __init__(self, child: PhysicalOp, key_exprs: Sequence[ir.Expr],
+                 num_partitions: int, data_file: str, index_file: str,
+                 mode: str = "hash"):
+        self.children = [child]
+        self.key_exprs = [ir.bind(e, child.schema) for e in key_exprs]
+        self.num_partitions = num_partitions
+        self.data_file = data_file
+        self.index_file = index_file
+        assert mode in ("hash", "single", "round_robin")
+        self.mode = mode
+        if mode == "hash" and not key_exprs:
+            raise ValueError("hash partitioning requires keys")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        cfg = ctx.config
+        bufs = PartitionBuffers(self.num_partitions, cfg.spill_dir())
+        rr_next = partition  # round-robin start varies by map partition
+        for cb in self.children[0].execute(partition, ctx):
+            cb = ensure_compacted(cb)
+            if cb.num_rows == 0:
+                continue
+            exprs, _, aug = lower_strings_host(self.key_exprs, cb) \
+                if self.mode == "hash" else (self.key_exprs, 0, cb)
+            if self.mode == "single" or self.num_partitions == 1:
+                rb = cb.to_arrow()
+                bufs.append(
+                    0, encode_ipc_segment(rb, cfg.ipc_compression_level)
+                )
+                continue
+            if self.mode == "round_robin":
+                pids = (
+                    (np.arange(cb.num_rows) + rr_next)
+                    % self.num_partitions
+                ).astype(np.int32)
+                rr_next = int(
+                    (rr_next + cb.num_rows) % self.num_partitions
+                )
+                order = np.argsort(pids, kind="stable")
+                rb_sorted = take_batch(
+                    cb, jnp.asarray(np.concatenate(
+                        [order,
+                         np.arange(cb.num_rows, cb.capacity)])),
+                    cb.num_rows,
+                ).to_arrow()
+                sorted_pids = pids[order]
+            else:
+                pids = spark_partition_ids(
+                    aug, exprs, self.num_partitions
+                )
+                # scatter = one stable device argsort by partition id
+                pid_full = jnp.full(
+                    cb.capacity, self.num_partitions, dtype=jnp.int32
+                )
+                pid_full = pid_full.at[: len(pids)].set(
+                    jnp.asarray(pids)
+                )
+                order_dev = jnp.argsort(pid_full, stable=True)
+                rb_sorted = take_batch(
+                    cb, order_dev, cb.num_rows
+                ).to_arrow()
+                sorted_pids = np.sort(pids, kind="stable")
+            counts = np.bincount(
+                sorted_pids, minlength=self.num_partitions
+            )
+            start = 0
+            for p in range(self.num_partitions):
+                c = int(counts[p])
+                if c == 0:
+                    continue
+                part_rb = rb_sorted.slice(start, c)
+                bufs.append(
+                    p,
+                    encode_ipc_segment(
+                        part_rb, cfg.ipc_compression_level
+                    ),
+                )
+                start += c
+            ctx.metrics.add("shuffle_rows_written", cb.num_rows)
+        lengths = bufs.finalize(self.data_file, self.index_file)
+        ctx.metrics.add("shuffle_bytes_written", sum(lengths))
+        return iter(())

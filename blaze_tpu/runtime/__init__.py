@@ -1,0 +1,6 @@
+"""Runtime: native host library bindings, memory management, task executor.
+
+TPU-native counterparts of the reference's runtime tier: the JNI entry /
+session bootstrap (exec.rs), the MemoryConsumer/spill protocol
+(shuffle_writer_exec.rs:570-623), and metrics (metrics.rs).
+"""

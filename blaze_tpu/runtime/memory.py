@@ -1,0 +1,88 @@
+"""Memory budget + spill ladder.
+
+Reference counterpart: DataFusion's MemoryConsumer/try_grow/spill protocol
+wired through MemoryManagerConfig {max_memory, memory_fraction}
+(exec.rs:79-94; spill path shuffle_writer_exec.rs:570-623). The TPU engine
+extends the ladder one level: device HBM -> host RAM -> disk (SURVEY 7
+"spill & memory ladder") - operators materialize on device, overflow to
+host buffers tracked here, and spill those to disk under pressure.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+from blaze_tpu.config import get_config
+
+
+class MemoryPool:
+    """Tracks host-side buffered bytes; triggers consumer spills when the
+    budget (max_memory * memory_fraction) is exceeded. Spill order is
+    largest-consumer-first like DataFusion's."""
+
+    def __init__(self, budget: int = None):
+        cfg = get_config()
+        self.budget = budget if budget is not None else int(
+            cfg.max_memory * cfg.memory_fraction
+        )
+        self._used: Dict[int, int] = {}
+        self._spill_fns: Dict[int, Callable[[], int]] = {}
+        self._lock = threading.Lock()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    def register(self, consumer_id: int, spill: Callable[[], int]) -> None:
+        with self._lock:
+            self._used.setdefault(consumer_id, 0)
+            self._spill_fns[consumer_id] = spill
+
+    def unregister(self, consumer_id: int) -> None:
+        with self._lock:
+            self._used.pop(consumer_id, None)
+            self._spill_fns.pop(consumer_id, None)
+
+    def total_used(self) -> int:
+        with self._lock:
+            return sum(self._used.values())
+
+    def grow(self, consumer_id: int, nbytes: int) -> None:
+        """Account nbytes to the consumer; spill others (or it) if needed."""
+        with self._lock:
+            self._used[consumer_id] = self._used.get(consumer_id, 0) + nbytes
+            over = sum(self._used.values()) - self.budget
+            victims: List[int] = []
+            if over > 0:
+                victims = sorted(
+                    self._used, key=lambda c: -self._used[c]
+                )
+        if over > 0:
+            freed = 0
+            for v in victims:
+                fn = self._spill_fns.get(v)
+                if fn is None:
+                    continue
+                released = fn()
+                with self._lock:
+                    self._used[v] = max(0, self._used[v] - released)
+                self.spill_count += 1
+                self.spilled_bytes += released
+                freed += released
+                if freed >= over:
+                    break
+
+    def shrink(self, consumer_id: int, nbytes: int) -> None:
+        with self._lock:
+            self._used[consumer_id] = max(
+                0, self._used.get(consumer_id, 0) - nbytes
+            )
+
+
+_POOL = None
+
+
+def get_pool() -> MemoryPool:
+    global _POOL
+    if _POOL is None:
+        _POOL = MemoryPool()
+    return _POOL
