@@ -1,0 +1,157 @@
+"""Parquet scan: host decode -> device columns.
+
+Reference counterpart: DataFusion ParquetExec with pruning predicate,
+driven by per-partition FileGroups (from_proto.rs:202-212; Spark side
+NativeParquetScanExec.scala:61-107 builds the groups/projection/filters).
+
+TPU-first shape (SURVEY 7 step 4): Parquet decode is host-tier work
+(pyarrow's C++ reader), producing record batches of `batch_size` rows that
+are dictionary-encoded/padded/transferred once each. Row-group pruning
+evaluates the pruning predicate against row-group statistics before any IO,
+like the reference's pruning predicate; byte ranges in a FileRange select
+row groups the way Spark's splits do."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from blaze_tpu.types import Schema, from_arrow_schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+
+
+@dataclasses.dataclass(frozen=True)
+class FileRange:
+    path: str
+    start: int = 0
+    length: int = 0  # 0 = whole file
+
+
+class ParquetScanExec(PhysicalOp):
+    def __init__(
+        self,
+        file_groups: Sequence[Sequence[FileRange]],
+        schema: Optional[Schema] = None,
+        projection: Optional[Sequence[str]] = None,
+        pruning_predicate: Optional[ir.Expr] = None,
+    ):
+        import pyarrow.parquet as pq
+
+        self.children = []
+        self.file_groups = [list(g) for g in file_groups]
+        self.projection = list(projection) if projection else None
+        self.pruning_predicate = pruning_predicate
+        if schema is None:
+            first = self.file_groups[0][0].path
+            aschema = pq.read_schema(first)
+            if self.projection:
+                aschema = __import__("pyarrow").schema(
+                    [aschema.field(n) for n in self.projection]
+                )
+            schema = from_arrow_schema(aschema)
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return len(self.file_groups)
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        import pyarrow.parquet as pq
+
+        cfg = ctx.config
+        cols = self.projection or [f.name for f in self._schema]
+        for fr in self.file_groups[partition]:
+            pf = pq.ParquetFile(fr.path)
+            groups = self._select_row_groups(pf, fr)
+            if not groups:
+                continue
+            for rb in pf.iter_batches(
+                batch_size=cfg.batch_size, row_groups=groups,
+                columns=cols, use_threads=True,
+            ):
+                ctx.metrics.add("input_rows", rb.num_rows)
+                ctx.metrics.add("input_batches", 1)
+                if rb.num_rows == 0:
+                    continue
+                yield ColumnBatch.from_arrow(rb)
+
+    # ------------------------------------------------------------------
+    def _select_row_groups(self, pf, fr: FileRange) -> List[int]:
+        """Row groups whose byte midpoint falls in the split range (Spark's
+        split ownership rule) and that survive stats pruning."""
+        md = pf.metadata
+        out = []
+        for i in range(md.num_row_groups):
+            rg = md.row_group(i)
+            if fr.length > 0:
+                start = rg.column(0).file_offset
+                mid = start + rg.total_byte_size // 2
+                if not (fr.start <= mid < fr.start + fr.length):
+                    continue
+            if self.pruning_predicate is not None and not _may_match(
+                self.pruning_predicate, rg, self._schema
+            ):
+                continue
+            out.append(i)
+        return out
+
+
+def _may_match(pred: ir.Expr, rg, schema: Schema) -> bool:
+    """Conservative stats-based pruning: False only when the predicate
+    provably rejects the whole row group. Handles comparisons between a
+    column and a literal plus AND/OR composition (the reference gets the
+    equivalent from DataFusion's PruningPredicate)."""
+    from blaze_tpu.exprs.ir import BinaryOp, Col, BoundCol, Literal, Op
+
+    if isinstance(pred, BinaryOp) and pred.op in (Op.AND, Op.OR):
+        l = _may_match(pred.left, rg, schema)
+        r = _may_match(pred.right, rg, schema)
+        return (l and r) if pred.op is Op.AND else (l or r)
+    if not isinstance(pred, BinaryOp):
+        return True
+    col, lit, op = None, None, pred.op
+    flip = {Op.LT: Op.GT, Op.GT: Op.LT, Op.LTE: Op.GTE, Op.GTE: Op.LTE}
+    if isinstance(pred.left, (Col, BoundCol)) and isinstance(
+        pred.right, Literal
+    ):
+        col, lit = pred.left, pred.right
+    elif isinstance(pred.right, (Col, BoundCol)) and isinstance(
+        pred.left, Literal
+    ):
+        col, lit = pred.right, pred.left
+        op = flip.get(op, op)
+    if col is None or lit.value is None:
+        return True
+    name = col.name if isinstance(col, Col) else schema.fields[col.index].name
+    stats = None
+    for ci in range(rg.num_columns):
+        c = rg.column(ci)
+        if c.path_in_schema == name:
+            stats = c.statistics
+            break
+    if stats is None or not stats.has_min_max:
+        return True
+    lo, hi, v = stats.min, stats.max, lit.value
+    try:
+        if op is Op.EQ:
+            return lo <= v <= hi
+        if op is Op.LT:
+            return lo < v
+        if op is Op.LTE:
+            return lo <= v
+        if op is Op.GT:
+            return hi > v
+        if op is Op.GTE:
+            return hi >= v
+    except TypeError:
+        return True
+    return True

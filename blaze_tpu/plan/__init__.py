@@ -1,0 +1,2 @@
+"""Plan serde: the protobuf boundary of the engine (reference
+native-engine/plan-serde)."""

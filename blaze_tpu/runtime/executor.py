@@ -1,0 +1,94 @@
+"""Task executor: the engine's entry point.
+
+Reference counterpart: the JNI entry `callNative` (exec.rs:118-328) -
+decode a TaskDefinition, build the operator tree, execute one partition,
+stream Arrow batches back, then push collected metrics. Here the embedding
+is in-process Python instead of JNI, and the batch handshake is a plain
+iterator instead of the SynchronousQueue rendezvous (NativeSupports.scala:
+237-323) - XLA's async dispatch already overlaps host and device work.
+
+Failure semantics follow the reference (SURVEY 5.3): operator errors are
+wrapped with task context into TaskExecutionError and propagate cleanly to
+the embedder; partial output is never silently dropped.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import ExecContext, MetricNode, PhysicalOp
+from blaze_tpu.ops.util import ensure_compacted
+
+log = logging.getLogger("blaze_tpu.executor")
+
+
+class TaskExecutionError(RuntimeError):
+    def __init__(self, task_id: str, partition: int, cause: BaseException):
+        super().__init__(
+            f"task {task_id} partition {partition} failed: {cause!r}"
+        )
+        self.task_id = task_id
+        self.partition = partition
+        self.__cause__ = cause
+
+
+def execute_task(task_bytes: bytes,
+                 ctx: Optional[ExecContext] = None
+                 ) -> Iterator[pa.RecordBatch]:
+    """Decode and run one serialized TaskDefinition; yields Arrow batches
+    (the FFI-equivalent boundary, exec.rs:205-255)."""
+    from blaze_tpu.plan.serde import task_from_proto
+
+    op, partition, task_id = task_from_proto(task_bytes)
+    ctx = ctx or ExecContext()
+    ctx.partition_id = partition
+    ctx.task_id = task_id
+    yield from execute_partition(op, partition, ctx)
+
+
+def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
+                      ) -> Iterator[pa.RecordBatch]:
+    try:
+        for cb in op.execute(partition, ctx):
+            cb = ensure_compacted(cb)
+            if cb.num_rows == 0:
+                continue
+            rb = cb.to_arrow()
+            ctx.metrics.add("output_rows", rb.num_rows)
+            ctx.metrics.add("output_batches", 1)
+            yield rb
+    except (KeyboardInterrupt, GeneratorExit):
+        # task cancellation must not poison the engine (the reference
+        # swallows JVM-interrupts the same way, exec.rs:330-343)
+        log.info("task %s partition %d cancelled", ctx.task_id, partition)
+        raise
+    except Exception as e:
+        raise TaskExecutionError(ctx.task_id, partition, e) from e
+
+
+def run_plan(op: PhysicalOp, ctx: Optional[ExecContext] = None
+             ) -> pa.Table:
+    """Run every partition and collect one Arrow table (driver-side
+    convenience; partitions share the context/resource registry)."""
+    ctx = ctx or ExecContext()
+    batches: List[pa.RecordBatch] = []
+    schema = None
+    for p in range(op.partition_count):
+        for rb in execute_partition(op, p, ctx):
+            if schema is None:
+                schema = rb.schema
+            batches.append(rb)
+    if schema is None:
+        from blaze_tpu.types import to_arrow_schema
+
+        return pa.Table.from_batches([], to_arrow_schema(op.schema))
+    aligned = []
+    for rb in batches:
+        if rb.schema != schema:
+            rb = rb.cast(schema)
+        aligned.append(rb)
+    return pa.Table.from_batches(aligned, schema)
