@@ -1,0 +1,37 @@
+"""Distributed execution tier.
+
+The reference's parallelism inventory (SURVEY 2.3/2.4) mapped TPU-first:
+
+| reference mechanism                  | here                               |
+|--------------------------------------|------------------------------------|
+| per-partition data-parallel tasks    | mesh 'data' axis: one partition    |
+|   (NativeRDD.compute per partition)  | per device via shard_map           |
+| hash repartition shuffle (murmur3 +  | intra-slice: lax.all_to_all over   |
+|   segmented-IPC files)               | ICI (parallel/repartition);        |
+|                                      | inter-node: segmented-IPC files    |
+|                                      | (ShuffleExchangeExec), same disk   |
+|                                      | format as the reference            |
+| broadcast replication (Torrent       | lax.all_gather over ICI /          |
+|   broadcast of IPC bytes)            | BroadcastExchangeExec (IPC bytes)  |
+| AQE coalesced/ranged shuffle reads   | CoalescedShuffleReader partition   |
+|                                      | range mapping                      |
+
+The two-tier design follows SURVEY 2.4's north star: XLA collectives ride
+ICI inside a slice; the segmented Arrow-IPC file fabric (Spark-compatible)
+spans hosts over DCN.
+"""
+
+from blaze_tpu.parallel.mesh import get_mesh, device_count
+from blaze_tpu.parallel.exchange import (
+    BroadcastExchangeExec,
+    CoalescedShuffleReader,
+    ShuffleExchangeExec,
+)
+
+__all__ = [
+    "get_mesh",
+    "device_count",
+    "ShuffleExchangeExec",
+    "BroadcastExchangeExec",
+    "CoalescedShuffleReader",
+]

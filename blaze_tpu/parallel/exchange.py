@@ -1,0 +1,163 @@
+"""Host-level exchange operators: the inter-node (DCN/disk) shuffle tier.
+
+These play Spark's orchestration role locally, the way the reference's
+local-mode TPC-DS CI exercises its full shuffle path in one process
+(SURVEY 4): a ShuffleExchange lazily runs the map stage (one
+ShuffleWriterExec per input partition -> reference-format .data/.index
+files), then serves reduce partitions as FileSegment reads; a
+BroadcastExchange collects the child once as compressed IPC parts and
+replays them to every consumer partition (reference
+ArrowBroadcastExchangeExec.scala:139-256).
+
+CoalescedShuffleReader maps AQE-style partition specs (coalesced ranges)
+onto the same files (reference NativeSupports.scala:131-212).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.io.ipc import partition_ranges
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.ipc_reader import FileSegment, IpcReaderExec, IpcReadMode
+from blaze_tpu.ops.ipc_writer import collect_ipc
+from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
+
+
+class ShuffleExchangeExec(PhysicalOp):
+    """Full repartitioning exchange (reference
+    ArrowShuffleExchangeExec301.scala): hash / single / round_robin."""
+
+    def __init__(self, child: PhysicalOp, keys: Sequence[ir.Expr],
+                 num_partitions: int, mode: str = "hash",
+                 shuffle_dir: Optional[str] = None):
+        self.children = [child]
+        self.keys = list(keys)
+        self.num_partitions = num_partitions
+        self.mode = mode
+        self.shuffle_dir = shuffle_dir
+        self._map_outputs: Optional[List[Tuple[str, str]]] = None
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def partition_count(self) -> int:
+        return self.num_partitions
+
+    # ------------------------------------------------------------------
+    def _run_map_stage(self, ctx: ExecContext) -> List[Tuple[str, str]]:
+        with self._lock:
+            if self._map_outputs is not None:
+                return self._map_outputs
+            child = self.children[0]
+            d = self.shuffle_dir or tempfile.mkdtemp(prefix="blz-shuffle-")
+            os.makedirs(d, exist_ok=True)
+            outputs = []
+            for map_id in range(child.partition_count):
+                data = os.path.join(d, f"shuffle_{id(self):x}_{map_id}_0.data")
+                index = os.path.join(
+                    d, f"shuffle_{id(self):x}_{map_id}_0.index"
+                )
+                writer = ShuffleWriterExec(
+                    child, self.keys, self.num_partitions, data, index,
+                    self.mode,
+                )
+                for _ in writer.execute(map_id, ctx):
+                    pass
+                outputs.append((data, index))
+            self._map_outputs = outputs
+            return outputs
+
+    def segments_for(self, partition_range: Tuple[int, int],
+                     ctx: ExecContext) -> List[FileSegment]:
+        """FileSegments covering [start, end) reduce partitions across all
+        map outputs (AQE coalesced reads use ranges > 1 wide)."""
+        start, end = partition_range
+        segs = []
+        for data, index in self._run_map_stage(ctx):
+            ranges = partition_ranges(index)
+            for p in range(start, end):
+                off, length = ranges[p]
+                if length > 0:
+                    segs.append(FileSegment(data, off, length))
+        return segs
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.io.ipc import read_file_segment
+
+        for seg in self.segments_for((partition, partition + 1), ctx):
+            for rb in read_file_segment(seg.path, seg.offset, seg.length):
+                yield ColumnBatch.from_arrow(rb)
+
+
+class CoalescedShuffleReader(PhysicalOp):
+    """AQE-style reader over a ShuffleExchange: each output partition maps
+    to a contiguous range of reduce partitions (reference
+    CustomShuffleReaderExec handling, NativeSupports.scala:131-212)."""
+
+    def __init__(self, exchange: ShuffleExchangeExec,
+                 partition_ranges_: Sequence[Tuple[int, int]]):
+        self.children = [exchange]
+        self.ranges = list(partition_ranges_)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def partition_count(self) -> int:
+        return len(self.ranges)
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.io.ipc import read_file_segment
+
+        ex: ShuffleExchangeExec = self.children[0]
+        for seg in ex.segments_for(self.ranges[partition], ctx):
+            for rb in read_file_segment(seg.path, seg.offset, seg.length):
+                yield ColumnBatch.from_arrow(rb)
+
+
+class BroadcastExchangeExec(PhysicalOp):
+    """Collect-once, replay-everywhere broadcast (reference
+    ArrowBroadcastExchangeExec: native IPC collect -> spark broadcast ->
+    per-task CHANNEL reads)."""
+
+    def __init__(self, child: PhysicalOp,
+                 num_partitions: Optional[int] = None):
+        self.children = [child]
+        self._parts: Optional[List[bytes]] = None
+        self._n = num_partitions
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def partition_count(self) -> int:
+        return self._n or self.children[0].partition_count
+
+    def broadcast_bytes(self, ctx: ExecContext) -> List[bytes]:
+        with self._lock:
+            if self._parts is None:
+                self._parts = collect_ipc(self.children[0], ctx)
+            return self._parts
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.io.ipc import decode_ipc_parts
+
+        for part in self.broadcast_bytes(ctx):
+            for rb in decode_ipc_parts(part):
+                yield ColumnBatch.from_arrow(rb)

@@ -1,0 +1,43 @@
+"""Device mesh helpers.
+
+The engine scales with one logical axis today - 'data', carrying query
+partitions (the reference's task-per-partition model, NativeRDD.scala:41) -
+and keeps the mesh-creation surface general so wider topologies (e.g. a
+second axis for intra-operator sharding of giant builds) slot in without
+touching operators."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def get_mesh(shape: Optional[Tuple[int, ...]] = None,
+             axis_names: Sequence[str] = ("data",)) -> Mesh:
+    devs = jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devs)}"
+        )
+    arr = np.array(devs[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard leading (partition) axis across the 'data' mesh axis."""
+    return NamedSharding(mesh, PartitionSpec("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

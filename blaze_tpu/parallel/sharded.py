@@ -1,0 +1,303 @@
+"""Mesh-sharded query execution: whole pipeline stages as ONE pjit'd
+program over the device mesh.
+
+This is the intra-slice fast path (SURVEY 2.4 TPU mapping): N query
+partitions execute simultaneously, one per device on the mesh 'data' axis,
+inside a single XLA program; the repartitioning exchange between a partial
+and a final aggregate is a `lax.all_to_all` on ICI instead of the
+segmented-IPC file shuffle. The file tier (parallel/exchange) remains the
+fabric between hosts - this module replaces it only within a slice.
+
+`DistributedGroupBy` is the flagship distributed step: per-shard
+filter -> project -> partial sort-based aggregate, hash repartition of the
+partial states by group key over ICI, per-shard final merge. One jit, no
+host round-trips - the engine's equivalent of a "training step" for
+__graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from blaze_tpu.types import DataType, Schema, TypeId
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.exprs.hashing import hash_columns_device, pmod
+from blaze_tpu.exprs.ir import AggFn
+from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.parallel.repartition import _bucket_live, _bucketize
+
+
+@dataclasses.dataclass(frozen=True)
+class DistAgg:
+    fn: AggFn  # SUM / COUNT / COUNT_STAR / MIN / MAX / AVG
+    expr: Optional[ir.Expr]  # bound against input schema; None for COUNT_*
+
+
+class DistributedGroupBy:
+    """filter -> project-keys -> partial agg -> ICI repartition -> final.
+
+    All group-key dtypes must be device-hashable (ints/dates/f32/bool);
+    string keys go through the file-shuffle tier instead (host hashing).
+    """
+
+    def __init__(self, mesh: Mesh, schema: Schema,
+                 keys: Sequence[ir.Expr],
+                 aggs: Sequence[DistAgg],
+                 filter_pred: Optional[ir.Expr] = None,
+                 axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.schema = schema
+        self.keys = [ir.bind(k, schema) for k in keys]
+        self.aggs = [
+            DistAgg(a.fn, ir.bind(a.expr, schema)
+                    if a.expr is not None else None)
+            for a in aggs
+        ]
+        self.filter_pred = (
+            ir.bind(filter_pred, schema) if filter_pred is not None else None
+        )
+        self._fn = None
+
+    # ------------------------------------------------------------------
+    def __call__(self, stacked_cols: Sequence[jax.Array],
+                 num_rows: jax.Array):
+        """stacked_cols: [n_dev, cap] per input column (sharded or
+        shardable on axis 0); num_rows: [n_dev] live rows per shard.
+        Returns (key_out, agg_out, group_counts): stacked [n_dev, ...] with
+        group_counts[d] = groups owned by device d."""
+        if self._fn is None:
+            self._fn = self._compile(
+                tuple(c.shape for c in stacked_cols),
+                tuple(c.dtype for c in stacked_cols),
+            )
+        return self._fn(*stacked_cols, num_rows)
+
+    # ------------------------------------------------------------------
+    def _compile(self, shapes, dtypes):
+        mesh, axis = self.mesh, self.axis
+        n_dev = mesh.shape[axis]
+        schema = self.schema
+        keys = self.keys
+        aggs = self.aggs
+        pred = self.filter_pred
+        n_keys = len(keys)
+
+        def group_reduce(key_vals: List[jax.Array],
+                         agg_ins: List[jax.Array],
+                         live: jax.Array, cap: int):
+            """Sort-based segmented reduce of one shard's rows.
+
+            Returns (sorted key cols at boundaries, reduced states,
+            n_groups, live_groups mask)."""
+            pri = [jnp.where(live, 0, 1).astype(jnp.int8)]
+            pri += [k for k in key_vals]
+            order = jnp.lexsort(tuple(reversed(pri)))
+            s_live = jnp.take(live, order)
+            diff = jnp.zeros(cap, dtype=jnp.bool_)
+            s_keys = []
+            for k in key_vals:
+                sk = jnp.take(k, order)
+                s_keys.append(sk)
+                diff = diff | (sk != jnp.concatenate([sk[:1], sk[:-1]]))
+            first = s_live & ~jnp.concatenate(
+                [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
+            )
+            boundary = s_live & (diff | first)
+            gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            gid = jnp.where(s_live, gid, cap - 1)
+            n_groups = jnp.sum(boundary.astype(jnp.int32))
+            bpos = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
+            out_keys = [jnp.take(sk, bpos) for sk in s_keys]
+            states = []
+            for (a, x) in zip(aggs, agg_ins):
+                sx = jnp.take(x, order) if x is not None else None
+                if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+                    states.append(
+                        jax.ops.segment_sum(
+                            s_live.astype(jnp.int64), gid,
+                            num_segments=cap,
+                        )
+                    )
+                elif a.fn in (AggFn.SUM, AggFn.AVG):
+                    v = jnp.where(s_live, sx, jnp.zeros_like(sx))
+                    states.append(
+                        jax.ops.segment_sum(v, gid, num_segments=cap)
+                    )
+                    if a.fn is AggFn.AVG:
+                        states.append(
+                            jax.ops.segment_sum(
+                                s_live.astype(jnp.int64), gid,
+                                num_segments=cap,
+                            )
+                        )
+                elif a.fn in (AggFn.MIN, AggFn.MAX):
+                    if jnp.issubdtype(sx.dtype, jnp.floating):
+                        neutral = jnp.inf if a.fn is AggFn.MIN else -jnp.inf
+                    else:
+                        info = jnp.iinfo(sx.dtype)
+                        neutral = (
+                            info.max if a.fn is AggFn.MIN else info.min
+                        )
+                    v = jnp.where(s_live, sx, jnp.asarray(neutral, sx.dtype))
+                    red = (jax.ops.segment_min if a.fn is AggFn.MIN
+                           else jax.ops.segment_max)
+                    states.append(red(v, gid, num_segments=cap))
+                else:
+                    raise NotImplementedError(a.fn)
+            live_groups = jnp.arange(cap) < n_groups
+            return out_keys, states, n_groups, live_groups
+
+        def merge_reduce(key_vals, states_in, live, cap):
+            """Final merge: same grouping, states combine by their merge op
+            (sum for SUM/COUNT/AVG parts, min/max for MIN/MAX)."""
+            pri = [jnp.where(live, 0, 1).astype(jnp.int8)]
+            pri += [k for k in key_vals]
+            order = jnp.lexsort(tuple(reversed(pri)))
+            s_live = jnp.take(live, order)
+            diff = jnp.zeros(cap, dtype=jnp.bool_)
+            s_keys = []
+            for k in key_vals:
+                sk = jnp.take(k, order)
+                s_keys.append(sk)
+                diff = diff | (sk != jnp.concatenate([sk[:1], sk[:-1]]))
+            first = s_live & ~jnp.concatenate(
+                [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
+            )
+            boundary = s_live & (diff | first)
+            gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            gid = jnp.where(s_live, gid, cap - 1)
+            n_groups = jnp.sum(boundary.astype(jnp.int32))
+            bpos = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
+            out_keys = [jnp.take(sk, bpos) for sk in s_keys]
+            out_states = []
+            si = 0
+            for a in aggs:
+                width = 2 if a.fn is AggFn.AVG else 1
+                for w in range(width):
+                    x = jnp.take(states_in[si], order)
+                    if a.fn in (AggFn.MIN, AggFn.MAX) and w == 0:
+                        if jnp.issubdtype(x.dtype, jnp.floating):
+                            neutral = (jnp.inf if a.fn is AggFn.MIN
+                                       else -jnp.inf)
+                        else:
+                            info = jnp.iinfo(x.dtype)
+                            neutral = (info.max if a.fn is AggFn.MIN
+                                       else info.min)
+                        v = jnp.where(s_live, x,
+                                      jnp.asarray(neutral, x.dtype))
+                        red = (jax.ops.segment_min if a.fn is AggFn.MIN
+                               else jax.ops.segment_max)
+                        out_states.append(
+                            red(v, gid, num_segments=cap)
+                        )
+                    else:
+                        v = jnp.where(s_live, x, jnp.zeros_like(x))
+                        out_states.append(
+                            jax.ops.segment_sum(v, gid, num_segments=cap)
+                        )
+                    si += 1
+            return out_keys, out_states, n_groups
+
+        def per_shard(num_rows_s, *cols_s):
+            cols = [c[0] for c in cols_s]
+            nr = num_rows_s[0]
+            cap = cols[0].shape[0]
+            ev = DeviceEvaluator(
+                schema, [(c, None) for c in cols], cap
+            )
+            live = jnp.arange(cap) < nr
+            if pred is not None:
+                live = live & ev.evaluate_predicate(pred)
+            key_vals = [ev.evaluate(k)[0] for k in keys]
+            agg_ins = [
+                ev.evaluate(a.expr)[0] if a.expr is not None else None
+                for a in aggs
+            ]
+            out_keys, states, _, live_g = group_reduce(
+                key_vals, agg_ins, live, cap
+            )
+            # ---- ICI repartition of partial groups by key hash ----
+            kcols = [
+                (k, None, _key_dtype(keys[i], schema))
+                for i, k in enumerate(out_keys)
+            ]
+            target = pmod(hash_columns_device(kcols, cap), n_dev)
+            payload = out_keys + states
+            exchanged = []
+            for arr in payload:
+                b = _bucketize(arr, target, live_g, n_dev, cap)
+                ex = lax.all_to_all(
+                    b[None], axis, split_axis=1, concat_axis=0
+                )
+                exchanged.append(ex.reshape(n_dev * cap))
+            lv = _bucket_live(target, live_g, n_dev, cap)
+            lx = lax.all_to_all(
+                lv[None], axis, split_axis=1, concat_axis=0
+            ).reshape(n_dev * cap)
+            # ---- final merge on the owning shard ----
+            big = n_dev * cap
+            fk, fs, ng = merge_reduce(
+                exchanged[:n_keys], exchanged[n_keys:], lx, big
+            )
+            # finalize AVG into a float column
+            final_cols = []
+            si = 0
+            for a in aggs:
+                if a.fn is AggFn.AVG:
+                    s, c = fs[si], fs[si + 1]
+                    final_cols.append(
+                        s.astype(jnp.float64)
+                        / jnp.maximum(c, 1).astype(jnp.float64)
+                    )
+                    si += 2
+                else:
+                    final_cols.append(fs[si])
+                    si += 1
+            return (
+                tuple(k[None, :] for k in fk)
+                + tuple(c[None, :] for c in final_cols)
+                + (ng[None],)
+            )
+
+        n_out = n_keys + len(aggs) + 1
+        fn = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(axis),) + tuple(P(axis) for _ in shapes),
+            out_specs=tuple([P(axis)] * n_out),
+        )
+
+        @jax.jit
+        def run(*args):
+            num_rows = args[-1]
+            cols = args[:-1]
+            outs = fn(num_rows, *cols)
+            return (
+                list(outs[:n_keys]),
+                list(outs[n_keys:-1]),
+                outs[-1],
+            )
+
+        return lambda *cols_and_rows: run(
+            *cols_and_rows[:-1], cols_and_rows[-1]
+        )
+
+
+def _key_dtype(e: ir.Expr, schema: Schema) -> DataType:
+    dt = infer_dtype(e, schema)
+    if dt.is_dictionary_encoded:
+        raise NotImplementedError(
+            "string group keys use the file-shuffle tier"
+        )
+    return dt
