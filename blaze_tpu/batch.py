@@ -337,4 +337,4 @@ def empty_batch(schema: Schema, capacity: Optional[int] = None) -> ColumnBatch:
 
 def row_mask(num_rows, capacity: int) -> jax.Array:
     """Mask of live rows for a padded batch; `num_rows` may be traced."""
-    return jnp.arange(capacity) < num_rows
+    return jnp.arange(capacity, dtype=jnp.int32) < num_rows

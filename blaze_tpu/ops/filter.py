@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from blaze_tpu.types import Schema
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.host_lower import lower_strings_host
@@ -26,7 +27,7 @@ from blaze_tpu.ops.project import _unflatten_cvs
 class FilterExec(PhysicalOp):
     def __init__(self, child: PhysicalOp, predicate: ir.Expr):
         self.children = [child]
-        self.predicate = ir.bind(predicate, child.schema)
+        self.predicate = bind_opt(predicate, child.schema)
         self._jit_cache = {}
 
     @property

@@ -34,6 +34,7 @@ from blaze_tpu.config import get_config
 from blaze_tpu.types import DataType, Field, Schema, TypeId
 from blaze_tpu.batch import Column, ColumnBatch, row_mask
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.exprs.ir import AggExpr, AggFn
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.typing import infer_dtype
@@ -96,7 +97,7 @@ class HashAggregateExec(PhysicalOp):
         self.children = [child]
         self.mode = mode
         in_schema = child.schema
-        self.keys = [(ir.bind(e, in_schema), n) for e, n in keys]
+        self.keys = [(bind_opt(e, in_schema), n) for e, n in keys]
         if mode is AggMode.FINAL:
             # child refs are ignored in FINAL mode; states are located
             # positionally in the partial output (keys first, then states
@@ -115,7 +116,7 @@ class HashAggregateExec(PhysicalOp):
                 (
                     AggExpr(
                         a.fn,
-                        ir.bind(a.child, in_schema)
+                        bind_opt(a.child, in_schema)
                         if a.child is not None
                         else None,
                     ),
@@ -234,7 +235,7 @@ class HashAggregateExec(PhysicalOp):
         def kernel(bufs, selection, num_rows):
             cols = _unflatten_cvs(layout, bufs)
             ev = DeviceEvaluator(in_schema, cols, capacity)
-            live = jnp.arange(capacity) < num_rows
+            live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
             if selection is not None:
                 live = live & selection
 
@@ -280,7 +281,7 @@ class HashAggregateExec(PhysicalOp):
                     boundary, size=capacity, fill_value=0
                 )[0]
             else:
-                idx = jnp.arange(capacity)
+                idx = jnp.arange(capacity, dtype=jnp.int32)
                 s_live = live
                 gid_sorted = jnp.where(live, 0, capacity - 1)
                 n_groups = jnp.asarray(1, jnp.int32)
@@ -382,7 +383,7 @@ class HashAggregateExec(PhysicalOp):
             any_v = seg(contrib.astype(jnp.int64)) > 0
             return [(m, any_v)]
         if fn in (AggFn.FIRST, AggFn.LAST):
-            pos_in = jnp.arange(capacity)
+            pos_in = jnp.arange(capacity, dtype=jnp.int32)
             big = capacity + 1
             if fn is AggFn.FIRST:
                 rank = jnp.where(contrib, pos_in, big)
@@ -450,7 +451,7 @@ class HashAggregateExec(PhysicalOp):
         if fn in (AggFn.FIRST, AggFn.LAST):
             v, m = states[0]
             contrib = live_f if m is None else (live_f & m)
-            pos_in = jnp.arange(capacity)
+            pos_in = jnp.arange(capacity, dtype=jnp.int32)
             big = capacity + 1
             if fn is AggFn.FIRST:
                 rank = jnp.where(contrib, pos_in, big)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from blaze_tpu.types import Field, Schema
 from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
@@ -26,7 +27,7 @@ class ProjectExec(PhysicalOp):
     def __init__(self, child: PhysicalOp,
                  exprs: Sequence[Tuple[ir.Expr, str]]):
         self.children = [child]
-        self.exprs = [(ir.bind(e, child.schema), name) for e, name in exprs]
+        self.exprs = [(bind_opt(e, child.schema), name) for e, name in exprs]
         self._schema = Schema(
             [
                 Field(name, infer_dtype(e, child.schema), True)

@@ -30,6 +30,7 @@ from blaze_tpu.config import get_config
 from blaze_tpu.types import Schema
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.hashing import (
     device_hash_supported,
@@ -206,7 +207,7 @@ class ShuffleWriterExec(PhysicalOp):
                  num_partitions: int, data_file: str, index_file: str,
                  mode: str = "hash"):
         self.children = [child]
-        self.key_exprs = [ir.bind(e, child.schema) for e in key_exprs]
+        self.key_exprs = [bind_opt(e, child.schema) for e in key_exprs]
         self.num_partitions = num_partitions
         self.data_file = data_file
         self.index_file = index_file

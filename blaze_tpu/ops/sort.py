@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from blaze_tpu.types import Schema
 from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.util import (
     concat_batches,
@@ -42,7 +43,7 @@ class SortExec(PhysicalOp):
                  fetch: Optional[int] = None):
         self.children = [child]
         self.keys = [
-            SortKey(ir.bind(k.expr, child.schema), k.ascending,
+            SortKey(bind_opt(k.expr, child.schema), k.ascending,
                     k.nulls_first)
             for k in keys
         ]

@@ -180,8 +180,8 @@ def sort_indices(
     Uses iterated stable sorts from the least-significant key (classic
     radix-style lexsort) - every pass is one XLA sort op.
     """
-    idx = jnp.arange(capacity)
-    live = jnp.arange(capacity) < num_rows
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    live = jnp.arange(capacity, dtype=jnp.int32) < num_rows
     for values, validity, asc, nulls_first in reversed(list(keys)):
         v = jnp.take(values, idx, axis=0)
         lv = jnp.take(live.astype(jnp.int8), idx, axis=0)

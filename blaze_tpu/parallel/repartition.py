@@ -54,7 +54,7 @@ def _bucketize(values: jax.Array, target: jax.Array, live: jax.Array,
     # row index within its bucket
     ones = jnp.ones_like(st)
     idx_in_bucket = jnp.cumsum(ones) - 1
-    bucket_start = jnp.searchsorted(st, jnp.arange(num_devices + 1))
+    bucket_start = jnp.searchsorted(st, jnp.arange(num_devices + 1, dtype=jnp.int32))
     within = idx_in_bucket - jnp.take(bucket_start, st)
     # scatter into [num_devices * cap]
     flat_pos = jnp.where(
@@ -71,9 +71,9 @@ def _bucket_live(target: jax.Array, live: jax.Array, num_devices: int,
     t = jnp.where(live, target, num_devices)
     order = jnp.argsort(t, stable=True)
     st = jnp.take(t, order)
-    bucket_start = jnp.searchsorted(st, jnp.arange(num_devices + 1))
+    bucket_start = jnp.searchsorted(st, jnp.arange(num_devices + 1, dtype=jnp.int32))
     counts = bucket_start[1:] - bucket_start[:-1]  # rows per target
-    return jnp.arange(cap)[None, :] < counts[:, None]
+    return jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
 
 
 def all_to_all_repartition(

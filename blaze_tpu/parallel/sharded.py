@@ -31,6 +31,7 @@ from jax import shard_map
 
 from blaze_tpu.types import DataType, Schema, TypeId
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.optimize import bind_opt
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.hashing import hash_columns_device, pmod
 from blaze_tpu.exprs.ir import AggFn
@@ -59,14 +60,14 @@ class DistributedGroupBy:
         self.mesh = mesh
         self.axis = axis
         self.schema = schema
-        self.keys = [ir.bind(k, schema) for k in keys]
+        self.keys = [bind_opt(k, schema) for k in keys]
         self.aggs = [
-            DistAgg(a.fn, ir.bind(a.expr, schema)
+            DistAgg(a.fn, bind_opt(a.expr, schema)
                     if a.expr is not None else None)
             for a in aggs
         ]
         self.filter_pred = (
-            ir.bind(filter_pred, schema) if filter_pred is not None else None
+            bind_opt(filter_pred, schema) if filter_pred is not None else None
         )
         self._fn = None
 
@@ -156,7 +157,7 @@ class DistributedGroupBy:
                     states.append(red(v, gid, num_segments=cap))
                 else:
                     raise NotImplementedError(a.fn)
-            live_groups = jnp.arange(cap) < n_groups
+            live_groups = jnp.arange(cap, dtype=jnp.int32) < n_groups
             return out_keys, states, n_groups, live_groups
 
         def merge_reduce(key_vals, states_in, live, cap):
@@ -217,7 +218,7 @@ class DistributedGroupBy:
             ev = DeviceEvaluator(
                 schema, [(c, None) for c in cols], cap
             )
-            live = jnp.arange(cap) < nr
+            live = jnp.arange(cap, dtype=jnp.int32) < nr
             if pred is not None:
                 live = live & ev.evaluate_predicate(pred)
             key_vals = [ev.evaluate(k)[0] for k in keys]
