@@ -1,0 +1,297 @@
+"""TPC-DS-shaped differential tests.
+
+The reference's correctness strategy is differential testing of whole
+queries against a reference engine (SURVEY 4: 99-query TPC-DS CI validated
+against vanilla Spark). Here: the BASELINE.json benchmark shapes (q6 scan+
+filter+project, q1 grouped aggregate on returns, q3 join+aggregate, q18
+multi-join multi-group) built as engine plans over synthetic TPC-DS-like
+tables and validated against pandas.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col, ScalarFn
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    HashJoinExec,
+    JoinType,
+    MemoryScanExec,
+    ProjectExec,
+    SortExec,
+    SortKey,
+    SortMergeJoinExec,
+    LimitExec,
+)
+from blaze_tpu.parallel import ShuffleExchangeExec
+from blaze_tpu.runtime.executor import run_plan
+from blaze_tpu.types import DataType
+
+RNG = np.random.default_rng(20260728)
+N_SALES = 20_000
+N_ITEMS = 200
+N_DATES = 400
+N_CUSTOMERS = 300
+
+
+@pytest.fixture(scope="module")
+def tables():
+    store_sales = pd.DataFrame(
+        {
+            "ss_sold_date_sk": RNG.integers(0, N_DATES, N_SALES),
+            "ss_item_sk": RNG.integers(0, N_ITEMS, N_SALES),
+            "ss_customer_sk": RNG.integers(0, N_CUSTOMERS, N_SALES),
+            "ss_quantity": RNG.integers(1, 100, N_SALES),
+            "ss_sales_price": np.round(RNG.random(N_SALES) * 200, 2),
+            "ss_ext_sales_price": np.round(RNG.random(N_SALES) * 2000, 2),
+        }
+    )
+    date_dim = pd.DataFrame(
+        {
+            "d_date_sk": np.arange(N_DATES),
+            "d_year": 1998 + (np.arange(N_DATES) // 100),
+            "d_moy": (np.arange(N_DATES) // 30) % 12 + 1,
+        }
+    )
+    item = pd.DataFrame(
+        {
+            "i_item_sk": np.arange(N_ITEMS),
+            "i_brand_id": RNG.integers(0, 20, N_ITEMS),
+            "i_category": RNG.choice(
+                ["Books", "Music", "Sports", "Home"], N_ITEMS
+            ),
+        }
+    )
+    store_returns = pd.DataFrame(
+        {
+            "sr_customer_sk": RNG.integers(0, N_CUSTOMERS, 5000),
+            "sr_store_sk": RNG.integers(0, 10, 5000),
+            "sr_return_amt": np.round(RNG.random(5000) * 100, 2),
+        }
+    )
+    return {
+        "store_sales": store_sales,
+        "date_dim": date_dim,
+        "item": item,
+        "store_returns": store_returns,
+    }
+
+
+def scan(df: pd.DataFrame, parts: int = 4) -> MemoryScanExec:
+    rb = pa.RecordBatch.from_pandas(df, preserve_index=False)
+    n = rb.num_rows
+    per = (n + parts - 1) // parts
+    partitions = []
+    schema = None
+    for p in range(parts):
+        sl = rb.slice(p * per, min(per, n - p * per))
+        cb = ColumnBatch.from_arrow(sl)
+        schema = cb.schema
+        partitions.append([cb] if sl.num_rows else [])
+    return MemoryScanExec(partitions, schema)
+
+
+def as_df(table) -> pd.DataFrame:
+    return table.to_pandas()
+
+
+def test_q6_shape(tables):
+    """scan + filter + project + global aggregate."""
+    ss = tables["store_sales"]
+    partial = HashAggregateExec(
+        ProjectExec(
+            FilterExec(
+                scan(ss),
+                (Col("ss_sales_price") > 100.0)
+                & (Col("ss_quantity") < 50),
+            ),
+            [
+                (
+                    Col("ss_sales_price")
+                    * Col("ss_quantity").cast(DataType.float64()),
+                    "rev",
+                )
+            ],
+        ),
+        keys=[],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("rev")), "total"),
+            (AggExpr(AggFn.COUNT_STAR, None), "cnt"),
+        ],
+        mode=AggMode.PARTIAL,
+    )
+    # global aggregate = partial per partition + single exchange + final
+    # (the Spark planner shape the reference executes)
+    plan = HashAggregateExec(
+        ShuffleExchangeExec(partial, [], 1, mode="single"),
+        keys=[],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("rev")), "total"),
+            (AggExpr(AggFn.COUNT_STAR, None), "cnt"),
+        ],
+        mode=AggMode.FINAL,
+    )
+    got = as_df(run_plan(plan))
+    ref = ss[(ss.ss_sales_price > 100.0) & (ss.ss_quantity < 50)]
+    np.testing.assert_allclose(
+        got["total"][0], (ref.ss_sales_price * ref.ss_quantity).sum(),
+        rtol=1e-12,
+    )
+    assert got["cnt"][0] == len(ref)
+
+
+def test_q1_shape(tables):
+    """grouped aggregate with shuffle exchange (two-phase over files)."""
+    sr = tables["store_returns"]
+    partial = HashAggregateExec(
+        scan(sr),
+        keys=[(Col("sr_customer_sk"), "c"), (Col("sr_store_sk"), "s")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sr_return_amt")), "amt")],
+        mode=AggMode.PARTIAL,
+    )
+    exchange = ShuffleExchangeExec(partial, [Col("c"), Col("s")], 6)
+    final = HashAggregateExec(
+        exchange,
+        keys=[(Col("c"), "c"), (Col("s"), "s")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sr_return_amt")), "amt")],
+        mode=AggMode.FINAL,
+    )
+    got = as_df(run_plan(final)).sort_values(["c", "s"]).reset_index(
+        drop=True
+    )
+    ref = (
+        sr.groupby(["sr_customer_sk", "sr_store_sk"])["sr_return_amt"]
+        .sum()
+        .reset_index()
+        .sort_values(["sr_customer_sk", "sr_store_sk"])
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(ref)
+    np.testing.assert_array_equal(got["c"], ref.sr_customer_sk)
+    np.testing.assert_array_equal(got["s"], ref.sr_store_sk)
+    np.testing.assert_allclose(got["amt"], ref.sr_return_amt, rtol=1e-12)
+
+
+def test_q3_shape(tables):
+    """date_dim JOIN store_sales (SMJ) -> grouped aggregate -> sort."""
+    ss, dd, it = (
+        tables["store_sales"], tables["date_dim"], tables["item"],
+    )
+    dates = FilterExec(scan(dd, 1), Col("d_moy") == 11)
+    sales_one_part = ShuffleExchangeExec(scan(ss), [], 1, mode="single")
+    j = SortMergeJoinExec(
+        sales_one_part, dates,
+        ["ss_sold_date_sk"], ["d_date_sk"], JoinType.INNER,
+    )
+    agg = HashAggregateExec(
+        j,
+        keys=[(Col("d_year"), "d_year"),
+              (Col("ss_item_sk"), "item_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")), "sum_agg")],
+        mode=AggMode.COMPLETE,
+    )
+    out = SortExec(
+        agg,
+        [SortKey(Col("d_year")), SortKey(Col("sum_agg"), ascending=False)],
+        fetch=25,
+    )
+    got = as_df(run_plan(out))
+    mer = ss.merge(
+        dd[dd.d_moy == 11], left_on="ss_sold_date_sk",
+        right_on="d_date_sk",
+    )
+    ref = (
+        mer.groupby(["d_year", "ss_item_sk"])["ss_ext_sales_price"]
+        .sum()
+        .reset_index()
+        .sort_values(
+            ["d_year", "ss_ext_sales_price"], ascending=[True, False]
+        )
+        .head(25)
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(ref)
+    np.testing.assert_array_equal(got["d_year"], ref.d_year)
+    np.testing.assert_allclose(
+        got["sum_agg"], ref.ss_ext_sales_price, rtol=1e-12
+    )
+
+
+def test_q18_shape(tables):
+    """multi-join (broadcast + SMJ) + multi-key aggregate over strings."""
+    ss, dd, it = (
+        tables["store_sales"], tables["date_dim"], tables["item"],
+    )
+    sales_one = ShuffleExchangeExec(scan(ss), [], 1, mode="single")
+    j1 = HashJoinExec(
+        FilterExec(scan(dd, 1), Col("d_year") == 1999),
+        sales_one,
+        ["d_date_sk"], ["ss_sold_date_sk"], JoinType.INNER,
+    )
+    j2 = HashJoinExec(
+        scan(it, 1), j1, ["i_item_sk"], ["ss_item_sk"], JoinType.INNER,
+    )
+    agg = HashAggregateExec(
+        j2,
+        keys=[(Col("i_category"), "cat"), (Col("i_brand_id"), "brand")],
+        aggs=[
+            (AggExpr(AggFn.AVG, Col("ss_quantity")), "avg_qty"),
+            (AggExpr(AggFn.COUNT_STAR, None), "n"),
+        ],
+        mode=AggMode.COMPLETE,
+    )
+    got = as_df(run_plan(agg)).sort_values(["cat", "brand"]).reset_index(
+        drop=True
+    )
+    mer = ss.merge(
+        dd[dd.d_year == 1999], left_on="ss_sold_date_sk",
+        right_on="d_date_sk",
+    ).merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    ref = (
+        mer.groupby(["i_category", "i_brand_id"])
+        .agg(avg_qty=("ss_quantity", "mean"), n=("ss_quantity", "size"))
+        .reset_index()
+        .sort_values(["i_category", "i_brand_id"])
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(ref)
+    np.testing.assert_array_equal(got["cat"], ref.i_category)
+    np.testing.assert_array_equal(got["brand"], ref.i_brand_id)
+    np.testing.assert_allclose(got["avg_qty"], ref.avg_qty, rtol=1e-12)
+    np.testing.assert_array_equal(got["n"], ref.n)
+
+
+def test_repartition_shape(tables):
+    """BASELINE config 4: 200-way hash repartition on customer_sk -
+    row-preservation and Spark-placement invariants."""
+    ss = tables["store_sales"]
+    ex = ShuffleExchangeExec(scan(ss), [Col("ss_customer_sk")], 200)
+    from blaze_tpu.ops.base import ExecContext
+
+    ctx = ExecContext()
+    per_part_keys = {}
+    total = 0
+    for p in range(200):
+        for b in ex.execute(p, ctx):
+            arr = b.to_arrow()
+            total += arr.num_rows
+            for k in arr.column(
+                arr.schema.get_field_index("ss_customer_sk")
+            ).to_pylist():
+                per_part_keys.setdefault(k, set()).add(p)
+    assert total == len(ss)
+    # one key -> one partition, bit-exact Spark placement
+    from blaze_tpu.exprs.hashing import hash_long_host
+
+    for k, parts in per_part_keys.items():
+        assert len(parts) == 1
+        h = hash_long_host(int(k))
+        exp = np.int32(np.uint32(h & 0xFFFFFFFF)) % 200
+        if exp < 0:
+            exp += 200
+        assert parts == {int(exp)}
