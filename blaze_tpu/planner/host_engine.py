@@ -1,0 +1,244 @@
+"""Host fallback engine: executes PlanSpec subtrees in pandas.
+
+The role the JVM row-based execution plays for the reference: any node the
+convert strategy rejects (disabled op, unsupported expression, Window, ...)
+runs here, and `HostFallbackExec` bridges the result back into device
+batches - the ConvertToNative analog (ConvertToNativeExec.scala:61-95);
+the reverse bridge (native subtree consumed by a host node) is a plain
+`to_arrow()/to_pandas()` - the ConvertToUnsafeRow analog
+(ConvertToUnsafeRowExec.scala:50-90)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from blaze_tpu.types import Schema, from_arrow_schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.host_eval import HostEvaluator
+from blaze_tpu.exprs.ir import AggFn
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.planner import spec as S
+
+
+def _eval_expr_pd(df: pd.DataFrame, e: ir.Expr) -> pa.Array:
+    rb = pa.RecordBatch.from_pandas(df, preserve_index=False)
+    schema = from_arrow_schema(rb.schema)
+    bound = ir.bind(e, schema)
+    ev = HostEvaluator(schema, [rb.column(i) for i in range(rb.num_columns)])
+    out = ev.evaluate(bound)
+    if isinstance(out, pa.ChunkedArray):
+        out = out.combine_chunks()
+    return out
+
+
+_PD_AGG = {
+    AggFn.SUM: "sum",
+    AggFn.MIN: "min",
+    AggFn.MAX: "max",
+    AggFn.AVG: "mean",
+    AggFn.COUNT: "count",
+    AggFn.COUNT_STAR: "size",
+    AggFn.VAR_SAMP: "var",
+    AggFn.STDDEV_SAMP: "std",
+    AggFn.FIRST: "first",
+    AggFn.LAST: "last",
+}
+
+
+def execute_host(node: S.PlanSpec) -> pd.DataFrame:
+    """Interpret a PlanSpec subtree in pandas."""
+    if isinstance(node, S.MemorySpec):
+        return node.dataframe.copy()
+    if isinstance(node, S.ScanSpec):
+        import pyarrow.parquet as pq
+
+        frames = []
+        for group in node.file_groups:
+            for fr in group:
+                path = fr.path if hasattr(fr, "path") else fr
+                frames.append(
+                    pq.read_table(path, columns=list(node.projection)
+                                  if node.projection else None).to_pandas()
+                )
+        df = pd.concat(frames, ignore_index=True)
+        if node.predicate is not None:
+            mask = _eval_expr_pd(df, node.predicate).to_pandas()
+            df = df[mask.fillna(False).to_numpy(dtype=bool)]
+        return df.reset_index(drop=True)
+    if isinstance(node, S.ProjectSpec):
+        df = execute_host(node.children[0])
+        out = {}
+        for e, name in node.exprs:
+            out[name] = _eval_expr_pd(df, e).to_pandas()
+        return pd.DataFrame(out)
+    if isinstance(node, S.FilterSpec):
+        df = execute_host(node.children[0])
+        mask = _eval_expr_pd(df, node.predicate).to_pandas()
+        return df[mask.fillna(False).to_numpy(dtype=bool)].reset_index(
+            drop=True
+        )
+    if isinstance(node, S.SortSpec):
+        df = execute_host(node.children[0])
+        cols, ascs, poss = [], [], []
+        tmp = df.copy()
+        for i, (e, asc, nf) in enumerate(node.keys):
+            cname = f"__sk{i}"
+            tmp[cname] = _eval_expr_pd(df, e).to_pandas()
+            cols.append(cname)
+            ascs.append(asc)
+            poss.append("first" if nf else "last")
+        tmp = tmp.sort_values(
+            cols, ascending=ascs, kind="stable",
+            na_position=poss[0] if poss else "first",
+        ).drop(columns=cols)
+        if node.fetch:
+            tmp = tmp.head(node.fetch)
+        return tmp.reset_index(drop=True)
+    if isinstance(node, S.UnionSpec):
+        return pd.concat(
+            [execute_host(c) for c in node.children], ignore_index=True
+        )
+    if isinstance(node, S.LimitSpec):
+        return execute_host(node.children[0]).head(node.limit).reset_index(
+            drop=True
+        )
+    if isinstance(node, S.AggSpec):
+        df = execute_host(node.children[0])
+        key_names = []
+        tmp = pd.DataFrame(index=df.index)
+        for e, name in node.keys:
+            tmp[name] = _eval_expr_pd(df, e).to_pandas()
+            key_names.append(name)
+        agg_cols = {}
+        for i, (a, name) in enumerate(node.aggs):
+            if a.child is not None:
+                tmp[f"__a{i}"] = _eval_expr_pd(df, a.child).to_pandas()
+            else:
+                tmp[f"__a{i}"] = 1
+        if key_names:
+            g = tmp.groupby(key_names, dropna=False, sort=False)
+            out = pd.DataFrame()
+            parts = {}
+            for i, (a, name) in enumerate(node.aggs):
+                fn = _PD_AGG[a.fn]
+                col = g[f"__a{i}"]
+                parts[name] = getattr(col, fn)() if fn != "size" \
+                    else col.size()
+            out = pd.DataFrame(parts).reset_index()
+            return out
+        parts = {}
+        for i, (a, name) in enumerate(node.aggs):
+            fn = _PD_AGG[a.fn]
+            col = tmp[f"__a{i}"]
+            parts[name] = [
+                getattr(col, fn)() if fn != "size" else len(col)
+            ]
+        return pd.DataFrame(parts)
+    if isinstance(node, S.JoinSpec):
+        l = execute_host(node.children[0])
+        r = execute_host(node.children[1])
+        how = {
+            "inner": "inner", "left": "left", "right": "right",
+            "full": "outer",
+        }.get(node.join_type)
+        if how is None:
+            lk = list(node.left_keys)
+            rk = list(node.right_keys)
+            matched = l.merge(
+                r[rk].drop_duplicates(), left_on=lk, right_on=rk,
+                how="inner",
+            )[l.columns]
+            if node.join_type == "left_semi":
+                out = matched.drop_duplicates()
+            else:  # left_anti
+                key = l[lk].apply(tuple, axis=1)
+                mkey = set(matched[lk].apply(tuple, axis=1))
+                out = l[~key.isin(mkey)]
+            df = out.reset_index(drop=True)
+        else:
+            df = l.merge(
+                r, left_on=list(node.left_keys),
+                right_on=list(node.right_keys), how=how,
+            )
+        if node.condition is not None:
+            mask = _eval_expr_pd(df, node.condition).to_pandas()
+            df = df[mask.fillna(False).to_numpy(dtype=bool)]
+        return df.reset_index(drop=True)
+    if isinstance(node, S.ExchangeSpec):
+        # partitioning is a no-op for the single-frame host engine
+        return execute_host(node.children[0])
+    if isinstance(node, S.WindowSpec):
+        df = execute_host(node.children[0])
+        if node.function == "row_number":
+            if node.partition_by:
+                rn = (
+                    df.sort_values(list(node.order_by), kind="stable")
+                    .groupby(list(node.partition_by), sort=False)
+                    .cumcount()
+                    + 1
+                )
+            else:
+                rn = (
+                    df.sort_values(list(node.order_by), kind="stable")
+                    .reset_index()
+                    .index
+                    + 1
+                )
+            out = df.copy()
+            out[node.output] = rn.sort_index()
+            return out
+        raise NotImplementedError(node.function)
+    raise NotImplementedError(type(node))
+
+
+class HostFallbackExec(PhysicalOp):
+    """Run a PlanSpec subtree on the host engine and re-enter the native
+    tier as device batches (ConvertToNative analog)."""
+
+    def __init__(self, node: S.PlanSpec, num_partitions: int = 1):
+        self.children = []
+        self.node = node
+        self._n = num_partitions
+        self._df: Optional[pd.DataFrame] = None
+        self._schema: Optional[Schema] = None
+
+    def _frame(self) -> pd.DataFrame:
+        if self._df is None:
+            self._df = execute_host(self.node)
+        return self._df
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema is None:
+            rb = pa.RecordBatch.from_pandas(
+                self._frame(), preserve_index=False
+            )
+            self._schema = from_arrow_schema(rb.schema)
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return self._n
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        df = self._frame()
+        n = len(df)
+        per = (n + self._n - 1) // self._n if self._n else n
+        lo = partition * per
+        hi = min(n, lo + per)
+        if hi <= lo:
+            return
+        rb = pa.RecordBatch.from_pandas(
+            df.iloc[lo:hi], preserve_index=False
+        )
+        bs = ctx.config.batch_size
+        for start in range(0, rb.num_rows, bs):
+            yield ColumnBatch.from_arrow(
+                rb.slice(start, min(bs, rb.num_rows - start))
+            )

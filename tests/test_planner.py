@@ -1,0 +1,188 @@
+"""Planner tier tests: convert strategy, per-node fallback, bridges."""
+
+import numpy as np
+import pandas as pd
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.planner import (
+    AggSpec,
+    ConvertStrategy,
+    ExchangeSpec,
+    FilterSpec,
+    JoinSpec,
+    LimitSpec,
+    MemorySpec,
+    ProjectSpec,
+    ScanSpec,
+    SortSpec,
+    WindowSpec,
+    convert_plan,
+)
+from blaze_tpu.planner.host_engine import HostFallbackExec
+from blaze_tpu.runtime.executor import run_plan
+
+
+def df_sales(n=1000, seed=5):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "k": rng.integers(0, 9, n),
+            "v": rng.integers(0, 100, n),
+            "p": np.round(rng.random(n) * 10, 3),
+        }
+    )
+
+
+def test_native_pipeline_through_planner():
+    df = df_sales()
+    plan = AggSpec(
+        children=[
+            FilterSpec(
+                children=[MemorySpec(dataframe=df, partitions=3)],
+                predicate=Col("v") > 50,
+            )
+        ],
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("p")), "s")],
+        mode="complete",
+    )
+    # grouped agg per partition would split groups; wrap in exchange first
+    plan = AggSpec(
+        children=[
+            ExchangeSpec(
+                children=[plan.children[0]], keys=[Col("k")],
+                num_partitions=4,
+            )
+        ],
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("p")), "s")],
+        mode="complete",
+    )
+    op = convert_plan(plan)
+    assert not isinstance(op, HostFallbackExec)
+    got = run_plan(op).to_pandas().sort_values("k").reset_index(drop=True)
+    ref = (
+        df[df.v > 50].groupby("k")["p"].sum().reset_index(name="s")
+        .sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["k"], ref["k"])
+    np.testing.assert_allclose(got["s"], ref["s"], rtol=1e-12)
+
+
+def test_window_falls_back_to_host():
+    df = df_sales(100)
+    plan = WindowSpec(
+        children=[MemorySpec(dataframe=df)],
+        partition_by=["k"],
+        order_by=["v"],
+        function="row_number",
+        output="rn",
+    )
+    op = convert_plan(plan)
+    assert isinstance(op, HostFallbackExec)
+    got = run_plan(op).to_pandas()
+    assert "rn" in got.columns
+    assert sorted(got[got.k == got.k.iloc[0]].rn)[0] == 1
+
+
+def test_native_above_host_window():
+    """A native filter over a host-only window: the host subtree bridges
+    back into device batches."""
+    df = df_sales(200)
+    plan = FilterSpec(
+        children=[
+            WindowSpec(
+                children=[MemorySpec(dataframe=df)],
+                partition_by=["k"], order_by=["v"],
+                function="row_number", output="rn",
+            )
+        ],
+        predicate=Col("rn") == 1,
+    )
+    op = convert_plan(plan)
+    from blaze_tpu.ops import FilterExec
+
+    assert isinstance(op, FilterExec)
+    assert isinstance(op.children[0], HostFallbackExec)
+    got = run_plan(op).to_pandas()
+    assert len(got) == df.k.nunique()
+
+
+def test_disabled_gate_falls_back():
+    df = df_sales(50)
+    plan = SortSpec(
+        children=[MemorySpec(dataframe=df)],
+        keys=[(Col("v"), True, True)],
+    )
+    op = convert_plan(plan, ConvertStrategy(enable_sort=False))
+    assert isinstance(op, HostFallbackExec)
+    got = run_plan(op).to_pandas()
+    assert got["v"].is_monotonic_increasing
+
+
+def test_non_equi_join_host_fallback():
+    l = pd.DataFrame({"a": [1, 2, 3]})
+    r = pd.DataFrame({"b": [2, 3, 4]})
+    plan = JoinSpec(
+        children=[MemorySpec(dataframe=l), MemorySpec(dataframe=r)],
+        kind="smj", left_keys=[], right_keys=[], join_type="inner",
+    )
+    op = convert_plan(plan)
+    assert isinstance(op, HostFallbackExec)
+
+
+def test_join_condition_becomes_native_filter():
+    l = pd.DataFrame({"a": [1, 2, 2], "x": [10, 20, 30]})
+    r = pd.DataFrame({"b": [1, 2], "y": [5, 25]})
+    plan = JoinSpec(
+        children=[MemorySpec(dataframe=l), MemorySpec(dataframe=r)],
+        kind="smj", left_keys=["a"], right_keys=["b"],
+        join_type="inner", condition=Col("x") > Col("y"),
+    )
+    op = convert_plan(plan)
+    from blaze_tpu.ops import FilterExec, SortMergeJoinExec
+
+    assert isinstance(op, FilterExec)
+    assert isinstance(op.children[0], SortMergeJoinExec)
+    got = run_plan(op).to_pandas()
+    rows = set(map(tuple, got.values.tolist()))
+    assert rows == {(1, 10, 1, 5), (2, 30, 2, 25)}
+
+
+def test_parquet_scan_spec(tmp_path):
+    import pyarrow as pa
+
+    from blaze_tpu.ops.parquet_scan import FileRange
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table({"a": list(range(100)), "b": [i * 2 for i in range(100)]}),
+        path,
+    )
+    plan = ProjectSpec(
+        children=[
+            ScanSpec(
+                file_groups=[[FileRange(path)]],
+                projection=["a", "b"],
+                predicate=Col("a") >= 95,
+            )
+        ],
+        exprs=[(Col("b") + 1, "b1")],
+    )
+    op = convert_plan(plan)
+    got = run_plan(op).to_pandas()
+    assert sorted(got["b1"]) == [191, 193, 195, 197, 199]
+
+
+def test_broadcast_exchange_spec():
+    df = df_sales(60)
+    plan = ExchangeSpec(
+        children=[MemorySpec(dataframe=df, partitions=2)],
+        mode="broadcast",
+    )
+    op = convert_plan(plan)
+    from blaze_tpu.parallel import BroadcastExchangeExec
+
+    assert isinstance(op, BroadcastExchangeExec)
