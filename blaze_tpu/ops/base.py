@@ -79,6 +79,19 @@ class PhysicalOp:
         raise NotImplementedError
 
     # ------------------------------------------------------------------
+    def describe(self) -> str:
+        """One-line operator description for plan display."""
+        return type(self).__name__
+
+    def display(self, indent: int = 0) -> str:
+        """Indented plan tree (the reference logs the same shape at task
+        start: displayable(...).indent(), exec.rs:154-158)."""
+        lines = ["  " * indent + self.describe()]
+        for c in self.children:
+            lines.append(c.display(indent + 1))
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
     def fingerprint(self) -> str:
         """Stable id for jit-cache keying; subclasses append params."""
         parts = [type(self).__name__]

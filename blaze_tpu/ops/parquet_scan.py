@@ -46,8 +46,10 @@ class ParquetScanExec(PhysicalOp):
         self.projection = list(projection) if projection else None
         self.pruning_predicate = pruning_predicate
         if schema is None:
+            from blaze_tpu.io.object_store import store_for
+
             first = self.file_groups[0][0].path
-            aschema = pq.read_schema(first)
+            aschema = pq.read_schema(store_for(first).open_input(first))
             if self.projection:
                 aschema = __import__("pyarrow").schema(
                     [aschema.field(n) for n in self.projection]
@@ -67,10 +69,14 @@ class ParquetScanExec(PhysicalOp):
                 ) -> Iterator[ColumnBatch]:
         import pyarrow.parquet as pq
 
+        from blaze_tpu.io.object_store import store_for
+
         cfg = ctx.config
         cols = self.projection or [f.name for f in self._schema]
         for fr in self.file_groups[partition]:
-            pf = pq.ParquetFile(fr.path)
+            # all byte IO flows through the object-store seam (the
+            # reference's registered ObjectStore, exec.rs:96-103)
+            pf = pq.ParquetFile(store_for(fr.path).open_input(fr.path))
             groups = self._select_row_groups(pf, fr)
             if not groups:
                 continue

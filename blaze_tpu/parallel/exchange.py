@@ -54,6 +54,9 @@ class ShuffleExchangeExec(PhysicalOp):
         return self.num_partitions
 
     # ------------------------------------------------------------------
+    MAX_TASK_ATTEMPTS = 3  # Spark-style task retry (SURVEY 5.3: the
+    # reference leans on Spark's task/stage retry as its recovery layer)
+
     def _run_map_stage(self, ctx: ExecContext) -> List[Tuple[str, str]]:
         with self._lock:
             if self._map_outputs is not None:
@@ -67,23 +70,51 @@ class ShuffleExchangeExec(PhysicalOp):
                 index = os.path.join(
                     d, f"shuffle_{id(self):x}_{map_id}_0.index"
                 )
-                writer = ShuffleWriterExec(
-                    child, self.keys, self.num_partitions, data, index,
-                    self.mode,
-                )
-                for _ in writer.execute(map_id, ctx):
-                    pass
+                last_err = None
+                for attempt in range(self.MAX_TASK_ATTEMPTS):
+                    try:
+                        writer = ShuffleWriterExec(
+                            child, self.keys, self.num_partitions,
+                            data, index, self.mode,
+                        )
+                        for _ in writer.execute(map_id, ctx):
+                            pass
+                        last_err = None
+                        break
+                    except Exception as e:  # retry like a failed Spark task
+                        last_err = e
+                        ctx.metrics.add("task_retries", 1)
+                if last_err is not None:
+                    raise last_err
                 outputs.append((data, index))
             self._map_outputs = outputs
             return outputs
 
+    def map_output_statistics(self, ctx: ExecContext) -> List[int]:
+        """Bytes per reduce partition, summed over map outputs - what the
+        reference feeds AQE through mapOutputStatisticsFuture
+        (ArrowShuffleExchangeExec301.scala:104-130)."""
+        sizes = [0] * self.num_partitions
+        for _, index in self._run_map_stage(ctx):
+            for p, (_, length) in enumerate(partition_ranges(index)):
+                sizes[p] += length
+        return sizes
+
     def segments_for(self, partition_range: Tuple[int, int],
-                     ctx: ExecContext) -> List[FileSegment]:
-        """FileSegments covering [start, end) reduce partitions across all
-        map outputs (AQE coalesced reads use ranges > 1 wide)."""
+                     ctx: ExecContext,
+                     map_range: Optional[Tuple[int, int]] = None
+                     ) -> List[FileSegment]:
+        """FileSegments covering [start, end) reduce partitions across the
+        given range of map outputs (all by default). Reduce-range > 1 wide
+        = AQE CoalescedPartitionSpec; map-range narrower than all maps =
+        PartialReducerPartitionSpec (skew split) / PartialMapper
+        (NativeSupports.scala:131-212 spec handling)."""
         start, end = partition_range
+        outputs = self._run_map_stage(ctx)
+        if map_range is not None:
+            outputs = outputs[map_range[0]: map_range[1]]
         segs = []
-        for data, index in self._run_map_stage(ctx):
+        for data, index in outputs:
             ranges = partition_ranges(index)
             for p in range(start, end):
                 off, length = ranges[p]
@@ -102,13 +133,23 @@ class ShuffleExchangeExec(PhysicalOp):
 
 class CoalescedShuffleReader(PhysicalOp):
     """AQE-style reader over a ShuffleExchange: each output partition maps
-    to a contiguous range of reduce partitions (reference
-    CustomShuffleReaderExec handling, NativeSupports.scala:131-212)."""
+    to a (reduce-range, map-range) spec (reference CustomShuffleReaderExec
+    handling, NativeSupports.scala:131-212):
+    - (start, end) with full map range  = CoalescedPartitionSpec
+    - single reduce + partial map range = PartialReducerPartitionSpec
+      (skew-join split)
+    """
 
     def __init__(self, exchange: ShuffleExchangeExec,
-                 partition_ranges_: Sequence[Tuple[int, int]]):
+                 partition_ranges_: Sequence[Tuple[int, int]],
+                 map_ranges: Optional[Sequence[Optional[Tuple[int, int]]]]
+                 = None):
         self.children = [exchange]
         self.ranges = list(partition_ranges_)
+        self.map_ranges = (
+            list(map_ranges) if map_ranges is not None
+            else [None] * len(self.ranges)
+        )
 
     @property
     def schema(self) -> Schema:
@@ -123,9 +164,30 @@ class CoalescedShuffleReader(PhysicalOp):
         from blaze_tpu.io.ipc import read_file_segment
 
         ex: ShuffleExchangeExec = self.children[0]
-        for seg in ex.segments_for(self.ranges[partition], ctx):
+        for seg in ex.segments_for(
+            self.ranges[partition], ctx, self.map_ranges[partition]
+        ):
             for rb in read_file_segment(seg.path, seg.offset, seg.length):
                 yield ColumnBatch.from_arrow(rb)
+
+
+def plan_coalesced_partitions(sizes: Sequence[int], target_bytes: int
+                              ) -> List[Tuple[int, int]]:
+    """AQE partition coalescing: greedily pack adjacent reduce partitions
+    up to ~target_bytes (what Spark's CoalesceShufflePartitions does with
+    the stats the exchange reports)."""
+    ranges: List[Tuple[int, int]] = []
+    start = 0
+    acc = 0
+    for i, s in enumerate(sizes):
+        if acc > 0 and acc + s > target_bytes:
+            ranges.append((start, i))
+            start = i
+            acc = 0
+        acc += s
+    if start < len(sizes):
+        ranges.append((start, len(sizes)))
+    return ranges
 
 
 class BroadcastExchangeExec(PhysicalOp):

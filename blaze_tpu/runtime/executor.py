@@ -52,6 +52,11 @@ def execute_task(task_bytes: bytes,
 
 def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
                       ) -> Iterator[pa.RecordBatch]:
+    if log.isEnabledFor(logging.DEBUG):
+        log.debug(
+            "executing task %s partition %d:\n%s",
+            ctx.task_id, partition, op.display(),
+        )
     try:
         for cb in op.execute(partition, ctx):
             cb = ensure_compacted(cb)
