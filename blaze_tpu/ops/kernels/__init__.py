@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for hot ops the XLA fusion path doesn't already own.
+
+SURVEY 7 design stance: "hash partition = murmur3 (bit-exact Spark
+semantics) as a Pallas kernel". Everything here ships with a jnp fallback
+and an interpret-mode test path so the CPU test mesh exercises the same
+code."""

@@ -117,6 +117,26 @@ def spark_partition_ids(cb: ColumnBatch, key_exprs: Sequence[ir.Expr],
     there; C++/numpy host path otherwise."""
     schema = cb.schema
     dtypes = [infer_dtype(e, schema) for e in key_exprs]
+    # pallas fast path: single non-nullable int key on real TPU hardware
+    # (SURVEY 7: murmur3 partition hash as a Pallas kernel)
+    if (
+        len(key_exprs) == 1
+        and isinstance(key_exprs[0], ir.BoundCol)
+        and cb.columns[key_exprs[0].index].validity is None
+        and jax.default_backend() == "tpu"
+    ):
+        from blaze_tpu.ops.kernels import murmur3_pallas as mp
+
+        col = cb.columns[key_exprs[0].index]
+        tid = dtypes[0].id.value
+        if mp.supports(tid, cb.capacity):
+            fn = (
+                mp.partition_ids_int32
+                if tid in ("int32", "date32")
+                else mp.partition_ids_int64
+            )
+            pids = fn(col.values, num_partitions)
+            return np.asarray(pids)[: cb.num_rows]
     if all(device_hash_supported(dt) for dt in dtypes):
         cols = []
         ev = DeviceEvaluator(
