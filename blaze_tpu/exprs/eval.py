@@ -375,6 +375,17 @@ class DeviceEvaluator:
     # ------------------------------------------------------------------
     def _scalar_fn(self, e: ir.ScalarFn) -> CV:
         n = e.name
+        # fns with a literal config argument evaluate only the data args
+        if n == "date_part":
+            part = e.args[0]
+            assert isinstance(part, ir.Literal), "date_part needs literal"
+            v, m = self._eval(e.args[1])
+            return _date_part(str(part.value).lower(), v), m
+        if n == "trunc_date":
+            part = e.args[1]
+            assert isinstance(part, ir.Literal), "trunc needs literal fmt"
+            v, m = self._eval(e.args[0])
+            return _trunc_date(str(part.value).lower(), v), m
         args = [self._eval(a) for a in e.args]
         m = None
         for _, am in args:
@@ -459,8 +470,18 @@ class DeviceEvaluator:
         if n == "spark_make_decimal":
             # bigint -> decimal unscaled: identity (spark_ext_function.rs:29)
             return vs[0].astype(jnp.int64), m
-        if n in ("year", "month", "day", "dayofmonth", "quarter"):
+        if n in ("year", "month", "day", "dayofmonth", "quarter",
+                 "dayofweek", "dayofyear"):
             return _date_part(n, vs[0]), m
+        if n == "null_if":
+            # NULL when both args are equal (reference NullIf)
+            a, b = vs[0], vs[1]
+            eq = a == b.astype(a.dtype)
+            if m is not None:
+                eq = eq & m
+            base = args[0][1]
+            out_m = (~eq) if base is None else (base & ~eq)
+            return a, out_m
         raise NotImplementedError(f"device scalar fn {n}")
 
 
@@ -475,6 +496,39 @@ def _apply_float_op(op: Op, lv, rv):
 def _java_div(a, b):
     """Integer division truncating toward zero (Java/Spark semantics)."""
     return lax.div(a, b)
+
+
+def _days_from_civil(y, mth, d):
+    """Inverse of _date_part: civil date -> days since epoch (Hinnant)."""
+    y = y - jnp.where(mth <= 2, 1, 0)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(mth > 2, mth - 3, mth + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(
+        yoe, 100
+    ) + doy
+    return (era * 146_097 + doe - 719_468).astype(jnp.int32)
+
+
+def _trunc_date(fmt: str, days32) -> jax.Array:
+    """TruncDate: round a date32 down to year/quarter/month/week start."""
+    y = _date_part("year", days32).astype(jnp.int64)
+    mth = _date_part("month", days32).astype(jnp.int64)
+    if fmt in ("year", "yyyy", "yy"):
+        return _days_from_civil(y, jnp.ones_like(mth), jnp.ones_like(mth))
+    if fmt in ("quarter",):
+        qm = ((mth - 1) // 3) * 3 + 1
+        return _days_from_civil(y, qm, jnp.ones_like(mth))
+    if fmt in ("month", "mon", "mm"):
+        return _days_from_civil(y, mth, jnp.ones_like(mth))
+    if fmt in ("week",):
+        d = days32.astype(jnp.int64)
+        # 1970-01-01 was a Thursday; Monday-start weeks
+        dow = jax.lax.rem(d + 3, jnp.int64(7))
+        dow = jnp.where(dow < 0, dow + 7, dow)
+        return (d - dow).astype(jnp.int32)
+    raise NotImplementedError(f"trunc_date {fmt}")
 
 
 def _date_part(part: str, days32) -> jax.Array:
@@ -504,4 +558,15 @@ def _date_part(part: str, days32) -> jax.Array:
         return d.astype(jnp.int32)
     if part == "quarter":
         return (jnp.floor_divide(month - 1, 3) + 1).astype(jnp.int32)
+    if part in ("dayofweek", "dow"):
+        # Spark dayofweek: 1 = Sunday ... 7 = Saturday
+        dd = days32.astype(jnp.int64)
+        w = jax.lax.rem(dd + 4, jnp.int64(7))
+        w = jnp.where(w < 0, w + 7, w)
+        return (w + 1).astype(jnp.int32)
+    if part in ("dayofyear", "doy"):
+        jan1 = _days_from_civil(
+            year, jnp.ones_like(year), jnp.ones_like(year)
+        )
+        return (days32.astype(jnp.int64) - jan1 + 1).astype(jnp.int32)
     raise NotImplementedError(part)

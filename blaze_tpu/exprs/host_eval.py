@@ -158,6 +158,25 @@ class HostEvaluator:
                 args[0], pattern=_pat(e.args[1]),
                 replacement=_pat(e.args[2]),
             )
+        if n == "null_if":
+            eq = pc.fill_null(pc.equal(args[0], args[1]), False)
+            return pc.if_else(eq, pa.nulls(self.length, args[0].type),
+                              args[0])
+        if n == "octet_length":
+            return pc.cast(pc.binary_length(args[0]), pa.int32())
+        if n in ("md5", "sha224", "sha256", "sha384", "sha512"):
+            # digest fns (reference Md5/Sha2 cases): host-only, hashlib
+            import hashlib
+
+            fn = getattr(hashlib, n)
+            vals = args[0].to_pylist()
+            out = [
+                None if v is None else fn(
+                    v.encode("utf-8") if isinstance(v, str) else v
+                ).hexdigest()
+                for v in vals
+            ]
+            return pa.array(out, type=pa.utf8())
         raise NotImplementedError(f"host scalar fn {n}")
 
 

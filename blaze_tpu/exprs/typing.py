@@ -167,10 +167,14 @@ def infer_dtype(e: ir.Expr, schema: Schema) -> DataType:
             return DataType.int32()
         if n in ("year", "month", "day", "dayofmonth", "dayofweek",
                  "dayofyear", "quarter", "hour", "minute", "second",
-                 "weekofyear"):
+                 "weekofyear", "date_part", "octet_length"):
             return DataType.int32()
-        if n == "to_date":
+        if n in ("to_date", "trunc_date"):
             return DataType.date32()
+        if n == "null_if":
+            return infer_dtype(e.args[0], schema)
+        if n in ("md5", "sha224", "sha256", "sha384", "sha512"):
+            return DataType.utf8()
         raise NotImplementedError(f"unknown scalar fn {n}")
     if isinstance(e, ir.AggExpr):
         from blaze_tpu.exprs.ir import AggFn

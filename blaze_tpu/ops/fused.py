@@ -1,0 +1,164 @@
+"""Pipeline fusion: collapse Filter/Project/Rename chains into ONE jitted
+XLA program per batch.
+
+SURVEY 7 design stance: "operators are pure functions composed and jit'd
+per (plan-fingerprint, batch-shape-bucket)". Unfused, each operator in a
+scan->filter->project chain dispatches its own device program per batch;
+through this harness's network-tunneled chip a dispatch costs ~70ms, and
+even on directly-attached hardware it forfeits XLA's cross-op fusion. The
+`fuse_pipelines` pass rewrites maximal stateless chains into a
+FusedPipelineExec whose whole chain traces into a single program; the
+deferred selection vector (batch.ColumnBatch.selection) carries filter
+results through without any host sync.
+
+Stages whose expressions need the host string tier are left unfused (the
+per-op path handles their per-batch host lowering).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import Column, ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.filter import FilterExec
+from blaze_tpu.ops.project import ProjectExec, _unflatten_cvs
+from blaze_tpu.ops.rename import RenameColumnsExec
+
+
+def _expr_needs_host(e: ir.Expr, schema: Schema) -> bool:
+    """True when any non-passthrough node has a direct string input (the
+    host_lower hoisting condition)."""
+    if isinstance(e, (ir.BoundCol, ir.Col, ir.Literal)):
+        return False
+    for c in ir.children(e):
+        if _expr_needs_host(c, schema):
+            return True
+        try:
+            if infer_dtype(c, schema).is_string_like:
+                return True
+        except Exception:
+            return True
+    return False
+
+
+def _stage_fusable(op: PhysicalOp) -> bool:
+    if isinstance(op, RenameColumnsExec):
+        return True
+    if isinstance(op, FilterExec):
+        return not _expr_needs_host(op.predicate, op.children[0].schema)
+    if isinstance(op, ProjectExec):
+        child_schema = op.children[0].schema
+        return not any(
+            _expr_needs_host(e, child_schema) for e, _ in op.exprs
+        )
+    return False
+
+
+class FusedPipelineExec(PhysicalOp):
+    """A chain of stateless stages compiled as one device program."""
+
+    def __init__(self, leaf: PhysicalOp, stages: Sequence[PhysicalOp]):
+        self.children = [leaf]
+        self.stages = list(stages)  # bottom-up; stage i's child is i-1
+        self._schema = self.stages[-1].schema
+        self._jit_cache = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        inner = " -> ".join(type(s).__name__ for s in self.stages)
+        return f"FusedPipelineExec[{inner}]"
+
+    def execute(self, partition: int, ctx: ExecContext):
+        for cb in self.children[0].execute(partition, ctx):
+            yield self._run(cb)
+
+    def _run(self, cb: ColumnBatch) -> ColumnBatch:
+        key = cb.layout()
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._build_kernel(cb.layout()))
+            self._jit_cache[key] = fn
+        out_bufs, sel = fn(cb.device_buffers(), cb.selection)
+        # dictionaries for passthrough string columns
+        dicts = self._out_dictionaries(cb)
+        cols: List[Column] = []
+        it = iter(out_bufs)
+        for field, d in zip(self._schema, dicts):
+            v = next(it)
+            m = next(it)
+            cols.append(Column(field.dtype, v, m, d))
+        return ColumnBatch(self._schema, cols, cb.num_rows, sel)
+
+    def _build_kernel(self, layout):
+        stages = self.stages
+        leaf_schema = self.children[0].schema
+
+        def kernel(bufs, selection):
+            cols = _unflatten_cvs(layout, bufs)
+            schema = leaf_schema
+            cap = layout[0]
+            sel = selection
+            for st in stages:
+                ev = DeviceEvaluator(schema, cols, cap)
+                if isinstance(st, FilterExec):
+                    keep = ev.evaluate_predicate(st.predicate)
+                    sel = keep if sel is None else (sel & keep)
+                elif isinstance(st, ProjectExec):
+                    cols = [ev.evaluate(e) for e, _ in st.exprs]
+                    schema = st.schema
+                else:  # Rename
+                    schema = st.schema
+            out = []
+            for v, m in cols:
+                out.append(v)
+                out.append(
+                    m if m is not None
+                    else jnp.ones(cap, dtype=jnp.bool_)
+                )
+            return out, sel
+
+        return kernel
+
+    def _out_dictionaries(self, cb: ColumnBatch):
+        """Track dictionaries of string columns through the stage chain
+        (only passthrough BoundCol survives fusion for strings)."""
+        dicts = [c.dictionary for c in cb.columns]
+        for st in self.stages:
+            if isinstance(st, ProjectExec):
+                new = []
+                for e, _ in st.exprs:
+                    if isinstance(e, ir.BoundCol) and \
+                            e.dtype.is_dictionary_encoded:
+                        new.append(dicts[e.index])
+                    else:
+                        new.append(None)
+                dicts = new
+        return dicts
+
+
+def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
+    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages)."""
+    chain: List[PhysicalOp] = []
+    t = op
+    while (
+        isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
+        and len(t.children) == 1
+        and _stage_fusable(t)
+    ):
+        chain.append(t)
+        t = t.children[0]
+    if len(chain) >= 2:
+        return FusedPipelineExec(fuse_pipelines(t), list(reversed(chain)))
+    op.children = [fuse_pipelines(c) for c in op.children]
+    return op

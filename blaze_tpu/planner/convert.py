@@ -103,12 +103,19 @@ class ConvertStrategy:
 
 
 def convert_plan(root: S.PlanSpec,
-                 strategy: Optional[ConvertStrategy] = None) -> PhysicalOp:
+                 strategy: Optional[ConvertStrategy] = None,
+                 fuse: bool = True) -> PhysicalOp:
     """Convert a PlanSpec tree to an executable operator tree with
-    per-node host fallback."""
+    per-node host fallback, then fuse stateless chains into single
+    device programs (ops/fused.py)."""
     strategy = strategy or ConvertStrategy()
     _tag(root, strategy)
-    return _build(root, strategy)
+    op = _build(root, strategy)
+    if fuse:
+        from blaze_tpu.ops.fused import fuse_pipelines
+
+        op = fuse_pipelines(op)
+    return op
 
 
 # ---------------------------------------------------------------------------

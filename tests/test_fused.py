@@ -1,0 +1,97 @@
+"""Pipeline fusion tests: fused chains must match unfused results."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import Col, ScalarFn
+from blaze_tpu.ops import (
+    FilterExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+)
+from blaze_tpu.ops.fused import FusedPipelineExec, fuse_pipelines
+from blaze_tpu.runtime.executor import run_plan
+
+
+def chain(scan):
+    return ProjectExec(
+        RenameColumnsExec(
+            FilterExec(
+                ProjectExec(
+                    scan,
+                    [(Col("a"), "a"), (Col("a") * Col("b"), "ab")],
+                ),
+                Col("ab") > 10,
+            ),
+            ["a", "prod"],
+        ),
+        [(Col("prod") + 1, "p1"), (Col("a"), "a")],
+    )
+
+
+def test_fusion_rewrites_and_matches():
+    cb = ColumnBatch.from_pydict(
+        {"a": list(range(20)), "b": [2] * 20}
+    )
+    scan = MemoryScanExec.from_batches([cb])
+    unfused = chain(scan)
+    ref = run_plan(unfused).to_pydict()
+
+    fused = fuse_pipelines(chain(scan))
+    assert isinstance(fused, FusedPipelineExec)
+    assert len(fused.stages) == 4
+    got = run_plan(fused).to_pydict()
+    assert got == ref
+    assert got["p1"] == [2 * a + 1 for a in range(20) if 2 * a > 10]
+
+
+def test_string_stage_not_fused():
+    cb = ColumnBatch.from_pydict({"s": ["x", "yy"], "v": [1, 2]})
+    scan = MemoryScanExec.from_batches([cb])
+    plan = FilterExec(
+        ProjectExec(scan, [(Col("s"), "s"), (Col("v"), "v")]),
+        Col("s") == "x",
+    )
+    out = fuse_pipelines(plan)
+    # the string filter stays unfused; result still correct
+    assert isinstance(out, FilterExec)
+    assert run_plan(out).to_pydict() == {"s": ["x"], "v": [1]}
+
+
+def test_string_passthrough_fuses():
+    cb = ColumnBatch.from_pydict({"s": ["x", "yy", "z"], "v": [1, 2, 3]})
+    scan = MemoryScanExec.from_batches([cb])
+    plan = ProjectExec(
+        FilterExec(scan, Col("v") > 1),
+        [(Col("s"), "s"), (Col("v") * 10, "v10")],
+    )
+    out = fuse_pipelines(plan)
+    assert isinstance(out, FusedPipelineExec)
+    got = run_plan(out).to_pydict()
+    assert got == {"s": ["yy", "z"], "v10": [20, 30]}
+
+
+def test_fused_inside_larger_plan():
+    from blaze_tpu.exprs import AggExpr, AggFn
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+
+    cb = ColumnBatch.from_pydict(
+        {"k": [1, 2, 1, 2, 1], "v": [1, 2, 3, 4, 100]}
+    )
+    scan = MemoryScanExec.from_batches([cb])
+    plan = HashAggregateExec(
+        ProjectExec(
+            FilterExec(scan, Col("v") < 50),
+            [(Col("k"), "k"), (Col("v") * 2, "v2")],
+        ),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v2")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    fused = fuse_pipelines(plan)
+    assert isinstance(fused.children[0], FusedPipelineExec)
+    out = run_plan(fused).to_pydict()
+    assert dict(zip(out["k"], out["s"])) == {1: 8, 2: 12}

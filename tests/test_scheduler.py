@@ -1,0 +1,65 @@
+"""Threaded scheduler tests: concurrency, retry, ordering."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import Col
+from blaze_tpu.ops import FilterExec, MemoryScanExec
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.runtime.executor import TaskExecutionError
+from blaze_tpu.runtime.scheduler import run_plan_parallel
+
+
+def multi_scan(n_parts=6, rows=50):
+    parts = []
+    schema = None
+    for p in range(n_parts):
+        cb = ColumnBatch.from_pydict(
+            {"a": list(range(p * rows, (p + 1) * rows))}
+        )
+        schema = cb.schema
+        parts.append([cb])
+    return MemoryScanExec(parts, schema)
+
+
+def test_parallel_matches_serial():
+    op = FilterExec(multi_scan(), Col("a") % 3 == 0)
+    out = run_plan_parallel(op, parallelism=4)
+    got = out.to_pydict()["a"]
+    assert got == [a for a in range(300) if a % 3 == 0]  # partition order
+
+
+def test_flaky_task_retries():
+    fails = {"count": 0}
+    lock = threading.Lock()
+
+    class Flaky(MemoryScanExec):
+        def execute(self, partition, ctx):
+            with lock:
+                if partition == 2 and fails["count"] < 2:
+                    fails["count"] += 1
+                    raise IOError("transient")
+            return super().execute(partition, ctx)
+
+    base = multi_scan(4)
+    op = Flaky(base.partitions, base.schema)
+    ctx = ExecContext()
+    out = run_plan_parallel(op, ctx=ctx, parallelism=2)
+    assert out.num_rows == 200
+    assert fails["count"] == 2
+    assert ctx.metrics.counters["task_retries"] == 2
+
+
+def test_permanent_failure_raises():
+    class Dead(MemoryScanExec):
+        def execute(self, partition, ctx):
+            raise ValueError("no")
+            yield
+
+    base = multi_scan(2)
+    op = Dead(base.partitions, base.schema)
+    with pytest.raises(TaskExecutionError):
+        run_plan_parallel(op, parallelism=2, max_attempts=2)
