@@ -173,27 +173,65 @@ def execute_host(node: S.PlanSpec) -> pd.DataFrame:
         # partitioning is a no-op for the single-frame host engine
         return execute_host(node.children[0])
     if isinstance(node, S.WindowSpec):
-        df = execute_host(node.children[0])
-        if node.function == "row_number":
-            if node.partition_by:
-                rn = (
-                    df.sort_values(list(node.order_by), kind="stable")
-                    .groupby(list(node.partition_by), sort=False)
-                    .cumcount()
-                    + 1
-                )
-            else:
-                rn = (
-                    df.sort_values(list(node.order_by), kind="stable")
-                    .reset_index()
-                    .index
-                    + 1
-                )
-            out = df.copy()
-            out[node.output] = rn.sort_index()
-            return out
-        raise NotImplementedError(node.function)
+        return _execute_window(node)
     raise NotImplementedError(type(node))
+
+
+def _execute_window(node: S.WindowSpec) -> pd.DataFrame:
+    """Window functions stay host-tier (the reference keeps Window on the
+    JVM too, inserting row barriers before it - BlazeConverters.scala:
+    93-107). Supported: row_number, rank, dense_rank, lag, lead, and
+    sum/min/max/avg/count over the whole partition frame."""
+    df = execute_host(node.children[0])
+    out = df.copy()
+    pb = list(node.partition_by)
+    ob = list(node.order_by)
+    fn = node.function
+    ordered = df.sort_values(ob, kind="stable") if ob else df
+
+    def grouped(frame):
+        return frame.groupby(pb, sort=False) if pb else None
+
+    if fn == "row_number":
+        g = grouped(ordered)
+        rn = (g.cumcount() + 1) if g is not None else pd.Series(
+            np.arange(1, len(ordered) + 1), index=ordered.index
+        )
+        out[node.output] = rn.sort_index()
+        return out
+    if fn in ("rank", "dense_rank"):
+        method = "min" if fn == "rank" else "dense"
+        key = df[ob[0]] if len(ob) == 1 else df[ob].apply(tuple, axis=1)
+        if pb:
+            r = key.groupby(
+                [df[c] for c in pb], sort=False
+            ).rank(method=method)
+        else:
+            r = key.rank(method=method)
+        out[node.output] = r.astype(np.int64)
+        return out
+    if fn in ("lag", "lead"):
+        shift = 1 if fn == "lag" else -1
+        src = node.source or ob[0]
+        g = grouped(ordered)
+        s = (
+            g[src].shift(shift) if g is not None
+            else ordered[src].shift(shift)
+        )
+        out[node.output] = s.sort_index()
+        return out
+    if fn in ("sum", "min", "max", "mean", "avg", "count"):
+        src = node.source or ob[0]
+        agg = "mean" if fn == "avg" else fn
+        if pb:
+            s = df.groupby(pb, sort=False)[src].transform(agg)
+        else:
+            s = pd.Series(
+                getattr(df[src], agg)(), index=df.index
+            )
+        out[node.output] = s
+        return out
+    raise NotImplementedError(fn)
 
 
 class HostFallbackExec(PhysicalOp):

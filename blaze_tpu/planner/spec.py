@@ -98,4 +98,5 @@ class WindowSpec(PlanSpec):
     partition_by: Sequence[str] = ()
     order_by: Sequence[str] = ()
     function: str = "row_number"
+    source: Optional[str] = None  # input column for lag/lead/agg-over
     output: str = "w"

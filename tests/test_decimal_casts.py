@@ -1,0 +1,124 @@
+"""Decimal arithmetic and cast semantics tests (the engine's i64-unscaled
+decimal representation, matching the reference's i64-only decimals,
+plan.proto:598-601)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import Col, ScalarFn
+from blaze_tpu.exprs.ir import bind
+from blaze_tpu.exprs.eval import DeviceEvaluator
+from blaze_tpu.types import DataType
+
+
+def run_expr(expr, rb):
+    cb = ColumnBatch.from_arrow(rb)
+    bound = bind(expr, cb.schema)
+    ev = DeviceEvaluator(
+        cb.schema, [(c.values, c.validity) for c in cb.columns],
+        cb.capacity,
+    )
+    v, m = ev.evaluate(bound)
+    n = cb.num_rows
+    vals = np.asarray(v)[:n]
+    mask = np.asarray(m)[:n] if m is not None else np.ones(n, dtype=bool)
+    return [vals[i].item() if mask[i] else None for i in range(n)]
+
+
+def dec_col(vals, p=10, s=2):
+    return pa.array(
+        [None if v is None else Decimal(v) for v in vals],
+        type=pa.decimal128(p, s),
+    )
+
+
+def test_decimal_add_sub_same_scale():
+    rb = pa.RecordBatch.from_arrays(
+        [dec_col(["1.50", "2.25", None]), dec_col(["0.50", "1.00", "9.99"])],
+        names=["a", "b"],
+    )
+    # unscaled i64 at scale 2
+    assert run_expr(Col("a") + Col("b"), rb) == [200, 325, None]
+    assert run_expr(Col("a") - Col("b"), rb) == [100, 125, None]
+
+
+def test_decimal_mul_rescales():
+    rb = pa.RecordBatch.from_arrays(
+        [dec_col(["1.50"]), dec_col(["2.00"])], names=["a", "b"]
+    )
+    # 1.50 * 2.00 = 3.00 -> unscaled 300 at result scale 2
+    assert run_expr(Col("a") * Col("b"), rb) == [300]
+
+
+def test_decimal_div_is_float():
+    rb = pa.RecordBatch.from_arrays(
+        [dec_col(["3.00"]), dec_col(["2.00"])], names=["a", "b"]
+    )
+    out = run_expr(Col("a") / Col("b"), rb)
+    np.testing.assert_allclose(out, [1.5])
+
+
+def test_decimal_compare_and_unscaled_roundtrip():
+    rb = pa.RecordBatch.from_arrays(
+        [dec_col(["1.00", "2.50"]), dec_col(["1.00", "2.49"])],
+        names=["a", "b"],
+    )
+    assert run_expr(Col("a") == Col("b"), rb) == [True, False]
+    assert run_expr(Col("a") > Col("b"), rb) == [False, True]
+    # spark ext fns: UnscaledValue then MakeDecimal round-trips
+    e = ScalarFn(
+        "spark_make_decimal",
+        (ScalarFn("spark_unscaled_value", (Col("a"),)),),
+    )
+    assert run_expr(e, rb) == [100, 250]
+
+
+def test_decimal_rescale_cast():
+    rb = pa.RecordBatch.from_arrays(
+        [dec_col(["1.25"])], names=["a"]
+    )
+    up = Col("a").cast(DataType.decimal(12, 4))
+    assert run_expr(up, rb) == [12500]
+    down = Col("a").cast(DataType.decimal(12, 1))
+    assert run_expr(down, rb) == [12]  # truncation toward zero
+    to_f = Col("a").cast(DataType.float64())
+    np.testing.assert_allclose(run_expr(to_f, rb), [1.25])
+    to_i = Col("a").cast(DataType.int64())
+    assert run_expr(to_i, rb) == [1]
+
+
+def test_timestamp_date_casts():
+    rb = pa.RecordBatch.from_pydict(
+        {
+            "t": pa.array([86_400_000_000 + 3_600_000_000, 0]).cast(
+                pa.timestamp("us")
+            )
+        }
+    )
+    # timestamp -> date truncates to days
+    out = run_expr(Col("t").cast(DataType.date32()), rb)
+    assert out[1] == 0
+    # round-trip back to timestamp lands on midnight
+    rt = run_expr(
+        Col("t").cast(DataType.date32()).cast(DataType.timestamp_us()),
+        rb,
+    )
+    assert rt == [86_400_000_000, 0]
+
+
+def test_int_overflow_wraps_like_java():
+    rb = pa.RecordBatch.from_pydict(
+        {"a": pa.array([2**31 - 1], type=pa.int32())}
+    )
+    # int32 + int32 stays int32 in Spark (non-ANSI) and wraps:
+    # (2^31-1) + (2^31-1) = 2^32 - 2 -> -2
+    out = run_expr(
+        Col("a").cast(DataType.int32())
+        + Col("a").cast(DataType.int32()),
+        rb,
+    )
+    assert out == [-2]

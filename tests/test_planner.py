@@ -186,3 +186,25 @@ def test_broadcast_exchange_spec():
     from blaze_tpu.parallel import BroadcastExchangeExec
 
     assert isinstance(op, BroadcastExchangeExec)
+
+
+def test_window_functions_host_tier():
+    df = pd.DataFrame(
+        {"k": [1, 1, 1, 2, 2], "v": [30, 10, 20, 5, 5]}
+    )
+    for fn, src, exp in [
+        ("rank", None, [3, 1, 2, 1, 1]),
+        ("dense_rank", None, [3, 1, 2, 1, 1]),
+        ("lag", "v", [20.0, None, 10.0, None, 5.0]),
+        ("sum", "v", [60, 60, 60, 10, 10]),
+        ("avg", "v", [20.0, 20.0, 20.0, 5.0, 5.0]),
+    ]:
+        plan = WindowSpec(
+            children=[MemorySpec(dataframe=df)],
+            partition_by=["k"], order_by=["v"], function=fn,
+            source=src, output="w",
+        )
+        got = run_plan(convert_plan(plan)).to_pandas()["w"].tolist()
+        norm = [None if (isinstance(x, float) and x != x) else x
+                for x in got]
+        assert norm == exp, (fn, norm)
