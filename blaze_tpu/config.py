@@ -37,6 +37,12 @@ class EngineConfig:
     ipc_compression_level: int = 1
     # Default shuffle partition count when a plan does not specify one.
     default_shuffle_partitions: int = 200
+    # Pipeline-breaker materialization cap: aggregates/joins whose input
+    # exceeds this many rows switch to external (grace) hash-bucketed
+    # execution through the segmented-IPC spill format (ops/external.py).
+    max_materialize_rows: int = 1 << 22
+    # Bucket count for external execution.
+    external_buckets: int = 32
     # Enable per-operator timing metrics.
     collect_metrics: bool = True
 

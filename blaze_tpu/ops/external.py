@@ -1,0 +1,131 @@
+"""External (grace) execution: hash-bucket oversized inputs through the
+engine's own shuffle format, then process bucket-by-bucket.
+
+The reference handles oversized state with the DataFusion MemoryConsumer
+spill ladder (shuffle_writer_exec.rs:570-623) and streaming operators; our
+sort-based aggregate and vectorized join instead materialize a partition,
+which caps input size at device-buffer capacity. This module restores
+unbounded inputs the TPU-first way (SURVEY 7 "spill & memory ladder"):
+
+    too-big stream -> murmur3 hash-bucket on the op's keys ->
+    segmented-IPC bucket file (same writer/format as the shuffle tier) ->
+    per-bucket processing (each bucket now fits)
+
+Because bucketing uses the same key hash on both join sides, equal keys
+co-locate and every join type remains correct bucket-wise; for aggregation
+every group lands wholly in one bucket.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Iterator, List, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.io.ipc import (
+    encode_ipc_segment,
+    partition_ranges,
+    read_file_segment,
+)
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.ops.shuffle_writer import (
+    PartitionBuffers,
+    spark_partition_ids,
+)
+from blaze_tpu.ops.util import ensure_compacted, take_batch
+
+
+class BucketedInput:
+    """A stream hash-bucketed into an on-disk .data/.index pair."""
+
+    def __init__(self, data_path: str, index_path: str, schema: Schema,
+                 n_buckets: int):
+        self.data_path = data_path
+        self.index_path = index_path
+        self.schema = schema
+        self.n_buckets = n_buckets
+
+    def bucket(self, i: int) -> Iterator[ColumnBatch]:
+        off, length = partition_ranges(self.index_path)[i]
+        if length == 0:
+            return
+        for rb in read_file_segment(self.data_path, off, length):
+            yield ColumnBatch.from_arrow(rb)
+
+    def cleanup(self) -> None:
+        for p in (self.data_path, self.index_path):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def bucket_stream(
+    batches: Iterator[ColumnBatch],
+    key_exprs: Sequence[ir.Expr],
+    n_buckets: int,
+    ctx: ExecContext,
+    schema: Schema,
+    head: Sequence[ColumnBatch] = (),
+) -> BucketedInput:
+    """Write (head + remaining stream) into n_buckets hash buckets using
+    the shuffle writer's scatter + segmented-IPC machinery."""
+    d = ctx.config.spill_dir()
+    fd, data_path = tempfile.mkstemp(prefix="blz-ext-", suffix=".data",
+                                     dir=d)
+    os.close(fd)
+    index_path = data_path[:-5] + ".index"
+    bufs = PartitionBuffers(n_buckets, d)
+
+    def feed(cb: ColumnBatch) -> None:
+        cb = ensure_compacted(cb)
+        if cb.num_rows == 0:
+            return
+        pids = spark_partition_ids(cb, list(key_exprs), n_buckets)
+        pid_full = jnp.full(cb.capacity, n_buckets, dtype=jnp.int32)
+        pid_full = pid_full.at[: len(pids)].set(jnp.asarray(pids))
+        order = jnp.argsort(pid_full, stable=True)
+        rb_sorted = take_batch(cb, order, cb.num_rows).to_arrow()
+        sorted_pids = np.sort(pids, kind="stable")
+        counts = np.bincount(sorted_pids, minlength=n_buckets)
+        start = 0
+        for p in range(n_buckets):
+            c = int(counts[p])
+            if c:
+                bufs.append(
+                    p,
+                    encode_ipc_segment(
+                        rb_sorted.slice(start, c),
+                        ctx.config.ipc_compression_level,
+                    ),
+                )
+                start += c
+
+    for cb in head:
+        feed(cb)
+    for cb in batches:
+        feed(cb)
+    bufs.finalize(data_path, index_path)
+    return BucketedInput(data_path, index_path, schema, n_buckets)
+
+
+def collect_until(
+    it: Iterator[ColumnBatch], row_limit: int
+) -> tuple[List[ColumnBatch], bool]:
+    """Pull batches until the stream ends or row_limit is crossed.
+    Returns (collected, exceeded)."""
+    out: List[ColumnBatch] = []
+    total = 0
+    for cb in it:
+        out.append(cb)
+        total += cb.num_rows
+        if total > row_limit:
+            return out, True
+    return out, False

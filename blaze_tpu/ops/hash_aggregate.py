@@ -50,6 +50,22 @@ class AggMode(enum.Enum):
     COMPLETE = "complete"
 
 
+class _SchemaStub:
+    """Placeholder child carrying only a schema (internal op wiring)."""
+
+    def __init__(self, schema: Schema):
+        self.children = []
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return 1
+
+
 @dataclasses.dataclass(frozen=True)
 class NamedAgg:
     agg: AggExpr
@@ -161,17 +177,88 @@ class HashAggregateExec(PhysicalOp):
                 out = self._aggregate_batch(cb)
                 if out.num_rows > 0:
                     yield out
-        else:
-            batches = list(child_it)
-            cb = concat_batches(batches, schema=self.children[0].schema)
-            if cb.num_rows == 0 and self.keys:
+            return
+        from blaze_tpu.ops.external import bucket_stream, collect_until
+
+        batches, exceeded = collect_until(
+            child_it, ctx.config.max_materialize_rows
+        )
+        if exceeded:
+            yield from self._execute_external(batches, child_it, ctx)
+            return
+        cb = concat_batches(batches, schema=self.children[0].schema)
+        if cb.num_rows == 0 and self.keys:
+            return
+        out = self._aggregate_batch(cb)
+        if cb.num_rows == 0 and not self.keys:
+            # global aggregate over empty input still emits one row
+            yield _empty_global_row(self)
+            return
+        yield out
+
+    def _execute_external(self, head, rest, ctx: ExecContext
+                          ) -> Iterator[ColumnBatch]:
+        """Grace aggregation for oversized inputs (ops/external.py): every
+        group lands wholly in one hash bucket, so buckets aggregate
+        independently. The keyless case folds per-batch partial states
+        instead (one state row per batch, always bounded)."""
+        from blaze_tpu.ops.external import bucket_stream
+
+        in_schema = self.children[0].schema
+        if not self.keys:
+            if self.mode is AggMode.FINAL:
+                # keyless FINAL consumes tiny partial-state rows (one per
+                # upstream batch); crossing the row cap here implies an
+                # absurd upstream batch count - concat is still bounded
+                batches = list(head) + list(rest)
+                yield self._aggregate_batch(
+                    concat_batches(batches, schema=in_schema)
+                )
                 return
-            out = self._aggregate_batch(cb)
-            if cb.num_rows == 0 and not self.keys:
-                # global aggregate over empty input still emits one row
+            # keyless COMPLETE: fold per-batch partial states, then one
+            # final merge (one state row per input batch)
+            partial = HashAggregateExec(
+                self.children[0],
+                keys=[],
+                aggs=[(a, n) for a, n in self.aggs],
+                mode=AggMode.PARTIAL,
+            )
+            partials = []
+            for cb in list(head) + list(rest):
+                p = partial._aggregate_batch(cb)
+                if p.num_rows:
+                    partials.append(p)
+            if not partials:
                 yield _empty_global_row(self)
                 return
-            yield out
+            final = HashAggregateExec(
+                _SchemaStub(partial.schema),
+                keys=[],
+                aggs=[(a, n) for a, n in self.aggs],
+                mode=AggMode.FINAL,
+            )
+            yield final._aggregate_batch(
+                concat_batches(partials, schema=partial.schema)
+            )
+            return
+        key_exprs = [e for e, _ in self.keys]
+        bucketed = bucket_stream(
+            rest, key_exprs, ctx.config.external_buckets, ctx,
+            in_schema, head=head,
+        )
+        ctx.metrics.add("external_agg_buckets", bucketed.n_buckets)
+        try:
+            for b in range(bucketed.n_buckets):
+                chunk = list(bucketed.bucket(b))
+                if not chunk:
+                    continue
+                out = self._aggregate_batch(
+                    concat_batches(chunk, schema=in_schema)
+                )
+                if out.num_rows:
+                    yield out
+        finally:
+            bucketed.cleanup()
 
     # ------------------------------------------------------------------
     def _aggregate_batch(self, cb: ColumnBatch) -> ColumnBatch:
@@ -444,10 +531,18 @@ class HashAggregateExec(PhysicalOp):
             c = seg(jnp.where(live_f, cv2, jnp.zeros_like(cv2)))
             any_v = c > 0
             safe = jnp.maximum(c, 1)
-            if jnp.issubdtype(sv.dtype, jnp.integer):
-                avg = s * 10000 // safe
+            # the state's logical type (BoundCol in FINAL mode) decides
+            # decimal-vs-float finalization; int64 sums of plain integers
+            # still produce a double AVG like Spark
+            state_is_decimal = (
+                isinstance(a.child, ir.BoundCol)
+                and a.child.dtype.id is TypeId.DECIMAL
+            )
+            if state_is_decimal:
+                avg = s * 10000 // safe  # rescale to scale+4
                 return [(avg, any_v)]
-            return [(s / safe.astype(jnp.float64), any_v)]
+            return [(s.astype(jnp.float64)
+                     / safe.astype(jnp.float64), any_v)]
         if fn in (AggFn.FIRST, AggFn.LAST):
             v, m = states[0]
             contrib = live_f if m is None else (live_f & m)

@@ -391,15 +391,49 @@ class SortMergeJoinExec(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.ops.external import bucket_stream, collect_until
+
+        left, right = self.children
+        limit = ctx.config.max_materialize_rows
+        r_it = right.execute(partition, ctx)
+        r_head, r_exc = collect_until(r_it, limit)
+        l_it = left.execute(partition, ctx)
+        l_head, l_exc = collect_until(l_it, limit)
+        if not (r_exc or l_exc):
+            yield from self._join_bucket(l_head, r_head)
+            return
+        # grace join: co-bucket both sides on the join keys; equal keys
+        # land in the same bucket, so every join type is correct per bucket
+        n_b = ctx.config.external_buckets
+        lkeys = [
+            ir.BoundCol(i, left.schema.fields[i].dtype)
+            for i in self.left_keys
+        ]
+        rkeys = [
+            ir.BoundCol(i, right.schema.fields[i].dtype)
+            for i in self.right_keys
+        ]
+        bl = bucket_stream(l_it, lkeys, n_b, ctx, left.schema,
+                           head=l_head)
+        br = bucket_stream(r_it, rkeys, n_b, ctx, right.schema,
+                           head=r_head)
+        ctx.metrics.add("external_join_buckets", n_b)
+        try:
+            for b in range(n_b):
+                yield from self._join_bucket(
+                    list(bl.bucket(b)), list(br.bucket(b))
+                )
+        finally:
+            bl.cleanup()
+            br.cleanup()
+
+    def _join_bucket(self, left_batches, right_batches
+                     ) -> Iterator[ColumnBatch]:
         left, right = self.children
         jt = self.join_type
-        build = concat_batches(
-            list(right.execute(partition, ctx)), schema=right.schema
-        )
+        build = concat_batches(right_batches, schema=right.schema)
         core = _JoinCore(build, self.right_keys)
-        probe = concat_batches(
-            list(left.execute(partition, ctx)), schema=left.schema
-        )
+        probe = concat_batches(left_batches, schema=left.schema)
         (probe, pair_b, pair_p, valid, pair_cap,
          matched_p) = core.probe(probe, self.left_keys)
         live_p = row_mask(probe.num_rows, probe.capacity)

@@ -1,0 +1,161 @@
+"""External (grace) execution tests: oversized aggregates and joins run
+bucket-wise through the spill format and stay correct."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.config import EngineConfig, get_config, set_config
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    ExecContext,
+    HashAggregateExec,
+    JoinType,
+    MemoryScanExec,
+    SortMergeJoinExec,
+)
+from blaze_tpu.runtime.executor import run_plan
+
+
+@pytest.fixture
+def tiny_limit():
+    old = get_config()
+    cfg = EngineConfig(
+        max_materialize_rows=500, external_buckets=4,
+        shape_buckets=old.shape_buckets,
+    )
+    set_config(cfg)
+    yield cfg
+    set_config(old)
+
+
+def multi_batch_scan(n_batches=10, rows=200, seed=3):
+    rng = np.random.default_rng(seed)
+    parts = []
+    schema = None
+    for _ in range(n_batches):
+        cb = ColumnBatch.from_pydict(
+            {
+                "k": rng.integers(0, 37, rows).astype(int).tolist(),
+                "v": rng.integers(0, 100, rows).astype(int).tolist(),
+            }
+        )
+        schema = cb.schema
+        parts.append(cb)
+    return MemoryScanExec([parts], schema)
+
+
+def test_external_grouped_aggregate(tiny_limit):
+    scan = multi_batch_scan()
+    ctx = ExecContext(config=tiny_limit)
+    op = HashAggregateExec(
+        scan,
+        keys=[(Col("k"), "k")],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("v")), "s"),
+            (AggExpr(AggFn.COUNT_STAR, None), "n"),
+            (AggExpr(AggFn.MIN, Col("v")), "mn"),
+        ],
+        mode=AggMode.COMPLETE,
+    )
+    rows = {}
+    for b in op.execute(0, ctx):
+        d = b.to_pydict()
+        for k, s, n, mn in zip(d["k"], d["s"], d["n"], d["mn"]):
+            assert k not in rows, "group split across buckets"
+            rows[k] = (s, n, mn)
+    assert ctx.metrics.counters.get("external_agg_buckets", 0) == 4
+    # differential reference
+    import collections
+
+    ref = collections.defaultdict(lambda: [0, 0, 10**9])
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        ks = rng.integers(0, 37, 200)
+        vs = rng.integers(0, 100, 200)
+        for k, v in zip(ks, vs):
+            r = ref[int(k)]
+            r[0] += int(v)
+            r[1] += 1
+            r[2] = min(r[2], int(v))
+    assert rows == {k: tuple(v) for k, v in ref.items()}
+
+
+def test_external_keyless_aggregate(tiny_limit):
+    scan = multi_batch_scan()
+    ctx = ExecContext(config=tiny_limit)
+    op = HashAggregateExec(
+        scan,
+        keys=[],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("v")), "s"),
+            (AggExpr(AggFn.AVG, Col("v")), "a"),
+            (AggExpr(AggFn.COUNT_STAR, None), "n"),
+        ],
+        mode=AggMode.COMPLETE,
+    )
+    out = [b.to_pydict() for b in op.execute(0, ctx)]
+    assert len(out) == 1
+    rng = np.random.default_rng(3)
+    vs = np.concatenate(
+        [rng.integers(0, 100, 200)[None] or rng.integers(0, 100, 200)
+         for _ in range(10)]
+    ) if False else None
+    # recompute reference
+    total, count = 0, 0
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        rng.integers(0, 37, 200)
+        v = rng.integers(0, 100, 200)
+        total += int(v.sum())
+        count += len(v)
+    assert out[0]["s"] == [total]
+    assert out[0]["n"] == [count]
+    np.testing.assert_allclose(out[0]["a"][0], total / count)
+
+
+def test_external_smj(tiny_limit):
+    l = multi_batch_scan(6, 150, seed=5)
+    r = multi_batch_scan(6, 150, seed=8)
+    ctx = ExecContext(config=tiny_limit)
+    op = SortMergeJoinExec(l, r, ["k"], ["k"], JoinType.INNER)
+    got = 0
+    for b in op.execute(0, ctx):
+        got += b.to_arrow().num_rows
+    assert ctx.metrics.counters.get("external_join_buckets", 0) == 4
+    # reference count via pandas
+    import pandas as pd
+
+    def frame(seed):
+        rng = np.random.default_rng(seed)
+        ks, vs = [], []
+        for _ in range(6):
+            ks += rng.integers(0, 37, 150).tolist()
+            vs += rng.integers(0, 100, 150).tolist()
+        return pd.DataFrame({"k": ks, "v": vs})
+
+    ref = len(frame(5).merge(frame(8), on="k"))
+    assert got == ref
+
+
+def test_external_smj_outer(tiny_limit):
+    l = multi_batch_scan(4, 150, seed=5)
+    r = multi_batch_scan(4, 150, seed=8)
+    ctx = ExecContext(config=tiny_limit)
+    op = SortMergeJoinExec(l, r, ["v"], ["v"], JoinType.LEFT)
+    got = 0
+    for b in op.execute(0, ctx):
+        got += b.to_arrow().num_rows
+    import pandas as pd
+
+    def frame(seed, n=4):
+        rng = np.random.default_rng(seed)
+        ks, vs = [], []
+        for _ in range(n):
+            ks += rng.integers(0, 37, 150).tolist()
+            vs += rng.integers(0, 100, 150).tolist()
+        return pd.DataFrame({"k": ks, "v": vs})
+
+    ref = len(frame(5).merge(frame(8), on="v", how="left"))
+    assert got == ref
