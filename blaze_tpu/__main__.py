@@ -1,0 +1,87 @@
+"""CLI entry points: `python -m blaze_tpu <command>`.
+
+  run-task FILE   execute a serialized TaskDefinition protobuf and print
+                  the resulting Arrow batches (the embedder-facing boundary,
+                  reference callNative)
+  query SQL-ish   tiny demo runner: scan a parquet file with filter/limit
+  info            engine / device / native-runtime status
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_info(args) -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from blaze_tpu.runtime import native
+
+    lib = native.get_lib()
+    info = {
+        "version": __import__("blaze_tpu").__version__,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "native_host_lib": bool(lib),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_run_task(args) -> int:
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.runtime.executor import execute_task
+
+    with open(args.file, "rb") as f:
+        blob = f.read()
+    ctx = ExecContext()
+    total = 0
+    for rb in execute_task(blob, ctx):
+        total += rb.num_rows
+        if not args.quiet:
+            print(rb.to_pandas().to_string(max_rows=20))
+    print(f"-- {total} rows", file=sys.stderr)
+    return 0
+
+
+def cmd_scan(args) -> int:
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops import LimitExec
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.runtime.executor import run_plan
+
+    plan = ParquetScanExec(
+        [[FileRange(args.file)]],
+        projection=args.columns.split(",") if args.columns else None,
+    )
+    op = LimitExec(plan, args.limit) if args.limit else plan
+    tbl = run_plan(op)
+    print(tbl.to_pandas().to_string(max_rows=args.limit or 50))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="blaze_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("info")
+    rt = sub.add_parser("run-task")
+    rt.add_argument("file")
+    rt.add_argument("--quiet", action="store_true")
+    sc = sub.add_parser("scan")
+    sc.add_argument("file")
+    sc.add_argument("--columns", default=None)
+    sc.add_argument("--limit", type=int, default=20)
+    args = p.parse_args(argv)
+    return {
+        "info": cmd_info,
+        "run-task": cmd_run_task,
+        "scan": cmd_scan,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

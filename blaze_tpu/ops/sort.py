@@ -55,7 +55,16 @@ class SortExec(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
-        batches = list(self.children[0].execute(partition, ctx))
+        from blaze_tpu.ops.external import collect_until
+
+        it = self.children[0].execute(partition, ctx)
+        limit = ctx.config.max_materialize_rows
+        batches, exceeded = collect_until(it, limit)
+        if exceeded:
+            # top-k stays bounded: sort+trim incrementally on device
+            if self.fetch is not None and self.fetch <= limit // 2:
+                return self._execute_topk(batches, it, ctx)
+            return self._execute_host_sort(batches, it, ctx)
         cb = concat_batches(batches, schema=self.schema)
         if cb.num_rows == 0:
             return iter(())
@@ -65,6 +74,54 @@ class SortExec(PhysicalOp):
                 cb.schema, cb.columns, self.fetch, cb.selection
             )
         return iter(slice_to_batches(cb, ctx.config.batch_size))
+
+    def _execute_topk(self, head, rest, ctx) -> Iterator[ColumnBatch]:
+        """Oversized sort WITH a small fetch: keep only the running top-k
+        (sort + trim per chunk), memory stays O(fetch + chunk)."""
+        from blaze_tpu.ops.external import collect_until
+
+        limit = ctx.config.max_materialize_rows
+        acc = None
+        chunk = head
+        it = rest
+        while chunk:
+            pool = ([acc] if acc is not None else []) + list(chunk)
+            cb = concat_batches(pool, schema=self.schema)
+            cb = sort_batch(cb, self.keys)
+            n = min(self.fetch, cb.num_rows)
+            acc = ColumnBatch(cb.schema, cb.columns, n, None)
+            chunk, _ = collect_until(it, limit)
+        if acc is None:
+            return
+        yield from slice_to_batches(acc, ctx.config.batch_size)
+
+    def _execute_host_sort(self, head, rest, ctx) -> Iterator[ColumnBatch]:
+        """Oversized full sort: spill to host RAM and sort with pyarrow
+        (host RAM outsizes the device-materialization cap; sorting beyond
+        host RAM would need run-merge spilling - future work, the
+        reference leans on DataFusion's external sort the same way)."""
+        import pyarrow as pa
+
+        ctx.metrics.add("host_sorts", 1)
+        tables = [b.to_arrow() for b in head] + [
+            b.to_arrow() for b in rest
+        ]
+        tbl = pa.Table.from_batches(tables)
+        keys = []
+        for k in self.keys:
+            assert isinstance(k.expr, ir.BoundCol), (
+                "host sort fallback needs plain column keys"
+            )
+            name = self.schema.fields[k.expr.index].name
+            keys.append((name, "ascending" if k.ascending else
+                         "descending"))
+        tbl = tbl.sort_by(keys)
+        if self.fetch is not None:
+            tbl = tbl.slice(0, self.fetch)
+        bs = ctx.config.batch_size
+        for rb in tbl.to_batches(max_chunksize=bs):
+            if rb.num_rows:
+                yield ColumnBatch.from_arrow(rb)
 
 
 def sort_batch(cb: ColumnBatch, keys: List[SortKey]) -> ColumnBatch:

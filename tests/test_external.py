@@ -97,11 +97,6 @@ def test_external_keyless_aggregate(tiny_limit):
     )
     out = [b.to_pydict() for b in op.execute(0, ctx)]
     assert len(out) == 1
-    rng = np.random.default_rng(3)
-    vs = np.concatenate(
-        [rng.integers(0, 100, 200)[None] or rng.integers(0, 100, 200)
-         for _ in range(10)]
-    ) if False else None
     # recompute reference
     total, count = 0, 0
     rng = np.random.default_rng(3)
@@ -159,3 +154,30 @@ def test_external_smj_outer(tiny_limit):
 
     ref = len(frame(5).merge(frame(8), on="v", how="left"))
     assert got == ref
+
+
+def test_external_sort_topk_and_host(tiny_limit):
+    from blaze_tpu.ops import SortExec, SortKey
+
+    scan = multi_batch_scan(8, 150, seed=9)
+    ctx = ExecContext(config=tiny_limit)
+    # top-k path
+    op = SortExec(scan, [SortKey(Col("v"), ascending=False)], fetch=10)
+    got = []
+    for b in op.execute(0, ctx):
+        got += b.to_pydict()["v"]
+    rng = np.random.default_rng(9)
+    allv = []
+    for _ in range(8):
+        rng.integers(0, 37, 150)
+        allv += rng.integers(0, 100, 150).tolist()
+    assert got == sorted(allv, reverse=True)[:10]
+    # full host-sort path
+    scan2 = multi_batch_scan(8, 150, seed=9)
+    op2 = SortExec(scan2, [SortKey(Col("v"))])
+    ctx2 = ExecContext(config=tiny_limit)
+    got2 = []
+    for b in op2.execute(0, ctx2):
+        got2 += b.to_pydict()["v"]
+    assert got2 == sorted(allv)
+    assert ctx2.metrics.counters.get("host_sorts") == 1

@@ -63,3 +63,32 @@ def test_permanent_failure_raises():
     op = Dead(base.partitions, base.schema)
     with pytest.raises(TaskExecutionError):
         run_plan_parallel(op, parallelism=2, max_attempts=2)
+
+
+def test_prefetch_iterator():
+    from blaze_tpu.runtime.prefetch import PrefetchExec, prefetch
+
+    seen = []
+
+    def gen():
+        for i in range(10):
+            seen.append(i)
+            yield i
+
+    out = list(prefetch(gen(), depth=3))
+    assert out == list(range(10))
+
+    # errors propagate
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    it = prefetch(bad(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError):
+        list(it)
+
+    # operator wrapper preserves results
+    op = PrefetchExec(multi_scan(3, 10))
+    got = run_plan_parallel(op, parallelism=2)
+    assert got.num_rows == 30
