@@ -312,14 +312,17 @@ class HashJoinExec(PhysicalOp):
                 ) -> Iterator[ColumnBatch]:
         left, right = self.children
         jt = self.join_type
-        build = concat_batches(
-            [
+        # a broadcast child already replays the FULL relation from any one
+        # partition; collecting every partition would duplicate build rows
+        if getattr(left, "is_broadcast", False):
+            build_batches = list(left.execute(0, ctx))
+        else:
+            build_batches = [
                 b
                 for p in range(left.partition_count)
                 for b in left.execute(p, ctx)
-            ],
-            schema=left.schema,
-        )
+            ]
+        build = concat_batches(build_batches, schema=left.schema)
         core = _JoinCore(build, self.left_keys)
         emit_pairs = jt in (
             JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL

@@ -195,6 +195,8 @@ class BroadcastExchangeExec(PhysicalOp):
     ArrowBroadcastExchangeExec: native IPC collect -> spark broadcast ->
     per-task CHANNEL reads)."""
 
+    is_broadcast = True  # every partition replays the full relation
+
     def __init__(self, child: PhysicalOp,
                  num_partitions: Optional[int] = None):
         self.children = [child]

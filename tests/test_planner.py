@@ -208,3 +208,25 @@ def test_window_functions_host_tier():
         norm = [None if (isinstance(x, float) and x != x) else x
                 for x in got]
         assert norm == exp, (fn, norm)
+
+
+def test_bhj_over_broadcast_exchange_no_duplication():
+    """BHJ composed under a broadcast exchange must not multiply build
+    rows by the partition count (SURVEY 3.4 composition)."""
+    build_df = pd.DataFrame({"a": [1, 2], "x": [10, 20]})
+    probe_df = pd.DataFrame({"b": [1, 1, 2, 3], "y": [1, 2, 3, 4]})
+    plan = JoinSpec(
+        children=[
+            ExchangeSpec(
+                children=[MemorySpec(dataframe=build_df, partitions=3)],
+                mode="broadcast",
+            ),
+            MemorySpec(dataframe=probe_df, partitions=2),
+        ],
+        kind="bhj", left_keys=["a"], right_keys=["b"],
+        join_type="inner",
+    )
+    op = convert_plan(plan)
+    got = run_plan(op).to_pandas()
+    assert len(got) == 3  # (1,1),(1,1 dup probe rows),(2,2): exactly 3
+    assert sorted(got["y"].tolist()) == [1, 2, 3]
