@@ -212,21 +212,35 @@ class ColumnBatch:
         return m
 
     def to_arrow(self):
-        """Materialize the live rows back to a pyarrow RecordBatch."""
+        """Materialize the live rows back to a pyarrow RecordBatch.
+
+        All device buffers transfer in ONE jax.device_get (a single batched
+        D2H) instead of per-column fetches."""
         import pyarrow as pa
+
+        device_bufs = [self.selection] + self.device_buffers()
+        host_bufs = jax.device_get(device_bufs)
+        host_sel, host_iter = host_bufs[0], iter(host_bufs[1:])
+        host_cols = []
+        for c in self.columns:
+            v = next(host_iter)
+            m = next(host_iter) if c.validity is not None else None
+            host_cols.append((v, m))
 
         n = self.num_rows
         sel = None
         if self.selection is not None:
-            sel = np.asarray(self.selection)[:n]
+            sel = np.asarray(host_sel)[:n]
             n = int(sel.sum())
         arrays = []
         fields = []
-        for field, col in zip(self.schema, self.columns):
-            vals = np.asarray(col.values)[: self.num_rows]
+        for field, col, (hv, hm) in zip(
+            self.schema, self.columns, host_cols
+        ):
+            vals = np.asarray(hv)[: self.num_rows]
             mask = None
-            if col.validity is not None:
-                mask = ~np.asarray(col.validity)[: self.num_rows]
+            if hm is not None:
+                mask = ~np.asarray(hm)[: self.num_rows]
             if sel is not None:
                 vals = vals[sel]
                 if mask is not None:

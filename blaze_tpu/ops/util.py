@@ -16,15 +16,29 @@ from blaze_tpu.types import Schema, TypeId
 from blaze_tpu.batch import Column, ColumnBatch, row_mask
 
 
+@jax.jit
+def _take_many(arrays, indices):
+    # one dispatch for the whole batch instead of one per column buffer
+    return [jnp.take(a, indices, axis=0) for a in arrays]
+
+
 def take_batch(cb: ColumnBatch, indices: jax.Array, num_rows: int
                ) -> ColumnBatch:
     """Gather rows by index (device). `indices` length defines capacity."""
-    cols = []
+    bufs = []
+    slots = []
     for c in cb.columns:
-        v = jnp.take(c.values, indices, axis=0)
-        m = jnp.take(c.validity, indices, axis=0) if c.validity is not None \
-            else None
-        cols.append(Column(c.dtype, v, m, c.dictionary))
+        slots.append((len(bufs), c.validity is not None))
+        bufs.append(c.values)
+        if c.validity is not None:
+            bufs.append(c.validity)
+    taken = _take_many(bufs, indices)
+    cols = []
+    for c, (i, has_m) in zip(cb.columns, slots):
+        cols.append(
+            Column(c.dtype, taken[i],
+                   taken[i + 1] if has_m else None, c.dictionary)
+        )
     return ColumnBatch(cb.schema, cols, num_rows)
 
 
@@ -118,32 +132,62 @@ def concat_batches(batches: List[ColumnBatch],
     total = sum(b.num_rows for b in batches)
     cap = get_config().bucket_for(total)
     ncols = len(schema)
+    lengths = tuple(b.num_rows for b in batches)
+    any_mask = [
+        any(b.columns[ci].validity is not None for b in batches)
+        for ci in range(ncols)
+    ]
+    values_in = [[b.columns[ci].values for b in batches]
+                 for ci in range(ncols)]
+    masks_in = [
+        [
+            b.columns[ci].validity
+            if b.columns[ci].validity is not None
+            else None
+            for b in batches
+        ]
+        if any_mask[ci]
+        else None
+        for ci in range(ncols)
+    ]
+    vs, ms = _concat_many(
+        values_in, masks_in, lengths, cap, tuple(any_mask)
+    )
     cols: List[Column] = []
     for ci in range(ncols):
         ref = batches[0].columns[ci]
-        parts_v = []
-        parts_m = []
-        any_mask = any(b.columns[ci].validity is not None for b in batches)
-        for b in batches:
-            c = b.columns[ci]
-            parts_v.append(c.values[: b.num_rows])
-            if any_mask:
-                parts_m.append(
-                    c.validity[: b.num_rows]
-                    if c.validity is not None
-                    else jnp.ones(b.num_rows, dtype=jnp.bool_)
-                )
-        pad = cap - total
-        v = jnp.concatenate(
-            parts_v + ([jnp.zeros(pad, dtype=ref.values.dtype)] if pad else [])
+        cols.append(
+            Column(ref.dtype, vs[ci], ms[ci] if any_mask[ci] else None,
+                   ref.dictionary)
         )
-        m = None
-        if any_mask:
-            m = jnp.concatenate(
-                parts_m + ([jnp.zeros(pad, dtype=jnp.bool_)] if pad else [])
-            )
-        cols.append(Column(ref.dtype, v, m, ref.dictionary))
     return ColumnBatch(schema, cols, total)
+
+
+@partial(jax.jit, static_argnames=("lengths", "cap", "any_mask"))
+def _concat_many(values_in, masks_in, lengths, cap: int, any_mask):
+    """Concatenate all columns of all batches in one dispatch."""
+    total = sum(lengths)
+    pad = cap - total
+    vs = []
+    ms = []
+    for ci, parts in enumerate(values_in):
+        pieces = [p[:n] for p, n in zip(parts, lengths)]
+        if pad:
+            pieces.append(jnp.zeros(pad, dtype=parts[0].dtype))
+        vs.append(jnp.concatenate(pieces))
+        if any_mask[ci]:
+            mparts = []
+            for mp, n in zip(masks_in[ci], lengths):
+                mparts.append(
+                    mp[:n] if mp is not None
+                    else jnp.ones(n, dtype=jnp.bool_)
+                )
+            if pad:
+                mparts.append(jnp.zeros(pad, dtype=jnp.bool_))
+            ms.append(jnp.concatenate(mparts))
+        else:
+            ms.append(None)
+    return vs, ms
 
 
 def slice_to_batches(cb: ColumnBatch, batch_size: int) -> List[ColumnBatch]:
