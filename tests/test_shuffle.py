@@ -197,3 +197,36 @@ def test_round_robin_mode(tmp_path):
     ]
     assert sum(sizes) == 10
     assert max(sizes) - min(sizes) <= 1  # balanced
+
+
+def test_ipc_reader_uncompressed_recordbatch():
+    """CHANNEL_UNCOMPRESSED: pre-decoded RecordBatches pass straight
+    through (the ConvertToNative input path, ipc_reader_exec.rs mode
+    CHANNEL_UNCOMPRESSED)."""
+    import pyarrow as pa
+
+    rb = pa.RecordBatch.from_pydict({"a": [1, 2], "s": ["x", None]})
+    cb = ColumnBatch.from_pydict({"a": [0]})
+    ctx = ExecContext()
+    ctx.resources["u"] = [[rb]]
+    from blaze_tpu.types import from_arrow_schema
+
+    rd = IpcReaderExec(
+        "u", from_arrow_schema(rb.schema), 1,
+        IpcReadMode.CHANNEL_UNCOMPRESSED,
+    )
+    out = [b.to_arrow().to_pydict() for b in rd.execute(0, ctx)]
+    assert out == [rb.to_pydict()]
+    assert ctx.metrics.counters["ipc_rows_read"] == 2
+
+
+def test_metrics_counters_flow(tmp_path):
+    ctx = ExecContext()
+    op = ShuffleWriterExec(
+        scan_of({"k": list(range(40))}), [Col("k")], 4,
+        str(tmp_path / "m.data"), str(tmp_path / "m.index"),
+    )
+    drain(op, 0, ctx)
+    flat = ctx.metrics.flatten()["root"]
+    assert flat["shuffle_rows_written"] == 40
+    assert flat["shuffle_bytes_written"] > 0
