@@ -254,3 +254,16 @@ def test_aggregate_after_filter_uses_selection():
     )
     out = collect(op)
     assert dict(zip(out["k"], out["s"])) == {1: 1, 2: 2}
+
+
+def test_sort_nan_ordering():
+    """Spark: NaN sorts greater than any double (asc -> last before
+    padding; desc -> first)."""
+    nan = float("nan")
+    data = {"x": [1.0, nan, -5.0, 2.0]}
+    asc = SortExec(scan_of(data), [SortKey(Col("x"))])
+    vals = collect(asc)["x"]
+    assert vals[:3] == [-5.0, 1.0, 2.0] and vals[3] != vals[3]
+    desc = SortExec(scan_of(data), [SortKey(Col("x"), ascending=False)])
+    vals = collect(desc)["x"]
+    assert vals[0] != vals[0] and vals[1:] == [2.0, 1.0, -5.0]
