@@ -295,6 +295,108 @@ class DistributedGroupBy:
         )
 
 
+class DistributedBroadcastJoin:
+    """Mesh-wide broadcast equi-join against a unique-key build side.
+
+    The intra-slice analog of the broadcast hash join (reference BHJ /
+    CollectLeft): the build relation is sharded over the mesh, replicated
+    to every device with ONE lax.all_gather over ICI, sorted once, and
+    each shard probes its rows with searchsorted - all inside a single
+    pjit program, no host round trips. Build keys must be unique (the
+    dimension-table case: every probe row matches at most one build row),
+    which keeps output shapes static; general many-match joins go through
+    the host-tier join (ops/joins.py).
+    """
+
+    def __init__(self, mesh: Mesh, probe_schema: Schema,
+                 build_schema: Schema, probe_key: ir.Expr,
+                 build_key: ir.Expr, axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.probe_schema = probe_schema
+        self.build_schema = build_schema
+        self.probe_key = bind_opt(probe_key, probe_schema)
+        self.build_key = bind_opt(build_key, build_schema)
+        self._fn = None
+
+    def __call__(self, probe_cols, probe_rows, build_cols, build_rows):
+        """probe_cols/build_cols: [n_dev, cap] stacked arrays per column;
+        *_rows: [n_dev] live counts. Returns (probe_cols, matched mask,
+        gathered build cols) all stacked [n_dev, cap_probe]."""
+        if self._fn is None:
+            self._fn = self._compile()
+        return self._fn(probe_cols, probe_rows, build_cols, build_rows)
+
+    def _compile(self):
+        mesh, axis = self.mesh, self.axis
+        n_dev = mesh.shape[axis]
+        p_schema, b_schema = self.probe_schema, self.build_schema
+        p_key, b_key = self.probe_key, self.build_key
+
+        def per_shard(p_rows_s, b_rows_s, *cols_s):
+            np_cols = len(p_schema)
+            p_cols = [c[0] for c in cols_s[:np_cols]]
+            b_cols = [c[0] for c in cols_s[np_cols:]]
+            p_cap = p_cols[0].shape[0]
+            b_cap = b_cols[0].shape[0]
+            # replicate the build side over ICI
+            g_cols = [
+                lax.all_gather(c, axis).reshape(n_dev * b_cap)
+                for c in b_cols
+            ]
+            b_live_local = jnp.arange(b_cap, dtype=jnp.int32) < b_rows_s[0]
+            g_live = lax.all_gather(b_live_local, axis).reshape(
+                n_dev * b_cap
+            )
+            ev_b = DeviceEvaluator(
+                b_schema, [(c, None) for c in g_cols], n_dev * b_cap
+            )
+            bk, _ = ev_b.evaluate(b_key)
+            # dead rows take the dtype-max sentinel so the array stays
+            # GLOBALLY sorted (searchsorted requires it; sorting dead rows
+            # last by a separate rank key would break that invariant)
+            if jnp.issubdtype(bk.dtype, jnp.floating):
+                sentinel = jnp.asarray(jnp.inf, bk.dtype)
+            else:
+                sentinel = jnp.asarray(jnp.iinfo(bk.dtype).max, bk.dtype)
+            bk_keyed = jnp.where(g_live, bk, sentinel)
+            order = jnp.argsort(bk_keyed, stable=True)
+            bk_sorted = jnp.take(bk_keyed, order)
+            n_build = jnp.sum(g_live.astype(jnp.int32))
+            ev_p = DeviceEvaluator(
+                p_schema, [(c, None) for c in p_cols], p_cap
+            )
+            pk, _ = ev_p.evaluate(p_key)
+            pos = jnp.searchsorted(bk_sorted, pk)
+            pos = jnp.clip(pos, 0, n_dev * b_cap - 1)
+            hit = (jnp.take(bk_sorted, pos) == pk) & (pos < n_build)
+            p_live = jnp.arange(p_cap, dtype=jnp.int32) < p_rows_s[0]
+            hit = hit & p_live
+            build_idx = jnp.take(order, pos)
+            out_build = [
+                jnp.take(g, build_idx)[None] for g in g_cols
+            ]
+            return (hit[None],) + tuple(out_build)
+
+        n_out = 1 + len(b_schema)
+        fn = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(axis), P(axis))
+            + tuple(P(axis) for _ in range(len(p_schema)))
+            + tuple(P(axis) for _ in range(len(b_schema))),
+            out_specs=tuple([P(axis)] * n_out),
+        )
+
+        @jax.jit
+        def run(probe_cols, probe_rows, build_cols, build_rows):
+            outs = fn(
+                probe_rows, build_rows, *probe_cols, *build_cols
+            )
+            return outs[0], list(outs[1:])
+
+        return run
+
+
 def _key_dtype(e: ir.Expr, schema: Schema) -> DataType:
     dt = infer_dtype(e, schema)
     if dt.is_dictionary_encoded:

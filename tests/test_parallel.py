@@ -171,3 +171,50 @@ def test_distributed_group_by():
     for k in exp_full:
         assert got[k][:3] == exp_full[k][:3], k
         np.testing.assert_allclose(got[k][3], exp_full[k][3])
+
+
+def test_distributed_broadcast_join():
+    from blaze_tpu.parallel.sharded import DistributedBroadcastJoin
+    from blaze_tpu.types import DataType, Field, Schema
+
+    mesh = get_mesh()
+    n_dev, p_cap, b_cap = 8, 32, 8
+    rng = np.random.default_rng(21)
+    # build: 8*8 slots, unique keys 0..n_build-1 scattered over shards
+    build_rows = rng.integers(2, b_cap + 1, n_dev).astype(np.int32)
+    all_keys = rng.permutation(500)[: int(build_rows.sum())]
+    bk = np.zeros((n_dev, b_cap), dtype=np.int64)
+    bv = np.zeros((n_dev, b_cap), dtype=np.int64)
+    it = iter(all_keys)
+    for d in range(n_dev):
+        for i in range(int(build_rows[d])):
+            k = int(next(it))
+            bk[d, i] = k
+            bv[d, i] = k * 100
+    probe_rows = rng.integers(5, p_cap + 1, n_dev).astype(np.int32)
+    pk = rng.integers(0, 500, (n_dev, p_cap)).astype(np.int64)
+    pv = rng.integers(0, 10, (n_dev, p_cap)).astype(np.int64)
+
+    p_schema = Schema([Field("pk", DataType.int64()),
+                       Field("pv", DataType.int64())])
+    b_schema = Schema([Field("bk", DataType.int64()),
+                       Field("bv", DataType.int64())])
+    from blaze_tpu.exprs import Col
+
+    j = DistributedBroadcastJoin(
+        mesh, p_schema, b_schema, Col("pk"), Col("bk")
+    )
+    hit, build_out = j(
+        [jnp.asarray(pk), jnp.asarray(pv)], jnp.asarray(probe_rows),
+        [jnp.asarray(bk), jnp.asarray(bv)], jnp.asarray(build_rows),
+    )
+    hit = np.asarray(hit)
+    got_bv = np.asarray(build_out[1])
+    key_set = set(int(k) for k in all_keys)
+    for d in range(n_dev):
+        for i in range(int(probe_rows[d])):
+            expected = int(pk[d, i]) in key_set
+            assert bool(hit[d, i]) == expected, (d, i)
+            if expected:
+                assert int(got_bv[d, i]) == int(pk[d, i]) * 100
+        assert not hit[d, int(probe_rows[d]):].any()
