@@ -82,7 +82,10 @@ class ConvertStrategy:
     enable_bhj: bool = True
     enable_aggr: bool = True
     enable_exchange: bool = True
-    enable_window: bool = False  # host-only in the reference as well
+    # the reference keeps Window host-side; this engine runs the common
+    # window functions natively on device (ops/window.py) and falls back
+    # to the host engine for the rest
+    enable_window: bool = True
 
     def gate(self, node: S.PlanSpec) -> bool:
         table = {
@@ -150,7 +153,13 @@ def _check_convertible(node: S.PlanSpec) -> None:
     ):
         raise NotImplementedError(node.mode)
     if isinstance(node, S.WindowSpec):
-        raise NotImplementedError("window functions run on host")
+        if node.function not in (
+            "row_number", "rank", "dense_rank", "lag", "lead",
+            "sum", "min", "max", "count", "avg",
+        ):
+            raise NotImplementedError(
+                f"window fn {node.function} runs on host"
+            )
 
 
 def _build(node: S.PlanSpec, strategy: ConvertStrategy) -> PhysicalOp:
@@ -248,5 +257,21 @@ def _convert_native(node: S.PlanSpec, strategy: ConvertStrategy
             return BroadcastExchangeExec(child)
         return ShuffleExchangeExec(
             child, list(node.keys), node.num_partitions, node.mode
+        )
+    if isinstance(node, S.WindowSpec):
+        from blaze_tpu.exprs.ir import Col
+        from blaze_tpu.ops.sort import SortKey
+        from blaze_tpu.ops.window import WindowExec, WindowFn
+
+        child = _child(node, strategy)
+        src = (
+            Col(node.source) if node.source
+            else (Col(node.order_by[0]) if node.order_by else None)
+        )
+        return WindowExec(
+            child,
+            partition_by=[Col(c) for c in node.partition_by],
+            order_by=[SortKey(Col(c)) for c in node.order_by],
+            functions=[WindowFn(node.function, src, node.output)],
         )
     raise NotImplementedError(type(node))

@@ -71,7 +71,9 @@ def test_native_pipeline_through_planner():
     np.testing.assert_allclose(got["s"], ref["s"], rtol=1e-12)
 
 
-def test_window_falls_back_to_host():
+def test_window_native_device():
+    """Common window fns now run natively on device (beyond-reference
+    capability); unsupported ones still fall back to the host engine."""
     df = df_sales(100)
     plan = WindowSpec(
         children=[MemorySpec(dataframe=df)],
@@ -81,10 +83,19 @@ def test_window_falls_back_to_host():
         output="rn",
     )
     op = convert_plan(plan)
-    assert isinstance(op, HostFallbackExec)
+    from blaze_tpu.ops.window import WindowExec
+
+    assert isinstance(op, WindowExec)
     got = run_plan(op).to_pandas()
     assert "rn" in got.columns
     assert sorted(got[got.k == got.k.iloc[0]].rn)[0] == 1
+
+    unsupported = WindowSpec(
+        children=[MemorySpec(dataframe=df)],
+        partition_by=["k"], order_by=["v"],
+        function="ntile", output="n",
+    )
+    assert isinstance(convert_plan(unsupported), HostFallbackExec)
 
 
 def test_native_above_host_window():
@@ -101,7 +112,9 @@ def test_native_above_host_window():
         ],
         predicate=Col("rn") == 1,
     )
-    op = convert_plan(plan)
+    op = convert_plan(
+        plan, ConvertStrategy(enable_window=False)
+    )
     from blaze_tpu.ops import FilterExec
 
     assert isinstance(op, FilterExec)
@@ -204,10 +217,30 @@ def test_window_functions_host_tier():
             partition_by=["k"], order_by=["v"], function=fn,
             source=src, output="w",
         )
-        got = run_plan(convert_plan(plan)).to_pandas()["w"].tolist()
+        # host path (order-preserving) vs pandas expectation
+        host_op = convert_plan(
+            plan, ConvertStrategy(enable_window=False)
+        )
+        got = run_plan(host_op).to_pandas()["w"].tolist()
         norm = [None if (isinstance(x, float) and x != x) else x
                 for x in got]
         assert norm == exp, (fn, norm)
+        # native device path emits (partition, order)-sorted rows;
+        # compare as (k, v, w) multisets
+        nat = run_plan(convert_plan(plan)).to_pandas()
+        keyfn = lambda t: (t[0], t[1], t[2] is None, t[2] or 0.0)
+        nat_rows = sorted(
+            ((int(r.k), int(r.v),
+              None if r.w != r.w else float(r.w))
+             for r in nat.itertuples()),
+            key=keyfn,
+        )
+        exp_rows = sorted(
+            ((int(k), int(v), None if x is None else float(x))
+             for k, v, x in zip(df.k, df.v, exp)),
+            key=keyfn,
+        )
+        assert nat_rows == exp_rows, fn
 
 
 def test_bhj_over_broadcast_exchange_no_duplication():
