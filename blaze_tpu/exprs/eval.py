@@ -316,19 +316,36 @@ class DeviceEvaluator:
 
     def _in_list(self, e: ir.InList) -> CV:
         v, m = self._eval(e.child)
-        hit = jnp.zeros(self.capacity, dtype=jnp.bool_)
-        any_null_item = False
-        for item in e.values:
-            if isinstance(item, ir.Literal) and item.value is None:
-                any_null_item = True
-                continue
-            iv, im = self._eval(item)
-            ct = promote(
-                infer_dtype(e.child, self.schema),
-                infer_dtype(item, self.schema),
-            )
+        any_null_item = any(
+            isinstance(x, ir.Literal) and x.value is None
+            for x in e.values
+        )
+        non_null = [
+            x for x in e.values
+            if not (isinstance(x, ir.Literal) and x.value is None)
+        ]
+        all_literals = all(isinstance(x, ir.Literal) for x in non_null)
+        ct = infer_dtype(e.child, self.schema)
+        if all_literals and len(non_null) > 8 and ct.is_numeric:
+            # InSet fast path (the reference keeps a separate InSet node
+            # for exactly this case): one searchsorted over a sorted
+            # constant table instead of an OR-chain of comparisons
             phys = _np_dtype(ct)
-            hit = hit | (v.astype(phys) == iv.astype(phys))
+            table = np.sort(
+                np.asarray([x.value for x in non_null], dtype=phys)
+            )
+            tbl = jnp.asarray(table)
+            pos = jnp.clip(
+                jnp.searchsorted(tbl, v.astype(phys)), 0, len(table) - 1
+            )
+            hit = jnp.take(tbl, pos) == v.astype(phys)
+        else:
+            hit = jnp.zeros(self.capacity, dtype=jnp.bool_)
+            for item in non_null:
+                iv, im = self._eval(item)
+                ict = promote(ct, infer_dtype(item, self.schema))
+                phys = _np_dtype(ict)
+                hit = hit | (v.astype(phys) == iv.astype(phys))
         # Spark: x IN (...) is NULL if no match and any element (or x) is NULL
         validity = m
         if any_null_item:

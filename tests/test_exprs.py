@@ -177,3 +177,19 @@ def test_eval_inside_jit():
     out = np.asarray(v)[:4]
     mask = np.asarray(m)[:4]
     assert out[mask].tolist() == [3, 5, 9]
+
+
+def test_in_set_fast_path():
+    # > 8 literals triggers the searchsorted InSet path
+    vals = [3, 7, 11, 19, 23, 29, 31, 37, 41, 43]
+    out = run_expr(
+        Col("a").isin(vals),
+        {"a": [3, 4, 43, None, 100]},
+    )
+    assert out == [True, False, True, None, False]
+    # negated
+    from blaze_tpu.exprs.ir import InList, Literal as L
+
+    e = InList(Col("a"), tuple(L.infer(v) for v in vals), negated=True)
+    out = run_expr(e, {"a": [3, 4]})
+    assert out == [False, True]
