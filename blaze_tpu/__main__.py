@@ -44,7 +44,9 @@ def cmd_run_task(args) -> int:
         total += rb.num_rows
         if not args.quiet:
             print(rb.to_pandas().to_string(max_rows=20))
+    # metrics push after stream end (reference metrics.rs:32-56)
     print(f"-- {total} rows", file=sys.stderr)
+    print(json.dumps(ctx.metrics.flatten()), file=sys.stderr)
     return 0
 
 

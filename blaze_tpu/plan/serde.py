@@ -395,6 +395,28 @@ def plan_from_proto(p: pb.PlanProto) -> PhysicalOp:
         return DebugExec(
             plan_from_proto(p.debug.input), p.debug.debug_id
         )
+    if kind == "window":
+        from blaze_tpu.ops.window import WindowExec, WindowFn
+
+        w = p.window
+        return WindowExec(
+            plan_from_proto(w.input),
+            partition_by=[expr_from_proto(e) for e in w.partition_by],
+            order_by=[
+                SortKey(expr_from_proto(k.expr), k.ascending,
+                        k.nulls_first)
+                for k in w.order_by
+            ],
+            functions=[
+                WindowFn(
+                    f.kind,
+                    expr_from_proto(f.source)
+                    if f.HasField("source") else None,
+                    f.output,
+                )
+                for f in w.functions
+            ],
+        )
     raise NotImplementedError(kind)
 
 
@@ -491,6 +513,20 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
     elif isinstance(op, DebugExec):
         p.debug.input.CopyFrom(plan_to_proto(op.children[0]))
         p.debug.debug_id = op.debug_id
+    elif type(op).__name__ == "WindowExec":
+        w = p.window
+        w.input.CopyFrom(plan_to_proto(op.children[0]))
+        for e in op.partition_by:
+            w.partition_by.add().CopyFrom(expr_to_proto(e))
+        for k in op.order_by:
+            w.order_by.add(
+                expr=expr_to_proto(k.expr), ascending=k.ascending,
+                nulls_first=k.nulls_first,
+            )
+        for f in op.functions:
+            fp = w.functions.add(kind=f.kind, output=f.output)
+            if f.source is not None:
+                fp.source.CopyFrom(expr_to_proto(f.source))
     else:
         raise NotImplementedError(type(op))
     return p

@@ -190,3 +190,34 @@ def test_error_wrapping():
     with pytest.raises(TaskExecutionError) as ei:
         run_plan(op)
     assert "boom" in repr(ei.value.__cause__)
+
+
+def test_window_proto_roundtrip():
+    from blaze_tpu.ops.sort import SortKey
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    cb = ColumnBatch.from_pydict(
+        {"k": [1, 1, 2], "v": [3.0, 1.0, 2.0]}
+    )
+    from blaze_tpu.ops import IpcReaderExec, IpcReadMode, collect_ipc
+
+    ctx = ExecContext()
+    parts = collect_ipc(MemoryScanExec.from_batches([cb]), ctx)
+    reader = IpcReaderExec("w", cb.schema, 1, IpcReadMode.CHANNEL)
+    plan = WindowExec(
+        reader,
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("v"))],
+        functions=[WindowFn("row_number", None, "rn"),
+                   WindowFn("sum", Col("v"), "sv")],
+    )
+    rt = plan_from_proto(plan_to_proto(plan))
+    ctx.resources["w"] = [parts]
+    out = pa.Table.from_batches(
+        [b for b in __import__("blaze_tpu.runtime.executor",
+                               fromlist=["execute_partition"])
+         .execute_partition(rt, 0, ctx)]
+    ).to_pydict()
+    assert sorted(out["rn"]) == [1, 1, 2]
+    got = dict(zip(zip(out["k"], out["rn"]), out["sv"]))
+    assert got[(1, 1)] == 4.0 and got[(2, 1)] == 2.0
