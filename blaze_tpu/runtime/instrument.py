@@ -1,0 +1,70 @@
+"""Per-operator metric instrumentation.
+
+The reference walks the finished plan in lockstep with a mirrored
+MetricNode tree and reports per-operator counters into the Spark UI
+(metrics.rs:32-56, NativeSupports.scala:215-228). `instrument(op, metrics)`
+builds the same mirrored tree over our operator DAG: every node's batch
+stream is wrapped to count rows/batches and inclusive elapsed wall time
+(an operator's time contains its children's, like a profiler call tree;
+subtract child nodes for exclusive time)."""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.ops.base import ExecContext, MetricNode, PhysicalOp
+
+
+class _Instrumented(PhysicalOp):
+    def __init__(self, inner: PhysicalOp, node: MetricNode):
+        self.inner = inner
+        self.node = node
+        self.children = inner.children  # already-wrapped children
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    @property
+    def partition_count(self):
+        return self.inner.partition_count
+
+    def describe(self):
+        return self.inner.describe()
+
+    def fingerprint(self):
+        return self.inner.fingerprint()
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        it = self.inner.execute(partition, ctx)
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                b = next(it)
+            except StopIteration:
+                self.node.add(
+                    "elapsed_compute", time.perf_counter_ns() - t0
+                )
+                return
+            self.node.add("elapsed_compute", time.perf_counter_ns() - t0)
+            self.node.add("output_rows", b.num_rows)
+            self.node.add("output_batches", 1)
+            yield b
+
+    def __getattr__(self, name):
+        # delegate operator-specific attributes (keys, exprs, ...)
+        return getattr(self.inner, name)
+
+
+def instrument(op: PhysicalOp, metrics: MetricNode) -> PhysicalOp:
+    """Wrap every node of the plan with a mirrored metric tree."""
+    if isinstance(op, _Instrumented):
+        return op
+    node = MetricNode(op.describe())
+    metrics.children.append(node)
+    wrapped_children = [instrument(c, node) for c in op.children]
+    op.children = wrapped_children
+    return _Instrumented(op, node)

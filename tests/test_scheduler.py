@@ -92,3 +92,30 @@ def test_prefetch_iterator():
     op = PrefetchExec(multi_scan(3, 10))
     got = run_plan_parallel(op, parallelism=2)
     assert got.num_rows == 30
+
+
+def test_instrumented_metric_tree():
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops import FilterExec, ProjectExec
+    from blaze_tpu.ops.base import MetricNode
+    from blaze_tpu.runtime.executor import run_plan
+    from blaze_tpu.runtime.instrument import instrument
+
+    scan = multi_scan(2, 30)
+    plan = ProjectExec(
+        FilterExec(scan, Col("a") % 2 == 0), [(Col("a") + 1, "a1")]
+    )
+    root = MetricNode("root")
+    wrapped = instrument(plan, root)
+    out = run_plan(wrapped)
+    assert out.num_rows == 30
+    flat = root.flatten()
+    proj = flat["ProjectExec"]
+    filt = flat["FilterExec"]
+    scan_m = flat["MemoryScanExec"]
+    assert scan_m["output_rows"] == 60
+    # filter/project defer compaction (selection vectors), so they report
+    # pre-compaction row counts; the executor's final output is compacted
+    assert filt["output_rows"] == 60
+    assert proj["output_rows"] == 60
+    assert proj["elapsed_compute"] > 0
