@@ -147,9 +147,124 @@ class FusedPipelineExec(PhysicalOp):
         return dicts
 
 
+class FusedAggregateExec(PhysicalOp):
+    """A stateless chain + a streaming PARTIAL aggregate in ONE program.
+
+    Each input batch flows scan -> filter/project stages -> sort-based
+    partial aggregation without leaving the device or re-dispatching:
+    stage evaluation and the aggregate kernel trace into a single jit
+    (ROADMAP: dispatch-count reduction beyond chain fusion)."""
+
+    def __init__(self, pipeline: FusedPipelineExec, agg):
+        self.children = [pipeline.children[0]]
+        self.pipeline = pipeline
+        self.agg = agg
+        self._schema = agg.schema
+        self._jit_cache = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"FusedAggregateExec[{self.pipeline.describe()} -> partial]"
+
+    def execute(self, partition: int, ctx: ExecContext):
+        from blaze_tpu.batch import Column, ColumnBatch
+
+        for cb in self.children[0].execute(partition, ctx):
+            key = cb.layout()
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                fn = jax.jit(self._build_kernel(cb.layout()))
+                self._jit_cache[key] = fn
+            outs, n_groups = fn(
+                cb.device_buffers(), cb.selection, cb.num_rows
+            )
+            n = int(n_groups)
+            if n == 0:
+                continue
+            cols = [
+                Column(f.dtype, v, m, None)
+                for f, (v, m) in zip(self._schema.fields, outs)
+            ]
+            yield ColumnBatch(self._schema, cols, n)
+
+    def _build_kernel(self, layout):
+        pipe_kernel = self.pipeline._build_kernel(layout)
+        mid_schema = self.pipeline.schema
+        cap = layout[0]
+        mid_layout = (
+            cap,
+            tuple(
+                (f.dtype.id.value, f.dtype.precision, f.dtype.scale, True)
+                for f in mid_schema
+            ),
+        )
+        agg = self.agg
+        key_exprs = [e for e, _ in agg.keys]
+        child_map = {
+            i: a.child
+            for i, (a, _) in enumerate(agg.aggs)
+            if a.child is not None
+        }
+        agg_kernel = agg._build_kernel(
+            mid_schema, cap, key_exprs, child_map, False, mid_layout
+        )
+
+        def kernel(bufs, selection, num_rows):
+            mid_bufs, sel = pipe_kernel(bufs, selection)
+            return agg_kernel(mid_bufs, sel, num_rows)
+
+        return kernel
+
+
+def _agg_fusable(agg) -> bool:
+    from blaze_tpu.ops.hash_aggregate import AggMode
+
+    if agg.mode is not AggMode.PARTIAL:
+        return False
+    child_schema = agg.children[0].schema
+    exprs = [e for e, _ in agg.keys] + [
+        a.child for a, _ in agg.aggs if a.child is not None
+    ]
+    for e in exprs:
+        if _expr_needs_host(e, child_schema):
+            return False
+        try:
+            if infer_dtype(e, child_schema).is_string_like:
+                return False
+        except Exception:
+            return False
+    return True
+
+
 def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
-    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages)."""
-    chain: List[PhysicalOp] = []
+    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
+    plus folding a streaming PARTIAL aggregate into the chain below it."""
+    from blaze_tpu.ops.hash_aggregate import HashAggregateExec
+
+    if (
+        isinstance(op, HashAggregateExec)
+        and len(op.children) == 1
+        and _agg_fusable(op)
+    ):
+        child = op.children[0]
+        chain: List[PhysicalOp] = []
+        t = child
+        while (
+            isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
+            and len(t.children) == 1
+            and _stage_fusable(t)
+        ):
+            chain.append(t)
+            t = t.children[0]
+        if chain:
+            pipeline = FusedPipelineExec(
+                fuse_pipelines(t), list(reversed(chain))
+            )
+            return FusedAggregateExec(pipeline, op)
+    chain = []
     t = op
     while (
         isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))

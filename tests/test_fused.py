@@ -95,3 +95,49 @@ def test_fused_inside_larger_plan():
     assert isinstance(fused.children[0], FusedPipelineExec)
     out = run_plan(fused).to_pydict()
     assert dict(zip(out["k"], out["s"])) == {1: 8, 2: 12}
+
+
+def test_fused_partial_aggregate():
+    from blaze_tpu.exprs import AggExpr, AggFn
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+    from blaze_tpu.ops.fused import FusedAggregateExec
+
+    batches = [
+        ColumnBatch.from_pydict(
+            {"k": [1, 2, 1, 3], "v": [10.0, 20.0, 30.0, 40.0]}
+        ),
+        ColumnBatch.from_pydict({"k": [2, 3], "v": [5.0, 5.0]}),
+    ]
+    scan = MemoryScanExec([batches], batches[0].schema)
+
+    def plan():
+        return HashAggregateExec(
+            ProjectExec(
+                FilterExec(scan, Col("v") < 40.0),
+                [(Col("k"), "k"), (Col("v") * 2, "v2")],
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v2")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n")],
+            mode=AggMode.PARTIAL,
+        )
+
+    fused = fuse_pipelines(plan())
+    assert isinstance(fused, FusedAggregateExec)
+    ref_batches = [b.to_pydict() for b in plan().execute(0, __import__(
+        "blaze_tpu.ops.base", fromlist=["ExecContext"]).ExecContext())]
+    got_batches = [b.to_pydict() for b in fused.execute(0, __import__(
+        "blaze_tpu.ops.base", fromlist=["ExecContext"]).ExecContext())]
+
+    def merge(bs):
+        out = {}
+        for d in bs:
+            for k, s, n in zip(d["k"], d["s#sum"], d["n#count"]):
+                acc = out.get(k, (0.0, 0))
+                out[k] = (acc[0] + s, acc[1] + n)
+        return out
+
+    assert merge(got_batches) == merge(ref_batches)
+    assert merge(got_batches) == {
+        1: (80.0, 2), 2: (50.0, 2), 3: (10.0, 1),
+    }
