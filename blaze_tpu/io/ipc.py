@@ -57,6 +57,33 @@ def decode_ipc_parts(buf: bytes) -> Iterator[pa.RecordBatch]:
                     yield rb
 
 
+def decode_ipc_stream(stream) -> Iterator[pa.RecordBatch]:
+    """Incrementally decode parts from a file-like object (the remote
+    shuffle-fetch path: the reference wraps a JVM ReadableByteChannel the
+    same way, ipc_reader_exec.rs:283-326). Reads exactly one part at a
+    time - memory stays bounded by the largest part."""
+    while True:
+        hdr = stream.read(8)
+        if not hdr or len(hdr) < 8:
+            return
+        (length,) = struct.unpack("<Q", hdr)
+        if length == 0:
+            continue
+        frame = b""
+        while len(frame) < length:
+            chunk = stream.read(length - len(frame))
+            if not chunk:
+                raise IOError("truncated IPC part in stream")
+            frame += chunk
+        raw = native.zstd_decompress(frame)
+        if not raw:
+            continue
+        with pa.ipc.open_stream(raw) as reader:
+            for rb in reader:
+                if rb.num_rows > 0:
+                    yield rb
+
+
 def read_file_segment(path: str, offset: int, length: int
                       ) -> Iterator[pa.RecordBatch]:
     """Zero-copy-ish read of one partition's byte range from a .data file

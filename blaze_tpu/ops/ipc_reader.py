@@ -77,6 +77,11 @@ class IpcReaderExec(PhysicalOp):
                 it = decode_ipc_parts(bytes(src))
             elif isinstance(src, pa.RecordBatch):
                 it = iter((src,))
+            elif hasattr(src, "read"):
+                # remote stream (the reference's ReadableByteChannel path)
+                from blaze_tpu.io.ipc import decode_ipc_stream
+
+                it = decode_ipc_stream(src)
             else:
                 raise TypeError(f"bad IPC source {type(src)}")
             for rb in it:

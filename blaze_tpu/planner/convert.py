@@ -146,6 +146,10 @@ def _check_convertible(node: S.PlanSpec) -> None:
             raise NotImplementedError(node.join_type)
         if not node.left_keys or not node.right_keys:
             raise NotImplementedError("non-equi joins run on host")
+        if node.skewed:
+            raise NotImplementedError(
+                "skew joins stay host-side (reference strategy)"
+            )
     if isinstance(node, S.AggSpec) and node.mode not in _MODE:
         raise NotImplementedError(node.mode)
     if isinstance(node, S.ExchangeSpec) and node.mode not in (

@@ -82,6 +82,9 @@ class JoinSpec(PlanSpec):
     right_keys: Sequence[str] = ()
     join_type: str = "inner"
     condition: Optional[ir.Expr] = None  # post-join filter
+    # AQE-detected skew joins stay host-side, like the reference's
+    # strategy (BlazeConvertStrategy.scala:159 "never convert skew joins")
+    skewed: bool = False
 
 
 @dataclasses.dataclass

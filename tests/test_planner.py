@@ -263,3 +263,14 @@ def test_bhj_over_broadcast_exchange_no_duplication():
     got = run_plan(op).to_pandas()
     assert len(got) == 3  # (1,1),(1,1 dup probe rows),(2,2): exactly 3
     assert sorted(got["y"].tolist()) == [1, 2, 3]
+
+
+def test_skew_join_stays_host():
+    l = pd.DataFrame({"a": [1, 2]})
+    r = pd.DataFrame({"b": [1, 2]})
+    plan = JoinSpec(
+        children=[MemorySpec(dataframe=l), MemorySpec(dataframe=r)],
+        kind="smj", left_keys=["a"], right_keys=["b"],
+        join_type="inner", skewed=True,
+    )
+    assert isinstance(convert_plan(plan), HostFallbackExec)
