@@ -25,6 +25,7 @@ from blaze_tpu.ops.empty import EmptyPartitionsExec
 from blaze_tpu.ops.debug import DebugExec
 from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
 from blaze_tpu.ops.joins import HashJoinExec, JoinType, SortMergeJoinExec
+from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
 from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
 from blaze_tpu.ops.ipc_reader import FileSegment, IpcReaderExec, IpcReadMode
 from blaze_tpu.ops.ipc_writer import IpcWriterExec, collect_ipc
@@ -47,6 +48,7 @@ __all__ = [
     "HashJoinExec",
     "JoinType",
     "SortMergeJoinExec",
+    "StreamingSortMergeJoinExec",
     "ShuffleWriterExec",
     "FileSegment",
     "IpcReaderExec",

@@ -1,0 +1,201 @@
+"""Streaming sort-merge join for pre-sorted inputs.
+
+The reference's flagship custom operator streams both sorted sides with
+single-row cursors (sort_merge_join_exec.rs:293-601). Row cursors are
+hostile to vectorization (SURVEY 7 hard parts), so this operator streams
+at BATCH granularity instead: a sliding window of right-side batches is
+kept only as wide as the current left batch's key range requires
+(sorted-input invariant: once the left stream has passed a key, right rows
+below it can never match again), and each left batch joins against the
+window with the shared vectorized core. Memory is O(window), not O(side).
+
+Contract: both inputs sorted ascending by their join keys (the planner
+guarantees this the same way Spark does for SMJ - sort nodes under the
+join). All six join types supported; RIGHT/FULL emit evicted-unmatched
+window rows incrementally.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from blaze_tpu.types import Schema
+from blaze_tpu.batch import ColumnBatch, row_mask
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.joins import (
+    JoinType,
+    _JoinCore,
+    _gather_side,
+    _joined_schema,
+    _null_side,
+)
+from blaze_tpu.ops.util import concat_batches, ensure_compacted
+
+
+def _key_matrix(cb: ColumnBatch, key_idx: Sequence[int]) -> np.ndarray:
+    """(num_rows, n_keys) host array of key values for range bookkeeping
+    (tiny D2H: keys only)."""
+    cols = []
+    for i in key_idx:
+        c = cb.columns[i]
+        cols.append(np.asarray(c.values)[: cb.num_rows])
+    return np.stack(cols, axis=1) if cols else np.zeros((cb.num_rows, 0))
+
+
+def _tuple_lt(a: np.ndarray, b: np.ndarray) -> bool:
+    """Lexicographic a < b for 1-D key tuples."""
+    for x, y in zip(a, b):
+        if x < y:
+            return True
+        if x > y:
+            return False
+    return False
+
+
+class StreamingSortMergeJoinExec(PhysicalOp):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 join_type: JoinType = JoinType.INNER):
+        self.children = [left, right]
+        self.left_keys = [left.schema.index_of(k) for k in left_keys]
+        self.right_keys = [right.schema.index_of(k) for k in right_keys]
+        for side, idxs in ((left, self.left_keys),
+                           (right, self.right_keys)):
+            for i in idxs:
+                if side.schema.fields[i].dtype.is_string_like:
+                    raise NotImplementedError(
+                        "streaming SMJ needs ordered fixed-width keys; "
+                        "string-keyed joins use the materializing SMJ"
+                    )
+        self.join_type = join_type
+        self._schema = _joined_schema(left.schema, right.schema, join_type)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return self.children[0].partition_count
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        left, right = self.children
+        jt = self.join_type
+        right_it = right.execute(partition, ctx)
+        # window entries: (batch, matched np.bool_[num_rows], max_key)
+        window: List[List] = []
+        right_done = False
+
+        def pull_right() -> bool:
+            nonlocal right_done
+            if right_done:
+                return False
+            for rb in right_it:
+                rb = ensure_compacted(rb)
+                if rb.num_rows == 0:
+                    continue
+                keys = _key_matrix(rb, self.right_keys)
+                window.append(
+                    [rb, np.zeros(rb.num_rows, dtype=bool), keys[-1]]
+                )
+                return True
+            right_done = True
+            return False
+
+        def evict(before_key: Optional[np.ndarray]
+                  ) -> Iterator[ColumnBatch]:
+            """Drop window batches wholly below `before_key` (None = all),
+            emitting their unmatched rows for RIGHT/FULL."""
+            keep = []
+            for entry in window:
+                rb, matched, maxk = entry
+                if before_key is None or _tuple_lt(maxk, before_key):
+                    if jt in (JoinType.RIGHT, JoinType.FULL) and \
+                            not matched.all():
+                        yield self._right_unmatched(rb, matched)
+                else:
+                    keep.append(entry)
+            window[:] = keep
+
+        for lb in left.execute(partition, ctx):
+            lb = ensure_compacted(lb)
+            if lb.num_rows == 0:
+                continue
+            lkeys = _key_matrix(lb, self.left_keys)
+            lmin, lmax = lkeys[0], lkeys[-1]
+            # widen window until the right stream passes lmax
+            while (not window or not _tuple_lt(lmax, window[-1][2])) \
+                    and pull_right():
+                pass
+            # shrink: whole batches below lmin can never match again
+            yield from evict(lmin)
+            yield from self._join_left_batch(lb, window)
+        # final flush of never-matched right rows
+        yield from evict(None)
+        if jt in (JoinType.RIGHT, JoinType.FULL) and not right_done:
+            for rb in right_it:
+                rb = ensure_compacted(rb)
+                if rb.num_rows:
+                    yield self._right_unmatched(
+                        rb, np.zeros(rb.num_rows, dtype=bool)
+                    )
+
+    # ------------------------------------------------------------------
+    def _join_left_batch(self, lb: ColumnBatch, window: List[List]
+                         ) -> Iterator[ColumnBatch]:
+        left, right = self.children
+        jt = self.join_type
+        build = concat_batches(
+            [e[0] for e in window], schema=right.schema
+        )
+        core = _JoinCore(build, self.right_keys)
+        (probe, pair_b, pair_p, valid, pair_cap,
+         matched_p) = core.probe(lb, self.left_keys)
+        live_p = row_mask(probe.num_rows, probe.capacity)
+        # fold this probe's build-side matches back into window bookkeeping
+        mb = np.asarray(core.matched_build)
+        off = 0
+        for entry in window:
+            n = entry[0].num_rows
+            entry[1] |= mb[off: off + n]
+            off += n
+        if jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                  JoinType.FULL):
+            lcols = _gather_side(probe.columns, pair_p, None)
+            rcols = _gather_side(build.columns, pair_b, None)
+            yield ColumnBatch(self._schema, lcols + rcols, pair_cap, valid)
+            if jt in (JoinType.LEFT, JoinType.FULL):
+                import jax.numpy as jnp
+
+                un = live_p & ~matched_p
+                rnull = _null_side(right.schema.fields, probe.capacity)
+                yield ColumnBatch(
+                    self._schema, list(probe.columns) + rnull,
+                    probe.num_rows, un,
+                )
+        elif jt is JoinType.LEFT_SEMI:
+            yield ColumnBatch(
+                self._schema, list(probe.columns), probe.num_rows,
+                live_p & matched_p,
+            )
+        elif jt is JoinType.LEFT_ANTI:
+            yield ColumnBatch(
+                self._schema, list(probe.columns), probe.num_rows,
+                live_p & ~matched_p,
+            )
+
+    def _right_unmatched(self, rb: ColumnBatch, matched: np.ndarray
+                         ) -> ColumnBatch:
+        import jax.numpy as jnp
+
+        left = self.children[0]
+        un = np.zeros(rb.capacity, dtype=bool)
+        un[: rb.num_rows] = ~matched
+        lnull = _null_side(left.schema.fields, rb.capacity)
+        return ColumnBatch(
+            self._schema, lnull + list(rb.columns), rb.num_rows,
+            jnp.asarray(un),
+        )

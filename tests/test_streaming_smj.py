@@ -1,0 +1,116 @@
+"""Streaming SMJ tests: differential vs the materializing SMJ over
+multi-batch sorted streams, all join types, window eviction coverage."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.ops import (
+    ExecContext,
+    JoinType,
+    MemoryScanExec,
+    SortMergeJoinExec,
+)
+from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
+
+
+def sorted_scan(keys, vals, batch_rows=7, names=("k", "v")):
+    order = np.argsort(keys, kind="stable")
+    keys = np.asarray(keys)[order]
+    vals = np.asarray(vals)[order]
+    batches = []
+    for s in range(0, len(keys), batch_rows):
+        batches.append(
+            ColumnBatch.from_pydict(
+                {
+                    names[0]: keys[s: s + batch_rows].tolist(),
+                    names[1]: vals[s: s + batch_rows].tolist(),
+                }
+            )
+        )
+    if not batches:
+        from blaze_tpu.batch import empty_batch
+
+        sch = ColumnBatch.from_pydict(
+            {names[0]: [0], names[1]: [0]}
+        ).schema
+        return MemoryScanExec([[empty_batch(sch)]], sch)
+    return MemoryScanExec([batches], batches[0].schema)
+
+
+def rows_of(op):
+    out = []
+    for b in op.execute(0, ExecContext()):
+        arr = b.to_arrow()
+        out += list(
+            zip(*[arr.column(i).to_pylist()
+                  for i in range(arr.num_columns)])
+        )
+    return sorted(
+        out, key=lambda r: tuple((x is None, x) for x in r)
+    )
+
+
+@pytest.mark.parametrize(
+    "jt",
+    [JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL,
+     JoinType.LEFT_SEMI, JoinType.LEFT_ANTI],
+)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_streaming_matches_materializing(jt, seed):
+    rng = np.random.default_rng(seed)
+    lk = rng.integers(0, 25, 60)
+    lv = rng.integers(0, 100, 60)
+    rk = rng.integers(0, 25, 45)
+    rv = rng.integers(0, 100, 45)
+
+    def build(cls):
+        return cls(
+            sorted_scan(lk, lv, 7, ("k", "v")),
+            sorted_scan(rk, rv, 5, ("k2", "w")),
+            ["k"], ["k2"], jt,
+        )
+
+    got = rows_of(build(StreamingSortMergeJoinExec))
+    ref = rows_of(build(SortMergeJoinExec))
+    assert got == ref, (jt, seed)
+
+
+def test_window_eviction_bounded():
+    """Disjoint key ranges per batch: the window must never hold more
+    than ~2 right batches at a time."""
+    lk = np.arange(100)
+    rk = np.arange(100)
+    op = StreamingSortMergeJoinExec(
+        sorted_scan(lk, lk * 2, 10, ("k", "v")),
+        sorted_scan(rk, rk * 3, 10, ("k2", "w")),
+        ["k"], ["k2"], JoinType.INNER,
+    )
+    # spy on the internal window length via monkeypatched concat
+    import blaze_tpu.ops.streaming_smj as mod
+
+    max_window = {"n": 0}
+    orig = mod.concat_batches
+
+    def spy(batches, schema=None):
+        max_window["n"] = max(max_window["n"], len(batches))
+        return orig(batches, schema=schema)
+
+    mod.concat_batches = spy
+    try:
+        rows = rows_of(op)
+    finally:
+        mod.concat_batches = orig
+    assert len(rows) == 100
+    assert max_window["n"] <= 3  # bounded, never the whole side
+
+
+def test_streaming_empty_sides():
+    empty = sorted_scan([], [], 5, ("k", "v"))
+    right = sorted_scan([1, 2], [10, 20], 5, ("k2", "w"))
+    op = StreamingSortMergeJoinExec(
+        empty, right, ["k"], ["k2"], JoinType.FULL
+    )
+    rows = rows_of(op)
+    assert rows == [(None, None, 1, 10), (None, None, 2, 20)]
