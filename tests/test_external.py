@@ -172,12 +172,79 @@ def test_external_sort_topk_and_host(tiny_limit):
         rng.integers(0, 37, 150)
         allv += rng.integers(0, 100, 150).tolist()
     assert got == sorted(allv, reverse=True)[:10]
-    # full host-sort path
-    scan2 = multi_batch_scan(8, 150, seed=9)
-    op2 = SortExec(scan2, [SortKey(Col("v"))])
+    # host-sort fallback path (string keys cannot run-merge on codes)
+    rng2 = np.random.default_rng(9)
+    sbatches = []
+    alls = []
+    for _ in range(8):
+        rng2.integers(0, 37, 150)
+        vs = rng2.integers(0, 100, 150)
+        ss = [f"s{v:03d}" for v in vs]
+        alls += ss
+        sbatches.append(ColumnBatch.from_pydict({"s": ss}))
+    scan2 = MemoryScanExec([sbatches], sbatches[0].schema)
+    op2 = SortExec(scan2, [SortKey(Col("s"))])
     ctx2 = ExecContext(config=tiny_limit)
     got2 = []
     for b in op2.execute(0, ctx2):
-        got2 += b.to_pydict()["v"]
-    assert got2 == sorted(allv)
+        got2 += b.to_pydict()["s"]
+    assert got2 == sorted(alls)
     assert ctx2.metrics.counters.get("host_sorts") == 1
+
+
+def test_external_run_merge_sort(tiny_limit):
+    from blaze_tpu.ops import SortExec, SortKey
+
+    scan = multi_batch_scan(8, 150, seed=13)
+    ctx = ExecContext(config=tiny_limit)
+    op = SortExec(scan, [SortKey(Col("v")), SortKey(Col("k"))])
+    got = []
+    for b in op.execute(0, ctx):
+        d = b.to_pydict()
+        got += list(zip(d["k"], d["v"]))
+    assert ctx.metrics.counters.get("sort_spilled_runs", 0) >= 2
+    rng = np.random.default_rng(13)
+    allrows = []
+    for _ in range(8):
+        ks = rng.integers(0, 37, 150).tolist()
+        vs = rng.integers(0, 100, 150).tolist()
+        allrows += list(zip(ks, vs))
+    exp = sorted(allrows, key=lambda t: (t[1], t[0]))
+    assert [(v,) for _, v in got] == [(v,) for _, v in exp]
+    # full (k within v) ordering as well
+    assert sorted(got) == sorted(exp)
+    assert got == exp
+
+
+def test_external_run_merge_sort_desc_nulls(tiny_limit):
+    import pyarrow as pa
+
+    from blaze_tpu.ops import MemoryScanExec as MS, SortExec, SortKey
+
+    rng = np.random.default_rng(17)
+    batches = []
+    allv = []
+    for _ in range(6):
+        vals = [
+            None if rng.random() < 0.1 else int(rng.integers(0, 1000))
+            for _ in range(150)
+        ]
+        allv += vals
+        batches.append(
+            ColumnBatch.from_arrow(
+                pa.RecordBatch.from_pydict(
+                    {"v": pa.array(vals, type=pa.int64())}
+                )
+            )
+        )
+    scan = MS([batches], batches[0].schema)
+    ctx = ExecContext(config=tiny_limit)
+    op = SortExec(
+        scan, [SortKey(Col("v"), ascending=False, nulls_first=False)]
+    )
+    got = []
+    for b in op.execute(0, ctx):
+        got += b.to_pydict()["v"]
+    nn = sorted([v for v in allv if v is not None], reverse=True)
+    exp = nn + [None] * (len(allv) - len(nn))
+    assert got == exp
