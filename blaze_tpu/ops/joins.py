@@ -57,10 +57,15 @@ class JoinType(enum.Enum):
     FULL = "full"
     LEFT_SEMI = "left_semi"
     LEFT_ANTI = "left_anti"
+    # Spark's NOT IN semantics (null-aware anti join): if the build side
+    # contains any NULL key the result is empty; probe rows with NULL keys
+    # never qualify either
+    LEFT_ANTI_NULL_AWARE = "left_anti_null_aware"
 
 
 def _joined_schema(left: Schema, right: Schema, jt: JoinType) -> Schema:
-    if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+    if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+              JoinType.LEFT_ANTI_NULL_AWARE):
         return left
     nullable_left = jt in (JoinType.RIGHT, JoinType.FULL)
     nullable_right = jt in (JoinType.LEFT, JoinType.FULL)
@@ -402,6 +407,13 @@ class SortMergeJoinExec(PhysicalOp):
         r_head, r_exc = collect_until(r_it, limit)
         l_it = left.execute(partition, ctx)
         l_head, l_exc = collect_until(l_it, limit)
+        if self.join_type is JoinType.LEFT_ANTI_NULL_AWARE:
+            # "any build NULL -> empty result" is a GLOBAL property, so
+            # NAAJ cannot bucket; NOT-IN subquery build sides are small
+            l_head += list(l_it)
+            r_head += list(r_it)
+            yield from self._join_bucket(l_head, r_head)
+            return
         if not (r_exc or l_exc):
             yield from self._join_bucket(l_head, r_head)
             return
@@ -469,4 +481,26 @@ class SortMergeJoinExec(PhysicalOp):
             yield ColumnBatch(
                 self._schema, list(probe.columns), probe.num_rows,
                 live_p & ~matched_p,
+            )
+        elif jt is JoinType.LEFT_ANTI_NULL_AWARE:
+            # NOT IN: probe rows with NULL keys never qualify, and any
+            # NULL key on the build side empties the result entirely
+            def keys_valid(cb, idxs, live):
+                ok = jnp.ones(cb.capacity, dtype=jnp.bool_)
+                for i in idxs:
+                    c = cb.columns[i]
+                    if c.validity is not None:
+                        ok = ok & c.validity
+                return ok
+
+            live_b = row_mask(build.num_rows, build.capacity)
+            build_has_null = jnp.any(
+                live_b & ~keys_valid(build, self.right_keys, live_b)
+            )
+            probe_ok = keys_valid(probe, self.left_keys, live_p)
+            sel = (
+                live_p & ~matched_p & probe_ok & ~build_has_null
+            )
+            yield ColumnBatch(
+                self._schema, list(probe.columns), probe.num_rows, sel
             )

@@ -272,6 +272,7 @@ _JT_TO_PB = {
     JoinType.INNER: pb.INNER, JoinType.LEFT: pb.LEFT,
     JoinType.RIGHT: pb.RIGHT, JoinType.FULL: pb.FULL,
     JoinType.LEFT_SEMI: pb.LEFT_SEMI, JoinType.LEFT_ANTI: pb.LEFT_ANTI,
+    JoinType.LEFT_ANTI_NULL_AWARE: pb.LEFT_ANTI_NULL_AWARE,
 }
 _PB_TO_JT = {v: k for k, v in _JT_TO_PB.items()}
 

@@ -56,6 +56,7 @@ _JT = {
     "full": JoinType.FULL,
     "left_semi": JoinType.LEFT_SEMI,
     "left_anti": JoinType.LEFT_ANTI,
+    "left_anti_null_aware": JoinType.LEFT_ANTI_NULL_AWARE,
 }
 
 _MODE = {

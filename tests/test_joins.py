@@ -217,3 +217,21 @@ def test_join_empty_sides():
     assert collect_rows(inner) == []
     left = SortMergeJoinExec(l, r, ["a"], ["b"], JoinType.LEFT)
     assert collect_rows(left, sort_by=[0]) == [(1, None), (2, None)]
+
+
+def test_null_aware_anti_join():
+    """Spark NOT IN semantics: build-side NULL empties the result; probe
+    NULL keys never qualify."""
+    l = scan_of({"a": [1, 2, None, 4]})
+    # no nulls in build: plain anti minus null probe rows
+    r = scan_of({"b": [2, 5]})
+    op = SortMergeJoinExec(
+        l, r, ["a"], ["b"], JoinType.LEFT_ANTI_NULL_AWARE
+    )
+    assert collect_rows(op, sort_by=[0]) == [(1,), (4,)]
+    # any null in build -> empty
+    r2 = scan_of({"b": [2, None]})
+    op2 = SortMergeJoinExec(
+        l, r2, ["a"], ["b"], JoinType.LEFT_ANTI_NULL_AWARE
+    )
+    assert collect_rows(op2) == []
