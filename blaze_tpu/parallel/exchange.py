@@ -61,12 +61,16 @@ class ShuffleExchangeExec(PhysicalOp):
         with self._lock:
             if self._map_outputs is not None:
                 return self._map_outputs
+            import concurrent.futures as cf
+
             child = self.children[0]
             d = self.shuffle_dir or tempfile.mkdtemp(prefix="blz-shuffle-")
             os.makedirs(d, exist_ok=True)
-            outputs = []
-            for map_id in range(child.partition_count):
-                data = os.path.join(d, f"shuffle_{id(self):x}_{map_id}_0.data")
+
+            def run_map(map_id: int) -> Tuple[str, str]:
+                data = os.path.join(
+                    d, f"shuffle_{id(self):x}_{map_id}_0.data"
+                )
                 index = os.path.join(
                     d, f"shuffle_{id(self):x}_{map_id}_0.index"
                 )
@@ -79,14 +83,19 @@ class ShuffleExchangeExec(PhysicalOp):
                         )
                         for _ in writer.execute(map_id, ctx):
                             pass
-                        last_err = None
-                        break
-                    except Exception as e:  # retry like a failed Spark task
+                        return (data, index)
+                    except Exception as e:  # retry like a Spark task
                         last_err = e
                         ctx.metrics.add("task_retries", 1)
-                if last_err is not None:
-                    raise last_err
-                outputs.append((data, index))
+                raise last_err  # type: ignore[misc]
+
+            # map tasks run concurrently like Spark executor threads
+            # (device dispatch is async; host encode/IO overlaps)
+            n = child.partition_count
+            with cf.ThreadPoolExecutor(
+                max_workers=min(4, max(1, n))
+            ) as pool:
+                outputs = list(pool.map(run_map, range(n)))
             self._map_outputs = outputs
             return outputs
 
