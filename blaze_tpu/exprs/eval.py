@@ -471,15 +471,33 @@ class DeviceEvaluator:
         if n == "trunc" or n == "truncate":
             return jnp.trunc(f64(vs[0])), m
         if n in ("greatest", "least"):
+            # Spark: NULL operands are skipped; NULL only when all are
             phys = _np_dtype(infer_dtype(e, self.schema))
-            acc = vs[0].astype(phys)
-            for v in vs[1:]:
-                acc = (
-                    jnp.maximum(acc, v.astype(phys))
-                    if n == "greatest"
-                    else jnp.minimum(acc, v.astype(phys))
+            acc_v = None
+            acc_m = None
+            for v, vm in args:
+                v = v.astype(phys)
+                valid = valid_or_true(vm, v.shape)
+                if acc_v is None:
+                    acc_v, acc_m = v, valid
+                    continue
+                both = acc_m & valid
+                pick = (
+                    jnp.maximum(acc_v, v) if n == "greatest"
+                    else jnp.minimum(acc_v, v)
                 )
-            return acc, m
+                acc_v = jnp.where(
+                    both, pick, jnp.where(valid, v, acc_v)
+                )
+                acc_m = acc_m | valid
+            return acc_v, acc_m
+        if n == "pmod":
+            # non-negative modulo (Spark pmod expression)
+            zero = vs[1] == 0
+            safe = jnp.where(zero, jnp.ones_like(vs[1]), vs[1])
+            r = lax.rem(vs[0], safe)
+            r = jnp.where(r < 0, r + jnp.abs(safe), r)
+            return r, and_validity(m, ~zero)
         if n == "spark_unscaled_value":
             # decimal (i64-unscaled repr) -> bigint: identity on device
             # (reference spark_ext_function.rs:8)

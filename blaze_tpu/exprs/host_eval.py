@@ -143,11 +143,22 @@ class HostEvaluator:
         if n == "like":
             return pc.match_like(args[0], pattern=_pat(e.args[1]))
         if n == "substring":
-            # Spark 1-based start; 0 behaves like 1
+            # Spark 1-based start; 0 behaves like 1; negative counts from
+            # the end
             start = _int_lit(e.args[1])
             length = _int_lit(e.args[2]) if len(e.args) > 2 else None
-            start0 = start - 1 if start > 0 else max(start, 0)
-            stop = None if length is None else start0 + length
+            if start > 0:
+                start0 = start - 1
+            elif start == 0:
+                start0 = 0
+            else:
+                start0 = start  # arrow slice supports negative starts
+            if length is None:
+                stop = None
+            else:
+                stop = start0 + length
+                if start0 < 0 and stop >= 0:
+                    stop = None  # reaches the end of the string
             return pc.utf8_slice_codeunits(args[0], start0, stop)
         if n == "concat":
             return pc.binary_join_element_wise(

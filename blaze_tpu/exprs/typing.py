@@ -140,7 +140,7 @@ def infer_dtype(e: ir.Expr, schema: Schema) -> DataType:
         if n in _DEVICE_FN_TYPES:
             return DataType(_DEVICE_FN_TYPES[n])
         if n in ("abs", "negative", "positive", "signum", "round", "trunc",
-                 "ceil", "floor", "nanvl", "greatest", "least"):
+                 "ceil", "floor", "nanvl", "greatest", "least", "pmod"):
             if n in ("ceil", "floor"):
                 # Spark: ceil/floor(double) -> bigint
                 ct = infer_dtype(e.args[0], schema)

@@ -155,6 +155,13 @@ def execute_host(node: S.PlanSpec) -> pd.DataFrame:
             )[l.columns]
             if node.join_type == "left_semi":
                 out = matched.drop_duplicates()
+            elif node.join_type == "left_anti_null_aware":
+                if r[rk].isna().any().any():
+                    out = l.iloc[0:0]  # any build NULL -> empty (NOT IN)
+                else:
+                    key = l[lk].apply(tuple, axis=1)
+                    mkey = set(matched[lk].apply(tuple, axis=1))
+                    out = l[~key.isin(mkey) & l[lk].notna().all(axis=1)]
             else:  # left_anti
                 key = l[lk].apply(tuple, axis=1)
                 mkey = set(matched[lk].apply(tuple, axis=1))

@@ -193,3 +193,28 @@ def test_in_set_fast_path():
     e = InList(Col("a"), tuple(L.infer(v) for v in vals), negated=True)
     out = run_expr(e, {"a": [3, 4]})
     assert out == [False, True]
+
+
+def test_greatest_least_skip_nulls():
+    from blaze_tpu.exprs.ir import ScalarFn as SF
+
+    out = run_expr(
+        SF("greatest", (Col("a"), Col("b"))),
+        {"a": [1, None, None], "b": [5, 7, None]},
+    )
+    assert out == [5, 7, None]
+    out = run_expr(
+        SF("least", (Col("a"), Col("b"))),
+        {"a": [1, None, None], "b": [5, 7, None]},
+    )
+    assert out == [1, 7, None]
+
+
+def test_pmod_fn():
+    from blaze_tpu.exprs.ir import ScalarFn as SF
+
+    out = run_expr(
+        SF("pmod", (Col("a"), Col("b"))),
+        {"a": [-7, 7, -7], "b": [3, 3, 0]},
+    )
+    assert out == [2, 1, None]
