@@ -1,0 +1,154 @@
+"""Mesh-integrated operators: the ICI fast path as a PhysicalOp.
+
+`MeshGroupByExec` executes an entire two-phase GROUP BY across the device
+mesh in one pjit program (parallel/sharded.DistributedGroupBy): each child
+partition lands on one device, partial-aggregates locally, exchanges
+partial states by key hash over ICI (all_to_all), and final-merges on the
+owner - replacing a ShuffleExchange(partial->final) pair with zero host
+round trips for slice-resident data. The file-fabric path remains the
+fallback for string keys / more partitions than devices / multi-host.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.types import DataType, Field, Schema, TypeId
+from blaze_tpu.batch import Column, ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import AggExpr, AggFn
+from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.ops.util import concat_batches
+from blaze_tpu.parallel.mesh import get_mesh
+from blaze_tpu.parallel.sharded import DistAgg, DistributedGroupBy
+
+
+class MeshGroupByExec(PhysicalOp):
+    """GROUP BY over the whole mesh in one dispatch.
+
+    Constraints (fall back to exchange+aggregate otherwise): fixed-width
+    non-null-sensitive key/agg exprs (no strings), child partition count
+    <= mesh size. Output: one partition per device (group-disjoint).
+    """
+
+    def __init__(self, child: PhysicalOp,
+                 keys: Sequence[Tuple[ir.Expr, str]],
+                 aggs: Sequence[Tuple[AggExpr, str]],
+                 filter_pred: ir.Expr = None,
+                 mesh=None):
+        self.children = [child]
+        self.mesh = mesh or get_mesh()
+        in_schema = child.schema
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        self.filter_pred = filter_pred
+        for e, _ in keys:
+            if infer_dtype(ir.bind(e, in_schema),
+                           in_schema).is_string_like:
+                raise NotImplementedError(
+                    "string keys use the file-shuffle tier"
+                )
+        key_fields = [
+            Field(n, infer_dtype(ir.bind(e, in_schema), in_schema), True)
+            for e, n in keys
+        ]
+        agg_fields = []
+        for a, n in aggs:
+            if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+                agg_fields.append(Field(n, DataType.int64(), False))
+            elif a.fn is AggFn.AVG:
+                agg_fields.append(Field(n, DataType.float64(), True))
+            else:
+                agg_fields.append(
+                    Field(
+                        n,
+                        infer_dtype(
+                            ir.bind(a.child, in_schema), in_schema
+                        ),
+                        True,
+                    )
+                )
+        self._schema = Schema(key_fields + agg_fields)
+        self._gb = DistributedGroupBy(
+            self.mesh, in_schema,
+            keys=[e for e, _ in keys],
+            aggs=[DistAgg(a.fn, a.child) for a, _ in aggs],
+            filter_pred=filter_pred,
+        )
+        self._result = None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return int(self.mesh.shape["data"])
+
+    def _run(self, ctx: ExecContext):
+        if self._result is not None:
+            return self._result
+        child = self.children[0]
+        n_dev = self.partition_count
+        assert child.partition_count <= n_dev, (
+            "more partitions than devices; use the exchange tier"
+        )
+        per_part = [
+            concat_batches(
+                list(child.execute(p, ctx)), schema=child.schema
+            )
+            for p in range(child.partition_count)
+        ]
+        for b in per_part:
+            for c in b.columns:
+                if c.validity is not None:
+                    raise NotImplementedError(
+                        "mesh group-by handles non-nullable columns; "
+                        "nullable inputs use the exchange tier"
+                    )
+        # pad to a common capacity and stack [n_dev, cap] per column
+        cap = max(max((b.capacity for b in per_part), default=1), 1)
+        ncols = len(child.schema)
+        stacked = []
+        for ci in range(ncols):
+            phys = child.schema.fields[ci].dtype.physical_dtype()
+            rows = []
+            for b in per_part:
+                v = np.asarray(b.columns[ci].values)
+                if len(v) < cap:
+                    v = np.pad(v, (0, cap - len(v)))
+                rows.append(v)
+            for _ in range(n_dev - len(per_part)):
+                rows.append(np.zeros(cap, dtype=phys))
+            stacked.append(jnp.asarray(np.stack(rows)))
+        num_rows = jnp.asarray(
+            np.array(
+                [b.num_rows for b in per_part]
+                + [0] * (n_dev - len(per_part)),
+                dtype=np.int32,
+            )
+        )
+        key_out, agg_out, counts = self._gb(stacked, num_rows)
+        self._result = (key_out, agg_out, np.asarray(counts))
+        ctx.metrics.add("mesh_groupby_groups", int(self._result[2].sum()))
+        return self._result
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        key_out, agg_out, counts = self._run(ctx)
+        n = int(counts[partition])
+        if n == 0:
+            return
+        cols: List[Column] = []
+        for arr, f in zip(
+            list(key_out) + list(agg_out), self._schema.fields
+        ):
+            v = arr[partition].astype(f.dtype.physical_dtype())
+            cols.append(Column(f.dtype, v, None, None))
+        yield ColumnBatch(self._schema, cols, n)

@@ -218,3 +218,29 @@ def test_distributed_broadcast_join():
             if expected:
                 assert int(got_bv[d, i]) == int(pk[d, i]) * 100
         assert not hit[d, int(probe_rows[d]):].any()
+
+
+def test_mesh_group_by_exec():
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+    from blaze_tpu.runtime.executor import run_plan
+
+    scan = multi_partition_scan(6, 80)  # 6 partitions <= 8 devices
+    op = MeshGroupByExec(
+        scan,
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+    )
+    out = run_plan(op).to_pandas().sort_values("k").reset_index(drop=True)
+    import pandas as pd
+
+    rows = [(i % 10, i) for i in range(480)]
+    ref = (
+        pd.DataFrame(rows, columns=["k", "v"])
+        .groupby("k")
+        .agg(s=("v", "sum"), n=("v", "size"))
+        .reset_index()
+    )
+    np.testing.assert_array_equal(out["k"], ref["k"])
+    np.testing.assert_array_equal(out["s"], ref["s"])
+    np.testing.assert_array_equal(out["n"], ref["n"])
