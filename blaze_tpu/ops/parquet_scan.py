@@ -70,25 +70,34 @@ class ParquetScanExec(PhysicalOp):
         import pyarrow.parquet as pq
 
         from blaze_tpu.io.object_store import store_for
+        from blaze_tpu.runtime.prefetch import prefetch
 
         cfg = ctx.config
         cols = self.projection or [f.name for f in self._schema]
-        for fr in self.file_groups[partition]:
-            # all byte IO flows through the object-store seam (the
-            # reference's registered ObjectStore, exec.rs:96-103)
-            pf = pq.ParquetFile(store_for(fr.path).open_input(fr.path))
-            groups = self._select_row_groups(pf, fr)
-            if not groups:
-                continue
-            for rb in pf.iter_batches(
-                batch_size=cfg.batch_size, row_groups=groups,
-                columns=cols, use_threads=True,
-            ):
-                ctx.metrics.add("input_rows", rb.num_rows)
-                ctx.metrics.add("input_batches", 1)
-                if rb.num_rows == 0:
+
+        def decode() -> Iterator[ColumnBatch]:
+            for fr in self.file_groups[partition]:
+                # all byte IO flows through the object-store seam (the
+                # reference's registered ObjectStore, exec.rs:96-103)
+                pf = pq.ParquetFile(
+                    store_for(fr.path).open_input(fr.path)
+                )
+                groups = self._select_row_groups(pf, fr)
+                if not groups:
                     continue
-                yield ColumnBatch.from_arrow(rb)
+                for rb in pf.iter_batches(
+                    batch_size=cfg.batch_size, row_groups=groups,
+                    columns=cols, use_threads=True,
+                ):
+                    ctx.metrics.add("input_rows", rb.num_rows)
+                    ctx.metrics.add("input_batches", 1)
+                    if rb.num_rows == 0:
+                        continue
+                    yield ColumnBatch.from_arrow(rb)
+
+        # overlap parquet decode + H2D with downstream device compute
+        # (SURVEY 7 streaming model: double-buffered host pipeline)
+        yield from prefetch(decode(), depth=2)
 
     # ------------------------------------------------------------------
     def _select_row_groups(self, pf, fr: FileRange) -> List[int]:

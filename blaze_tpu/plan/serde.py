@@ -534,13 +534,31 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
 
 
 def task_to_proto(op: PhysicalOp, partition: int,
-                  task_id: str = "task") -> bytes:
+                  task_id: str = "task",
+                  file_resources=None) -> bytes:
+    """`file_resources`: {resource_id: [FileSegment,...]} shipped with the
+    task so IpcReader leaves resolve without an in-process registry
+    (cross-process/host execution)."""
     t = pb.TaskDefinitionProto(partition=partition, task_id=task_id)
     t.plan.CopyFrom(plan_to_proto(op))
+    for rid, segments in (file_resources or {}).items():
+        rp = t.file_resources.add(resource_id=rid)
+        for seg in segments:
+            rp.segments.add(
+                path=seg.path, start=seg.offset, length=seg.length
+            )
     return t.SerializeToString()
 
 
 def task_from_proto(data: bytes):
+    from blaze_tpu.ops.ipc_reader import FileSegment
+
     t = pb.TaskDefinitionProto()
     t.ParseFromString(data)
-    return plan_from_proto(t.plan), t.partition, t.task_id
+    resources = {}
+    for rp in t.file_resources:
+        segs = [
+            FileSegment(s.path, s.start, s.length) for s in rp.segments
+        ]
+        resources[rp.resource_id] = (lambda ss: (lambda p: ss))(segs)
+    return plan_from_proto(t.plan), t.partition, t.task_id, resources

@@ -1,0 +1,174 @@
+"""Mini-cluster runner: multi-PROCESS stage execution over the file
+fabric.
+
+The multi-host story of this engine (SURVEY 2.4): hosts coordinate through
+serialized TaskDefinitions and the segmented Arrow-IPC shuffle files -
+exactly how a Spark cluster drives the reference (tasks arrive as protobuf
+over JNI, shuffle moves as .data/.index files). This runner proves that
+path with real process isolation: a driver serializes each map task to a
+spool directory, worker PROCESSES (separate interpreters, separate JAX
+runtimes - `python -m blaze_tpu worker`) claim tasks by atomic rename,
+execute them through `runtime.executor.execute_task`, and write results as
+segmented IPC; the driver assembles. No state crosses process boundaries
+except protobuf + IPC files, so the same layout scales to real multi-host
+DCN with a shared filesystem or object store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+
+
+class MiniCluster:
+    def __init__(self, num_workers: int = 2,
+                 spool_dir: Optional[str] = None,
+                 env: Optional[dict] = None):
+        self.num_workers = num_workers
+        self.spool = spool_dir or tempfile.mkdtemp(prefix="blz-cluster-")
+        os.makedirs(os.path.join(self.spool, "tasks"), exist_ok=True)
+        os.makedirs(os.path.join(self.spool, "claimed"), exist_ok=True)
+        os.makedirs(os.path.join(self.spool, "out"), exist_ok=True)
+        self._procs: List[subprocess.Popen] = []
+        self._env = env
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        env = dict(os.environ)
+        env.update(self._env or {})
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        for i in range(self.num_workers):
+            self._procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "blaze_tpu.runtime.cluster",
+                     self.spool],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE,
+                )
+            )
+
+    def stop(self) -> None:
+        open(os.path.join(self.spool, "SHUTDOWN"), "w").close()
+        for p in self._procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs.clear()
+
+    # ------------------------------------------------------------------
+    def run_tasks(self, task_blobs: Sequence[bytes],
+                  timeout: float = 300.0) -> List[pa.Table]:
+        """Submit serialized TaskDefinitions; wait for per-task results
+        (tables decoded from segmented IPC)."""
+        from blaze_tpu.io.ipc import decode_ipc_parts
+
+        ids = []
+        for blob in task_blobs:
+            tid = uuid.uuid4().hex
+            tmp = os.path.join(self.spool, "tasks", f".{tid}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self.spool, "tasks", tid))
+            ids.append(tid)
+        deadline = time.time() + timeout
+        tables: List[Optional[pa.Table]] = [None] * len(ids)
+        pending = set(range(len(ids)))
+        while pending:
+            if time.time() > deadline:
+                raise TimeoutError(f"tasks incomplete: {pending}")
+            for i in list(pending):
+                done = os.path.join(self.spool, "out", ids[i] + ".done")
+                err = os.path.join(self.spool, "out", ids[i] + ".err")
+                if os.path.exists(err):
+                    with open(err) as f:
+                        raise RuntimeError(
+                            f"worker task failed: {f.read()}"
+                        )
+                if os.path.exists(done):
+                    with open(
+                        os.path.join(self.spool, "out", ids[i] + ".ipc"),
+                        "rb",
+                    ) as f:
+                        batches = list(decode_ipc_parts(f.read()))
+                    tables[i] = (
+                        pa.Table.from_batches(batches)
+                        if batches else pa.table({})
+                    )
+                    pending.discard(i)
+            time.sleep(0.05)
+        return tables  # type: ignore[return-value]
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# worker loop (runs in its own interpreter/JAX runtime)
+# ---------------------------------------------------------------------------
+
+def worker_main(spool: str) -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from blaze_tpu.io.ipc import encode_ipc_segment
+    from blaze_tpu.runtime.executor import execute_task
+
+    tasks_dir = os.path.join(spool, "tasks")
+    claimed_dir = os.path.join(spool, "claimed")
+    out_dir = os.path.join(spool, "out")
+    while not os.path.exists(os.path.join(spool, "SHUTDOWN")):
+        claimed = None
+        for name in sorted(os.listdir(tasks_dir)):
+            if name.startswith("."):
+                continue
+            src = os.path.join(tasks_dir, name)
+            dst = os.path.join(claimed_dir, name)
+            try:
+                os.replace(src, dst)  # atomic claim
+                claimed = (name, dst)
+                break
+            except FileNotFoundError:
+                continue  # another worker won the race
+        if claimed is None:
+            time.sleep(0.05)
+            continue
+        name, path = claimed
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            parts = bytearray()
+            for rb in execute_task(blob):
+                parts += encode_ipc_segment(rb)
+            with open(os.path.join(out_dir, name + ".ipc"), "wb") as f:
+                f.write(bytes(parts))
+            open(os.path.join(out_dir, name + ".done"), "w").close()
+        except Exception as e:  # report back to the driver
+            import traceback
+
+            with open(os.path.join(out_dir, name + ".err"), "w") as f:
+                f.write(f"{e}\n{traceback.format_exc()}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(worker_main(sys.argv[1]))

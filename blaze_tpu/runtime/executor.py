@@ -43,10 +43,12 @@ def execute_task(task_bytes: bytes,
     (the FFI-equivalent boundary, exec.rs:205-255)."""
     from blaze_tpu.plan.serde import task_from_proto
 
-    op, partition, task_id = task_from_proto(task_bytes)
+    op, partition, task_id, resources = task_from_proto(task_bytes)
     ctx = ctx or ExecContext()
     ctx.partition_id = partition
     ctx.task_id = task_id
+    for rid, provider in resources.items():
+        ctx.resources.setdefault(rid, provider)
     yield from execute_partition(op, partition, ctx)
 
 
