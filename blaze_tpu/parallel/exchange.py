@@ -140,6 +140,49 @@ class ShuffleExchangeExec(PhysicalOp):
                 yield ColumnBatch.from_arrow(rb)
 
 
+class ClusterShuffleExchangeExec(ShuffleExchangeExec):
+    """ShuffleExchange whose map stage runs on a MiniCluster: map tasks
+    ship as serialized TaskDefinitions to worker processes (the Spark-
+    driver role for multi-host runs); the reduce side reads the same
+    .data/.index files. The child subtree must be serializable
+    (plan/serde surface)."""
+
+    def __init__(self, child: PhysicalOp, keys, num_partitions: int,
+                 cluster, mode: str = "hash",
+                 shuffle_dir: Optional[str] = None):
+        super().__init__(child, keys, num_partitions, mode, shuffle_dir)
+        self.cluster = cluster
+
+    def _run_map_stage(self, ctx: ExecContext):
+        with self._lock:
+            if self._map_outputs is not None:
+                return self._map_outputs
+            from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
+            from blaze_tpu.plan.serde import task_to_proto
+
+            child = self.children[0]
+            d = self.shuffle_dir or tempfile.mkdtemp(
+                prefix="blz-cshuffle-"
+            )
+            os.makedirs(d, exist_ok=True)
+            tasks = []
+            outputs = []
+            for map_id in range(child.partition_count):
+                data = os.path.join(d, f"cm{map_id}.data")
+                index = os.path.join(d, f"cm{map_id}.index")
+                outputs.append((data, index))
+                plan = ShuffleWriterExec(
+                    child, self.keys, self.num_partitions, data, index,
+                    self.mode,
+                )
+                tasks.append(
+                    task_to_proto(plan, map_id, f"map-{map_id}")
+                )
+            self.cluster.run_tasks(tasks)
+            self._map_outputs = outputs
+            return outputs
+
+
 class CoalescedShuffleReader(PhysicalOp):
     """AQE-style reader over a ShuffleExchange: each output partition maps
     to a (reduce-range, map-range) spec (reference CustomShuffleReaderExec
