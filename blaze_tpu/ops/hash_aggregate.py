@@ -72,6 +72,19 @@ class NamedAgg:
     name: str
 
 
+def _decimal_avg(sum_unscaled, safe_count):
+    """Decimal AVG at scale+4 with Spark's HALF_UP rounding (away from
+    zero at .5), computed on floor-division remainders so both signs round
+    correctly. The *10^4 pre-scale bounds |sum| < ~9.2e14 (i64 headroom);
+    beyond that needs 128-bit state (ROADMAP)."""
+    num = sum_unscaled.astype(jnp.int64) * 10000
+    q = num // safe_count
+    r = num - q * safe_count  # 0 <= r < count (floor semantics)
+    half_up = jnp.where(num >= 0, 2 * r >= safe_count,
+                        2 * r > safe_count)
+    return q + half_up.astype(jnp.int64)
+
+
 def _state_fields(agg: AggExpr, name: str, in_schema: Schema) -> List[Field]:
     fn = agg.fn
     if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
@@ -339,6 +352,11 @@ class HashAggregateExec(PhysicalOp):
                             jnp.where(m, jnp.int8(1), jnp.int8(0))
                         )
                     priority.append(_null_last_key(v, m))
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        # NaN encodes as +inf for ordering; this extra
+                        # component keeps the NaN run adjacent but
+                        # SEPARATE from a real +inf run
+                        priority.append(jnp.isnan(v).astype(jnp.int8))
                 # jnp.lexsort: last key is the primary -> reverse
                 order = jnp.lexsort(tuple(reversed(priority)))
                 idx = order
@@ -349,9 +367,22 @@ class HashAggregateExec(PhysicalOp):
                 )
                 diff = jnp.zeros(capacity, dtype=jnp.bool_)
                 for v, m in keys_cv:
-                    sv = jnp.take(v, idx)
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        # group NaN with NaN (Spark normalizes NaN keys);
+                        # the isnan flag separates it from real +inf
+                        nanf = jnp.take(
+                            jnp.isnan(v).astype(jnp.int8), idx
+                        )
+                        sv = jnp.take(
+                            jnp.where(jnp.isnan(v), jnp.inf, v), idx
+                        )
+                        nanp = jnp.concatenate([nanf[:1], nanf[:-1]])
+                        extra = nanf != nanp
+                    else:
+                        sv = jnp.take(v, idx)
+                        extra = jnp.zeros(capacity, dtype=jnp.bool_)
                     svp = jnp.concatenate([sv[:1], sv[:-1]])
-                    neq = sv != svp
+                    neq = (sv != svp) | extra
                     if m is not None:
                         sm = jnp.take(m, idx)
                         smp = jnp.concatenate([sm[:1], sm[:-1]])
@@ -446,8 +477,7 @@ class HashAggregateExec(PhysicalOp):
                 return [(s, any_v), (cnt, None)]
             safe = jnp.maximum(cnt, 1)
             if st.id is TypeId.DECIMAL:
-                avg = jnp.asarray(s, jnp.int64) * 10000 // safe  # scale+4
-                return [(avg, any_v)]
+                return [(_decimal_avg(s, safe), any_v)]  # scale+4
             return [(s / safe.astype(jnp.float64), any_v)]
         if fn in (AggFn.MIN, AggFn.MAX):
             phys = cv.dtype
@@ -539,8 +569,7 @@ class HashAggregateExec(PhysicalOp):
                 and a.child.dtype.id is TypeId.DECIMAL
             )
             if state_is_decimal:
-                avg = s * 10000 // safe  # rescale to scale+4
-                return [(avg, any_v)]
+                return [(_decimal_avg(s, safe), any_v)]  # scale+4
             return [(s.astype(jnp.float64)
                      / safe.astype(jnp.float64), any_v)]
         if fn in (AggFn.FIRST, AggFn.LAST):

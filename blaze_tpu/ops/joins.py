@@ -294,9 +294,23 @@ class HashJoinExec(PhysicalOp):
     (broadcast relation), the RIGHT child streams (reference
     from_proto.rs:349-428 PartitionMode::CollectLeft)."""
 
+    # join types whose OUTPUT depends on build-side matched state - that
+    # state is global across probe partitions, so these cannot emit
+    # per-partition (Spark restricts broadcast-side outer joins the same
+    # way); execute() funnels them through partition 0 over all probe
+    # partitions
+    _BUILD_EMITTING = frozenset(
+        {JoinType.LEFT, JoinType.FULL, JoinType.LEFT_SEMI,
+         JoinType.LEFT_ANTI, JoinType.LEFT_ANTI_NULL_AWARE}
+    )
+
     def __init__(self, left: PhysicalOp, right: PhysicalOp,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  join_type: JoinType = JoinType.INNER):
+        if join_type is JoinType.LEFT_ANTI_NULL_AWARE:
+            raise NotImplementedError(
+                "null-aware anti join runs through SortMergeJoinExec"
+            )
         self.children = [left, right]
         self.left_keys = [left.schema.index_of(k) for k in left_keys]
         self.right_keys = [right.schema.index_of(k) for k in right_keys]
@@ -304,6 +318,10 @@ class HashJoinExec(PhysicalOp):
         self._schema = _joined_schema(
             left.schema, right.schema, join_type
         )
+        self._build: Optional[ColumnBatch] = None
+        import threading
+
+        self._build_lock = threading.Lock()
 
     @property
     def schema(self) -> Schema:
@@ -313,42 +331,62 @@ class HashJoinExec(PhysicalOp):
     def partition_count(self) -> int:
         return self.children[1].partition_count
 
+    def _collect_build(self, ctx: ExecContext) -> ColumnBatch:
+        """Collect the build relation ONCE and share it across probe
+        partitions (reference CollectLeft collects one shared build)."""
+        with self._build_lock:
+            if self._build is None:
+                left = self.children[0]
+                if getattr(left, "is_broadcast", False):
+                    # a broadcast child replays the FULL relation from any
+                    # one partition; collecting all would duplicate rows
+                    batches = list(left.execute(0, ctx))
+                else:
+                    batches = [
+                        b
+                        for p in range(left.partition_count)
+                        for b in left.execute(p, ctx)
+                    ]
+                self._build = concat_batches(
+                    batches, schema=left.schema
+                )
+            return self._build
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         left, right = self.children
         jt = self.join_type
-        # a broadcast child already replays the FULL relation from any one
-        # partition; collecting every partition would duplicate build rows
-        if getattr(left, "is_broadcast", False):
-            build_batches = list(left.execute(0, ctx))
+        if jt in self._BUILD_EMITTING:
+            # global build-matched state: all probe partitions drain
+            # through partition 0, other partitions are empty
+            if partition != 0:
+                return
+            probe_parts = range(right.partition_count)
         else:
-            build_batches = [
-                b
-                for p in range(left.partition_count)
-                for b in left.execute(p, ctx)
-            ]
-        build = concat_batches(build_batches, schema=left.schema)
+            probe_parts = (partition,)
+        build = self._collect_build(ctx)
         core = _JoinCore(build, self.left_keys)
         emit_pairs = jt in (
             JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL
         )
-        for pb in right.execute(partition, ctx):
-            (pb, pair_b, pair_p, valid, pair_cap,
-             matched_p) = core.probe(pb, self.right_keys)
-            if emit_pairs:
-                lcols = _gather_side(build.columns, pair_b, None)
-                rcols = _gather_side(pb.columns, pair_p, None)
-                yield ColumnBatch(
-                    self._schema, lcols + rcols, pair_cap, valid
-                )
-            if jt in (JoinType.RIGHT, JoinType.FULL):
-                un = row_mask(pb.num_rows, pb.capacity) & ~matched_p
-                lnull = _null_side(left.schema.fields, pb.capacity)
-                yield ColumnBatch(
-                    self._schema, lnull + list(pb.columns),
-                    pb.num_rows, un,
-                )
-        # build-side epilogue
+        for pp in probe_parts:
+            for pb in right.execute(pp, ctx):
+                (pb, pair_b, pair_p, valid, pair_cap,
+                 matched_p) = core.probe(pb, self.right_keys)
+                if emit_pairs:
+                    lcols = _gather_side(build.columns, pair_b, None)
+                    rcols = _gather_side(pb.columns, pair_p, None)
+                    yield ColumnBatch(
+                        self._schema, lcols + rcols, pair_cap, valid
+                    )
+                if jt in (JoinType.RIGHT, JoinType.FULL):
+                    un = row_mask(pb.num_rows, pb.capacity) & ~matched_p
+                    lnull = _null_side(left.schema.fields, pb.capacity)
+                    yield ColumnBatch(
+                        self._schema, lnull + list(pb.columns),
+                        pb.num_rows, un,
+                    )
+        # build-side epilogue (partition 0 only; it saw every probe row)
         live_b = row_mask(build.num_rows, build.capacity)
         if jt in (JoinType.LEFT, JoinType.FULL):
             un = live_b & ~core.matched_build

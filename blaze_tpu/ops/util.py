@@ -132,7 +132,6 @@ def concat_batches(batches: List[ColumnBatch],
     total = sum(b.num_rows for b in batches)
     cap = get_config().bucket_for(total)
     ncols = len(schema)
-    lengths = tuple(b.num_rows for b in batches)
     any_mask = [
         any(b.columns[ci].validity is not None for b in batches)
         for ci in range(ncols)
@@ -150,6 +149,9 @@ def concat_batches(batches: List[ColumnBatch],
         else None
         for ci in range(ncols)
     ]
+    lengths = jnp.asarray(
+        np.array([b.num_rows for b in batches], dtype=np.int32)
+    )
     vs, ms = _concat_many(
         values_in, masks_in, lengths, cap, tuple(any_mask)
     )
@@ -163,30 +165,38 @@ def concat_batches(batches: List[ColumnBatch],
     return ColumnBatch(schema, cols, total)
 
 
-@partial(jax.jit, static_argnames=("lengths", "cap", "any_mask"))
+@partial(jax.jit, static_argnames=("cap", "any_mask"))
 def _concat_many(values_in, masks_in, lengths, cap: int, any_mask):
-    """Concatenate all columns of all batches in one dispatch."""
-    total = sum(lengths)
-    pad = cap - total
+    """Concatenate all columns of all batches in one dispatch.
+
+    Row counts (`lengths`) stay TRACED: a filter upstream makes them
+    data-dependent, and baking them in statically would recompile this
+    program for every distinct combination. Instead each part scatters its
+    live rows to a dynamic offset (dead/pad rows land in a dump slot), so
+    one compile covers every batch mix with the same shapes/layout."""
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32),
+         jnp.cumsum(lengths)[:-1].astype(jnp.int32)]
+    )
     vs = []
     ms = []
     for ci, parts in enumerate(values_in):
-        pieces = [p[:n] for p, n in zip(parts, lengths)]
-        if pad:
-            pieces.append(jnp.zeros(pad, dtype=parts[0].dtype))
-        vs.append(jnp.concatenate(pieces))
-        if any_mask[ci]:
-            mparts = []
-            for mp, n in zip(masks_in[ci], lengths):
-                mparts.append(
-                    mp[:n] if mp is not None
-                    else jnp.ones(n, dtype=jnp.bool_)
+        out = jnp.zeros(cap + 1, dtype=parts[0].dtype)
+        mout = jnp.zeros(cap + 1, dtype=jnp.bool_)
+        for i, p in enumerate(parts):
+            pos = jnp.arange(p.shape[0], dtype=jnp.int32)
+            keep = pos < lengths[i]
+            tgt = jnp.where(keep, offsets[i] + pos, cap)
+            out = out.at[tgt].set(p, mode="drop")
+            if any_mask[ci]:
+                mp = masks_in[ci][i]
+                mv = (
+                    mp if mp is not None
+                    else jnp.ones(p.shape[0], dtype=jnp.bool_)
                 )
-            if pad:
-                mparts.append(jnp.zeros(pad, dtype=jnp.bool_))
-            ms.append(jnp.concatenate(mparts))
-        else:
-            ms.append(None)
+                mout = mout.at[tgt].set(mv, mode="drop")
+        vs.append(out[:cap])
+        ms.append(mout[:cap] if any_mask[ci] else None)
     return vs, ms
 
 
@@ -259,4 +269,7 @@ def _invert_order(v: jax.Array) -> jax.Array:
         return -v
     if v.dtype == jnp.bool_:
         return ~v
-    return -v.astype(jnp.int64) if v.dtype != jnp.int64 else -v
+    # bitwise NOT (-v - 1) is an order-reversing bijection on two's-
+    # complement ints with no overflow: plain negation maps INT64_MIN to
+    # itself and would sort it first in a descending sort
+    return jnp.bitwise_not(v.astype(jnp.int64))

@@ -137,8 +137,17 @@ class WindowExec(PhysicalOp):
                 b = jnp.zeros(cap, dtype=jnp.bool_)
                 for e in exprs:
                     v, m = ev.evaluate(e)
+                    if jnp.issubdtype(v.dtype, jnp.floating):
+                        # NaN partitions/runs group together (Spark
+                        # normalizes NaN), distinct from real +inf
+                        nan = jnp.isnan(v)
+                        nanp = jnp.concatenate([nan[:1], nan[:-1]])
+                        v = jnp.where(nan, jnp.inf, v)
+                        extra = nan != nanp
+                    else:
+                        extra = jnp.zeros(cap, dtype=jnp.bool_)
                     prev = jnp.concatenate([v[:1], v[:-1]])
-                    neq = v != prev
+                    neq = (v != prev) | extra
                     if m is not None:
                         pm = jnp.concatenate([m[:1], m[:-1]])
                         neq = jnp.where(m & pm, neq, m != pm)

@@ -103,7 +103,12 @@ class DistributedGroupBy:
             Returns (sorted key cols at boundaries, reduced states,
             n_groups, live_groups mask)."""
             pri = [jnp.where(live, 0, 1).astype(jnp.int8)]
-            pri += [k for k in key_vals]
+            for k in key_vals:
+                if jnp.issubdtype(k.dtype, jnp.floating):
+                    pri.append(jnp.where(jnp.isnan(k), jnp.inf, k))
+                    pri.append(jnp.isnan(k).astype(jnp.int8))
+                else:
+                    pri.append(k)
             order = jnp.lexsort(tuple(reversed(pri)))
             s_live = jnp.take(live, order)
             diff = jnp.zeros(cap, dtype=jnp.bool_)
@@ -111,7 +116,17 @@ class DistributedGroupBy:
             for k in key_vals:
                 sk = jnp.take(k, order)
                 s_keys.append(sk)
-                diff = diff | (sk != jnp.concatenate([sk[:1], sk[:-1]]))
+                if jnp.issubdtype(k.dtype, jnp.floating):
+                    # NaN groups with NaN, distinct from real +inf
+                    nf = jnp.take(jnp.isnan(k).astype(jnp.int8), order)
+                    cv = jnp.where(jnp.isnan(sk), jnp.inf, sk)
+                    diff = diff | (
+                        cv != jnp.concatenate([cv[:1], cv[:-1]])
+                    ) | (nf != jnp.concatenate([nf[:1], nf[:-1]]))
+                else:
+                    diff = diff | (
+                        sk != jnp.concatenate([sk[:1], sk[:-1]])
+                    )
             first = s_live & ~jnp.concatenate(
                 [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
             )
@@ -164,7 +179,12 @@ class DistributedGroupBy:
             """Final merge: same grouping, states combine by their merge op
             (sum for SUM/COUNT/AVG parts, min/max for MIN/MAX)."""
             pri = [jnp.where(live, 0, 1).astype(jnp.int8)]
-            pri += [k for k in key_vals]
+            for k in key_vals:
+                if jnp.issubdtype(k.dtype, jnp.floating):
+                    pri.append(jnp.where(jnp.isnan(k), jnp.inf, k))
+                    pri.append(jnp.isnan(k).astype(jnp.int8))
+                else:
+                    pri.append(k)
             order = jnp.lexsort(tuple(reversed(pri)))
             s_live = jnp.take(live, order)
             diff = jnp.zeros(cap, dtype=jnp.bool_)
@@ -172,7 +192,17 @@ class DistributedGroupBy:
             for k in key_vals:
                 sk = jnp.take(k, order)
                 s_keys.append(sk)
-                diff = diff | (sk != jnp.concatenate([sk[:1], sk[:-1]]))
+                if jnp.issubdtype(k.dtype, jnp.floating):
+                    # NaN groups with NaN, distinct from real +inf
+                    nf = jnp.take(jnp.isnan(k).astype(jnp.int8), order)
+                    cv = jnp.where(jnp.isnan(sk), jnp.inf, sk)
+                    diff = diff | (
+                        cv != jnp.concatenate([cv[:1], cv[:-1]])
+                    ) | (nf != jnp.concatenate([nf[:1], nf[:-1]]))
+                else:
+                    diff = diff | (
+                        sk != jnp.concatenate([sk[:1], sk[:-1]])
+                    )
             first = s_live & ~jnp.concatenate(
                 [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
             )

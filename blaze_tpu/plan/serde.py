@@ -345,7 +345,7 @@ def plan_from_proto(p: pb.PlanProto) -> PhysicalOp:
                 )
                 for k in p.sort.keys
             ],
-            fetch=p.sort.fetch or None,
+            fetch=None if p.sort.fetch < 0 else p.sort.fetch,
         )
     if kind == "union":
         return UnionExec([plan_from_proto(i) for i in p.union.inputs])
@@ -457,8 +457,7 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
                 expr=expr_to_proto(k.expr), ascending=k.ascending,
                 nulls_first=k.nulls_first,
             )
-        if op.fetch:
-            p.sort.fetch = op.fetch
+        p.sort.fetch = op.fetch if op.fetch is not None else -1
     elif isinstance(op, UnionExec):
         for c in op.children:
             p.union.inputs.add().CopyFrom(plan_to_proto(c))

@@ -1,0 +1,159 @@
+"""Regression tests for the round-1 code-review findings."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    ExecContext,
+    HashAggregateExec,
+    HashJoinExec,
+    JoinType,
+    MemoryScanExec,
+    SortExec,
+    SortKey,
+)
+
+
+def collect(op, partitions=None):
+    ctx = ExecContext()
+    rows = []
+    for p in partitions or range(op.partition_count):
+        for b in op.execute(p, ctx):
+            arr = b.to_arrow()
+            rows += list(
+                zip(*[arr.column(i).to_pylist()
+                      for i in range(arr.num_columns)])
+            )
+    return rows
+
+
+def test_hash_join_build_epilogue_multi_partition_probe():
+    """Finding 1: build-side-emitting join types over a MULTI-partition
+    probe must emit each build verdict exactly once."""
+    build = MemoryScanExec.from_batches(
+        [ColumnBatch.from_pydict({"a": [1, 2, 9], "x": [10, 20, 90]})]
+    )
+    probe = MemoryScanExec(
+        [
+            [ColumnBatch.from_pydict({"b": [1], "y": [100]})],
+            [ColumnBatch.from_pydict({"b": [2], "y": [200]})],
+        ],
+        ColumnBatch.from_pydict({"b": [1], "y": [1]}).schema,
+    )
+    left = HashJoinExec(build, probe, ["a"], ["b"], JoinType.LEFT)
+    rows = sorted(collect(left), key=lambda r: (r[0],))
+    # 1 and 2 matched (one row each), 9 unmatched exactly ONCE
+    assert rows == [
+        (1, 10, 1, 100), (2, 20, 2, 200), (9, 90, None, None),
+    ]
+    anti = HashJoinExec(build, probe, ["a"], ["b"], JoinType.LEFT_ANTI)
+    assert sorted(collect(anti)) == [(9, 90)]
+    semi = HashJoinExec(build, probe, ["a"], ["b"], JoinType.LEFT_SEMI)
+    assert sorted(collect(semi)) == [(1, 10), (2, 20)]
+
+
+def test_nan_group_keys():
+    """Finding 2: NaN keys form ONE group, distinct from +inf."""
+    nan, inf = float("nan"), float("inf")
+    cb = ColumnBatch.from_pydict(
+        {"k": [inf, nan, inf, nan, 1.0], "v": [1, 2, 3, 4, 5]}
+    )
+    op = HashAggregateExec(
+        MemoryScanExec.from_batches([cb]),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    rows = collect(op)
+    assert len(rows) == 3
+    by_kind = {}
+    for k, s in rows:
+        kind = "nan" if k != k else ("inf" if k == inf else "one")
+        by_kind[kind] = s
+    assert by_kind == {"nan": 6, "inf": 4, "one": 5}
+
+
+def test_nan_window_partitions():
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    nan = float("nan")
+    cb = ColumnBatch.from_pydict(
+        {"k": [nan, 1.0, nan], "v": [1, 2, 3]}
+    )
+    op = WindowExec(
+        MemoryScanExec.from_batches([cb]),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("v"))],
+        functions=[WindowFn("count", Col("v"), "c")],
+    )
+    rows = collect(op)
+    nan_counts = [c for k, v, c in rows if k != k]
+    assert nan_counts == [2, 2]  # one NaN partition of two rows
+
+
+def test_decimal_avg_half_up():
+    """Finding 3: decimal AVG rounds HALF_UP, both signs."""
+    def run(vals):
+        arr = pa.array(
+            [Decimal(v) for v in vals], type=pa.decimal128(10, 0)
+        )
+        cb = ColumnBatch.from_arrow(
+            pa.RecordBatch.from_arrays([arr], names=["d"])
+        )
+        op = HashAggregateExec(
+            MemoryScanExec.from_batches([cb]),
+            keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("d")), "a")],
+            mode=AggMode.COMPLETE,
+        )
+        (row,) = collect(op)
+        return row[0]
+
+    # 2/3 = 0.66666... -> 0.6667 at scale+4 (HALF_UP)
+    assert run(["1", "1"]) == Decimal("1.0000")
+    assert run(["1", "1", "0"]) == Decimal("0.6667")
+    assert run(["-1", "-1", "0"]) == Decimal("-0.6667")
+    assert run(["1", "0"]) == Decimal("0.5000")
+    # exact .5 in the 4th place: 1/8 = 0.125 stays exact at scale 4
+    assert run(["1", "0", "0", "0", "0", "0", "0", "0"]) == Decimal(
+        "0.1250"
+    )
+
+
+def test_int64_min_descending_sort():
+    """Finding 4: INT64_MIN must sort LAST descending."""
+    vals = [0, -(2**63), 5, -7]
+    cb = ColumnBatch.from_pydict({"a": vals})
+    op = SortExec(
+        MemoryScanExec.from_batches([cb]),
+        [SortKey(Col("a"), ascending=False)],
+    )
+    got = [r[0] for r in collect(op)]
+    assert got == [5, 0, -7, -(2**63)]
+
+
+def test_sort_fetch_zero_roundtrip():
+    """Finding 7: fetch=0 must survive the proto boundary."""
+    from blaze_tpu.ops import IpcReaderExec, IpcReadMode, collect_ipc
+    from blaze_tpu.plan.serde import plan_from_proto, plan_to_proto
+
+    cb = ColumnBatch.from_pydict({"a": [3, 1, 2]})
+    ctx = ExecContext()
+    parts = collect_ipc(MemoryScanExec.from_batches([cb]), ctx)
+    reader = IpcReaderExec("z", cb.schema, 1, IpcReadMode.CHANNEL)
+    plan = SortExec(reader, [SortKey(Col("a"))], fetch=0)
+    rt = plan_from_proto(plan_to_proto(plan))
+    assert rt.fetch == 0
+    ctx.resources["z"] = [parts]
+    assert list(rt.execute(0, ctx)) == [] or all(
+        b.num_rows == 0 for b in rt.execute(0, ctx)
+    )
+    # and None still round-trips as None
+    plan2 = SortExec(reader, [SortKey(Col("a"))])
+    assert plan_from_proto(plan_to_proto(plan2)).fetch is None
