@@ -203,6 +203,9 @@ class ColumnBatch:
                     vmask[:n] = ~null_np
                 validity = jnp.asarray(vmask)
             cols.append(Column(dt, jnp.asarray(padded), validity, dictionary))
+        from blaze_tpu.runtime import dispatch as _dispatch
+
+        _dispatch.record("h2d_batches")
         return ColumnBatch(schema, cols, n)
 
     def live_mask(self) -> jax.Array:
@@ -218,8 +221,13 @@ class ColumnBatch:
         D2H) instead of per-column fetches."""
         import pyarrow as pa
 
+        from blaze_tpu.runtime import dispatch as _dispatch
+
         device_bufs = [self.selection] + self.device_buffers()
-        host_bufs = jax.device_get(device_bufs)
+        if any(isinstance(b, jax.Array) for b in device_bufs):
+            host_bufs = _dispatch.device_get(device_bufs)
+        else:
+            host_bufs = device_bufs  # already host-resident (numpy)
         host_sel, host_iter = host_bufs[0], iter(host_bufs[1:])
         host_cols = []
         for c in self.columns:

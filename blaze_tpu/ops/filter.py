@@ -22,13 +22,13 @@ from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.host_lower import lower_strings_host
 from blaze_tpu.ops.project import _unflatten_cvs
+from blaze_tpu.runtime.dispatch import cached_kernel
 
 
 class FilterExec(PhysicalOp):
     def __init__(self, child: PhysicalOp, predicate: ir.Expr):
         self.children = [child]
         self.predicate = bind_opt(predicate, child.schema)
-        self._jit_cache = {}
 
     @property
     def schema(self) -> Schema:
@@ -42,13 +42,12 @@ class FilterExec(PhysicalOp):
     def _filter(self, cb: ColumnBatch) -> ColumnBatch:
         exprs, _, aug = lower_strings_host([self.predicate], cb)
         pred = exprs[0]
-        key = (pred, aug.layout())
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            in_schema = aug.schema
-            cap = aug.capacity
+        in_schema = aug.schema
+        cap = aug.capacity
+        layout = aug.layout()
 
-            def run(bufs, sel, layout=aug.layout()):
+        def build():
+            def run(bufs, sel):
                 cols = _unflatten_cvs(layout, bufs)
                 ev = DeviceEvaluator(in_schema, cols, cap)
                 keep = ev.evaluate_predicate(pred)
@@ -56,7 +55,8 @@ class FilterExec(PhysicalOp):
                     keep = keep & sel
                 return keep
 
-            fn = jax.jit(run)
-            self._jit_cache[key] = fn
+            return run
+
+        fn = cached_kernel(("filter", pred, layout), build)
         sel = fn(aug.device_buffers(), aug.selection)
         return ColumnBatch(cb.schema, cb.columns, cb.num_rows, sel)

@@ -1,5 +1,4 @@
-"""Pipeline fusion: collapse Filter/Project/Rename chains into ONE jitted
-XLA program per batch.
+"""Pipeline fusion: collapse operator chains into ONE device program.
 
 SURVEY 7 design stance: "operators are pure functions composed and jit'd
 per (plan-fingerprint, batch-shape-bucket)". Unfused, each operator in a
@@ -11,26 +10,40 @@ FusedPipelineExec whose whole chain traces into a single program; the
 deferred selection vector (batch.ColumnBatch.selection) carries filter
 results through without any host sync.
 
+Aggregate folding goes further (the reference's one-native-call-per-task
+model, exec.rs:196-255): a PARTIAL aggregate fuses into the producing
+chain (FusedAggregateExec - one dispatch per input batch), and a COMPLETE
+aggregate is rewritten as device-PARTIAL + host-FINAL: the per-batch heavy
+reduction happens on device inside the fused program, its tiny
+grouped-state output comes back in ONE batched D2H, and finalization
+(AVG division, variance, multi-batch merge) runs in numpy on the host -
+zero additional device round trips. Per single-batch aggregation query the
+device cost is exactly 1 dispatch + 1 fetch.
+
 Stages whose expressions need the host string tier are left unfused (the
 per-op path handles their per-batch host lowering).
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from blaze_tpu.types import Schema
+from blaze_tpu.types import DataType, Schema, TypeId
 from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import AggExpr, AggFn
 from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.filter import FilterExec
 from blaze_tpu.ops.project import ProjectExec, _unflatten_cvs
 from blaze_tpu.ops.rename import RenameColumnsExec
+from blaze_tpu.runtime.dispatch import cached_kernel, device_get
 
 
 def _expr_needs_host(e: ir.Expr, schema: Schema) -> bool:
@@ -62,14 +75,28 @@ def _stage_fusable(op: PhysicalOp) -> bool:
     return False
 
 
+def _stage_key(st: PhysicalOp) -> Tuple:
+    """Structural descriptor of one fused stage (global kernel-cache key
+    component; two plans with equal descriptors trace identically)."""
+    if isinstance(st, FilterExec):
+        return ("F", st.predicate)
+    if isinstance(st, ProjectExec):
+        return ("P", tuple(e for e, _ in st.exprs))
+    return ("R",)
+
+
 class FusedPipelineExec(PhysicalOp):
-    """A chain of stateless stages compiled as one device program."""
+    """A chain of stateless stages compiled as one device program.
+
+    An empty stage list is allowed (identity pipeline) - used when an
+    aggregate fuses directly over a non-chain child such as a join."""
 
     def __init__(self, leaf: PhysicalOp, stages: Sequence[PhysicalOp]):
         self.children = [leaf]
         self.stages = list(stages)  # bottom-up; stage i's child is i-1
-        self._schema = self.stages[-1].schema
-        self._jit_cache = {}
+        self._schema = (
+            self.stages[-1].schema if self.stages else leaf.schema
+        )
 
     @property
     def schema(self) -> Schema:
@@ -79,16 +106,19 @@ class FusedPipelineExec(PhysicalOp):
         inner = " -> ".join(type(s).__name__ for s in self.stages)
         return f"FusedPipelineExec[{inner}]"
 
+    def structure_key(self) -> Tuple:
+        return tuple(_stage_key(s) for s in self.stages)
+
     def execute(self, partition: int, ctx: ExecContext):
         for cb in self.children[0].execute(partition, ctx):
             yield self._run(cb)
 
     def _run(self, cb: ColumnBatch) -> ColumnBatch:
-        key = cb.layout()
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            fn = jax.jit(self._build_kernel(cb.layout()))
-            self._jit_cache[key] = fn
+        layout = cb.layout()
+        fn = cached_kernel(
+            ("fusedpipe", self.structure_key(), layout),
+            lambda: self._build_kernel(layout),
+        )
         out_bufs, sel = fn(cb.device_buffers(), cb.selection)
         # dictionaries for passthrough string columns
         dicts = self._out_dictionaries(cb)
@@ -152,15 +182,17 @@ class FusedAggregateExec(PhysicalOp):
 
     Each input batch flows scan -> filter/project stages -> sort-based
     partial aggregation without leaving the device or re-dispatching:
-    stage evaluation and the aggregate kernel trace into a single jit
-    (ROADMAP: dispatch-count reduction beyond chain fusion)."""
+    stage evaluation and the aggregate kernel trace into a single jit.
+    The grouped partial state (at most one row per distinct group in the
+    batch - small) is fetched in ONE batched D2H together with the group
+    count, so downstream consumers (host finalization, shuffle IPC
+    encode) start from host-resident buffers with no further syncs."""
 
     def __init__(self, pipeline: FusedPipelineExec, agg):
         self.children = [pipeline.children[0]]
         self.pipeline = pipeline
         self.agg = agg
         self._schema = agg.schema
-        self._jit_cache = {}
 
     @property
     def schema(self) -> Schema:
@@ -170,23 +202,26 @@ class FusedAggregateExec(PhysicalOp):
         return f"FusedAggregateExec[{self.pipeline.describe()} -> partial]"
 
     def execute(self, partition: int, ctx: ExecContext):
-        from blaze_tpu.batch import Column, ColumnBatch
-
         for cb in self.children[0].execute(partition, ctx):
-            key = cb.layout()
-            fn = self._jit_cache.get(key)
-            if fn is None:
-                fn = jax.jit(self._build_kernel(cb.layout()))
-                self._jit_cache[key] = fn
+            layout = cb.layout()
+            fn = cached_kernel(
+                ("fusedagg", self.pipeline.structure_key(),
+                 tuple((e, n) for e, n in self.agg.keys),
+                 tuple((a.fn, a.child) for a, _ in self.agg.aggs),
+                 layout),
+                lambda: self._build_kernel(layout),
+            )
             outs, n_groups = fn(
                 cb.device_buffers(), cb.selection, cb.num_rows
             )
-            n = int(n_groups)
+            # one batched D2H for states + count (single round trip)
+            host_outs, host_n = device_get((outs, n_groups))
+            n = int(host_n)
             if n == 0:
                 continue
             cols = [
                 Column(f.dtype, v, m, None)
-                for f, (v, m) in zip(self._schema.fields, outs)
+                for f, (v, m) in zip(self._schema.fields, host_outs)
             ]
             yield ColumnBatch(self._schema, cols, n)
 
@@ -219,11 +254,159 @@ class FusedAggregateExec(PhysicalOp):
         return kernel
 
 
-def _agg_fusable(agg) -> bool:
-    from blaze_tpu.ops.hash_aggregate import AggMode
+class _IterChild(PhysicalOp):
+    """Single-partition child that replays pre-collected batches (feeds
+    the device-FINAL fallback of HostFinalAggExec)."""
 
-    if agg.mode is not AggMode.PARTIAL:
-        return False
+    def __init__(self, batches: List[ColumnBatch], schema: Schema):
+        self.children = []
+        self.batches = batches
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return 1
+
+    def execute(self, partition: int, ctx: ExecContext):
+        yield from self.batches
+
+
+class HostFinalAggExec(PhysicalOp):
+    """Finalize a stream of device-produced PARTIAL aggregate states on
+    the HOST - the other half of the COMPLETE-mode rewrite.
+
+    Rationale: after the fused device partial, the state is one row per
+    group per batch - orders of magnitude smaller than the input. When a
+    partition produced exactly ONE partial batch (the common case with
+    large shape buckets), groups are already unique, so finalization is a
+    pure vectorized numpy pass: no dispatch, no transfer (the states
+    arrived host-resident from FusedAggregateExec's batched fetch). With
+    multiple partial batches the proven device FINAL kernel merges them
+    (one extra dispatch). Mirrors the reference's partial/final split
+    (NativeHashAggregateExec.scala:98-161) with the final leg moved off
+    the critical dispatch path."""
+
+    def __init__(self, child: PhysicalOp, template):
+        # template: the original COMPLETE HashAggregateExec (carries the
+        # final schema, bound keys and agg fns)
+        self.children = [child]
+        self.template = template
+        self._schema = template.schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return "HostFinalAggExec"
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.ops.hash_aggregate import (
+            AggMode,
+            HashAggregateExec,
+            _SchemaStub,
+            _empty_global_row,
+        )
+
+        partials = [
+            cb for cb in self.children[0].execute(partition, ctx)
+            if cb.num_rows > 0
+        ]
+        if not partials:
+            if not self.template.keys:
+                yield _empty_global_row(self.template)
+            return
+        if len(partials) == 1:
+            yield self._finalize_host(partials[0])
+            return
+        partial_schema = self.children[0].schema
+        n_keys = len(self.template.keys)
+        final = HashAggregateExec(
+            _SchemaStub(partial_schema),
+            keys=[
+                (ir.BoundCol(i, partial_schema.fields[i].dtype), name)
+                for i, (_, name) in enumerate(self.template.keys)
+            ],
+            aggs=[(a, n) for a, n in self.template.aggs],
+            mode=AggMode.FINAL,
+        )
+        src = _IterChild(partials, partial_schema)
+        final.children = [src]
+        yield from final.execute(0, ctx)
+
+    # ------------------------------------------------------------------
+    def _finalize_host(self, cb: ColumnBatch) -> ColumnBatch:
+        """Vectorized numpy finalization of one unique-group state batch."""
+        from blaze_tpu.ops.hash_aggregate import _state_width
+
+        n = cb.num_rows
+        n_keys = len(self.template.keys)
+        host = [
+            (np.asarray(c.values),
+             np.asarray(c.validity) if c.validity is not None else None)
+            for c in cb.columns
+        ]
+        out_cols: List[Column] = []
+        for i in range(n_keys):
+            field = self._schema.fields[i]
+            v, m = host[i]
+            out_cols.append(
+                Column(field.dtype, v, m, cb.columns[i].dictionary)
+            )
+        pos = n_keys
+        for (a, name), field in zip(
+            self.template.aggs, self._schema.fields[n_keys:]
+        ):
+            w = _state_width(a)
+            states = host[pos: pos + w]
+            pos += w
+            out_cols.append(
+                Column(field.dtype, *self._finalize_agg(a, field, states))
+            )
+        return ColumnBatch(self._schema, out_cols, n)
+
+    @staticmethod
+    def _finalize_agg(a: AggExpr, field, states):
+        fn = a.fn
+        if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
+            return states[0][0], None
+        if fn in (AggFn.SUM, AggFn.MIN, AggFn.MAX, AggFn.FIRST,
+                  AggFn.LAST):
+            return states[0]
+        if fn is AggFn.AVG:
+            (s, sm), (c, _) = states
+            safe = np.maximum(c, 1)
+            valid = c > 0 if sm is None else (sm & (c > 0))
+            if field.dtype.id is TypeId.DECIMAL:
+                # scale+4 with Spark HALF_UP (mirror of _decimal_avg)
+                num = s.astype(np.int64) * 10000
+                q = num // safe
+                r = num - q * safe
+                half_up = np.where(num >= 0, 2 * r >= safe, 2 * r > safe)
+                return q + half_up.astype(np.int64), valid
+            return (
+                s.astype(np.float64) / safe.astype(np.float64), valid
+            )
+        # var/stddev family from (n, s1, s2) moments
+        (nv, _), (s1, _), (s2, _) = states
+        mean = s1 / np.maximum(nv, 1.0)
+        m2 = s2 - s1 * mean
+        pop = fn in (AggFn.VAR_POP, AggFn.STDDEV_POP)
+        denom = np.maximum(nv if pop else nv - 1.0, 1.0)
+        var = np.maximum(m2, 0.0) / denom
+        valid = nv > (0.0 if pop else 1.0)
+        out = var
+        if fn in (AggFn.STDDEV_SAMP, AggFn.STDDEV_POP):
+            out = np.sqrt(var)
+        return out, valid
+
+
+def _agg_exprs_fusable(agg) -> bool:
     child_schema = agg.children[0].schema
     exprs = [e for e, _ in agg.keys] + [
         a.child for a, _ in agg.aggs if a.child is not None
@@ -239,32 +422,9 @@ def _agg_fusable(agg) -> bool:
     return True
 
 
-def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
-    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
-    plus folding a streaming PARTIAL aggregate into the chain below it."""
-    from blaze_tpu.ops.hash_aggregate import HashAggregateExec
-
-    if (
-        isinstance(op, HashAggregateExec)
-        and len(op.children) == 1
-        and _agg_fusable(op)
-    ):
-        child = op.children[0]
-        chain: List[PhysicalOp] = []
-        t = child
-        while (
-            isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
-            and len(t.children) == 1
-            and _stage_fusable(t)
-        ):
-            chain.append(t)
-            t = t.children[0]
-        if chain:
-            pipeline = FusedPipelineExec(
-                fuse_pipelines(t), list(reversed(chain))
-            )
-            return FusedAggregateExec(pipeline, op)
-    chain = []
+def _collect_chain(op: PhysicalOp):
+    """Peel the maximal fusable stateless chain below `op`'s child."""
+    chain: List[PhysicalOp] = []
     t = op
     while (
         isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
@@ -273,6 +433,43 @@ def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
     ):
         chain.append(t)
         t = t.children[0]
+    return chain, t
+
+
+def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
+    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
+    folding PARTIAL aggregates into the chain below them, and rewriting
+    COMPLETE aggregates into device-PARTIAL + host-FINAL."""
+    from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
+
+    if (
+        isinstance(op, HashAggregateExec)
+        and len(op.children) == 1
+        and op.mode in (AggMode.PARTIAL, AggMode.COMPLETE)
+        and _agg_exprs_fusable(op)
+    ):
+        chain, leaf = _collect_chain(op.children[0])
+        if op.mode is AggMode.PARTIAL:
+            if chain:
+                pipeline = FusedPipelineExec(
+                    fuse_pipelines(leaf), list(reversed(chain))
+                )
+                return FusedAggregateExec(pipeline, op)
+            # no chain to fold - leave the plain streaming partial
+        else:  # COMPLETE -> fused device PARTIAL + host FINAL
+            pipeline = FusedPipelineExec(
+                fuse_pipelines(leaf), list(reversed(chain))
+            )
+            partial = HashAggregateExec(
+                pipeline,
+                keys=[(e, n) for e, n in op.keys],
+                aggs=[(a, n) for a, n in op.aggs],
+                mode=AggMode.PARTIAL,
+            )
+            return HostFinalAggExec(
+                FusedAggregateExec(pipeline, partial), op
+            )
+    chain, t = _collect_chain(op)
     if len(chain) >= 2:
         return FusedPipelineExec(fuse_pipelines(t), list(reversed(chain)))
     op.children = [fuse_pipelines(c) for c in op.children]

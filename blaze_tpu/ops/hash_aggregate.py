@@ -42,6 +42,7 @@ from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.host_lower import lower_strings_host
 from blaze_tpu.ops.project import _unflatten_cvs
 from blaze_tpu.ops.util import concat_batches, sort_indices
+from blaze_tpu.runtime.dispatch import cached_kernel, host_int
 
 
 class AggMode(enum.Enum):
@@ -175,7 +176,6 @@ class HashAggregateExec(PhysicalOp):
                     for a, n in self.aggs
                 ]
             )
-        self._jit_cache = {}
 
     @property
     def schema(self) -> Schema:
@@ -292,20 +292,20 @@ class HashAggregateExec(PhysicalOp):
                 if a.child is not None:
                     child_map[i] = next(it)
 
-        key = (tuple(key_exprs_l), tuple(child_map.items()),
+        key = ("hashagg", self.mode.value,
+               tuple((a.fn, a.child) for a, _ in self.aggs),
+               tuple(key_exprs_l), tuple(child_map.items()),
                aug.layout(), merging)
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                self._build_kernel(aug.schema, aug.capacity,
-                                   key_exprs_l, child_map, merging,
-                                   aug.layout())
-            )
-            self._jit_cache[key] = fn
+        fn = cached_kernel(
+            key,
+            lambda: self._build_kernel(aug.schema, aug.capacity,
+                                       key_exprs_l, child_map, merging,
+                                       aug.layout()),
+        )
         outs, n_groups = fn(
             aug.device_buffers(), aug.selection, aug.num_rows
         )
-        n = int(n_groups)
+        n = host_int(n_groups)
         cols: List[Column] = []
         # recover dictionaries for string key passthroughs
         for (v, m), field, e in zip(

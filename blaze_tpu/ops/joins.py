@@ -48,6 +48,7 @@ from blaze_tpu.ops.util import (
     ensure_compacted,
     take_batch,
 )
+from blaze_tpu.runtime.dispatch import cached_kernel, host_int
 
 
 class JoinType(enum.Enum):
@@ -130,17 +131,16 @@ def _key_hash_cols(cols: List[Column]) -> List[Tuple]:
     return out
 
 
-@partial(jax.jit, static_argnames=("capacity", "dtypes"))
-def _build_index(values, valids, dtypes, capacity: int):
-    """Sort build rows by key hash; returns (hash_sorted, order)."""
-    cols = list(zip(values, valids, dtypes))
-    h = hash_columns_device(cols, capacity).astype(jnp.int32)
-    order = jnp.argsort(h, stable=True)
-    return jnp.take(h, order), order
-
-
 class _JoinCore:
-    """Shared vectorized equi-join over one materialized build batch."""
+    """Shared vectorized equi-join over one materialized build batch.
+
+    Dispatch budget per probe batch (the tunnel-RTT model of
+    runtime/dispatch.py): one build-index kernel per build relation, then
+    per probe batch ONE counting kernel + ONE blocking scalar readback
+    (the dynamic pair count that picks the static output bucket) + ONE
+    emission kernel that expands, verifies, gathers both sides and folds
+    the matched flags - instead of the ~20 eager ops a naive translation
+    of the reference's cursor loop would dispatch."""
 
     def __init__(self, build: ColumnBatch, build_keys: List[int]):
         self.build = build
@@ -149,20 +149,39 @@ class _JoinCore:
         self._index = None
 
     def _ensure_index(self, build_cols: List[Column]):
+        # the index is probe-invariant unless a build key is
+        # dictionary-encoded (dictionary unification re-maps build codes
+        # per probe batch); cache it so multi-batch probes pay the index
+        # kernel once
+        if self._index is not None and not any(
+            c.dtype.is_dictionary_encoded for c in build_cols
+        ):
+            return
         # NULL keys hash like values and are rejected later by the equality
         # check, so collisions only cost verification work
         bufs = _key_hash_cols(build_cols)
-        self._index = _build_index(
-            tuple(v for v, _, _ in bufs),
-            tuple(m for _, m, _ in bufs),
-            tuple(d for _, _, d in bufs),
-            self.build.capacity,
+        dtypes = tuple(d for _, _, d in bufs)
+        cap = self.build.capacity
+
+        def build():
+            def kernel(values, valids):
+                cols = list(zip(values, valids, dtypes))
+                h = hash_columns_device(cols, cap).astype(jnp.int32)
+                order = jnp.argsort(h, stable=True)
+                return jnp.take(h, order), order
+
+            return kernel
+
+        fn = cached_kernel(("join_index", dtypes, cap), build)
+        self._index = fn(
+            tuple(v for v, _, _ in bufs), tuple(m for _, m, _ in bufs)
         )
 
     def probe(self, probe_cb: ColumnBatch, probe_keys: List[int]):
-        """Returns (pair_build_idx, pair_probe_idx, valid_pair, pair_cap,
-        matched_probe, build_cols, probe_cols) - everything downstream
-        emission needs."""
+        """Hash the probe keys and size the pair expansion (one host
+        sync). Returns the state tuple for emit_pairs(); emission - and
+        the matched_build update - happens only when emit_pairs() runs,
+        so read core.matched_build only after that call."""
         probe_cb = ensure_compacted(probe_cb)
         build_cols = [self.build.columns[i] for i in self.build_keys]
         probe_cols = [probe_cb.columns[i] for i in probe_keys]
@@ -175,114 +194,188 @@ class _JoinCore:
         h_sorted, order = self._index
 
         pbufs = _key_hash_cols(unified_p)
-        counts, lo = _probe_counts(
+        pdtypes = tuple(d for _, _, d in pbufs)
+        pcap = probe_cb.capacity
+
+        def build_counts():
+            def kernel(values, valids, h_sorted, num_rows):
+                cols = list(zip(values, valids, pdtypes))
+                h = hash_columns_device(cols, pcap).astype(jnp.int32)
+                lo = jnp.searchsorted(h_sorted, h, side="left")
+                hi = jnp.searchsorted(h_sorted, h, side="right")
+                counts = (hi - lo).astype(jnp.int32)
+                live = jnp.arange(pcap, dtype=jnp.int32) < num_rows
+                counts = jnp.where(live, counts, 0)
+                return counts, lo.astype(jnp.int32), jnp.sum(counts)
+
+            return kernel
+
+        fn = cached_kernel(("join_counts", pdtypes, pcap), build_counts)
+        counts, lo, total_dev = fn(
             tuple(v for v, _, _ in pbufs),
             tuple(m for _, m, _ in pbufs),
-            tuple(d for _, _, d in pbufs),
             h_sorted,
-            probe_cb.capacity,
+            probe_cb.num_rows,
         )
-        live_p = row_mask(probe_cb.num_rows, probe_cb.capacity)
-        counts = jnp.where(live_p, counts, 0)
-        total = int(jnp.sum(counts))
+        total = host_int(total_dev)
         pair_cap = max(get_config().bucket_for(total), 1)
-        pair_b, pair_p, in_range = _expand_pairs(
-            counts, lo, order, pair_cap
+        return (
+            probe_cb, unified_b, unified_p, counts, lo, order, pair_cap
         )
-        valid = in_range
-        # true key equality (NULL never equals NULL in join keys)
-        live_b = row_mask(self.build.num_rows, self.build.capacity)
-        valid = valid & jnp.take(live_b, pair_b)
-        for b2, p2 in zip(unified_b, unified_p):
-            bv = jnp.take(b2.values, pair_b)
-            pv = jnp.take(p2.values, pair_p)
-            eq = bv == pv
-            if jnp.issubdtype(bv.dtype, jnp.floating):
-                eq = eq | (jnp.isnan(bv) & jnp.isnan(pv))  # Spark NaN=NaN
-            if b2.validity is not None:
-                eq = eq & jnp.take(b2.validity, pair_b)
-            if p2.validity is not None:
-                eq = eq & jnp.take(p2.validity, pair_p)
-            valid = valid & eq
-        matched_probe = _matched_flags(
-            pair_p, valid, probe_cb.capacity
-        ) & live_p
-        self.matched_build = self.matched_build | _matched_flags(
-            pair_b, valid, self.build.capacity
+
+    def emit_pairs(self, probe_state, out_build_cols: List[Column],
+                   out_probe_cols: List[Column], build_first: bool):
+        """ONE kernel: expand candidate pairs, verify key equality, gather
+        both sides' output columns, fold matched flags. Returns
+        (out_columns, valid, pair_cap, matched_probe) and updates
+        matched_build."""
+        (probe_cb, unified_b, unified_p, counts, lo, order,
+         pair_cap) = probe_state
+        bcap = self.build.capacity
+        pcap = probe_cb.capacity
+        b_layout = tuple(
+            (c.values.dtype.str, c.validity is not None)
+            for c in out_build_cols
         )
-        return probe_cb, pair_b, pair_p, valid, pair_cap, matched_probe
-
-
-@partial(jax.jit, static_argnames=("capacity", "dtypes"))
-def _probe_counts(values, valids, dtypes, h_sorted, capacity: int):
-    cols = list(zip(values, valids, dtypes))
-    h = hash_columns_device(cols, capacity).astype(jnp.int32)
-    lo = jnp.searchsorted(h_sorted, h, side="left")
-    hi = jnp.searchsorted(h_sorted, h, side="right")
-    return (hi - lo).astype(jnp.int32), lo.astype(jnp.int32)
-
-
-@partial(jax.jit, static_argnames=("pair_cap",))
-def _expand_pairs(counts, lo, order, pair_cap: int):
-    """Run-length expansion of per-probe-row candidate ranges into flat
-    (build_idx, probe_idx) pairs with static capacity."""
-    offsets = jnp.cumsum(counts) - counts
-    ends = jnp.cumsum(counts)
-    total = jnp.sum(counts)
-    pos = jnp.arange(pair_cap, dtype=jnp.int32)
-    # pair_p[k] = first probe row whose cumulative end exceeds slot k
-    # (zero-count rows are skipped by side='right')
-    pair_p = jnp.searchsorted(ends, pos, side="right")
-    pair_p = jnp.clip(pair_p, 0, counts.shape[0] - 1).astype(jnp.int32)
-    within = pos - jnp.take(offsets, pair_p)
-    sorted_pos = jnp.take(lo, pair_p) + within
-    sorted_pos = jnp.clip(sorted_pos, 0, order.shape[0] - 1)
-    pair_b = jnp.take(order, sorted_pos)
-    in_range = pos < total
-    return pair_b, pair_p, in_range
-
-
-@partial(jax.jit, static_argnames=("capacity",))
-def _matched_flags(pair_idx, valid, capacity: int):
-    # segment_sum, not segment_max: empty segments must read as False
-    # (segment_max fills them with the dtype minimum, which is truthy)
-    return (
-        jax.ops.segment_sum(
-            valid.astype(jnp.int32),
-            jnp.clip(pair_idx, 0, capacity - 1),
-            num_segments=capacity,
+        p_layout = tuple(
+            (c.values.dtype.str, c.validity is not None)
+            for c in out_probe_cols
         )
-        > 0
-    )
+        k_layout = tuple(
+            (b2.values.dtype.str, b2.validity is not None,
+             p2.values.dtype.str, p2.validity is not None)
+            for b2, p2 in zip(unified_b, unified_p)
+        )
+        n_b = len(out_build_cols)
+        n_p = len(out_probe_cols)
 
+        def build_emit():
+            def kernel(counts, lo, order, bkey_bufs, pkey_bufs,
+                       bout_bufs, pout_bufs, build_rows, probe_rows,
+                       matched_build):
+                # ---- expand ----
+                offsets = jnp.cumsum(counts) - counts
+                ends = jnp.cumsum(counts)
+                total = jnp.sum(counts)
+                pos = jnp.arange(pair_cap, dtype=jnp.int32)
+                pair_p = jnp.searchsorted(ends, pos, side="right")
+                pair_p = jnp.clip(
+                    pair_p, 0, counts.shape[0] - 1
+                ).astype(jnp.int32)
+                within = pos - jnp.take(offsets, pair_p)
+                sorted_pos = jnp.take(lo, pair_p) + within
+                sorted_pos = jnp.clip(sorted_pos, 0, order.shape[0] - 1)
+                pair_b = jnp.take(order, sorted_pos)
+                valid = pos < total
+                # ---- verify true key equality ----
+                live_b = jnp.arange(bcap, dtype=jnp.int32) < build_rows
+                valid = valid & jnp.take(live_b, pair_b)
+                ki = iter(zip(bkey_bufs, pkey_bufs))
+                for _ in k_layout:
+                    bv_all, (pv_all, bmask, pmask) = next(ki)
+                    bv = jnp.take(bv_all, pair_b)
+                    pv = jnp.take(pv_all, pair_p)
+                    eq = bv == pv
+                    if jnp.issubdtype(bv.dtype, jnp.floating):
+                        eq = eq | (jnp.isnan(bv) & jnp.isnan(pv))
+                    if bmask is not None:
+                        eq = eq & jnp.take(bmask, pair_b)
+                    if pmask is not None:
+                        eq = eq & jnp.take(pmask, pair_p)
+                    valid = valid & eq
+                # ---- matched flags ----
+                live_p = jnp.arange(pcap, dtype=jnp.int32) < probe_rows
+                mp = (
+                    jax.ops.segment_sum(
+                        valid.astype(jnp.int32),
+                        jnp.clip(pair_p, 0, pcap - 1),
+                        num_segments=pcap,
+                    ) > 0
+                ) & live_p
+                mb = matched_build | (
+                    jax.ops.segment_sum(
+                        valid.astype(jnp.int32),
+                        jnp.clip(pair_b, 0, bcap - 1),
+                        num_segments=bcap,
+                    ) > 0
+                )
+                # ---- gather output columns ----
+                def gather(bufs, layout, idx, cap_in):
+                    out = []
+                    it = iter(bufs)
+                    ci = jnp.clip(idx, 0, cap_in - 1)
+                    for _, has_m in layout:
+                        v = next(it)
+                        out.append(jnp.take(v, ci, axis=0))
+                        if has_m:
+                            out.append(jnp.take(next(it), ci, axis=0))
+                        else:
+                            out.append(None)
+                    return out
 
-def _gather_side(cols: List[Column], idx: jax.Array,
-                 present: Optional[jax.Array]) -> List[Column]:
-    """Gather one side's columns by row index; `present`=False rows become
-    SQL NULLs (outer-join padding)."""
-    out = []
-    for c in cols:
-        v = jnp.take(c.values, jnp.clip(idx, 0, c.capacity - 1), axis=0)
-        if c.validity is not None:
-            m = jnp.take(c.validity, jnp.clip(idx, 0, c.capacity - 1),
-                         axis=0)
+                bout = gather(bout_bufs, b_layout, pair_b, bcap)
+                pout = gather(pout_bufs, p_layout, pair_p, pcap)
+                return bout, pout, valid, mp, mb
+
+            return kernel
+
+        fn = cached_kernel(
+            ("join_emit", k_layout, b_layout, p_layout, bcap, pcap,
+             pair_cap, n_b, n_p),
+            build_emit,
+        )
+        bkey_bufs = tuple(b2.values for b2 in unified_b)
+        pkey_bufs = tuple(
+            (p2.values, b2.validity, p2.validity)
+            for b2, p2 in zip(unified_b, unified_p)
+        )
+        bout_bufs = _flatten_cols(out_build_cols)
+        pout_bufs = _flatten_cols(out_probe_cols)
+        bout, pout, valid, matched_p, mb = fn(
+            counts, lo, order, bkey_bufs, pkey_bufs, bout_bufs,
+            pout_bufs, self.build.num_rows, probe_cb.num_rows,
+            self.matched_build,
+        )
+        self.matched_build = mb
+        bcols = _rewrap_cols(out_build_cols, bout)
+        pcols = _rewrap_cols(out_probe_cols, pout)
+        if build_first:
+            out_cols = bcols + pcols
         else:
-            m = None
-        if present is not None:
-            m = present if m is None else (m & present)
+            out_cols = pcols + bcols
+        return out_cols, valid, pair_cap, matched_p
+
+
+def _flatten_cols(cols: List[Column]):
+    bufs = []
+    for c in cols:
+        bufs.append(c.values)
+        if c.validity is not None:
+            bufs.append(c.validity)
+    return tuple(bufs)
+
+
+def _rewrap_cols(cols: List[Column], flat) -> List[Column]:
+    out = []
+    it = iter(flat)
+    for c in cols:
+        v = next(it)
+        m = next(it)
         out.append(Column(c.dtype, v, m, c.dictionary))
     return out
 
 
 def _null_side(schema_fields, capacity: int) -> List[Column]:
+    # numpy zeros: all-NULL padding columns cost no device dispatch; they
+    # upload lazily only if a downstream kernel actually consumes them
     cols = []
     for f in schema_fields:
         phys = f.dtype.physical_dtype()
         cols.append(
             Column(
                 f.dtype,
-                jnp.zeros(capacity, dtype=phys),
-                jnp.zeros(capacity, dtype=jnp.bool_),
+                np.zeros(capacity, dtype=phys),
+                np.zeros(capacity, dtype=bool),
                 None,
             )
         )
@@ -371,13 +464,16 @@ class HashJoinExec(PhysicalOp):
         )
         for pp in probe_parts:
             for pb in right.execute(pp, ctx):
-                (pb, pair_b, pair_p, valid, pair_cap,
-                 matched_p) = core.probe(pb, self.right_keys)
+                state = core.probe(pb, self.right_keys)
+                pb = state[0]
+                bcols = build.columns if emit_pairs else []
+                pcols = pb.columns if emit_pairs else []
+                out_cols, valid, pair_cap, matched_p = core.emit_pairs(
+                    state, bcols, pcols, build_first=True
+                )
                 if emit_pairs:
-                    lcols = _gather_side(build.columns, pair_b, None)
-                    rcols = _gather_side(pb.columns, pair_p, None)
                     yield ColumnBatch(
-                        self._schema, lcols + rcols, pair_cap, valid
+                        self._schema, out_cols, pair_cap, valid
                     )
                 if jt in (JoinType.RIGHT, JoinType.FULL):
                     un = row_mask(pb.num_rows, pb.capacity) & ~matched_p
@@ -487,14 +583,18 @@ class SortMergeJoinExec(PhysicalOp):
         build = concat_batches(right_batches, schema=right.schema)
         core = _JoinCore(build, self.right_keys)
         probe = concat_batches(left_batches, schema=left.schema)
-        (probe, pair_b, pair_p, valid, pair_cap,
-         matched_p) = core.probe(probe, self.left_keys)
+        state = core.probe(probe, self.left_keys)
+        probe = state[0]
+        emit = jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                      JoinType.FULL)
+        bcols = build.columns if emit else []
+        pcols = probe.columns if emit else []
+        out_cols, valid, pair_cap, matched_p = core.emit_pairs(
+            state, bcols, pcols, build_first=False
+        )
         live_p = row_mask(probe.num_rows, probe.capacity)
-        if jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
-                  JoinType.FULL):
-            lcols = _gather_side(probe.columns, pair_p, None)
-            rcols = _gather_side(build.columns, pair_b, None)
-            yield ColumnBatch(self._schema, lcols + rcols, pair_cap, valid)
+        if emit:
+            yield ColumnBatch(self._schema, out_cols, pair_cap, valid)
             if jt in (JoinType.LEFT, JoinType.FULL):
                 un = live_p & ~matched_p
                 rnull = _null_side(right.schema.fields, probe.capacity)

@@ -21,6 +21,7 @@ from blaze_tpu.exprs.eval import DeviceEvaluator
 from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.host_lower import lower_strings_host
+from blaze_tpu.runtime.dispatch import cached_kernel
 
 
 class ProjectExec(PhysicalOp):
@@ -34,7 +35,6 @@ class ProjectExec(PhysicalOp):
                 for e, name in self.exprs
             ]
         )
-        self._jit_cache = {}
 
     @property
     def schema(self) -> Schema:
@@ -52,15 +52,12 @@ class ProjectExec(PhysicalOp):
         exprs, host_cols, aug = lower_strings_host(
             [e for e, _ in self.exprs], cb
         )
-        key = (tuple(exprs), aug.layout())
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            in_schema = aug.schema
-            cap = aug.capacity
+        in_schema = aug.schema
+        cap = aug.capacity
+        layout = aug.layout()
 
-            def run(bufs, layout=aug.layout()):
-                from blaze_tpu.batch import ColumnBatch as CB
-
+        def build():
+            def run(bufs):
                 cols = _unflatten_cvs(layout, bufs)
                 ev = DeviceEvaluator(in_schema, cols, cap)
                 out = []
@@ -69,8 +66,9 @@ class ProjectExec(PhysicalOp):
                     out.append((v, mm))
                 return out
 
-            fn = jax.jit(run)
-            self._jit_cache[key] = fn
+            return run
+
+        fn = cached_kernel(("project", tuple(exprs), layout), build)
         results = fn(aug.device_buffers())
         out_cols: List[Column] = []
         for (e, (_, name)), (v, mm) in zip(

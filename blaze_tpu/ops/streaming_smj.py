@@ -27,7 +27,6 @@ from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.joins import (
     JoinType,
     _JoinCore,
-    _gather_side,
     _joined_schema,
     _null_side,
 )
@@ -152,8 +151,16 @@ class StreamingSortMergeJoinExec(PhysicalOp):
             [e[0] for e in window], schema=right.schema
         )
         core = _JoinCore(build, self.right_keys)
-        (probe, pair_b, pair_p, valid, pair_cap,
-         matched_p) = core.probe(lb, self.left_keys)
+        state = core.probe(lb, self.left_keys)
+        probe = state[0]
+        emit = jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                      JoinType.FULL)
+        out_cols, valid, pair_cap, matched_p = core.emit_pairs(
+            state,
+            build.columns if emit else [],
+            probe.columns if emit else [],
+            build_first=False,
+        )
         live_p = row_mask(probe.num_rows, probe.capacity)
         # fold this probe's build-side matches back into window bookkeeping
         mb = np.asarray(core.matched_build)
@@ -162,11 +169,8 @@ class StreamingSortMergeJoinExec(PhysicalOp):
             n = entry[0].num_rows
             entry[1] |= mb[off: off + n]
             off += n
-        if jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
-                  JoinType.FULL):
-            lcols = _gather_side(probe.columns, pair_p, None)
-            rcols = _gather_side(build.columns, pair_b, None)
-            yield ColumnBatch(self._schema, lcols + rcols, pair_cap, valid)
+        if emit:
+            yield ColumnBatch(self._schema, out_cols, pair_cap, valid)
             if jt in (JoinType.LEFT, JoinType.FULL):
                 import jax.numpy as jnp
 

@@ -71,7 +71,6 @@ class WindowExec(PhysicalOp):
                 Field(f.output, self._fn_dtype(f, schema), True)
             )
         self._schema = Schema(out_fields)
-        self._jit_cache = {}
 
     @staticmethod
     def _fn_dtype(f: WindowFn, schema: Schema) -> DataType:
@@ -106,11 +105,14 @@ class WindowExec(PhysicalOp):
 
     # ------------------------------------------------------------------
     def _apply(self, cb: ColumnBatch) -> ColumnBatch:
-        key = cb.layout()
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            fn = jax.jit(self._build_kernel(cb.layout()))
-            self._jit_cache[key] = fn
+        from blaze_tpu.runtime.dispatch import cached_kernel
+
+        key = ("window", tuple(self.partition_by),
+               tuple((k.expr, k.ascending, k.nulls_first)
+                     for k in self.order_by),
+               tuple((f.kind, f.source) for f in self.functions),
+               cb.layout())
+        fn = cached_kernel(key, lambda: self._build_kernel(cb.layout()))
         outs = fn(cb.device_buffers(), cb.num_rows)
         cols = list(cb.columns)
         for f, (v, m) in zip(self.functions, outs):

@@ -92,7 +92,14 @@ def test_fused_inside_larger_plan():
         mode=AggMode.COMPLETE,
     )
     fused = fuse_pipelines(plan)
-    assert isinstance(fused.children[0], FusedPipelineExec)
+    # COMPLETE rewrites to device-PARTIAL (fused over the chain) wrapped
+    # in a host finalizer
+    from blaze_tpu.ops.fused import FusedAggregateExec, HostFinalAggExec
+
+    assert isinstance(fused, HostFinalAggExec)
+    inner = fused.children[0]
+    assert isinstance(inner, FusedAggregateExec)
+    assert isinstance(inner.pipeline, FusedPipelineExec)
     out = run_plan(fused).to_pydict()
     assert dict(zip(out["k"], out["s"])) == {1: 8, 2: 12}
 
