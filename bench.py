@@ -1,158 +1,301 @@
-"""Engine benchmark: q6-shaped scan+filter+project+aggregate throughput.
+"""Engine benchmark: q6-shaped pipeline, end-to-end through execute_task.
 
-Measures the flagship pipeline (BASELINE.json configs[0]: TPC-DS q6 shape -
-predicate + arithmetic projection + global aggregate over a store_sales-like
-table) end-to-end from host-resident columns: H2D transfer, jit'd device
-compute, scalar readback. Baseline is the identical computation as
-vectorized numpy on this host's CPU - the stand-in for the reference's
-vectorized CPU engine (DataFusion kernels are the same class of
-SIMD-vectorized columnar loop; the Rust toolchain isn't in this image).
+Measures the flagship query shape (BASELINE.json configs[0]: predicate +
+arithmetic projection + aggregate over a store_sales-like table) through
+the PRODUCTION entry point - a serialized TaskDefinition executed by
+runtime/executor.execute_task, including parquet IO, H2D staging, the
+fused device program, and the Arrow result boundary. A second
+(dispatch-amortized, HBM-resident) kernel metric isolates chip compute
+throughput. The CPU baseline is the same computation as BOTH vectorized
+numpy and pyarrow.compute (SIMD C++ kernels - the same class of columnar
+loop as the reference's DataFusion engine); the faster of the two is the
+denominator. This host exposes a single CPU core; the reference engine
+would be similarly single-threaded per task.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": rows/s on TPU, "unit": "rows/s",
-   "vs_baseline": tpu_rows_per_s / cpu_rows_per_s}
+Robustness (round-1 failure hardening): the TPU backend sits behind a
+network tunnel that can hang at init. All device work runs in
+subprocesses with hard timeouts and retry/backoff; whatever happens,
+this script prints exactly ONE valid JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N, ...}
+with an "error" field describing any degradation instead of dying.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+ROWS = int(os.environ.get("BLAZE_BENCH_ROWS", 4 << 20))
+PROBE_TIMEOUT = int(os.environ.get("BLAZE_BENCH_PROBE_TIMEOUT", 150))
+CHILD_TIMEOUT = int(os.environ.get("BLAZE_BENCH_CHILD_TIMEOUT", 1200))
+RETRY_DELAYS = (0, 10, 30)  # backoff between backend probes
 
 
-ROWS_PER_BATCH = 1 << 22  # 4M rows, ~48 MB of columns per batch
-N_BATCHES = 8
-MEASURE_ITERS = 3
-INNER_ITERS = 32  # repeats fused into one dispatch (amortizes RPC latency)
-
-
-def make_batches(rng):
-    batches = []
-    for _ in range(N_BATCHES):
-        batches.append(
-            (
-                rng.integers(0, 1000, ROWS_PER_BATCH).astype(np.int32),
-                rng.integers(1, 10, ROWS_PER_BATCH).astype(np.int32),
-                (rng.random(ROWS_PER_BATCH) * 100).astype(np.float32),
-            )
-        )
-    return batches
-
-
-def bench_tpu(batches):
-    import jax
-    import jax.numpy as jnp
-
-    jax.config.update("jax_enable_x64", True)
-
-    from blaze_tpu.types import DataType, Field, Schema
-    from blaze_tpu.exprs import Col
-    from blaze_tpu.exprs.optimize import bind_opt as bind
-    from blaze_tpu.exprs.eval import DeviceEvaluator
-
-    schema = Schema(
-        [
-            Field("item", DataType.int32()),
-            Field("qty", DataType.int32()),
-            Field("price", DataType.float32()),
-        ]
+def _repo_env(platform=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
     )
-    pred = bind((Col("price") > 50.0) & (Col("qty") < 8), schema)
-    revenue = bind(
-        Col("price") * Col("qty").cast(DataType.float32()), schema
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+    return env
+
+
+def probe_backend():
+    """Can jax init its default backend right now? (subprocess: a hung
+    tunnel must not hang the benchmark)."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "print('PLATFORM:' + d[0].platform)"
     )
-
-    def step(item, qty, price):
-        cap = item.shape[0]
-        ev = DeviceEvaluator(
-            schema, [(item, None), (qty, None), (price, None)], cap
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+            env=_repo_env(),
         )
-        live = ev.evaluate_predicate(pred)
-        rev, _ = ev.evaluate(revenue)
-        rev = jnp.where(live, rev, np.float32(0.0))
-        return jnp.sum(rev, dtype=jnp.float32), jnp.sum(
-            live.astype(jnp.int32)
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe timed out after {PROBE_TIMEOUT}s"
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM:"):
+            return line.split(":", 1)[1], None
+    err = (out.stderr or "").strip().splitlines()
+    return None, (err[-1] if err else f"probe rc={out.returncode}")
+
+
+def run_child(platform=None):
+    """Run the measurement in a subprocess; returns (dict | None, err)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             str(ROWS)],
+            capture_output=True,
+            text=True,
+            timeout=CHILD_TIMEOUT,
+            env=_repo_env(platform),
         )
-
-    def sweep_once(items, qtys, prices, jitter):
-        # one pass over all batches; `jitter` (==0.0 numerically for f32)
-        # makes the pass iteration-dependent so XLA cannot hoist it out of
-        # the repeat loop below
-        def body(carry, b):
-            t, c = carry
-            item, qty, price = b
-            s, n = step(item, qty, price + jitter)
-            return (t + s, (c + n).astype(jnp.int32)), None
-
-        return jax.lax.scan(
-            body, (jnp.float32(0), jnp.int32(0)), (items, qtys, prices)
-        )[0]
-
-    @jax.jit
-    def sweep_many(items, qtys, prices):
-        # the chip sits behind a network RPC tunnel in this harness
-        # (~70 ms/call); amortize the dispatch by repeating the full sweep
-        # inside ONE executable
-        def body(i, carry):
-            t, c = carry
-            jitter = i.astype(jnp.float32) * np.float32(1e-18)
-            s, n = sweep_once(items, qtys, prices, jitter)
-            return (t + s, c + n)
-
-        return jax.lax.fori_loop(
-            0, INNER_ITERS, body, (jnp.float32(0), jnp.int32(0))
-        )
-
-    # stage batches into HBM once: the engine's operating point is jit'd
-    # kernels over HBM-resident columns (BASELINE.json north star)
-    items = jnp.asarray(np.stack([b[0] for b in batches]))
-    qtys = jnp.asarray(np.stack([b[1] for b in batches]))
-    prices = jnp.asarray(np.stack([b[2] for b in batches]))
-    out = sweep_many(items, qtys, prices)
-    np.asarray(out[0])  # force completion (block_until_ready is advisory
-    # through the tunnel; a D2H fetch is definitive)
-
-    t0 = time.perf_counter()
-    totals = [sweep_many(items, qtys, prices) for _ in range(MEASURE_ITERS)]
-    total = float(sum(np.asarray(t) for t, _ in totals))
-    count = int(sum(np.asarray(c) for _, c in totals))
-    dt = time.perf_counter() - t0
-    rows = ROWS_PER_BATCH * N_BATCHES * MEASURE_ITERS * INNER_ITERS
-    return rows / dt, total / INNER_ITERS, count // INNER_ITERS
-
-
-def bench_cpu(batches):
-    t0 = time.perf_counter()
-    total = np.float32(0)
-    count = 0
-    for _ in range(MEASURE_ITERS):
-        for item, qty, price in batches:
-            live = (price > 50.0) & (qty < 8)
-            rev = np.where(live, price * qty.astype(np.float32),
-                           np.float32(0))
-            total = total + rev.sum(dtype=np.float32)
-            count += int(live.sum())
-    dt = time.perf_counter() - t0
-    rows = ROWS_PER_BATCH * N_BATCHES * MEASURE_ITERS
-    return rows / dt, float(total), count
+    except subprocess.TimeoutExpired:
+        return None, f"measurement timed out after {CHILD_TIMEOUT}s"
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                pass
+    err = (out.stderr or "").strip().splitlines()
+    return None, (err[-1] if err else f"child rc={out.returncode}")
 
 
 def main():
+    errors = []
+    platform = None
+    for delay in RETRY_DELAYS:
+        if delay:
+            time.sleep(delay)
+        platform, err = probe_backend()
+        if platform is not None:
+            break
+        errors.append(err)
+        if "timed out" in (err or ""):
+            # a hung tunnel rarely recovers within the retry budget;
+            # don't burn the full timeout twice more
+            break
+    degraded = platform is None or platform == "cpu"
+    res, err = (None, "skipped")
+    if platform is not None:
+        res, err = run_child()
+        if res is None:
+            errors.append(f"measurement on {platform}: {err}")
+    if res is None:
+        # degraded path: measure on the CPU backend so the driver still
+        # records a parseable number (flagged in "error")
+        degraded = True
+        res, err = run_child(platform="cpu")
+        if res is None:
+            errors.append(f"cpu fallback: {err}")
+            res = {
+                "metric": "q6_e2e_execute_task_rows_per_sec_chip",
+                "value": 0,
+                "unit": "rows/s",
+                "vs_baseline": 0.0,
+            }
+    if degraded:
+        res["error"] = (
+            "TPU backend unavailable; degraded measurement. "
+            + "; ".join(errors)
+        )
+    print(json.dumps(res))
+
+
+# ---------------------------------------------------------------------------
+# measurement child
+# ---------------------------------------------------------------------------
+
+def child(n_rows):
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize pins jax_platforms="axon,cpu" in config;
+        # the env var alone does not stick - override before backend init
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from blaze_tpu.config import EngineConfig, set_config
+
+    set_config(
+        EngineConfig(
+            batch_size=n_rows,
+            shape_buckets=(256, 4096, 65536, 1 << 20, n_rows),
+        )
+    )
+
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import (
+        AggMode,
+        FilterExec,
+        HashAggregateExec,
+        MemoryScanExec,
+        ProjectExec,
+    )
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.ops.fused import fuse_pipelines
+    from blaze_tpu.plan.serde import task_to_proto
+    from blaze_tpu.runtime import dispatch
+    from blaze_tpu.runtime.executor import execute_task, run_plan
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.types import DataType
+
     rng = np.random.default_rng(42)
-    batches = make_batches(rng)
-    cpu_rps, cpu_total, cpu_count = bench_cpu(batches)
-    tpu_rps, tpu_total, tpu_count = bench_tpu(batches)
-    assert tpu_count == cpu_count, (tpu_count, cpu_count)
+    item = rng.integers(0, 1000, n_rows).astype(np.int32)
+    qty = rng.integers(1, 10, n_rows).astype(np.int32)
+    price = (rng.random(n_rows) * 100).astype(np.float32)
+
+    path = "/tmp/blaze_bench_store_sales.parquet"
+    pq.write_table(
+        pa.table({"item": item, "qty": qty, "price": price}), path,
+        compression="zstd",
+    )
+
+    def q6_plan(scan):
+        return HashAggregateExec(
+            ProjectExec(
+                FilterExec(
+                    scan, (Col("price") > 50.0) & (Col("qty") < 8)
+                ),
+                [(Col("price") * Col("qty").cast(DataType.float32()),
+                  "rev")],
+            ),
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("rev")), "t"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n")],
+            mode=AggMode.COMPLETE,
+        )
+
+    def timed(fn, iters=3, warmup=1):
+        for _ in range(warmup):
+            out = fn()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        return (time.perf_counter() - t0) / iters, out
+
+    # ---- end-to-end: serialized task through execute_task, incl IO ----
+    blob = task_to_proto(
+        q6_plan(ParquetScanExec([[FileRange(path)]])), 0
+    )
+
+    def e2e():
+        rows = list(execute_task(blob))
+        return float(rows[0].column(0)[0].as_py()), int(
+            rows[0].column(1)[0].as_py()
+        )
+
+    t_e2e, (total_e2e, count_e2e) = timed(e2e)
+    with dispatch.counting() as c:
+        e2e()
+    e2e_counts = c.counts
+
+    # ---- device-resident operator path (HBM-staged scan) ----
+    rb = pa.record_batch(
+        {"item": item, "qty": qty, "price": price}
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    scan_mem = MemoryScanExec([[cb]], cb.schema)
+    plan_mem = fuse_pipelines(q6_plan(scan_mem))
+
+    def staged():
+        t = run_plan(plan_mem)
+        return float(t.column("t")[0].as_py())
+
+    t_staged, _ = timed(staged)
+
+    # ---- CPU baselines: numpy and pyarrow.compute (SIMD C++) ----
+    def cpu_numpy():
+        tbl = pq.read_table(path)
+        p = tbl.column("price").to_numpy()
+        q = tbl.column("qty").to_numpy()
+        live = (p > 50.0) & (q < 8)
+        rev = np.where(live, p * q.astype(np.float32), np.float32(0))
+        return float(rev.sum(dtype=np.float64)), int(live.sum())
+
+    def cpu_arrow():
+        tbl = pq.read_table(path)
+        live = pc.and_(
+            pc.greater(tbl.column("price"), 50.0),
+            pc.less(tbl.column("qty"), 8),
+        )
+        f = tbl.filter(live)
+        rev = pc.multiply(
+            f.column("price"), pc.cast(f.column("qty"), pa.float32())
+        )
+        return float(pc.sum(rev).as_py() or 0.0), f.num_rows
+
+    t_np, (total_np, count_np) = timed(cpu_numpy)
+    t_pa, (total_pa, count_pa) = timed(cpu_arrow)
+    t_cpu = min(t_np, t_pa)
+
+    assert count_e2e == count_np == count_pa, (
+        count_e2e, count_np, count_pa,
+    )
+    assert abs(total_e2e - total_np) / max(abs(total_np), 1) < 1e-3
+
+    backend = jax.default_backend()
+    e2e_rps = n_rows / t_e2e
     print(
         json.dumps(
             {
-                "metric": "q6_scan_filter_project_agg_rows_per_sec_chip",
-                "value": round(tpu_rps),
+                "metric": "q6_e2e_execute_task_rows_per_sec_chip",
+                "value": round(e2e_rps),
                 "unit": "rows/s",
-                "vs_baseline": round(tpu_rps / cpu_rps, 3),
+                "vs_baseline": round(t_cpu / t_e2e, 3),
+                "backend": backend,
+                "rows": n_rows,
+                "e2e_seconds": round(t_e2e, 4),
+                "staged_device_seconds": round(t_staged, 4),
+                "staged_rows_per_sec": round(n_rows / t_staged),
+                "cpu_numpy_seconds": round(t_np, 4),
+                "cpu_arrow_seconds": round(t_pa, 4),
+                "dispatch_counts": e2e_counts,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]))
+    else:
+        main()

@@ -188,10 +188,16 @@ class FusedAggregateExec(PhysicalOp):
     count, so downstream consumers (host finalization, shuffle IPC
     encode) start from host-resident buffers with no further syncs."""
 
-    def __init__(self, pipeline: FusedPipelineExec, agg):
+    def __init__(self, pipeline: FusedPipelineExec, agg,
+                 fetch_host: bool = False):
         self.children = [pipeline.children[0]]
         self.pipeline = pipeline
         self.agg = agg
+        # fetch_host: the consumer finalizes on the host (COMPLETE
+        # rewrite) - fold the state fetch into one batched D2H. A
+        # standalone PARTIAL (feeding a device shuffle writer) keeps
+        # states device-resident and pays only the scalar sync.
+        self.fetch_host = fetch_host
         self._schema = agg.schema
 
     @property
@@ -202,6 +208,9 @@ class FusedAggregateExec(PhysicalOp):
         return f"FusedAggregateExec[{self.pipeline.describe()} -> partial]"
 
     def execute(self, partition: int, ctx: ExecContext):
+        from blaze_tpu.runtime.dispatch import host_int
+
+        first = True
         for cb in self.children[0].execute(partition, ctx):
             layout = cb.layout()
             fn = cached_kernel(
@@ -214,9 +223,17 @@ class FusedAggregateExec(PhysicalOp):
             outs, n_groups = fn(
                 cb.device_buffers(), cb.selection, cb.num_rows
             )
-            # one batched D2H for states + count (single round trip)
-            host_outs, host_n = device_get((outs, n_groups))
-            n = int(host_n)
+            if self.fetch_host and first:
+                # the single-batch-per-partition hot path: states + count
+                # in ONE batched D2H. Later batches (multi-batch stream
+                # headed for the device FINAL merge) stay device-resident
+                # and pay only the scalar sync.
+                host_outs, host_n = device_get((outs, n_groups))
+                n = int(host_n)
+            else:
+                host_outs = outs
+                n = host_int(n_groups)
+            first = False
             if n == 0:
                 continue
             cols = [
@@ -255,12 +272,15 @@ class FusedAggregateExec(PhysicalOp):
 
 
 class _IterChild(PhysicalOp):
-    """Single-partition child that replays pre-collected batches (feeds
-    the device-FINAL fallback of HostFinalAggExec)."""
+    """Single-partition, single-shot child that replays a batch head plus
+    a live stream (feeds the device-FINAL fallback of HostFinalAggExec
+    without materializing the stream)."""
 
-    def __init__(self, batches: List[ColumnBatch], schema: Schema):
+    def __init__(self, batches: List[ColumnBatch], schema: Schema,
+                 rest=None):
         self.children = []
         self.batches = batches
+        self.rest = rest
         self._schema = schema
 
     @property
@@ -273,6 +293,8 @@ class _IterChild(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext):
         yield from self.batches
+        if self.rest is not None:
+            yield from self.rest
 
 
 class HostFinalAggExec(PhysicalOp):
@@ -313,19 +335,23 @@ class HostFinalAggExec(PhysicalOp):
             _empty_global_row,
         )
 
-        partials = [
+        stream = (
             cb for cb in self.children[0].execute(partition, ctx)
             if cb.num_rows > 0
-        ]
-        if not partials:
+        )
+        first = next(stream, None)
+        if first is None:
             if not self.template.keys:
                 yield _empty_global_row(self.template)
             return
-        if len(partials) == 1:
-            yield self._finalize_host(partials[0])
+        second = next(stream, None)
+        if second is None:
+            yield self._finalize_host(first)
             return
+        # multi-batch: hand the STREAM to the device FINAL kernel, whose
+        # execute() owns the max_materialize_rows cap and grace-spill
+        # ladder - partials are not accumulated here
         partial_schema = self.children[0].schema
-        n_keys = len(self.template.keys)
         final = HashAggregateExec(
             _SchemaStub(partial_schema),
             keys=[
@@ -335,8 +361,9 @@ class HostFinalAggExec(PhysicalOp):
             aggs=[(a, n) for a, n in self.template.aggs],
             mode=AggMode.FINAL,
         )
-        src = _IterChild(partials, partial_schema)
-        final.children = [src]
+        final.children = [
+            _IterChild([first, second], partial_schema, rest=stream)
+        ]
         yield from final.execute(0, ctx)
 
     # ------------------------------------------------------------------
@@ -467,7 +494,8 @@ def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
                 mode=AggMode.PARTIAL,
             )
             return HostFinalAggExec(
-                FusedAggregateExec(pipeline, partial), op
+                FusedAggregateExec(pipeline, partial, fetch_host=True),
+                op,
             )
     chain, t = _collect_chain(op)
     if len(chain) >= 2:

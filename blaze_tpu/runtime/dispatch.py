@@ -18,6 +18,7 @@ what a per-query report wants.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Any, Callable, Dict, Tuple
 
@@ -30,7 +31,13 @@ _counts: Dict[str, int] = {}
 # Keys must capture everything that changes the traced program: op kind,
 # bound expression trees (ir.Expr is structurally hashable), schema dtype
 # descriptors, buffer layout, static config (capacities, modes).
-_KERNELS: Dict[Tuple, Callable] = {}
+# LRU-bounded: a long-lived worker seeing many structurally distinct
+# queries must not accumulate executables forever (per-plan caches used
+# to die with the plan object; this is the global replacement).
+_KERNELS: "collections.OrderedDict[Tuple, Callable]" = (
+    collections.OrderedDict()
+)
+_KERNEL_CACHE_CAP = 1024
 
 
 def record(kind: str, n: int = 1) -> None:
@@ -87,7 +94,10 @@ def cached_kernel(key: Tuple, build: Callable[[], Callable],
     `build()` returns the python function to jit; it runs only on cache
     miss. Each invocation of the returned callable records one
     "dispatches" count (steady state: one XLA execution per call)."""
-    fn = _KERNELS.get(key)
+    with _lock:
+        fn = _KERNELS.get(key)
+        if fn is not None:
+            _KERNELS.move_to_end(key)
     if fn is None:
         with _lock:
             fn = _KERNELS.get(key)
@@ -101,6 +111,8 @@ def cached_kernel(key: Tuple, build: Callable[[], Callable],
                     jax.jit(build(), **jit_kwargs), "dispatches"
                 )
                 _KERNELS[key] = fn
+                while len(_KERNELS) > _KERNEL_CACHE_CAP:
+                    _KERNELS.popitem(last=False)
     return fn
 
 
