@@ -11,7 +11,7 @@ numpy/pandas AND (where expressible) pyarrow.compute; the faster is the
 denominator. This host has one CPU core - the reference's DataFusion
 engine is likewise single-threaded per task.
 
-Usage: python benchmarks/run_report.py [--rows N] [--parts K]
+Usage: python benchmarks/run_report.py [--rows N]
 """
 
 from __future__ import annotations

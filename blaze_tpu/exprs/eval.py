@@ -462,9 +462,37 @@ class DeviceEvaluator:
         if n == "round":
             src_t = infer_dtype(e.args[0], self.schema)
             if src_t.is_integer:
-                return vs[0], m
-            # Spark HALF_UP rounding (not banker's)
+                # round(int, d>=0) is the identity; d<0 rounds to a
+                # power of ten with HALF_UP (Spark round(1250,-2)=1300).
+                # Only a literal scale is supported on the int path.
+                d = (
+                    e.args[1].value
+                    if len(e.args) > 1
+                    and isinstance(e.args[1], ir.Literal)
+                    else 0
+                )
+                if d is None or d >= 0:
+                    return vs[0], m
+                p = 10 ** (-d)
+                v = vs[0].astype(jnp.int64)
+                q = v // p
+                r = v - q * p
+                half = jnp.where(v >= 0, 2 * r >= p, 2 * r > p)
+                return ((q + half.astype(jnp.int64)) * p).astype(
+                    vs[0].dtype
+                ), m
+            # Spark HALF_UP rounding (not banker's), at optional scale
+            # (round(x, d) -> HALF_UP at 10^-d)
             v = f64(vs[0])
+            if len(vs) > 1:
+                scale = jnp.power(
+                    jnp.float64(10.0), f64(vs[1])
+                )
+                v = v * scale
+                r = jnp.where(
+                    v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5)
+                )
+                return r / scale, m
             return jnp.where(
                 v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5)
             ), m

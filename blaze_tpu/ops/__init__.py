@@ -18,7 +18,7 @@ from blaze_tpu.ops.memory_scan import MemoryScanExec
 from blaze_tpu.ops.project import ProjectExec
 from blaze_tpu.ops.filter import FilterExec
 from blaze_tpu.ops.sort import SortExec, SortKey
-from blaze_tpu.ops.union import UnionExec
+from blaze_tpu.ops.union import CoalescePartitionsExec, UnionExec
 from blaze_tpu.ops.limit import LimitExec
 from blaze_tpu.ops.rename import RenameColumnsExec
 from blaze_tpu.ops.empty import EmptyPartitionsExec
@@ -39,6 +39,7 @@ __all__ = [
     "SortExec",
     "SortKey",
     "UnionExec",
+    "CoalescePartitionsExec",
     "LimitExec",
     "RenameColumnsExec",
     "EmptyPartitionsExec",

@@ -183,10 +183,11 @@ class FusedAggregateExec(PhysicalOp):
     Each input batch flows scan -> filter/project stages -> sort-based
     partial aggregation without leaving the device or re-dispatching:
     stage evaluation and the aggregate kernel trace into a single jit.
-    The grouped partial state (at most one row per distinct group in the
-    batch - small) is fetched in ONE batched D2H together with the group
-    count, so downstream consumers (host finalization, shuffle IPC
-    encode) start from host-resident buffers with no further syncs."""
+    With fetch_host=True (the COMPLETE/host-finalize rewrite) the
+    grouped state of the first non-empty batch returns in ONE batched
+    D2H together with the group count; otherwise (standalone PARTIAL
+    feeding a device consumer) states stay device-resident and only the
+    group-count scalar syncs."""
 
     def __init__(self, pipeline: FusedPipelineExec, agg,
                  fetch_host: bool = False):
@@ -227,13 +228,17 @@ class FusedAggregateExec(PhysicalOp):
                 # the single-batch-per-partition hot path: states + count
                 # in ONE batched D2H. Later batches (multi-batch stream
                 # headed for the device FINAL merge) stay device-resident
-                # and pay only the scalar sync.
+                # and pay only the scalar sync. `first` stays set until a
+                # NON-EMPTY batch was host-fetched, so a filtered-out
+                # leading batch doesn't push the sole survivor onto the
+                # per-column-fetch path.
                 host_outs, host_n = device_get((outs, n_groups))
                 n = int(host_n)
+                if n > 0:
+                    first = False
             else:
                 host_outs = outs
                 n = host_int(n_groups)
-            first = False
             if n == 0:
                 continue
             cols = [

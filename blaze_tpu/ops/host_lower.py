@@ -58,9 +58,20 @@ class _Lowerer:
     def aug_schema(self) -> Schema:
         return Schema(list(self.schema.fields) + self.new_fields)
 
-    def lower(self, e: ir.Expr) -> ir.Expr:
+    def lower(self, e: ir.Expr, root: bool = False) -> ir.Expr:
+        if isinstance(e, ir.Literal):
+            if root and infer_dtype(
+                e, self.aug_schema()
+            ).is_string_like:
+                # a PROJECTED string constant becomes a one-entry
+                # dictionary column (codes all zero) - no device
+                # strings. Literals nested inside expressions (InList
+                # values, comparisons) stay in place: their parent is
+                # host-evaluated and consumes them natively.
+                return self._hoist_literal(e)
+            return e
         e = self._lower_children(e)
-        if isinstance(e, (ir.BoundCol, ir.Literal)):
+        if isinstance(e, ir.BoundCol):
             return e
         if any(
             infer_dtype(c, self.aug_schema()).is_string_like
@@ -73,6 +84,38 @@ class _Lowerer:
         return _rebuild_with_children(
             e, [self.lower(c) for c in ir.children(e)]
         )
+
+    def _hoist_literal(self, e: ir.Literal) -> ir.Expr:
+        if e in self._cache:
+            return self._cache[e]
+        cap = self.cb.capacity
+        dt = e.dtype
+        val_type = (
+            pa.binary() if dt.id is TypeId.BINARY else pa.utf8()
+        )
+        if e.value is None:
+            codes = jnp.zeros(cap, dtype=jnp.int32)
+            col = Column(
+                dt, codes, jnp.zeros(cap, dtype=jnp.bool_),
+                pa.array([], type=val_type),
+            )
+        else:
+            codes = jnp.zeros(cap, dtype=jnp.int32)
+            col = Column(
+                dt, codes, None,
+                pa.array([e.value], type=val_type),
+            )
+        idx = len(self.schema) + len(self.new_fields)
+        self.new_fields.append(Field(f"__host_{idx}", dt, True))
+        self.new_columns.append(col)
+        # keep the host-array view aligned with the augmented schema
+        n = self.cb.num_rows
+        self._arrays = self.arrays() + [
+            pa.array([e.value] * n, type=val_type)
+        ]
+        ref = ir.BoundCol(idx, dt)
+        self._cache[e] = ref
+        return ref
 
     def _hoist(self, e: ir.Expr) -> ir.Expr:
         if e in self._cache:
@@ -105,7 +148,7 @@ def lower_strings_host(
 ) -> Tuple[List[ir.Expr], int, ColumnBatch]:
     """Returns (rewritten exprs, n new columns, augmented batch)."""
     lw = _Lowerer(cb)
-    out = [lw.lower(e) for e in exprs]
+    out = [lw.lower(e, root=True) for e in exprs]
     if not lw.new_columns:
         return list(out), 0, cb
     aug = ColumnBatch(

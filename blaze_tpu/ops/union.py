@@ -37,3 +37,29 @@ class UnionExec(PhysicalOp):
                 return
             partition -= n
         raise IndexError("partition out of range")
+
+
+class CoalescePartitionsExec(PhysicalOp):
+    """Merge every child partition into one (Spark CoalescePartitionsExec;
+    the planner plants it below single-partition operators - e.g. a
+    COMPLETE aggregate or global sort - when no exchange re-partitions
+    the stream first)."""
+
+    def __init__(self, child: PhysicalOp):
+        self.children = [child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def partition_count(self) -> int:
+        return 1
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        if partition != 0:
+            raise IndexError("partition out of range")
+        child = self.children[0]
+        for p in range(child.partition_count):
+            yield from child.execute(p, ctx)

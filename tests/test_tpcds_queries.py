@@ -1,0 +1,417 @@
+"""TPC-DS q1-q10 whole-query differential matrix.
+
+Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
+runs twice - broadcast hash joins and forced sort-merge joins - and both
+results are validated against an independent pandas implementation of
+the same query (Spark join/NULL semantics hand-enforced: NULL join keys
+never match, NULL groups are kept, AVG ignores NULLs). Comparison is
+order-insensitive where the query's sort key is non-unique.
+
+Scale: BLAZE_TPCDS_ROWS (default 1M store_sales rows; returns/web/
+catalog scale proportionally).
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from blaze_tpu.runtime.executor import run_plan
+
+from tests.tpcds_support import QUERIES, gen_tables, scans_of
+
+
+@pytest.fixture(scope="module")
+def env():
+    from blaze_tpu.config import EngineConfig, set_config
+
+    n = int(os.environ.get("BLAZE_TPCDS_ROWS", 1_000_000))
+    set_config(
+        EngineConfig(
+            batch_size=max(n, 1 << 20),
+            shape_buckets=(256, 4096, 65536, 1 << 20, max(n, 1 << 20)),
+        )
+    )
+    t = gen_tables()
+    return t, scans_of(t)
+
+
+def run_query(scans, name, flavor):
+    plan = QUERIES[name](scans, flavor)
+    return run_plan(plan).to_pandas()
+
+
+def canon(df: pd.DataFrame) -> pd.DataFrame:
+    """Order-insensitive canonical form: sort by every column, with
+    numeric-like columns coerced to float so both frames sort the same
+    way regardless of nullable-int vs float representation."""
+    df = df.reset_index(drop=True).copy()
+    for c in df.columns:
+        try:
+            df[c] = pd.to_numeric(df[c], errors="raise").astype(
+                "float64")
+        except (ValueError, TypeError):
+            df[c] = df[c].astype("string")
+    return (
+        df.sort_values(list(df.columns), na_position="first")
+        .reset_index(drop=True)
+    )
+
+
+def assert_frames_match(got: pd.DataFrame, exp: pd.DataFrame, q: str):
+    assert list(got.columns) == list(exp.columns), (
+        q, list(got.columns), list(exp.columns))
+    g, e = canon(got), canon(exp)
+    assert len(g) == len(e), (q, len(g), len(e))
+    for c in g.columns:
+        gv, ev = g[c], e[c]
+        if gv.dtype.kind in "fc" or ev.dtype.kind in "fc":
+            ga = gv.astype(float).values
+            ea = ev.astype(float).values
+            both_nan = np.isnan(ga) & np.isnan(ea)
+            close = np.isclose(ga, ea, rtol=1e-6, atol=1e-6)
+            assert bool(np.all(both_nan | close)), (
+                q, c, ga[~(both_nan | close)][:5],
+                ea[~(both_nan | close)][:5],
+            )
+        else:
+            ga = gv.astype("string").fillna("\0null")
+            ea = ev.astype("string").fillna("\0null")
+            assert ga.tolist() == ea.tolist(), (q, c)
+
+
+# ---------------------------------------------------------------------------
+# pandas oracles (Spark semantics enforced by hand)
+# ---------------------------------------------------------------------------
+
+def _merge(left, right, lk, rk, how="inner"):
+    """Join with SQL NULL-key semantics: NULL never matches NULL."""
+    lf = left.dropna(subset=[lk] if isinstance(lk, str) else lk)
+    rf = right.dropna(subset=[rk] if isinstance(rk, str) else rk)
+    return lf.merge(rf, left_on=lk, right_on=rk, how=how)
+
+
+def oracle_q1(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    sr = _merge(t["store_returns"], dd[["d_date_sk"]],
+                "sr_returned_date_sk", "d_date_sk")
+    ctr = (
+        sr.groupby(["sr_customer_sk", "sr_store_sk"], dropna=False)
+        .sr_return_amt.sum().reset_index(name="ctr_total_return")
+    )
+    avg = (
+        ctr.groupby("sr_store_sk")
+        .ctr_total_return.mean().reset_index(name="avg_r")
+    )
+    m = ctr.merge(avg, on="sr_store_sk")
+    m = m[m.ctr_total_return > 1.2 * m.avg_r]
+    st = t["store"][t["store"].s_state == "TN"]
+    m = m.merge(st[["s_store_sk"]], left_on="sr_store_sk",
+                right_on="s_store_sk")
+    m = _merge(m, t["customer"][["c_customer_sk", "c_customer_id"]],
+               "sr_customer_sk", "c_customer_sk")
+    out = m.c_customer_id.sort_values().head(100)
+    return pd.DataFrame({"c_customer_id": out.values})
+
+
+def oracle_q2(t):
+    ws = t["web_sales"][["ws_sold_date_sk", "ws_ext_sales_price"]].rename(
+        columns={"ws_sold_date_sk": "sold_date_sk",
+                 "ws_ext_sales_price": "sales_price"})
+    cs = t["catalog_sales"][
+        ["cs_sold_date_sk", "cs_ext_sales_price"]
+    ].rename(columns={"cs_sold_date_sk": "sold_date_sk",
+                      "cs_ext_sales_price": "sales_price"})
+    both = pd.concat([ws, cs], ignore_index=True)
+    dd = t["date_dim"]
+    j = _merge(dd, both, "d_date_sk", "sold_date_sk")
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    cols = [f"{d.lower()[:3]}_sales" for d in days]
+    for d, c in zip(days, cols):
+        j[c] = j.sales_price.where(j.d_day_name == d)
+    wswscs = j.groupby("d_week_seq")[cols].sum(min_count=1).reset_index()
+    wk = dd.merge(wswscs, on="d_week_seq")
+    wk_year = (
+        wk.groupby(["d_week_seq", "d_year"])[cols].max().reset_index()
+    )
+    y1 = wk_year[wk_year.d_year == 1998].copy()
+    y2 = wk_year[wk_year.d_year == 1999].copy()
+    y2["d_week_seq"] = y2.d_week_seq - 53
+    m = y1.merge(y2, on="d_week_seq", suffixes=("1", "2"))
+    out = pd.DataFrame({"d_week_seq1": m.d_week_seq})
+    for c in cols:
+        out[c + "_r"] = (m[c + "1"] / m[c + "2"]).round(2)
+    return out.sort_values("d_week_seq1").reset_index(drop=True)
+
+
+def oracle_q3(t):
+    dd = t["date_dim"][t["date_dim"].d_moy == 11]
+    it = t["item"][t["item"].i_manufact_id == 128]
+    j = _merge(t["store_sales"], dd[["d_date_sk", "d_year"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(it[["i_item_sk", "i_brand_id", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby(["d_year", "i_brand_id", "i_brand"], dropna=False)
+        .ss_ext_sales_price.sum().reset_index(name="sum_agg")
+    )
+    agg = agg.rename(columns={"i_brand_id": "brand_id",
+                              "i_brand": "brand"})
+    agg = agg.sort_values(
+        ["d_year", "sum_agg", "brand_id"],
+        ascending=[True, False, True],
+    ).head(100)
+    return agg[["d_year", "brand_id", "brand", "sum_agg"]].reset_index(
+        drop=True)
+
+
+def _oracle_year_total(t, prefix, table, cust):
+    j = _merge(t[table], t["date_dim"][["d_date_sk", "d_year"]],
+               f"{prefix}_sold_date_sk", "d_date_sk")
+    j = _merge(
+        j, t["customer"][["c_customer_sk", "c_customer_id"]],
+        cust, "c_customer_sk",
+    )
+    j["yt"] = (j[f"{prefix}_ext_list_price"]
+               - j[f"{prefix}_ext_discount_amt"]) / 2.0
+    return (
+        j.groupby(["c_customer_sk", "c_customer_id", "d_year"])
+        .yt.sum().reset_index(name="year_total")
+    )
+
+
+def oracle_q4(t):
+    s_yt = _oracle_year_total(t, "ss", "store_sales", "ss_customer_sk")
+    c_yt = _oracle_year_total(
+        t, "cs", "catalog_sales", "cs_bill_customer_sk")
+
+    def pick(df, year):
+        return df[df.d_year == year][
+            ["c_customer_sk", "c_customer_id", "year_total"]
+        ]
+
+    s1, s2 = pick(s_yt, 1998), pick(s_yt, 1999)
+    c1, c2 = pick(c_yt, 1998), pick(c_yt, 1999)
+    m = s1.merge(s2, on="c_customer_sk", suffixes=("_s1", "_s2"))
+    m = m.merge(c1.rename(columns={"year_total": "yt_c1"}),
+                on="c_customer_sk")
+    m = m.merge(
+        c2.rename(columns={"year_total": "yt_c2"})[
+            ["c_customer_sk", "yt_c2"]],
+        on="c_customer_sk",
+    )
+    m = m[(m.year_total_s1 > 0) & (m.yt_c1 > 0)]
+    m = m[m.yt_c2 / m.yt_c1 > m.year_total_s2 / m.year_total_s1]
+    out = m.c_customer_id_s1.sort_values().head(100)
+    return pd.DataFrame({"s1_id": out.values})
+
+
+def oracle_q5(t):
+    dd98 = t["date_dim"][t["date_dim"].d_year == 1998][["d_date_sk"]]
+
+    def channel(sales, s_date, s_id, s_price, rets, r_date, r_id, r_amt,
+                name):
+        a = sales[[s_date, s_id, s_price]].rename(
+            columns={s_date: "date_sk", s_id: "id",
+                     s_price: "sales_price"})
+        a["return_amt"] = 0.0
+        b = rets[[r_date, r_id, r_amt]].rename(
+            columns={r_date: "date_sk", r_id: "id", r_amt: "return_amt"})
+        b["sales_price"] = 0.0
+        both = pd.concat(
+            [a[["date_sk", "id", "sales_price", "return_amt"]],
+             b[["date_sk", "id", "sales_price", "return_amt"]]],
+            ignore_index=True,
+        )
+        j = _merge(both, dd98, "date_sk", "d_date_sk")
+        j["channel"] = name
+        return j[["channel", "id", "sales_price", "return_amt"]]
+
+    all_ch = pd.concat(
+        [
+            channel(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+                    "ss_ext_sales_price", t["store_returns"],
+                    "sr_returned_date_sk", "sr_item_sk",
+                    "sr_return_amt", "store channel"),
+            channel(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+                    "cs_ext_sales_price", t["catalog_returns"],
+                    "cr_returned_date_sk", "cr_item_sk",
+                    "cr_return_amount", "catalog channel"),
+            channel(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+                    "ws_ext_sales_price", t["web_returns"],
+                    "wr_returned_date_sk", "wr_item_sk",
+                    "wr_return_amt", "web channel"),
+        ],
+        ignore_index=True,
+    )
+    detail = (
+        all_ch.groupby(["channel", "id"])
+        .agg(sales=("sales_price", "sum"), returns_=("return_amt", "sum"))
+        .reset_index()
+    )
+    by_ch = detail.groupby("channel")[["sales", "returns_"]].sum(
+    ).reset_index()
+    by_ch["id"] = pd.NA
+    grand = pd.DataFrame(
+        {"channel": [pd.NA], "id": [pd.NA],
+         "sales": [detail.sales.sum()],
+         "returns_": [detail.returns_.sum()]}
+    )
+    out = pd.concat(
+        [detail, by_ch[["channel", "id", "sales", "returns_"]], grand],
+        ignore_index=True,
+    )
+    return out[["channel", "id", "sales", "returns_"]]
+
+
+def oracle_q6(t):
+    dd = t["date_dim"]
+    target = set(
+        dd[(dd.d_year == 1999) & (dd.d_moy == 1)].d_month_seq.unique()
+    )
+    dates = dd[dd.d_month_seq.isin(target)][["d_date_sk"]]
+    it = t["item"]
+    cat_avg = (
+        it.dropna(subset=["i_category"])
+        .groupby("i_category").i_current_price.mean()
+        .reset_index(name="cat_avg")
+    )
+    pricey = it.merge(cat_avg, on="i_category")
+    pricey = pricey[pricey.i_current_price > 1.2 * pricey.cat_avg]
+    j = _merge(t["store_sales"], dates, "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(pricey[["i_item_sk"]], left_on="ss_item_sk",
+                right_on="i_item_sk")
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "ss_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_state"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    agg = (
+        j.groupby("ca_state", dropna=False).size().reset_index(name="cnt")
+    )
+    agg = agg[agg.cnt >= 10].rename(columns={"ca_state": "state"})
+    agg = agg.sort_values(
+        ["cnt", "state"], na_position="first").head(100)
+    return agg[["state", "cnt"]].reset_index(drop=True)
+
+
+def oracle_q7(t):
+    cd = t["customer_demographics"]
+    cd = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+            & (cd.cd_education_status == "College")]
+    pr = t["promotion"]
+    pr = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")]
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(cd[["cd_demo_sk"]], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(pr[["p_promo_sk"]], left_on="ss_promo_sk",
+                right_on="p_promo_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby("i_item_id")
+        .agg(agg1=("ss_quantity", "mean"),
+             agg2=("ss_list_price", "mean"),
+             agg3=("ss_coupon_amt", "mean"),
+             agg4=("ss_sales_price", "mean"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q8(t):
+    zip_list = [f"{(24000 + (i % 500) * 131) % 90000:05d}"
+                for i in range(0, 400)][:200]
+    ca = t["customer_address"]
+    a_side = ca[ca.ca_zip.str[:5].isin(set(zip_list))].copy()
+    a_side["zip5"] = a_side.ca_zip.str[:5]
+    pref = t["customer"][t["customer"].c_preferred_cust_flag == "Y"]
+    pz = ca.merge(pref[["c_current_addr_sk"]],
+                  left_on="ca_address_sk", right_on="c_current_addr_sk")
+    pz["zip5"] = pz.ca_zip.str[:5]
+    counts = pz.groupby("zip5").size().reset_index(name="cnt")
+    good = set(counts[counts.cnt > 10].zip5)
+    both = a_side[a_side.zip5.isin(good)]
+    zip2 = set(both.zip5.str[:2])
+    st = t["store"].copy()
+    st["s_zip2"] = st.s_zip.str[:2]
+    qual = st[st.s_zip2.isin(zip2)]
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1998) & (dd.d_moy == 2)]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(qual[["s_store_sk", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    agg = (
+        j.groupby("s_store_name").ss_net_profit.sum()
+        .reset_index(name="net_profit")
+    )
+    return agg.sort_values("s_store_name").head(100).reset_index(
+        drop=True)
+
+
+def oracle_q9(t):
+    ss = t["store_sales"]
+    row = {}
+    for i, (lo, hi) in enumerate(
+        [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)], 1
+    ):
+        sel = ss[(ss.ss_quantity >= lo) & (ss.ss_quantity <= hi)]
+        cnt = len(sel)
+        row[f"bucket{i}"] = (
+            sel.ss_ext_discount_amt.mean()
+            if cnt > 7438 else sel.ss_net_profit.mean()
+        )
+    return pd.DataFrame([row])
+
+
+def oracle_q10(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 2000) & (dd.d_moy >= 1) & (dd.d_moy <= 4)][
+        ["d_date_sk"]]
+
+    def active(df, date_col, cust_col):
+        j = _merge(df, dd, date_col, "d_date_sk")
+        return set(j[cust_col].dropna())
+
+    store_set = active(t["store_sales"], "ss_sold_date_sk",
+                       "ss_customer_sk")
+    other_set = active(
+        t["web_sales"], "ws_sold_date_sk", "ws_bill_customer_sk"
+    ) | active(
+        t["catalog_sales"], "cs_sold_date_sk", "cs_bill_customer_sk"
+    )
+    c = t["customer"]
+    c = c[c.c_customer_sk.isin(store_set)
+          & c.c_customer_sk.isin(other_set)]
+    ca = t["customer_address"]
+    ca = ca[ca.ca_county.isin(["Rich County", "Walker County"])]
+    j = c.merge(ca[["ca_address_sk"]], left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = _merge(j, t["customer_demographics"],
+               "c_current_cdemo_sk", "cd_demo_sk")
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating"]
+    agg = j.groupby(keys, dropna=False).size().reset_index(name="cnt")
+    agg = agg.sort_values(keys, na_position="first").head(100)
+    return agg[keys + ["cnt"]].reset_index(drop=True)
+
+
+ORACLES = {
+    "q1": oracle_q1, "q2": oracle_q2, "q3": oracle_q3, "q4": oracle_q4,
+    "q5": oracle_q5, "q6": oracle_q6, "q7": oracle_q7, "q8": oracle_q8,
+    "q9": oracle_q9, "q10": oracle_q10,
+}
+
+
+@pytest.mark.parametrize("flavor", ["bhj", "smj"])
+@pytest.mark.parametrize("q", sorted(QUERIES, key=lambda x: int(x[1:])))
+def test_tpcds_query(env, q, flavor):
+    tables, scans = env
+    got = run_query(scans, q, flavor)
+    exp = ORACLES[q](tables)
+    exp.columns = list(got.columns)  # positional contract
+    assert_frames_match(got, exp, f"{q}/{flavor}")

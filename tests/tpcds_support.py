@@ -1,0 +1,961 @@
+"""TPC-DS q1-q10 as engine plan builders over synthetic tables.
+
+The reference's correctness backbone is whole-query differential testing:
+99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
+vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
+38-57). This module is that harness's engine side for q1-q10: each query
+is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
+unions, semi/anti joins, decorrelated subqueries - the same rewrites
+Spark's optimizer performs) built twice, once with broadcast hash joins
+and once with forced sort-merge joins. Oracles live in
+test_tpcds_queries.py as independent pandas implementations.
+
+Scale is configurable (BLAZE_TPCDS_ROWS, default 1M store_sales rows);
+all generated data is deterministic (seeded) and includes NULL keys.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import (
+    AggExpr,
+    AggFn,
+    CaseWhen,
+    Col,
+    If,
+    InList,
+    IsNotNull,
+    Literal,
+    ScalarFn,
+)
+from blaze_tpu.ops import (
+    AggMode,
+    CoalescePartitionsExec,
+    FilterExec,
+    HashAggregateExec,
+    HashJoinExec,
+    JoinType,
+    LimitExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+    SortExec,
+    SortKey,
+    SortMergeJoinExec,
+    UnionExec,
+)
+from blaze_tpu.types import DataType
+
+N_SALES = int(os.environ.get("BLAZE_TPCDS_ROWS", 1_000_000))
+N_DATES = 1461  # 4 years
+N_ITEMS = 2_000
+N_CUSTOMERS = 20_000
+N_STORES = 12
+N_ADDRESSES = 10_000
+N_CDEMO = 500
+N_PROMOS = 30
+
+_STATES = ["TN", "GA", "CA", "TX", "OH", "NY", None]
+_CATEGORIES = ["Books", "Music", "Home", "Sports", "Shoes"]
+_GENDERS = ["M", "F"]
+_MARITAL = ["S", "M", "D", "W"]
+_EDU = ["College", "Primary", "2 yr Degree", "4 yr Degree"]
+_YN = ["Y", "N"]
+
+
+def gen_tables(seed: int = 20260729):
+    rng = np.random.default_rng(seed)
+    n = N_SALES
+
+    def pick(values, size, null_frac=0.0):
+        idx = rng.integers(0, len(values), size)
+        out = np.array([values[i] for i in idx], dtype=object)
+        if null_frac:
+            out[rng.random(size) < null_frac] = None
+        return out
+
+    date_dim = pd.DataFrame(
+        {
+            "d_date_sk": np.arange(N_DATES, dtype=np.int32),
+            "d_year": (1998 + np.arange(N_DATES) // 365).astype(np.int32),
+            "d_moy": ((np.arange(N_DATES) % 365) // 31 % 12 + 1).astype(
+                np.int32),
+            "d_month_seq": (
+                (1998 - 1900) * 12
+                + (np.arange(N_DATES) // 365) * 12
+                + ((np.arange(N_DATES) % 365) // 31 % 12)
+            ).astype(np.int32),
+            "d_week_seq": (np.arange(N_DATES) // 7).astype(np.int32),
+            "d_day_name": np.array(
+                ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+                 "Friday", "Saturday"], dtype=object,
+            )[np.arange(N_DATES) % 7],
+            "d_dom": ((np.arange(N_DATES) % 31) + 1).astype(np.int32),
+        }
+    )
+
+    def sales_frame(prefix, size, date_null=0.01, cust_null=0.01):
+        dsk = rng.integers(0, N_DATES, size).astype(np.float64)
+        dsk[rng.random(size) < date_null] = np.nan
+        csk = rng.integers(0, N_CUSTOMERS, size).astype(np.float64)
+        csk[rng.random(size) < cust_null] = np.nan
+        return {
+            f"{prefix}_sold_date_sk": pd.array(
+                dsk, dtype=pd.Int32Dtype()
+            ),
+            f"{prefix}_item_sk": rng.integers(0, N_ITEMS, size).astype(
+                np.int32),
+            f"{prefix}_ext_sales_price": np.round(
+                rng.random(size) * 2000, 2),
+            f"{prefix}_ext_list_price": np.round(
+                rng.random(size) * 2500, 2),
+            f"{prefix}_ext_wholesale_cost": np.round(
+                rng.random(size) * 1500, 2),
+            f"{prefix}_ext_discount_amt": np.round(
+                rng.random(size) * 100, 2),
+            f"{prefix}_customer_sk": pd.array(
+                csk, dtype=pd.Int32Dtype()
+            ),
+        }
+
+    store_sales = pd.DataFrame(sales_frame("ss", n))
+    store_sales["ss_store_sk"] = rng.integers(0, N_STORES, n).astype(
+        np.int32)
+    store_sales["ss_cdemo_sk"] = rng.integers(0, N_CDEMO, n).astype(
+        np.int32)
+    store_sales["ss_promo_sk"] = rng.integers(0, N_PROMOS, n).astype(
+        np.int32)
+    store_sales["ss_quantity"] = rng.integers(1, 101, n).astype(np.int32)
+    store_sales["ss_sales_price"] = np.round(rng.random(n) * 200, 2)
+    store_sales["ss_list_price"] = np.round(rng.random(n) * 250, 2)
+    store_sales["ss_coupon_amt"] = np.round(rng.random(n) * 50, 2)
+    store_sales["ss_net_profit"] = np.round(rng.random(n) * 300 - 50, 2)
+
+    n_sr = max(n // 10, 1000)
+    store_returns = pd.DataFrame(
+        {
+            "sr_returned_date_sk": rng.integers(
+                0, N_DATES, n_sr).astype(np.int32),
+            "sr_customer_sk": pd.array(
+                np.where(
+                    rng.random(n_sr) < 0.02, np.nan,
+                    rng.integers(0, N_CUSTOMERS, n_sr).astype(np.float64),
+                ),
+                dtype=pd.Int32Dtype(),
+            ),
+            "sr_store_sk": rng.integers(0, N_STORES, n_sr).astype(
+                np.int32),
+            "sr_item_sk": rng.integers(0, N_ITEMS, n_sr).astype(np.int32),
+            "sr_return_amt": np.round(rng.random(n_sr) * 500, 2),
+            "sr_net_loss": np.round(rng.random(n_sr) * 100, 2),
+        }
+    )
+
+    n_ws = max(n // 4, 1000)
+    web_sales = pd.DataFrame(sales_frame("ws", n_ws))
+    web_sales = web_sales.rename(
+        columns={"ws_customer_sk": "ws_bill_customer_sk"}
+    )
+    n_cs = max(n // 3, 1000)
+    catalog_sales = pd.DataFrame(sales_frame("cs", n_cs))
+    catalog_sales = catalog_sales.rename(
+        columns={"cs_customer_sk": "cs_bill_customer_sk"}
+    )
+    n_wr = max(n_ws // 10, 200)
+    web_returns = pd.DataFrame(
+        {
+            "wr_returned_date_sk": rng.integers(0, N_DATES, n_wr).astype(
+                np.int32),
+            "wr_item_sk": rng.integers(0, N_ITEMS, n_wr).astype(np.int32),
+            "wr_return_amt": np.round(rng.random(n_wr) * 400, 2),
+            "wr_net_loss": np.round(rng.random(n_wr) * 80, 2),
+        }
+    )
+    n_cr = max(n_cs // 10, 200)
+    catalog_returns = pd.DataFrame(
+        {
+            "cr_returned_date_sk": rng.integers(0, N_DATES, n_cr).astype(
+                np.int32),
+            "cr_item_sk": rng.integers(0, N_ITEMS, n_cr).astype(np.int32),
+            "cr_return_amount": np.round(rng.random(n_cr) * 450, 2),
+            "cr_net_loss": np.round(rng.random(n_cr) * 90, 2),
+        }
+    )
+
+    store = pd.DataFrame(
+        {
+            "s_store_sk": np.arange(N_STORES, dtype=np.int32),
+            "s_store_name": [f"store_{i%7}" for i in range(N_STORES)],
+            "s_state": pick(_STATES[:-1], N_STORES),
+            "s_zip": [f"{35000 + i * 97 % 60000:05d}" for i in
+                      range(N_STORES)],
+        }
+    )
+    customer = pd.DataFrame(
+        {
+            "c_customer_sk": np.arange(N_CUSTOMERS, dtype=np.int32),
+            "c_customer_id": [
+                f"AAAAAAAA{i:08d}" for i in range(N_CUSTOMERS)
+            ],
+            "c_current_addr_sk": rng.integers(
+                0, N_ADDRESSES, N_CUSTOMERS).astype(np.int32),
+            "c_current_cdemo_sk": pd.array(
+                np.where(
+                    rng.random(N_CUSTOMERS) < 0.05, np.nan,
+                    rng.integers(0, N_CDEMO, N_CUSTOMERS).astype(
+                        np.float64),
+                ),
+                dtype=pd.Int32Dtype(),
+            ),
+            "c_preferred_cust_flag": pick(_YN, N_CUSTOMERS, 0.02),
+            "c_first_name": pick(
+                ["John", "Jane", "Alex", "Sam", "Pat"], N_CUSTOMERS),
+            "c_last_name": pick(
+                ["Smith", "Jones", "Lee", "Patel", "Kim"], N_CUSTOMERS),
+            "c_birth_year": pd.array(
+                np.where(
+                    rng.random(N_CUSTOMERS) < 0.03, np.nan,
+                    rng.integers(1924, 1993, N_CUSTOMERS).astype(
+                        np.float64),
+                ),
+                dtype=pd.Int32Dtype(),
+            ),
+        }
+    )
+    customer_address = pd.DataFrame(
+        {
+            "ca_address_sk": np.arange(N_ADDRESSES, dtype=np.int32),
+            "ca_state": pick(_STATES, N_ADDRESSES, 0.02),
+            # ~500 distinct zips -> ~20 addresses per zip, so q8's
+            # ">10 preferred customers per zip" predicate selects a
+            # non-trivial subset
+            "ca_zip": [
+                f"{(24000 + (i % 500) * 131) % 90000:05d}" for i in
+                range(N_ADDRESSES)
+            ],
+            "ca_county": pick(
+                ["Rich County", "Ziebach County", "Walker County"],
+                N_ADDRESSES,
+            ),
+        }
+    )
+    customer_demographics = pd.DataFrame(
+        {
+            "cd_demo_sk": np.arange(N_CDEMO, dtype=np.int32),
+            "cd_gender": pick(_GENDERS, N_CDEMO),
+            "cd_marital_status": pick(_MARITAL, N_CDEMO),
+            "cd_education_status": pick(_EDU, N_CDEMO),
+            "cd_purchase_estimate": rng.integers(
+                500, 10000, N_CDEMO).astype(np.int32),
+            "cd_credit_rating": pick(
+                ["Low Risk", "Good", "High Risk"], N_CDEMO),
+            "cd_dep_count": rng.integers(0, 7, N_CDEMO).astype(np.int32),
+            "cd_dep_employed_count": rng.integers(0, 7, N_CDEMO).astype(
+                np.int32),
+            "cd_dep_college_count": rng.integers(0, 7, N_CDEMO).astype(
+                np.int32),
+        }
+    )
+    item = pd.DataFrame(
+        {
+            "i_item_sk": np.arange(N_ITEMS, dtype=np.int32),
+            "i_item_id": [f"ITEM{i:08d}" for i in range(N_ITEMS)],
+            "i_item_desc": pick(
+                ["desc one", "desc two", "desc three"], N_ITEMS),
+            "i_current_price": np.round(
+                rng.random(N_ITEMS) * 100 + 0.5, 2),
+            "i_category": pick(_CATEGORIES, N_ITEMS, 0.01),
+            "i_brand": pick(
+                [f"brand_{j}" for j in range(20)], N_ITEMS),
+            "i_brand_id": rng.integers(1, 21, N_ITEMS).astype(np.int32),
+            "i_manufact_id": rng.integers(1, 200, N_ITEMS).astype(
+                np.int32),
+            "i_manager_id": rng.integers(1, 100, N_ITEMS).astype(
+                np.int32),
+        }
+    )
+    promotion = pd.DataFrame(
+        {
+            "p_promo_sk": np.arange(N_PROMOS, dtype=np.int32),
+            "p_channel_email": pick(_YN, N_PROMOS),
+            "p_channel_event": pick(_YN, N_PROMOS),
+        }
+    )
+    reason = pd.DataFrame(
+        {
+            "r_reason_sk": np.arange(1, 10, dtype=np.int32),
+            "r_reason_desc": [f"reason {i}" for i in range(1, 10)],
+        }
+    )
+    return {
+        "date_dim": date_dim,
+        "store_sales": store_sales,
+        "store_returns": store_returns,
+        "web_sales": web_sales,
+        "catalog_sales": catalog_sales,
+        "web_returns": web_returns,
+        "catalog_returns": catalog_returns,
+        "store": store,
+        "customer": customer,
+        "customer_address": customer_address,
+        "customer_demographics": customer_demographics,
+        "item": item,
+        "promotion": promotion,
+        "reason": reason,
+    }
+
+
+def scans_of(tables: dict) -> dict:
+    """MemoryScanExec per table (device-staged once per session)."""
+    out = {}
+    for name, df in tables.items():
+        rb = pa.RecordBatch.from_pandas(df, preserve_index=False)
+        cb = ColumnBatch.from_arrow(rb)
+        out[name] = lambda cb=cb: MemoryScanExec([[cb]], cb.schema)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# plan-building helpers
+# ---------------------------------------------------------------------------
+
+def _union(children):
+    """UNION ALL coalesced to one partition (the exchange Spark's
+    planner would insert below a single-partition consumer)."""
+    return CoalescePartitionsExec(UnionExec(children))
+
+
+def _join(flavor, left, right, lk, rk, jt=JoinType.INNER):
+    """BHJ (left = build/broadcast side) or forced SMJ - the two CI
+    flavors of the reference (tpcds.yml:139-147)."""
+    if flavor == "bhj":
+        return HashJoinExec(left, right, lk, rk, jt)
+    return SortMergeJoinExec(left, right, lk, rk, jt)
+
+
+def _semi(flavor, left, right, lk, rk):
+    """left SEMI right regardless of flavor's build-side convention."""
+    if flavor == "bhj":
+        # HashJoinExec LEFT_SEMI emits the build (left) side
+        return HashJoinExec(left, right, lk, rk, JoinType.LEFT_SEMI)
+    return SortMergeJoinExec(left, right, lk, rk, JoinType.LEFT_SEMI)
+
+
+def _agg(child, keys, aggs, mode=AggMode.COMPLETE):
+    return HashAggregateExec(child, keys=keys, aggs=aggs, mode=mode)
+
+
+def _project_names(child, names):
+    return ProjectExec(child, [(Col(n), n) for n in names])
+
+
+def _sorted_limit(child, sort_keys, limit):
+    return LimitExec(SortExec(child, sort_keys), limit)
+
+
+# ---------------------------------------------------------------------------
+# queries
+# ---------------------------------------------------------------------------
+
+def q1(s, flavor):
+    """TPC-DS q1: customers returning >1.2x the store-average return.
+    CTE customer_total_return; correlated subquery decorrelated into a
+    per-store AVG join (Spark plans it the same way)."""
+    ctr = _agg(
+        _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 2000),
+            s["store_returns"](),
+            ["d_date_sk"], ["sr_returned_date_sk"],
+        ),
+        keys=[(Col("sr_customer_sk"), "ctr_customer_sk"),
+              (Col("sr_store_sk"), "ctr_store_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sr_return_amt")),
+               "ctr_total_return")],
+    )
+    avg_ctr = ProjectExec(
+        _agg(
+            ctr,
+            keys=[(Col("ctr_store_sk"), "avg_store_sk")],
+            aggs=[(AggExpr(AggFn.AVG, Col("ctr_total_return")), "avg_r")],
+        ),
+        [(Col("avg_store_sk"), "avg_store_sk"),
+         (Col("avg_r") * 1.2, "threshold")],
+    )
+    ctr2 = _agg(
+        _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 2000),
+            s["store_returns"](),
+            ["d_date_sk"], ["sr_returned_date_sk"],
+        ),
+        keys=[(Col("sr_customer_sk"), "ctr_customer_sk"),
+              (Col("sr_store_sk"), "ctr_store_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sr_return_amt")),
+               "ctr_total_return")],
+    )
+    over = FilterExec(
+        _join(flavor, avg_ctr, ctr2, ["avg_store_sk"], ["ctr_store_sk"]),
+        Col("ctr_total_return") > Col("threshold"),
+    )
+    with_store = _join(
+        flavor,
+        FilterExec(s["store"](), Col("s_state") == "TN"),
+        over,
+        ["s_store_sk"], ["ctr_store_sk"],
+    )
+    with_cust = _join(
+        flavor, with_store, s["customer"](),
+        ["ctr_customer_sk"], ["c_customer_sk"],
+    )
+    return _sorted_limit(
+        _project_names(with_cust, ["c_customer_id"]),
+        [SortKey(Col("c_customer_id"), True, True)],
+        100,
+    )
+
+
+def q2(s, flavor):
+    """TPC-DS q2: weekly web+catalog sales pivoted by day name, year vs
+    year+1 ratio on aligned week_seq (self-join at +53 weeks)."""
+    def wscs(prefix, table):
+        return ProjectExec(
+            s[table](),
+            [(Col(f"{prefix}_sold_date_sk"), "sold_date_sk"),
+             (Col(f"{prefix}_ext_sales_price"), "sales_price")],
+        )
+
+    both = _union([wscs("ws", "web_sales"), wscs("cs", "catalog_sales")])
+    joined = _join(
+        flavor, s["date_dim"](), both, ["d_date_sk"], ["sold_date_sk"]
+    )
+
+    def day_sum(day):
+        return AggExpr(
+            AggFn.SUM,
+            If(Col("d_day_name") == day, Col("sales_price"),
+               Literal(None, DataType.float64())),
+        )
+
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    wswscs = _agg(
+        joined,
+        keys=[(Col("d_week_seq"), "d_week_seq")],
+        aggs=[(day_sum(d), f"{d.lower()[:3]}_sales") for d in days],
+    )
+    cols = [f"{d.lower()[:3]}_sales" for d in days]
+    # year 1998 weeks vs 1999 weeks, aligned by week_seq + 53
+    wk_year = _agg(
+        _join(flavor, s["date_dim"](), wswscs,
+              ["d_week_seq"], ["d_week_seq"]),
+        keys=[(Col("d_week_seq"), "week_seq"), (Col("d_year"), "year")],
+        aggs=[(AggExpr(AggFn.MAX, Col(c)), c) for c in cols],
+    )
+    y1 = RenameColumnsExec(
+        FilterExec(wk_year, Col("year") == 1998),
+        ["week_seq1", "year1"] + [c + "1" for c in cols],
+    )
+    y2 = ProjectExec(
+        FilterExec(wk_year, Col("year") == 1999),
+        [(Col("week_seq") - 53, "week_seq2")]
+        + [(Col(c), c + "2") for c in cols],
+    )
+    paired = _join(flavor, y1, y2, ["week_seq1"], ["week_seq2"])
+    ratios = ProjectExec(
+        paired,
+        [(Col("week_seq1"), "d_week_seq1")]
+        + [
+            (ScalarFn("round", (Col(c + "1") / Col(c + "2"),
+                                Literal(2, DataType.int32()))), c + "_r")
+            for c in cols
+        ],
+    )
+    return SortExec(ratios, [SortKey(Col("d_week_seq1"), True, True)])
+
+
+def q3(s, flavor):
+    """TPC-DS q3: brand revenue for one manufacturer in November."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_moy") == 11),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j2 = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_manufact_id") == 128),
+        j,
+        ["i_item_sk"], ["ss_item_sk"],
+    )
+    agg = _agg(
+        j2,
+        keys=[(Col("d_year"), "d_year"),
+              (Col("i_brand_id"), "brand_id"),
+              (Col("i_brand"), "brand")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")), "sum_agg")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("d_year"), True, True),
+         SortKey(Col("sum_agg"), False, False),
+         SortKey(Col("brand_id"), True, True)],
+        100,
+    )
+
+
+def _year_total(s, flavor, prefix, table, cust_col):
+    """q4/q11 CTE: per customer per year net revenue for one channel."""
+    j = _join(
+        flavor,
+        s["date_dim"](),
+        s[table](),
+        ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+    )
+    j2 = _join(
+        flavor, s["customer"](), j,
+        ["c_customer_sk"], [cust_col],
+    )
+    return _agg(
+        j2,
+        keys=[(Col("c_customer_sk"), "customer_sk"),
+              (Col("c_customer_id"), "customer_id"),
+              (Col("d_year"), "dyear")],
+        aggs=[
+            (
+                AggExpr(
+                    AggFn.SUM,
+                    (Col(f"{prefix}_ext_list_price")
+                     - Col(f"{prefix}_ext_discount_amt")) / 2.0,
+                ),
+                "year_total",
+            )
+        ],
+    )
+
+
+def q4(s, flavor):
+    """TPC-DS q4 (2-channel variant = q11 shape): customers whose
+    catalog-channel growth outpaces store-channel growth across two
+    years. 4-way self-join of the year_total CTE."""
+    def yt(prefix, table, cust_col, year, names):
+        base = _year_total(s, flavor, prefix, table, cust_col)
+        return RenameColumnsExec(
+            FilterExec(base, Col("dyear") == year), names
+        )
+
+    ts1 = yt("ss", "store_sales", "ss_customer_sk", 1998,
+             ["s1_sk", "s1_id", "s1_year", "s1_total"])
+    ts2 = yt("ss", "store_sales", "ss_customer_sk", 1999,
+             ["s2_sk", "s2_id", "s2_year", "s2_total"])
+    tc1 = yt("cs", "catalog_sales", "cs_bill_customer_sk", 1998,
+             ["c1_sk", "c1_id", "c1_year", "c1_total"])
+    tc2 = yt("cs", "catalog_sales", "cs_bill_customer_sk", 1999,
+             ["c2_sk", "c2_id", "c2_year", "c2_total"])
+    j = _join(flavor, ts1, ts2, ["s1_sk"], ["s2_sk"])
+    j = _join(flavor, tc1, j, ["c1_sk"], ["s1_sk"])
+    j = _join(flavor, tc2, j, ["c2_sk"], ["c1_sk"])
+    cond = FilterExec(
+        FilterExec(j, (Col("s1_total") > 0) & (Col("c1_total") > 0)),
+        Col("c2_total") / Col("c1_total")
+        > Col("s2_total") / Col("s1_total"),
+    )
+    return _sorted_limit(
+        _project_names(cond, ["s1_id"]),
+        [SortKey(Col("s1_id"), True, True)],
+        100,
+    )
+
+
+def q5(s, flavor):
+    """TPC-DS q5 (rollup as explicit grouping-set union): per-channel
+    sales/returns/profit, plus the channel and grand totals."""
+    def channel(sales_prefix, sales_table, ret_prefix, ret_table,
+                ret_amt_col, channel_name, id_prefix):
+        sales = ProjectExec(
+            s[sales_table](),
+            [(Col(f"{sales_prefix}_sold_date_sk"), "date_sk"),
+             (Col(f"{sales_prefix}_item_sk"), "id"),
+             (Col(f"{sales_prefix}_ext_sales_price"), "sales_price"),
+             (Literal(0.0, DataType.float64()), "return_amt")],
+        )
+        rets = ProjectExec(
+            s[ret_table](),
+            [(Col(f"{ret_prefix}_returned_date_sk"), "date_sk"),
+             (Col(f"{ret_prefix}_item_sk"), "id"),
+             (Literal(0.0, DataType.float64()), "sales_price"),
+             (Col(ret_amt_col), "return_amt")],
+        )
+        both = _union([sales, rets])
+        dated = _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 1998),
+            both,
+            ["d_date_sk"], ["date_sk"],
+        )
+        return ProjectExec(
+            dated,
+            [(Literal(channel_name, DataType.utf8()), "channel"),
+             (Col("id"), "id"),
+             (Col("sales_price"), "sales_price"),
+             (Col("return_amt"), "return_amt")],
+        )
+
+    all_ch = _union([
+        channel("ss", "store_sales", "sr", "store_returns",
+                "sr_return_amt", "store channel", "store"),
+        channel("cs", "catalog_sales", "cr", "catalog_returns",
+                "cr_return_amount", "catalog channel", "catalog"),
+        channel("ws", "web_sales", "wr", "web_returns",
+                "wr_return_amt", "web channel", "web"),
+    ])
+    detail = _agg(
+        all_ch,
+        keys=[(Col("channel"), "channel"), (Col("id"), "id")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sales_price")), "sales"),
+              (AggExpr(AggFn.SUM, Col("return_amt")), "returns_")],
+    )
+    by_channel = ProjectExec(
+        _agg(
+            detail,
+            keys=[(Col("channel"), "channel")],
+            aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+                  (AggExpr(AggFn.SUM, Col("returns_")), "returns_")],
+        ),
+        [(Col("channel"), "channel"),
+         (Literal(None, DataType.int32()), "id"),
+         (Col("sales"), "sales"), (Col("returns_"), "returns_")],
+    )
+    grand = ProjectExec(
+        _agg(
+            detail,
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+                  (AggExpr(AggFn.SUM, Col("returns_")), "returns_")],
+        ),
+        [(Literal(None, DataType.utf8()), "channel"),
+         (Literal(None, DataType.int32()), "id"),
+         (Col("sales"), "sales"), (Col("returns_"), "returns_")],
+    )
+    detail_out = _project_names(
+        detail, ["channel", "id", "sales", "returns_"]
+    )
+    return UnionExec([detail_out, by_channel, grand])
+
+
+def q6(s, flavor):
+    """TPC-DS q6: state of customers buying items priced >1.2x their
+    category average in one month. Scalar subqueries decorrelated into a
+    month_seq semi-join and a per-category AVG join."""
+    month = ProjectExec(
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") == 1),
+        ),
+        [(Col("d_month_seq"), "target_seq")],
+    )
+    target_dates = _semi(
+        flavor,
+        s["date_dim"](),
+        _agg(month, keys=[(Col("target_seq"), "target_seq")], aggs=[]),
+        ["d_month_seq"], ["target_seq"],
+    )
+    cat_avg = ProjectExec(
+        _agg(
+            FilterExec(s["item"](), IsNotNull(Col("i_category"))),
+            keys=[(Col("i_category"), "avg_cat")],
+            aggs=[(AggExpr(AggFn.AVG, Col("i_current_price")),
+                   "cat_avg_price")],
+        ),
+        [(Col("avg_cat"), "avg_cat"),
+         (Col("cat_avg_price") * 1.2, "price_threshold")],
+    )
+    pricey = FilterExec(
+        _join(flavor, cat_avg, s["item"](), ["avg_cat"], ["i_category"]),
+        Col("i_current_price") > Col("price_threshold"),
+    )
+    sales = _join(
+        flavor, target_dates, s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    sales = _join(flavor, pricey, sales, ["i_item_sk"], ["ss_item_sk"])
+    sales = _join(
+        flavor, s["customer"](), sales,
+        ["c_customer_sk"], ["ss_customer_sk"],
+    )
+    sales = _join(
+        flavor, s["customer_address"](), sales,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    agg = FilterExec(
+        _agg(
+            sales,
+            keys=[(Col("ca_state"), "state")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+        ),
+        Col("cnt") >= 10,
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("cnt"), True, True),
+              SortKey(Col("state"), True, True)], 100,
+    )
+
+
+def q7(s, flavor):
+    """TPC-DS q7: average item stats for one demographic slice with
+    email/event promotions."""
+    demo = FilterExec(
+        s["customer_demographics"](),
+        (Col("cd_gender") == "M")
+        & (Col("cd_marital_status") == "S")
+        & (Col("cd_education_status") == "College"),
+    )
+    promos = FilterExec(
+        s["promotion"](),
+        (Col("p_channel_email") == "N") | (Col("p_channel_event") == "N"),
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 2000),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, demo, j, ["cd_demo_sk"], ["ss_cdemo_sk"])
+    j = _join(flavor, promos, j, ["p_promo_sk"], ["ss_promo_sk"])
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (AggExpr(AggFn.AVG, Col("ss_quantity")), "agg1"),
+            (AggExpr(AggFn.AVG, Col("ss_list_price")), "agg2"),
+            (AggExpr(AggFn.AVG, Col("ss_coupon_amt")), "agg3"),
+            (AggExpr(AggFn.AVG, Col("ss_sales_price")), "agg4"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def q8(s, flavor):
+    """TPC-DS q8: store sales for stores whose zip-2 prefix appears in
+    (literal zip list INTERSECT zips of >10 preferred customers)."""
+    zip_list = [f"{(24000 + i * 131) % 90000:05d}" for i in range(0, 400)]
+    a_side = ProjectExec(
+        FilterExec(
+            s["customer_address"](),
+            InList(
+                ScalarFn(
+                    "substring",
+                    (Col("ca_zip"), Literal(1, DataType.int32()),
+                     Literal(5, DataType.int32())),
+                ),
+                tuple(
+                    Literal(z, DataType.utf8()) for z in zip_list[:200]
+                ),
+            ),
+        ),
+        [(ScalarFn(
+            "substring",
+            (Col("ca_zip"), Literal(1, DataType.int32()),
+             Literal(5, DataType.int32())),
+        ), "zip5")],
+    )
+    preferred = FilterExec(
+        s["customer"](), Col("c_preferred_cust_flag") == "Y"
+    )
+    pref_zips = FilterExec(
+        _agg(
+            _join(
+                flavor, s["customer_address"](), preferred,
+                ["ca_address_sk"], ["c_current_addr_sk"],
+            ),
+            keys=[(ScalarFn(
+                "substring",
+                (Col("ca_zip"), Literal(1, DataType.int32()),
+                 Literal(5, DataType.int32())),
+            ), "zip5")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+        ),
+        Col("cnt") > 10,
+    )
+    both = _semi(flavor, a_side, pref_zips, ["zip5"], ["zip5"])
+    zip2 = _agg(
+        ProjectExec(
+            both,
+            [(ScalarFn(
+                "substring",
+                (Col("zip5"), Literal(1, DataType.int32()),
+                 Literal(2, DataType.int32())),
+            ), "zip2")],
+        ),
+        keys=[(Col("zip2"), "zip2")],
+        aggs=[],
+    )
+    stores = ProjectExec(
+        s["store"](),
+        [(Col("s_store_sk"), "s_store_sk"),
+         (Col("s_store_name"), "s_store_name"),
+         (ScalarFn(
+             "substring",
+             (Col("s_zip"), Literal(1, DataType.int32()),
+              Literal(2, DataType.int32())),
+         ), "s_zip2")],
+    )
+    qual_stores = _semi(flavor, stores, zip2, ["s_zip2"], ["zip2"])
+    sales = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1998) & (Col("d_moy") == 2),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, qual_stores, sales, ["s_store_sk"], ["ss_store_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("s_store_name"), "s_store_name")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_net_profit")), "net_profit")],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("s_store_name"), True, True)], 100
+    )
+
+
+def q9(s, flavor):
+    """TPC-DS q9: five quantity-range buckets choosing count-vs-avg
+    expressions; the 15 scalar subqueries become one conditional global
+    aggregate, cross-joined with the filtered reason row."""
+    buckets = [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)]
+    aggs = []
+    for i, (lo, hi) in enumerate(buckets, 1):
+        in_range = (Col("ss_quantity") >= lo) & (Col("ss_quantity") <= hi)
+        null_f = Literal(None, DataType.float64())
+        aggs += [
+            (AggExpr(
+                AggFn.SUM,
+                If(in_range, Literal(1, DataType.int64()),
+                   Literal(None, DataType.int64())),
+            ), f"cnt_{i}"),
+            (AggExpr(
+                AggFn.AVG,
+                If(in_range, Col("ss_ext_discount_amt"), null_f),
+            ), f"avg_disc_{i}"),
+            (AggExpr(
+                AggFn.AVG,
+                If(in_range, Col("ss_net_profit"), null_f),
+            ), f"avg_profit_{i}"),
+        ]
+    stats = ProjectExec(
+        _agg(s["store_sales"](), keys=[], aggs=aggs),
+        [(Literal(1, DataType.int32()), "k")]
+        + [(Col(n), n) for _, n in aggs],
+    )
+    r = ProjectExec(
+        FilterExec(s["reason"](), Col("r_reason_sk") == 1),
+        [(Literal(1, DataType.int32()), "k")],
+    )
+    crossed = _join(flavor, r, stats, ["k"], ["k"])
+    outs = []
+    for i in range(1, 6):
+        outs.append(
+            (
+                If(
+                    Coalesce_int(Col(f"cnt_{i}")) > 7438,
+                    Col(f"avg_disc_{i}"),
+                    Col(f"avg_profit_{i}"),
+                ),
+                f"bucket{i}",
+            )
+        )
+    return ProjectExec(crossed, outs)
+
+
+def Coalesce_int(e):
+    from blaze_tpu.exprs import Coalesce
+
+    return Coalesce((e, Literal(0, DataType.int64())))
+
+
+def q10(s, flavor):
+    """TPC-DS q10: demographics of customers active in store AND
+    (web OR catalog) channels in a quarter; EXISTS via semi joins, the
+    OR-of-EXISTS via a unioned semi-join (Spark's rewrite)."""
+    def active(prefix, table, cust):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 2000)
+                & (Col("d_moy") >= 1) & (Col("d_moy") <= 4),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return ProjectExec(j, [(Col(cust), "active_sk")])
+
+    store_active = active("ss", "store_sales", "ss_customer_sk")
+    other_active = _union([
+        active("ws", "web_sales", "ws_bill_customer_sk"),
+        active("cs", "catalog_sales", "cs_bill_customer_sk"),
+    ])
+    cust = _semi(
+        flavor,
+        _semi(
+            flavor,
+            s["customer"](),
+            _agg(store_active,
+                 keys=[(Col("active_sk"), "active_sk")], aggs=[]),
+            ["c_customer_sk"], ["active_sk"],
+        ),
+        _agg(other_active,
+             keys=[(Col("active_sk"), "active_sk")], aggs=[]),
+        ["c_customer_sk"], ["active_sk"],
+    )
+    in_counties = _join(
+        flavor,
+        FilterExec(
+            s["customer_address"](),
+            InList(Col("ca_county"),
+                   (Literal("Rich County", DataType.utf8()),
+                    Literal("Walker County", DataType.utf8()))),
+        ),
+        cust,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    j = _join(
+        flavor, s["customer_demographics"](), in_counties,
+        ["cd_demo_sk"], ["c_current_cdemo_sk"],
+    )
+    agg = _agg(
+        j,
+        keys=[(Col("cd_gender"), "cd_gender"),
+              (Col("cd_marital_status"), "cd_marital_status"),
+              (Col("cd_education_status"), "cd_education_status"),
+              (Col("cd_purchase_estimate"), "cd_purchase_estimate"),
+              (Col("cd_credit_rating"), "cd_credit_rating")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("cd_gender"), True, True),
+         SortKey(Col("cd_marital_status"), True, True),
+         SortKey(Col("cd_education_status"), True, True),
+         SortKey(Col("cd_purchase_estimate"), True, True),
+         SortKey(Col("cd_credit_rating"), True, True)],
+        100,
+    )
+
+
+QUERIES = {
+    "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5,
+    "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10,
+}
