@@ -465,12 +465,13 @@ class DeviceEvaluator:
                 # round(int, d>=0) is the identity; d<0 rounds to a
                 # power of ten with HALF_UP (Spark round(1250,-2)=1300).
                 # Only a literal scale is supported on the int path.
-                d = (
-                    e.args[1].value
-                    if len(e.args) > 1
-                    and isinstance(e.args[1], ir.Literal)
-                    else 0
-                )
+                if len(e.args) > 1 and not isinstance(
+                    e.args[1], ir.Literal
+                ):
+                    raise NotImplementedError(
+                        "round(int, scale) needs a literal scale"
+                    )
+                d = e.args[1].value if len(e.args) > 1 else 0
                 if d is None or d >= 0:
                     return vs[0], m
                 p = 10 ** (-d)

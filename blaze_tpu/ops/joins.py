@@ -387,11 +387,13 @@ class HashJoinExec(PhysicalOp):
     (broadcast relation), the RIGHT child streams (reference
     from_proto.rs:349-428 PartitionMode::CollectLeft)."""
 
-    # join types whose OUTPUT depends on build-side matched state - that
-    # state is global across probe partitions, so these cannot emit
-    # per-partition (Spark restricts broadcast-side outer joins the same
-    # way); execute() funnels them through partition 0 over all probe
-    # partitions
+    # join types whose build-side epilogue (unmatched-build padding,
+    # semi/anti output) depends on matched state across ALL probe
+    # partitions. Probes still run per-partition in parallel; each
+    # partition OR-merges its local matched-build bitmap into a shared
+    # accumulator and the LAST partition to finish emits the epilogue
+    # (reference CollectLeft probes per-partition the same way,
+    # from_proto.rs:349-428)
     _BUILD_EMITTING = frozenset(
         {JoinType.LEFT, JoinType.FULL, JoinType.LEFT_SEMI,
          JoinType.LEFT_ANTI, JoinType.LEFT_ANTI_NULL_AWARE}
@@ -415,6 +417,14 @@ class HashJoinExec(PhysicalOp):
         import threading
 
         self._build_lock = threading.Lock()
+        # epilogue coordination (epoch-reset so a plan object can run
+        # more than once, e.g. benchmark warmup loops). A SET of
+        # completed partition ids - not a counter - so abandoned
+        # generators (LimitExec early return, sampling passes) and
+        # partition re-runs stay idempotent
+        self._epi_lock = threading.Lock()
+        self._epi_matched = None
+        self._epi_parts: set = set()
 
     @property
     def schema(self) -> Schema:
@@ -449,43 +459,58 @@ class HashJoinExec(PhysicalOp):
                 ) -> Iterator[ColumnBatch]:
         left, right = self.children
         jt = self.join_type
-        if jt in self._BUILD_EMITTING:
-            # global build-matched state: all probe partitions drain
-            # through partition 0, other partitions are empty
-            if partition != 0:
-                return
-            probe_parts = range(right.partition_count)
-        else:
-            probe_parts = (partition,)
         build = self._collect_build(ctx)
         core = _JoinCore(build, self.left_keys)
         emit_pairs = jt in (
             JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL
         )
-        for pp in probe_parts:
-            for pb in right.execute(pp, ctx):
-                state = core.probe(pb, self.right_keys)
-                pb = state[0]
-                bcols = build.columns if emit_pairs else []
-                pcols = pb.columns if emit_pairs else []
-                out_cols, valid, pair_cap, matched_p = core.emit_pairs(
-                    state, bcols, pcols, build_first=True
+        for pb in right.execute(partition, ctx):
+            state = core.probe(pb, self.right_keys)
+            pb = state[0]
+            bcols = build.columns if emit_pairs else []
+            pcols = pb.columns if emit_pairs else []
+            out_cols, valid, pair_cap, matched_p = core.emit_pairs(
+                state, bcols, pcols, build_first=True
+            )
+            if emit_pairs:
+                yield ColumnBatch(
+                    self._schema, out_cols, pair_cap, valid
                 )
-                if emit_pairs:
-                    yield ColumnBatch(
-                        self._schema, out_cols, pair_cap, valid
-                    )
-                if jt in (JoinType.RIGHT, JoinType.FULL):
-                    un = row_mask(pb.num_rows, pb.capacity) & ~matched_p
-                    lnull = _null_side(left.schema.fields, pb.capacity)
-                    yield ColumnBatch(
-                        self._schema, lnull + list(pb.columns),
-                        pb.num_rows, un,
-                    )
-        # build-side epilogue (partition 0 only; it saw every probe row)
+            if jt in (JoinType.RIGHT, JoinType.FULL):
+                un = row_mask(pb.num_rows, pb.capacity) & ~matched_p
+                lnull = _null_side(left.schema.fields, pb.capacity)
+                yield ColumnBatch(
+                    self._schema, lnull + list(pb.columns),
+                    pb.num_rows, un,
+                )
+        if jt in self._BUILD_EMITTING:
+            yield from self._build_epilogue(
+                core.matched_build, build, partition,
+                right.partition_count,
+            )
+
+    def _build_epilogue(self, local_matched, build: ColumnBatch,
+                        partition: int, n_parts: int
+                        ) -> Iterator[ColumnBatch]:
+        """OR-merge this partition's matched-build bitmap; the run that
+        completes the partition set emits the build-side output, then
+        resets the epoch so the plan object can run again."""
+        left, right = self.children
+        jt = self.join_type
+        with self._epi_lock:
+            if self._epi_matched is None:
+                self._epi_matched = local_matched
+            else:
+                self._epi_matched = self._epi_matched | local_matched
+            self._epi_parts.add(partition)
+            if len(self._epi_parts) < n_parts:
+                return
+            matched = self._epi_matched
+            self._epi_matched = None
+            self._epi_parts = set()
         live_b = row_mask(build.num_rows, build.capacity)
         if jt in (JoinType.LEFT, JoinType.FULL):
-            un = live_b & ~core.matched_build
+            un = live_b & ~matched
             rnull = _null_side(right.schema.fields, build.capacity)
             yield ColumnBatch(
                 self._schema, list(build.columns) + rnull,
@@ -494,12 +519,12 @@ class HashJoinExec(PhysicalOp):
         elif jt is JoinType.LEFT_SEMI:
             yield ColumnBatch(
                 self._schema, list(build.columns), build.num_rows,
-                live_b & core.matched_build,
+                live_b & matched,
             )
         elif jt is JoinType.LEFT_ANTI:
             yield ColumnBatch(
                 self._schema, list(build.columns), build.num_rows,
-                live_b & ~core.matched_build,
+                live_b & ~matched,
             )
 
 

@@ -218,6 +218,102 @@ def _chain_fixed(values, validity, dt, h):
     return link
 
 
+def _key_array_for_range(rb, cb: ColumnBatch, e: ir.Expr) -> np.ndarray:
+    """Materialized host key values for range partitioning, as object
+    arrays with None for NULL. Non-string keys use the engine's PHYSICAL
+    representation (date32 day ints, timestamp micros, decimal unscaled
+    i64) - physical order == logical order, and the values round-trip
+    through the plan proto as plain int/float literals. Strings use real
+    values (dictionary codes don't order). Float NaN maps to +inf so it
+    ranks greatest like Spark's total order (inf ties break to the same
+    or adjacent partition; the in-partition sort finishes the job)."""
+    if isinstance(e, ir.BoundCol):
+        idx = e.index
+    elif isinstance(e, ir.Col):
+        idx = cb.schema.index_of(e.name)
+    else:
+        raise NotImplementedError(
+            "range partitioning keys must be plain columns"
+        )
+    field = cb.schema.fields[idx]
+    n = cb.num_rows
+    if field.dtype.is_string_like:
+        out = np.asarray(rb.column(idx).to_pandas(), dtype=object)
+        return out[:n]
+    col = cb.columns[idx]
+    vals = np.asarray(col.values)[:n]
+    if np.issubdtype(vals.dtype, np.floating):
+        vals = np.where(np.isnan(vals), np.inf, vals)
+    out = vals.astype(object)
+    if col.validity is not None:
+        valid = np.asarray(col.validity)[:n]
+        out[~valid] = None
+    return out
+
+
+def range_partition_ids(key_arrays: Sequence[np.ndarray],
+                        bounds: Sequence[Tuple],
+                        ascending: Sequence[bool]) -> np.ndarray:
+    """Partition id per row for RANGE partitioning: the count of
+    boundary tuples the row's key tuple exceeds lexicographically (rows
+    equal to a bound land in the lower partition, like Spark's
+    RangePartitioner binary search). NULL ranks first in the sort
+    order regardless of direction."""
+    import pandas as pd
+
+    n = len(key_arrays[0]) if key_arrays else 0
+    pid = np.zeros(n, dtype=np.int32)
+    for bound in bounds:
+        gt = np.zeros(n, dtype=bool)
+        eq = np.ones(n, dtype=bool)
+        for arr, bv, asc in zip(key_arrays, bound, ascending):
+            isn = pd.isna(arr)
+            if bv is None or (isinstance(bv, float) and np.isnan(bv)):
+                col_gt = ~isn  # any value outranks a NULL bound
+                col_eq = isn
+            else:
+                # NULL slots can't be compared (object arrays raise);
+                # substitute the bound itself, then mask them out
+                safe = np.where(isn, bv, arr)
+                with np.errstate(invalid="ignore"):
+                    raw_gt = np.asarray(safe > bv, dtype=bool)
+                    raw_lt = np.asarray(safe < bv, dtype=bool)
+                if not asc:
+                    raw_gt, raw_lt = raw_lt, raw_gt
+                col_gt = raw_gt & ~isn
+                col_eq = np.asarray(safe == bv, dtype=bool) & ~isn
+            gt = gt | (eq & col_gt)
+            eq = eq & col_eq
+        pid += gt.astype(np.int32)
+    return pid
+
+
+def compute_range_bounds(sample_df, num_partitions: int,
+                         ascending: Sequence[bool]) -> List[Tuple]:
+    """num_partitions-1 boundary tuples from a sample of key rows
+    (driver-side sampling, reference RangePartitioner role in
+    ArrowShuffleExchangeExec301.scala:317-357)."""
+    if len(sample_df) == 0 or num_partitions <= 1:
+        return []
+    s = sample_df.sort_values(
+        list(sample_df.columns),
+        ascending=list(ascending),
+        na_position="first",
+        kind="stable",
+    ).reset_index(drop=True)
+    n = len(s)
+    bounds = []
+    for k in range(1, num_partitions):
+        idx = min(n - 1, (k * n) // num_partitions)
+        row = tuple(
+            None if (v is None or (isinstance(v, float) and np.isnan(v)))
+            else v
+            for v in s.iloc[idx]
+        )
+        bounds.append(row)
+    return bounds
+
+
 class ShuffleWriterExec(PhysicalOp):
     """Writes one map task's shuffle output; the output stream is empty
     (lengths land in the index file), matching the reference
@@ -225,16 +321,32 @@ class ShuffleWriterExec(PhysicalOp):
 
     def __init__(self, child: PhysicalOp, key_exprs: Sequence[ir.Expr],
                  num_partitions: int, data_file: str, index_file: str,
-                 mode: str = "hash"):
+                 mode: str = "hash",
+                 range_bounds: Optional[Sequence[Tuple]] = None,
+                 sort_ascending: Optional[Sequence[bool]] = None):
         self.children = [child]
         self.key_exprs = [bind_opt(e, child.schema) for e in key_exprs]
         self.num_partitions = num_partitions
         self.data_file = data_file
         self.index_file = index_file
-        assert mode in ("hash", "single", "round_robin")
+        assert mode in ("hash", "single", "round_robin", "range")
         self.mode = mode
         if mode == "hash" and not key_exprs:
             raise ValueError("hash partitioning requires keys")
+        if mode == "range":
+            if not key_exprs:
+                raise ValueError("range partitioning requires sort keys")
+            # bounds are plan constants (driver-sampled) so every map
+            # task splits identically
+            self.range_bounds = list(range_bounds or [])
+            self.sort_ascending = list(
+                sort_ascending
+                if sort_ascending is not None
+                else [True] * len(key_exprs)
+            )
+        else:
+            self.range_bounds = []
+            self.sort_ascending = []
 
     @property
     def schema(self) -> Schema:
@@ -272,6 +384,22 @@ class ShuffleWriterExec(PhysicalOp):
                          np.arange(cb.num_rows, cb.capacity)])),
                     cb.num_rows,
                 ).to_arrow()
+                sorted_pids = pids[order]
+            elif self.mode == "range":
+                # host path: key ordering incl. strings/NULLs needs real
+                # values (ordering on dictionary codes would be wrong);
+                # the D2H below is the same transfer the IPC encode
+                # needs anyway
+                rb = cb.to_arrow()
+                key_arrays = [
+                    _key_array_for_range(rb, cb, e)
+                    for e in self.key_exprs
+                ]
+                pids = range_partition_ids(
+                    key_arrays, self.range_bounds, self.sort_ascending
+                )
+                order = np.argsort(pids, kind="stable")
+                rb_sorted = rb.take(order)
                 sorted_pids = pids[order]
             else:
                 pids = spark_partition_ids(

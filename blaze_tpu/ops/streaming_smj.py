@@ -57,6 +57,11 @@ class StreamingSortMergeJoinExec(PhysicalOp):
     def __init__(self, left: PhysicalOp, right: PhysicalOp,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  join_type: JoinType = JoinType.INNER):
+        if join_type is JoinType.LEFT_ANTI_NULL_AWARE:
+            raise NotImplementedError(
+                "null-aware anti join needs the whole build side (any "
+                "NULL key empties the result) - materializing SMJ only"
+            )
         self.children = [left, right]
         self.left_keys = [left.schema.index_of(k) for k in left_keys]
         self.right_keys = [right.schema.index_of(k) for k in right_keys]

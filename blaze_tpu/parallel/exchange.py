@@ -34,16 +34,69 @@ class ShuffleExchangeExec(PhysicalOp):
     """Full repartitioning exchange (reference
     ArrowShuffleExchangeExec301.scala): hash / single / round_robin."""
 
+    SAMPLE_ROWS_PER_PARTITION = 10_000
+
     def __init__(self, child: PhysicalOp, keys: Sequence[ir.Expr],
                  num_partitions: int, mode: str = "hash",
-                 shuffle_dir: Optional[str] = None):
+                 shuffle_dir: Optional[str] = None,
+                 sort_ascending: Optional[Sequence[bool]] = None):
         self.children = [child]
         self.keys = list(keys)
         self.num_partitions = num_partitions
         self.mode = mode
         self.shuffle_dir = shuffle_dir
+        self.sort_ascending = list(
+            sort_ascending
+            if sort_ascending is not None
+            else [True] * len(keys)
+        )
         self._map_outputs: Optional[List[Tuple[str, str]]] = None
+        self._range_bounds: Optional[List[Tuple]] = None
         self._lock = threading.Lock()
+
+    def _compute_range_bounds(self, ctx: ExecContext) -> List[Tuple]:
+        """Driver-side sampling pass (Spark runs a sample job the same
+        way for RangePartitioning): pull up to SAMPLE_ROWS_PER_PARTITION
+        key rows from each child partition, derive quantile bounds."""
+        if self._range_bounds is not None:
+            return self._range_bounds
+        import pandas as pd
+
+        from blaze_tpu.ops.shuffle_writer import (
+            _key_array_for_range,
+            compute_range_bounds,
+        )
+
+        child = self.children[0]
+        frames = []
+        for p in range(child.partition_count):
+            taken = 0
+            for cb in child.execute(p, ctx):
+                from blaze_tpu.ops.util import ensure_compacted
+
+                cb = ensure_compacted(cb)
+                if cb.num_rows == 0:
+                    continue
+                rb = cb.to_arrow()
+                cols = {
+                    f"k{i}": _key_array_for_range(rb, cb, e)
+                    for i, e in enumerate(self.keys)
+                }
+                frames.append(pd.DataFrame(cols))
+                taken += cb.num_rows
+                if taken >= self.SAMPLE_ROWS_PER_PARTITION:
+                    break
+        sample = (
+            pd.concat(frames, ignore_index=True)
+            if frames
+            else pd.DataFrame(
+                {f"k{i}": [] for i in range(len(self.keys))}
+            )
+        )
+        self._range_bounds = compute_range_bounds(
+            sample, self.num_partitions, self.sort_ascending
+        )
+        return self._range_bounds
 
     @property
     def schema(self) -> Schema:
@@ -66,6 +119,11 @@ class ShuffleExchangeExec(PhysicalOp):
             child = self.children[0]
             d = self.shuffle_dir or tempfile.mkdtemp(prefix="blz-shuffle-")
             os.makedirs(d, exist_ok=True)
+            bounds = (
+                self._compute_range_bounds(ctx)
+                if self.mode == "range"
+                else None
+            )
 
             def run_map(map_id: int) -> Tuple[str, str]:
                 data = os.path.join(
@@ -80,6 +138,8 @@ class ShuffleExchangeExec(PhysicalOp):
                         writer = ShuffleWriterExec(
                             child, self.keys, self.num_partitions,
                             data, index, self.mode,
+                            range_bounds=bounds,
+                            sort_ascending=self.sort_ascending,
                         )
                         for _ in writer.execute(map_id, ctx):
                             pass
@@ -165,6 +225,11 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                 prefix="blz-cshuffle-"
             )
             os.makedirs(d, exist_ok=True)
+            bounds = (
+                self._compute_range_bounds(ctx)
+                if self.mode == "range"
+                else None
+            )
             tasks = []
             outputs = []
             for map_id in range(child.partition_count):
@@ -174,6 +239,8 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
                 plan = ShuffleWriterExec(
                     child, self.keys, self.num_partitions, data, index,
                     self.mode,
+                    range_bounds=bounds,
+                    sort_ascending=self.sort_ascending,
                 )
                 tasks.append(
                     task_to_proto(plan, map_id, f"map-{map_id}")

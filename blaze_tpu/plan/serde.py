@@ -35,6 +35,7 @@ from blaze_tpu.ops import (
     SortMergeJoinExec,
     UnionExec,
 )
+from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
 from blaze_tpu.ops.base import PhysicalOp
 from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
 
@@ -368,19 +369,40 @@ def plan_from_proto(p: pb.PlanProto) -> PhysicalOp:
         )
     if kind == "sort_merge_join":
         h = p.sort_merge_join
+        left = plan_from_proto(h.left)
+        right = plan_from_proto(h.right)
+        if h.streaming:
+            try:
+                return StreamingSortMergeJoinExec(
+                    left, right, list(h.left_keys),
+                    list(h.right_keys), _PB_TO_JT[h.join_type],
+                )
+            except NotImplementedError:
+                pass  # string keys: materializing core below
         return SortMergeJoinExec(
-            plan_from_proto(h.left), plan_from_proto(h.right),
+            left, right,
             list(h.left_keys), list(h.right_keys),
             _PB_TO_JT[h.join_type],
         )
     if kind == "shuffle_writer":
         s = p.shuffle_writer
         mode = {pb.HASH: "hash", pb.SINGLE: "single",
-                pb.ROUND_ROBIN: "round_robin"}[s.mode]
+                pb.ROUND_ROBIN: "round_robin",
+                pb.RANGE: "range"}[s.mode]
+        bounds = []
+        for row in s.range_bounds:
+            vals = []
+            for lp in row.values:
+                wrap = pb.ExprProto()
+                wrap.literal.CopyFrom(lp)
+                vals.append(expr_from_proto(wrap).value)
+            bounds.append(tuple(vals))
         return ShuffleWriterExec(
             plan_from_proto(s.input),
             [expr_from_proto(k) for k in s.keys],
             s.num_partitions, s.data_file, s.index_file, mode,
+            range_bounds=bounds or None,
+            sort_ascending=list(s.sort_ascending) or None,
         )
     if kind == "ipc_writer":
         return IpcWriterExec(
@@ -483,7 +505,7 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
             op.children[1].schema.fields[i].name for i in op.right_keys
         )
         h.join_type = _JT_TO_PB[op.join_type]
-    elif isinstance(op, SortMergeJoinExec):
+    elif isinstance(op, (SortMergeJoinExec, StreamingSortMergeJoinExec)):
         h = p.sort_merge_join
         h.left.CopyFrom(plan_to_proto(op.children[0]))
         h.right.CopyFrom(plan_to_proto(op.children[1]))
@@ -494,6 +516,7 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
             op.children[1].schema.fields[i].name for i in op.right_keys
         )
         h.join_type = _JT_TO_PB[op.join_type]
+        h.streaming = isinstance(op, StreamingSortMergeJoinExec)
     elif isinstance(op, ShuffleWriterExec):
         s = p.shuffle_writer
         s.input.CopyFrom(plan_to_proto(op.children[0]))
@@ -503,7 +526,22 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
         s.data_file = op.data_file
         s.index_file = op.index_file
         s.mode = {"hash": pb.HASH, "single": pb.SINGLE,
-                  "round_robin": pb.ROUND_ROBIN}[op.mode]
+                  "round_robin": pb.ROUND_ROBIN,
+                  "range": pb.RANGE}[op.mode]
+        if op.mode == "range":
+            from blaze_tpu.exprs.typing import infer_dtype
+
+            s.sort_ascending.extend(op.sort_ascending)
+            key_dtypes = [
+                infer_dtype(e, op.children[0].schema)
+                for e in op.key_exprs
+            ]
+            for bound in op.range_bounds:
+                row = s.range_bounds.add()
+                for v, dt in zip(bound, key_dtypes):
+                    row.values.add().CopyFrom(
+                        expr_to_proto(ir.Literal(v, dt)).literal
+                    )
     elif isinstance(op, IpcWriterExec):
         p.ipc_writer.input.CopyFrom(plan_to_proto(op.children[0]))
         p.ipc_writer.resource_id = op.resource_id

@@ -167,6 +167,29 @@ def _check_convertible(node: S.PlanSpec) -> None:
             )
 
 
+def _smj_inputs_sorted(node: "S.JoinSpec") -> bool:
+    """True when both join inputs carry a sort guarantee whose leading
+    keys are exactly the join keys ascending - Spark plants SortExec
+    under SMJ the same way, so a SortSpec child is the guarantee."""
+    from blaze_tpu.exprs import ir
+
+    def guaranteed(child: S.PlanSpec, keys) -> bool:
+        if not isinstance(child, S.SortSpec) or child.convertible is False:
+            return False
+        lead = list(child.keys)[: len(keys)]
+        if len(lead) < len(keys):
+            return False
+        for (e, asc, _nf), name in zip(lead, keys):
+            if not asc:
+                return False
+            if not (isinstance(e, ir.Col) and e.name == name):
+                return False
+        return True
+
+    return guaranteed(node.children[0], list(node.left_keys)) and \
+        guaranteed(node.children[1], list(node.right_keys))
+
+
 def _build(node: S.PlanSpec, strategy: ConvertStrategy) -> PhysicalOp:
     if not node.convertible:
         return HostFallbackExec(node)
@@ -248,10 +271,27 @@ def _convert_native(node: S.PlanSpec, strategy: ConvertStrategy
                 list(node.right_keys), jt,
             )
         else:
-            out = SortMergeJoinExec(
-                left, right, list(node.left_keys),
-                list(node.right_keys), jt,
-            )
+            out = None
+            if _smj_inputs_sorted(node):
+                # sort-guaranteed inputs take the streaming merge (the
+                # reference's flagship operator, sort_merge_join_exec.rs:
+                # 293-601); string keys fall through to materializing
+                from blaze_tpu.ops.streaming_smj import (
+                    StreamingSortMergeJoinExec,
+                )
+
+                try:
+                    out = StreamingSortMergeJoinExec(
+                        left, right, list(node.left_keys),
+                        list(node.right_keys), jt,
+                    )
+                except NotImplementedError:
+                    out = None
+            if out is None:
+                out = SortMergeJoinExec(
+                    left, right, list(node.left_keys),
+                    list(node.right_keys), jt,
+                )
         if node.condition is not None:
             # join conditions become a native filter above the join
             out = FilterExec(out, node.condition)

@@ -91,7 +91,7 @@ class JoinSpec(PlanSpec):
 class ExchangeSpec(PlanSpec):
     keys: Sequence[ir.Expr] = ()
     num_partitions: int = 1
-    mode: str = "hash"  # hash | single | round_robin | broadcast
+    mode: str = "hash"  # hash | single | round_robin | range | broadcast
 
 
 @dataclasses.dataclass

@@ -235,3 +235,69 @@ def test_null_aware_anti_join():
         l, r2, ["a"], ["b"], JoinType.LEFT_ANTI_NULL_AWARE
     )
     assert collect_rows(op2) == []
+
+
+@pytest.mark.parametrize(
+    "jt",
+    [JoinType.LEFT, JoinType.FULL, JoinType.LEFT_SEMI,
+     JoinType.LEFT_ANTI],
+)
+def test_bhj_build_emitting_concurrent_probe_partitions(jt):
+    """Build-emitting joins probe per-partition in parallel; the shared
+    matched-build bitmap OR-merges and the last finisher emits the
+    epilogue - results must equal the single-partition run."""
+    import threading
+
+    build = {"a": [1, 2, 3, 5, 7], "x": [10, 20, 30, 50, 70]}
+    probe_parts = [
+        {"b": [2, 2, 9], "y": [200, 201, 900]},
+        {"b": [3, 11], "y": [300, 1100]},
+        {"b": [12], "y": [1200]},
+    ]
+
+    def multi_scan():
+        return MemoryScanExec(
+            [[ColumnBatch.from_pydict(p)] for p in probe_parts],
+            ColumnBatch.from_pydict(probe_parts[0]).schema,
+        )
+
+    def single_scan():
+        merged = {
+            "b": sum((p["b"] for p in probe_parts), []),
+            "y": sum((p["y"] for p in probe_parts), []),
+        }
+        return MemoryScanExec.from_batches(
+            [ColumnBatch.from_pydict(merged)]
+        )
+
+    ref = sorted(
+        collect_rows(
+            HashJoinExec(scan_of(build), single_scan(), ["a"], ["b"], jt)
+        ),
+        key=lambda r: tuple((v is None, v) for v in r),
+    )
+
+    join = HashJoinExec(scan_of(build), multi_scan(), ["a"], ["b"], jt)
+    results = [[] for _ in probe_parts]
+    errs = []
+
+    def run(p):
+        try:
+            results[p] = collect_rows(join, partition=p)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(p,))
+        for p in range(len(probe_parts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    got = sorted(
+        (r for part in results for r in part),
+        key=lambda r: tuple((v is None, v) for v in r),
+    )
+    assert got == ref

@@ -252,3 +252,118 @@ def test_ipc_stream_channel_source(tmp_path):
     )
     rows = [x for b in rd.execute(0, ctx) for x in b.to_pydict()["a"]]
     assert rows == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# range partitioning (reference ArrowShuffleExchangeExec301.scala:317-357)
+# ---------------------------------------------------------------------------
+
+def test_range_partition_ids_bounds_ties_nulls_desc():
+    import numpy as np
+
+    from blaze_tpu.ops.shuffle_writer import range_partition_ids
+
+    keys = [np.array([None, 1, 5, 10, 10, 25], dtype=object)]
+    bounds = [(5,), (10,)]
+    pids = range_partition_ids(keys, bounds, [True])
+    # NULL first -> 0; 1 -> 0; 5 (== bound) -> lower partition 0;
+    # 10 -> 1 (== second bound); 25 -> 2
+    assert pids.tolist() == [0, 0, 0, 1, 1, 2]
+
+    # descending: order reverses (25 sorts first -> partition 0; 1
+    # sorts past both bounds -> partition 2); NULL still ranks first
+    pids_d = range_partition_ids(keys, [(10,), (5,)], [False])
+    assert pids_d.tolist() == [0, 2, 1, 0, 0, 0]
+
+    # two keys, lexicographic
+    k2 = [
+        np.array([1, 1, 2, 2], dtype=object),
+        np.array(["a", "z", "a", "z"], dtype=object),
+    ]
+    pids2 = range_partition_ids(k2, [(1, "m"), (2, "m")], [True, True])
+    assert pids2.tolist() == [0, 1, 1, 2]
+
+
+def test_compute_range_bounds_quantiles():
+    import numpy as np
+    import pandas as pd
+
+    from blaze_tpu.ops.shuffle_writer import compute_range_bounds
+
+    df = pd.DataFrame({"k0": np.arange(100)})
+    bounds = compute_range_bounds(df, 4, [True])
+    assert bounds == [(25,), (50,), (75,)]
+    assert compute_range_bounds(df, 1, [True]) == []
+    assert compute_range_bounds(df.iloc[:0], 4, [True]) == []
+
+
+def test_range_exchange_global_sort():
+    """Distributed global sort: range exchange + per-partition sort =>
+    concatenated output is totally ordered."""
+    import numpy as np
+
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops import SortExec, SortKey
+    from blaze_tpu.parallel import ShuffleExchangeExec
+
+    rng = np.random.default_rng(11)
+    parts = [
+        {"k": rng.integers(0, 1000, 500).tolist(),
+         "v": list(range(500))}
+        for _ in range(3)
+    ]
+    batches = [[ColumnBatch.from_pydict(p)] for p in parts]
+    scan = MemoryScanExec(batches, ColumnBatch.from_pydict(parts[0]).schema)
+    ex = ShuffleExchangeExec(scan, [Col("k")], 4, mode="range")
+    ctx = ExecContext()
+    all_keys = []
+    for p in range(4):
+        part_keys = []
+        srt = SortExec(ex, [SortKey(Col("k"), True, True)])
+        # sort executes per partition; collect partition p
+        for cb in srt.execute(p, ctx):
+            part_keys += cb.to_arrow().column("k").to_pylist()
+        assert part_keys == sorted(part_keys)
+        all_keys.append(part_keys)
+    flat = [k for part in all_keys for k in part]
+    assert flat == sorted(flat)  # global order across partitions
+    expect = sorted(k for p in parts for k in p["k"])
+    assert flat == expect  # no rows lost or duplicated
+
+
+def test_range_writer_serde_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
+    from blaze_tpu.plan.serde import plan_from_proto, plan_to_proto
+
+    src = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [3, 1, 2], "v": [1.0, 2.0, 3.0]}), src)
+    op = ShuffleWriterExec(
+        ParquetScanExec([[FileRange(src)]]), [Col("k")], 3,
+        str(tmp_path / "o.data"), str(tmp_path / "o.index"),
+        mode="range", range_bounds=[(1,), (2,)],
+        sort_ascending=[True],
+    )
+    back = plan_from_proto(plan_to_proto(op))
+    assert back.mode == "range"
+    assert back.range_bounds == [(1,), (2,)]
+    assert back.sort_ascending == [True]
+    # and it runs: write + verify partition ordering via the index
+    ctx = ExecContext()
+    for _ in back.execute(0, ctx):
+        pass
+    from blaze_tpu.io.ipc import partition_ranges, read_file_segment
+
+    ranges = partition_ranges(str(tmp_path / "o.index"))
+    seen = []
+    for off, length in ranges:
+        if length:
+            for rb in read_file_segment(
+                str(tmp_path / "o.data"), off, length
+            ):
+                seen.append(rb.column("k").to_pylist())
+    assert seen == [[1], [2], [3]]

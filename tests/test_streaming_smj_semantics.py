@@ -1,0 +1,229 @@
+"""Streaming SMJ semantics matrix with PINNED expected rows.
+
+Port of the reference's in-file SMJ unit-test suite
+(sort_merge_join_exec.rs:965-1896: inner/one-key, inner/two-key,
+null keys, left/right/full outer padding, semi, anti, empty sides,
+equal-key cartesian runs, multi-batch streams) to the streaming
+operator, plus the plan wiring: proto round-trip of the streaming flag
+and planner selection on sort-guaranteed inputs.
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.batch import empty_batch
+from blaze_tpu.ops import ExecContext, JoinType, MemoryScanExec
+from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
+
+
+def scan(cols: dict, batch_rows=2):
+    n = len(next(iter(cols.values())))
+    if n == 0:
+        sch = ColumnBatch.from_pydict(
+            {k: [0] for k in cols}
+        ).schema
+        return MemoryScanExec([[empty_batch(sch)]], sch)
+    batches = [
+        ColumnBatch.from_pydict(
+            {k: v[s: s + batch_rows] for k, v in cols.items()}
+        )
+        for s in range(0, n, batch_rows)
+    ]
+    return MemoryScanExec([batches], batches[0].schema)
+
+
+def rows(op):
+    out = []
+    for b in op.execute(0, ExecContext()):
+        arr = b.to_arrow()
+        out += list(zip(*[arr.column(i).to_pylist()
+                          for i in range(arr.num_columns)]))
+    return sorted(out, key=lambda r: tuple((x is None, x) for x in r))
+
+
+L = {"a": [1, 2, 2, 3, 5], "b": [10, 20, 21, 30, 50]}
+R = {"a2": [2, 2, 3, 4], "c": [200, 201, 300, 400]}
+
+
+def smj(left_cols, right_cols, lk, rk, jt, batch_rows=2):
+    return StreamingSortMergeJoinExec(
+        scan(left_cols, batch_rows), scan(right_cols, batch_rows),
+        lk, rk, jt,
+    )
+
+
+def test_inner_one_key_with_duplicate_runs():
+    got = rows(smj(L, R, ["a"], ["a2"], JoinType.INNER))
+    assert got == sorted([
+        (2, 20, 2, 200), (2, 20, 2, 201),
+        (2, 21, 2, 200), (2, 21, 2, 201),
+        (3, 30, 3, 300),
+    ])
+
+
+def test_left_outer_padding():
+    got = rows(smj(L, R, ["a"], ["a2"], JoinType.LEFT))
+    assert got == sorted(
+        [
+            (2, 20, 2, 200), (2, 20, 2, 201),
+            (2, 21, 2, 200), (2, 21, 2, 201),
+            (3, 30, 3, 300),
+            (1, 10, None, None), (5, 50, None, None),
+        ],
+        key=lambda r: tuple((x is None, x) for x in r),
+    )
+
+
+def test_right_outer_padding():
+    got = rows(smj(L, R, ["a"], ["a2"], JoinType.RIGHT))
+    assert (None, None, 4, 400) in got
+    assert len(got) == 6
+
+
+def test_full_outer():
+    got = rows(smj(L, R, ["a"], ["a2"], JoinType.FULL))
+    assert len(got) == 8
+    assert (1, 10, None, None) in got
+    assert (5, 50, None, None) in got
+    assert (None, None, 4, 400) in got
+
+
+def test_left_semi_and_anti():
+    semi = rows(smj(L, R, ["a"], ["a2"], JoinType.LEFT_SEMI))
+    assert semi == [(2, 20), (2, 21), (3, 30)]
+    anti = rows(smj(L, R, ["a"], ["a2"], JoinType.LEFT_ANTI))
+    assert anti == [(1, 10), (5, 50)]
+
+
+def test_two_key_join():
+    l2 = {"k1": [1, 1, 2], "k2": [1, 2, 1], "v": [7, 8, 9]}
+    r2 = {"j1": [1, 1, 2], "j2": [1, 3, 1], "w": [70, 71, 72]}
+    got = rows(smj(l2, r2, ["k1", "k2"], ["j1", "j2"], JoinType.INNER))
+    assert got == [(1, 1, 7, 1, 1, 70), (2, 1, 9, 2, 1, 72)]
+
+
+def test_null_keys_never_match():
+    ln = {"a": [1, 2, None], "b": [10, 12, 11]}
+    rn = {"a2": [2, None], "c": [200, 99]}
+    # ascending with nulls: engine sorts null-first per sorted_scan
+    # convention; keys arrive ascending with None last here, so place
+    # them explicitly in sorted position for the streaming contract
+    ln = {"a": [None, 1, 2], "b": [11, 10, 12]}
+    rn = {"a2": [None, 2], "c": [99, 200]}
+    inner = rows(smj(ln, rn, ["a"], ["a2"], JoinType.INNER))
+    assert inner == [(2, 12, 2, 200)]
+    left = rows(smj(ln, rn, ["a"], ["a2"], JoinType.LEFT))
+    assert (None, 11, None, None) in left and len(left) == 3
+    full = rows(smj(ln, rn, ["a"], ["a2"], JoinType.FULL))
+    assert (None, None, None, 99) in full and len(full) == 4
+
+
+def test_empty_right_side():
+    er = {"a2": [], "c": []}
+    assert rows(smj(L, er, ["a"], ["a2"], JoinType.INNER)) == []
+    left = rows(smj(L, er, ["a"], ["a2"], JoinType.LEFT))
+    assert len(left) == 5 and all(r[2] is None for r in left)
+    anti = rows(smj(L, er, ["a"], ["a2"], JoinType.LEFT_ANTI))
+    assert len(anti) == 5
+
+
+def test_empty_left_side():
+    el = {"a": [], "b": []}
+    assert rows(smj(el, R, ["a"], ["a2"], JoinType.INNER)) == []
+    right = rows(smj(el, R, ["a"], ["a2"], JoinType.RIGHT))
+    assert len(right) == 4 and all(r[0] is None for r in right)
+
+
+@pytest.mark.parametrize("batch_rows", [1, 2, 3, 100])
+def test_batch_granularity_invariance(batch_rows):
+    """Output must not depend on how the sorted streams are batched
+    (the reference's output-batch-splitting tests)."""
+    ref = rows(smj(L, R, ["a"], ["a2"], JoinType.FULL, batch_rows=100))
+    got = rows(smj(L, R, ["a"], ["a2"], JoinType.FULL,
+                   batch_rows=batch_rows))
+    assert got == ref
+
+
+def test_naaj_rejected():
+    with pytest.raises(NotImplementedError):
+        smj(L, R, ["a"], ["a2"], JoinType.LEFT_ANTI_NULL_AWARE)
+
+
+# ---------------------------------------------------------------------------
+# plan wiring
+# ---------------------------------------------------------------------------
+
+def test_serde_streaming_flag_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.plan.serde import plan_from_proto, plan_to_proto
+
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": [1, 2], "b": [3, 4]}), p)
+    left = ParquetScanExec([[FileRange(p)]])
+    right = ParquetScanExec([[FileRange(p)]])
+    op = StreamingSortMergeJoinExec(
+        left, right, ["a"], ["a"], JoinType.INNER
+    )
+    proto = plan_to_proto(op)
+    assert proto.sort_merge_join.streaming is True
+    back = plan_from_proto(proto)
+    assert isinstance(back, StreamingSortMergeJoinExec)
+
+
+def test_planner_picks_streaming_when_sort_guaranteed():
+    import pandas as pd
+
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.planner import spec as S
+    from blaze_tpu.planner.convert import convert_plan
+
+    def mem(df):
+        return S.MemorySpec(children=[], dataframe=df)
+
+    ldf = pd.DataFrame({"a": [2, 1], "b": [20, 10]})
+    rdf = pd.DataFrame({"a2": [2, 3], "c": [200, 300]})
+    join = S.JoinSpec(
+        children=[
+            S.SortSpec(children=[mem(ldf)], keys=[(Col("a"), True, True)]),
+            S.SortSpec(children=[mem(rdf)],
+                       keys=[(Col("a2"), True, True)]),
+        ],
+        kind="smj",
+        left_keys=["a"],
+        right_keys=["a2"],
+        join_type="inner",
+    )
+    plan = convert_plan(join, fuse=False)
+    found = []
+
+    def walk(op):
+        found.append(type(op).__name__)
+        for c in op.children:
+            walk(c)
+
+    walk(plan)
+    assert "StreamingSortMergeJoinExec" in found
+
+    # unsorted children stay on the materializing SMJ
+    join2 = S.JoinSpec(
+        children=[mem(ldf), mem(rdf)],
+        kind="smj",
+        left_keys=["a"],
+        right_keys=["a2"],
+        join_type="inner",
+    )
+    plan2 = convert_plan(join2, fuse=False)
+    found2 = []
+
+    def walk2(op):
+        found2.append(type(op).__name__)
+        for c in op.children:
+            walk2(c)
+
+    walk2(plan2)
+    assert "StreamingSortMergeJoinExec" not in found2
+    assert "SortMergeJoinExec" in found2
