@@ -66,6 +66,13 @@ def cmd_scan(args) -> int:
     return 0
 
 
+def cmd_gateway(args) -> int:
+    from blaze_tpu.runtime.gateway import serve_forever
+
+    serve_forever(args.host, args.port)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="blaze_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -77,11 +84,15 @@ def main(argv=None) -> int:
     sc.add_argument("file")
     sc.add_argument("--columns", default=None)
     sc.add_argument("--limit", type=int, default=20)
+    gw = sub.add_parser("gateway")
+    gw.add_argument("--host", default="127.0.0.1")
+    gw.add_argument("--port", type=int, default=8484)
     args = p.parse_args(argv)
     return {
         "info": cmd_info,
         "run-task": cmd_run_task,
         "scan": cmd_scan,
+        "gateway": cmd_gateway,
     }[args.cmd](args)
 
 

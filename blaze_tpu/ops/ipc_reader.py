@@ -69,8 +69,27 @@ class IpcReaderExec(PhysicalOp):
             provider(partition) if callable(provider)
             else provider[partition]
         )
+        from blaze_tpu.runtime.transport import (
+            RemoteSegment,
+            open_remote_stream,
+        )
+
         rows = 0
         for src in sources:
+            if isinstance(src, RemoteSegment):
+                # remote block streamed off another host's BlockServer
+                # (reference remote-fetch path, ipc_reader_exec.rs:283-326);
+                # the socket must close even if the consumer stops early
+                from blaze_tpu.io.ipc import decode_ipc_stream
+
+                stream = open_remote_stream(src)
+                try:
+                    for rb in decode_ipc_stream(stream):
+                        rows += rb.num_rows
+                        yield ColumnBatch.from_arrow(rb)
+                finally:
+                    stream.close()
+                continue
             if isinstance(src, FileSegment):
                 it = read_file_segment(src.path, src.offset, src.length)
             elif isinstance(src, (bytes, bytearray, memoryview)):

@@ -24,7 +24,9 @@ spans hosts over DCN.
 from blaze_tpu.parallel.mesh import get_mesh, device_count
 from blaze_tpu.parallel.exchange import (
     BroadcastExchangeExec,
+    ClusterShuffleExchangeExec,
     CoalescedShuffleReader,
+    RemoteClusterShuffleExchangeExec,
     ShuffleExchangeExec,
 )
 
@@ -32,6 +34,8 @@ __all__ = [
     "get_mesh",
     "device_count",
     "ShuffleExchangeExec",
+    "ClusterShuffleExchangeExec",
+    "RemoteClusterShuffleExchangeExec",
     "BroadcastExchangeExec",
     "CoalescedShuffleReader",
 ]

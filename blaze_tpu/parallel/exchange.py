@@ -250,6 +250,93 @@ class ClusterShuffleExchangeExec(ShuffleExchangeExec):
             return outputs
 
 
+class RemoteClusterShuffleExchangeExec(ClusterShuffleExchangeExec):
+    """Cluster exchange over DISJOINT per-worker data directories: the
+    driver does not know (or share) where map outputs land. Map tasks
+    carry __WORKER_LOCAL__ shuffle paths that the claiming worker
+    rewrites into its private directory; its completion metadata reports
+    (host, port, path, per-partition ranges), and reduce reads stream
+    every block over the workers' BlockServers - the reference's
+    netty remote-fetch path (ArrowBlockStoreShuffleReader301.scala:
+    83-123) rather than its local-FileSegment shortcut."""
+
+    def _run_map_stage(self, ctx: ExecContext):
+        with self._lock:
+            if self._map_outputs is not None:
+                return self._map_outputs
+            from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
+            from blaze_tpu.plan.serde import task_to_proto
+            from blaze_tpu.runtime.cluster import WORKER_LOCAL_PREFIX
+
+            child = self.children[0]
+            bounds = (
+                self._compute_range_bounds(ctx)
+                if self.mode == "range"
+                else None
+            )
+            tasks = []
+            tag = f"{id(self):x}"
+            for map_id in range(child.partition_count):
+                plan = ShuffleWriterExec(
+                    child, self.keys, self.num_partitions,
+                    f"{WORKER_LOCAL_PREFIX}/ex{tag}_m{map_id}.data",
+                    f"{WORKER_LOCAL_PREFIX}/ex{tag}_m{map_id}.index",
+                    self.mode,
+                    range_bounds=bounds,
+                    sort_ascending=self.sort_ascending,
+                )
+                tasks.append(
+                    task_to_proto(plan, map_id, f"map-{map_id}")
+                )
+            _, metas = self.cluster.run_tasks(tasks, return_metas=True)
+            self._map_outputs = metas
+            return metas
+
+    def segments_for(self, partition_range: Tuple[int, int],
+                     ctx: ExecContext,
+                     map_range: Optional[Tuple[int, int]] = None):
+        from blaze_tpu.runtime.transport import RemoteSegment
+
+        start, end = partition_range
+        metas = self._run_map_stage(ctx)
+        if map_range is not None:
+            metas = metas[map_range[0]: map_range[1]]
+        segs = []
+        for meta in metas:
+            for out in meta["outputs"]:
+                for p in range(start, end):
+                    off, length = out["ranges"][p]
+                    if length > 0:
+                        segs.append(
+                            RemoteSegment(
+                                meta["host"], meta["port"],
+                                out["data"], off, length,
+                            )
+                        )
+        return segs
+
+    def map_output_statistics(self, ctx: ExecContext) -> List[int]:
+        sizes = [0] * self.num_partitions
+        for meta in self._run_map_stage(ctx):
+            for out in meta["outputs"]:
+                for p, (_, length) in enumerate(out["ranges"]):
+                    sizes[p] += length
+        return sizes
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.io.ipc import decode_ipc_stream
+        from blaze_tpu.runtime.transport import open_remote_stream
+
+        for seg in self.segments_for((partition, partition + 1), ctx):
+            stream = open_remote_stream(seg)
+            try:
+                for rb in decode_ipc_stream(stream):
+                    yield ColumnBatch.from_arrow(rb)
+            finally:
+                stream.close()
+
+
 class CoalescedShuffleReader(PhysicalOp):
     """AQE-style reader over a ShuffleExchange: each output partition maps
     to a (reduce-range, map-range) spec (reference CustomShuffleReaderExec
@@ -280,14 +367,30 @@ class CoalescedShuffleReader(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
-        from blaze_tpu.io.ipc import read_file_segment
+        from blaze_tpu.io.ipc import decode_ipc_stream, read_file_segment
+        from blaze_tpu.runtime.transport import (
+            RemoteSegment,
+            open_remote_stream,
+        )
 
         ex: ShuffleExchangeExec = self.children[0]
         for seg in ex.segments_for(
             self.ranges[partition], ctx, self.map_ranges[partition]
         ):
-            for rb in read_file_segment(seg.path, seg.offset, seg.length):
-                yield ColumnBatch.from_arrow(rb)
+            if isinstance(seg, RemoteSegment):
+                # remote-exchange segments stream over the BlockServer;
+                # their paths live in another process's private dir
+                stream = open_remote_stream(seg)
+                try:
+                    for rb in decode_ipc_stream(stream):
+                        yield ColumnBatch.from_arrow(rb)
+                finally:
+                    stream.close()
+            else:
+                for rb in read_file_segment(
+                    seg.path, seg.offset, seg.length
+                ):
+                    yield ColumnBatch.from_arrow(rb)
 
 
 def plan_coalesced_partitions(sizes: Sequence[int], target_bytes: int

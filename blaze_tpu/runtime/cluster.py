@@ -31,6 +31,12 @@ from blaze_tpu.ops.base import ExecContext, PhysicalOp
 
 
 class MiniCluster:
+    """The control plane (task spool) is shared - it plays the driver
+    RPC role - but every worker owns a PRIVATE data directory for its
+    shuffle outputs, exported only through its BlockServer
+    (runtime/transport.py). Remote reads therefore go over the network,
+    never through the shared filesystem."""
+
     def __init__(self, num_workers: int = 2,
                  spool_dir: Optional[str] = None,
                  env: Optional[dict] = None):
@@ -51,10 +57,11 @@ class MiniCluster:
         )
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         for i in range(self.num_workers):
+            data_dir = tempfile.mkdtemp(prefix=f"blz-worker{i}-")
             self._procs.append(
                 subprocess.Popen(
                     [sys.executable, "-m", "blaze_tpu.runtime.cluster",
-                     self.spool],
+                     self.spool, data_dir],
                     env=env,
                     stdout=subprocess.DEVNULL,
                     stderr=subprocess.PIPE,
@@ -72,11 +79,16 @@ class MiniCluster:
 
     # ------------------------------------------------------------------
     def run_tasks(self, task_blobs: Sequence[bytes],
-                  timeout: float = 300.0) -> List[pa.Table]:
+                  timeout: float = 300.0,
+                  return_metas: bool = False):
         """Submit serialized TaskDefinitions; wait for per-task results
-        (tables decoded from segmented IPC)."""
+        (tables decoded from segmented IPC). With return_metas, also
+        return each task's worker-reported metadata (block-server
+        address + shuffle output ranges) - per call, so concurrent map
+        stages on one cluster can't clobber each other."""
         from blaze_tpu.io.ipc import decode_ipc_parts
 
+        metas: List[Optional[dict]] = [None] * len(task_blobs)
         ids = []
         for blob in task_blobs:
             tid = uuid.uuid4().hex
@@ -109,8 +121,16 @@ class MiniCluster:
                         pa.Table.from_batches(batches)
                         if batches else pa.table({})
                     )
+                    meta = os.path.join(
+                        self.spool, "out", ids[i] + ".meta.json"
+                    )
+                    if os.path.exists(meta):
+                        with open(meta) as f:
+                            metas[i] = json.load(f)
                     pending.discard(i)
             time.sleep(0.05)
+        if return_metas:
+            return tables, metas
         return tables  # type: ignore[return-value]
 
     def __enter__(self):
@@ -125,13 +145,70 @@ class MiniCluster:
 # worker loop (runs in its own interpreter/JAX runtime)
 # ---------------------------------------------------------------------------
 
-def worker_main(spool: str) -> int:
+WORKER_LOCAL_PREFIX = "__WORKER_LOCAL__"
+
+
+def _rewrite_worker_local(blob: bytes, data_dir: str):
+    """Rewrite __WORKER_LOCAL__ shuffle paths in a TaskDefinition to this
+    worker's private data directory; returns (new blob, local outputs).
+    Drivers use the token when they cannot know which worker will claim
+    the map task (disjoint spool dirs, no shared data filesystem)."""
+    from blaze_tpu.plan import plan_pb2 as pb
+
+    t = pb.TaskDefinitionProto()
+    t.ParseFromString(blob)
+    outputs = []
+
+    def walk(plan):
+        kind = plan.WhichOneof("kind")
+        if kind is None:
+            return
+        node = getattr(plan, kind)
+        if kind == "shuffle_writer":
+            for attr in ("data_file", "index_file"):
+                v = getattr(node, attr)
+                if v.startswith(WORKER_LOCAL_PREFIX):
+                    setattr(
+                        node, attr,
+                        os.path.join(
+                            data_dir,
+                            v[len(WORKER_LOCAL_PREFIX):].lstrip("/"),
+                        ),
+                    )
+            outputs.append((node.data_file, node.index_file))
+        for field, value in node.ListFields():
+            if field.message_type is None:
+                continue
+            if field.message_type.name == "PlanProto":
+                if field.label == field.LABEL_REPEATED:
+                    for sub in value:
+                        walk(sub)
+                else:
+                    walk(value)
+
+    walk(t.plan)
+    if not outputs:
+        return blob, []
+    return t.SerializeToString(), outputs
+
+
+def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
     import jax
 
     jax.config.update("jax_enable_x64", True)
 
-    from blaze_tpu.io.ipc import encode_ipc_segment
+    from blaze_tpu.io.ipc import encode_ipc_segment, partition_ranges
     from blaze_tpu.runtime.executor import execute_task
+    from blaze_tpu.runtime.transport import BlockServer
+
+    data_dir = data_dir or tempfile.mkdtemp(prefix="blz-worker-")
+    os.makedirs(data_dir, exist_ok=True)
+    # multi-host: bind/advertise a routable address via env (loopback
+    # only works when every worker shares this machine)
+    bind_host = os.environ.get("BLAZE_WORKER_BIND_HOST", "127.0.0.1")
+    server = BlockServer([data_dir], host=bind_host).start()
+    host, port = server.address
+    host = os.environ.get("BLAZE_WORKER_ADVERTISE_HOST", host)
 
     tasks_dir = os.path.join(spool, "tasks")
     claimed_dir = os.path.join(spool, "claimed")
@@ -156,19 +233,44 @@ def worker_main(spool: str) -> int:
         try:
             with open(path, "rb") as f:
                 blob = f.read()
+            blob, outputs = _rewrite_worker_local(blob, data_dir)
             parts = bytearray()
             for rb in execute_task(blob):
                 parts += encode_ipc_segment(rb)
             with open(os.path.join(out_dir, name + ".ipc"), "wb") as f:
                 f.write(bytes(parts))
+            meta = {
+                "host": host,
+                "port": port,
+                "outputs": [
+                    {
+                        "data": data,
+                        "index": index,
+                        "ranges": [
+                            list(r) for r in partition_ranges(index)
+                        ],
+                    }
+                    for data, index in outputs
+                    if os.path.exists(index)
+                ],
+            }
+            with open(
+                os.path.join(out_dir, name + ".meta.json"), "w"
+            ) as f:
+                json.dump(meta, f)
             open(os.path.join(out_dir, name + ".done"), "w").close()
         except Exception as e:  # report back to the driver
             import traceback
 
             with open(os.path.join(out_dir, name + ".err"), "w") as f:
                 f.write(f"{e}\n{traceback.format_exc()}")
+    server.stop()
     return 0
 
 
 if __name__ == "__main__":
-    raise SystemExit(worker_main(sys.argv[1]))
+    raise SystemExit(
+        worker_main(
+            sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None
+        )
+    )

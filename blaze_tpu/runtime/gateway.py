@@ -1,0 +1,108 @@
+"""Task gateway: the engine's cross-language entry point over a socket.
+
+The reference's defining boundary is JNI + Arrow C-Data with a batch
+handshake (exec.rs:118-255 decodes a TaskDefinition from the JVM and
+pumps batches back; JniBridge.java:33-36). This environment has no JVM,
+so the exercised out-of-process embedding is a socket gateway speaking
+the same two currencies: TaskDefinition protobuf in, segmented Arrow-IPC
+parts out (the u64-LE length + zstd Arrow-IPC framing of io/ipc.py -
+also the shuffle wire format, so any client that reads shuffle files can
+read this). A C++ client (cpp/blaze_client.cpp) drives it in tests,
+proving the L4 gateway contract without Python on the embedder side.
+
+Framing:
+  request:  u64-LE blob_len | TaskDefinition protobuf bytes
+  response: per batch, one segmented-IPC part (u64-LE part_len | zstd
+            Arrow IPC stream)
+            then u64-LE 0 (end of stream)
+            on error: u64-LE 0xFFFFFFFFFFFFFFFF | u32-LE msg_len | utf8
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_ERR = 0xFFFFFFFFFFFFFFFF
+MAX_TASK_BYTES = 64 << 20
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from blaze_tpu.io.ipc import encode_ipc_segment
+        from blaze_tpu.runtime.executor import execute_task
+
+        sock = self.request
+        try:
+            (blob_len,) = _U64.unpack(_recv_exact(sock, _U64.size))
+            if blob_len > MAX_TASK_BYTES:
+                raise ValueError("task too large")
+            blob = _recv_exact(sock, blob_len)
+        except Exception:
+            return
+        try:
+            for rb in execute_task(blob):
+                part = encode_ipc_segment(rb)
+                sock.sendall(part)  # already u64-LE length-prefixed
+            sock.sendall(_U64.pack(0))
+        except Exception as e:
+            msg = str(e).encode("utf-8")[:65536]
+            try:
+                sock.sendall(_U64.pack(_ERR) + _U32.pack(len(msg)) + msg)
+            except OSError:
+                pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True  # fixed-port restarts during TIME_WAIT
+
+
+class TaskGatewayServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = _Server(
+            (host, port), _Handler, bind_and_activate=True
+        )
+        self._srv.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+
+    @property
+    def address(self):
+        return self._srv.server_address
+
+    def start(self) -> "TaskGatewayServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        b = sock.recv(n - len(buf))
+        if not b:
+            raise ConnectionError("socket closed mid-frame")
+        buf += b
+    return buf
+
+
+def serve_forever(host: str = "127.0.0.1",
+                  port: int = 8484) -> None:  # pragma: no cover - CLI
+    srv = TaskGatewayServer(host, port)
+    print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
+    srv._thread.run()

@@ -1,0 +1,158 @@
+// Out-of-process embedding client for the blaze-tpu task gateway.
+//
+// Proves the engine's L4 gateway contract from a NON-Python embedder
+// (reference boundary: JNI callNative, exec.rs:118-255 / JniBridge.java:
+// 33-36): ships a serialized TaskDefinition protobuf over a socket,
+// receives segmented Arrow-IPC parts (u64-LE length + zstd Arrow IPC -
+// the engine's shuffle wire format), integrity-checks each part by zstd
+// decompression, and writes the raw part stream to a file for the
+// harness to decode and differential-check.
+//
+// Usage: blaze_client HOST PORT TASK_FILE OUT_FILE
+// Exit:  0 ok, 2 engine-reported error, 1 transport/usage error.
+//
+// Build: g++ -O2 -o blaze_client blaze_client.cpp -lzstd
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <zstd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+static bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+static bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr,
+                 "usage: blaze_client HOST PORT TASK_FILE OUT_FILE\n");
+    return 1;
+  }
+  const char* host = argv[1];
+  int port = std::atoi(argv[2]);
+
+  std::ifstream task(argv[3], std::ios::binary);
+  if (!task) {
+    std::fprintf(stderr, "cannot read %s\n", argv[3]);
+    return 1;
+  }
+  std::vector<char> blob((std::istreambuf_iterator<char>(task)),
+                         std::istreambuf_iterator<char>());
+
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    std::fprintf(stderr, "bad host %s\n", host);
+    return 1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr))) {
+    std::perror("connect");
+    return 1;
+  }
+
+  uint64_t blob_len = blob.size();  // u64-LE on every supported target
+  if (!send_all(fd, &blob_len, 8) ||
+      !send_all(fd, blob.data(), blob.size())) {
+    std::fprintf(stderr, "send failed\n");
+    return 1;
+  }
+
+  std::ofstream out(argv[4], std::ios::binary);
+  uint64_t parts = 0, total = 0;
+  for (;;) {
+    uint64_t part_len = 0;
+    if (!recv_all(fd, &part_len, 8)) {
+      std::fprintf(stderr, "stream truncated\n");
+      return 1;
+    }
+    if (part_len == 0) break;  // end-of-stream marker
+    if (part_len == 0xFFFFFFFFFFFFFFFFull) {  // engine error frame
+      uint32_t mlen = 0;
+      if (!recv_all(fd, &mlen, 4)) return 1;
+      std::vector<char> msg(mlen);
+      if (!recv_all(fd, msg.data(), mlen)) return 1;
+      std::fprintf(stderr, "engine error: %.*s\n",
+                   static_cast<int>(mlen), msg.data());
+      return 2;
+    }
+    std::vector<char> part(part_len);
+    if (!recv_all(fd, part.data(), part_len)) {
+      std::fprintf(stderr, "part truncated\n");
+      return 1;
+    }
+    // integrity: every part must be a valid zstd frame (Arrow IPC
+    // stream inside); decompress fully
+    unsigned long long raw =
+        ZSTD_getFrameContentSize(part.data(), part.size());
+    std::vector<char> plain;
+    if (raw == ZSTD_CONTENTSIZE_UNKNOWN ||
+        raw == ZSTD_CONTENTSIZE_ERROR) {
+      // streaming-decode fallback
+      size_t cap = part.size() * 8 + (1 << 20);
+      plain.resize(cap);
+      size_t got = ZSTD_decompress(plain.data(), cap, part.data(),
+                                   part.size());
+      if (ZSTD_isError(got)) {
+        std::fprintf(stderr, "bad zstd part: %s\n",
+                     ZSTD_getErrorName(got));
+        return 1;
+      }
+      plain.resize(got);
+    } else {
+      plain.resize(raw);
+      size_t got = ZSTD_decompress(plain.data(), raw, part.data(),
+                                   part.size());
+      if (ZSTD_isError(got) || got != raw) {
+        std::fprintf(stderr, "bad zstd part\n");
+        return 1;
+      }
+    }
+    // Arrow IPC streams open with a 0xFFFFFFFF continuation marker
+    if (plain.size() >= 4) {
+      uint32_t magic;
+      std::memcpy(&magic, plain.data(), 4);
+      if (magic != 0xFFFFFFFFu) {
+        std::fprintf(stderr, "part is not an Arrow IPC stream\n");
+        return 1;
+      }
+    }
+    out.write(reinterpret_cast<const char*>(&part_len), 8);
+    out.write(part.data(), static_cast<std::streamsize>(part_len));
+    parts++;
+    total += part_len;
+  }
+  ::close(fd);
+  std::printf("{\"parts\": %llu, \"bytes\": %llu}\n",
+              static_cast<unsigned long long>(parts),
+              static_cast<unsigned long long>(total));
+  return 0;
+}

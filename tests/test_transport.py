@@ -1,0 +1,133 @@
+"""Block transport tests: BlockServer framing/scoping, RemoteSegment
+streaming through the channel reader, and the full remote-fetch cluster
+exchange over DISJOINT worker data directories (reference remote path:
+ArrowBlockStoreShuffleReader301.scala:83-123, ipc_reader_exec.rs:283-326).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.io.ipc import encode_ipc_segment
+from blaze_tpu.ops import ExecContext
+from blaze_tpu.runtime.transport import (
+    BlockServer,
+    RemoteSegment,
+    open_remote_stream,
+)
+
+
+@pytest.fixture()
+def served_dir(tmp_path):
+    d = tmp_path / "blocks"
+    d.mkdir()
+    srv = BlockServer([str(d)]).start()
+    yield str(d), srv
+    srv.stop()
+
+
+def test_block_server_range_reads(served_dir):
+    d, srv = served_dir
+    path = os.path.join(d, "x.data")
+    payload = bytes(range(256)) * 10
+    with open(path, "wb") as f:
+        f.write(payload)
+    host, port = srv.address
+    s = open_remote_stream(RemoteSegment(host, port, path, 100, 300))
+    assert s.read() == payload[100:400]
+    s.close()
+    # whole file via length -1
+    s = open_remote_stream(RemoteSegment(host, port, path, 0, -1))
+    assert s.read() == payload
+    s.close()
+
+
+def test_block_server_scoping(served_dir, tmp_path):
+    d, srv = served_dir
+    outside = tmp_path / "secret.txt"
+    outside.write_text("no")
+    host, port = srv.address
+    with pytest.raises(IOError):
+        open_remote_stream(
+            RemoteSegment(host, port, str(outside), 0, -1)
+        ).read()
+
+
+def test_remote_segment_through_ipc_reader(served_dir):
+    """A RemoteSegment source streams through IpcReaderExec's channel
+    decode exactly like the reference's ReadableByteChannel path."""
+    from blaze_tpu.ops.ipc_reader import IpcReaderExec, IpcReadMode
+
+    d, srv = served_dir
+    rb = pa.record_batch({"a": pa.array([1, 2, 3], pa.int64())})
+    seg_bytes = encode_ipc_segment(rb)
+    path = os.path.join(d, "s.data")
+    with open(path, "wb") as f:
+        f.write(b"JUNKHEAD")  # offset support
+        f.write(seg_bytes)
+    host, port = srv.address
+    reader = IpcReaderExec(
+        "r1", ColumnBatch.from_arrow(rb).schema, 1,
+        IpcReadMode.CHANNEL_AND_FILE_SEGMENT,
+    )
+    ctx = ExecContext()
+    ctx.resources["r1"] = [
+        [RemoteSegment(host, port, path, 8, len(seg_bytes))]
+    ]
+    got = [cb.to_pydict() for cb in reader.execute(0, ctx)]
+    assert got == [{"a": [1, 2, 3]}]
+
+
+def test_remote_cluster_exchange_disjoint_dirs(tmp_path):
+    """End-to-end: map tasks write into per-worker PRIVATE dirs; reduce
+    reads stream every block over the BlockServers."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.parallel import RemoteClusterShuffleExchangeExec
+    from blaze_tpu.runtime.cluster import MiniCluster
+
+    rng = np.random.default_rng(3)
+    files = []
+    all_rows = []
+    for m in range(2):
+        ks = rng.integers(0, 100, 400)
+        vs = rng.integers(0, 10**6, 400)
+        all_rows += list(zip(ks.tolist(), vs.tolist()))
+        p = str(tmp_path / f"in{m}.parquet")
+        pq.write_table(
+            pa.table({"k": pa.array(ks, pa.int64()),
+                      "v": pa.array(vs, pa.int64())}), p,
+        )
+        files.append(p)
+    scan = ParquetScanExec([[FileRange(f)] for f in files])
+    with MiniCluster(
+        num_workers=2,
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+    ) as cluster:
+        ex = RemoteClusterShuffleExchangeExec(
+            scan, [Col("k")], 4, cluster,
+        )
+        ctx = ExecContext()
+        got = []
+        for p in range(4):
+            for cb in ex.execute(p, ctx):
+                d = cb.to_pydict()
+                got += list(zip(d["k"], d["v"]))
+        assert sorted(got) == sorted(all_rows)
+        # and the stats path works off the metadata
+        sizes = ex.map_output_statistics(ctx)
+        assert len(sizes) == 4 and sum(sizes) > 0
+        # disjointness: the outputs live under per-worker private dirs,
+        # not under any driver-chosen shared shuffle dir
+        metas = ex._run_map_stage(ctx)
+        dirs = {
+            os.path.dirname(out["data"])
+            for meta in metas for out in meta["outputs"]
+        }
+        for d in dirs:
+            assert "blz-worker" in d
