@@ -172,7 +172,10 @@ class ColumnBatch:
                     zero_copy_only=False)
                 np_vals = np.ascontiguousarray(np_vals, dtype=np.int32)
             elif dt.id is TypeId.DECIMAL:
-                np_vals = _decimal_unscaled_i64(arr)
+                if dt.is_wide_decimal:
+                    np_vals = _decimal_limbs(arr)  # (n, 2) [lo, hi]
+                else:
+                    np_vals = _decimal_unscaled_i64(arr)
             elif dt.id is TypeId.TIMESTAMP_US:
                 arr = arr.cast(pa.timestamp("us"))
                 np_vals = arr.to_numpy(zero_copy_only=False).astype(
@@ -192,7 +195,9 @@ class ColumnBatch:
             phys = dt.physical_dtype()
             if np_vals.dtype != phys:
                 np_vals = np_vals.astype(phys)
-            padded = np.zeros(cap, dtype=phys)
+            padded = np.zeros(
+                (cap, 2) if np_vals.ndim == 2 else cap, dtype=phys
+            )
             padded[:n] = np_vals
             validity = None
             if has_nulls or dt.id is TypeId.NULL:
@@ -266,9 +271,16 @@ class ColumnBatch:
                     indices, dict_arr
                 ).cast(to_arrow_type(dt))
             elif dt.id is TypeId.DECIMAL:
-                arr = _decimal_from_unscaled_i64(
-                    vals.astype(np.int64), mask, dt.precision, dt.scale
-                )
+                if vals.ndim == 2:
+                    arr = _decimal_from_limbs(
+                        vals.astype(np.int64), mask,
+                        dt.precision, dt.scale,
+                    )
+                else:
+                    arr = _decimal_from_unscaled_i64(
+                        vals.astype(np.int64), mask,
+                        dt.precision, dt.scale,
+                    )
             elif dt.id is TypeId.DATE32:
                 arr = pa.array(
                     vals.astype(np.int32), mask=mask, type=pa.int32()
@@ -329,6 +341,40 @@ def _decimal_unscaled_i64(arr) -> np.ndarray:
     return np.ascontiguousarray(lo)
 
 
+def _decimal_limbs(arr) -> np.ndarray:
+    """(n, 2) little-endian int64 limbs [lo bit-pattern, hi] of a
+    decimal128 array - the full 16-byte representation."""
+    import pyarrow as pa
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    buf = arr.buffers()[1]
+    n = len(arr)
+    if buf is None:
+        return np.zeros((n, 2), dtype=np.int64)
+    raw = np.frombuffer(buf, dtype=np.int64)
+    start = arr.offset * 2
+    return np.ascontiguousarray(
+        raw[start: start + 2 * n].reshape(n, 2)
+    )
+
+
+def _decimal_from_limbs(vals: np.ndarray, mask, precision: int,
+                        scale: int):
+    """(n, 2) [lo, hi] limbs -> Decimal128Array."""
+    import pyarrow as pa
+
+    n = len(vals)
+    data = pa.py_buffer(np.ascontiguousarray(vals).tobytes())
+    if mask is not None:
+        validity = pa.array(~mask).buffers()[1]
+    else:
+        validity = None
+    return pa.Array.from_buffers(
+        pa.decimal128(precision, scale), n, [validity, data]
+    )
+
+
 def _decimal_from_unscaled_i64(vals: np.ndarray, mask, precision: int,
                                scale: int):
     """Inverse of _decimal_unscaled_i64: i64 unscaled -> Decimal128Array."""
@@ -353,7 +399,10 @@ def empty_batch(schema: Schema, capacity: Optional[int] = None) -> ColumnBatch:
     cols = []
     for f in schema:
         phys = f.dtype.physical_dtype()
-        cols.append(Column(f.dtype, jnp.zeros(cap, dtype=phys), None, None))
+        shape = (cap, 2) if f.dtype.is_wide_decimal else (cap,)
+        cols.append(
+            Column(f.dtype, jnp.zeros(shape, dtype=phys), None, None)
+        )
     return ColumnBatch(schema, cols, 0)
 
 

@@ -90,6 +90,26 @@ _STRING_FNS_STR = {
 }
 
 
+def expr_computes_wide_decimal(e: ir.Expr, schema: Schema) -> bool:
+    """True when any non-passthrough node consumes a decimal(>18) input.
+    Wide decimals are limb-pair columns (types.is_wide_decimal) that
+    pass through scans/projections/aggregate states exactly, but VALUE
+    compute on them needs 128-bit host math - operators raise at
+    CONSTRUCTION so the planner's tryConvert falls back to the host
+    tier (the window the reference uses, BlazeConverters tryConvert)."""
+    if isinstance(e, (ir.BoundCol, ir.Col, ir.Literal)):
+        return False
+    for c in ir.children(e):
+        if expr_computes_wide_decimal(c, schema):
+            return True
+        try:
+            if infer_dtype(c, schema).is_wide_decimal:
+                return True
+        except Exception:
+            continue
+    return False
+
+
 def infer_dtype(e: ir.Expr, schema: Schema) -> DataType:
     if isinstance(e, ir.Literal):
         return e.dtype

@@ -27,8 +27,14 @@ from blaze_tpu.runtime.dispatch import cached_kernel
 
 class FilterExec(PhysicalOp):
     def __init__(self, child: PhysicalOp, predicate: ir.Expr):
+        from blaze_tpu.exprs.typing import expr_computes_wide_decimal
+
         self.children = [child]
         self.predicate = bind_opt(predicate, child.schema)
+        if expr_computes_wide_decimal(self.predicate, child.schema):
+            raise NotImplementedError(
+                "predicates on decimal(>18) are host-tier work"
+            )
 
     @property
     def schema(self) -> Schema:

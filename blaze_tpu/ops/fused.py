@@ -374,10 +374,14 @@ class HostFinalAggExec(PhysicalOp):
     # ------------------------------------------------------------------
     def _finalize_host(self, cb: ColumnBatch) -> ColumnBatch:
         """Vectorized numpy finalization of one unique-group state batch."""
-        from blaze_tpu.ops.hash_aggregate import _state_width
+        from blaze_tpu.ops.hash_aggregate import (
+            _parse_dsum_scale,
+            _state_width,
+        )
 
         n = cb.num_rows
         n_keys = len(self.template.keys)
+        partial_fields = self.children[0].schema.fields
         host = [
             (np.asarray(c.values),
              np.asarray(c.validity) if c.validity is not None else None)
@@ -394,17 +398,32 @@ class HostFinalAggExec(PhysicalOp):
         for (a, name), field in zip(
             self.template.aggs, self._schema.fields[n_keys:]
         ):
-            w = _state_width(a)
+            dscale = _parse_dsum_scale(partial_fields[pos].name)
+            w = _state_width(a.fn, dscale is not None)
             states = host[pos: pos + w]
             pos += w
             out_cols.append(
-                Column(field.dtype, *self._finalize_agg(a, field, states))
+                Column(
+                    field.dtype,
+                    *self._finalize_agg(a, field, states, dscale),
+                )
             )
         return ColumnBatch(self._schema, out_cols, n)
 
     @staticmethod
-    def _finalize_agg(a: AggExpr, field, states):
+    def _finalize_agg(a: AggExpr, field, states, dscale=None):
+        from blaze_tpu.ops.hash_aggregate import _reassemble_decimal
+
         fn = a.fn
+        if dscale is not None and fn in (AggFn.SUM, AggFn.AVG):
+            chunks = [v for v, _ in states[:4]]
+            any_v = states[0][1]
+            count = states[4][0] if fn is AggFn.AVG else None
+            limbs, mask, dt = _reassemble_decimal(
+                chunks, any_v, count, dscale, fn is AggFn.AVG
+            )
+            assert dt == field.dtype, (dt, field.dtype)
+            return limbs, mask
         if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
             return states[0][0], None
         if fn in (AggFn.SUM, AggFn.MIN, AggFn.MAX, AggFn.FIRST,
@@ -414,13 +433,6 @@ class HostFinalAggExec(PhysicalOp):
             (s, sm), (c, _) = states
             safe = np.maximum(c, 1)
             valid = c > 0 if sm is None else (sm & (c > 0))
-            if field.dtype.id is TypeId.DECIMAL:
-                # scale+4 with Spark HALF_UP (mirror of _decimal_avg)
-                num = s.astype(np.int64) * 10000
-                q = num // safe
-                r = num - q * safe
-                half_up = np.where(num >= 0, 2 * r >= safe, 2 * r > safe)
-                return q + half_up.astype(np.int64), valid
             return (
                 s.astype(np.float64) / safe.astype(np.float64), valid
             )

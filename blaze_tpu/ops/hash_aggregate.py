@@ -73,17 +73,70 @@ class NamedAgg:
     name: str
 
 
-def _decimal_avg(sum_unscaled, safe_count):
-    """Decimal AVG at scale+4 with Spark's HALF_UP rounding (away from
-    zero at .5), computed on floor-division remainders so both signs round
-    correctly. The *10^4 pre-scale bounds |sum| < ~9.2e14 (i64 headroom);
-    beyond that needs 128-bit state (ROADMAP)."""
-    num = sum_unscaled.astype(jnp.int64) * 10000
-    q = num // safe_count
-    r = num - q * safe_count  # 0 <= r < count (floor semantics)
-    half_up = jnp.where(num >= 0, 2 * r >= safe_count,
-                        2 * r > safe_count)
-    return q + half_up.astype(jnp.int64)
+def _decimal_chunks(cv):
+    """Split decimal unscaled values into four 32-bit chunk columns so
+    segment sums never overflow i64: value = sum(c_k * 2^(32k)), c3
+    carries the sign. Narrow input is a 1-D i64 array; wide input is the
+    (capacity, 2) [lo-bit-pattern, hi] limb pair (types.is_wide_decimal,
+    the reference's 16-byte decimal slot, shuffle_writer_exec.rs:
+    196-220)."""
+    mask = jnp.int64(0xFFFFFFFF)
+    if cv.ndim == 1:
+        c0 = cv & mask
+        c1 = cv >> 32  # arithmetic: carries the sign
+        z = jnp.zeros_like(cv)
+        return [c0, c1, z, z]
+    lo = cv[:, 0]
+    hi = cv[:, 1]
+    lo_u = lo.astype(jnp.uint64)
+    c0 = (lo_u & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+    c1 = (lo_u >> jnp.uint64(32)).astype(jnp.int64)
+    c2 = hi & mask
+    c3 = hi >> 32  # arithmetic: the 128-bit sign
+    return [c0, c1, c2, c3]
+
+
+_DEC38_MAX = 10**38 - 1
+_U64 = (1 << 64) - 1
+
+
+def _reassemble_decimal(chunk_cols: List[np.ndarray],
+                        any_v: Optional[np.ndarray],
+                        count: Optional[np.ndarray],
+                        scale: int, avg: bool):
+    """Host-exact reassembly of chunked decimal sums -> (values, mask,
+    DataType). SUM overflowing decimal(38) nulls out (Spark non-ANSI);
+    AVG divides at scale+4 with HALF_UP using full-precision ints."""
+    total = (
+        chunk_cols[0].astype(object)
+        + (chunk_cols[1].astype(object) << 32)
+        + (chunk_cols[2].astype(object) << 64)
+        + (chunk_cols[3].astype(object) << 96)
+    )
+    out_scale = scale
+    if avg:
+        out_scale = min(scale + 4, 38)
+        mul = 10 ** (out_scale - scale)
+        safe = np.maximum(count, 1).astype(object)
+        num = total * mul
+        q = num // safe
+        r = num - q * safe
+        half_up = np.where(num >= 0, 2 * r >= safe, 2 * r > safe)
+        total = q + half_up.astype(object)
+    overflow = np.abs(total) > _DEC38_MAX
+    mask = any_v.copy() if any_v is not None else np.ones(
+        len(total), dtype=bool
+    )
+    mask &= ~overflow
+    safe_total = np.where(overflow, 0, total)
+    t_mod = np.mod(safe_total, 1 << 128)  # two's complement 128
+    lo = t_mod & _U64
+    hi = t_mod >> 64
+    to_i64 = lambda x: np.where(
+        x >= (1 << 63), x - (1 << 64), x
+    ).astype(np.int64)
+    limbs = np.stack([to_i64(lo), to_i64(hi)], axis=1)
+    return limbs, mask, DataType.decimal(38, out_scale)
 
 
 def _state_fields(agg: AggExpr, name: str, in_schema: Schema) -> List[Field]:
@@ -91,6 +144,22 @@ def _state_fields(agg: AggExpr, name: str, in_schema: Schema) -> List[Field]:
     if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
         return [Field(f"{name}#count", DataType.int64(), False)]
     ct = infer_dtype(agg.child, in_schema)
+    if fn in (AggFn.SUM, AggFn.AVG) and ct.id is TypeId.DECIMAL:
+        # chunked 128-bit-exact sum state; the scale rides in the field
+        # name so the FINAL side (which only sees the partial schema,
+        # e.g. across a shuffle) can finalize exactly
+        fields = [
+            Field(
+                f"{name}#dsum{ct.scale}_c{k}", DataType.int64(),
+                k == 0,
+            )
+            for k in range(4)
+        ]
+        if fn is AggFn.AVG:
+            fields.append(
+                Field(f"{name}#count", DataType.int64(), False)
+            )
+        return fields
     if fn is AggFn.SUM:
         return [Field(f"{name}#sum", _sum_type(ct), True)]
     if fn in (AggFn.MIN, AggFn.MAX, AggFn.FIRST, AggFn.LAST):
@@ -106,6 +175,36 @@ def _state_fields(agg: AggExpr, name: str, in_schema: Schema) -> List[Field]:
         Field(f"{name}#s1", DataType.float64(), False),
         Field(f"{name}#s2", DataType.float64(), False),
     ]
+
+
+def _state_width(fn: AggFn, chunked: bool) -> int:
+    """Positional state width per aggregate (immune to duplicate output
+    aliases - the layout is deterministic given fn + whether the first
+    state field carries the chunked-decimal #dsum marker)."""
+    if fn in (AggFn.COUNT, AggFn.COUNT_STAR, AggFn.MIN, AggFn.MAX,
+              AggFn.FIRST, AggFn.LAST):
+        return 1
+    if fn is AggFn.SUM:
+        return 4 if chunked else 1
+    if fn is AggFn.AVG:
+        return 5 if chunked else 2
+    return 3  # var/stddev moments
+
+
+def _parse_dsum_scale(field_name: str) -> Optional[int]:
+    """Scale encoded in a chunked-decimal state field name, or None."""
+    marker = "#dsum"
+    i = field_name.find(marker)
+    if i < 0:
+        return None
+    rest = field_name[i + len(marker):]
+    j = rest.find("_c")
+    if j <= 0:
+        return None
+    try:
+        return int(rest[:j])
+    except ValueError:
+        return None
 
 
 def _sum_type(ct: DataType) -> DataType:
@@ -132,15 +231,24 @@ class HashAggregateExec(PhysicalOp):
             # child refs are ignored in FINAL mode; states are located
             # positionally in the partial output (keys first, then states
             # in agg order) - mirror of the reference's partial/final
-            # column splice (NativeHashAggregateExec.scala:98-161)
+            # column splice (NativeHashAggregateExec.scala:98-161).
+            # Widths come from the partial schema's "{name}#..." field
+            # names, which also carry the chunked-decimal scale marker.
             self.aggs = []
+            self._final_widths: List[int] = []
             pos = len(self.keys)
+            fields = in_schema.fields
             for a, n in aggs:
-                first_state = in_schema.fields[pos]
+                chunked = (
+                    _parse_dsum_scale(fields[pos].name) is not None
+                )
+                width = _state_width(a.fn, chunked)
+                first_state = fields[pos]
                 self.aggs.append(
                     (AggExpr(a.fn, ir.BoundCol(pos, first_state.dtype)), n)
                 )
-                pos += _state_width(a)
+                self._final_widths.append(width)
+                pos += width
         else:
             self.aggs = [
                 (
@@ -160,9 +268,26 @@ class HashAggregateExec(PhysicalOp):
                     raise NotImplementedError(
                         "MIN/MAX over strings is host-tier work (planned)"
                     )
+            if (
+                mode is not AggMode.FINAL
+                and a.child is not None
+                and a.fn not in (AggFn.SUM, AggFn.AVG, AggFn.COUNT,
+                                 AggFn.FIRST, AggFn.LAST)
+                and infer_dtype(a.child, in_schema).is_wide_decimal
+            ):
+                # 128-bit ordering/moments need host math; SUM/AVG use
+                # the chunked state, FIRST/LAST/COUNT are passthrough
+                raise NotImplementedError(
+                    f"{a.fn.value} over decimal(>18) is host-tier work"
+                )
         key_fields = [
             Field(n, infer_dtype(e, in_schema), True) for e, n in self.keys
         ]
+        for f in key_fields:
+            if f.dtype.is_wide_decimal:
+                raise NotImplementedError(
+                    "group keys of decimal(>18) are host-tier work"
+                )
         if mode is AggMode.PARTIAL:
             state_fields: List[Field] = []
             for a, n in self.aggs:
@@ -319,10 +444,33 @@ class HashAggregateExec(PhysicalOp):
             ):
                 dictionary = aug.columns[e.index].dictionary
             cols.append(Column(field.dtype, v, m, dictionary))
-        for (v, m), field in zip(
-            outs[len(self.keys):], self._schema.fields[len(self.keys):]
-        ):
-            cols.append(Column(field.dtype, v, m, None))
+        agg_fields = self._schema.fields[len(self.keys):]
+        it = iter(outs[len(self.keys):])
+        if self.mode is AggMode.PARTIAL:
+            # state fields align 1:1 with kernel outputs
+            for (v, m), field in zip(it, agg_fields):
+                cols.append(Column(field.dtype, v, m, None))
+        else:
+            for (a, _), field in zip(self.aggs, agg_fields):
+                spec = self._agg_spec(a, aug.schema)
+                if spec[0] == "plain":
+                    v, m = next(it)
+                    cols.append(Column(field.dtype, v, m, None))
+                    continue
+                # chunked decimal: exact host reassembly into limbs
+                pairs = [next(it) for _ in range(4)]
+                count = (
+                    np.asarray(next(it)[0])
+                    if spec[0] == "dec_avg" else None
+                )
+                chunks = [np.asarray(v) for v, _ in pairs]
+                any_np = np.asarray(pairs[0][1])
+                limbs, mask, dt = _reassemble_decimal(
+                    chunks, any_np, count, spec[1],
+                    spec[0] == "dec_avg",
+                )
+                assert dt == field.dtype, (dt, field.dtype)
+                cols.append(Column(field.dtype, limbs, mask, None))
         return ColumnBatch(self._schema, cols, n)
 
     # ------------------------------------------------------------------
@@ -427,14 +575,34 @@ class HashAggregateExec(PhysicalOp):
 
     def _state_offsets(self, in_schema: Schema):
         """In FINAL mode, locate each agg's state columns positionally:
-        keys first, then state columns in agg order."""
+        keys first, then state columns in agg order (widths were scanned
+        from the partial schema's field names at construction)."""
         offs = {}
         pos = len(self.keys)
         for i, (a, n) in enumerate(self.aggs):
-            width = _state_width(a)
+            width = self._final_widths[i]
             offs[i] = (pos, width)
             pos += width
         return offs
+
+    def _agg_spec(self, a: AggExpr, in_schema: Schema):
+        """Output classification: ("plain", None) or
+        ("dec_sum"|"dec_avg", scale) for chunked-exact decimal
+        aggregation whose result reassembles on the host."""
+        if a.fn not in (AggFn.SUM, AggFn.AVG):
+            return ("plain", None)
+        if self.mode is AggMode.FINAL:
+            s = _parse_dsum_scale(in_schema.fields[a.child.index].name)
+            if s is not None:
+                return (
+                    "dec_avg" if a.fn is AggFn.AVG else "dec_sum", s
+                )
+            return ("plain", None)
+        ct = infer_dtype(a.child, in_schema)
+        if ct.id is TypeId.DECIMAL:
+            return ("dec_avg" if a.fn is AggFn.AVG else "dec_sum",
+                    ct.scale)
+        return ("plain", None)
 
     def _agg_state(self, a, i, ev, idx, s_live, gid, capacity,
                    child_map, merging, state_offsets, cols):
@@ -446,25 +614,42 @@ class HashAggregateExec(PhysicalOp):
         if merging:
             pos, width = state_offsets[i]
             states = [
-                (jnp.take(cols[pos + k][0], idx),
+                (jnp.take(cols[pos + k][0], idx, axis=0),
                  jnp.take(cols[pos + k][1], idx)
                  if cols[pos + k][1] is not None else None)
                 for k in range(width)
             ]
-            return self._merge_states(a, states, seg, live_f, gid, capacity)
+            spec = self._agg_spec(a, ev.schema)
+            return self._merge_states(
+                a, states, seg, live_f, gid, capacity, spec
+            )
 
         # raw input -> state/result
         if fn is AggFn.COUNT_STAR:
             c = seg(live_f.astype(jnp.int64))
             return [(c, None)]
         cv, cm = ev.evaluate(child_map[i])
-        cv = jnp.take(cv, idx)
+        cv = jnp.take(cv, idx, axis=0)
         cm_s = jnp.take(cm, idx) if cm is not None else None
         contrib = live_f if cm_s is None else (live_f & cm_s)
         if fn is AggFn.COUNT:
             return [(seg(contrib.astype(jnp.int64)), None)]
         if fn in (AggFn.SUM, AggFn.AVG):
             st = _sum_type(infer_dtype_of(a, ev.schema))
+            if st.id is TypeId.DECIMAL:
+                # chunked 128-bit-exact sum; result reassembles on host
+                chunks = _decimal_chunks(cv)
+                sums = [
+                    seg(jnp.where(contrib, c, jnp.zeros_like(c)))
+                    for c in chunks
+                ]
+                any_v = seg(contrib.astype(jnp.int64)) > 0
+                out = [(sums[0], any_v)] + [
+                    (c, None) for c in sums[1:]
+                ]
+                if fn is AggFn.AVG:
+                    out.append((seg(contrib.astype(jnp.int64)), None))
+                return out
             acc = jnp.where(contrib, cv, jnp.zeros_like(cv)).astype(
                 st.physical_dtype()
             )
@@ -476,8 +661,6 @@ class HashAggregateExec(PhysicalOp):
             if self.mode is AggMode.PARTIAL:
                 return [(s, any_v), (cnt, None)]
             safe = jnp.maximum(cnt, 1)
-            if st.id is TypeId.DECIMAL:
-                return [(_decimal_avg(s, safe), any_v)]  # scale+4
             return [(s / safe.astype(jnp.float64), any_v)]
         if fn in (AggFn.MIN, AggFn.MAX):
             phys = cv.dtype
@@ -514,7 +697,7 @@ class HashAggregateExec(PhysicalOp):
                 )
             has = (best >= 0) & (best < big)
             safe_best = jnp.clip(best, 0, capacity - 1)
-            vals = jnp.take(cv, safe_best)
+            vals = jnp.take(cv, safe_best, axis=0)
             return [(vals, has)]
         # var/stddev family: moments
         x = jnp.where(contrib, cv, jnp.zeros_like(cv)).astype(jnp.float64)
@@ -525,8 +708,26 @@ class HashAggregateExec(PhysicalOp):
             return [(n, None), (s1, None), (s2, None)]
         return [_finalize_var(a.fn, n, s1, s2)]
 
-    def _merge_states(self, a, states, seg, live_f, gid, capacity):
+    def _merge_states(self, a, states, seg, live_f, gid, capacity,
+                      spec=("plain", None)):
         fn = a.fn
+        if spec[0] in ("dec_sum", "dec_avg"):
+            # chunk sums merge by plain segment addition
+            c0, m0 = states[0]
+            contrib = live_f if m0 is None else (live_f & m0)
+            sums = [
+                seg(jnp.where(live_f, c, jnp.zeros_like(c)))
+                for c, _ in states[:4]
+            ]
+            any_v = seg(contrib.astype(jnp.int64)) > 0
+            out = [(sums[0], any_v)] + [(c, None) for c in sums[1:]]
+            if spec[0] == "dec_avg":
+                cnt, _ = states[4]
+                out.append(
+                    (seg(jnp.where(live_f, cnt, jnp.zeros_like(cnt))),
+                     None)
+                )
+            return out
         if fn in (AggFn.COUNT, AggFn.COUNT_STAR):
             v, _ = states[0]
             return [(seg(jnp.where(live_f, v, 0)), None)]
@@ -561,15 +762,8 @@ class HashAggregateExec(PhysicalOp):
             c = seg(jnp.where(live_f, cv2, jnp.zeros_like(cv2)))
             any_v = c > 0
             safe = jnp.maximum(c, 1)
-            # the state's logical type (BoundCol in FINAL mode) decides
-            # decimal-vs-float finalization; int64 sums of plain integers
-            # still produce a double AVG like Spark
-            state_is_decimal = (
-                isinstance(a.child, ir.BoundCol)
-                and a.child.dtype.id is TypeId.DECIMAL
-            )
-            if state_is_decimal:
-                return [(_decimal_avg(s, safe), any_v)]  # scale+4
+            # decimal AVG runs on the chunked path above; this is the
+            # int/float double AVG
             return [(s.astype(jnp.float64)
                      / safe.astype(jnp.float64), any_v)]
         if fn in (AggFn.FIRST, AggFn.LAST):
@@ -584,7 +778,7 @@ class HashAggregateExec(PhysicalOp):
                 rank = jnp.where(contrib, pos_in, -1)
                 best = jax.ops.segment_max(rank, gid, num_segments=capacity)
             has = (best >= 0) & (best < big)
-            vals = jnp.take(v, jnp.clip(best, 0, capacity - 1))
+            vals = jnp.take(v, jnp.clip(best, 0, capacity - 1), axis=0)
             return [(vals, has)]
         # moments merge
         (nv, _), (s1v, _), (s2v, _) = states
@@ -623,28 +817,22 @@ def _finalize_var(fn: AggFn, n, s1, s2):
     return (out, valid)
 
 
-def _state_width(a: AggExpr) -> int:
-    if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR, AggFn.SUM, AggFn.MIN,
-                AggFn.MAX, AggFn.FIRST, AggFn.LAST):
-        return 1
-    if a.fn is AggFn.AVG:
-        return 2
-    return 3
-
-
 def _result_type(a: AggExpr, in_schema: Schema, mode: AggMode) -> DataType:
     if mode is AggMode.FINAL:
         # child is a BoundCol at the first state column (see __init__)
         if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
             return DataType.int64()
+        dscale = _parse_dsum_scale(in_schema.fields[a.child.index].name)
+        if dscale is not None:
+            if a.fn is AggFn.AVG:
+                return DataType.decimal(38, min(dscale + 4, 38))
+            return DataType.decimal(38, dscale)
         st = a.child.dtype
         if a.fn is AggFn.SUM or a.fn in (
             AggFn.MIN, AggFn.MAX, AggFn.FIRST, AggFn.LAST
         ):
             return st
         if a.fn is AggFn.AVG:
-            if st.id is TypeId.DECIMAL:
-                return DataType.decimal(38, min(st.scale + 4, 38))
             return DataType.float64()
         return DataType.float64()  # var/stddev
     return infer_dtype(a, in_schema)
@@ -660,7 +848,8 @@ def _empty_global_row(op: HashAggregateExec) -> ColumnBatch:
     cap = get_config().shape_buckets[0]
     for field, (a, _) in zip(op.schema.fields, op.aggs):
         phys = field.dtype.physical_dtype()
-        v = jnp.zeros(cap, dtype=phys)
+        shape = (cap, 2) if field.dtype.is_wide_decimal else (cap,)
+        v = jnp.zeros(shape, dtype=phys)
         if a.fn in (AggFn.COUNT, AggFn.COUNT_STAR):
             cols.append(Column(field.dtype, v, None, None))
         else:

@@ -71,24 +71,17 @@ class IpcReaderExec(PhysicalOp):
         )
         from blaze_tpu.runtime.transport import (
             RemoteSegment,
-            open_remote_stream,
+            iter_remote_batches,
         )
 
         rows = 0
         for src in sources:
             if isinstance(src, RemoteSegment):
                 # remote block streamed off another host's BlockServer
-                # (reference remote-fetch path, ipc_reader_exec.rs:283-326);
-                # the socket must close even if the consumer stops early
-                from blaze_tpu.io.ipc import decode_ipc_stream
-
-                stream = open_remote_stream(src)
-                try:
-                    for rb in decode_ipc_stream(stream):
-                        rows += rb.num_rows
-                        yield ColumnBatch.from_arrow(rb)
-                finally:
-                    stream.close()
+                # (reference remote-fetch path, ipc_reader_exec.rs:283-326)
+                for rb in iter_remote_batches(src):
+                    rows += rb.num_rows
+                    yield ColumnBatch.from_arrow(rb)
                 continue
             if isinstance(src, FileSegment):
                 it = read_file_segment(src.path, src.offset, src.length)

@@ -115,6 +115,10 @@ def _key_hash_cols(cols: List[Column]) -> List[Tuple]:
     dictionary unification)."""
     out = []
     for c in cols:
+        if c.dtype.is_wide_decimal:
+            raise NotImplementedError(
+                "join keys of decimal(>18) are host-tier work"
+            )
         dt = c.dtype
         if dt.is_dictionary_encoded:
             dt = DataType.int32()
@@ -371,10 +375,11 @@ def _null_side(schema_fields, capacity: int) -> List[Column]:
     cols = []
     for f in schema_fields:
         phys = f.dtype.physical_dtype()
+        shape = (capacity, 2) if f.dtype.is_wide_decimal else (capacity,)
         cols.append(
             Column(
                 f.dtype,
-                np.zeros(capacity, dtype=phys),
+                np.zeros(shape, dtype=phys),
                 np.zeros(capacity, dtype=bool),
                 None,
             )

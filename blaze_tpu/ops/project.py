@@ -28,7 +28,14 @@ class ProjectExec(PhysicalOp):
     def __init__(self, child: PhysicalOp,
                  exprs: Sequence[Tuple[ir.Expr, str]]):
         self.children = [child]
+        from blaze_tpu.exprs.typing import expr_computes_wide_decimal
+
         self.exprs = [(bind_opt(e, child.schema), name) for e, name in exprs]
+        for e, _ in self.exprs:
+            if expr_computes_wide_decimal(e, child.schema):
+                raise NotImplementedError(
+                    "compute on decimal(>18) is host-tier work"
+                )
         self._schema = Schema(
             [
                 Field(name, infer_dtype(e, child.schema), True)

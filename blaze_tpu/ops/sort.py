@@ -22,6 +22,7 @@ from blaze_tpu.types import Schema
 from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.optimize import bind_opt
+from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.util import (
     compact,
@@ -48,6 +49,11 @@ class SortExec(PhysicalOp):
                     k.nulls_first)
             for k in keys
         ]
+        for k in self.keys:
+            if infer_dtype(k.expr, child.schema).is_wide_decimal:
+                raise NotImplementedError(
+                    "sort keys of decimal(>18) are host-tier work"
+                )
         self.fetch = fetch
 
     @property

@@ -181,7 +181,10 @@ def _concat_many(values_in, masks_in, lengths, cap: int, any_mask):
     vs = []
     ms = []
     for ci, parts in enumerate(values_in):
-        out = jnp.zeros(cap + 1, dtype=parts[0].dtype)
+        # trailing dims (e.g. wide-decimal limb pairs) ride along
+        out = jnp.zeros(
+            (cap + 1,) + parts[0].shape[1:], dtype=parts[0].dtype
+        )
         mout = jnp.zeros(cap + 1, dtype=jnp.bool_)
         for i, p in enumerate(parts):
             pos = jnp.arange(p.shape[0], dtype=jnp.int32)

@@ -65,6 +65,13 @@ class WindowExec(PhysicalOp):
             )
             for f in functions
         ]
+        for e in self.partition_by + [k.expr for k in self.order_by] + [
+            f.source for f in self.functions if f.source is not None
+        ]:
+            if infer_dtype(e, schema).is_wide_decimal:
+                raise NotImplementedError(
+                    "window over decimal(>18) is host-tier work"
+                )
         out_fields = list(schema.fields)
         for f in self.functions:
             out_fields.append(

@@ -325,16 +325,11 @@ class RemoteClusterShuffleExchangeExec(ClusterShuffleExchangeExec):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
-        from blaze_tpu.io.ipc import decode_ipc_stream
-        from blaze_tpu.runtime.transport import open_remote_stream
+        from blaze_tpu.runtime.transport import iter_remote_batches
 
         for seg in self.segments_for((partition, partition + 1), ctx):
-            stream = open_remote_stream(seg)
-            try:
-                for rb in decode_ipc_stream(stream):
-                    yield ColumnBatch.from_arrow(rb)
-            finally:
-                stream.close()
+            for rb in iter_remote_batches(seg):
+                yield ColumnBatch.from_arrow(rb)
 
 
 class CoalescedShuffleReader(PhysicalOp):
@@ -367,10 +362,10 @@ class CoalescedShuffleReader(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
-        from blaze_tpu.io.ipc import decode_ipc_stream, read_file_segment
+        from blaze_tpu.io.ipc import read_file_segment
         from blaze_tpu.runtime.transport import (
             RemoteSegment,
-            open_remote_stream,
+            iter_remote_batches,
         )
 
         ex: ShuffleExchangeExec = self.children[0]
@@ -380,12 +375,8 @@ class CoalescedShuffleReader(PhysicalOp):
             if isinstance(seg, RemoteSegment):
                 # remote-exchange segments stream over the BlockServer;
                 # their paths live in another process's private dir
-                stream = open_remote_stream(seg)
-                try:
-                    for rb in decode_ipc_stream(stream):
-                        yield ColumnBatch.from_arrow(rb)
-                finally:
-                    stream.close()
+                for rb in iter_remote_batches(seg):
+                    yield ColumnBatch.from_arrow(rb)
             else:
                 for rb in read_file_segment(
                     seg.path, seg.offset, seg.length

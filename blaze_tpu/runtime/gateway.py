@@ -20,11 +20,11 @@ Framing:
 
 from __future__ import annotations
 
-import socket
 import socketserver
 import struct
 import threading
-from typing import Optional
+
+from blaze_tpu.runtime.transport import _recv_exact
 
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
@@ -91,18 +91,8 @@ class TaskGatewayServer:
         self.stop()
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        b = sock.recv(n - len(buf))
-        if not b:
-            raise ConnectionError("socket closed mid-frame")
-        buf += b
-    return buf
-
-
 def serve_forever(host: str = "127.0.0.1",
                   port: int = 8484) -> None:  # pragma: no cover - CLI
     srv = TaskGatewayServer(host, port)
     print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
-    srv._thread.run()
+    srv._srv.serve_forever()

@@ -44,6 +44,9 @@ class RemoteSegment:
     length: int
 
 
+_CHUNK = 1 << 20
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: BlockServer = self.server.block_server  # type: ignore
@@ -56,19 +59,24 @@ class _Handler(socketserver.BaseRequestHandler):
             offset, length = _REQ_RANGE.unpack(
                 _recv_exact(self.request, _REQ_RANGE.size)
             )
-            data = server.read_range(path, offset, length)
+            f, total = server.open_range(path, offset, length)
         except Exception:
             try:
                 self.request.sendall(_RESP_HEAD.pack(1, 0))
             except OSError:
                 pass
             return
-        self.request.sendall(_RESP_HEAD.pack(0, len(data)))
-        # stream in chunks; a shuffle block can be large
-        view = memoryview(data)
-        CHUNK = 1 << 20
-        for i in range(0, len(view), CHUNK):
-            self.request.sendall(view[i: i + CHUNK])
+        # stream straight off the file in bounded chunks: O(chunk)
+        # memory per connection regardless of block size
+        with f:
+            self.request.sendall(_RESP_HEAD.pack(0, total))
+            left = total
+            while left:
+                chunk = f.read(min(left, _CHUNK))
+                if not chunk:
+                    break  # truncated on disk; client sees short stream
+                self.request.sendall(chunk)
+                left -= len(chunk)
 
 
 class BlockServer:
@@ -99,17 +107,26 @@ class BlockServer:
         self._srv.shutdown()
         self._srv.server_close()
 
-    def read_range(self, path: str, offset: int, length: int) -> bytes:
+    def open_range(self, path: str, offset: int, length: int):
+        """(open file positioned at offset, byte count) for a scoped
+        range; length < 0 means to end-of-file."""
         real = os.path.realpath(path)
         if not any(
             real == r or real.startswith(r + os.sep) for r in self.roots
         ):
             raise PermissionError(f"{path} outside served roots")
-        with open(real, "rb") as f:
-            f.seek(offset)
-            if length < 0:
-                return f.read()
-            return f.read(length)
+        size = os.path.getsize(real)
+        if length < 0:
+            length = max(size - offset, 0)
+        length = min(length, max(size - offset, 0))
+        f = open(real, "rb")
+        f.seek(offset)
+        return f, length
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        f, total = self.open_range(path, offset, length)
+        with f:
+            return f.read(total)
 
 
 class _SocketStream(io.RawIOBase):
@@ -166,6 +183,19 @@ def open_remote_stream(seg: RemoteSegment,
     except Exception:
         sock.close()
         raise
+
+
+def iter_remote_batches(seg: RemoteSegment):
+    """Stream one remote block's Arrow RecordBatches, closing the socket
+    even when the consumer stops early - the single fetch loop shared by
+    every remote-read call site."""
+    from blaze_tpu.io.ipc import decode_ipc_stream
+
+    stream = open_remote_stream(seg)
+    try:
+        yield from decode_ipc_stream(stream)
+    finally:
+        stream.close()
 
 
 def _recv_exact(sock, n: int) -> bytes:

@@ -123,6 +123,16 @@ class DataType:
         """True when the device representation is int32 codes + host dict."""
         return self.is_string_like
 
+    @property
+    def is_wide_decimal(self) -> bool:
+        """DECIMAL whose unscaled values exceed i64 (precision > 18):
+        the device representation is a (capacity, 2) int64 array of
+        little-endian limbs [lo64-bit-pattern, hi64] (the reference's
+        16-byte decimal shuffle slot, shuffle_writer_exec.rs:196-220).
+        Wide columns pass through scans/aggregates exactly; value
+        compute on them is host-tier work."""
+        return self.id is TypeId.DECIMAL and self.precision > 18
+
     def physical_dtype(self) -> np.dtype:
         """numpy dtype of the on-device value array."""
         return np.dtype(_PHYSICAL[self.id])

@@ -1,0 +1,176 @@
+"""Decimal128 exactness: two-limb columns and chunked aggregate state.
+
+Reference parity target: Decimal128 flows through Arrow with a 16-byte
+shuffle slot (shuffle_writer_exec.rs:196-220). Here: wide (p>18)
+decimals are (capacity, 2) limb columns at the scan/result boundaries;
+SUM/AVG over ANY decimal accumulates in four 32-bit chunk sums (exact,
+no i64 overflow) and reassembles on the host with full-precision ints -
+lifting the round-1 |sum| < ~9.2e14 limitation.
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    ExecContext,
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from blaze_tpu.runtime.executor import run_plan
+
+
+def scan_of(rb):
+    cb = ColumnBatch.from_arrow(rb)
+    return MemoryScanExec([[cb]], cb.schema)
+
+
+def wide_batch(values, prec=38, scale=2, group=None):
+    import decimal
+
+    with decimal.localcontext() as ctx:
+        ctx.prec = 60
+        arr = [Decimal(v).scaleb(-scale) for v in values]
+    cols = {
+        "d": pa.array(arr, pa.decimal128(prec, scale)),
+    }
+    if group is not None:
+        cols["g"] = pa.array(group, pa.int32())
+    return pa.record_batch(cols)
+
+
+def test_wide_decimal_scan_roundtrip():
+    vals = [0, 1, -1, (1 << 100), -(1 << 100), 10**37]
+    rb = wide_batch(vals)
+    cb = ColumnBatch.from_arrow(rb)
+    assert cb.columns[0].values.ndim == 2
+    back = cb.to_arrow()
+    assert back.column("d").to_pylist() == rb.column("d").to_pylist()
+
+
+def test_sum_beyond_i64_exact():
+    # unscaled sum = 3 * (2^62) overflows i64; chunked state is exact
+    big = 1 << 62
+    rb = wide_batch([big, big, big], prec=38, scale=2)
+    plan = HashAggregateExec(
+        scan_of(rb), keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("d")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = run_plan(plan).to_pydict()
+    assert out["s"] == [Decimal(3 * big) / 100]
+
+
+def test_narrow_decimal_sum_huge_rowsum_exact():
+    # i64-unscaled inputs whose SUM exceeds the old ~9.2e14*... i64 cap
+    n = 1000
+    unscaled = [(10**17) + i for i in range(n)]  # sum ~1e20 > i64
+    rb = pa.record_batch(
+        {"d": pa.array([Decimal(u) / 100 for u in unscaled],
+                       pa.decimal128(18, 2))}
+    )
+    plan = HashAggregateExec(
+        scan_of(rb), keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("d")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = run_plan(plan).to_pydict()
+    assert out["s"] == [Decimal(sum(unscaled)) / 100]
+
+
+def test_grouped_avg_exact_half_up_beyond_old_bound():
+    # sums per group > 9.2e14 unscaled: old device AVG overflowed
+    u = 10**16
+    rb = pa.record_batch(
+        {
+            "g": pa.array([1, 1, 1, 2], pa.int32()),
+            "d": pa.array(
+                [Decimal(u) / 100, Decimal(u) / 100,
+                 Decimal(u + 1) / 100, Decimal(5) / 100],
+                pa.decimal128(18, 2),
+            ),
+        }
+    )
+    plan = HashAggregateExec(
+        scan_of(rb),
+        keys=[(Col("g"), "g")],
+        aggs=[(AggExpr(AggFn.AVG, Col("d")), "a")],
+        mode=AggMode.COMPLETE,
+    )
+    out = run_plan(plan).to_pydict()
+    got = dict(zip(out["g"], out["a"]))
+    # group 1: (3u+1)/3 unscaled at scale 2 -> scale 6 HALF_UP
+    exp1 = Decimal((u * 3 + 1) * 10**4 // 3 + (
+        1 if ((u * 3 + 1) * 10**4 % 3) * 2 >= 3 else 0
+    )) / 10**6
+    assert got[1] == exp1
+    assert got[2] == Decimal("0.050000")
+
+
+def test_partial_final_state_roundtrips_shuffle_slot():
+    """The chunked state survives the Arrow boundary (PARTIAL batches ->
+    to_arrow -> from_arrow -> FINAL merge), i.e. the shuffle slot."""
+    big = 1 << 61
+    rb1 = wide_batch([big, 3], prec=38, scale=2, group=[1, 2])
+    rb2 = wide_batch([big, big], prec=38, scale=2, group=[1, 1])
+
+    def partial_of(rb):
+        return HashAggregateExec(
+            scan_of(rb),
+            keys=[(Col("g"), "g")],
+            aggs=[(AggExpr(AggFn.AVG, Col("d")), "a")],
+            mode=AggMode.PARTIAL,
+        )
+
+    parts = []
+    schema = None
+    for rb in (rb1, rb2):
+        p = partial_of(rb)
+        schema = p.schema
+        for cb in p.execute(0, ExecContext()):
+            # Arrow round trip = the shuffle wire format
+            parts.append(ColumnBatch.from_arrow(cb.to_arrow()))
+    final = HashAggregateExec(
+        MemoryScanExec([parts], schema),
+        keys=[(Col("g"), "g")],
+        aggs=[(AggExpr(AggFn.AVG, Col("d")), "a")],
+        mode=AggMode.FINAL,
+    )
+    out = run_plan(final).to_pydict()
+    got = dict(zip(out["g"], out["a"]))
+    exp1_unscaled = (3 * big) * 10**4 // 3  # exact division
+    assert got[1] == Decimal(exp1_unscaled) / 10**6
+    assert got[2] == Decimal("0.030000")
+
+
+def test_sum_overflow_decimal38_nulls():
+    near_max = 10**38 - 1
+    rb = wide_batch([near_max, near_max], prec=38, scale=0)
+    plan = HashAggregateExec(
+        scan_of(rb), keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("d")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    out = run_plan(plan).to_pydict()
+    assert out["s"] == [None]  # Spark non-ANSI overflow -> NULL
+
+
+def test_wide_decimal_compute_raises_at_construction():
+    """Compute on wide decimals raises when the operator is BUILT - the
+    tryConvert window - so the planner falls back to the host tier."""
+    from blaze_tpu.ops import FilterExec, ProjectExec
+
+    rb = wide_batch([1 << 90, 5])
+    with pytest.raises(NotImplementedError):
+        FilterExec(scan_of(rb), Col("d") > 1.0)
+    with pytest.raises(NotImplementedError):
+        ProjectExec(scan_of(rb), [(Col("d") + 1, "x")])
+    # pure passthrough projection stays native
+    p = ProjectExec(scan_of(rb), [(Col("d"), "d")])
+    assert run_plan(p).column("d").to_pylist() == \
+        rb.column("d").to_pylist()
