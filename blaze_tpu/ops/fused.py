@@ -372,6 +372,8 @@ class HostFinalAggExec(PhysicalOp):
         yield from final.execute(0, ctx)
 
     # ------------------------------------------------------------------
+    # number of live state rows flows into the decimal reassembly so the
+    # bigint work is O(groups), not O(capacity)
     def _finalize_host(self, cb: ColumnBatch) -> ColumnBatch:
         """Vectorized numpy finalization of one unique-group state batch."""
         from blaze_tpu.ops.hash_aggregate import (
@@ -405,13 +407,14 @@ class HostFinalAggExec(PhysicalOp):
             out_cols.append(
                 Column(
                     field.dtype,
-                    *self._finalize_agg(a, field, states, dscale),
+                    *self._finalize_agg(a, field, states, dscale, n),
                 )
             )
         return ColumnBatch(self._schema, out_cols, n)
 
     @staticmethod
-    def _finalize_agg(a: AggExpr, field, states, dscale=None):
+    def _finalize_agg(a: AggExpr, field, states, dscale=None,
+                      n_live=None):
         from blaze_tpu.ops.hash_aggregate import _reassemble_decimal
 
         fn = a.fn
@@ -420,7 +423,8 @@ class HostFinalAggExec(PhysicalOp):
             any_v = states[0][1]
             count = states[4][0] if fn is AggFn.AVG else None
             limbs, mask, dt = _reassemble_decimal(
-                chunks, any_v, count, dscale, fn is AggFn.AVG
+                chunks, any_v, count, dscale, fn is AggFn.AVG,
+                n_live=n_live,
             )
             assert dt == field.dtype, (dt, field.dtype)
             return limbs, mask

