@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Callable, Iterator, List, Sequence
+from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -67,6 +67,24 @@ class BucketedInput:
                 pass
 
 
+def subdivide_pid_fn(key_exprs: Sequence[ir.Expr], parent_modulus: int,
+                     fanout: int = 4) -> Callable:
+    """pid function splitting one parent hash bucket into `fanout`
+    children using the NEXT hash bits: rows of a parent bucket share
+    h % parent_modulus, so pmod(h, parent_modulus * fanout) //
+    parent_modulus spreads them over 0..fanout-1. Grace recursion uses
+    this so each level allocates `fanout` buckets, not parent * fanout
+    (of which all but `fanout` would stay empty)."""
+
+    def pid(cb: ColumnBatch) -> np.ndarray:
+        wide = spark_partition_ids(
+            cb, list(key_exprs), parent_modulus * fanout
+        )
+        return (wide // parent_modulus).astype(np.int32)
+
+    return pid
+
+
 def bucket_stream(
     batches: Iterator[ColumnBatch],
     key_exprs: Sequence[ir.Expr],
@@ -74,9 +92,11 @@ def bucket_stream(
     ctx: ExecContext,
     schema: Schema,
     head: Sequence[ColumnBatch] = (),
+    pid_fn: Optional[Callable] = None,
 ) -> BucketedInput:
     """Write (head + remaining stream) into n_buckets hash buckets using
-    the shuffle writer's scatter + segmented-IPC machinery."""
+    the shuffle writer's scatter + segmented-IPC machinery. `pid_fn`
+    overrides the partition-id computation (grace recursion)."""
     d = ctx.config.spill_dir()
     fd, data_path = tempfile.mkstemp(prefix="blz-ext-", suffix=".data",
                                      dir=d)
@@ -88,7 +108,10 @@ def bucket_stream(
         cb = ensure_compacted(cb)
         if cb.num_rows == 0:
             return
-        pids = spark_partition_ids(cb, list(key_exprs), n_buckets)
+        pids = (
+            pid_fn(cb) if pid_fn is not None
+            else spark_partition_ids(cb, list(key_exprs), n_buckets)
+        )
         pid_full = jnp.full(cb.capacity, n_buckets, dtype=jnp.int32)
         pid_full = pid_full.at[: len(pids)].set(jnp.asarray(pids))
         order = jnp.argsort(pid_full, stable=True)

@@ -346,7 +346,7 @@ class HashAggregateExec(PhysicalOp):
         group lands wholly in one hash bucket, so buckets aggregate
         independently. The keyless case folds per-batch partial states
         instead (one state row per batch, always bounded)."""
-        from blaze_tpu.ops.external import bucket_stream
+        from blaze_tpu.ops.external import bucket_stream, collect_until
 
         in_schema = self.children[0].schema
         if not self.keys:
@@ -400,17 +400,62 @@ class HashAggregateExec(PhysicalOp):
             n_b = choose_external_bucket_count(
                 2 * head_bytes, ctx.config
             )
-            bucketed = bucket_stream(
-                rest, key_exprs, n_b, ctx, in_schema, head=head,
+            yield from self._grace_agg(
+                rest, head, ctx, in_schema, n_b, depth=0
             )
         finally:
             tracker.release(track_key)
-        ctx.metrics.add("external_agg_buckets", bucketed.n_buckets)
+
+    _MAX_GRACE_DEPTH = 2
+    _GRACE_FANOUT = 4
+
+    def _grace_agg(self, rest, head, ctx: ExecContext, in_schema,
+                   n_b: int, depth: int,
+                   modulus: Optional[int] = None
+                   ) -> Iterator[ColumnBatch]:
+        """One grace level. Overflowing buckets re-bucket recursively by
+        the next hash bits (splits many-distinct-key overflow); at max
+        depth - a single hot key - COMPLETE mode aggregates the bucket
+        CHUNK-WISE (partial per sub-chunk + one final merge), which a
+        hash split can never achieve."""
+        from blaze_tpu.ops.external import (
+            bucket_stream,
+            collect_until,
+            subdivide_pid_fn,
+        )
+
+        key_exprs = [e for e, _ in self.keys]
+        if modulus is None:
+            modulus = n_b
+            pid = None
+        else:
+            pid = subdivide_pid_fn(key_exprs, modulus, n_b)
+            modulus *= n_b
+        bucketed = bucket_stream(
+            rest, key_exprs, n_b, ctx, in_schema, head=head, pid_fn=pid,
+        )
+        ctx.metrics.add("external_agg_buckets", n_b)
         try:
-            for b in range(bucketed.n_buckets):
-                chunk = list(bucketed.bucket(b))
+            limit = ctx.config.max_materialize_rows
+            for b in range(n_b):
+                it = bucketed.bucket(b)
+                chunk, exceeded = collect_until(it, limit)
                 if not chunk:
                     continue
+                if exceeded and depth < self._MAX_GRACE_DEPTH:
+                    ctx.metrics.add("external_agg_rebuckets", 1)
+                    yield from self._grace_agg(
+                        it, chunk, ctx, in_schema,
+                        self._GRACE_FANOUT, depth + 1, modulus,
+                    )
+                    continue
+                if exceeded and self.mode is AggMode.COMPLETE:
+                    ctx.metrics.add("external_agg_hot_buckets", 1)
+                    yield from self._aggregate_chunked(
+                        chunk, it, in_schema, limit
+                    )
+                    continue
+                chunk += list(it)  # exceeded FINAL: states stay mergeable
                 out = self._aggregate_batch(
                     concat_batches(chunk, schema=in_schema)
                 )
@@ -418,6 +463,55 @@ class HashAggregateExec(PhysicalOp):
                     yield out
         finally:
             bucketed.cleanup()
+
+    def _aggregate_chunked(self, head, rest, in_schema, limit
+                           ) -> Iterator[ColumnBatch]:
+        """Partial-per-chunk + final-merge for one oversized bucket."""
+        partial = HashAggregateExec(
+            self.children[0],
+            keys=[(e, n) for e, n in self.keys],
+            aggs=[(a, n) for a, n in self.aggs],
+            mode=AggMode.PARTIAL,
+        )
+        partials: List[ColumnBatch] = []
+
+        def drain(batches):
+            chunk: List[ColumnBatch] = []
+            rows = 0
+            for cb in batches:
+                chunk.append(cb)
+                rows += cb.num_rows
+                if rows > limit:
+                    p = partial._aggregate_batch(
+                        concat_batches(chunk, schema=in_schema)
+                    )
+                    if p.num_rows:
+                        partials.append(p)
+                    chunk, rows = [], 0
+            if chunk:
+                p = partial._aggregate_batch(
+                    concat_batches(chunk, schema=in_schema)
+                )
+                if p.num_rows:
+                    partials.append(p)
+
+        drain(list(head) + list(rest))
+        if not partials:
+            return
+        final = HashAggregateExec(
+            _SchemaStub(partial.schema),
+            keys=[
+                (ir.BoundCol(i, partial.schema.fields[i].dtype), n)
+                for i, (_, n) in enumerate(self.keys)
+            ],
+            aggs=[(a, n) for a, n in self.aggs],
+            mode=AggMode.FINAL,
+        )
+        out = final._aggregate_batch(
+            concat_batches(partials, schema=partial.schema)
+        )
+        if out.num_rows:
+            yield out
 
     # ------------------------------------------------------------------
     def _aggregate_batch(self, cb: ColumnBatch) -> ColumnBatch:

@@ -602,32 +602,80 @@ class SortMergeJoinExec(PhysicalOp):
         # account (and release) independently
         track_key = (id(self), ctx.partition_id)
         tracker.track(track_key, head_bytes)
-        bl = br = None
         try:
             n_b = choose_external_bucket_count(est, ctx.config)
-            lkeys = [
-                ir.BoundCol(i, left.schema.fields[i].dtype)
-                for i in self.left_keys
-            ]
-            rkeys = [
-                ir.BoundCol(i, right.schema.fields[i].dtype)
-                for i in self.right_keys
-            ]
+            yield from self._grace_join(
+                l_it, r_it, l_head, r_head, ctx, n_b, depth=0
+            )
+        finally:
+            tracker.release(track_key)
+
+    _MAX_GRACE_DEPTH = 2
+    _GRACE_FANOUT = 4
+
+    def _grace_join(self, l_it, r_it, l_head, r_head, ctx: ExecContext,
+                    n_b: int, depth: int, modulus: Optional[int] = None
+                    ) -> Iterator[ColumnBatch]:
+        """One grace level: bucket both sides, join fitting buckets; a
+        bucket still over the materialize cap RE-BUCKETS recursively by
+        the NEXT hash bits (fanout-way split of just that bucket -
+        splits many-key overflow; a single hot key can't split and is
+        joined materialized at max depth)."""
+        from blaze_tpu.ops.external import (
+            bucket_stream,
+            collect_until,
+            subdivide_pid_fn,
+        )
+
+        left, right = self.children
+        lkeys = [
+            ir.BoundCol(i, left.schema.fields[i].dtype)
+            for i in self.left_keys
+        ]
+        rkeys = [
+            ir.BoundCol(i, right.schema.fields[i].dtype)
+            for i in self.right_keys
+        ]
+        if modulus is None:
+            modulus = n_b
+            l_pid = r_pid = None
+        else:
+            l_pid = subdivide_pid_fn(lkeys, modulus, n_b)
+            r_pid = subdivide_pid_fn(rkeys, modulus, n_b)
+            modulus *= n_b
+        bl = br = None
+        try:
             bl = bucket_stream(l_it, lkeys, n_b, ctx, left.schema,
-                               head=l_head)
+                               head=l_head, pid_fn=l_pid)
             br = bucket_stream(r_it, rkeys, n_b, ctx, right.schema,
-                               head=r_head)
+                               head=r_head, pid_fn=r_pid)
             ctx.metrics.add("external_join_buckets", n_b)
+            limit = ctx.config.max_materialize_rows
             for b in range(n_b):
-                yield from self._join_bucket(
-                    list(bl.bucket(b)), list(br.bucket(b))
-                )
+                lb_it = bl.bucket(b)
+                rb_it = br.bucket(b)
+                lb_head, l_exc = collect_until(lb_it, limit)
+                rb_head, r_exc = collect_until(rb_it, limit)
+                if (l_exc or r_exc) and depth < self._MAX_GRACE_DEPTH:
+                    ctx.metrics.add("external_join_rebuckets", 1)
+                    yield from self._grace_join(
+                        lb_it, rb_it, lb_head, rb_head, ctx,
+                        self._GRACE_FANOUT, depth + 1, modulus,
+                    )
+                    continue
+                if l_exc or r_exc:
+                    # single hot key survives every re-bucket; join it
+                    # materialized (correct, memory-heavy) and record it
+                    ctx.metrics.add("external_join_hot_buckets", 1)
+                    lb_head += list(lb_it)
+                    rb_head += list(rb_it)
+                if lb_head or rb_head:
+                    yield from self._join_bucket(lb_head, rb_head)
         finally:
             if bl is not None:
                 bl.cleanup()
             if br is not None:
                 br.cleanup()
-            tracker.release(track_key)
 
     def _join_bucket(self, left_batches, right_batches
                      ) -> Iterator[ColumnBatch]:

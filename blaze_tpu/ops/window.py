@@ -6,11 +6,18 @@ that powers the aggregate makes the common window functions cheap on
 device, so this operator EXCEEDS reference capability while staying
 TPU-first: one stable sort by (partition keys, order keys), segment ids by
 boundary detection, then each function is a few vectorized passes
-(cumulative counts, run boundaries, segment reductions, guarded shifts).
+(cumulative counts, run boundaries, segment reductions, guarded shifts,
+partition-reset prefix scans for frames).
 
-Supported: row_number, rank, dense_rank, lag, lead (offset 1),
-sum/min/max/count/avg over the whole partition frame. Rows are emitted in
-(partition, order) sorted order - the order Spark's WindowExec produces.
+Supported: row_number, rank, dense_rank, ntile(n), percent_rank,
+cume_dist, lag/lead(offset k), and sum/min/max/count/avg over
+- the whole partition (frame=None),
+- ROWS BETWEEN a PRECEDING AND b FOLLOWING (("rows", lo, hi); None =
+  UNBOUNDED; min/max need lo=None i.e. a running frame),
+- RANGE UNBOUNDED PRECEDING .. CURRENT ROW (("range", None, 0) - the
+  SQL default frame with ORDER BY; ties share the frame result).
+Rows are emitted in (partition, order) sorted order - the order Spark's
+WindowExec produces.
 """
 
 from __future__ import annotations
@@ -33,15 +40,59 @@ from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.sort import SortKey, sort_batch
 from blaze_tpu.ops.util import concat_batches
 
-_RANKING = ("row_number", "rank", "dense_rank")
+_RANKING = ("row_number", "rank", "dense_rank", "ntile",
+            "percent_rank", "cume_dist")
 _FRAME_AGGS = ("sum", "min", "max", "count", "avg")
 
 
 @dataclasses.dataclass(frozen=True)
 class WindowFn:
-    kind: str  # row_number | rank | dense_rank | lag | lead | frame aggs
+    kind: str  # ranking | lag | lead | frame aggs
     source: Optional[ir.Expr]  # for lag/lead/aggs
     output: str
+    # lag/lead distance, or ntile bucket count
+    offset: int = 1
+    # None = whole partition; ("rows", lo, hi) with None = UNBOUNDED;
+    # ("range", None, 0) = RANGE UNBOUNDED..CURRENT (ties share)
+    frame: Optional[tuple] = None
+
+
+def _whole_partition_agg(kind, v, contrib, gid, cap):
+    """sum/min/max/count/avg over the entire partition (frame=None)."""
+    if kind == "count":
+        red = jax.ops.segment_sum(
+            contrib.astype(jnp.int64), gid, num_segments=cap
+        )
+        return jnp.take(red, gid), None
+    if kind in ("sum", "avg"):
+        acc = jnp.where(contrib, v, jnp.zeros_like(v))
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            acc = acc.astype(jnp.int64)
+        s = jax.ops.segment_sum(acc, gid, num_segments=cap)
+        c = jax.ops.segment_sum(
+            contrib.astype(jnp.int64), gid, num_segments=cap
+        )
+        anyv = jnp.take(c, gid) > 0
+        if kind == "sum":
+            return jnp.take(s, gid), anyv
+        return (
+            jnp.take(s, gid).astype(jnp.float64)
+            / jnp.maximum(jnp.take(c, gid), 1).astype(jnp.float64),
+            anyv,
+        )
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        neutral = jnp.inf if kind == "min" else -jnp.inf
+    else:
+        info = jnp.iinfo(v.dtype)
+        neutral = info.max if kind == "min" else info.min
+    acc = jnp.where(contrib, v, jnp.asarray(neutral, v.dtype))
+    red = (
+        jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+    )(acc, gid, num_segments=cap)
+    c = jax.ops.segment_sum(
+        contrib.astype(jnp.int32), gid, num_segments=cap
+    )
+    return jnp.take(red, gid), jnp.take(c, gid) > 0
 
 
 class WindowExec(PhysicalOp):
@@ -62,9 +113,38 @@ class WindowExec(PhysicalOp):
                 bind_opt(f.source, schema)
                 if f.source is not None else None,
                 f.output,
+                f.offset,
+                f.frame,
             )
             for f in functions
         ]
+        for f in self.functions:
+            fr = f.frame
+            if fr is None:
+                continue
+            ftype, lo, hi = fr
+            if ftype == "range":
+                # only the SQL default frame (RANGE UNBOUNDED..CURRENT)
+                if not (lo is None and hi == 0):
+                    raise NotImplementedError(
+                        "RANGE frames other than UNBOUNDED..CURRENT"
+                    )
+            elif ftype == "rows":
+                if f.kind in ("min", "max"):
+                    # bounded/following min/max needs a sparse-table
+                    # pass; only the running frame is supported
+                    if not (lo is None and hi == 0):
+                        raise NotImplementedError(
+                            "min/max ROWS frames other than "
+                            "UNBOUNDED..CURRENT"
+                        )
+                else:
+                    if lo is not None and lo < 0:
+                        raise NotImplementedError("negative frame lo")
+                    if hi is not None and hi < 0:
+                        raise NotImplementedError("negative frame hi")
+            else:
+                raise NotImplementedError(f"frame type {ftype}")
         for e in self.partition_by + [k.expr for k in self.order_by] + [
             f.source for f in self.functions if f.source is not None
         ]:
@@ -81,6 +161,8 @@ class WindowExec(PhysicalOp):
 
     @staticmethod
     def _fn_dtype(f: WindowFn, schema: Schema) -> DataType:
+        if f.kind in ("percent_rank", "cume_dist"):
+            return DataType.float64()
         if f.kind in _RANKING or f.kind == "count":
             return DataType.int64()
         if f.kind in ("lag", "lead"):
@@ -117,7 +199,8 @@ class WindowExec(PhysicalOp):
         key = ("window", tuple(self.partition_by),
                tuple((k.expr, k.ascending, k.nulls_first)
                      for k in self.order_by),
-               tuple((f.kind, f.source) for f in self.functions),
+               tuple((f.kind, f.source, f.offset, f.frame)
+                     for f in self.functions),
                cb.layout())
         fn = cached_kernel(key, lambda: self._build_kernel(cb.layout()))
         outs = fn(cb.device_buffers(), cb.num_rows)
@@ -173,18 +256,79 @@ class WindowExec(PhysicalOp):
             seg_start = jnp.take(
                 jnp.nonzero(pb, size=cap, fill_value=0)[0], gid
             )
-            # value-run boundaries within partitions (for rank/dense_rank)
-            vb = (boundaries(order_exprs) | pb) & live
-            run_start = jnp.take(
-                jnp.nonzero(vb, size=cap, fill_value=0)[0],
-                jnp.cumsum(vb.astype(jnp.int32)) - 1,
+            # partition sizes + end position (exclusive)
+            seg_count = jax.ops.segment_sum(
+                live.astype(jnp.int64), gid, num_segments=cap
             )
+            size = jnp.take(seg_count, gid)
+            seg_end = seg_start + size.astype(jnp.int32)
+            rn = (pos - seg_start + 1).astype(jnp.int64)
+            # value-run boundaries within partitions (rank/dense_rank/
+            # cume_dist/range frames)
+            vb = (boundaries(order_exprs) | pb) & live
+            run_id = jnp.cumsum(vb.astype(jnp.int32)) - 1
+            run_start = jnp.take(
+                jnp.nonzero(vb, size=cap, fill_value=0)[0], run_id
+            )
+            run_count = jax.ops.segment_sum(
+                live.astype(jnp.int32), run_id, num_segments=cap
+            )
+            run_end = run_start + jnp.take(run_count, run_id)  # excl
+
+            def part_prefix(x):
+                """Inclusive prefix sums reset at partition starts."""
+                g = jnp.cumsum(x, axis=0)
+                gshift = jnp.concatenate(
+                    [jnp.zeros_like(g[:1]), g[:-1]]
+                )
+                return g - jnp.take(gshift, seg_start)
+
+            def frame_agg_sumlike(vals64, contrib, lo, hi):
+                """SUM over ROWS frame [i-lo, i+hi] clamped to the
+                partition (None = unbounded); also used for counts."""
+                x = jnp.where(contrib, vals64, jnp.zeros_like(vals64))
+                S = part_prefix(x)  # S[i] = sum seg_start..i
+                hi_idx = (
+                    seg_end - 1 if hi is None
+                    else jnp.minimum(pos + hi, seg_end - 1)
+                )
+                hi_idx = jnp.clip(hi_idx, 0, cap - 1)
+                s_hi = jnp.take(S, hi_idx)
+                if lo is None:
+                    return s_hi
+                lo_idx = jnp.maximum(pos - lo, seg_start)
+                s_lo_prev = jnp.where(
+                    lo_idx > seg_start,
+                    jnp.take(S, jnp.clip(lo_idx - 1, 0, cap - 1)),
+                    jnp.zeros_like(s_hi),
+                )
+                return s_hi - s_lo_prev
+
+            def running_minmax(v, contrib, is_min):
+                """Partition-reset running min/max via associative scan."""
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    neutral = jnp.inf if is_min else -jnp.inf
+                else:
+                    info = jnp.iinfo(v.dtype)
+                    neutral = info.max if is_min else info.min
+                x = jnp.where(contrib, v, jnp.asarray(neutral, v.dtype))
+
+                def op(a, b):
+                    fa, va = a
+                    fb, vb_ = b
+                    red = (
+                        jnp.minimum(va, vb_) if is_min
+                        else jnp.maximum(va, vb_)
+                    )
+                    return fa | fb, jnp.where(fb, vb_, red)
+
+                _, out = jax.lax.associative_scan(op, (pb, x))
+                return out
+
             outs = []
             for f in fns:
                 if f.kind == "row_number":
-                    outs.append(
-                        ((pos - seg_start + 1).astype(jnp.int64), None)
-                    )
+                    outs.append((rn, None))
                 elif f.kind == "rank":
                     outs.append(
                         ((run_start - seg_start + 1).astype(jnp.int64),
@@ -194,88 +338,102 @@ class WindowExec(PhysicalOp):
                     dr = jnp.cumsum(vb.astype(jnp.int64))
                     seg_dr = jnp.take(dr, seg_start)
                     outs.append((dr - seg_dr + 1, None))
+                elif f.kind == "ntile":
+                    nt = max(int(f.offset), 1)
+                    base = size // nt
+                    rem = size % nt
+                    cutoff = rem * (base + 1)
+                    tile = jnp.where(
+                        rn <= cutoff,
+                        (rn - 1) // jnp.maximum(base + 1, 1),
+                        rem + (rn - 1 - cutoff)
+                        // jnp.maximum(base, 1),
+                    )
+                    outs.append(((tile + 1).astype(jnp.int64), None))
+                elif f.kind == "percent_rank":
+                    rk = (run_start - seg_start + 1).astype(jnp.float64)
+                    pr = jnp.where(
+                        size > 1,
+                        (rk - 1.0)
+                        / jnp.maximum(size - 1, 1).astype(jnp.float64),
+                        0.0,
+                    )
+                    outs.append((pr, None))
+                elif f.kind == "cume_dist":
+                    cd = (run_end - seg_start).astype(jnp.float64) \
+                        / jnp.maximum(size, 1).astype(jnp.float64)
+                    outs.append((cd, None))
                 elif f.kind in ("lag", "lead"):
                     v, m = ev.evaluate(f.source)
+                    k = max(int(f.offset), 1)
                     if f.kind == "lag":
-                        sv = jnp.concatenate([v[:1], v[:-1]])
+                        sv = jnp.concatenate([v[:k], v[:-k]], axis=0)
                         sm = (
-                            jnp.concatenate([m[:1], m[:-1]])
+                            jnp.concatenate([m[:k], m[:-k]])
                             if m is not None else None
                         )
-                        ok = pos > seg_start
+                        ok = rn > k
                     else:
-                        sv = jnp.concatenate([v[1:], v[-1:]])
+                        sv = jnp.concatenate([v[k:], v[-k:]], axis=0)
                         sm = (
-                            jnp.concatenate([m[1:], m[-1:]])
+                            jnp.concatenate([m[k:], m[-k:]])
                             if m is not None else None
                         )
-                        nxt_pb = jnp.concatenate(
-                            [pb[1:], jnp.ones(1, dtype=jnp.bool_)]
-                        )
-                        nxt_live = jnp.concatenate(
-                            [live[1:], jnp.zeros(1, dtype=jnp.bool_)]
-                        )
-                        ok = ~nxt_pb & nxt_live
+                        ok = rn <= size - k
                     valid = ok if sm is None else (ok & sm)
                     outs.append((sv, valid & live))
-                else:  # frame aggregates over the whole partition
+                else:  # frame aggregates
                     v, m = ev.evaluate(f.source)
                     contrib = live if m is None else (live & m)
-                    if f.kind == "count":
-                        red = jax.ops.segment_sum(
-                            contrib.astype(jnp.int64), gid,
-                            num_segments=cap,
-                        )
-                        outs.append((jnp.take(red, gid), None))
-                        continue
-                    if f.kind in ("sum", "avg"):
-                        acc = jnp.where(contrib, v, jnp.zeros_like(v))
-                        if jnp.issubdtype(v.dtype, jnp.integer):
-                            acc = acc.astype(jnp.int64)
-                        s = jax.ops.segment_sum(
-                            acc, gid, num_segments=cap
-                        )
-                        c = jax.ops.segment_sum(
-                            contrib.astype(jnp.int64), gid,
-                            num_segments=cap,
-                        )
-                        anyv = jnp.take(c, gid) > 0
-                        if f.kind == "sum":
-                            outs.append((jnp.take(s, gid), anyv))
-                        else:
-                            outs.append(
-                                (
-                                    jnp.take(s, gid).astype(jnp.float64)
-                                    / jnp.maximum(
-                                        jnp.take(c, gid), 1
-                                    ).astype(jnp.float64),
-                                    anyv,
-                                )
+                    frame = f.frame
+                    if frame is None:
+                        outs.append(
+                            _whole_partition_agg(
+                                f.kind, v, contrib, gid, cap
                             )
+                        )
                         continue
-                    if jnp.issubdtype(v.dtype, jnp.floating):
-                        neutral = (
-                            jnp.inf if f.kind == "min" else -jnp.inf
+                    ftype, lo, hi = frame
+                    if f.kind in ("min", "max"):
+                        # running (UNBOUNDED lo) min/max; range frames
+                        # read the value at the tie-run end
+                        running = running_minmax(
+                            v, contrib, f.kind == "min"
                         )
-                    else:
-                        info = jnp.iinfo(v.dtype)
-                        neutral = (
-                            info.max if f.kind == "min" else info.min
+                        cnt = frame_agg_sumlike(
+                            contrib.astype(jnp.int64), live, lo, 0
                         )
-                    acc = jnp.where(contrib, v,
-                                    jnp.asarray(neutral, v.dtype))
-                    red = (
-                        jax.ops.segment_min
-                        if f.kind == "min"
-                        else jax.ops.segment_max
-                    )(acc, gid, num_segments=cap)
-                    c = jax.ops.segment_sum(
-                        contrib.astype(jnp.int32), gid,
-                        num_segments=cap,
+                        if ftype == "range":
+                            at = jnp.clip(run_end - 1, 0, cap - 1)
+                            running = jnp.take(running, at)
+                            cnt = jnp.take(cnt, at)
+                        outs.append((running, cnt > 0))
+                        continue
+                    vals = v
+                    if jnp.issubdtype(v.dtype, jnp.integer):
+                        vals = v.astype(jnp.int64)
+                    s = frame_agg_sumlike(vals, contrib, lo, hi)
+                    c = frame_agg_sumlike(
+                        contrib.astype(jnp.int64), live, lo, hi
                     )
-                    outs.append(
-                        (jnp.take(red, gid), jnp.take(c, gid) > 0)
-                    )
+                    if ftype == "range":
+                        # ties share the frame ending at the run end
+                        at = jnp.clip(run_end - 1, 0, cap - 1)
+                        s = jnp.take(s, at)
+                        c = jnp.take(c, at)
+                    anyv = c > 0
+                    if f.kind == "count":
+                        outs.append((c, None))
+                    elif f.kind == "sum":
+                        outs.append((s, anyv))
+                    else:  # avg
+                        outs.append(
+                            (
+                                s.astype(jnp.float64)
+                                / jnp.maximum(c, 1).astype(jnp.float64),
+                                anyv,
+                            )
+                        )
             return outs
 
         return kernel

@@ -436,6 +436,15 @@ def plan_from_proto(p: pb.PlanProto) -> PhysicalOp:
                     expr_from_proto(f.source)
                     if f.HasField("source") else None,
                     f.output,
+                    f.offset if f.offset else 1,
+                    (
+                        (
+                            f.frame,
+                            None if f.frame_lo < 0 else f.frame_lo,
+                            None if f.frame_hi < 0 else f.frame_hi,
+                        )
+                        if f.frame else None
+                    ),
                 )
                 for f in w.functions
             ],
@@ -565,6 +574,11 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
             fp = w.functions.add(kind=f.kind, output=f.output)
             if f.source is not None:
                 fp.source.CopyFrom(expr_to_proto(f.source))
+            fp.offset = f.offset
+            if f.frame is not None:
+                fp.frame = f.frame[0]
+                fp.frame_lo = -1 if f.frame[1] is None else f.frame[1]
+                fp.frame_hi = -1 if f.frame[2] is None else f.frame[2]
     else:
         raise NotImplementedError(type(op))
     return p

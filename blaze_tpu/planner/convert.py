@@ -88,6 +88,23 @@ class ConvertStrategy:
     # to the host engine for the rest
     enable_window: bool = True
 
+    # ---- strategy heuristics (BlazeConvertStrategy.scala:159-265) ----
+    # Long continuously-fusable chains: the reference DECLINES to convert
+    # them (length >= threshold) because JVM whole-stage codegen amortizes
+    # long chains well (scala:191-221). This engine's fused pipelines
+    # amortize even better (one XLA program), so the switch defaults OFF;
+    # the mechanism is here for parity and for embedders whose host tier
+    # is codegen-strong.
+    continuous_codegen_threshold: int = 5
+    enable_codegen_chain_heuristic: bool = False
+    # A convertible scan whose PARENT stays host-side only buys a native
+    # island plus two extra boundary crossings - keep it host-side
+    # (scala:223-233).
+    enable_scan_parent_heuristic: bool = True
+    # Children of a non-convertible aggregate: the boundary would land
+    # mid-aggregation; keep the subtree together (scala:234-265).
+    enable_agg_child_heuristic: bool = True
+
     def gate(self, node: S.PlanSpec) -> bool:
         table = {
             S.ScanSpec: self.enable_scan,
@@ -114,6 +131,7 @@ def convert_plan(root: S.PlanSpec,
     device programs (ops/fused.py)."""
     strategy = strategy or ConvertStrategy()
     _tag(root, strategy)
+    _apply_heuristics(root, strategy)
     op = _build(root, strategy)
     if fuse:
         from blaze_tpu.ops.fused import fuse_pipelines
@@ -139,6 +157,64 @@ def _tag(node: S.PlanSpec, strategy: ConvertStrategy) -> None:
         node.convertible = False
 
 
+def _apply_heuristics(root: S.PlanSpec,
+                      strategy: ConvertStrategy) -> None:
+    """Post-tagging strategy heuristics (BlazeConvertStrategy.scala:
+    159-265): refine the convertible tags using PARENT context, which
+    the bottom-up dry run cannot see."""
+
+    def walk(node: S.PlanSpec, parent: Optional[S.PlanSpec]) -> None:
+        if (
+            strategy.enable_scan_parent_heuristic
+            and isinstance(node, (S.ScanSpec, S.MemorySpec))
+            and node.convertible
+            and parent is not None
+            and not parent.convertible
+        ):
+            # a native scan island under a host parent costs two extra
+            # boundary crossings for zero fused work
+            node.convertible = False
+        if (
+            strategy.enable_agg_child_heuristic
+            and isinstance(node, S.AggSpec)
+            and not node.convertible
+        ):
+            # keep the WHOLE aggregation subtree together (down to the
+            # next exchange, which is a legitimate boundary anyway) -
+            # a native island mid-aggregation costs two crossings
+            def demote(n: S.PlanSpec) -> None:
+                if isinstance(n, S.ExchangeSpec):
+                    return
+                n.convertible = False
+                for cc in n.children:
+                    demote(cc)
+
+            for c in node.children:
+                demote(c)
+        for c in node.children:
+            walk(c, node)
+
+    def chain_pass(node: S.PlanSpec) -> None:
+        # maximal chains of fusable narrow ops: the reference declines
+        # chains >= threshold (JVM codegen amortizes them); gated OFF by
+        # default here - see ConvertStrategy
+        chain: list = []
+        t = node
+        while isinstance(t, (S.ProjectSpec, S.FilterSpec)) and \
+                t.convertible and len(t.children) == 1:
+            chain.append(t)
+            t = t.children[0]
+        if len(chain) >= strategy.continuous_codegen_threshold:
+            for n in chain:
+                n.convertible = False
+        for c in t.children if chain else node.children:
+            chain_pass(c)
+
+    walk(root, None)
+    if strategy.enable_codegen_chain_heuristic:
+        chain_pass(root)
+
+
 def _check_convertible(node: S.PlanSpec) -> None:
     """Cheap structural dry-run (full conversion happens in _build under
     tryConvert anyway)."""
@@ -154,7 +230,7 @@ def _check_convertible(node: S.PlanSpec) -> None:
     if isinstance(node, S.AggSpec) and node.mode not in _MODE:
         raise NotImplementedError(node.mode)
     if isinstance(node, S.ExchangeSpec) and node.mode not in (
-        "hash", "single", "round_robin", "broadcast"
+        "hash", "single", "round_robin", "range", "broadcast"
     ):
         raise NotImplementedError(node.mode)
     if isinstance(node, S.WindowSpec):

@@ -274,3 +274,82 @@ def test_skew_join_stays_host():
         join_type="inner", skewed=True,
     )
     assert isinstance(convert_plan(plan), HostFallbackExec)
+
+
+# ---------------------------------------------------------------------------
+# strategy heuristics (BlazeConvertStrategy.scala:159-265 analogs)
+# ---------------------------------------------------------------------------
+
+def _types_in(plan):
+    out = []
+
+    def walk(op):
+        out.append(type(op).__name__)
+        for c in op.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def test_scan_feeding_inconvertible_parent_stays_host():
+    """A convertible scan under a host-only parent is tagged host-side
+    (no two-crossing native island; the reference rule,
+    BlazeConvertStrategy.scala:223-233). The built tree is one host
+    fallback covering agg AND scan either way - HostFallbackExec
+    absorbs whole subtrees - so the rule shows in the tags."""
+    def make_plan():
+        return AggSpec(
+            children=[MemorySpec(children=[], dataframe=df_sales())],
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode="complete",
+            strategy="never",  # force the agg host-side
+        )
+
+    plan = make_plan()
+    built = convert_plan(plan, fuse=False)
+    names = _types_in(built)
+    assert "HostFallbackExec" in names
+    assert "MemoryScanExec" not in names
+    assert plan.children[0].convertible is False  # heuristic applied
+
+    # with the heuristics off the scan keeps its native tag
+    plan2 = make_plan()
+    st = ConvertStrategy(enable_scan_parent_heuristic=False,
+                         enable_agg_child_heuristic=False)
+    convert_plan(plan2, strategy=st, fuse=False)
+    assert plan2.children[0].convertible is True
+
+
+def test_codegen_chain_heuristic_gated():
+    """The continuous-chain decline mirrors the reference switch; it
+    defaults OFF (fused pipelines amortize long chains here)."""
+    df = df_sales()
+    node = MemorySpec(children=[], dataframe=df)
+    for i in range(6):
+        node = ProjectSpec(
+            children=[node],
+            exprs=[(Col("k"), "k"), (Col("v") + i, "v")],
+        )
+    # default: everything native
+    built = convert_plan(node, fuse=False)
+    assert "HostFallbackExec" not in _types_in(built)
+    # reference-faithful switch: chain >= threshold declines conversion
+    st = ConvertStrategy(enable_codegen_chain_heuristic=True)
+    built2 = convert_plan(node, strategy=st, fuse=False)
+    assert "HostFallbackExec" in _types_in(built2)
+
+
+def test_range_exchange_spec_converts():
+    df = df_sales()
+    plan = ExchangeSpec(
+        children=[MemorySpec(children=[], dataframe=df)],
+        keys=[Col("k")],
+        num_partitions=3,
+        mode="range",
+    )
+    built = convert_plan(plan, fuse=False)
+    assert "ShuffleExchangeExec" in _types_in(built)
+    tbl = run_plan(built)
+    assert tbl.num_rows == len(df)

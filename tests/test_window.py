@@ -120,3 +120,163 @@ def test_global_window_no_partition(df):
     # rn order must follow (o, v) order
     ov = list(zip(srt.o, srt.v))
     assert ov == sorted(ov)
+
+
+# ---------------------------------------------------------------------------
+# round-2 surface: frames, ntile/percent_rank/cume_dist, lag/lead(k)
+# ---------------------------------------------------------------------------
+
+def _frame_df(seed=9, n=200):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "g": rng.integers(0, 5, n),
+            "o": rng.permutation(n),
+            "v": rng.integers(-50, 100, n).astype(np.int64),
+        }
+    )
+
+
+def _run_window(df, fns):
+    cb = ColumnBatch.from_pydict(
+        {c: df[c].tolist() for c in df.columns}
+    )
+    op = WindowExec(
+        MemoryScanExec.from_batches([cb]),
+        partition_by=[Col("g")],
+        order_by=[SortKey(Col("o"), True, True)],
+        functions=fns,
+    )
+    out = run_plan(op).to_pandas()
+    return out.sort_values(["g", "o"]).reset_index(drop=True)
+
+
+def test_ntile_percent_rank_cume_dist():
+    df = _frame_df()
+    got = _run_window(
+        df,
+        [
+            WindowFn("ntile", None, "nt", offset=4),
+            WindowFn("percent_rank", None, "pr"),
+            WindowFn("cume_dist", None, "cd"),
+        ],
+    )
+    s = df.sort_values(["g", "o"]).reset_index(drop=True)
+    gb = s.groupby("g")["o"]
+    sizes = s.groupby("g")["o"].transform("size")
+    exp_pr = (gb.rank(method="min") - 1) / (sizes - 1).clip(lower=1)
+    exp_pr = exp_pr.where(sizes > 1, 0.0)
+    exp_cd = gb.rank(method="max") / sizes
+    assert np.allclose(got["pr"].values, exp_pr.values)
+    assert np.allclose(got["cd"].values, exp_cd.values)
+
+    def ntile_ref(size, rn, n=4):
+        base, rem = size // n, size % n
+        cutoff = rem * (base + 1)
+        if rn <= cutoff:
+            return (rn - 1) // (base + 1) + 1
+        return rem + (rn - 1 - cutoff) // max(base, 1) + 1
+
+    rns = gb.rank(method="first").astype(int).values
+    exp_nt = [ntile_ref(s_, r_) for s_, r_ in zip(sizes.values, rns)]
+    assert got["nt"].tolist() == exp_nt
+
+
+def test_lag_lead_offset_k():
+    df = _frame_df(seed=4)
+    got = _run_window(
+        df,
+        [
+            WindowFn("lag", Col("v"), "l2", offset=2),
+            WindowFn("lead", Col("v"), "f3", offset=3),
+        ],
+    )
+    s = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp_l2 = s.groupby("g")["v"].shift(2)
+    exp_f3 = s.groupby("g")["v"].shift(-3)
+    assert (
+        got["l2"].fillna(-999).tolist()
+        == exp_l2.fillna(-999).astype(np.int64).tolist()
+    )
+    assert (
+        got["f3"].fillna(-999).tolist()
+        == exp_f3.fillna(-999).astype(np.int64).tolist()
+    )
+
+
+def test_rows_frame_bounded_sum_avg_count():
+    df = _frame_df(seed=12)
+    got = _run_window(
+        df,
+        [
+            WindowFn("sum", Col("v"), "s", frame=("rows", 2, 1)),
+            WindowFn("avg", Col("v"), "a", frame=("rows", 2, 1)),
+            WindowFn("count", Col("v"), "c", frame=("rows", 2, 1)),
+        ],
+    )
+    s = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp = []
+    for _, grp in s.groupby("g"):
+        vs = grp["v"].tolist()
+        for i in range(len(vs)):
+            window = vs[max(0, i - 2): min(len(vs), i + 2)]
+            exp.append((sum(window), len(window)))
+    exp_sum = [e[0] for e in exp]
+    exp_cnt = [e[1] for e in exp]
+    assert got["s"].tolist() == exp_sum
+    assert got["c"].tolist() == exp_cnt
+    assert np.allclose(
+        got["a"].values, np.array(exp_sum) / np.array(exp_cnt)
+    )
+
+
+def test_running_and_range_frames():
+    # ROWS UNBOUNDED..CURRENT (running) and RANGE UNBOUNDED..CURRENT
+    # (ties share) for sum/min/max
+    df = pd.DataFrame(
+        {
+            "g": [1, 1, 1, 1, 2, 2],
+            "o": [10, 20, 20, 30, 5, 5],
+            "v": [1, 2, 3, 4, 10, 20],
+        }
+    )
+    got = _run_window(
+        df,
+        [
+            WindowFn("sum", Col("v"), "rs", frame=("rows", None, 0)),
+            WindowFn("min", Col("v"), "rm", frame=("rows", None, 0)),
+            WindowFn("sum", Col("v"), "gs", frame=("range", None, 0)),
+            WindowFn("max", Col("v"), "gm", frame=("range", None, 0)),
+        ],
+    )
+    assert got["rs"].tolist() == [1, 3, 6, 10, 10, 30]
+    assert got["rm"].tolist() == [1, 1, 1, 1, 10, 10]
+    # RANGE: ties share the run-end frame
+    assert got["gs"].tolist() == [1, 6, 6, 10, 30, 30]
+    assert got["gm"].tolist() == [1, 3, 3, 4, 20, 20]
+
+
+def test_window_fn_serde_roundtrip(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.plan.serde import plan_from_proto, plan_to_proto
+
+    p = str(tmp_path / "w.parquet")
+    pq.write_table(pa.table({"g": [1, 1, 2], "v": [1.0, 2.0, 3.0]}), p)
+    op = WindowExec(
+        ParquetScanExec([[FileRange(p)]]),
+        partition_by=[Col("g")],
+        order_by=[SortKey(Col("v"), True, True)],
+        functions=[
+            WindowFn("lag", Col("v"), "l", offset=3),
+            WindowFn("sum", Col("v"), "s", frame=("rows", 2, None)),
+            WindowFn("ntile", None, "n", offset=5),
+        ],
+    )
+    back = plan_from_proto(plan_to_proto(op))
+    fns = back.functions
+    assert fns[0].offset == 3
+    assert fns[1].frame == ("rows", 2, None)
+    assert fns[2].offset == 5
