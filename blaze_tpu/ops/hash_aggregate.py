@@ -346,8 +346,6 @@ class HashAggregateExec(PhysicalOp):
         group lands wholly in one hash bucket, so buckets aggregate
         independently. The keyless case folds per-batch partial states
         instead (one state row per batch, always bounded)."""
-        from blaze_tpu.ops.external import bucket_stream, collect_until
-
         in_schema = self.children[0].schema
         if not self.keys:
             if self.mode is AggMode.FINAL:
@@ -495,7 +493,11 @@ class HashAggregateExec(PhysicalOp):
                 if p.num_rows:
                     partials.append(p)
 
-        drain(list(head) + list(rest))
+        import itertools
+
+        # STREAM the bucket: materializing it here would re-create the
+        # exact blow-up this path exists to avoid
+        drain(itertools.chain(head, rest))
         if not partials:
             return
         final = HashAggregateExec(

@@ -119,6 +119,10 @@ class WindowExec(PhysicalOp):
             for f in functions
         ]
         for f in self.functions:
+            if f.kind in ("lag", "lead", "ntile") and f.offset < 0:
+                raise NotImplementedError(
+                    f"negative {f.kind} offset (use the mirror fn)"
+                )
             fr = f.frame
             if fr is None:
                 continue
@@ -365,7 +369,11 @@ class WindowExec(PhysicalOp):
                     outs.append((cd, None))
                 elif f.kind in ("lag", "lead"):
                     v, m = ev.evaluate(f.source)
-                    k = max(int(f.offset), 1)
+                    k = int(f.offset)
+                    if k == 0:  # Spark lag/lead(v, 0) = current row
+                        valid = live if m is None else (live & m)
+                        outs.append((v, valid))
+                        continue
                     if f.kind == "lag":
                         sv = jnp.concatenate([v[:k], v[:-k]], axis=0)
                         sm = (

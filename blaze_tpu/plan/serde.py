@@ -436,7 +436,10 @@ def plan_from_proto(p: pb.PlanProto) -> PhysicalOp:
                     expr_from_proto(f.source)
                     if f.HasField("source") else None,
                     f.output,
-                    f.offset if f.offset else 1,
+                    # offset is encoded biased by +1 so proto3's 0
+                    # default means "unset -> 1" while lag(v, 0) stays
+                    # representable
+                    (f.offset - 1) if f.offset else 1,
                     (
                         (
                             f.frame,
@@ -574,7 +577,7 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
             fp = w.functions.add(kind=f.kind, output=f.output)
             if f.source is not None:
                 fp.source.CopyFrom(expr_to_proto(f.source))
-            fp.offset = f.offset
+            fp.offset = f.offset + 1  # +1 bias: see decode side
             if f.frame is not None:
                 fp.frame = f.frame[0]
                 fp.frame_lo = -1 if f.frame[1] is None else f.frame[1]

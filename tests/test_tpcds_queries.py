@@ -1,4 +1,4 @@
-"""TPC-DS q1-q10 whole-query differential matrix.
+"""TPC-DS q1-q20 whole-query differential matrix (q14 deferred).
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -415,3 +415,224 @@ def test_tpcds_query(env, q, flavor):
     exp = ORACLES[q](tables)
     exp.columns = list(got.columns)  # positional contract
     assert_frames_match(got, exp, f"{q}/{flavor}")
+
+
+# ---------------------------------------------------------------------------
+# q11-q20 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q11(t):
+    s_yt = _oracle_year_total(t, "ss", "store_sales", "ss_customer_sk")
+    w_yt = _oracle_year_total(t, "ws", "web_sales",
+                              "ws_bill_customer_sk")
+
+    def pick(df, year):
+        return df[df.d_year == year][
+            ["c_customer_sk", "c_customer_id", "year_total"]
+        ]
+
+    s1, s2 = pick(s_yt, 1998), pick(s_yt, 1999)
+    w1, w2 = pick(w_yt, 1998), pick(w_yt, 1999)
+    m = s1.merge(s2, on="c_customer_sk", suffixes=("_s1", "_s2"))
+    m = m.merge(w1.rename(columns={"year_total": "yt_w1"}),
+                on="c_customer_sk")
+    m = m.merge(
+        w2.rename(columns={"year_total": "yt_w2"})[
+            ["c_customer_sk", "yt_w2"]],
+        on="c_customer_sk",
+    )
+    m = m[(m.year_total_s1 > 0) & (m.yt_w1 > 0)]
+    m = m[m.yt_w2 / m.yt_w1 > m.year_total_s2 / m.year_total_s1]
+    out = m.c_customer_id_s1.sort_values().head(100)
+    return pd.DataFrame({"s1_id": out.values})
+
+
+def _oracle_class_ratio(t, prefix, table):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy <= 2)][["d_date_sk"]]
+    it = t["item"]
+    it = it[it.i_category.isin(["Books", "Home", "Sports"])]
+    j = _merge(t[table], dd, f"{prefix}_sold_date_sk", "d_date_sk")
+    j = j.merge(
+        it[["i_item_sk", "i_item_id", "i_item_desc", "i_category",
+            "i_current_price"]],
+        left_on=f"{prefix}_item_sk", right_on="i_item_sk",
+    )
+    rev = (
+        j.groupby(["i_item_id", "i_item_desc", "i_category",
+                   "i_current_price"])
+        [f"{prefix}_ext_sales_price"].sum()
+        .reset_index(name="itemrevenue")
+    )
+    rev["classrev"] = rev.groupby("i_category")[
+        "itemrevenue"].transform("sum")
+    rev["revenueratio"] = rev.itemrevenue * 100.0 / rev.classrev
+    out = rev.sort_values(["i_category", "i_item_id"]).head(100)
+    return out[["i_item_id", "i_category", "itemrevenue",
+                "revenueratio"]].reset_index(drop=True)
+
+
+def oracle_q12(t):
+    return _oracle_class_ratio(t, "ws", "web_sales")
+
+
+def oracle_q20(t):
+    return _oracle_class_ratio(t, "cs", "catalog_sales")
+
+
+def oracle_q13(t):
+    cd = t["customer_demographics"]
+    cd = cd[
+        ((cd.cd_marital_status == "M")
+         & (cd.cd_education_status == "College"))
+        | ((cd.cd_marital_status == "S")
+           & (cd.cd_education_status == "Primary"))
+    ]
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(cd[["cd_demo_sk"]], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["store"][["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j[
+        ((j.ss_sales_price >= 50.0) & (j.ss_sales_price <= 150.0))
+        | ((j.ss_sales_price >= 10.0) & (j.ss_sales_price <= 60.0))
+    ]
+    return pd.DataFrame(
+        [
+            {
+                "avg_qty": j.ss_quantity.mean(),
+                "avg_esp": j.ss_ext_sales_price.mean(),
+                "avg_wc": j.ss_ext_wholesale_cost.mean(),
+                "sum_wc": j.ss_ext_wholesale_cost.sum(),
+            }
+        ]
+    )
+
+
+def oracle_q15(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy >= 1) & (dd.d_moy <= 3)]
+    j = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+               "cs_sold_date_sk", "d_date_sk")
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "cs_bill_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_zip",
+                                       "ca_state"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    zips = {"85669", "86197", "88274", "83405", "86475"}
+    sel = (
+        j.ca_zip.str[:5].isin(zips)
+        | j.ca_state.isin(["CA", "GA"])
+        | (j.cs_ext_sales_price > 500.0)
+    )
+    # SQL OR with NULL operands: NULL state rows still qualify via the
+    # price arm; pandas isin treats NaN as False, matching
+    j = j[sel.fillna(False)]
+    agg = (
+        j.groupby("ca_zip", dropna=False).cs_ext_sales_price.sum()
+        .reset_index(name="s")
+    )
+    return agg.sort_values("ca_zip", na_position="first").head(
+        100).reset_index(drop=True)
+
+
+def oracle_q16(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy >= 2) & (dd.d_moy <= 4)]
+    j = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+               "cs_sold_date_sk", "d_date_sk")
+    returned = set(t["catalog_returns"].cr_item_sk.dropna())
+    j = j[~j.cs_item_sk.isin(returned)]
+    dist = (
+        j.groupby("cs_item_sk").cs_ext_sales_price.sum()
+        .reset_index(name="net")
+    )
+    return pd.DataFrame(
+        [{"order_count": len(dist), "total_net": dist.net.sum()}]
+    )
+
+
+def oracle_q17(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1998]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    # join against ALL return rows (the query joins the returns table,
+    # so each return multiplies the sale row - mirror of the plan)
+    j = j.merge(
+        t["store_returns"][["sr_item_sk"]],
+        left_on="ss_item_sk", right_on="sr_item_sk",
+    )
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby("i_item_id")
+        .agg(qty_count=("ss_quantity", "count"),
+             qty_avg=("ss_quantity", "mean"),
+             qty_stdev=("ss_quantity", "std"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q18(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1998]
+    j = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+               "cs_sold_date_sk", "d_date_sk")
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "cs_bill_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_state"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    detail = (
+        j.groupby(["i_item_id", "ca_state"], dropna=False)
+        .cs_ext_sales_price.mean().reset_index(name="a")
+    )
+    by_state = (
+        j.groupby("ca_state", dropna=False)
+        .cs_ext_sales_price.mean().reset_index(name="a")
+    )
+    by_state.insert(0, "i_item_id", pd.NA)
+    grand = pd.DataFrame(
+        [{"i_item_id": pd.NA, "ca_state": pd.NA,
+          "a": j.cs_ext_sales_price.mean()}]
+    )
+    return pd.concat([detail, by_state, grand], ignore_index=True)[
+        ["i_item_id", "ca_state", "a"]
+    ]
+
+
+def oracle_q19(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy == 11)]
+    it = t["item"][t["item"].i_manager_id <= 20]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(it[["i_item_sk", "i_brand_id", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "ss_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_zip"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_zip"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[j.ca_zip.str[:5] != j.s_zip.str[:5]]
+    agg = (
+        j.groupby(["i_brand_id", "i_brand"])
+        .ss_ext_sales_price.sum().reset_index(name="ext_price")
+    )
+    agg = agg.rename(columns={"i_brand_id": "brand_id",
+                              "i_brand": "brand"})
+    agg = agg.sort_values(["ext_price", "brand_id"],
+                          ascending=[False, True]).head(100)
+    return agg[["brand_id", "brand", "ext_price"]].reset_index(
+        drop=True)
+
+
+ORACLES.update({
+    "q11": oracle_q11, "q12": oracle_q12, "q13": oracle_q13,
+    "q15": oracle_q15, "q16": oracle_q16, "q17": oracle_q17,
+    "q18": oracle_q18, "q19": oracle_q19, "q20": oracle_q20,
+})

@@ -3,7 +3,7 @@
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness's engine side for q1-q10: each query
+38-57). This module is that harness engine side for q1-q20 (q14 deferred): each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -959,3 +959,361 @@ QUERIES = {
     "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5,
     "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10,
 }
+
+
+# ---------------------------------------------------------------------------
+# q11-q20 (q14's cross-channel INTERSECT CTE is deferred)
+# ---------------------------------------------------------------------------
+
+def q11(s, flavor):
+    """TPC-DS q11: customers whose web-channel growth outpaces store
+    growth (2-year year_total self-join, web+store channels)."""
+    def yt(prefix, table, cust_col, year, names):
+        base = _year_total(s, flavor, prefix, table, cust_col)
+        return RenameColumnsExec(
+            FilterExec(base, Col("dyear") == year), names
+        )
+
+    ts1 = yt("ss", "store_sales", "ss_customer_sk", 1998,
+             ["s1_sk", "s1_id", "s1_year", "s1_total"])
+    ts2 = yt("ss", "store_sales", "ss_customer_sk", 1999,
+             ["s2_sk", "s2_id", "s2_year", "s2_total"])
+    tw1 = yt("ws", "web_sales", "ws_bill_customer_sk", 1998,
+             ["w1_sk", "w1_id", "w1_year", "w1_total"])
+    tw2 = yt("ws", "web_sales", "ws_bill_customer_sk", 1999,
+             ["w2_sk", "w2_id", "w2_year", "w2_total"])
+    j = _join(flavor, ts1, ts2, ["s1_sk"], ["s2_sk"])
+    j = _join(flavor, tw1, j, ["w1_sk"], ["s1_sk"])
+    j = _join(flavor, tw2, j, ["w2_sk"], ["w1_sk"])
+    cond = FilterExec(
+        FilterExec(j, (Col("s1_total") > 0) & (Col("w1_total") > 0)),
+        Col("w2_total") / Col("w1_total")
+        > Col("s2_total") / Col("s1_total"),
+    )
+    return _sorted_limit(
+        _project_names(cond, ["s1_id"]),
+        [SortKey(Col("s1_id"), True, True)],
+        100,
+    )
+
+
+def _channel_class_ratio(s, flavor, prefix, table):
+    """q12/q20 shape: revenue by item with its share of the CLASS
+    revenue via a window sum."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") <= 2),
+        ),
+        s[table](),
+        ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(
+            s["item"](),
+            InList(Col("i_category"),
+                   (Literal("Books", DataType.utf8()),
+                    Literal("Home", DataType.utf8()),
+                    Literal("Sports", DataType.utf8()))),
+        ),
+        j,
+        ["i_item_sk"], [f"{prefix}_item_sk"],
+    )
+    rev = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id"),
+              (Col("i_item_desc"), "i_item_desc"),
+              (Col("i_category"), "i_category"),
+              (Col("i_current_price"), "i_current_price")],
+        aggs=[(AggExpr(AggFn.SUM, Col(f"{prefix}_ext_sales_price")),
+               "itemrevenue")],
+    )
+    w = WindowExec(
+        rev,
+        partition_by=[Col("i_category")],
+        order_by=[],
+        functions=[WindowFn("sum", Col("itemrevenue"), "classrev")],
+    )
+    ratio = ProjectExec(
+        w,
+        [(Col("i_item_id"), "i_item_id"),
+         (Col("i_category"), "i_category"),
+         (Col("itemrevenue"), "itemrevenue"),
+         (Col("itemrevenue") * 100.0 / Col("classrev"), "revenueratio")],
+    )
+    return _sorted_limit(
+        ratio,
+        [SortKey(Col("i_category"), True, True),
+         SortKey(Col("i_item_id"), True, True)],
+        100,
+    )
+
+
+def q12(s, flavor):
+    """TPC-DS q12: web revenue share of class (window ratio)."""
+    return _channel_class_ratio(s, flavor, "ws", "web_sales")
+
+
+def q20(s, flavor):
+    """TPC-DS q20: catalog revenue share of class (window ratio)."""
+    return _channel_class_ratio(s, flavor, "cs", "catalog_sales")
+
+
+def q13(s, flavor):
+    """TPC-DS q13: OR'd demographic/price bands over store sales."""
+    demo = FilterExec(
+        s["customer_demographics"](),
+        (
+            (Col("cd_marital_status") == "M")
+            & (Col("cd_education_status") == "College")
+        )
+        | (
+            (Col("cd_marital_status") == "S")
+            & (Col("cd_education_status") == "Primary")
+        ),
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 2000),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, demo, j, ["cd_demo_sk"], ["ss_cdemo_sk"])
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    j = FilterExec(
+        j,
+        ((Col("ss_sales_price") >= 50.0)
+         & (Col("ss_sales_price") <= 150.0))
+        | ((Col("ss_sales_price") >= 10.0)
+           & (Col("ss_sales_price") <= 60.0)),
+    )
+    return _agg(
+        j,
+        keys=[],
+        aggs=[(AggExpr(AggFn.AVG, Col("ss_quantity")), "avg_qty"),
+              (AggExpr(AggFn.AVG, Col("ss_ext_sales_price")), "avg_esp"),
+              (AggExpr(AggFn.AVG, Col("ss_ext_wholesale_cost")),
+               "avg_wc"),
+              (AggExpr(AggFn.SUM, Col("ss_ext_wholesale_cost")),
+               "sum_wc")],
+    )
+
+
+def q15(s, flavor):
+    """TPC-DS q15: catalog sales by customer zip for qualifying
+    zips/states, one quarter."""
+    zips = tuple(
+        Literal(z, DataType.utf8())
+        for z in ("85669", "86197", "88274", "83405", "86475")
+    )
+    cond = FilterExec(
+        _join(
+            flavor,
+            s["customer_address"](),
+            _join(
+                flavor,
+                s["customer"](),
+                _join(
+                    flavor,
+                    FilterExec(
+                        s["date_dim"](),
+                        (Col("d_year") == 1999) & (Col("d_moy") >= 1)
+                        & (Col("d_moy") <= 3),
+                    ),
+                    s["catalog_sales"](),
+                    ["d_date_sk"], ["cs_sold_date_sk"],
+                ),
+                ["c_customer_sk"], ["cs_bill_customer_sk"],
+            ),
+            ["ca_address_sk"], ["c_current_addr_sk"],
+        ),
+        InList(
+            ScalarFn("substring",
+                     (Col("ca_zip"), Literal(1, DataType.int32()),
+                      Literal(5, DataType.int32()))),
+            zips,
+        )
+        | InList(Col("ca_state"),
+                 (Literal("CA", DataType.utf8()),
+                  Literal("GA", DataType.utf8())))
+        | (Col("cs_ext_sales_price") > 500.0),
+    )
+    agg = _agg(
+        cond,
+        keys=[(Col("ca_zip"), "ca_zip")],
+        aggs=[(AggExpr(AggFn.SUM, Col("cs_ext_sales_price")), "s")],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("ca_zip"), True, True)], 100
+    )
+
+
+def q16(s, flavor):
+    """TPC-DS q16 shape: catalog orders in a window shipped to chosen
+    counties, with returned orders EXCLUDED (anti join); COUNT(DISTINCT
+    order) via the Spark rewrite (distinct group-by then count)."""
+    sales = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") >= 2)
+            & (Col("d_moy") <= 4),
+        ),
+        s["catalog_sales"](),
+        ["d_date_sk"], ["cs_sold_date_sk"],
+    )
+    not_returned = SortMergeJoinExec(
+        sales, s["catalog_returns"](),
+        ["cs_item_sk"], ["cr_item_sk"], JoinType.LEFT_ANTI,
+    ) if flavor == "smj" else HashJoinExec(
+        sales, s["catalog_returns"](),
+        ["cs_item_sk"], ["cr_item_sk"], JoinType.LEFT_ANTI,
+    )
+    distinct_orders = _agg(
+        not_returned,
+        keys=[(Col("cs_item_sk"), "order_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("cs_ext_sales_price")), "net")],
+    )
+    return _agg(
+        distinct_orders,
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "order_count"),
+              (AggExpr(AggFn.SUM, Col("net")), "total_net")],
+    )
+
+
+def q17(s, flavor):
+    """TPC-DS q17 shape: quantity statistics for items sold and then
+    returned (store sales joined to store returns), by item."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1998),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor, s["store_returns"](), j,
+        ["sr_item_sk"], ["ss_item_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (AggExpr(AggFn.COUNT, Col("ss_quantity")), "qty_count"),
+            (AggExpr(AggFn.AVG, Col("ss_quantity")), "qty_avg"),
+            (AggExpr(AggFn.STDDEV_SAMP, Col("ss_quantity")),
+             "qty_stdev"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def q18(s, flavor):
+    """TPC-DS q18 (rollup as explicit grouping-set union): catalog
+    averages by (item, state) plus state and grand totals."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1998),
+        s["catalog_sales"](),
+        ["d_date_sk"], ["cs_sold_date_sk"],
+    )
+    j = _join(
+        flavor, s["customer"](), j,
+        ["c_customer_sk"], ["cs_bill_customer_sk"],
+    )
+    j = _join(
+        flavor, s["customer_address"](), j,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["cs_item_sk"])
+    detail = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id"),
+              (Col("ca_state"), "ca_state")],
+        aggs=[(AggExpr(AggFn.AVG, Col("cs_ext_sales_price")), "a")],
+    )
+    # rollup levels re-aggregate from the base join (AVG isn't
+    # mergeable from averaged details)
+    by_state = ProjectExec(
+        _agg(
+            j,
+            keys=[(Col("ca_state"), "ca_state")],
+            aggs=[(AggExpr(AggFn.AVG, Col("cs_ext_sales_price")), "a")],
+        ),
+        [(Literal(None, DataType.utf8()), "i_item_id"),
+         (Col("ca_state"), "ca_state"), (Col("a"), "a")],
+    )
+    grand = ProjectExec(
+        _agg(
+            j, keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("cs_ext_sales_price")), "a")],
+        ),
+        [(Literal(None, DataType.utf8()), "i_item_id"),
+         (Literal(None, DataType.utf8()), "ca_state"), (Col("a"), "a")],
+    )
+    detail_out = _project_names(detail, ["i_item_id", "ca_state", "a"])
+    return _union([detail_out, by_state, grand])
+
+
+def q19(s, flavor):
+    """TPC-DS q19 shape: brand revenue for one month/manager band where
+    the customer and store sit in different zip prefixes."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") == 11),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_manager_id") <= 20),
+        j,
+        ["i_item_sk"], ["ss_item_sk"],
+    )
+    j = _join(
+        flavor, s["customer"](), j,
+        ["c_customer_sk"], ["ss_customer_sk"],
+    )
+    j = _join(
+        flavor, s["customer_address"](), j,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    j = FilterExec(
+        j,
+        ScalarFn("substring",
+                 (Col("ca_zip"), Literal(1, DataType.int32()),
+                  Literal(5, DataType.int32())))
+        != ScalarFn("substring",
+                    (Col("s_zip"), Literal(1, DataType.int32()),
+                     Literal(5, DataType.int32()))),
+    )
+    agg = _agg(
+        j,
+        keys=[(Col("i_brand_id"), "brand_id"),
+              (Col("i_brand"), "brand")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+               "ext_price")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("ext_price"), False, False),
+         SortKey(Col("brand_id"), True, True)],
+        100,
+    )
+
+
+QUERIES.update({
+    "q11": q11, "q12": q12, "q13": q13, "q15": q15, "q16": q16,
+    "q17": q17, "q18": q18, "q19": q19, "q20": q20,
+})
