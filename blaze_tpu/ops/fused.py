@@ -214,31 +214,47 @@ class FusedAggregateExec(PhysicalOp):
         first = True
         for cb in self.children[0].execute(partition, ctx):
             layout = cb.layout()
+            base_key = (
+                "fusedagg", self.pipeline.structure_key(),
+                tuple((e, n) for e, n in self.agg.keys),
+                tuple((a.fn, a.child) for a, _ in self.agg.aggs),
+                layout,
+            )
             fn = cached_kernel(
-                ("fusedagg", self.pipeline.structure_key(),
-                 tuple((e, n) for e, n in self.agg.keys),
-                 tuple((a.fn, a.child) for a, _ in self.agg.aggs),
-                 layout),
-                lambda: self._build_kernel(layout),
+                base_key, lambda: self._build_kernel(layout)
             )
             outs, n_groups = fn(
                 cb.device_buffers(), cb.selection, cb.num_rows
             )
-            if self.fetch_host and first:
-                # the single-batch-per-partition hot path: states + count
-                # in ONE batched D2H. Later batches (multi-batch stream
-                # headed for the device FINAL merge) stay device-resident
-                # and pay only the scalar sync. `first` stays set until a
-                # NON-EMPTY batch was host-fetched, so a filtered-out
-                # leading batch doesn't push the sole survivor onto the
-                # per-column-fetch path.
-                host_outs, host_n = device_get((outs, n_groups))
-                n = int(host_n)
-                if n > 0:
-                    first = False
-            else:
-                host_outs = outs
-                n = host_int(n_groups)
+
+            def fetch(outs, n_groups):
+                # the single-batch-per-partition hot path: states +
+                # count in ONE batched D2H. Later batches (multi-batch
+                # stream headed for the device FINAL merge) stay
+                # device-resident and pay only the scalar sync. `first`
+                # stays set until a NON-EMPTY batch was host-fetched, so
+                # a filtered-out leading batch doesn't push the sole
+                # survivor onto the per-column-fetch path.
+                if self.fetch_host and first:
+                    host_outs, host_n = device_get((outs, n_groups))
+                    return host_outs, int(host_n)
+                return outs, host_int(n_groups)
+
+            host_outs, n = fetch(outs, n_groups)
+            if n < 0:
+                # narrow-key hash collision sentinel (vanishingly rare):
+                # re-run this batch on the exact lexsort kernel
+                fn = cached_kernel(
+                    base_key + ("lexsort",),
+                    lambda: self._build_kernel(
+                        layout, force_lexsort=True
+                    ),
+                )
+                host_outs, n = fetch(
+                    *fn(cb.device_buffers(), cb.selection, cb.num_rows)
+                )
+            if self.fetch_host and first and n > 0:
+                first = False
             if n == 0:
                 continue
             cols = [
@@ -247,7 +263,7 @@ class FusedAggregateExec(PhysicalOp):
             ]
             yield ColumnBatch(self._schema, cols, n)
 
-    def _build_kernel(self, layout):
+    def _build_kernel(self, layout, force_lexsort: bool = False):
         pipe_kernel = self.pipeline._build_kernel(layout)
         mid_schema = self.pipeline.schema
         cap = layout[0]
@@ -266,7 +282,8 @@ class FusedAggregateExec(PhysicalOp):
             if a.child is not None
         }
         agg_kernel = agg._build_kernel(
-            mid_schema, cap, key_exprs, child_map, False, mid_layout
+            mid_schema, cap, key_exprs, child_map, False, mid_layout,
+            force_lexsort=force_lexsort,
         )
 
         def kernel(bufs, selection, num_rows):

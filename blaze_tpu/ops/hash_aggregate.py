@@ -548,6 +548,20 @@ class HashAggregateExec(PhysicalOp):
             aug.device_buffers(), aug.selection, aug.num_rows
         )
         n = host_int(n_groups)
+        if n < 0:
+            # narrow-key hash collision sentinel: re-run on the exact
+            # full-width lexsort kernel (vanishingly rare)
+            fn = cached_kernel(
+                key + ("lexsort",),
+                lambda: self._build_kernel(
+                    aug.schema, aug.capacity, key_exprs_l, child_map,
+                    merging, aug.layout(), force_lexsort=True,
+                ),
+            )
+            outs, n_groups = fn(
+                aug.device_buffers(), aug.selection, aug.num_rows
+            )
+            n = host_int(n_groups)
         cols: List[Column] = []
         # recover dictionaries for string key passthroughs
         for (v, m), field, e in zip(
@@ -591,11 +605,38 @@ class HashAggregateExec(PhysicalOp):
         return ColumnBatch(self._schema, cols, n)
 
     # ------------------------------------------------------------------
+    def _narrow_key_dtypes(self, in_schema, key_exprs):
+        """Hash dtypes for the narrow-key grouping fast path, or None
+        when ineligible. Eligible: fixed-width non-float keys (ints,
+        dates, timestamps, bool, decimal<=18, dictionary codes) - the
+        sort then runs on ONE i32 hash lane instead of K emulated-64-bit
+        lanes (ROADMAP 'aggregate/sort key widths'). Floats keep the
+        lexsort path (NaN/-0.0 normalization)."""
+        from blaze_tpu.exprs.hashing import device_hash_supported
+
+        dtypes = []
+        for e in key_exprs:
+            dt = infer_dtype(e, in_schema)
+            if dt.is_dictionary_encoded:
+                dt = DataType.int32()  # group equality == code equality
+            if dt.id in (TypeId.FLOAT32, TypeId.FLOAT64):
+                return None
+            if dt.is_wide_decimal or not device_hash_supported(dt):
+                return None
+            dtypes.append(dt)
+        return dtypes
+
     def _build_kernel(self, in_schema, capacity, key_exprs, child_map,
-                      merging, layout):
+                      merging, layout, force_lexsort: bool = False):
+        from blaze_tpu.exprs.hashing import hash_columns_device
+
         aggs = self.aggs
         n_keys = len(key_exprs)
         state_offsets = self._state_offsets(in_schema) if merging else None
+        hash_dtypes = (
+            None if force_lexsort
+            else self._narrow_key_dtypes(in_schema, key_exprs)
+        )
 
         def kernel(bufs, selection, num_rows):
             cols = _unflatten_cvs(layout, bufs)
@@ -605,8 +646,30 @@ class HashAggregateExec(PhysicalOp):
                 live = live & selection
 
             keys_cv = [ev.evaluate(e) for e in key_exprs]
+            collision = jnp.asarray(False)
             # ---- group ids by stable sort + boundary detection ----
-            if n_keys:
+            if n_keys and hash_dtypes is not None:
+                # narrow-key fast path: ONE stable i32 sort by the key
+                # hash; true-key boundary detection below splits hash
+                # collisions into correct runs, and a collision between
+                # DIFFERENT keys (which could scatter one key across
+                # runs) is detected and reported via the n_groups
+                # sentinel so the caller re-runs the lexsort kernel
+                h = hash_columns_device(
+                    [
+                        (v, m, dt)
+                        for (v, m), dt in zip(keys_cv, hash_dtypes)
+                    ],
+                    capacity,
+                ).astype(jnp.int32)
+                order = jnp.lexsort(
+                    (h, jnp.where(live, 0, 1).astype(jnp.int8))
+                )
+                idx = order
+                sh = jnp.take(h, idx)
+                shp = jnp.concatenate([sh[:1], sh[:-1]])
+                hash_neq = sh != shp
+            elif n_keys:
                 # sort priority: live rows first, then per key a (validity,
                 # value) pair so NULL forms its own ordering class and never
                 # interleaves with the dtype-extreme sentinel values
@@ -625,11 +688,13 @@ class HashAggregateExec(PhysicalOp):
                 # jnp.lexsort: last key is the primary -> reverse
                 order = jnp.lexsort(tuple(reversed(priority)))
                 idx = order
+                hash_neq = None
+            if n_keys:
                 s_live = jnp.take(live, idx)
-                boundary = jnp.zeros(capacity, dtype=jnp.bool_)
-                first_live = s_live & ~jnp.concatenate(
+                prev_live = jnp.concatenate(
                     [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
                 )
+                first_live = s_live & ~prev_live
                 diff = jnp.zeros(capacity, dtype=jnp.bool_)
                 for v, m in keys_cv:
                     if jnp.issubdtype(v.dtype, jnp.floating):
@@ -655,10 +720,21 @@ class HashAggregateExec(PhysicalOp):
                             sm & smp, neq, sm != smp
                         )
                     diff = diff | neq
+                if hash_neq is not None:
+                    # a same-hash adjacency between DIFFERENT keys means
+                    # equal keys may be scattered across runs - bail to
+                    # the lexsort kernel via the n_groups sentinel
+                    collision = jnp.any(
+                        s_live & prev_live & ~hash_neq & diff
+                    )
                 boundary = s_live & (diff | first_live)
                 gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
                 gid_sorted = jnp.where(s_live, gid_sorted, capacity - 1)
-                n_groups = jnp.sum(boundary.astype(jnp.int32))
+                n_groups = jnp.where(
+                    collision,
+                    jnp.int32(-1),
+                    jnp.sum(boundary.astype(jnp.int32)),
+                )
                 # boundary row index per group, padded
                 bpos = jnp.nonzero(
                     boundary, size=capacity, fill_value=0

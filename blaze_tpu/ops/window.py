@@ -119,10 +119,13 @@ class WindowExec(PhysicalOp):
             for f in functions
         ]
         for f in self.functions:
-            if f.kind in ("lag", "lead", "ntile") and f.offset < 0:
+            if f.kind in ("lag", "lead") and f.offset < 0:
                 raise NotImplementedError(
                     f"negative {f.kind} offset (use the mirror fn)"
                 )
+            if f.kind == "ntile" and f.offset < 1:
+                # SQL: NTILE(n) requires n >= 1
+                raise NotImplementedError("ntile bucket count must be >= 1")
             fr = f.frame
             if fr is None:
                 continue
@@ -343,7 +346,7 @@ class WindowExec(PhysicalOp):
                     seg_dr = jnp.take(dr, seg_start)
                     outs.append((dr - seg_dr + 1, None))
                 elif f.kind == "ntile":
-                    nt = max(int(f.offset), 1)
+                    nt = int(f.offset)  # >= 1, validated at init
                     base = size // nt
                     rem = size % nt
                     cutoff = rem * (base + 1)

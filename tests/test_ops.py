@@ -267,3 +267,100 @@ def test_sort_nan_ordering():
     desc = SortExec(scan_of(data), [SortKey(Col("x"), ascending=False)])
     vals = collect(desc)["x"]
     assert vals[0] != vals[0] and vals[1:] == [2.0, 1.0, -5.0]
+
+
+def test_narrow_key_grouping_collision_fallback(monkeypatch):
+    from blaze_tpu.runtime.executor import run_plan
+    """The narrow-key hash-grouping fast path detects hash collisions
+    between distinct keys and re-runs the exact lexsort kernel. Forcing
+    every hash to collide must still produce exact results."""
+    import blaze_tpu.exprs.hashing as H
+    import blaze_tpu.ops.hash_aggregate as HA
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+    from blaze_tpu.runtime import dispatch
+
+    def constant_hash(cols, capacity, precomputed=()):
+        import jax.numpy as jnp
+
+        return jnp.zeros(capacity, dtype=jnp.int32)
+
+    # the kernel imports hash_columns_device from exprs.hashing at
+    # build time - patch at the source (monkeypatch auto-restores);
+    # caches cleared around the patch so other tests never see kernels
+    # traced with the degenerate hash
+    monkeypatch.setattr(H, "hash_columns_device", constant_hash)
+    dispatch.clear_kernel_cache()
+    try:
+        cb = ColumnBatch.from_pydict(
+            {"k": [3, 1, 2, 1, 3, 3], "v": [1, 2, 3, 4, 5, 6]}
+        )
+        scan = MemoryScanExec.from_batches([cb])
+        agg = HashAggregateExec(
+            scan,
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode=AggMode.COMPLETE,
+        )
+        out = run_plan(agg).to_pydict()
+        got = dict(zip(out["k"], out["s"]))
+        # the fallback lexsort kernel sorts keys directly, so results
+        # are exact even with the degenerate all-collide hash
+        assert got == {1: 6, 2: 3, 3: 12}
+    finally:
+        dispatch.clear_kernel_cache()
+
+
+def test_narrow_key_grouping_matches_lexsort():
+    """Fast-path grouping (int/string/null keys) must equal the lexsort
+    kernel's results exactly."""
+    from blaze_tpu.runtime.executor import run_plan
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+
+    rng = np.random.default_rng(31)
+    n = 5000
+    k1 = rng.integers(-50, 50, n)
+    k1_null = rng.random(n) < 0.05
+    k2 = rng.integers(0, 5, n)
+    v = rng.integers(0, 1000, n)
+    rb = pa.record_batch(
+        {
+            "k1": pa.array(
+                [None if nn else int(x) for x, nn in zip(k1, k1_null)],
+                pa.int64(),
+            ),
+            "k2": pa.array([f"g{x}" for x in k2], pa.utf8()),
+            "v": pa.array(v, pa.int64()),
+        }
+    )
+    cb = ColumnBatch.from_arrow(rb)
+    scan = MemoryScanExec([[cb]], cb.schema)
+    agg = HashAggregateExec(
+        scan,
+        keys=[(Col("k1"), "k1"), (Col("k2"), "k2")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    out = run_plan(agg).to_pandas()
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {"k1": [None if nn else int(x) for x, nn in zip(k1, k1_null)],
+         "k2": [f"g{x}" for x in k2], "v": v}
+    )
+    ref = (
+        df.groupby(["k1", "k2"], dropna=False)
+        .agg(s=("v", "sum"), n=("v", "size")).reset_index()
+    )
+    got = out.sort_values(["k2", "k1"], na_position="first").reset_index(
+        drop=True)
+    ref = ref.sort_values(["k2", "k1"], na_position="first").reset_index(
+        drop=True)
+    assert len(got) == len(ref)
+    assert got["s"].tolist() == ref["s"].tolist()
+    assert got["n"].tolist() == ref["n"].tolist()
