@@ -270,13 +270,12 @@ def test_sort_nan_ordering():
 
 
 def test_narrow_key_grouping_collision_fallback(monkeypatch):
-    from blaze_tpu.runtime.executor import run_plan
     """The narrow-key hash-grouping fast path detects hash collisions
     between distinct keys and re-runs the exact lexsort kernel. Forcing
     every hash to collide must still produce exact results."""
     import blaze_tpu.exprs.hashing as H
-    import blaze_tpu.ops.hash_aggregate as HA
     from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.runtime.executor import run_plan
     from blaze_tpu.ops import AggMode, HashAggregateExec
     from blaze_tpu.runtime import dispatch
 

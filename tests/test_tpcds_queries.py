@@ -1,4 +1,4 @@
-"""TPC-DS q1-q20 whole-query differential matrix (q14 deferred).
+"""TPC-DS q1-q20 whole-query differential matrix.
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -636,3 +636,68 @@ ORACLES.update({
     "q15": oracle_q15, "q16": oracle_q16, "q17": oracle_q17,
     "q18": oracle_q18, "q19": oracle_q19, "q20": oracle_q20,
 })
+
+
+def oracle_q14(t):
+    def triples(df, item_col):
+        j = _merge(df, t["item"][["i_item_sk", "i_brand_id",
+                                  "i_manufact_id"]],
+                   item_col, "i_item_sk")
+        return set(zip(j.i_brand_id, j.i_manufact_id))
+
+    cross = (
+        triples(t["store_sales"], "ss_item_sk")
+        & triples(t["catalog_sales"], "cs_item_sk")
+        & triples(t["web_sales"], "ws_item_sk")
+    )
+    it = t["item"]
+    cross_items = set(
+        it[
+            [
+                (b, m) in cross
+                for b, m in zip(it.i_brand_id, it.i_manufact_id)
+            ]
+        ].i_item_sk
+    )
+    dd = t["date_dim"][t["date_dim"].d_year == 1999][["d_date_sk"]]
+
+    def rev(df, date_col, item_col, price_col):
+        j = _merge(df, dd, date_col, "d_date_sk")
+        return j[[item_col, price_col]].rename(
+            columns={item_col: "item_sk", price_col: "sales"}
+        )
+
+    all_sales = pd.concat(
+        [
+            rev(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price"),
+            rev(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+                "cs_ext_sales_price"),
+            rev(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price"),
+        ],
+        ignore_index=True,
+    )
+    avg_sales = all_sales.sales.mean()
+    in_cross = all_sales[all_sales.item_sk.isin(cross_items)]
+    j = in_cross.merge(
+        t["item"][["i_item_sk", "i_brand_id"]],
+        left_on="item_sk", right_on="i_item_sk",
+    )
+    by_brand = (
+        j.groupby("i_brand_id")
+        .agg(sales=("sales", "sum"), number_sales=("sales", "size"))
+        .reset_index()
+        .rename(columns={"i_brand_id": "brand_id"})
+    )
+    detail = by_brand[by_brand.sales > avg_sales]
+    total = pd.DataFrame(
+        [{"brand_id": pd.NA, "sales": detail.sales.sum(),
+          "number_sales": detail.number_sales.sum()}]
+    )
+    return pd.concat([detail, total], ignore_index=True)[
+        ["brand_id", "sales", "number_sales"]
+    ]
+
+
+ORACLES["q14"] = oracle_q14

@@ -3,7 +3,7 @@
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for q1-q20 (q14 deferred): each query
+38-57). This module is that harness engine side for q1-q20: each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -1317,3 +1317,109 @@ QUERIES.update({
     "q11": q11, "q12": q12, "q13": q13, "q15": q15, "q16": q16,
     "q17": q17, "q18": q18, "q19": q19, "q20": q20,
 })
+
+
+def q14(s, flavor):
+    """TPC-DS q14a shape: cross_items = (brand_id, manufact_id) key
+    pairs sold in ALL three channels (semi-join intersect chain - the
+    real query intersects (brand,class,category); the generated item
+    table has no class column, so the 2-key pair exercises the same
+    intersect machinery); avg_sales
+    scalar over the three channels; per-channel item sales over
+    cross_items filtered above the scalar, with a channel-level rollup
+    (grouping-set union, as in q5/q18)."""
+    def channel_triples(prefix, table):
+        j = _join(
+            flavor, s["item"](), s[table](),
+            ["i_item_sk"], [f"{prefix}_item_sk"],
+        )
+        return _agg(
+            j,
+            keys=[(Col("i_brand_id"), "brand_id"),
+                  (Col("i_manufact_id"), "manu_id")],
+            aggs=[],
+        )
+
+    cross_triples = _semi(
+        flavor,
+        _semi(
+            flavor,
+            channel_triples("ss", "store_sales"),
+            channel_triples("cs", "catalog_sales"),
+            ["brand_id", "manu_id"], ["brand_id", "manu_id"],
+        ),
+        channel_triples("ws", "web_sales"),
+        ["brand_id", "manu_id"], ["brand_id", "manu_id"],
+    )
+    cross_items = _project_names(
+        _semi(
+            flavor, s["item"](), cross_triples,
+            ["i_brand_id", "i_manufact_id"], ["brand_id", "manu_id"],
+        ),
+        ["i_item_sk", "i_brand_id", "i_manufact_id"],
+    )
+
+    def channel_rev(prefix, table, price_col):
+        j = _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 1999),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return ProjectExec(
+            j,
+            [(Col(f"{prefix}_item_sk"), "item_sk"),
+             (Col(price_col), "sales")],
+        )
+
+    all_sales = _union([
+        channel_rev("ss", "store_sales", "ss_ext_sales_price"),
+        channel_rev("cs", "catalog_sales", "cs_ext_sales_price"),
+        channel_rev("ws", "web_sales", "ws_ext_sales_price"),
+    ])
+    avg_sales = ProjectExec(
+        _agg(
+            all_sales, keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("sales")), "avg_sales")],
+        ),
+        [(Literal(1, DataType.int32()), "k"),
+         (Col("avg_sales"), "avg_sales")],
+    )
+    in_cross = _semi(
+        flavor, all_sales, cross_items, ["item_sk"], ["i_item_sk"]
+    )
+    by_item = _agg(
+        _join(flavor, s["item"](), in_cross,
+              ["i_item_sk"], ["item_sk"]),
+        keys=[(Col("i_brand_id"), "brand_id")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+              (AggExpr(AggFn.COUNT_STAR, None), "number_sales")],
+    )
+    keyed = ProjectExec(
+        by_item,
+        [(Col("brand_id"), "brand_id"), (Col("sales"), "sales"),
+         (Col("number_sales"), "number_sales"),
+         (Literal(1, DataType.int32()), "k")],
+    )
+    over_avg = FilterExec(
+        _join(flavor, avg_sales, keyed, ["k"], ["k"]),
+        Col("sales") > Col("avg_sales"),
+    )
+    detail = _project_names(
+        over_avg, ["brand_id", "sales", "number_sales"]
+    )
+    total = ProjectExec(
+        _agg(
+            detail, keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+                  (AggExpr(AggFn.SUM, Col("number_sales")),
+                   "number_sales")],
+        ),
+        [(Literal(None, DataType.int32()), "brand_id"),
+         (Col("sales"), "sales"),
+         (Col("number_sales"), "number_sales")],
+    )
+    return _union([detail, total])
+
+
+QUERIES["q14"] = q14
