@@ -1,0 +1,220 @@
+"""Multi-process (multi-host) mesh launcher.
+
+The reference scales across hosts on Spark's netty fabric; the TPU-native
+equivalent for DEVICE-tier collectives is a jax.distributed process group:
+every host runs one engine process, `jax.distributed.initialize` stitches
+their local chips into one global mesh, and the engine's distributed
+operators (parallel/sharded.py) run as a single SPMD program with XLA
+collectives riding ICI within a host and DCN between hosts.
+
+Two entry points:
+- `initialize_worker(...)`: call FIRST in a worker process (before any
+  backend init); joins the process group and returns the global Mesh.
+- `launch_local(num_processes, ...)`: driver-side helper that spawns N
+  local worker processes (each with its own virtual device pool on CPU,
+  or its own TPU chips in production) running this module's smoke
+  workload - the single-machine stand-in for one-process-per-host, used
+  by tests and as the template for a real multi-host deployment.
+
+The smoke workload runs DistributedGroupBy over the global mesh: every
+process holds only its local shards; the result is allgathered and
+checked against a numpy reference on every process (rank-symmetric, so
+a pass means the cross-process collectives actually moved data).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def initialize_worker(coordinator: str, num_processes: int,
+                      process_id: int,
+                      local_device_count: Optional[int] = None,
+                      platform: Optional[str] = None):
+    """Join the process group and return (jax module, global Mesh over
+    the 'data' axis). Must run before any jax backend initialization."""
+    if local_device_count is not None:
+        # an explicit request overrides whatever the environment set
+        # (e.g. a sitecustomize that rewrites XLA_FLAGS at startup)
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{local_device_count}"
+        ).strip()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    return jax, mesh
+
+
+def _worker_main(coordinator: str, num_processes: int, process_id: int,
+                 local_device_count: int) -> int:
+    jax, mesh = initialize_worker(
+        coordinator, num_processes, process_id,
+        local_device_count=local_device_count,
+        platform=os.environ.get("BLAZE_LAUNCH_PLATFORM") or None,
+    )
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    jax.config.update("jax_enable_x64", True)
+
+    from blaze_tpu.types import DataType, Field, Schema
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.exprs.ir import AggFn
+    from blaze_tpu.parallel.sharded import DistAgg, DistributedGroupBy
+
+    n_dev = len(jax.devices())
+    cap = 64
+    # deterministic GLOBAL input: every process can construct the whole
+    # logical array, then keeps only its local shards
+    rng = np.random.default_rng(7)
+    keys_np = rng.integers(0, 13, (n_dev, cap)).astype(np.int64)
+    vals_np = rng.integers(0, 100, (n_dev, cap)).astype(np.int64)
+    rows_np = rng.integers(1, cap + 1, n_dev).astype(np.int32)
+
+    def to_global(arr):
+        return multihost_utils.host_local_array_to_global_array(
+            arr, mesh, jax.sharding.PartitionSpec("data")
+        )
+
+    # host-local slice for this process (contiguous device blocks)
+    per = n_dev // num_processes
+    sl = slice(process_id * per, (process_id + 1) * per)
+    keys = to_global(keys_np[sl])
+    vals = to_global(vals_np[sl])
+    rows = to_global(rows_np[sl])
+
+    schema = Schema(
+        [Field("k", DataType.int64()), Field("v", DataType.int64())]
+    )
+    gb = DistributedGroupBy(
+        mesh, schema,
+        keys=[Col("k")],
+        aggs=[DistAgg(AggFn.SUM, Col("v")),
+              DistAgg(AggFn.COUNT_STAR, None)],
+        filter_pred=Col("v") >= 5,
+    )
+    key_out, agg_out, counts = gb([keys, vals], rows)
+
+    # gather the global result on every process, normalized to
+    # [n_dev, ...] regardless of how allgather stacks the shards
+    def gather(x, trailing: bool):
+        g = np.asarray(
+            multihost_utils.process_allgather(x, tiled=True)
+        )
+        return g.reshape((n_dev, -1) if trailing else (n_dev,))
+
+    ko = gather(key_out, True)
+    so = gather(agg_out[0], True)
+    no = gather(agg_out[1], True)
+    cn = gather(counts, False)
+
+    # numpy reference over the full logical input
+    ref: dict = {}
+    for d in range(n_dev):
+        for i in range(int(rows_np[d])):
+            k, v = int(keys_np[d, i]), int(vals_np[d, i])
+            if v >= 5:
+                s, c = ref.get(k, (0, 0))
+                ref[k] = (s + v, c + 1)
+    got: dict = {}
+    for d in range(n_dev):
+        for g in range(int(cn[d])):
+            k = int(ko[d, g])
+            assert k not in got, "group owned by two devices"
+            got[k] = (int(so[d, g]), int(no[d, g]))
+    assert got == ref, (got, ref)
+    print(
+        json.dumps(
+            {
+                "process": process_id,
+                "global_devices": n_dev,
+                "groups": len(got),
+                "ok": True,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def launch_local(num_processes: int = 2, devices_per_process: int = 4,
+                 port: int = 19733, timeout: float = 300.0):
+    """Spawn num_processes local workers (one-per-host stand-in); each
+    contributes devices_per_process virtual CPU devices to the global
+    mesh. Returns the list of per-process JSON results."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BLAZE_LAUNCH_PLATFORM"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for pid in range(num_processes):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "blaze_tpu.runtime.launcher",
+                    f"127.0.0.1:{port}", str(num_processes), str(pid),
+                    str(devices_per_process),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    results = []
+    errors = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                errors.append(out[-2000:])
+                continue
+            for line in reversed(out.splitlines()):
+                if line.startswith("{"):
+                    results.append(json.loads(line))
+                    break
+    finally:
+        # a crashed peer leaves the others blocked in the distributed
+        # barrier holding the coordinator port - never orphan them
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if errors:
+        raise RuntimeError("worker failed:\n" + "\n---\n".join(errors))
+    return results
+
+
+if __name__ == "__main__":
+    raise SystemExit(
+        _worker_main(
+            sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+            int(sys.argv[4]),
+        )
+    )

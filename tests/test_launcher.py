@@ -1,0 +1,15 @@
+"""Multi-process mesh launcher: two worker processes (one-per-host
+stand-in), each contributing virtual devices to ONE global mesh, run the
+distributed group-by as a single SPMD program with cross-process
+collectives and validate the allgathered result on every rank."""
+
+from blaze_tpu.runtime.launcher import launch_local
+
+
+def test_two_process_global_mesh_groupby():
+    results = launch_local(num_processes=2, devices_per_process=4,
+                           port=19741)
+    assert len(results) == 2
+    for r in results:
+        assert r["ok"] and r["global_devices"] == 8
+    assert results[0]["groups"] == results[1]["groups"] > 0
