@@ -33,17 +33,32 @@ def cmd_info(args) -> int:
 
 
 def cmd_run_task(args) -> int:
-    from blaze_tpu.ops.base import ExecContext
-    from blaze_tpu.runtime.executor import execute_task
+    from blaze_tpu.ops.base import ExecContext, MetricNode
+    from blaze_tpu.runtime.executor import decode_task, execute_partition
+    from blaze_tpu.runtime.instrument import instrument, render_metrics
 
     with open(args.file, "rb") as f:
         blob = f.read()
     ctx = ExecContext()
     total = 0
-    for rb in execute_task(blob, ctx):
-        total += rb.num_rows
-        if not args.quiet:
-            print(rb.to_pandas().to_string(max_rows=20))
+    if args.metrics:
+        # per-operator metric tree (the reference's Spark-UI panel,
+        # metrics.rs:32-56): the ONE production decode path, then wrap
+        op, partition = decode_task(blob, ctx)
+        root = MetricNode("root")
+        wrapped = instrument(op, root)
+        for rb in execute_partition(wrapped, partition, ctx):
+            total += rb.num_rows
+            if not args.quiet:
+                print(rb.to_pandas().to_string(max_rows=20))
+        print(render_metrics(root), file=sys.stderr)
+    else:
+        from blaze_tpu.runtime.executor import execute_task
+
+        for rb in execute_task(blob, ctx):
+            total += rb.num_rows
+            if not args.quiet:
+                print(rb.to_pandas().to_string(max_rows=20))
     # metrics push after stream end (reference metrics.rs:32-56)
     print(f"-- {total} rows", file=sys.stderr)
     print(json.dumps(ctx.metrics.flatten()), file=sys.stderr)
@@ -80,6 +95,8 @@ def main(argv=None) -> int:
     rt = sub.add_parser("run-task")
     rt.add_argument("file")
     rt.add_argument("--quiet", action="store_true")
+    rt.add_argument("--metrics", action="store_true",
+                    help="print the per-operator metric tree")
     sc = sub.add_parser("scan")
     sc.add_argument("file")
     sc.add_argument("--columns", default=None)

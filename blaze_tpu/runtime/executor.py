@@ -36,25 +36,31 @@ class TaskExecutionError(RuntimeError):
         self.__cause__ = cause
 
 
+def decode_task(task_bytes: bytes, ctx: ExecContext):
+    """Decode a serialized TaskDefinition into a runnable (op, partition)
+    pair, fusing the tree exactly like driver-built plans (decoded tasks
+    are the production entry, so they must hit the same one-dispatch
+    pipeline programs; reference: the decoded plan IS the executed plan,
+    exec.rs:137-165) and installing its resources into the context."""
+    from blaze_tpu.plan.serde import task_from_proto
+    from blaze_tpu.ops.fused import fuse_pipelines
+
+    op, partition, task_id, resources = task_from_proto(task_bytes)
+    op = fuse_pipelines(op)
+    ctx.partition_id = partition
+    ctx.task_id = task_id
+    for rid, provider in resources.items():
+        ctx.resources.setdefault(rid, provider)
+    return op, partition
+
+
 def execute_task(task_bytes: bytes,
                  ctx: Optional[ExecContext] = None
                  ) -> Iterator[pa.RecordBatch]:
     """Decode and run one serialized TaskDefinition; yields Arrow batches
     (the FFI-equivalent boundary, exec.rs:205-255)."""
-    from blaze_tpu.plan.serde import task_from_proto
-    from blaze_tpu.ops.fused import fuse_pipelines
-
-    op, partition, task_id, resources = task_from_proto(task_bytes)
-    # fuse the decoded tree exactly like driver-built plans: decoded tasks
-    # are the production entry, so they must hit the same one-dispatch
-    # pipeline programs (reference: the decoded plan IS the executed plan,
-    # exec.rs:137-165)
-    op = fuse_pipelines(op)
     ctx = ctx or ExecContext()
-    ctx.partition_id = partition
-    ctx.task_id = task_id
-    for rid, provider in resources.items():
-        ctx.resources.setdefault(rid, provider)
+    op, partition = decode_task(task_bytes, ctx)
     yield from execute_partition(op, partition, ctx)
 
 

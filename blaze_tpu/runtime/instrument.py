@@ -68,3 +68,47 @@ def instrument(op: PhysicalOp, metrics: MetricNode) -> PhysicalOp:
     wrapped_children = [instrument(c, node) for c in op.children]
     op.children = wrapped_children
     return _Instrumented(op, node)
+
+
+def exclusive_elapsed(node: MetricNode) -> int:
+    """Exclusive compute nanoseconds for one metric node: inclusive time
+    minus the children's inclusive times (clamped at zero - children
+    driven from a sibling partition can exceed the parent's window)."""
+    own = node.counters.get("elapsed_compute", 0)
+    kids = sum(
+        c.counters.get("elapsed_compute", 0) for c in node.children
+    )
+    return max(0, own - kids)
+
+
+def render_metrics(root: MetricNode, indent: str = "") -> str:
+    """Spark-UI-style rendering of the mirrored metric tree: one line
+    per operator with rows/batches and inclusive + EXCLUSIVE time
+    (reference counterpart: the SQLMetric panel fed by metrics.rs)."""
+    lines = []
+
+    def walk(node: MetricNode, depth: int) -> None:
+        c = node.counters
+        incl_ms = c.get("elapsed_compute", 0) / 1e6
+        excl_ms = exclusive_elapsed(node) / 1e6
+        stats = []
+        if "output_rows" in c:
+            stats.append(f"rows={c['output_rows']:,}")
+        if "output_batches" in c:
+            stats.append(f"batches={c['output_batches']}")
+        stats.append(f"time={incl_ms:.1f}ms")
+        stats.append(f"self={excl_ms:.1f}ms")
+        for k, v in sorted(c.items()):
+            if k not in (
+                "output_rows", "output_batches", "elapsed_compute"
+            ):
+                stats.append(f"{k}={v}")
+        lines.append(
+            f"{'  ' * depth}{node.name}  [{', '.join(stats)}]"
+        )
+        for ch in node.children:
+            walk(ch, depth + 1)
+
+    for ch in root.children:
+        walk(ch, 0)
+    return indent + ("\n" + indent).join(lines) if lines else ""

@@ -159,11 +159,26 @@ def _worker_main(coordinator: str, num_processes: int, process_id: int,
     return 0
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def launch_local(num_processes: int = 2, devices_per_process: int = 4,
-                 port: int = 19733, timeout: float = 300.0):
+                 port: Optional[int] = None, timeout: float = 300.0):
     """Spawn num_processes local workers (one-per-host stand-in); each
     contributes devices_per_process virtual CPU devices to the global
-    mesh. Returns the list of per-process JSON results."""
+    mesh. Returns the list of per-process JSON results. Fails FAST with
+    the real worker error: a crashed rank leaves its peers blocked in
+    the distributed barrier, so the driver polls all ranks instead of
+    waiting out the timeout on rank order."""
+    import time as _time
+
+    if port is None:
+        port = _free_port()  # fixed ports collide across racing runs
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
@@ -188,26 +203,43 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
                 text=True,
             )
         )
-    results = []
-    errors = []
+    outputs: dict = {}
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            if p.returncode != 0:
-                errors.append(out[-2000:])
-                continue
-            for line in reversed(out.splitlines()):
-                if line.startswith("{"):
-                    results.append(json.loads(line))
-                    break
+        deadline = _time.monotonic() + timeout
+        pending = set(range(num_processes))
+        failed = None
+        while pending and _time.monotonic() < deadline:
+            for i in sorted(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                outputs[i] = procs[i].communicate()[0]
+                pending.discard(i)
+                if rc != 0 and failed is None:
+                    failed = i
+            if failed is not None:
+                raise RuntimeError(
+                    f"worker {failed} failed:\n"
+                    + outputs[failed][-2000:]
+                )
+            if pending:
+                _time.sleep(0.05)
+        if pending:
+            raise TimeoutError(
+                f"workers {sorted(pending)} still running after "
+                f"{timeout}s"
+            )
     finally:
-        # a crashed peer leaves the others blocked in the distributed
-        # barrier holding the coordinator port - never orphan them
+        # never orphan workers blocked in the distributed barrier
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    if errors:
-        raise RuntimeError("worker failed:\n" + "\n---\n".join(errors))
+    results = []
+    for i in range(num_processes):
+        for line in reversed(outputs[i].splitlines()):
+            if line.startswith("{"):
+                results.append(json.loads(line))
+                break
     return results
 
 

@@ -7,8 +7,7 @@ from blaze_tpu.runtime.launcher import launch_local
 
 
 def test_two_process_global_mesh_groupby():
-    results = launch_local(num_processes=2, devices_per_process=4,
-                           port=19741)
+    results = launch_local(num_processes=2, devices_per_process=4)
     assert len(results) == 2
     for r in results:
         assert r["ok"] and r["global_devices"] == 8

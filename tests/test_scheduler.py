@@ -119,3 +119,36 @@ def test_instrumented_metric_tree():
     assert filt["output_rows"] == 60
     assert proj["output_rows"] == 60
     assert proj["elapsed_compute"] > 0
+
+
+def test_exclusive_time_and_rendering():
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops import FilterExec, ProjectExec
+    from blaze_tpu.ops.base import MetricNode
+    from blaze_tpu.runtime.executor import run_plan
+    from blaze_tpu.runtime.instrument import (
+        exclusive_elapsed,
+        instrument,
+        render_metrics,
+    )
+
+    scan = multi_scan(2, 30)
+    plan = ProjectExec(
+        FilterExec(scan, Col("a") % 2 == 0), [(Col("a") + 1, "a1")]
+    )
+    root = MetricNode("root")
+    wrapped = instrument(plan, root)
+    run_plan(wrapped)
+    proj_node = root.children[0]
+    filt_node = proj_node.children[0]
+    # exclusive = inclusive - children's inclusive, never negative
+    assert exclusive_elapsed(proj_node) <= proj_node.counters[
+        "elapsed_compute"
+    ]
+    assert exclusive_elapsed(filt_node) >= 0
+    text = render_metrics(root)
+    lines = text.splitlines()
+    assert lines[0].startswith("ProjectExec")
+    assert "  FilterExec" in lines[1]
+    assert "self=" in lines[0] and "time=" in lines[0]
+    assert "rows=60" in lines[0]
