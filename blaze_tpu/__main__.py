@@ -41,24 +41,18 @@ def cmd_run_task(args) -> int:
         blob = f.read()
     ctx = ExecContext()
     total = 0
+    # ONE production decode path; --metrics only adds the mirrored
+    # metric tree (the reference's Spark-UI panel, metrics.rs:32-56)
+    op, partition = decode_task(blob, ctx)
+    root = MetricNode("root")
     if args.metrics:
-        # per-operator metric tree (the reference's Spark-UI panel,
-        # metrics.rs:32-56): the ONE production decode path, then wrap
-        op, partition = decode_task(blob, ctx)
-        root = MetricNode("root")
-        wrapped = instrument(op, root)
-        for rb in execute_partition(wrapped, partition, ctx):
-            total += rb.num_rows
-            if not args.quiet:
-                print(rb.to_pandas().to_string(max_rows=20))
+        op = instrument(op, root)
+    for rb in execute_partition(op, partition, ctx):
+        total += rb.num_rows
+        if not args.quiet:
+            print(rb.to_pandas().to_string(max_rows=20))
+    if args.metrics:
         print(render_metrics(root), file=sys.stderr)
-    else:
-        from blaze_tpu.runtime.executor import execute_task
-
-        for rb in execute_task(blob, ctx):
-            total += rb.num_rows
-            if not args.quiet:
-                print(rb.to_pandas().to_string(max_rows=20))
     # metrics push after stream end (reference metrics.rs:32-56)
     print(f"-- {total} rows", file=sys.stderr)
     print(json.dumps(ctx.metrics.flatten()), file=sys.stderr)

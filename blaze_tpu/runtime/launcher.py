@@ -187,8 +187,18 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
     env["JAX_PLATFORMS"] = "cpu"
     env["BLAZE_LAUNCH_PLATFORM"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    import tempfile
+
     procs = []
+    logs = []
     for pid in range(num_processes):
+        # file-backed stdout: a chatty worker can never fill a pipe
+        # buffer and deadlock the barrier
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", prefix=f"blz-launch-{pid}-", suffix=".log",
+            delete=False,
+        )
+        logs.append(log)
         procs.append(
             subprocess.Popen(
                 [
@@ -198,30 +208,30 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
                     str(devices_per_process),
                 ],
                 env=env,
-                stdout=subprocess.PIPE,
+                stdout=log,
                 stderr=subprocess.STDOUT,
                 text=True,
             )
         )
-    outputs: dict = {}
+
+    def read_log(i: int) -> str:
+        logs[i].flush()
+        with open(logs[i].name) as f:
+            return f.read()
+
     try:
         deadline = _time.monotonic() + timeout
         pending = set(range(num_processes))
-        failed = None
         while pending and _time.monotonic() < deadline:
             for i in sorted(pending):
                 rc = procs[i].poll()
                 if rc is None:
                     continue
-                outputs[i] = procs[i].communicate()[0]
                 pending.discard(i)
-                if rc != 0 and failed is None:
-                    failed = i
-            if failed is not None:
-                raise RuntimeError(
-                    f"worker {failed} failed:\n"
-                    + outputs[failed][-2000:]
-                )
+                if rc != 0:
+                    raise RuntimeError(
+                        f"worker {i} failed:\n" + read_log(i)[-2000:]
+                    )
             if pending:
                 _time.sleep(0.05)
         if pending:
@@ -229,18 +239,24 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
                 f"workers {sorted(pending)} still running after "
                 f"{timeout}s"
             )
+        results = []
+        for i in range(num_processes):
+            for line in reversed(read_log(i).splitlines()):
+                if line.startswith("{"):
+                    results.append(json.loads(line))
+                    break
+        return results
     finally:
         # never orphan workers blocked in the distributed barrier
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    results = []
-    for i in range(num_processes):
-        for line in reversed(outputs[i].splitlines()):
-            if line.startswith("{"):
-                results.append(json.loads(line))
-                break
-    return results
+        for log in logs:
+            log.close()
+            try:
+                os.unlink(log.name)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""TPC-DS q1-q20 whole-query differential matrix.
+"""TPC-DS q1-q27 whole-query differential matrix (q23/q24 deferred).
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -701,3 +701,147 @@ def oracle_q14(t):
 
 
 ORACLES["q14"] = oracle_q14
+
+
+# ---------------------------------------------------------------------------
+# q21-q27 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q21(t):
+    pivot = 500
+    dd = t["date_dim"]
+    dd = dd[(dd.d_date_sk >= pivot - 30) & (dd.d_date_sk <= pivot + 30)]
+    j = _merge(t["inventory"], dd[["d_date_sk"]],
+               "inv_date_sk", "d_date_sk")
+    j = j.merge(t["warehouse"][["w_warehouse_sk", "w_warehouse_name"]],
+                left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="inv_item_sk", right_on="i_item_sk")
+    j["before"] = j.inv_quantity_on_hand.where(j.inv_date_sk < pivot, 0)
+    j["after"] = j.inv_quantity_on_hand.where(j.inv_date_sk >= pivot, 0)
+    agg = (
+        j.groupby(["w_warehouse_name", "i_item_id"])
+        .agg(inv_before=("before", "sum"), inv_after=("after", "sum"))
+        .reset_index()
+    )
+    agg = agg[agg.inv_before > 0]
+    r = agg.inv_after / agg.inv_before
+    agg = agg[(r >= 2.0 / 3.0) & (r <= 3.0 / 2.0)]
+    return agg.sort_values(["w_warehouse_name", "i_item_id"]).head(
+        100).reset_index(drop=True)
+
+
+def oracle_q22(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_month_seq >= 1188) & (dd.d_month_seq <= 1199)]
+    j = _merge(t["inventory"], dd[["d_date_sk"]],
+               "inv_date_sk", "d_date_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_brand", "i_manufact_id"]],
+                left_on="inv_item_sk", right_on="i_item_sk")
+    detail = (
+        j.groupby(["i_brand", "i_manufact_id"])
+        .inv_quantity_on_hand.mean().reset_index(name="qoh")
+        .rename(columns={"i_brand": "brand",
+                         "i_manufact_id": "manufact_id"})
+    )
+    by_brand = (
+        j.groupby("i_brand").inv_quantity_on_hand.mean()
+        .reset_index(name="qoh").rename(columns={"i_brand": "brand"})
+    )
+    by_brand.insert(1, "manufact_id", pd.NA)
+    grand = pd.DataFrame(
+        [{"brand": pd.NA, "manufact_id": pd.NA,
+          "qoh": j.inv_quantity_on_hand.mean()}]
+    )
+    return pd.concat([detail, by_brand, grand], ignore_index=True)[
+        ["brand", "manufact_id", "qoh"]
+    ]
+
+
+def oracle_q25(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1998]
+    ss = _merge(t["store_sales"], dd[["d_date_sk"]],
+                "ss_sold_date_sk", "d_date_sk")
+    sr = t["store_returns"]
+    j = _merge(sr, ss, ["sr_customer_sk", "sr_item_sk"],
+               ["ss_customer_sk", "ss_item_sk"])
+    cs = t["catalog_sales"]
+    j = _merge(cs, j, ["cs_bill_customer_sk", "cs_item_sk"],
+               ["sr_customer_sk", "sr_item_sk"])
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby("i_item_id")
+        .agg(store_profit=("ss_net_profit", "sum"),
+             return_loss=("sr_net_loss", "sum"),
+             catalog_sales=("cs_ext_sales_price", "sum"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q26(t):
+    cd = t["customer_demographics"]
+    cd = cd[(cd.cd_gender == "F") & (cd.cd_marital_status == "M")
+            & (cd.cd_education_status == "4 yr Degree")]
+    pr = t["promotion"]
+    pr = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")]
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    j = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+               "cs_sold_date_sk", "d_date_sk")
+    j = j.merge(cd[["cd_demo_sk"]], left_on="cs_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(pr[["p_promo_sk"]], left_on="cs_promo_sk",
+                right_on="p_promo_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby("i_item_id")
+        .agg(agg1=("cs_quantity", "mean"),
+             agg2=("cs_list_price", "mean"),
+             agg3=("cs_coupon_amt", "mean"),
+             agg4=("cs_sales_price", "mean"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q27(t):
+    cd = t["customer_demographics"]
+    cd = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+            & (cd.cd_education_status == "College")]
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(cd[["cd_demo_sk"]], left_on="ss_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_state"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    detail = (
+        j.groupby(["i_item_id", "s_state"], dropna=False)
+        .agg(agg1=("ss_quantity", "mean"),
+             agg2=("ss_list_price", "mean"))
+        .reset_index()
+    )
+    by_item = (
+        j.groupby("i_item_id")
+        .agg(agg1=("ss_quantity", "mean"),
+             agg2=("ss_list_price", "mean"))
+        .reset_index()
+    )
+    by_item.insert(1, "s_state", pd.NA)
+    grand = pd.DataFrame(
+        [{"i_item_id": pd.NA, "s_state": pd.NA,
+          "agg1": j.ss_quantity.mean(), "agg2": j.ss_list_price.mean()}]
+    )
+    return pd.concat([detail, by_item, grand], ignore_index=True)[
+        ["i_item_id", "s_state", "agg1", "agg2"]
+    ]
+
+
+ORACLES.update({
+    "q21": oracle_q21, "q22": oracle_q22, "q25": oracle_q25,
+    "q26": oracle_q26, "q27": oracle_q27,
+})

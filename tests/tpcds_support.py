@@ -3,7 +3,7 @@
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for q1-q20: each query
+38-57). This module is that harness engine side for q1-q27 (q23/q24 deferred): each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -1423,3 +1423,309 @@ def q14(s, flavor):
 
 
 QUERIES["q14"] = q14
+
+
+# ---------------------------------------------------------------------------
+# q21-q27 block (inventory/warehouse tier; q23/q24's multi-CTE monsters
+# are deferred like q14's full 3-key variant)
+# ---------------------------------------------------------------------------
+
+N_WAREHOUSES = 6
+
+
+def gen_inventory_tables(seed: int = 20260730):
+    """inventory + warehouse, deterministic; appended to gen_tables()."""
+    rng = np.random.default_rng(seed)
+    n_inv = max(N_SALES // 5, 2000)
+    warehouse = pd.DataFrame(
+        {
+            "w_warehouse_sk": np.arange(N_WAREHOUSES, dtype=np.int32),
+            "w_warehouse_name": [
+                f"warehouse_{i}" for i in range(N_WAREHOUSES)
+            ],
+            "w_state": pick_from(
+                ["TN", "GA", "CA"], N_WAREHOUSES, rng
+            ),
+        }
+    )
+    inventory = pd.DataFrame(
+        {
+            "inv_date_sk": rng.integers(0, N_DATES, n_inv).astype(
+                np.int32),
+            "inv_item_sk": rng.integers(0, N_ITEMS, n_inv).astype(
+                np.int32),
+            "inv_warehouse_sk": rng.integers(
+                0, N_WAREHOUSES, n_inv).astype(np.int32),
+            "inv_quantity_on_hand": rng.integers(
+                0, 1000, n_inv).astype(np.int32),
+        }
+    )
+    return {"warehouse": warehouse, "inventory": inventory}
+
+
+def pick_from(values, size, rng):
+    idx = rng.integers(0, len(values), size)
+    return np.array([values[i] for i in idx], dtype=object)
+
+
+_BASE_GEN_TABLES = gen_tables
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend the base set
+    t = _BASE_GEN_TABLES(seed)
+    t.update(gen_inventory_tables(seed + 2))
+    # q26 columns the base catalog_sales generator omits
+    cs = t["catalog_sales"]
+    rng = np.random.default_rng(seed + 1)
+    n_cs = len(cs)
+    cs["cs_cdemo_sk"] = rng.integers(0, N_CDEMO, n_cs).astype(np.int32)
+    cs["cs_promo_sk"] = rng.integers(0, N_PROMOS, n_cs).astype(np.int32)
+    cs["cs_quantity"] = rng.integers(1, 101, n_cs).astype(np.int32)
+    cs["cs_list_price"] = np.round(rng.random(n_cs) * 250, 2)
+    cs["cs_coupon_amt"] = np.round(rng.random(n_cs) * 50, 2)
+    cs["cs_sales_price"] = np.round(rng.random(n_cs) * 200, 2)
+    return t
+
+
+def q21(s, flavor):
+    """TPC-DS q21: inventory before/after a pivot date by warehouse and
+    item, keeping items whose after/before ratio is in [2/3, 3/2]."""
+    pivot = 500  # date_sk pivot
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_date_sk") >= pivot - 30)
+            & (Col("d_date_sk") <= pivot + 30),
+        ),
+        s["inventory"](),
+        ["d_date_sk"], ["inv_date_sk"],
+    )
+    j = _join(
+        flavor, s["warehouse"](), j,
+        ["w_warehouse_sk"], ["inv_warehouse_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["inv_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("w_warehouse_name"), "w_warehouse_name"),
+              (Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (
+                AggExpr(
+                    AggFn.SUM,
+                    If(Col("d_date_sk") < pivot,
+                       Col("inv_quantity_on_hand"),
+                       Literal(0, DataType.int64())),
+                ),
+                "inv_before",
+            ),
+            (
+                AggExpr(
+                    AggFn.SUM,
+                    If(Col("d_date_sk") >= pivot,
+                       Col("inv_quantity_on_hand"),
+                       Literal(0, DataType.int64())),
+                ),
+                "inv_after",
+            ),
+        ],
+    )
+    cond = FilterExec(
+        FilterExec(agg, Col("inv_before") > 0),
+        (
+            Col("inv_after").cast(DataType.float64())
+            / Col("inv_before").cast(DataType.float64())
+            >= 2.0 / 3.0
+        )
+        & (
+            Col("inv_after").cast(DataType.float64())
+            / Col("inv_before").cast(DataType.float64())
+            <= 3.0 / 2.0
+        ),
+    )
+    return _sorted_limit(
+        cond,
+        [SortKey(Col("w_warehouse_name"), True, True),
+         SortKey(Col("i_item_id"), True, True)],
+        100,
+    )
+
+
+def q22(s, flavor):
+    """TPC-DS q22 (rollup as grouping-set union): average quantity on
+    hand by (brand, manufact) with brand and grand totals."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_month_seq") >= 1188) & (Col("d_month_seq") <= 1199),
+        ),
+        s["inventory"](),
+        ["d_date_sk"], ["inv_date_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["inv_item_sk"])
+    detail = _agg(
+        j,
+        keys=[(Col("i_brand"), "brand"),
+              (Col("i_manufact_id"), "manufact_id")],
+        aggs=[(AggExpr(AggFn.AVG, Col("inv_quantity_on_hand")), "qoh")],
+    )
+    by_brand = ProjectExec(
+        _agg(
+            j,
+            keys=[(Col("i_brand"), "brand")],
+            aggs=[(AggExpr(AggFn.AVG, Col("inv_quantity_on_hand")),
+                   "qoh")],
+        ),
+        [(Col("brand"), "brand"),
+         (Literal(None, DataType.int32()), "manufact_id"),
+         (Col("qoh"), "qoh")],
+    )
+    grand = ProjectExec(
+        _agg(
+            j, keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("inv_quantity_on_hand")),
+                   "qoh")],
+        ),
+        [(Literal(None, DataType.utf8()), "brand"),
+         (Literal(None, DataType.int32()), "manufact_id"),
+         (Col("qoh"), "qoh")],
+    )
+    detail_out = _project_names(detail, ["brand", "manufact_id", "qoh"])
+    return _union([detail_out, by_brand, grand])
+
+
+def q25(s, flavor):
+    """TPC-DS q25 shape: customers who bought in store, returned, then
+    bought the same item from the catalog - 3-way join on (customer,
+    item), grouped by item."""
+    ss = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1998),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    sr = s["store_returns"]()
+    j = _join(
+        flavor, sr, ss,
+        ["sr_customer_sk", "sr_item_sk"],
+        ["ss_customer_sk", "ss_item_sk"],
+    )
+    cs = s["catalog_sales"]()
+    j = _join(
+        flavor, cs, j,
+        ["cs_bill_customer_sk", "cs_item_sk"],
+        ["sr_customer_sk", "sr_item_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("ss_net_profit")), "store_profit"),
+            (AggExpr(AggFn.SUM, Col("sr_net_loss")), "return_loss"),
+            (AggExpr(AggFn.SUM, Col("cs_ext_sales_price")),
+             "catalog_sales"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def _demo_item_avgs(s, flavor, prefix, table, cdemo_col, promo_col):
+    """q7/q26 shape for any channel."""
+    demo = FilterExec(
+        s["customer_demographics"](),
+        (Col("cd_gender") == "F")
+        & (Col("cd_marital_status") == "M")
+        & (Col("cd_education_status") == "4 yr Degree"),
+    )
+    promos = FilterExec(
+        s["promotion"](),
+        (Col("p_channel_email") == "N") | (Col("p_channel_event") == "N"),
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 2000),
+        s[table](),
+        ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+    )
+    j = _join(flavor, demo, j, ["cd_demo_sk"], [cdemo_col])
+    j = _join(flavor, promos, j, ["p_promo_sk"], [promo_col])
+    j = _join(flavor, s["item"](), j, ["i_item_sk"],
+              [f"{prefix}_item_sk"])
+    return j
+
+
+def q26(s, flavor):
+    """TPC-DS q26: catalog-channel demographic item averages."""
+    j = _demo_item_avgs(
+        s, flavor, "cs", "catalog_sales", "cs_cdemo_sk", "cs_promo_sk"
+    )
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (AggExpr(AggFn.AVG, Col("cs_quantity")), "agg1"),
+            (AggExpr(AggFn.AVG, Col("cs_list_price")), "agg2"),
+            (AggExpr(AggFn.AVG, Col("cs_coupon_amt")), "agg3"),
+            (AggExpr(AggFn.AVG, Col("cs_sales_price")), "agg4"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def q27(s, flavor):
+    """TPC-DS q27 (rollup as grouping-set union): store-channel
+    demographic item averages by (item, state) + state/grand totals."""
+    demo = FilterExec(
+        s["customer_demographics"](),
+        (Col("cd_gender") == "M")
+        & (Col("cd_marital_status") == "S")
+        & (Col("cd_education_status") == "College"),
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 2000),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, demo, j, ["cd_demo_sk"], ["ss_cdemo_sk"])
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+
+    def level(key_exprs):
+        return _agg(
+            j,
+            keys=key_exprs,
+            aggs=[(AggExpr(AggFn.AVG, Col("ss_quantity")), "agg1"),
+                  (AggExpr(AggFn.AVG, Col("ss_list_price")), "agg2")],
+        )
+
+    detail = _project_names(
+        level([(Col("i_item_id"), "i_item_id"),
+               (Col("s_state"), "s_state")]),
+        ["i_item_id", "s_state", "agg1", "agg2"],
+    )
+    by_item = ProjectExec(
+        level([(Col("i_item_id"), "i_item_id")]),
+        [(Col("i_item_id"), "i_item_id"),
+         (Literal(None, DataType.utf8()), "s_state"),
+         (Col("agg1"), "agg1"), (Col("agg2"), "agg2")],
+    )
+    grand = ProjectExec(
+        level([]),
+        [(Literal(None, DataType.utf8()), "i_item_id"),
+         (Literal(None, DataType.utf8()), "s_state"),
+         (Col("agg1"), "agg1"), (Col("agg2"), "agg2")],
+    )
+    return _union([detail, by_item, grand])
+
+
+QUERIES.update({
+    "q21": q21, "q22": q22, "q25": q25, "q26": q26, "q27": q27,
+})
