@@ -1,4 +1,4 @@
-"""TPC-DS q1-q27 whole-query differential matrix (q23/q24 deferred).
+"""TPC-DS q1-q33 whole-query differential matrix (q23/q24/q31 deferred).
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -844,4 +844,132 @@ def oracle_q27(t):
 ORACLES.update({
     "q21": oracle_q21, "q22": oracle_q22, "q25": oracle_q25,
     "q26": oracle_q26, "q27": oracle_q27,
+})
+
+
+# ---------------------------------------------------------------------------
+# q28-q33 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q28(t):
+    ss = t["store_sales"]
+    buckets = [(0, 50), (50, 100), (100, 150), (150, 200), (200, 250),
+               (0, 250)]
+    rows = []
+    for i, (lo, hi) in enumerate(buckets):
+        sel = ss[(ss.ss_list_price >= lo) & (ss.ss_list_price < hi)]
+        rows.append(
+            {
+                "bucket": i,
+                "avg_p": sel.ss_list_price.mean(),
+                "cnt": len(sel),
+                "distinct_cnt": sel.ss_list_price.nunique(),
+            }
+        )
+    return pd.DataFrame(rows)
+
+
+def oracle_q29(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    ss = _merge(t["store_sales"], dd[["d_date_sk"]],
+                "ss_sold_date_sk", "d_date_sk")
+    j = _merge(t["store_returns"], ss,
+               ["sr_customer_sk", "sr_item_sk"],
+               ["ss_customer_sk", "ss_item_sk"])
+    j = _merge(t["catalog_sales"], j,
+               ["cs_bill_customer_sk", "cs_item_sk"],
+               ["sr_customer_sk", "sr_item_sk"])
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby("i_item_id")
+        .agg(store_qty=("ss_quantity", "sum"),
+             paths=("ss_quantity", "size"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q30(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    wr = _merge(t["web_returns"], dd[["d_date_sk"]],
+                "wr_returned_date_sk", "d_date_sk")
+    wr = _merge(wr, t["customer"][["c_customer_sk", "c_customer_id",
+                                   "c_current_addr_sk"]],
+                "wr_returning_customer_sk", "c_customer_sk")
+    wr = wr.merge(t["customer_address"][["ca_address_sk", "ca_state"]],
+                  left_on="c_current_addr_sk",
+                  right_on="ca_address_sk")
+    ctr = (
+        wr.groupby(["c_customer_sk", "c_customer_id", "ca_state"],
+                   dropna=False)
+        .wr_return_amt.sum().reset_index(name="ctr_total_return")
+    )
+    avg = (
+        ctr.groupby("ca_state", dropna=False)
+        .ctr_total_return.mean().reset_index(name="avg_r")
+    )
+    # engine joins on state: NULL state never matches (SQL), so rows
+    # with NULL state drop out of the threshold comparison
+    m = ctr.dropna(subset=["ca_state"]).merge(
+        avg.dropna(subset=["ca_state"]), on="ca_state"
+    )
+    m = m[m.ctr_total_return > 1.2 * m.avg_r]
+    out = m.sort_values("c_customer_id").head(100)
+    return out[["c_customer_id", "ctr_total_return"]].reset_index(
+        drop=True)
+
+
+def oracle_q32(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy <= 3)]
+    cs = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+                "cs_sold_date_sk", "d_date_sk")
+    thr = (
+        cs.groupby("cs_item_sk").cs_ext_discount_amt.mean()
+        .reset_index(name="avg_disc")
+    )
+    m = cs.merge(thr, on="cs_item_sk")
+    m = m[m.cs_ext_discount_amt > 1.3 * m.avg_disc]
+    return pd.DataFrame(
+        [{"excess_discount": m.cs_ext_discount_amt.sum()}]
+    )
+
+
+def oracle_q33(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy == 3)][["d_date_sk"]]
+    it = t["item"][t["item"].i_category == "Books"][
+        ["i_item_sk", "i_manufact_id"]]
+
+    def channel(df, date_col, item_col, price_col):
+        j = _merge(df, dd, date_col, "d_date_sk")
+        j = j.merge(it, left_on=item_col, right_on="i_item_sk")
+        return (
+            j.groupby("i_manufact_id")[price_col].sum()
+            .reset_index(name="total_sales")
+        )
+
+    all_ch = pd.concat(
+        [
+            channel(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+                    "ss_ext_sales_price"),
+            channel(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+                    "cs_ext_sales_price"),
+            channel(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+                    "ws_ext_sales_price"),
+        ],
+        ignore_index=True,
+    )
+    agg = (
+        all_ch.groupby("i_manufact_id").total_sales.sum().reset_index()
+    )
+    agg = agg.sort_values(["total_sales", "i_manufact_id"],
+                          ascending=[False, True]).head(100)
+    return agg[["i_manufact_id", "total_sales"]].reset_index(drop=True)
+
+
+ORACLES.update({
+    "q28": oracle_q28, "q29": oracle_q29, "q30": oracle_q30,
+    "q32": oracle_q32, "q33": oracle_q33,
 })

@@ -3,7 +3,7 @@
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for q1-q27 (q23/q24 deferred): each query
+38-57). This module is that harness engine side for q1-q33 (q23/q24/q31 deferred): each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -1484,6 +1484,16 @@ def gen_tables(seed: int = 20260729):  # noqa: F811 - extend the base set
     cs["cs_list_price"] = np.round(rng.random(n_cs) * 250, 2)
     cs["cs_coupon_amt"] = np.round(rng.random(n_cs) * 50, 2)
     cs["cs_sales_price"] = np.round(rng.random(n_cs) * 200, 2)
+    # q30 columns the base web_returns generator omits
+    wr = t["web_returns"]
+    n_wr = len(wr)
+    wr["wr_returning_customer_sk"] = pd.array(
+        np.where(
+            rng.random(n_wr) < 0.02, np.nan,
+            rng.integers(0, N_CUSTOMERS, n_wr).astype(np.float64),
+        ),
+        dtype=pd.Int32Dtype(),
+    )
     return t
 
 
@@ -1728,4 +1738,217 @@ def q27(s, flavor):
 
 QUERIES.update({
     "q21": q21, "q22": q22, "q25": q25, "q26": q26, "q27": q27,
+})
+
+
+# ---------------------------------------------------------------------------
+# q28-q33 block (q31's county quarter matrix deferred)
+# ---------------------------------------------------------------------------
+
+def q28(s, flavor):
+    """TPC-DS q28 shape: per price-bucket average / count / distinct
+    count of list prices (COUNT DISTINCT via the distinct-group-by
+    rewrite), unioned into one row set."""
+    buckets = [(0, 50), (50, 100), (100, 150), (150, 200), (200, 250),
+               (0, 250)]
+
+    def bucket(i, lo, hi):
+        f = FilterExec(
+            s["store_sales"](),
+            (Col("ss_list_price") >= float(lo))
+            & (Col("ss_list_price") < float(hi)),
+        )
+        stats = ProjectExec(
+            _agg(
+                f, keys=[],
+                aggs=[(AggExpr(AggFn.AVG, Col("ss_list_price")), "avg_p"),
+                      (AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+            ),
+            [(Literal(i, DataType.int32()), "bucket"),
+             (Col("avg_p"), "avg_p"), (Col("cnt"), "cnt"),
+             (Literal(1, DataType.int32()), "k")],
+        )
+        distinct = ProjectExec(
+            _agg(
+                _agg(
+                    f,  # same filter node feeds both branches
+                    keys=[(Col("ss_list_price"), "p")],
+                    aggs=[],
+                ),
+                keys=[],
+                aggs=[(AggExpr(AggFn.COUNT_STAR, None), "distinct_cnt")],
+            ),
+            [(Col("distinct_cnt"), "distinct_cnt"),
+             (Literal(1, DataType.int32()), "k2")],
+        )
+        joined = _join(flavor, stats, distinct, ["k"], ["k2"])
+        return _project_names(
+            joined, ["bucket", "avg_p", "cnt", "distinct_cnt"]
+        )
+
+    return _union([bucket(i, lo, hi)
+                   for i, (lo, hi) in enumerate(buckets)])
+
+
+def q29(s, flavor):
+    """TPC-DS q29 shape: quantity flows for store-sold, returned, then
+    catalog-repurchased items (q25's join spine, quantity sums)."""
+    ss = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor, s["store_returns"](), ss,
+        ["sr_customer_sk", "sr_item_sk"],
+        ["ss_customer_sk", "ss_item_sk"],
+    )
+    j = _join(
+        flavor, s["catalog_sales"](), j,
+        ["cs_bill_customer_sk", "cs_item_sk"],
+        ["sr_customer_sk", "sr_item_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("ss_quantity")), "store_qty"),
+            (AggExpr(AggFn.COUNT_STAR, None), "paths"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def q30(s, flavor):
+    """TPC-DS q30: web-return customers above 1.2x their state's
+    average total return (q1's decorrelation over the web channel,
+    grouped by customer state)."""
+    wr = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["web_returns"](),
+        ["d_date_sk"], ["wr_returned_date_sk"],
+    )
+    wr = _join(
+        flavor, s["customer"](), wr,
+        ["c_customer_sk"], ["wr_returning_customer_sk"],
+    )
+    wr = _join(
+        flavor, s["customer_address"](), wr,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    ctr = _agg(
+        wr,
+        keys=[(Col("c_customer_sk"), "ctr_customer_sk"),
+              (Col("c_customer_id"), "ctr_customer_id"),
+              (Col("ca_state"), "ctr_state")],
+        aggs=[(AggExpr(AggFn.SUM, Col("wr_return_amt")),
+               "ctr_total_return")],
+    )
+    avg_by_state = ProjectExec(
+        _agg(
+            ctr,
+            keys=[(Col("ctr_state"), "avg_state")],
+            aggs=[(AggExpr(AggFn.AVG, Col("ctr_total_return")),
+                   "avg_r")],
+        ),
+        [(Col("avg_state"), "avg_state"),
+         (Col("avg_r") * 1.2, "threshold")],
+    )
+    over = FilterExec(
+        _join(flavor, avg_by_state, ctr, ["avg_state"], ["ctr_state"]),
+        Col("ctr_total_return") > Col("threshold"),
+    )
+    return _sorted_limit(
+        _project_names(over, ["ctr_customer_id", "ctr_total_return"]),
+        [SortKey(Col("ctr_customer_id"), True, True)],
+        100,
+    )
+
+
+def q32(s, flavor):
+    """TPC-DS q32: catalog discounts exceeding 1.3x the item's average
+    discount in a window (scalar subquery decorrelated per item)."""
+    cs = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") <= 3),
+        ),
+        s["catalog_sales"](),
+        ["d_date_sk"], ["cs_sold_date_sk"],
+    )
+    thresholds = ProjectExec(
+        _agg(
+            cs,
+            keys=[(Col("cs_item_sk"), "t_item_sk")],
+            aggs=[(AggExpr(AggFn.AVG, Col("cs_ext_discount_amt")),
+                   "avg_disc")],
+        ),
+        [(Col("t_item_sk"), "t_item_sk"),
+         (Col("avg_disc") * 1.3, "threshold")],
+    )
+    over = FilterExec(
+        _join(flavor, thresholds, cs, ["t_item_sk"], ["cs_item_sk"]),
+        Col("cs_ext_discount_amt") > Col("threshold"),
+    )
+    return _agg(
+        over,
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("cs_ext_discount_amt")),
+               "excess_discount")],
+    )
+
+
+def q33(s, flavor):
+    """TPC-DS q33: manufacturer revenue for one category/month summed
+    over all three channels (per-channel aggregates unioned, re-summed
+    by manufacturer)."""
+    def channel(prefix, table):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") == 3),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(
+            flavor,
+            FilterExec(s["item"](), Col("i_category") == "Books"),
+            j,
+            ["i_item_sk"], [f"{prefix}_item_sk"],
+        )
+        return _agg(
+            j,
+            keys=[(Col("i_manufact_id"), "i_manufact_id")],
+            aggs=[(AggExpr(AggFn.SUM, Col(f"{prefix}_ext_sales_price")),
+                   "total_sales")],
+        )
+
+    all_ch = _union([
+        channel("ss", "store_sales"),
+        channel("cs", "catalog_sales"),
+        channel("ws", "web_sales"),
+    ])
+    agg = _agg(
+        all_ch,
+        keys=[(Col("i_manufact_id"), "i_manufact_id")],
+        aggs=[(AggExpr(AggFn.SUM, Col("total_sales")), "total_sales")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("total_sales"), False, False),
+         SortKey(Col("i_manufact_id"), True, True)],
+        100,
+    )
+
+
+QUERIES.update({
+    "q28": q28, "q29": q29, "q30": q30, "q32": q32, "q33": q33,
 })
