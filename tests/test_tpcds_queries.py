@@ -1,4 +1,4 @@
-"""TPC-DS q1-q33 whole-query differential matrix (q23/q24/q31 deferred).
+"""TPC-DS q1-q40 whole-query differential matrix (q23/q24/q31/q35/q39 deferred).
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -972,4 +972,133 @@ def oracle_q33(t):
 ORACLES.update({
     "q28": oracle_q28, "q29": oracle_q29, "q30": oracle_q30,
     "q32": oracle_q32, "q33": oracle_q33,
+})
+
+
+# ---------------------------------------------------------------------------
+# q34-q40 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q34(t):
+    hd = t["household_demographics"]
+    hd = hd[hd.hd_buy_potential.isin([">10000", "0-500"])]
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(hd[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    tick = (
+        j.groupby(["ss_ticket_number", "ss_customer_sk"], dropna=False)
+        .size().reset_index(name="cnt")
+    )
+    tick = tick[(tick.cnt >= 3) & (tick.cnt <= 8)]
+    named = _merge(
+        tick,
+        t["customer"][["c_customer_sk", "c_last_name",
+                       "c_first_name"]],
+        "ss_customer_sk", "c_customer_sk",
+    )
+    out = named.sort_values(
+        ["c_last_name", "c_first_name", "ss_ticket_number"],
+        na_position="first",
+    ).head(1000)
+    return out[["c_last_name", "c_first_name", "ss_ticket_number",
+                "cnt"]].reset_index(drop=True)
+
+
+def oracle_q36(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_category", "i_class"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+
+    def level(keys):
+        if keys:
+            g = j.groupby(keys, dropna=False).agg(
+                profit=("ss_net_profit", "sum"),
+                sales=("ss_ext_sales_price", "sum"),
+            ).reset_index()
+        else:
+            g = pd.DataFrame(
+                [{"profit": j.ss_net_profit.sum(),
+                  "sales": j.ss_ext_sales_price.sum()}]
+            )
+        for n in ("i_category", "i_class"):
+            if n not in g.columns:
+                g[n] = pd.NA
+        g["gross_margin"] = g.profit / g.sales
+        return g[["i_category", "i_class", "gross_margin"]]
+
+    return pd.concat(
+        [level(["i_category", "i_class"]), level(["i_category"]),
+         level([])],
+        ignore_index=True,
+    )
+
+
+def oracle_q37(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_date_sk >= 400) & (dd.d_date_sk <= 460)]
+    inv = _merge(t["inventory"], dd[["d_date_sk"]],
+                 "inv_date_sk", "d_date_sk")
+    inv = inv[(inv.inv_quantity_on_hand >= 100)
+              & (inv.inv_quantity_on_hand <= 500)]
+    it = t["item"][t["item"].i_current_price >= 10.0]
+    j = it.merge(inv[["inv_item_sk"]], left_on="i_item_sk",
+                 right_on="inv_item_sk")
+    sold = set(t["catalog_sales"].cs_item_sk.dropna())
+    j = j[j.i_item_sk.isin(sold)]
+    agg = j[["i_item_id", "i_item_desc",
+             "i_current_price"]].drop_duplicates()
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def oracle_q38(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy <= 2)][["d_date_sk"]]
+
+    def custs(df, date_col, cust_col):
+        j = _merge(df, dd, date_col, "d_date_sk")
+        return set(j[cust_col].dropna())
+
+    inter = (
+        custs(t["store_sales"], "ss_sold_date_sk", "ss_customer_sk")
+        & custs(t["catalog_sales"], "cs_sold_date_sk",
+                "cs_bill_customer_sk")
+        & custs(t["web_sales"], "ws_sold_date_sk",
+                "ws_bill_customer_sk")
+    )
+    return pd.DataFrame([{"num_customers": len(inter)}])
+
+
+def oracle_q40(t):
+    pivot = 700
+    dd = t["date_dim"]
+    dd = dd[(dd.d_date_sk >= pivot - 30) & (dd.d_date_sk <= pivot + 30)]
+    cs = _merge(t["catalog_sales"], dd[["d_date_sk"]],
+                "cs_sold_date_sk", "d_date_sk")
+    cr = t["catalog_returns"][["cr_order_number", "cr_item_sk",
+                               "cr_return_amount"]]
+    j = cs.merge(
+        cr, left_on=["cs_order_number", "cs_item_sk"],
+        right_on=["cr_order_number", "cr_item_sk"], how="left",
+    )
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    j["net"] = j.cs_ext_sales_price - j.cr_return_amount.fillna(0.0)
+    j["before"] = j.net.where(j.d_date_sk < pivot, 0.0)
+    j["after"] = j.net.where(j.d_date_sk >= pivot, 0.0)
+    agg = (
+        j.groupby("i_item_id")
+        .agg(sales_before=("before", "sum"),
+             sales_after=("after", "sum"))
+        .reset_index()
+    )
+    return agg.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+ORACLES.update({
+    "q34": oracle_q34, "q36": oracle_q36, "q37": oracle_q37,
+    "q38": oracle_q38, "q40": oracle_q40,
 })

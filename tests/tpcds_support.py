@@ -3,7 +3,7 @@
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for q1-q33 (q23/q24/q31 deferred): each query
+38-57). This module is that harness engine side for q1-q40 (q23/q24/q31/q35/q39 deferred): each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -27,6 +27,7 @@ from blaze_tpu.exprs import (
     AggExpr,
     AggFn,
     CaseWhen,
+    Coalesce,
     Col,
     If,
     InList,
@@ -1494,7 +1495,41 @@ def gen_tables(seed: int = 20260729):  # noqa: F811 - extend the base set
         ),
         dtype=pd.Int32Dtype(),
     )
+    # q34/q36 columns: tickets, household demographics, item class
+    ss_t = t["store_sales"]
+    n_ss = len(ss_t)
+    ss_t["ss_ticket_number"] = (
+        rng.integers(0, max(n_ss // 8, 1), n_ss).astype(np.int64)
+    )
+    ss_t["ss_hdemo_sk"] = rng.integers(0, N_HDEMO, n_ss).astype(
+        np.int32)
+    it = t["item"]
+    it["i_class"] = np.array(
+        [f"class_{x}" for x in rng.integers(0, 8, len(it))],
+        dtype=object,
+    )
+    t["household_demographics"] = pd.DataFrame(
+        {
+            "hd_demo_sk": np.arange(N_HDEMO, dtype=np.int32),
+            "hd_buy_potential": np.array(
+                [">10000", "5001-10000", "1001-5000", "0-500"],
+                dtype=object,
+            )[np.arange(N_HDEMO) % 4],
+            "hd_dep_count": (np.arange(N_HDEMO) % 7).astype(np.int32),
+            "hd_vehicle_count": (np.arange(N_HDEMO) % 5).astype(
+                np.int32),
+        }
+    )
+    # q40: order numbers linking catalog returns to their sale rows
+    cs["cs_order_number"] = np.arange(len(cs), dtype=np.int64)
+    cr = t["catalog_returns"]
+    order_idx = rng.integers(0, len(cs), len(cr))
+    cr["cr_order_number"] = order_idx.astype(np.int64)
+    cr["cr_item_sk"] = cs["cs_item_sk"].values[order_idx]
     return t
+
+
+N_HDEMO = 120
 
 
 def q21(s, flavor):
@@ -1951,4 +1986,231 @@ def q33(s, flavor):
 
 QUERIES.update({
     "q28": q28, "q29": q29, "q30": q30, "q32": q32, "q33": q33,
+})
+
+
+# ---------------------------------------------------------------------------
+# q34-q40 block (q35/q39 deferred with the other variants)
+# ---------------------------------------------------------------------------
+
+def q34(s, flavor):
+    """TPC-DS q34: customers with 3-8 items on one ticket under chosen
+    buy-potential bands, with names."""
+    hd = FilterExec(
+        s["household_demographics"](),
+        InList(Col("hd_buy_potential"),
+               (Literal(">10000", DataType.utf8()),
+                Literal("0-500", DataType.utf8()))),
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    tickets = FilterExec(
+        _agg(
+            j,
+            keys=[(Col("ss_ticket_number"), "ticket"),
+                  (Col("ss_customer_sk"), "cust_sk")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+        ),
+        (Col("cnt") >= 3) & (Col("cnt") <= 8),
+    )
+    named = _join(
+        flavor, s["customer"](), tickets,
+        ["c_customer_sk"], ["cust_sk"],
+    )
+    return _sorted_limit(
+        _project_names(
+            named, ["c_last_name", "c_first_name", "ticket", "cnt"]
+        ),
+        [SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("c_first_name"), True, True),
+         SortKey(Col("ticket"), True, True)],
+        1000,
+    )
+
+
+def q36(s, flavor):
+    """TPC-DS q36 (rollup as grouping-set union): gross margin ratio by
+    (category, class) with category and grand totals."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+
+    def level(key_exprs, pads):
+        agg = _agg(
+            j,
+            keys=key_exprs,
+            aggs=[(AggExpr(AggFn.SUM, Col("ss_net_profit")), "profit"),
+                  (AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+                   "sales")],
+        )
+        outs = []
+        names = ["i_category", "i_class"]
+        have = [n for _, n in key_exprs]
+        for n in names:
+            if n in have:
+                outs.append((Col(n), n))
+            else:
+                outs.append((Literal(None, DataType.utf8()), n))
+        outs.append(
+            (Col("profit") / Col("sales"), "gross_margin")
+        )
+        return ProjectExec(agg, outs)
+
+    detail = level([(Col("i_category"), "i_category"),
+                    (Col("i_class"), "i_class")], 0)
+    by_cat = level([(Col("i_category"), "i_category")], 1)
+    grand = level([], 2)
+    return _union([detail, by_cat, grand])
+
+
+def q37(s, flavor):
+    """TPC-DS q37: items with 100-500 on-hand inventory in a window
+    that also sold on the catalog channel."""
+    inv = FilterExec(
+        _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_date_sk") >= 400) & (Col("d_date_sk") <= 460),
+            ),
+            s["inventory"](),
+            ["d_date_sk"], ["inv_date_sk"],
+        ),
+        (Col("inv_quantity_on_hand") >= 100)
+        & (Col("inv_quantity_on_hand") <= 500),
+    )
+    items = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_current_price") >= 10.0),
+        inv,
+        ["i_item_sk"], ["inv_item_sk"],
+    )
+    sold = _semi(
+        flavor, items, s["catalog_sales"](),
+        ["i_item_sk"], ["cs_item_sk"],
+    )
+    agg = _agg(
+        sold,
+        keys=[(Col("i_item_id"), "i_item_id"),
+              (Col("i_item_desc"), "i_item_desc"),
+              (Col("i_current_price"), "i_current_price")],
+        aggs=[],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+def q38(s, flavor):
+    """TPC-DS q38: count of customers active in ALL three channels in a
+    window (distinct-intersect via semi-join chain + distinct count)."""
+    def channel_custs(prefix, table, cust_col):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") <= 2),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return _agg(
+            ProjectExec(j, [(Col(cust_col), "cust_sk")]),
+            keys=[(Col("cust_sk"), "cust_sk")],
+            aggs=[],
+        )
+
+    inter = _semi(
+        flavor,
+        _semi(
+            flavor,
+            channel_custs("ss", "store_sales", "ss_customer_sk"),
+            channel_custs("cs", "catalog_sales",
+                          "cs_bill_customer_sk"),
+            ["cust_sk"], ["cust_sk"],
+        ),
+        channel_custs("ws", "web_sales", "ws_bill_customer_sk"),
+        ["cust_sk"], ["cust_sk"],
+    )
+    return _agg(
+        FilterExec(inter, IsNotNull(Col("cust_sk"))),
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "num_customers")],
+    )
+
+
+def q40(s, flavor):
+    """TPC-DS q40: catalog sales net of returns (LEFT JOIN on order+item)
+    by warehouse-less item before/after a pivot date."""
+    pivot = 700
+    cs = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_date_sk") >= pivot - 30)
+            & (Col("d_date_sk") <= pivot + 30),
+        ),
+        s["catalog_sales"](),
+        ["d_date_sk"], ["cs_sold_date_sk"],
+    )
+    cr = ProjectExec(
+        s["catalog_returns"](),
+        [(Col("cr_order_number"), "r_order"),
+         (Col("cr_item_sk"), "r_item"),
+         (Col("cr_return_amount"), "r_amt")],
+    )
+    j = SortMergeJoinExec(
+        cs, cr, ["cs_order_number", "cs_item_sk"],
+        ["r_order", "r_item"], JoinType.LEFT,
+    ) if flavor == "smj" else HashJoinExec(
+        cr, cs, ["r_order", "r_item"],
+        ["cs_order_number", "cs_item_sk"], JoinType.RIGHT,
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["cs_item_sk"])
+    net = ProjectExec(
+        j,
+        [(Col("i_item_id"), "i_item_id"),
+         (Col("d_date_sk"), "d_date_sk"),
+         (Col("cs_ext_sales_price")
+          - Coalesce((Col("r_amt"), Literal(0.0, DataType.float64()))),
+          "net")],
+    )
+    agg = _agg(
+        net,
+        keys=[(Col("i_item_id"), "i_item_id")],
+        aggs=[
+            (
+                AggExpr(
+                    AggFn.SUM,
+                    If(Col("d_date_sk") < pivot, Col("net"),
+                       Literal(0.0, DataType.float64())),
+                ),
+                "sales_before",
+            ),
+            (
+                AggExpr(
+                    AggFn.SUM,
+                    If(Col("d_date_sk") >= pivot, Col("net"),
+                       Literal(0.0, DataType.float64())),
+                ),
+                "sales_after",
+            ),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("i_item_id"), True, True)], 100
+    )
+
+
+QUERIES.update({
+    "q34": q34, "q36": q36, "q37": q37, "q38": q38, "q40": q40,
 })
