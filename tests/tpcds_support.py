@@ -61,6 +61,7 @@ N_STORES = 12
 N_ADDRESSES = 10_000
 N_CDEMO = 500
 N_PROMOS = 30
+N_HDEMO = 120
 
 _STATES = ["TN", "GA", "CA", "TX", "OH", "NY", None]
 _CATEGORIES = ["Books", "Music", "Home", "Sports", "Shoes"]
@@ -1498,9 +1499,19 @@ def gen_tables(seed: int = 20260729):  # noqa: F811 - extend the base set
     # q34/q36 columns: tickets, household demographics, item class
     ss_t = t["store_sales"]
     n_ss = len(ss_t)
-    ss_t["ss_ticket_number"] = (
-        rng.integers(0, max(n_ss // 8, 1), n_ss).astype(np.int64)
+    # a ticket belongs to ONE customer (real baskets): ticket id =
+    # customer * B + basket slot, with B scaled so the mean basket size
+    # stays a few rows at any generator scale (keeps q34's count-band
+    # filter non-vacuous)
+    baskets_per_cust = max(1, n_ss // (N_CUSTOMERS * 5))
+    cust_for_ticket = (
+        t["store_sales"]["ss_customer_sk"].fillna(0).to_numpy(
+            dtype=np.int64)
     )
+    ss_t["ss_ticket_number"] = (
+        cust_for_ticket * baskets_per_cust
+        + rng.integers(0, baskets_per_cust, n_ss)
+    ).astype(np.int64)
     ss_t["ss_hdemo_sk"] = rng.integers(0, N_HDEMO, n_ss).astype(
         np.int32)
     it = t["item"]
@@ -1527,9 +1538,6 @@ def gen_tables(seed: int = 20260729):  # noqa: F811 - extend the base set
     cr["cr_order_number"] = order_idx.astype(np.int64)
     cr["cr_item_sk"] = cs["cs_item_sk"].values[order_idx]
     return t
-
-
-N_HDEMO = 120
 
 
 def q21(s, flavor):
@@ -2044,7 +2052,7 @@ def q36(s, flavor):
     )
     j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
 
-    def level(key_exprs, pads):
+    def level(key_exprs):
         agg = _agg(
             j,
             keys=key_exprs,
@@ -2066,9 +2074,9 @@ def q36(s, flavor):
         return ProjectExec(agg, outs)
 
     detail = level([(Col("i_category"), "i_category"),
-                    (Col("i_class"), "i_class")], 0)
-    by_cat = level([(Col("i_category"), "i_category")], 1)
-    grand = level([], 2)
+                    (Col("i_class"), "i_class")])
+    by_cat = level([(Col("i_category"), "i_category")])
+    grand = level([])
     return _union([detail, by_cat, grand])
 
 
