@@ -7,8 +7,9 @@ the same query (Spark join/NULL semantics hand-enforced: NULL join keys
 never match, NULL groups are kept, AVG ignores NULLs). Comparison is
 order-insensitive where the query's sort key is non-unique.
 
-Scale: BLAZE_TPCDS_ROWS (default 1M store_sales rows; returns/web/
-catalog scale proportionally).
+Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 35 queries
+x 2 flavors keeps the default suite a few minutes; raise to 1M+ for
+scale runs; returns/web/catalog scale proportionally).
 """
 
 import os
@@ -26,7 +27,7 @@ from tests.tpcds_support import QUERIES, gen_tables, scans_of
 def env():
     from blaze_tpu.config import EngineConfig, set_config
 
-    n = int(os.environ.get("BLAZE_TPCDS_ROWS", 1_000_000))
+    n = int(os.environ.get("BLAZE_TPCDS_ROWS", 200_000))
     set_config(
         EngineConfig(
             batch_size=max(n, 1 << 20),

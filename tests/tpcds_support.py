@@ -10,7 +10,8 @@ Spark's optimizer performs) built twice, once with broadcast hash joins
 and once with forced sort-merge joins. Oracles live in
 test_tpcds_queries.py as independent pandas implementations.
 
-Scale is configurable (BLAZE_TPCDS_ROWS, default 1M store_sales rows);
+Scale is configurable (BLAZE_TPCDS_ROWS, default 200k store_sales
+rows - raise to 1M+ for scale runs);
 all generated data is deterministic (seeded) and includes NULL keys.
 """
 
@@ -53,7 +54,7 @@ from blaze_tpu.ops import (
 )
 from blaze_tpu.types import DataType
 
-N_SALES = int(os.environ.get("BLAZE_TPCDS_ROWS", 1_000_000))
+N_SALES = int(os.environ.get("BLAZE_TPCDS_ROWS", 200_000))
 N_DATES = 1461  # 4 years
 N_ITEMS = 2_000
 N_CUSTOMERS = 20_000
