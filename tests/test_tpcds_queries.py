@@ -1,4 +1,4 @@
-"""TPC-DS q1-q40 whole-query differential matrix (q23/q24/q31/q35/q39 deferred).
+"""TPC-DS whole-query differential matrix: 39 queries from q1-q55.
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -7,8 +7,8 @@ the same query (Spark join/NULL semantics hand-enforced: NULL join keys
 never match, NULL groups are kept, AVG ignores NULLs). Comparison is
 order-insensitive where the query's sort key is non-unique.
 
-Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 35 queries
-x 2 flavors keeps the default suite a few minutes; raise to 1M+ for
+Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 39 queries
+x 2 flavors keeps the default suite ~10 minutes; raise to 1M+ for
 scale runs; returns/web/catalog scale proportionally).
 """
 
@@ -1102,4 +1102,83 @@ def oracle_q40(t):
 ORACLES.update({
     "q34": oracle_q34, "q36": oracle_q36, "q37": oracle_q37,
     "q38": oracle_q38, "q40": oracle_q40,
+})
+
+
+# ---------------------------------------------------------------------------
+# q42/q43/q52/q55 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q42(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy == 11)]
+    it = t["item"][t["item"].i_manager_id == 1]
+    j = _merge(t["store_sales"], dd[["d_date_sk", "d_year"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(it[["i_item_sk", "i_category"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby(["d_year", "i_category"], dropna=False)
+        .ss_ext_sales_price.sum().reset_index(name="total")
+    )
+    agg = agg.sort_values(
+        ["total", "d_year", "i_category"],
+        ascending=[False, True, True], na_position="first",
+    ).head(100)
+    return agg[["d_year", "i_category", "total"]].reset_index(drop=True)
+
+
+def oracle_q43(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = _merge(t["store_sales"], dd[["d_date_sk", "d_day_name"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    for d in days:
+        j[f"{d.lower()[:3]}_sales"] = j.ss_ext_sales_price.where(
+            j.d_day_name == d
+        )
+    cols = [f"{d.lower()[:3]}_sales" for d in days]
+    agg = (
+        j.groupby("s_store_name")[cols].sum(min_count=1).reset_index()
+    )
+    return agg.sort_values("s_store_name").head(100).reset_index(
+        drop=True)
+
+
+def _oracle_brand_month(t, mask_fn):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1998) & (dd.d_moy == 12)]
+    it = t["item"][mask_fn(t["item"])]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(it[["i_item_sk", "i_brand_id", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby(["i_brand_id", "i_brand"])
+        .ss_ext_sales_price.sum().reset_index(name="ext_price")
+        .rename(columns={"i_brand_id": "brand_id",
+                         "i_brand": "brand"})
+    )
+    agg = agg.sort_values(["ext_price", "brand_id"],
+                          ascending=[False, True]).head(100)
+    return agg[["brand_id", "brand", "ext_price"]].reset_index(
+        drop=True)
+
+
+def oracle_q52(t):
+    return _oracle_brand_month(t, lambda it: it.i_manager_id == 1)
+
+
+def oracle_q55(t):
+    return _oracle_brand_month(
+        t, lambda it: (it.i_manager_id >= 20) & (it.i_manager_id <= 40)
+    )
+
+
+ORACLES.update({
+    "q42": oracle_q42, "q43": oracle_q43, "q52": oracle_q52,
+    "q55": oracle_q55,
 })

@@ -1,9 +1,10 @@
-"""TPC-DS q1-q10 as engine plan builders over synthetic tables.
+"""TPC-DS queries (39 of q1-q55) as engine plan builders over
+synthetic tables.
 
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for q1-q40 (q23/q24/q31/q35/q39 deferred): each query
+38-57). This module is that harness engine side for 39 queries from q1-q55: each query
 is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
 unions, semi/anti joins, decorrelated subqueries - the same rewrites
 Spark's optimizer performs) built twice, once with broadcast hash joins
@@ -2223,3 +2224,118 @@ def q40(s, flavor):
 QUERIES.update({
     "q34": q34, "q36": q36, "q37": q37, "q38": q38, "q40": q40,
 })
+
+
+# ---------------------------------------------------------------------------
+# q42/q43/q52/q55: reporting variants (category/day-name/brand pivots)
+# ---------------------------------------------------------------------------
+
+def q42(s, flavor):
+    """TPC-DS q42: category revenue for one month."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") == 11),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_manager_id") == 1),
+        j,
+        ["i_item_sk"], ["ss_item_sk"],
+    )
+    agg = _agg(
+        j,
+        keys=[(Col("d_year"), "d_year"),
+              (Col("i_category"), "i_category")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")), "total")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("total"), False, False),
+         SortKey(Col("d_year"), True, True),
+         SortKey(Col("i_category"), True, True)],
+        100,
+    )
+
+
+def q43(s, flavor):
+    """TPC-DS q43: store sales pivoted by day name for one year."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    aggs = [
+        (
+            AggExpr(
+                AggFn.SUM,
+                If(Col("d_day_name") == d, Col("ss_ext_sales_price"),
+                   Literal(None, DataType.float64())),
+            ),
+            f"{d.lower()[:3]}_sales",
+        )
+        for d in days
+    ]
+    agg = _agg(
+        j,
+        keys=[(Col("s_store_name"), "s_store_name")],
+        aggs=aggs,
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("s_store_name"), True, True)], 100
+    )
+
+
+def _brand_month_revenue(s, flavor, manager_band):
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1998) & (Col("d_moy") == 12),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["item"](), manager_band),
+        j,
+        ["i_item_sk"], ["ss_item_sk"],
+    )
+    agg = _agg(
+        j,
+        keys=[(Col("i_brand_id"), "brand_id"),
+              (Col("i_brand"), "brand")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+               "ext_price")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("ext_price"), False, False),
+         SortKey(Col("brand_id"), True, True)],
+        100,
+    )
+
+
+def q52(s, flavor):
+    """TPC-DS q52: brand revenue for one month (manager 1)."""
+    return _brand_month_revenue(s, flavor, Col("i_manager_id") == 1)
+
+
+def q55(s, flavor):
+    """TPC-DS q55: brand revenue for a manager band."""
+    return _brand_month_revenue(
+        s, flavor,
+        (Col("i_manager_id") >= 20) & (Col("i_manager_id") <= 40),
+    )
+
+
+QUERIES.update({"q42": q42, "q43": q43, "q52": q52, "q55": q55})
