@@ -1,4 +1,4 @@
-"""TPC-DS whole-query differential matrix: 39 queries from q1-q55.
+"""TPC-DS whole-query differential matrix: 42 queries from q1-q55.
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -7,8 +7,8 @@ the same query (Spark join/NULL semantics hand-enforced: NULL join keys
 never match, NULL groups are kept, AVG ignores NULLs). Comparison is
 order-insensitive where the query's sort key is non-unique.
 
-Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 39 queries
-x 2 flavors keeps the default suite ~10 minutes; raise to 1M+ for
+Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 42 queries
+x 2 flavors keeps the default suite ~11 minutes; raise to 1M+ for
 scale runs; returns/web/catalog scale proportionally).
 """
 
@@ -1181,4 +1181,96 @@ def oracle_q55(t):
 ORACLES.update({
     "q42": oracle_q42, "q43": oracle_q43, "q52": oracle_q52,
     "q55": oracle_q55,
+})
+
+
+# ---------------------------------------------------------------------------
+# q45/q48/q50 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q45(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy >= 1) & (dd.d_moy <= 3)]
+    j = _merge(t["web_sales"], dd[["d_date_sk"]],
+               "ws_sold_date_sk", "d_date_sk")
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "ws_bill_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_zip"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    zips = {f"{(24000 + (i % 500) * 131) % 90000:05d}"
+            for i in range(0, 40)}
+    items = set(range(2, 30, 3))
+    sel = j.ca_zip.str[:5].isin(zips) | j.ws_item_sk.isin(items)
+    j = j[sel.fillna(False)]
+    agg = (
+        j.groupby("ca_zip", dropna=False)
+        .ws_ext_sales_price.sum().reset_index(name="total")
+    )
+    return agg.sort_values("ca_zip", na_position="first").head(
+        100).reset_index(drop=True)
+
+
+def oracle_q48(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(
+        t["customer_demographics"][
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"]],
+        left_on="ss_cdemo_sk", right_on="cd_demo_sk",
+    )
+    j = _merge(j, t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+               "ss_customer_sk", "c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_state"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    band = (
+        (
+            (j.cd_marital_status == "M")
+            & (j.cd_education_status == "4 yr Degree")
+            & (j.ss_sales_price >= 100.0)
+            & (j.ss_sales_price <= 150.0)
+        )
+        | (
+            (j.cd_marital_status == "D")
+            & (j.cd_education_status == "2 yr Degree")
+            & (j.ss_sales_price >= 50.0)
+            & (j.ss_sales_price <= 100.0)
+        )
+        | (
+            j.ca_state.isin(["TN", "GA"])
+            & (j.ss_net_profit >= 0.0)
+            & (j.ss_net_profit <= 100.0)
+        )
+    )
+    sel = j[band.fillna(False)]
+    return pd.DataFrame([{"total_qty": sel.ss_quantity.sum()}])
+
+
+def oracle_q50(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    ss = _merge(t["store_sales"], dd[["d_date_sk"]],
+                "ss_sold_date_sk", "d_date_sk")
+    j = _merge(t["store_returns"], ss,
+               ["sr_customer_sk", "sr_item_sk"],
+               ["ss_customer_sk", "ss_item_sk"])
+    j = j[j.sr_returned_date_sk >= j.d_date_sk]
+    j = j.merge(t["store"][["s_store_sk", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    lag = j.sr_returned_date_sk - j.d_date_sk
+    j = j.assign(
+        d30=(lag <= 30).astype(int),
+        d60=((lag > 30) & (lag <= 60)).astype(int),
+        d90=((lag > 60) & (lag <= 90)).astype(int),
+        d90plus=(lag > 90).astype(int),
+    )
+    agg = (
+        j.groupby("s_store_name")[["d30", "d60", "d90", "d90plus"]]
+        .sum().reset_index()
+    )
+    return agg.sort_values("s_store_name").head(100).reset_index(
+        drop=True)
+
+
+ORACLES.update({
+    "q45": oracle_q45, "q48": oracle_q48, "q50": oracle_q50,
 })

@@ -1,4 +1,4 @@
-"""TPC-DS queries (39 of q1-q55) as engine plan builders over
+"""TPC-DS queries (42 of q1-q55) as engine plan builders over
 synthetic tables.
 
 The reference's correctness backbone is whole-query differential testing:
@@ -2339,3 +2339,156 @@ def q55(s, flavor):
 
 
 QUERIES.update({"q42": q42, "q43": q43, "q52": q52, "q55": q55})
+
+
+# ---------------------------------------------------------------------------
+# q45/q48/q50: zip-or-item disjunction, demographic bands, return lag
+# ---------------------------------------------------------------------------
+
+def q45(s, flavor):
+    """TPC-DS q45 shape: web sales by customer zip where the zip is in
+    a literal list OR the item is in a chosen id set - the IN-subquery
+    arm decorrelates to an InList, so the whole disjunction is ONE
+    filter predicate over the joined rows."""
+    base = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") >= 1)
+            & (Col("d_moy") <= 3),
+        ),
+        s["web_sales"](),
+        ["d_date_sk"], ["ws_sold_date_sk"],
+    )
+    base = _join(
+        flavor, s["customer"](), base,
+        ["c_customer_sk"], ["ws_bill_customer_sk"],
+    )
+    base = _join(
+        flavor, s["customer_address"](), base,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    zips = tuple(
+        Literal(f"{(24000 + (i % 500) * 131) % 90000:05d}",
+                DataType.utf8())
+        for i in range(0, 40)
+    )
+    item_ids = tuple(
+        Literal(i, DataType.int64()) for i in range(2, 30, 3)
+    )
+    qual = FilterExec(
+        base,
+        InList(
+            ScalarFn("substring",
+                     (Col("ca_zip"), Literal(1, DataType.int32()),
+                      Literal(5, DataType.int32()))),
+            zips,
+        )
+        | InList(Col("ws_item_sk").cast(DataType.int64()), item_ids),
+    )
+    agg = _agg(
+        qual,
+        keys=[(Col("ca_zip"), "ca_zip")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ws_ext_sales_price")), "total")],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("ca_zip"), True, True)], 100
+    )
+
+
+def q48(s, flavor):
+    """TPC-DS q48: quantity sum over OR'd (demographic x price x state)
+    bands."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor, s["customer_demographics"](), j,
+        ["cd_demo_sk"], ["ss_cdemo_sk"],
+    )
+    cust = _join(
+        flavor, s["customer"](), j,
+        ["c_customer_sk"], ["ss_customer_sk"],
+    )
+    cust = _join(
+        flavor, s["customer_address"](), cust,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    band = FilterExec(
+        cust,
+        (
+            (Col("cd_marital_status") == "M")
+            & (Col("cd_education_status") == "4 yr Degree")
+            & (Col("ss_sales_price") >= 100.0)
+            & (Col("ss_sales_price") <= 150.0)
+        )
+        | (
+            (Col("cd_marital_status") == "D")
+            & (Col("cd_education_status") == "2 yr Degree")
+            & (Col("ss_sales_price") >= 50.0)
+            & (Col("ss_sales_price") <= 100.0)
+        )
+        | (
+            InList(Col("ca_state"),
+                   (Literal("TN", DataType.utf8()),
+                    Literal("GA", DataType.utf8())))
+            & (Col("ss_net_profit") >= 0.0)
+            & (Col("ss_net_profit") <= 100.0)
+        ),
+    )
+    return _agg(
+        band,
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_quantity")), "total_qty")],
+    )
+
+
+def q50(s, flavor):
+    """TPC-DS q50 shape: return-lag day buckets per store (sale joined
+    to its return on customer+item, lag = return date - sale date)."""
+    ss = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(
+        flavor, s["store_returns"](), ss,
+        ["sr_customer_sk", "sr_item_sk"],
+        ["ss_customer_sk", "ss_item_sk"],
+    )
+    j = FilterExec(
+        j, Col("sr_returned_date_sk") >= Col("d_date_sk")
+    )
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    lag = Col("sr_returned_date_sk") - Col("d_date_sk")
+
+    def bucket(cond, name):
+        return (
+            AggExpr(
+                AggFn.SUM,
+                If(cond, Literal(1, DataType.int64()),
+                   Literal(0, DataType.int64())),
+            ),
+            name,
+        )
+
+    agg = _agg(
+        j,
+        keys=[(Col("s_store_name"), "s_store_name")],
+        aggs=[
+            bucket(lag <= 30, "d30"),
+            bucket((lag > 30) & (lag <= 60), "d60"),
+            bucket((lag > 60) & (lag <= 90), "d90"),
+            bucket(lag > 90, "d90plus"),
+        ],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col("s_store_name"), True, True)], 100
+    )
+
+
+QUERIES.update({"q45": q45, "q48": q48, "q50": q50})
