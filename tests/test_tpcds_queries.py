@@ -1,4 +1,4 @@
-"""TPC-DS whole-query differential matrix: 42 queries from q1-q55.
+"""TPC-DS whole-query differential matrix: 43 queries from q1-q55.
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -7,7 +7,7 @@ the same query (Spark join/NULL semantics hand-enforced: NULL join keys
 never match, NULL groups are kept, AVG ignores NULLs). Comparison is
 order-insensitive where the query's sort key is non-unique.
 
-Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 42 queries
+Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 43 queries
 x 2 flavors keeps the default suite ~11 minutes; raise to 1M+ for
 scale runs; returns/web/catalog scale proportionally).
 """
@@ -1274,3 +1274,40 @@ def oracle_q50(t):
 ORACLES.update({
     "q45": oracle_q45, "q48": oracle_q48, "q50": oracle_q50,
 })
+
+
+def oracle_q51(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy <= 2)][["d_date_sk"]]
+
+    def cum(df, date_col, item_col, price_col):
+        j = _merge(df, dd, date_col, "d_date_sk")
+        daily = (
+            j.groupby([item_col, "d_date_sk"], dropna=False)[price_col]
+            .sum().reset_index(name="rev")
+            .rename(columns={item_col: "item_sk",
+                             "d_date_sk": "date_sk"})
+        )
+        daily = daily.sort_values(["item_sk", "date_sk"])
+        daily["cume"] = daily.groupby("item_sk").rev.cumsum()
+        return daily
+
+    web = cum(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+              "ws_ext_sales_price")
+    store = cum(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price")
+    m = web.merge(store, on=["item_sk", "date_sk"], how="outer",
+                  suffixes=("_w", "_s"))
+    m = m[m.cume_w.fillna(0.0) > m.cume_s.fillna(0.0)]
+    out = m.sort_values(["item_sk", "date_sk"]).head(200)
+    return pd.DataFrame(
+        {
+            "item_sk": out.item_sk.values,
+            "date_sk": out.date_sk.values,
+            "web_cume": out.cume_w.values,
+            "store_cume": out.cume_s.values,
+        }
+    )
+
+
+ORACLES["q51"] = oracle_q51

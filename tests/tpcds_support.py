@@ -1,4 +1,4 @@
-"""TPC-DS queries (42 of q1-q55) as engine plan builders over
+"""TPC-DS queries (43 of q1-q55) as engine plan builders over
 synthetic tables.
 
 The reference's correctness backbone is whole-query differential testing:
@@ -2492,3 +2492,76 @@ def q50(s, flavor):
 
 
 QUERIES.update({"q45": q45, "q48": q48, "q50": q50})
+
+
+def q51(s, flavor):
+    """TPC-DS q51: cumulative per-item daily revenue in web vs store
+    channels (running window sums), FULL-outer-joined on (item, day),
+    keeping days where the web cumulative exceeds the store one."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    def cum(prefix, table):
+        daily = _agg(
+            _join(
+                flavor,
+                FilterExec(
+                    s["date_dim"](),
+                    (Col("d_year") == 1999) & (Col("d_moy") <= 2),
+                ),
+                s[table](),
+                ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+            ),
+            keys=[(Col(f"{prefix}_item_sk"), "item_sk"),
+                  (Col("d_date_sk"), "date_sk")],
+            aggs=[(AggExpr(AggFn.SUM, Col(f"{prefix}_ext_sales_price")),
+                   "rev")],
+        )
+        return WindowExec(
+            daily,
+            partition_by=[Col("item_sk")],
+            order_by=[SortKey(Col("date_sk"), True, True)],
+            functions=[
+                WindowFn("sum", Col("rev"), "cume",
+                         frame=("rows", None, 0))
+            ],
+        )
+
+    web = RenameColumnsExec(
+        cum("ws", "web_sales"),
+        ["w_item", "w_date", "w_rev", "web_cume"],
+    )
+    store = RenameColumnsExec(
+        cum("ss", "store_sales"),
+        ["s_item", "s_date", "s_rev", "store_cume"],
+    )
+    j = SortMergeJoinExec(
+        web, store, ["w_item", "w_date"], ["s_item", "s_date"],
+        JoinType.FULL,
+    ) if flavor == "smj" else HashJoinExec(
+        web, store, ["w_item", "w_date"], ["s_item", "s_date"],
+        JoinType.FULL,
+    )
+    over = FilterExec(
+        j,
+        Coalesce((Col("web_cume"), Literal(0.0, DataType.float64())))
+        > Coalesce((Col("store_cume"),
+                    Literal(0.0, DataType.float64()))),
+    )
+    out = ProjectExec(
+        over,
+        [(Coalesce((Col("w_item").cast(DataType.int64()),
+                    Col("s_item").cast(DataType.int64()))), "item_sk"),
+         (Coalesce((Col("w_date").cast(DataType.int64()),
+                    Col("s_date").cast(DataType.int64()))), "date_sk"),
+         (Col("web_cume"), "web_cume"),
+         (Col("store_cume"), "store_cume")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("item_sk"), True, True),
+         SortKey(Col("date_sk"), True, True)],
+        200,
+    )
+
+
+QUERIES["q51"] = q51
