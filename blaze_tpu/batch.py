@@ -155,7 +155,8 @@ class ColumnBatch:
         schema = from_arrow_schema(rb.schema)
         n = rb.num_rows
         cap = capacity or get_config().bucket_for(n)
-        cols: List[Column] = []
+        host_bufs: List[np.ndarray] = []  # values/validity, packed H2D
+        col_meta: List[Tuple[DataType, bool, Optional[object]]] = []
         for i, field in enumerate(schema):
             arr = rb.column(i)
             if isinstance(arr, pa.ChunkedArray):
@@ -199,18 +200,24 @@ class ColumnBatch:
                 (cap, 2) if np_vals.ndim == 2 else cap, dtype=phys
             )
             padded[:n] = np_vals
-            validity = None
-            if has_nulls or dt.id is TypeId.NULL:
+            host_bufs.append(padded)
+            has_validity = has_nulls or dt.id is TypeId.NULL
+            if has_validity:
                 vmask = np.ones(cap, dtype=bool)
                 if dt.id is TypeId.NULL:
                     vmask[:] = False
                 else:
                     vmask[:n] = ~null_np
-                validity = jnp.asarray(vmask)
-            cols.append(Column(dt, jnp.asarray(padded), validity, dictionary))
-        from blaze_tpu.runtime import dispatch as _dispatch
+                host_bufs.append(vmask)
+            col_meta.append((dt, has_validity, dictionary))
+        from blaze_tpu.runtime.pack import put_packed
 
-        _dispatch.record("h2d_batches")
+        dev_bufs = iter(put_packed(host_bufs))
+        cols: List[Column] = []
+        for dt, has_validity, dictionary in col_meta:
+            values = next(dev_bufs)
+            validity = next(dev_bufs) if has_validity else None
+            cols.append(Column(dt, values, validity, dictionary))
         return ColumnBatch(schema, cols, n)
 
     def live_mask(self) -> jax.Array:
@@ -222,17 +229,22 @@ class ColumnBatch:
     def to_arrow(self):
         """Materialize the live rows back to a pyarrow RecordBatch.
 
-        All device buffers transfer in ONE jax.device_get (a single batched
-        D2H) instead of per-column fetches."""
+        All device buffers travel in ONE packed transfer (a single device
+        round trip regardless of column count), sliced on device to the
+        smallest shape bucket covering the live rows so padding beyond it
+        never crosses the wire."""
         import pyarrow as pa
 
-        from blaze_tpu.runtime import dispatch as _dispatch
+        from blaze_tpu.runtime.pack import get_packed
 
+        cap = self.capacity
+        k = None
+        if cap and self.num_rows < cap:
+            k = min(get_config().bucket_for(self.num_rows), cap)
+            if k >= cap:
+                k = None
         device_bufs = [self.selection] + self.device_buffers()
-        if any(isinstance(b, jax.Array) for b in device_bufs):
-            host_bufs = _dispatch.device_get(device_bufs)
-        else:
-            host_bufs = device_bufs  # already host-resident (numpy)
+        host_bufs = get_packed(device_bufs, slice_rows=k)
         host_sel, host_iter = host_bufs[0], iter(host_bufs[1:])
         host_cols = []
         for c in self.columns:

@@ -45,6 +45,12 @@ class EngineConfig:
     external_buckets: int = 32
     # Enable per-operator timing metrics.
     collect_metrics: bool = True
+    # Static output capacity for grouped-aggregate kernels: state arrays
+    # are sliced to this many group slots on device before leaving the
+    # kernel, so a small result never transfers (or feeds downstream
+    # kernels at) full input capacity. Overflow (more groups than slots)
+    # re-dispatches an unsliced kernel - correctness never depends on it.
+    agg_group_capacity: int = 65536
 
     def bucket_for(self, num_rows: int) -> int:
         for b in self.shape_buckets:

@@ -43,7 +43,7 @@ from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.filter import FilterExec
 from blaze_tpu.ops.project import ProjectExec, _unflatten_cvs
 from blaze_tpu.ops.rename import RenameColumnsExec
-from blaze_tpu.runtime.dispatch import cached_kernel, device_get
+from blaze_tpu.runtime.dispatch import cached_kernel
 
 
 def _expr_needs_host(e: ir.Expr, schema: Schema) -> bool:
@@ -211,48 +211,61 @@ class FusedAggregateExec(PhysicalOp):
     def execute(self, partition: int, ctx: ExecContext):
         from blaze_tpu.runtime.dispatch import host_int
 
+        from blaze_tpu.config import get_config
+        from blaze_tpu.ops.hash_aggregate import run_grouped_kernel
+        from blaze_tpu.runtime.pack import get_packed
+
         first = True
         for cb in self.children[0].execute(partition, ctx):
             layout = cb.layout()
+            cap = layout[0]
             base_key = (
                 "fusedagg", self.pipeline.structure_key(),
                 tuple((e, n) for e, n in self.agg.keys),
                 tuple((a.fn, a.child) for a, _ in self.agg.aggs),
                 layout,
             )
-            fn = cached_kernel(
-                base_key, lambda: self._build_kernel(layout)
-            )
-            outs, n_groups = fn(
-                cb.device_buffers(), cb.selection, cb.num_rows
-            )
 
             def fetch(outs, n_groups):
                 # the single-batch-per-partition hot path: states +
-                # count in ONE batched D2H. Later batches (multi-batch
-                # stream headed for the device FINAL merge) stay
-                # device-resident and pay only the scalar sync. `first`
-                # stays set until a NON-EMPTY batch was host-fetched, so
-                # a filtered-out leading batch doesn't push the sole
-                # survivor onto the per-column-fetch path.
+                # count in ONE packed transfer (a single device round
+                # trip however many state columns). Later batches
+                # (multi-batch stream headed for the device FINAL merge)
+                # stay device-resident and pay only the scalar sync.
+                # `first` stays set until a NON-EMPTY batch was
+                # host-fetched, so a filtered-out leading batch doesn't
+                # push the sole survivor onto the per-column-fetch path.
                 if self.fetch_host and first:
-                    host_outs, host_n = device_get((outs, n_groups))
-                    return host_outs, int(host_n)
+                    flat = [n_groups]
+                    for v, m in outs:
+                        flat.append(v)
+                        flat.append(m)
+                    host = get_packed(flat)
+                    host_outs = [
+                        (host[1 + 2 * i], host[2 + 2 * i])
+                        for i in range(len(outs))
+                    ]
+                    return host_outs, int(host[0])
                 return outs, host_int(n_groups)
 
-            host_outs, n = fetch(outs, n_groups)
-            if n < 0:
-                # narrow-key hash collision sentinel (vanishingly rare):
-                # re-run this batch on the exact lexsort kernel
-                fn = cached_kernel(
-                    base_key + ("lexsort",),
-                    lambda: self._build_kernel(
-                        layout, force_lexsort=True
-                    ),
-                )
-                host_outs, n = fetch(
-                    *fn(cb.device_buffers(), cb.selection, cb.num_rows)
-                )
+            # group-capacity slicing: state arrays leave the kernel cut
+            # to a static slot count so a small grouped result never
+            # crosses the wire (or feeds downstream kernels) at input
+            # capacity. Overflow / hash-collision sentinels re-dispatch
+            # (run_grouped_kernel owns the shared retry ladder).
+            gcap = (1 if not self.agg.keys
+                    else min(cap, get_config().agg_group_capacity))
+            if gcap >= cap:
+                gcap = None
+            host_outs, n = run_grouped_kernel(
+                base_key,
+                lambda fl, gc: self._build_kernel(
+                    layout, force_lexsort=fl, group_cap=gc
+                ),
+                (cb.device_buffers(), cb.selection, cb.num_rows),
+                fetch,
+                gcap,
+            )
             if self.fetch_host and first and n > 0:
                 first = False
             if n == 0:
@@ -263,7 +276,8 @@ class FusedAggregateExec(PhysicalOp):
             ]
             yield ColumnBatch(self._schema, cols, n)
 
-    def _build_kernel(self, layout, force_lexsort: bool = False):
+    def _build_kernel(self, layout, force_lexsort: bool = False,
+                      group_cap=None):
         pipe_kernel = self.pipeline._build_kernel(layout)
         mid_schema = self.pipeline.schema
         cap = layout[0]
@@ -283,7 +297,7 @@ class FusedAggregateExec(PhysicalOp):
         }
         agg_kernel = agg._build_kernel(
             mid_schema, cap, key_exprs, child_map, False, mid_layout,
-            force_lexsort=force_lexsort,
+            force_lexsort=force_lexsort, group_cap=group_cap,
         )
 
         def kernel(bufs, selection, num_rows):

@@ -96,6 +96,67 @@ def _decimal_chunks(cv):
     return [c0, c1, c2, c3]
 
 
+def run_grouped_kernel(base_key, build, args, fetch_n, gcap):
+    """Dispatch a grouped-aggregate kernel under the sentinel-retry
+    ladder shared by HashAggregateExec and FusedAggregateExec:
+
+    - n_groups == -1: narrow-key hash collision between DIFFERENT keys
+      (vanishingly rare) -> re-run the exact full-width lexsort kernel.
+    - n_groups > gcap: more groups than static output slots -> re-run
+      unsliced. Correctness never depends on the slot guess.
+
+    `build(force_lexsort, group_cap)` returns the python kernel to jit;
+    `fetch_n(outs, n_groups) -> (outs', n)` owns the host sync policy."""
+    force_lex = False
+    while True:
+        fn = cached_kernel(
+            base_key + (force_lex, gcap),
+            lambda fl=force_lex, gc=gcap: build(fl, gc),
+        )
+        outs, n_groups = fn(*args)
+        host_outs, n = fetch_n(outs, n_groups)
+        if n < 0 and not force_lex:
+            force_lex = True
+            continue
+        if gcap is not None and n > gcap:
+            gcap = None
+            continue
+        return host_outs, n
+
+
+class _SegOps:
+    """Segmented reductions sized to the group-slot capacity (out_cap),
+    not the row capacity. The keyless single-group case collapses to
+    plain masked reductions - an XLA reduce instead of a scatter, which
+    matters enormously on TPU where scatters serialize."""
+
+    def __init__(self, gid, out_cap: int, keyless: bool):
+        self.gid = gid
+        self.out_cap = out_cap
+        self.scalar = keyless and out_cap == 1
+
+    def sum(self, x):
+        if self.scalar:
+            return jnp.sum(x, axis=0, keepdims=True)
+        return jax.ops.segment_sum(
+            x, self.gid, num_segments=self.out_cap
+        )
+
+    def min(self, x):
+        if self.scalar:
+            return jnp.min(x, axis=0, keepdims=True)
+        return jax.ops.segment_min(
+            x, self.gid, num_segments=self.out_cap
+        )
+
+    def max(self, x):
+        if self.scalar:
+            return jnp.max(x, axis=0, keepdims=True)
+        return jax.ops.segment_max(
+            x, self.gid, num_segments=self.out_cap
+        )
+
+
 _DEC38_MAX = 10**38 - 1
 _U64 = (1 << 64) - 1
 
@@ -534,34 +595,24 @@ class HashAggregateExec(PhysicalOp):
                 if a.child is not None:
                     child_map[i] = next(it)
 
-        key = ("hashagg", self.mode.value,
-               tuple((a.fn, a.child) for a, _ in self.aggs),
-               tuple(key_exprs_l), tuple(child_map.items()),
-               aug.layout(), merging)
-        fn = cached_kernel(
-            key,
-            lambda: self._build_kernel(aug.schema, aug.capacity,
-                                       key_exprs_l, child_map, merging,
-                                       aug.layout()),
+        base_key = ("hashagg", self.mode.value,
+                    tuple((a.fn, a.child) for a, _ in self.aggs),
+                    tuple(key_exprs_l), tuple(child_map.items()),
+                    aug.layout(), merging)
+        gcap = (1 if not self.keys
+                else min(aug.capacity, get_config().agg_group_capacity))
+        if gcap >= aug.capacity:
+            gcap = None
+        outs, n = run_grouped_kernel(
+            base_key,
+            lambda fl, gc: self._build_kernel(
+                aug.schema, aug.capacity, key_exprs_l, child_map,
+                merging, aug.layout(), force_lexsort=fl, group_cap=gc,
+            ),
+            (aug.device_buffers(), aug.selection, aug.num_rows),
+            lambda o, ng: (o, host_int(ng)),
+            gcap,
         )
-        outs, n_groups = fn(
-            aug.device_buffers(), aug.selection, aug.num_rows
-        )
-        n = host_int(n_groups)
-        if n < 0:
-            # narrow-key hash collision sentinel: re-run on the exact
-            # full-width lexsort kernel (vanishingly rare)
-            fn = cached_kernel(
-                key + ("lexsort",),
-                lambda: self._build_kernel(
-                    aug.schema, aug.capacity, key_exprs_l, child_map,
-                    merging, aug.layout(), force_lexsort=True,
-                ),
-            )
-            outs, n_groups = fn(
-                aug.device_buffers(), aug.selection, aug.num_rows
-            )
-            n = host_int(n_groups)
         cols: List[Column] = []
         # recover dictionaries for string key passthroughs
         for (v, m), field, e in zip(
@@ -582,22 +633,40 @@ class HashAggregateExec(PhysicalOp):
             for (v, m), field in zip(it, agg_fields):
                 cols.append(Column(field.dtype, v, m, None))
         else:
+            staged = []
+            fetch_list: List = []
             for (a, _), field in zip(self.aggs, agg_fields):
                 spec = self._agg_spec(a, aug.schema)
                 if spec[0] == "plain":
-                    v, m = next(it)
+                    staged.append((spec, field, next(it)))
+                    continue
+                # chunked decimal: stage the chunk arrays; ALL of them
+                # fetch in one packed transfer below
+                pairs = [next(it) for _ in range(4)]
+                count = next(it)[0] if spec[0] == "dec_avg" else None
+                staged.append((spec, field, (pairs, count)))
+                fetch_list.extend(v for v, _ in pairs)
+                fetch_list.append(pairs[0][1])
+                if count is not None:
+                    fetch_list.append(count)
+            if fetch_list:
+                from blaze_tpu.runtime.pack import get_packed
+
+                host_it = iter(get_packed(fetch_list))
+            for spec, field, payload in staged:
+                if spec[0] == "plain":
+                    v, m = payload
                     cols.append(Column(field.dtype, v, m, None))
                     continue
-                # chunked decimal: exact host reassembly into limbs
-                pairs = [next(it) for _ in range(4)]
-                count = (
-                    np.asarray(next(it)[0])
-                    if spec[0] == "dec_avg" else None
+                _, count = payload
+                chunks = [np.asarray(next(host_it)) for _ in range(4)]
+                any_np = np.asarray(next(host_it))
+                count_np = (
+                    np.asarray(next(host_it)) if count is not None
+                    else None
                 )
-                chunks = [np.asarray(v) for v, _ in pairs]
-                any_np = np.asarray(pairs[0][1])
                 limbs, mask, dt = _reassemble_decimal(
-                    chunks, any_np, count, spec[1],
+                    chunks, any_np, count_np, spec[1],
                     spec[0] == "dec_avg", n_live=n,
                 )
                 assert dt == field.dtype, (dt, field.dtype)
@@ -627,7 +696,8 @@ class HashAggregateExec(PhysicalOp):
         return dtypes
 
     def _build_kernel(self, in_schema, capacity, key_exprs, child_map,
-                      merging, layout, force_lexsort: bool = False):
+                      merging, layout, force_lexsort: bool = False,
+                      group_cap=None):
         from blaze_tpu.exprs.hashing import hash_columns_device
 
         aggs = self.aggs
@@ -636,6 +706,16 @@ class HashAggregateExec(PhysicalOp):
         hash_dtypes = (
             None if force_lexsort
             else self._narrow_key_dtypes(in_schema, key_exprs)
+        )
+
+        # Segment-output capacity: with a small static group bound the
+        # reductions scatter into out_cap slots instead of `capacity`
+        # (keyless aggregates collapse to plain masked reductions), so
+        # both the compute AND the transfer scale with groups, not rows.
+        out_cap = (
+            group_cap
+            if group_cap is not None and group_cap < capacity
+            else capacity
         )
 
         def kernel(bufs, selection, num_rows):
@@ -729,7 +809,9 @@ class HashAggregateExec(PhysicalOp):
                     )
                 boundary = s_live & (diff | first_live)
                 gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-                gid_sorted = jnp.where(s_live, gid_sorted, capacity - 1)
+                # dead rows park in the last segment; every reduction
+                # masks them to its neutral element so they never count
+                gid_sorted = jnp.where(s_live, gid_sorted, out_cap - 1)
                 n_groups = jnp.where(
                     collision,
                     jnp.int32(-1),
@@ -737,14 +819,14 @@ class HashAggregateExec(PhysicalOp):
                 )
                 # boundary row index per group, padded
                 bpos = jnp.nonzero(
-                    boundary, size=capacity, fill_value=0
+                    boundary, size=out_cap, fill_value=0
                 )[0]
             else:
                 idx = jnp.arange(capacity, dtype=jnp.int32)
                 s_live = live
-                gid_sorted = jnp.where(live, 0, capacity - 1)
+                gid_sorted = jnp.where(live, 0, out_cap - 1)
                 n_groups = jnp.asarray(1, jnp.int32)
-                bpos = jnp.zeros(capacity, dtype=jnp.int32)
+                bpos = jnp.zeros(out_cap, dtype=jnp.int32)
 
             outs = []
             for (v, m) in keys_cv:
@@ -755,10 +837,11 @@ class HashAggregateExec(PhysicalOp):
                     km = jnp.take(jnp.take(m, idx), bpos)
                 outs.append((kv, km))
 
+            segops = _SegOps(gid_sorted, out_cap, n_keys == 0)
             for i, (a, name) in enumerate(aggs):
                 outs.extend(
                     self._agg_state(
-                        a, i, ev, idx, s_live, gid_sorted, capacity,
+                        a, i, ev, idx, s_live, segops, capacity,
                         child_map, merging, state_offsets, cols,
                     )
                 )
@@ -797,11 +880,11 @@ class HashAggregateExec(PhysicalOp):
                     ct.scale)
         return ("plain", None)
 
-    def _agg_state(self, a, i, ev, idx, s_live, gid, capacity,
+    def _agg_state(self, a, i, ev, idx, s_live, segops, capacity,
                    child_map, merging, state_offsets, cols):
         """Emit the output (value, validity) columns for one aggregate."""
         fn = a.fn
-        seg = lambda x: jax.ops.segment_sum(x, gid, num_segments=capacity)
+        seg = segops.sum
         live_f = s_live
 
         if merging:
@@ -814,7 +897,7 @@ class HashAggregateExec(PhysicalOp):
             ]
             spec = self._agg_spec(a, ev.schema)
             return self._merge_states(
-                a, states, seg, live_f, gid, capacity, spec
+                a, states, segops, live_f, capacity, spec
             )
 
         # raw input -> state/result
@@ -867,12 +950,8 @@ class HashAggregateExec(PhysicalOp):
                 info = jnp.iinfo(phys)
                 neutral = info.max if fn is AggFn.MIN else info.min
             acc = jnp.where(contrib, cv, jnp.asarray(neutral, phys))
-            red = (
-                jax.ops.segment_min
-                if fn is AggFn.MIN
-                else jax.ops.segment_max
-            )
-            m = red(acc, gid, num_segments=capacity)
+            red = segops.min if fn is AggFn.MIN else segops.max
+            m = red(acc)
             any_v = seg(contrib.astype(jnp.int64)) > 0
             return [(m, any_v)]
         if fn in (AggFn.FIRST, AggFn.LAST):
@@ -880,14 +959,10 @@ class HashAggregateExec(PhysicalOp):
             big = capacity + 1
             if fn is AggFn.FIRST:
                 rank = jnp.where(contrib, pos_in, big)
-                best = jax.ops.segment_min(
-                    rank, gid, num_segments=capacity
-                )
+                best = segops.min(rank)
             else:
                 rank = jnp.where(contrib, pos_in, -1)
-                best = jax.ops.segment_max(
-                    rank, gid, num_segments=capacity
-                )
+                best = segops.max(rank)
             has = (best >= 0) & (best < big)
             safe_best = jnp.clip(best, 0, capacity - 1)
             vals = jnp.take(cv, safe_best, axis=0)
@@ -901,9 +976,10 @@ class HashAggregateExec(PhysicalOp):
             return [(n, None), (s1, None), (s2, None)]
         return [_finalize_var(a.fn, n, s1, s2)]
 
-    def _merge_states(self, a, states, seg, live_f, gid, capacity,
+    def _merge_states(self, a, states, segops, live_f, capacity,
                       spec=("plain", None)):
         fn = a.fn
+        seg = segops.sum
         if spec[0] in ("dec_sum", "dec_avg"):
             # chunk sums merge by plain segment addition
             c0, m0 = states[0]
@@ -940,12 +1016,8 @@ class HashAggregateExec(PhysicalOp):
                 info = jnp.iinfo(phys)
                 neutral = info.max if fn is AggFn.MIN else info.min
             acc = jnp.where(contrib, v, jnp.asarray(neutral, phys))
-            red = (
-                jax.ops.segment_min
-                if fn is AggFn.MIN
-                else jax.ops.segment_max
-            )
-            out = red(acc, gid, num_segments=capacity)
+            red = segops.min if fn is AggFn.MIN else segops.max
+            out = red(acc)
             any_v = seg(contrib.astype(jnp.int64)) > 0
             return [(out, any_v)]
         if fn is AggFn.AVG:
@@ -966,10 +1038,10 @@ class HashAggregateExec(PhysicalOp):
             big = capacity + 1
             if fn is AggFn.FIRST:
                 rank = jnp.where(contrib, pos_in, big)
-                best = jax.ops.segment_min(rank, gid, num_segments=capacity)
+                best = segops.min(rank)
             else:
                 rank = jnp.where(contrib, pos_in, -1)
-                best = jax.ops.segment_max(rank, gid, num_segments=capacity)
+                best = segops.max(rank)
             has = (best >= 0) & (best < big)
             vals = jnp.take(v, jnp.clip(best, 0, capacity - 1), axis=0)
             return [(vals, has)]

@@ -1,0 +1,208 @@
+"""Packed host<->device transfers: O(1) round trips per batch.
+
+Why this exists: every `jax.Array` leaf in a `device_get` and every
+`device_put` pays its own host<->device round trip. On a network-attached
+TPU each round trip is tens of milliseconds, so a 20-column batch costs
+20x the latency of a 1-column batch even when the bytes are tiny. The
+reference hands a whole batch across its FFI boundary as ONE pointer
+pair per batch (exec.rs:205-255); the TPU-native equivalent is to pack
+all of a batch's buffers into ONE uint8 buffer on one side and split it
+on the other:
+
+- D2H (`get_packed`): a cached jit kernel slices each buffer to the live
+  prefix, bitcasts to bytes and concatenates -> one fetch -> host views
+  split it back (zero-copy numpy views into the fetched buffer).
+- H2D (`put_packed`): host concatenates raw bytes -> one device_put ->
+  a cached jit kernel splits and bitcasts back to typed device arrays.
+
+Byte order: XLA's bitcast-convert to/from uint8 enumerates bytes in
+little-endian element order on all supported backends, matching numpy's
+`.view` on little-endian hosts; `tests/test_pack.py` round-trips every
+engine dtype to pin this.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from blaze_tpu.runtime.dispatch import cached_kernel, record
+
+
+def _np_dtype(a) -> np.dtype:
+    return np.dtype(a.dtype)
+
+
+def _packed_nbytes(shape: Tuple[int, ...], dt: np.dtype) -> int:
+    n = int(np.prod(shape)) if shape else 1
+    return n * (1 if dt == np.bool_ else dt.itemsize)
+
+
+def _f64_pairs() -> bool:
+    """True when float64 must travel as exact (hi, lo) float32 pairs.
+
+    The TPU backend has no hardware f64: XLA emulates it as a
+    double-single (two-float32) pair with an f32 exponent range, and the
+    axon AOT compiler's X64-removal pass cannot lower bitcast-convert on
+    f64 at all. hi = f32(x), lo = f32(x - hi) is the exact double-single
+    decomposition - it round-trips every value the device itself can
+    represent, using only arithmetic + f32 bitcasts. CPU (true IEEE f64)
+    keeps the direct byte bitcast, which is lossless there."""
+    return jax.default_backend() != "cpu"
+
+
+def _build_pack(slice_rows: Optional[int], f64_pairs: bool):
+    """Device kernel: [arrays] -> one uint8 buffer. Shapes/dtypes are
+    picked up from the traced inputs; jax.jit specializes per signature
+    under the single cache entry."""
+
+    def pack(bufs):
+        parts = []
+        for b in bufs:
+            if slice_rows is not None and b.ndim >= 1:
+                b = b[:slice_rows]
+            if b.dtype == jnp.bool_:
+                b = b.astype(jnp.uint8)
+            if f64_pairs and b.dtype == jnp.float64:
+                hi = b.astype(jnp.float32)
+                lo = (b - hi.astype(jnp.float64)).astype(jnp.float32)
+                lo = jnp.where(jnp.isfinite(hi), lo, jnp.float32(0))
+                b = jnp.stack([hi, lo], axis=-1)
+            b = b.reshape(-1)
+            if b.dtype != jnp.uint8:
+                b = jax.lax.bitcast_convert_type(b, jnp.uint8)
+                b = b.reshape(-1)
+            parts.append(b)
+        if not parts:
+            return jnp.zeros(0, dtype=jnp.uint8)
+        return jnp.concatenate(parts)
+
+    return pack
+
+
+def _build_unpack(metas: Tuple[Tuple[str, Tuple[int, ...]], ...],
+                  f64_pairs: bool):
+    """Device kernel: one uint8 buffer -> [typed arrays] per metas."""
+
+    def unpack(u8):
+        outs = []
+        off = 0
+        for dt_s, shape in metas:
+            dt = np.dtype(dt_s)
+            n = int(np.prod(shape)) if shape else 1
+            nb = _packed_nbytes(shape, dt)
+            seg = jax.lax.slice(u8, (off,), (off + nb,))
+            if dt == np.bool_:
+                arr = seg.astype(jnp.bool_)
+            elif f64_pairs and dt == np.float64:
+                pair = jax.lax.bitcast_convert_type(
+                    seg.reshape(2 * n, 4), jnp.float32
+                ).reshape(n, 2)
+                hi = pair[:, 0].astype(jnp.float64)
+                lo = pair[:, 1].astype(jnp.float64)
+                # lo==0 keeps hi exactly (preserves -0.0: -0.0 + 0.0
+                # would round to +0.0)
+                arr = jnp.where(pair[:, 1] == 0, hi, hi + lo)
+            elif dt.itemsize == 1:
+                arr = jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
+            else:
+                arr = jax.lax.bitcast_convert_type(
+                    seg.reshape(n, dt.itemsize), jnp.dtype(dt)
+                )
+            outs.append(arr.reshape(shape))
+            off += nb
+        return outs
+
+    return unpack
+
+
+def _f64_to_pair_bytes(a: np.ndarray) -> np.ndarray:
+    """Host-side exact double-single split, little-endian f32-pair bytes."""
+    hi = a.astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        lo = (a - hi.astype(np.float64)).astype(np.float32)
+    lo = np.where(np.isfinite(hi), lo, np.float32(0))
+    pair = np.empty(a.shape + (2,), dtype=np.float32)
+    pair[..., 0] = hi
+    pair[..., 1] = lo
+    return pair.reshape(-1).view(np.uint8)
+
+
+def _pair_bytes_to_f64(seg: np.ndarray, n: int) -> np.ndarray:
+    pair = seg.view(np.float32).reshape(n, 2)
+    hi = pair[:, 0].astype(np.float64)
+    lo = pair[:, 1].astype(np.float64)
+    return np.where(pair[:, 1] == 0, hi, hi + lo)
+
+
+def put_packed(arrays: Sequence[np.ndarray]) -> List[jax.Array]:
+    """Move host arrays to device in ONE transfer + ONE split dispatch."""
+    if not arrays:
+        return []
+    pairs = _f64_pairs()
+    metas = tuple((str(_np_dtype(a)), tuple(a.shape)) for a in arrays)
+    parts = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        if a.dtype == np.bool_:
+            a = a.astype(np.uint8)
+        if pairs and a.dtype == np.float64:
+            parts.append(_f64_to_pair_bytes(a))
+            continue
+        parts.append(a.reshape(-1).view(np.uint8))
+    buf = np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+    record("h2d_batches")
+    dev = jax.device_put(buf)
+    fn = cached_kernel(
+        ("h2d_unpack", metas, pairs),
+        lambda: _build_unpack(metas, pairs),
+    )
+    return list(fn(dev))
+
+
+def get_packed(arrays: Sequence[object],
+               slice_rows: Optional[int] = None) -> List[np.ndarray]:
+    """Fetch a mixed list of jax/numpy arrays in ONE device round trip.
+
+    numpy entries pass through untouched. `slice_rows` statically caps the
+    FIRST axis of every device array with ndim>=1 before the transfer (the
+    caller knows live rows << capacity); the returned host arrays reflect
+    the capped shapes."""
+    out: List[object] = list(arrays)
+    dev_idx = [
+        i for i, a in enumerate(arrays)
+        if isinstance(a, jax.Array)
+    ]
+    if not dev_idx:
+        return out  # type: ignore[return-value]
+    pairs = _f64_pairs()
+    fn = cached_kernel(
+        ("d2h_pack", slice_rows, pairs),
+        lambda: _build_pack(slice_rows, pairs),
+    )
+    packed = fn([arrays[i] for i in dev_idx])
+    record("d2h_fetches")
+    host = np.asarray(packed)
+    off = 0
+    for i in dev_idx:
+        a = arrays[i]
+        shape = tuple(a.shape)
+        if slice_rows is not None and len(shape) >= 1:
+            shape = (min(slice_rows, shape[0]),) + shape[1:]
+        dt = _np_dtype(a)
+        nb = _packed_nbytes(shape, dt)
+        seg = host[off: off + nb]
+        if dt == np.bool_:
+            vals = seg.view(np.bool_)
+        elif pairs and dt == np.float64:
+            n = int(np.prod(shape)) if shape else 1
+            vals = _pair_bytes_to_f64(seg, n)
+        else:
+            vals = seg.view(dt)
+        out[i] = vals.reshape(shape)
+        off += nb
+    return out  # type: ignore[return-value]
