@@ -204,13 +204,18 @@ def child(n_rows):
             mode=AggMode.COMPLETE,
         )
 
-    def timed(fn, iters=3, warmup=1):
+    def timed(fn, iters=5, warmup=1):
+        # median-of-N: the tunnel's wire bandwidth and this host's single
+        # shared core are both noisy; the median reflects the steady state
         for _ in range(warmup):
             out = fn()
-        t0 = time.perf_counter()
+        ts = []
         for _ in range(iters):
+            t0 = time.perf_counter()
             out = fn()
-        return (time.perf_counter() - t0) / iters, out
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2], out
 
     # ---- end-to-end: serialized task through execute_task, incl IO ----
     blob = task_to_proto(
@@ -243,8 +248,11 @@ def child(n_rows):
     t_staged, _ = timed(staged)
 
     # ---- CPU baselines: numpy and pyarrow.compute (SIMD C++) ----
+    # fair fight: the baselines get the same column pruning the engine's
+    # scan performs (q6 never reads "item"), like the reference's
+    # DataFusion ParquetExec projection
     def cpu_numpy():
-        tbl = pq.read_table(path)
+        tbl = pq.read_table(path, columns=["qty", "price"])
         p = tbl.column("price").to_numpy()
         q = tbl.column("qty").to_numpy()
         live = (p > 50.0) & (q < 8)
@@ -252,7 +260,7 @@ def child(n_rows):
         return float(rev.sum(dtype=np.float64)), int(live.sum())
 
     def cpu_arrow():
-        tbl = pq.read_table(path)
+        tbl = pq.read_table(path, columns=["qty", "price"])
         live = pc.and_(
             pc.greater(tbl.column("price"), 50.0),
             pc.less(tbl.column("qty"), 8),
@@ -289,6 +297,14 @@ def child(n_rows):
                 "cpu_numpy_seconds": round(t_np, 4),
                 "cpu_arrow_seconds": round(t_pa, 4),
                 "dispatch_counts": e2e_counts,
+                # context: the chip sits behind a network tunnel
+                # (~70ms/dispatch RTT, bursty wire bandwidth); e2e
+                # includes parquet decode + H2D over that tunnel, so
+                # staged_rows_per_sec isolates on-device throughput
+                "scan_optimizations": (
+                    "column-pruning + host filter pushdown + "
+                    "rowgroup stats"
+                ),
             }
         )
     )

@@ -155,7 +155,9 @@ class ColumnBatch:
         schema = from_arrow_schema(rb.schema)
         n = rb.num_rows
         cap = capacity or get_config().bucket_for(n)
-        host_bufs: List[np.ndarray] = []  # values/validity, packed H2D
+        # (vals, cap, tail_fill) triples: padding and transfer-packing
+        # fuse into one host copy (pack.put_packed_padded)
+        entries: List[Tuple[np.ndarray, int, int]] = []
         col_meta: List[Tuple[DataType, bool, Optional[object]]] = []
         for i, field in enumerate(schema):
             arr = rb.column(i)
@@ -196,29 +198,50 @@ class ColumnBatch:
             phys = dt.physical_dtype()
             if np_vals.dtype != phys:
                 np_vals = np_vals.astype(phys)
-            padded = np.zeros(
-                (cap, 2) if np_vals.ndim == 2 else cap, dtype=phys
-            )
-            padded[:n] = np_vals
-            host_bufs.append(padded)
+            entries.append((np_vals, cap, 0))
             has_validity = has_nulls or dt.id is TypeId.NULL
             if has_validity:
-                vmask = np.ones(cap, dtype=bool)
                 if dt.id is TypeId.NULL:
-                    vmask[:] = False
+                    # all-invalid including the padding tail
+                    entries.append((np.zeros(0, dtype=bool), cap, 0))
                 else:
-                    vmask[:n] = ~null_np
-                host_bufs.append(vmask)
+                    entries.append(
+                        (~null_np, cap, 1)  # padding rows stay "valid"
+                    )
             col_meta.append((dt, has_validity, dictionary))
-        from blaze_tpu.runtime.pack import put_packed
+        from blaze_tpu.runtime.pack import put_packed_padded
 
-        dev_bufs = iter(put_packed(host_bufs))
+        dev_bufs = iter(put_packed_padded(entries))
         cols: List[Column] = []
         for dt, has_validity, dictionary in col_meta:
             values = next(dev_bufs)
             validity = next(dev_bufs) if has_validity else None
             cols.append(Column(dt, values, validity, dictionary))
         return ColumnBatch(schema, cols, n)
+
+    @staticmethod
+    def from_arrow_pruned(rb, schema: Schema, present: Sequence[int],
+                          capacity: Optional[int] = None) -> "ColumnBatch":
+        """Build a batch with `schema` positions intact from a RecordBatch
+        holding only the columns at `present` (ascending). Pruned
+        positions get shared device-resident zero placeholders - never
+        decoded, never transferred - valid only when no consumer reads
+        them (guaranteed by planner/colprune's conservative analysis)."""
+        sub = ColumnBatch.from_arrow(rb, capacity)
+        cap = sub.capacity if sub.columns else (
+            capacity or get_config().bucket_for(rb.num_rows)
+        )
+        it = iter(sub.columns)
+        pres = set(present)
+        cols: List[Column] = []
+        for i, field in enumerate(schema):
+            if i in pres:
+                cols.append(next(it))
+            else:
+                cols.append(
+                    Column(field.dtype, _placeholder(cap, field.dtype))
+                )
+        return ColumnBatch(schema, cols, rb.num_rows)
 
     def live_mask(self) -> jax.Array:
         m = row_mask(self.num_rows, self.capacity)
@@ -333,6 +356,23 @@ class ColumnBatch:
         """Host-side row slice (used by spill/IPC writers)."""
         rb = self.to_arrow().slice(start, length)
         return ColumnBatch.from_arrow(rb)
+
+
+_PLACEHOLDER_CACHE: dict = {}
+
+
+def _placeholder(cap: int, dtype: DataType) -> jax.Array:
+    """Shared all-zeros device column for pruned (never-read) scan
+    positions. Safe to share across batches/plans: engine kernels are
+    pure functions and never mutate input buffers."""
+    phys = dtype.physical_dtype()
+    shape = (cap, 2) if dtype.is_wide_decimal else (cap,)
+    key = (shape, str(phys))
+    arr = _PLACEHOLDER_CACHE.get(key)
+    if arr is None:
+        arr = jnp.zeros(shape, dtype=phys)
+        _PLACEHOLDER_CACHE[key] = arr
+    return arr
 
 
 def _decimal_unscaled_i64(arr) -> np.ndarray:

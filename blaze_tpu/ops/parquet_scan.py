@@ -75,6 +75,41 @@ class ParquetScanExec(PhysicalOp):
         cfg = ctx.config
         cols = self.projection or [f.name for f in self._schema]
 
+        # planner/colprune hints: columns no ancestor reads are neither
+        # decoded nor transferred (device zero placeholders keep schema
+        # positions valid); filter conjuncts pushed from the FilterExec
+        # directly above run on the host during decode, like DataFusion's
+        # CPU-side row-filter pushdown in ParquetExec (from_proto.rs:
+        # 202-212 builds the same pruning predicate)
+        required = getattr(self, "_hint_required", None)
+        filters = list(getattr(self, "_hint_filters", ()) or ())
+        if required is not None:
+            req_names = {cols[i] for i in required if i < len(cols)}
+            filt_names = {name for name, _, _ in filters}
+            read_names = [
+                c for c in cols if c in req_names or c in filt_names
+            ]
+            if not read_names:
+                # COUNT(*)-style scans still need row counts: read the
+                # cheapest column (strings cost parquet decode +
+                # dictionary encoding regardless of code width)
+                def decode_cost(c):
+                    dt = self._schema.fields[
+                        self._schema.index_of(c)
+                    ].dtype
+                    penalty = 100 if dt.is_dictionary_encoded else 0
+                    return penalty + dt.physical_dtype().itemsize
+
+                read_names = [min(cols, key=decode_cost)]
+            keep_names = [c for c in cols if c in req_names] or read_names[:1]
+            present = [cols.index(c) for c in keep_names]
+            if keep_names == cols and read_names == cols:
+                present = None
+        else:
+            read_names = cols
+            keep_names = cols
+            present = None
+
         def decode() -> Iterator[ColumnBatch]:
             for fr in self.file_groups[partition]:
                 # all byte IO flows through the object-store seam (the
@@ -82,27 +117,46 @@ class ParquetScanExec(PhysicalOp):
                 pf = pq.ParquetFile(
                     store_for(fr.path).open_input(fr.path)
                 )
-                groups = self._select_row_groups(pf, fr)
+                groups = self._select_row_groups(pf, fr, filters)
                 if not groups:
                     continue
                 for rb in pf.iter_batches(
                     batch_size=cfg.batch_size, row_groups=groups,
-                    columns=cols, use_threads=True,
+                    columns=read_names, use_threads=True,
                 ):
                     ctx.metrics.add("input_rows", rb.num_rows)
                     ctx.metrics.add("input_batches", 1)
+                    if filters:
+                        before = rb.num_rows
+                        rb = _apply_host_filters(rb, filters)
+                        ctx.metrics.add(
+                            "pushdown_filtered_rows", before - rb.num_rows
+                        )
                     if rb.num_rows == 0:
                         continue
-                    yield ColumnBatch.from_arrow(rb)
+                    if present is None:
+                        yield ColumnBatch.from_arrow(rb)
+                    else:
+                        import pyarrow as pa
+
+                        sub = pa.record_batch(
+                            [rb.column(c) for c in keep_names],
+                            names=keep_names,
+                        )
+                        yield ColumnBatch.from_arrow_pruned(
+                            sub, self._schema, present
+                        )
 
         # overlap parquet decode + H2D with downstream device compute
         # (SURVEY 7 streaming model: double-buffered host pipeline)
         yield from prefetch(decode(), depth=2)
 
     # ------------------------------------------------------------------
-    def _select_row_groups(self, pf, fr: FileRange) -> List[int]:
+    def _select_row_groups(self, pf, fr: FileRange,
+                           filters=()) -> List[int]:
         """Row groups whose byte midpoint falls in the split range (Spark's
-        split ownership rule) and that survive stats pruning."""
+        split ownership rule) and that survive stats pruning (the explicit
+        pruning predicate plus any pushed-down filter conjuncts)."""
         md = pf.metadata
         out = []
         for i in range(md.num_row_groups):
@@ -116,8 +170,74 @@ class ParquetScanExec(PhysicalOp):
                 self.pruning_predicate, rg, self._schema
             ):
                 continue
+            if any(
+                not _stats_may_match(name, op, value, rg)
+                for name, op, value in filters
+            ):
+                continue
             out.append(i)
         return out
+
+
+def _apply_host_filters(rb, filters):
+    """Evaluate pushed-down `(name, cmp, literal)` conjuncts with pyarrow
+    compute (vectorized C++) and compact the batch before any padding or
+    device transfer. NULL comparison results drop the row - exactly what
+    the device selection mask would do - and the device FilterExec still
+    re-applies the full predicate, so a conjunct that fails to evaluate
+    here is simply skipped."""
+    import pyarrow.compute as pc
+
+    fns = {
+        ir.Op.LT: pc.less, ir.Op.LTE: pc.less_equal,
+        ir.Op.GT: pc.greater, ir.Op.GTE: pc.greater_equal,
+        ir.Op.EQ: pc.equal, ir.Op.NEQ: pc.not_equal,
+    }
+    mask = None
+    for name, op, value in filters:
+        try:
+            m = fns[op](rb.column(name), value)
+        except Exception:
+            continue  # device filter re-checks; skipping is only slower
+        mask = m if mask is None else pc.and_(mask, m)
+    if mask is None:
+        return rb
+    return rb.filter(mask)
+
+
+def _rg_stats(name: str, rg):
+    for ci in range(rg.num_columns):
+        c = rg.column(ci)
+        if c.path_in_schema == name:
+            return c.statistics
+    return None
+
+
+def _minmax_may_match(stats, op: ir.Op, value) -> bool:
+    """min/max-vs-comparison core shared by the pruning-predicate and
+    pushed-conjunct row-group checks: False only when the whole group
+    provably fails the comparison."""
+    if stats is None or not stats.has_min_max:
+        return True
+    lo, hi = stats.min, stats.max
+    try:
+        if op is ir.Op.EQ:
+            return lo <= value <= hi
+        if op is ir.Op.LT:
+            return lo < value
+        if op is ir.Op.LTE:
+            return lo <= value
+        if op is ir.Op.GT:
+            return hi > value
+        if op is ir.Op.GTE:
+            return hi >= value
+    except TypeError:
+        return True
+    return True
+
+
+def _stats_may_match(name: str, op: ir.Op, value, rg) -> bool:
+    return _minmax_may_match(_rg_stats(name, rg), op, value)
 
 
 def _may_match(pred: ir.Expr, rg, schema: Schema) -> bool:
@@ -147,26 +267,4 @@ def _may_match(pred: ir.Expr, rg, schema: Schema) -> bool:
     if col is None or lit.value is None:
         return True
     name = col.name if isinstance(col, Col) else schema.fields[col.index].name
-    stats = None
-    for ci in range(rg.num_columns):
-        c = rg.column(ci)
-        if c.path_in_schema == name:
-            stats = c.statistics
-            break
-    if stats is None or not stats.has_min_max:
-        return True
-    lo, hi, v = stats.min, stats.max, lit.value
-    try:
-        if op is Op.EQ:
-            return lo <= v <= hi
-        if op is Op.LT:
-            return lo < v
-        if op is Op.LTE:
-            return lo <= v
-        if op is Op.GT:
-            return hi > v
-        if op is Op.GTE:
-            return hi >= v
-    except TypeError:
-        return True
-    return True
+    return _minmax_may_match(_rg_stats(name, rg), op, lit.value)

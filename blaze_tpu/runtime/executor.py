@@ -44,9 +44,13 @@ def decode_task(task_bytes: bytes, ctx: ExecContext):
     exec.rs:137-165) and installing its resources into the context."""
     from blaze_tpu.plan.serde import task_from_proto
     from blaze_tpu.ops.fused import fuse_pipelines
+    from blaze_tpu.planner.colprune import install as install_scan_hints
 
     op, partition, task_id, resources = task_from_proto(task_bytes)
     op = fuse_pipelines(op)
+    # freshly-decoded tree: scans are private to this task, so filter
+    # pushdown (not just column pruning) is safe to attach
+    install_scan_hints(op, with_filters=True)
     ctx.partition_id = partition
     ctx.task_id = task_id
     for rid, provider in resources.items():
@@ -66,6 +70,12 @@ def execute_task(task_bytes: bytes,
 
 def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
                       ) -> Iterator[pa.RecordBatch]:
+    from blaze_tpu.planner.colprune import install as install_scan_hints
+
+    # column pruning for driver-built plans too (required sets only
+    # union-grow, so scans shared across plans stay correct; filters are
+    # reserved for the fresh-tree decode path)
+    install_scan_hints(op)
     if log.isEnabledFor(logging.DEBUG):
         log.debug(
             "executing task %s partition %d:\n%s",

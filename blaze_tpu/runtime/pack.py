@@ -86,38 +86,15 @@ def _build_pack(slice_rows: Optional[int], f64_pairs: bool):
 
 def _build_unpack(metas: Tuple[Tuple[str, Tuple[int, ...]], ...],
                   f64_pairs: bool):
-    """Device kernel: one uint8 buffer -> [typed arrays] per metas."""
-
-    def unpack(u8):
-        outs = []
-        off = 0
-        for dt_s, shape in metas:
-            dt = np.dtype(dt_s)
-            n = int(np.prod(shape)) if shape else 1
-            nb = _packed_nbytes(shape, dt)
-            seg = jax.lax.slice(u8, (off,), (off + nb,))
-            if dt == np.bool_:
-                arr = seg.astype(jnp.bool_)
-            elif f64_pairs and dt == np.float64:
-                pair = jax.lax.bitcast_convert_type(
-                    seg.reshape(2 * n, 4), jnp.float32
-                ).reshape(n, 2)
-                hi = pair[:, 0].astype(jnp.float64)
-                lo = pair[:, 1].astype(jnp.float64)
-                # lo==0 keeps hi exactly (preserves -0.0: -0.0 + 0.0
-                # would round to +0.0)
-                arr = jnp.where(pair[:, 1] == 0, hi, hi + lo)
-            elif dt.itemsize == 1:
-                arr = jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
-            else:
-                arr = jax.lax.bitcast_convert_type(
-                    seg.reshape(n, dt.itemsize), jnp.dtype(dt)
-                )
-            outs.append(arr.reshape(shape))
-            off += nb
-        return outs
-
-    return unpack
+    """Device kernel: one uint8 buffer -> [typed arrays] per metas
+    (contiguous layout: the `concatenate`d put_packed wire format)."""
+    at = []
+    off = 0
+    for dt_s, shape in metas:
+        nb = _packed_nbytes(shape, np.dtype(dt_s))
+        at.append((dt_s, shape, off, nb))
+        off += nb
+    return _build_unpack_at(tuple(at), f64_pairs)
 
 
 def _f64_to_pair_bytes(a: np.ndarray) -> np.ndarray:
@@ -160,6 +137,99 @@ def put_packed(arrays: Sequence[np.ndarray]) -> List[jax.Array]:
     fn = cached_kernel(
         ("h2d_unpack", metas, pairs),
         lambda: _build_unpack(metas, pairs),
+    )
+    return list(fn(dev))
+
+
+_ALIGN = 16  # segment alignment so host typed views into the buffer work
+
+
+def _aligned_metas(entries):
+    """[(dtype_str, full_shape, off, nb)] with aligned offsets + total."""
+    metas = []
+    off = 0
+    for vals, cap, _fill in entries:
+        tail = tuple(vals.shape[1:])
+        dt = np.dtype(vals.dtype)
+        nb = _packed_nbytes((cap,) + tail, dt)
+        metas.append((str(dt), (cap,) + tail, off, nb))
+        off += (nb + _ALIGN - 1) // _ALIGN * _ALIGN
+    return tuple(metas), off
+
+
+def _build_unpack_at(metas, f64_pairs: bool):
+    """Device kernel: one uint8 buffer -> typed arrays at given offsets."""
+
+    def unpack(u8):
+        outs = []
+        for dt_s, shape, off, nb in metas:
+            dt = np.dtype(dt_s)
+            n = int(np.prod(shape)) if shape else 1
+            seg = jax.lax.slice(u8, (off,), (off + nb,))
+            if dt == np.bool_:
+                arr = seg.astype(jnp.bool_)
+            elif f64_pairs and dt == np.float64:
+                pair = jax.lax.bitcast_convert_type(
+                    seg.reshape(2 * n, 4), jnp.float32
+                ).reshape(n, 2)
+                hi = pair[:, 0].astype(jnp.float64)
+                lo = pair[:, 1].astype(jnp.float64)
+                arr = jnp.where(pair[:, 1] == 0, hi, hi + lo)
+            elif dt.itemsize == 1:
+                arr = jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
+            else:
+                arr = jax.lax.bitcast_convert_type(
+                    seg.reshape(n, dt.itemsize), jnp.dtype(dt)
+                )
+            outs.append(arr.reshape(shape))
+        return outs
+
+    return unpack
+
+
+def put_packed_padded(entries: Sequence[Tuple[np.ndarray, int, int]]
+                      ) -> List[jax.Array]:
+    """Pad + pack + transfer in ONE host copy and ONE device round trip.
+
+    Each entry is `(vals, cap, fill)`: a host array whose leading axis has
+    n live rows, the padded capacity, and the scalar tail-fill value. The
+    returned device arrays have shape `(cap,) + vals.shape[1:]`. This
+    fuses the shape-bucket padding copy (previously a separate
+    `np.zeros(cap); padded[:n] = vals` per column) with the transfer
+    packing copy - the padded column is written directly into its
+    aligned segment of the single wire buffer."""
+    if not entries:
+        return []
+    pairs = _f64_pairs()
+    norm = []
+    for vals, cap, fill in entries:
+        vals = np.asarray(vals)
+        norm.append((vals, cap, fill))
+    metas, total = _aligned_metas(norm)
+    buf = np.empty(total, dtype=np.uint8)
+    for (vals, cap, fill), (dt_s, shape, off, nb) in zip(norm, metas):
+        n = vals.shape[0] if vals.ndim else 0
+        dt = np.dtype(dt_s)
+        seg = buf[off: off + nb]
+        if dt == np.bool_:
+            view = seg.reshape(shape)
+            view[:n] = vals.astype(np.uint8).reshape(vals.shape)
+            view[n:] = 1 if fill else 0
+        elif pairs and dt == np.float64:
+            pb = _f64_to_pair_bytes(np.ascontiguousarray(vals))
+            seg[: pb.size] = pb
+            # only values columns carry f64 (fill is always 0 there);
+            # zero pairs reconstruct to exactly 0.0
+            seg[pb.size:] = 0
+        else:
+            view = seg.view(dt).reshape(shape)
+            view[:n] = vals
+            view[n:] = fill
+    record("h2d_batches")
+    dev = jax.device_put(buf)
+    fn = cached_kernel(
+        ("h2d_unpack_at", metas, pairs),
+        lambda: _build_unpack_at(metas, pairs),
     )
     return list(fn(dev))
 

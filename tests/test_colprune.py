@@ -1,0 +1,328 @@
+"""Scan column pruning + host filter pushdown (planner/colprune).
+
+The invariants under test mirror the reference's scan contract: explicit
+projection indices (NativeParquetScanExec.scala:105-107) and pushed
+pruning predicates (from_proto.rs:202-212) must never change query
+results - only the bytes decoded/transferred.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col, Literal
+from blaze_tpu.exprs import ir
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    HashJoinExec,
+    JoinType,
+    LimitExec,
+    ProjectExec,
+    SortExec,
+    SortKey,
+)
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.ops.fused import fuse_pipelines
+from blaze_tpu.planner.colprune import install
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.executor import execute_task, run_plan
+from blaze_tpu.types import DataType
+
+
+@pytest.fixture(scope="module")
+def pq_file(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    n = 40_000
+    tbl = pa.table(
+        {
+            "a": rng.integers(0, 100, n).astype(np.int32),
+            "b": rng.random(n).astype(np.float32) * 100,
+            "c": rng.integers(0, 10, n).astype(np.int64),
+            "unused_wide": rng.random(n),
+            "s": pa.array(
+                [None if i % 97 == 0 else f"v{i % 5}" for i in range(n)]
+            ),
+        }
+    )
+    path = str(tmp_path_factory.mktemp("cp") / "t.parquet")
+    pq.write_table(tbl, path, row_group_size=8_000)
+    return path, tbl
+
+
+def scan(path):
+    return ParquetScanExec([[FileRange(path)]])
+
+
+def test_required_columns_analysis(pq_file):
+    path, _ = pq_file
+    sc = scan(path)
+    plan = HashAggregateExec(
+        ProjectExec(
+            FilterExec(sc, (Col("b") > 50.0) & (Col("a") < 90)),
+            [(Col("b") * 2.0, "b2")],
+        ),
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("b2")), "t")],
+        mode=AggMode.COMPLETE,
+    )
+    install(plan, with_filters=True)
+    names = [f.name for f in sc.schema]
+    req = {names[i] for i in sc._hint_required}
+    assert req == {"a", "b"}
+    assert {f[0] for f in sc._hint_filters} == {"a", "b"}
+
+
+def test_fused_plan_analysis(pq_file):
+    path, _ = pq_file
+    sc = scan(path)
+    plan = fuse_pipelines(
+        HashAggregateExec(
+            ProjectExec(
+                FilterExec(sc, Col("c") == 3),
+                [(Col("b"), "b"), (Col("a"), "a")],
+            ),
+            keys=[(Col("a"), "a")],
+            aggs=[(AggExpr(AggFn.SUM, Col("b")), "t")],
+            mode=AggMode.COMPLETE,
+        )
+    )
+    install(plan, with_filters=True)
+    names = [f.name for f in sc.schema]
+    req = {names[i] for i in sc._hint_required}
+    assert req == {"a", "b", "c"}
+    assert [f[0] for f in sc._hint_filters] == ["c"]
+
+
+def test_join_split_analysis(pq_file):
+    path, _ = pq_file
+    left, right = scan(path), scan(path)
+    plan = ProjectExec(
+        HashJoinExec(left, right, ["a"], ["a"], JoinType.INNER),
+        # position 1 = left "b"; position 5+2 = right "c"
+        [(Col("b"), "lb")],
+    )
+    install(plan)
+    lnames = [f.name for f in left.schema]
+    assert {lnames[i] for i in left._hint_required} == {"a", "b"}
+    assert {lnames[i] for i in right._hint_required} == {"a"}
+
+
+def test_unknown_op_is_conservative(pq_file):
+    path, _ = pq_file
+    sc = scan(path)
+
+    class Weird:
+        children = [sc]
+
+    install(Weird())
+    assert sc._hint_required is None
+
+
+def test_required_only_grows_across_plans(pq_file):
+    path, _ = pq_file
+    sc = scan(path)
+    p1 = ProjectExec(sc, [(Col("a"), "a")])
+    install(p1)
+    names = [f.name for f in sc.schema]
+    assert {names[i] for i in sc._hint_required} == {"a"}
+    p2 = ProjectExec(sc, [(Col("c"), "c")])
+    install(p2)
+    assert {names[i] for i in sc._hint_required} == {"a", "c"}
+
+
+def test_conflicting_filters_on_shared_scan_drop_pushdown(pq_file):
+    path, _ = pq_file
+    sc = scan(path)
+    f1 = FilterExec(sc, Col("a") > 50)
+    f2 = FilterExec(sc, Col("a") <= 50)
+    plan = HashJoinExec(
+        ProjectExec(f1, [(Col("a"), "x")]),
+        ProjectExec(f2, [(Col("a"), "y")]),
+        ["x"], ["y"], JoinType.INNER,
+    )
+    install(plan, with_filters=True)
+    assert sc._hint_filters == ()
+
+
+def q_sum_plan(path, with_unused_pred=False):
+    sc = scan(path)
+    pred = (Col("b") > 50.0) & (Col("a") < 90)
+    return HashAggregateExec(
+        ProjectExec(
+            FilterExec(sc, pred),
+            [(Col("b") * Col("c").cast(DataType.float64()), "r")],
+        ),
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("r")), "t"),
+              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+
+
+def expected_sum(tbl):
+    df = tbl.to_pandas()
+    live = (df.b > 50.0) & (df.a < 90)
+    d = df[live]
+    return float((d.b * d.c).sum()), int(live.sum())
+
+
+def test_e2e_pruned_equals_unpruned(pq_file):
+    path, tbl = pq_file
+    blob = task_to_proto(q_sum_plan(path), 0)
+    rows = list(execute_task(blob))
+    got_t = rows[0].column(0)[0].as_py()
+    got_n = rows[0].column(1)[0].as_py()
+    exp_t, exp_n = expected_sum(tbl)
+    assert got_n == exp_n
+    assert abs(got_t - exp_t) / max(abs(exp_t), 1) < 1e-6
+
+
+def test_pushdown_metrics_and_rowgroup_skip(pq_file):
+    path, tbl = pq_file
+    from blaze_tpu.ops.base import ExecContext
+
+    sc = scan(path)
+    plan = FilterExec(sc, Col("a") < 0)  # provably empty via stats
+    install(plan, with_filters=True)
+    ctx = ExecContext()
+    out = run_plan(plan, ctx)
+    assert out.num_rows == 0
+    flat = ctx.metrics.flatten()
+    total_in = sum(
+        c.get("input_rows", 0) for c in flat.values()
+    )
+    assert total_in == 0  # every row group pruned by stats
+
+
+def test_count_star_only_scan(pq_file):
+    path, tbl = pq_file
+    plan = HashAggregateExec(
+        scan(path), keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    assert rows[0].column(0)[0].as_py() == tbl.num_rows
+
+
+def test_string_filter_pushdown_with_nulls(pq_file):
+    path, tbl = pq_file
+    plan = HashAggregateExec(
+        FilterExec(scan(path), Col("s") == "v2"),
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    df = tbl.to_pandas()
+    assert rows[0].column(0)[0].as_py() == int((df.s == "v2").sum())
+
+
+def test_sort_limit_requirements(pq_file):
+    path, tbl = pq_file
+    sc = scan(path)
+    plan = LimitExec(
+        SortExec(
+            ProjectExec(sc, [(Col("a"), "a"), (Col("b"), "b")]),
+            [SortKey(Col("b"), True, True)],
+        ),
+        5,
+    )
+    install(plan)
+    names = [f.name for f in sc.schema]
+    assert {names[i] for i in sc._hint_required} == {"a", "b"}
+    out = run_plan(plan).to_pandas()
+    exp = (
+        tbl.to_pandas()[["a", "b"]]
+        .sort_values("b").head(5).reset_index(drop=True)
+    )
+    assert np.allclose(out.b.values, exp.b.values)
+
+
+def test_nan_rows_survive_consistently(tmp_path):
+    n = 1000
+    rng = np.random.default_rng(3)
+    b = rng.random(n).astype(np.float32)
+    b[::7] = np.nan
+    tbl = pa.table({"a": np.arange(n, dtype=np.int32), "b": b})
+    path = str(tmp_path / "nan.parquet")
+    pq.write_table(tbl, path)
+    plan = HashAggregateExec(
+        FilterExec(scan(path), Col("b") > 0.5),
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    assert rows[0].column(0)[0].as_py() == int((b > 0.5).sum())
+
+
+def test_decimal_literal_not_pushable(tmp_path):
+    """Engine decimal literals are i64-unscaled; pyarrow would compare
+    them against real decimal values - must never push (review repro:
+    count came back 0 instead of 50)."""
+    import decimal
+
+    n = 100
+    vals = [decimal.Decimal(i + 1) / 1 for i in range(n)]  # 1.00..100.00
+    tbl = pa.table({"price": pa.array(
+        [decimal.Decimal(f"{i + 1}.00") for i in range(n)],
+        type=pa.decimal128(9, 2))})
+    path = str(tmp_path / "dec.parquet")
+    pq.write_table(tbl, path)
+    sc = scan(path)
+    from blaze_tpu.types import DataType as DT
+
+    plan = HashAggregateExec(
+        FilterExec(
+            sc, Col("price") > Literal(5000, DT.decimal(9, 2))
+        ),
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    assert rows[0].column(0)[0].as_py() == 50
+    assert getattr(sc, "_hint_filters", ()) == ()
+
+
+def test_narrowing_cast_not_pushable(tmp_path):
+    """cast(float->int) truncates on the device; pushing the uncast
+    comparison would drop rows the device keeps (review repro: count 1
+    instead of 3)."""
+    tbl = pa.table({"b": np.array([3.7, 3.2, 4.0, 2.9, 3.0])})
+    path = str(tmp_path / "cast.parquet")
+    pq.write_table(tbl, path)
+    from blaze_tpu.types import DataType as DT
+
+    plan = HashAggregateExec(
+        FilterExec(
+            scan(path), Col("b").cast(DT.int32()) == 3
+        ),
+        keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    assert rows[0].column(0)[0].as_py() == 3
+
+
+def test_widening_cast_still_pushable(pq_file):
+    """float32 -> float64 widening keeps comparisons identical, so the
+    conjunct stays pushable."""
+    path, tbl = pq_file
+    sc = scan(path)
+    from blaze_tpu.types import DataType as DT
+
+    plan = FilterExec(sc, Col("b").cast(DT.float64()) > 50.0)
+    install(plan, with_filters=True)
+    assert [f[0] for f in sc._hint_filters] == ["b"]

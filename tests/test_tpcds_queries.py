@@ -1311,3 +1311,215 @@ def oracle_q51(t):
 
 
 ORACLES["q51"] = oracle_q51
+
+
+# ---------------------------------------------------------------------------
+# q53/q63/q89/q98 oracles
+# ---------------------------------------------------------------------------
+
+def _oracle_dev_window(t, group_extra, window_part, month_col,
+                       sum_col="ss_sales_price"):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = _merge(t["store_sales"], dd[["d_date_sk", month_col]],
+               "ss_sold_date_sk", "d_date_sk")
+    it = t["item"]
+    it = it[it.i_category.isin(["Books", "Home", "Sports"])]
+    icols = [c for c in ["i_item_sk", "i_manufact_id", "i_manager_id",
+                         "i_category", "i_class", "i_brand"]]
+    j = j.merge(it[icols], left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(
+        t["store"][["s_store_sk", "s_store_name", "s_company_name"]],
+        left_on="ss_store_sk", right_on="s_store_sk",
+    )
+    keys = group_extra + [month_col]
+    agg = (
+        j.groupby(keys, dropna=False)[sum_col].sum()
+        .reset_index(name="sum_sales")
+    )
+    agg["avg_sales"] = agg.groupby(window_part, dropna=False)[
+        "sum_sales"].transform("mean")
+    keep = (agg.avg_sales > 0) & (
+        (agg.sum_sales - agg.avg_sales).abs() / agg.avg_sales > 0.1
+    )
+    return agg[keep]
+
+
+def oracle_q53(t):
+    a = _oracle_dev_window(
+        t, ["i_manufact_id"], ["i_manufact_id"], "d_qoy")
+    out = a.sort_values(
+        ["avg_sales", "sum_sales", "i_manufact_id"]).head(100)
+    return out[["i_manufact_id", "sum_sales", "avg_sales"]].reset_index(
+        drop=True)
+
+
+def oracle_q63(t):
+    a = _oracle_dev_window(
+        t, ["i_manager_id"], ["i_manager_id"], "d_moy")
+    out = a.sort_values(
+        ["i_manager_id", "avg_sales", "sum_sales"]).head(100)
+    return out[["i_manager_id", "sum_sales", "avg_sales"]].reset_index(
+        drop=True)
+
+
+def oracle_q89(t):
+    a = _oracle_dev_window(
+        t,
+        ["i_category", "i_class", "i_brand", "s_store_name",
+         "s_company_name"],
+        ["i_category", "i_brand", "s_store_name", "s_company_name"],
+        "d_moy",
+    )
+    a = a.assign(diff=a.sum_sales - a.avg_sales)
+    out = a.sort_values(
+        ["diff", "s_store_name", "i_category", "i_class", "i_brand",
+         "d_moy"]).head(100)
+    return out[
+        ["i_category", "i_class", "i_brand", "s_store_name",
+         "s_company_name", "d_moy", "sum_sales", "avg_sales"]
+    ].reset_index(drop=True)
+
+
+def oracle_q98(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year == 1999) & (dd.d_moy <= 2)][["d_date_sk"]]
+    it = t["item"]
+    it = it[it.i_category.isin(["Books", "Home", "Sports"])]
+    j = _merge(t["store_sales"], dd, "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(
+        it[["i_item_sk", "i_item_id", "i_item_desc", "i_category",
+            "i_class", "i_current_price"]],
+        left_on="ss_item_sk", right_on="i_item_sk",
+    )
+    rev = (
+        j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
+                   "i_current_price"], dropna=False)
+        .ss_ext_sales_price.sum().reset_index(name="itemrevenue")
+    )
+    rev["classrev"] = rev.groupby("i_class", dropna=False)[
+        "itemrevenue"].transform("sum")
+    rev["revenueratio"] = rev.itemrevenue * 100.0 / rev.classrev
+    out = rev.sort_values(
+        ["i_category", "i_class", "i_item_id", "i_item_desc",
+         "revenueratio"]).head(100)
+    return out[
+        ["i_item_id", "i_item_desc", "i_category", "i_class",
+         "i_current_price", "itemrevenue", "revenueratio"]
+    ].reset_index(drop=True)
+
+
+ORACLES.update({
+    "q53": oracle_q53, "q63": oracle_q63, "q89": oracle_q89,
+    "q98": oracle_q98,
+})
+
+
+# ---------------------------------------------------------------------------
+# q41/q44/q47/q57 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q41(t):
+    it = t["item"]
+    b1 = (it.i_color.isin(["red", "blue"])
+          & it.i_units.isin(["Oz", "Case"])
+          & it.i_size.isin(["small", "large"]))
+    b2 = (it.i_color.isin(["green", "navy"])
+          & it.i_units.isin(["Ton", "Each"])
+          & it.i_size.isin(["medium", "petite"]))
+    manufs = set(it[b1 | b2].i_manufact)
+    i1 = it[(it.i_manufact_id >= 100) & (it.i_manufact_id <= 140)]
+    i1 = i1[i1.i_manufact.isin(manufs)]
+    names = sorted(i1.i_product_name.unique())[:100]
+    return pd.DataFrame({"i_product_name": names})
+
+
+def oracle_q44(t):
+    ss = t["store_sales"]
+    base = ss[ss.ss_store_sk == 4]
+    nullavg = base[base.ss_customer_sk.isna()].ss_net_profit.mean()
+    by_item = (
+        base.groupby("ss_item_sk").ss_net_profit.mean()
+        .reset_index(name="rank_col")
+    )
+    q = by_item[by_item.rank_col > 0.9 * nullavg].copy()
+    q_asc = q.sort_values("rank_col", ascending=True).reset_index(
+        drop=True)
+    q_asc["rnk"] = q_asc.rank_col.rank(method="min").astype(int)
+    q_desc = q.sort_values("rank_col", ascending=False).reset_index(
+        drop=True)
+    q_desc["rnk"] = q_desc.rank_col.rank(
+        method="min", ascending=False).astype(int)
+    a = q_asc[q_asc.rnk <= 10][["rnk", "ss_item_sk"]]
+    d = q_desc[q_desc.rnk <= 10][["rnk", "ss_item_sk"]]
+    m = a.merge(d, on="rnk", suffixes=("_a", "_d"))
+    names = t["item"][["i_item_sk", "i_product_name"]]
+    m = m.merge(names, left_on="ss_item_sk_a", right_on="i_item_sk")
+    m = m.rename(columns={"i_product_name": "best"}).drop(
+        columns=["i_item_sk"])
+    m = m.merge(names, left_on="ss_item_sk_d", right_on="i_item_sk")
+    m = m.rename(columns={"i_product_name": "worst"})
+    out = m.sort_values("rnk")
+    return pd.DataFrame({
+        "a_rnk": out.rnk.astype(np.int64).values,
+        "best_performing": out.best.values,
+        "worst_performing": out.worst.values,
+    })
+
+
+def _oracle_q47_like(t, sales, date_col, item_fk, sum_col, entity,
+                     entity_sk, entity_fk, entity_cols):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_year >= 1998) & (dd.d_year <= 2000)]
+    j = _merge(t[sales], dd[["d_date_sk", "d_year", "d_moy"]],
+               date_col, "d_date_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_category", "i_brand"]],
+                left_on=item_fk, right_on="i_item_sk")
+    j = j.merge(t[entity][[entity_sk] + entity_cols],
+                left_on=entity_fk, right_on=entity_sk)
+    keys = ["i_category", "i_brand"] + entity_cols
+    agg = (
+        j.groupby(keys + ["d_year", "d_moy"], dropna=False)[sum_col]
+        .sum().reset_index(name="sum_sales")
+    )
+    agg["avg_monthly_sales"] = agg.groupby(
+        keys + ["d_year"], dropna=False
+    ).sum_sales.transform("mean")
+    agg = agg.sort_values(keys + ["d_year", "d_moy"])
+    g = agg.groupby(keys, dropna=False)
+    agg["psum"] = g.sum_sales.shift(1)
+    agg["nsum"] = g.sum_sales.shift(-1)
+    kept = agg[
+        (agg.d_year == 1999)
+        & (agg.avg_monthly_sales > 0)
+        & ((agg.sum_sales - agg.avg_monthly_sales).abs()
+           / agg.avg_monthly_sales > 0.1)
+    ].copy()
+    kept["diff"] = kept.sum_sales - kept.avg_monthly_sales
+    out = kept.sort_values(
+        ["diff"] + keys + ["d_year", "d_moy"]).head(100)
+    return out[
+        keys + ["d_year", "d_moy", "sum_sales", "avg_monthly_sales",
+                "psum", "nsum"]
+    ].reset_index(drop=True)
+
+
+def oracle_q47(t):
+    return _oracle_q47_like(
+        t, "store_sales", "ss_sold_date_sk", "ss_item_sk",
+        "ss_sales_price", "store", "s_store_sk", "ss_store_sk",
+        ["s_store_name", "s_company_name"],
+    )
+
+
+def oracle_q57(t):
+    return _oracle_q47_like(
+        t, "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+        "cs_sales_price", "call_center", "cc_call_center_sk",
+        "cs_call_center_sk", ["cc_name"],
+    )
+
+
+ORACLES.update({
+    "q41": oracle_q41, "q44": oracle_q44, "q47": oracle_q47,
+    "q57": oracle_q57,
+})

@@ -2565,3 +2565,421 @@ def q51(s, flavor):
 
 
 QUERIES["q51"] = q51
+
+
+# ---------------------------------------------------------------------------
+# q41/q44/q47/q53/q57/q63/q89/q98 block (manager/reporting + window tier)
+# ---------------------------------------------------------------------------
+
+_GEN_V2 = gen_tables
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V2(seed)
+    rng = np.random.default_rng(seed + 7)
+    dd = t["date_dim"]
+    dd["d_qoy"] = ((dd.d_moy - 1) // 3 + 1).astype(np.int32)
+    it = t["item"]
+    n_it = len(it)
+    it["i_manufact"] = np.array(
+        [f"manufact_{m % 50}" for m in it.i_manufact_id], dtype=object)
+    it["i_product_name"] = np.array(
+        [f"product_{k:06d}" for k in it.i_item_sk], dtype=object)
+    it["i_color"] = np.array(
+        ["red", "blue", "green", "navy", "khaki", "white"], dtype=object
+    )[rng.integers(0, 6, n_it)]
+    it["i_size"] = np.array(
+        ["small", "medium", "large", "petite", "N/A"], dtype=object
+    )[rng.integers(0, 5, n_it)]
+    it["i_units"] = np.array(
+        ["Oz", "Bunch", "Ton", "Case", "Each"], dtype=object
+    )[rng.integers(0, 5, n_it)]
+    st = t["store"]
+    st["s_company_name"] = np.array(
+        [f"company_{i % 3}" for i in range(len(st))], dtype=object)
+    cs = t["catalog_sales"]
+    cs["cs_call_center_sk"] = rng.integers(0, 4, len(cs)).astype(
+        np.int32)
+    t["call_center"] = pd.DataFrame(
+        {
+            "cc_call_center_sk": np.arange(4, dtype=np.int32),
+            "cc_name": [f"call_center_{i}" for i in range(4)],
+        }
+    )
+    return t
+
+
+def _dev_window_query(s, flavor, group_extra, window_part, month_col,
+                      sum_col="ss_sales_price"):
+    """Shared q53/q63/q89 shape: grouped store sales with a per-window
+    AVG and a >10% deviation filter (the reference plans these as
+    aggregate -> window -> filter)."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    j = _join(flavor, s["store"](), j, ["s_store_sk"], ["ss_store_sk"])
+    cat_filter = InList(
+        Col("i_category"),
+        (Literal("Books", DataType.utf8()),
+         Literal("Home", DataType.utf8()),
+         Literal("Sports", DataType.utf8())),
+    )
+    j = FilterExec(j, cat_filter)
+    agg = _agg(
+        j,
+        keys=[(Col(c), c) for c in group_extra + [month_col]],
+        aggs=[(AggExpr(AggFn.SUM, Col(sum_col)), "sum_sales")],
+    )
+    w = WindowExec(
+        agg,
+        partition_by=[Col(c) for c in window_part],
+        order_by=[],
+        functions=[WindowFn("avg", Col("sum_sales"), "avg_sales")],
+    )
+    dev = FilterExec(
+        w,
+        If(
+            Col("avg_sales") > 0.0,
+            ScalarFn(
+                "abs", (Col("sum_sales") - Col("avg_sales"),)
+            ) / Col("avg_sales") > 0.1,
+            Literal(None, DataType.bool_()),
+        ),
+    )
+    return dev
+
+
+def q53(s, flavor):
+    """TPC-DS q53: manufacturer quarterly sales vs the manufacturer's
+    average, keeping >10% deviations (aggregate -> window AVG -> HAVING,
+    the same decorrelation Spark plans)."""
+    dev = _dev_window_query(
+        s, flavor, ["i_manufact_id"], ["i_manufact_id"], "d_qoy")
+    out = _project_names(
+        dev, ["i_manufact_id", "sum_sales", "avg_sales"])
+    return _sorted_limit(
+        out,
+        [SortKey(Col("avg_sales"), True, True),
+         SortKey(Col("sum_sales"), True, True),
+         SortKey(Col("i_manufact_id"), True, True)],
+        100,
+    )
+
+
+def q63(s, flavor):
+    """TPC-DS q63: manager monthly sales vs manager average (q53's
+    shape keyed by i_manager_id / d_moy)."""
+    dev = _dev_window_query(
+        s, flavor, ["i_manager_id"], ["i_manager_id"], "d_moy")
+    out = _project_names(
+        dev, ["i_manager_id", "sum_sales", "avg_sales"])
+    return _sorted_limit(
+        out,
+        [SortKey(Col("i_manager_id"), True, True),
+         SortKey(Col("avg_sales"), True, True),
+         SortKey(Col("sum_sales"), True, True)],
+        100,
+    )
+
+
+def q89(s, flavor):
+    """TPC-DS q89: monthly (category,class,brand,store) sales vs the
+    (category,brand,store,company) yearly average."""
+    dev = _dev_window_query(
+        s, flavor,
+        ["i_category", "i_class", "i_brand", "s_store_name",
+         "s_company_name"],
+        ["i_category", "i_brand", "s_store_name", "s_company_name"],
+        "d_moy",
+    )
+    out = _project_names(
+        dev,
+        ["i_category", "i_class", "i_brand", "s_store_name",
+         "s_company_name", "d_moy", "sum_sales", "avg_sales"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("sum_sales") - Col("avg_sales"), True, True),
+         SortKey(Col("s_store_name"), True, True),
+         SortKey(Col("i_category"), True, True),
+         SortKey(Col("i_class"), True, True),
+         SortKey(Col("i_brand"), True, True),
+         SortKey(Col("d_moy"), True, True)],
+        100,
+    )
+
+
+def q98(s, flavor):
+    """TPC-DS q98: store revenue by item with share-of-class ratio
+    (store twin of q12/q20; window SUM over class via self-join-free
+    two-level aggregate)."""
+    dd = FilterExec(
+        s["date_dim"](),
+        (Col("d_year") == 1999) & (Col("d_moy") <= 2),
+    )
+    it = FilterExec(
+        s["item"](),
+        InList(Col("i_category"),
+               (Literal("Books", DataType.utf8()),
+                Literal("Home", DataType.utf8()),
+                Literal("Sports", DataType.utf8()))),
+    )
+    j = _join(flavor, dd, s["store_sales"](),
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    j = _join(flavor, it, j, ["i_item_sk"], ["ss_item_sk"])
+    rev = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id"),
+              (Col("i_item_desc"), "i_item_desc"),
+              (Col("i_category"), "i_category"),
+              (Col("i_class"), "i_class"),
+              (Col("i_current_price"), "i_current_price")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+               "itemrevenue")],
+    )
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    w = WindowExec(
+        rev,
+        partition_by=[Col("i_class")],
+        order_by=[],
+        functions=[WindowFn("sum", Col("itemrevenue"), "classrev")],
+    )
+    out = ProjectExec(
+        w,
+        [(Col("i_item_id"), "i_item_id"),
+         (Col("i_item_desc"), "i_item_desc"),
+         (Col("i_category"), "i_category"),
+         (Col("i_class"), "i_class"),
+         (Col("i_current_price"), "i_current_price"),
+         (Col("itemrevenue"), "itemrevenue"),
+         (Col("itemrevenue") * 100.0 / Col("classrev"),
+          "revenueratio")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("i_category"), True, True),
+         SortKey(Col("i_class"), True, True),
+         SortKey(Col("i_item_id"), True, True),
+         SortKey(Col("i_item_desc"), True, True),
+         SortKey(Col("revenueratio"), True, True)],
+        100,
+    )
+
+
+QUERIES.update({"q53": q53, "q63": q63, "q89": q89, "q98": q98})
+
+
+def q41(s, flavor):
+    """TPC-DS q41: distinct product names whose manufacturer also makes
+    items matching a color/units/size disjunction (correlated EXISTS
+    decorrelated into a count-per-manufact semi join)."""
+    def slit(v):
+        return Literal(v, DataType.utf8())
+
+    branch1 = (
+        InList(Col("i_color"), (slit("red"), slit("blue")))
+        & InList(Col("i_units"), (slit("Oz"), slit("Case")))
+        & InList(Col("i_size"), (slit("small"), slit("large")))
+    )
+    branch2 = (
+        InList(Col("i_color"), (slit("green"), slit("navy")))
+        & InList(Col("i_units"), (slit("Ton"), slit("Each")))
+        & InList(Col("i_size"), (slit("medium"), slit("petite")))
+    )
+    qual = FilterExec(s["item"](), branch1 | branch2)
+    manufs = ProjectExec(
+        _agg(
+            qual,
+            keys=[(Col("i_manufact"), "q_manufact")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "item_cnt")],
+        ),
+        [(Col("q_manufact"), "q_manufact")],
+    )
+    i1 = FilterExec(
+        s["item"](),
+        (Col("i_manufact_id") >= 100) & (Col("i_manufact_id") <= 140),
+    )
+    joined = _semi(flavor, i1, manufs, ["i_manufact"], ["q_manufact"])
+    distinct = _agg(
+        joined,
+        keys=[(Col("i_product_name"), "i_product_name")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "_c")],
+    )
+    return _sorted_limit(
+        _project_names(distinct, ["i_product_name"]),
+        [SortKey(Col("i_product_name"), True, True)],
+        100,
+    )
+
+
+def q44(s, flavor):
+    """TPC-DS q44: best and worst 10 items by average store net profit
+    at one store, thresholded by 0.9x the null-customer average (scalar
+    subquery via constant-key join), asc/desc ranks aligned."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    base = FilterExec(s["store_sales"](), Col("ss_store_sk") == 4)
+    thr = ProjectExec(
+        _agg(
+            FilterExec(
+                s["store_sales"](),
+                (Col("ss_store_sk") == 4)
+                & ~IsNotNull(Col("ss_customer_sk")),
+            ),
+            keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("ss_net_profit")), "nullavg")],
+        ),
+        [(Literal(1, DataType.int32()), "tk"),
+         (Col("nullavg") * 0.9, "threshold")],
+    )
+    by_item = ProjectExec(
+        _agg(
+            base,
+            keys=[(Col("ss_item_sk"), "item_sk")],
+            aggs=[(AggExpr(AggFn.AVG, Col("ss_net_profit")),
+                   "rank_col")],
+        ),
+        [(Col("item_sk"), "item_sk"), (Col("rank_col"), "rank_col"),
+         (Literal(1, DataType.int32()), "jk")],
+    )
+    qualified = ProjectExec(
+        FilterExec(
+            _join(flavor, thr, by_item, ["tk"], ["jk"]),
+            Col("rank_col") > Col("threshold"),
+        ),
+        [(Col("item_sk"), "item_sk"), (Col("rank_col"), "rank_col")],
+    )
+
+    def ranked(asc, out):
+        return ProjectExec(
+            FilterExec(
+                WindowExec(
+                    qualified,
+                    partition_by=[],
+                    order_by=[SortKey(Col("rank_col"), asc, True)],
+                    functions=[WindowFn("rank", None, "rnk")],
+                ),
+                Col("rnk") <= 10,
+            ),
+            [(Col("rnk").cast(DataType.int64()), f"{out}_rnk"),
+             (Col("item_sk"), f"{out}_item")],
+        )
+
+    asc = ranked(True, "a")
+    desc = ranked(False, "d")
+    both = _join(flavor, asc, desc, ["a_rnk"], ["d_rnk"])
+    it1 = ProjectExec(
+        s["item"](),
+        [(Col("i_item_sk"), "i1_sk"),
+         (Col("i_product_name"), "best_performing")],
+    )
+    it2 = ProjectExec(
+        s["item"](),
+        [(Col("i_item_sk"), "i2_sk"),
+         (Col("i_product_name"), "worst_performing")],
+    )
+    j = _join(flavor, it1, both, ["i1_sk"], ["a_item"])
+    j = _join(flavor, it2, j, ["i2_sk"], ["d_item"])
+    out = _project_names(
+        j, ["a_rnk", "best_performing", "worst_performing"])
+    return SortExec(out, [SortKey(Col("a_rnk"), True, True)])
+
+
+def _q47_like(s, flavor, sales, date_col, sum_col, entity_scan,
+              entity_sk, entity_fk, entity_cols):
+    """Shared q47/q57 shape: monthly sums per (item brand x entity),
+    yearly window AVG, lag/lead neighbours, >10% deviation in the
+    center year."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") >= 1998) & (Col("d_year") <= 2000),
+        ),
+        s[sales](),
+        ["d_date_sk"], [date_col],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"],
+              [date_col.split("_")[0] + "_item_sk"])
+    j = _join(flavor, entity_scan(), j, [entity_sk], [entity_fk])
+    agg = _agg(
+        j,
+        keys=[(Col("i_category"), "i_category"),
+              (Col("i_brand"), "i_brand")]
+        + [(Col(c), c) for c in entity_cols]
+        + [(Col("d_year"), "d_year"), (Col("d_moy"), "d_moy")],
+        aggs=[(AggExpr(AggFn.SUM, Col(sum_col)), "sum_sales")],
+    )
+    part = ["i_category", "i_brand"] + entity_cols
+    w = WindowExec(
+        agg,
+        partition_by=[Col(c) for c in part + ["d_year"]],
+        order_by=[],
+        functions=[WindowFn("avg", Col("sum_sales"),
+                            "avg_monthly_sales")],
+    )
+    w = WindowExec(
+        w,
+        partition_by=[Col(c) for c in part],
+        order_by=[SortKey(Col("d_year"), True, True),
+                  SortKey(Col("d_moy"), True, True)],
+        functions=[WindowFn("lag", Col("sum_sales"), "psum"),
+                   WindowFn("lead", Col("sum_sales"), "nsum")],
+    )
+    kept = FilterExec(
+        w,
+        (Col("d_year") == 1999)
+        & (Col("avg_monthly_sales") > 0.0)
+        & (
+            ScalarFn(
+                "abs", (Col("sum_sales") - Col("avg_monthly_sales"),)
+            ) / Col("avg_monthly_sales") > 0.1
+        ),
+    )
+    out = _project_names(
+        kept,
+        part + ["d_year", "d_moy", "sum_sales", "avg_monthly_sales",
+                "psum", "nsum"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("sum_sales") - Col("avg_monthly_sales"), True,
+                 True)]
+        + [SortKey(Col(c), True, True) for c in part]
+        + [SortKey(Col("d_year"), True, True),
+           SortKey(Col("d_moy"), True, True)],
+        100,
+    )
+
+
+def q47(s, flavor):
+    """TPC-DS q47: store monthly brand sales vs yearly average with
+    previous/next month neighbours (v1/v2 self-joins planned as
+    lag/lead windows)."""
+    return _q47_like(
+        s, flavor, "store_sales", "ss_sold_date_sk", "ss_sales_price",
+        s["store"], "s_store_sk", "ss_store_sk",
+        ["s_store_name", "s_company_name"],
+    )
+
+
+def q57(s, flavor):
+    """TPC-DS q57: q47's shape for catalog sales by call center."""
+    return _q47_like(
+        s, flavor, "catalog_sales", "cs_sold_date_sk",
+        "cs_sales_price",
+        s["call_center"], "cc_call_center_sk", "cs_call_center_sk",
+        ["cc_name"],
+    )
+
+
+QUERIES.update({"q41": q41, "q44": q44, "q47": q47, "q57": q57})
