@@ -358,20 +358,35 @@ class ColumnBatch:
         return ColumnBatch.from_arrow(rb)
 
 
-_PLACEHOLDER_CACHE: dict = {}
+import collections
+
+_PLACEHOLDER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_PLACEHOLDER_CACHE_CAP = 32
+_PLACEHOLDER_TRACK_ID = id(_PLACEHOLDER_CACHE)
 
 
 def _placeholder(cap: int, dtype: DataType) -> jax.Array:
     """Shared all-zeros device column for pruned (never-read) scan
     positions. Safe to share across batches/plans: engine kernels are
-    pure functions and never mutate input buffers."""
+    pure functions and never mutate input buffers. LRU-bounded and
+    accounted in the device-memory tracker so grace/spill budgeting
+    sees the pinned HBM."""
     phys = dtype.physical_dtype()
     shape = (cap, 2) if dtype.is_wide_decimal else (cap,)
     key = (shape, str(phys))
     arr = _PLACEHOLDER_CACHE.get(key)
-    if arr is None:
-        arr = jnp.zeros(shape, dtype=phys)
-        _PLACEHOLDER_CACHE[key] = arr
+    if arr is not None:
+        _PLACEHOLDER_CACHE.move_to_end(key)
+        return arr
+    from blaze_tpu.runtime.memory import get_device_tracker
+
+    arr = jnp.zeros(shape, dtype=phys)
+    _PLACEHOLDER_CACHE[key] = arr
+    tracker = get_device_tracker()
+    tracker.track(_PLACEHOLDER_TRACK_ID, int(arr.nbytes))
+    while len(_PLACEHOLDER_CACHE) > _PLACEHOLDER_CACHE_CAP:
+        _, old = _PLACEHOLDER_CACHE.popitem(last=False)
+        tracker.release(_PLACEHOLDER_TRACK_ID, int(old.nbytes))
     return arr
 
 

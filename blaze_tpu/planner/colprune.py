@@ -149,9 +149,8 @@ def pushable_conjunct(e: ir.Expr, schema: Schema
     # against the REAL arrow values - never pushable as-is
     if field.dtype.id.name in ("DECIMAL", "TIMESTAMP_US", "DATE32"):
         return None
-    if isinstance(lit.dtype, object) and getattr(
-        lit.dtype, "id", None
-    ) is not None and lit.dtype.id.name in (
+    lit_id = getattr(lit.dtype, "id", None)
+    if lit_id is not None and lit_id.name in (
         "DECIMAL", "TIMESTAMP_US", "DATE32"
     ):
         return None

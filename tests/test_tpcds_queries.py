@@ -1523,3 +1523,223 @@ ORACLES.update({
     "q41": oracle_q41, "q44": oracle_q44, "q47": oracle_q47,
     "q57": oracle_q57,
 })
+
+
+# ---------------------------------------------------------------------------
+# q46/q59/q68/q73/q79/q88/q90/q96 oracles
+# ---------------------------------------------------------------------------
+
+def _oracle_city_tickets(t, hd_mask_fn, amt_col, profit_col):
+    dd = t["date_dim"]
+    dd = dd[dd.d_dow.isin([6, 0]) & dd.d_year.between(1998, 2000)]
+    st = t["store"]
+    st = st[st.s_city.isin(["Midway", "Fairview"])]
+    hd = t["household_demographics"]
+    hd = hd[hd_mask_fn(hd)]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(st[["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(hd[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = _merge(j, t["customer_address"][["ca_address_sk", "ca_city"]],
+               "ss_addr_sk", "ca_address_sk")
+    j = j.rename(columns={"ca_city": "bought_city"})
+    per = (
+        j.groupby(["ss_ticket_number", "ss_customer_sk",
+                   "bought_city"], dropna=False)
+        .agg(amt=(amt_col, "sum"), profit=(profit_col, "sum"))
+        .reset_index()
+    )
+    per = _merge(per, t["customer"], "ss_customer_sk", "c_customer_sk")
+    per = per.merge(
+        t["customer_address"][["ca_address_sk", "ca_city"]],
+        left_on="c_current_addr_sk", right_on="ca_address_sk",
+    ).rename(columns={"ca_city": "home_city"})
+    return per[per.home_city != per.bought_city]
+
+
+def oracle_q46(t):
+    per = _oracle_city_tickets(
+        t, lambda hd: (hd.hd_dep_count == 4) | (hd.hd_vehicle_count == 3),
+        "ss_coupon_amt", "ss_net_profit",
+    )
+    out = per.sort_values(
+        ["c_last_name", "c_first_name", "bought_city",
+         "ss_ticket_number"], na_position="first",
+    ).head(100)
+    return out[
+        ["c_last_name", "c_first_name", "ss_ticket_number",
+         "bought_city", "amt", "profit"]
+    ].reset_index(drop=True)
+
+
+def oracle_q68(t):
+    per = _oracle_city_tickets(
+        t, lambda hd: (hd.hd_dep_count == 5) | (hd.hd_vehicle_count == 3),
+        "ss_ext_sales_price", "ss_ext_list_price",
+    )
+    out = per.sort_values(
+        ["c_last_name", "ss_ticket_number"], na_position="first",
+    ).head(100)
+    return out[
+        ["c_last_name", "c_first_name", "ss_ticket_number",
+         "bought_city", "amt", "profit"]
+    ].reset_index(drop=True)
+
+
+def oracle_q79(t):
+    dd = t["date_dim"]
+    dd = dd[(dd.d_dow == 1) & dd.d_year.between(1998, 2000)]
+    hd = t["household_demographics"]
+    hd = hd[(hd.hd_dep_count == 6) | (hd.hd_vehicle_count > 2)]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_city"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(hd[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    per = (
+        j.groupby(["ss_ticket_number", "ss_customer_sk", "s_city"],
+                  dropna=False)
+        .agg(amt=("ss_coupon_amt", "sum"),
+             profit=("ss_net_profit", "sum"))
+        .reset_index()
+    )
+    per = _merge(per, t["customer"], "ss_customer_sk", "c_customer_sk")
+    out = per.sort_values(
+        ["c_last_name", "c_first_name", "s_city", "profit",
+         "ss_ticket_number"], na_position="first",
+    ).head(100)
+    return out[
+        ["c_last_name", "c_first_name", "s_city", "profit",
+         "ss_ticket_number", "amt"]
+    ].reset_index(drop=True)
+
+
+def oracle_q73(t):
+    dd = t["date_dim"]
+    dd = dd[dd.d_dom.between(1, 2) & dd.d_year.between(1998, 2000)]
+    hd = t["household_demographics"]
+    hd = hd[hd.hd_buy_potential.isin([">10000", "0-500"])
+            & (hd.hd_vehicle_count > 0)]
+    j = _merge(t["store_sales"], dd[["d_date_sk"]],
+               "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(hd[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    per = (
+        j.groupby(["ss_ticket_number", "ss_customer_sk"], dropna=False)
+        .size().reset_index(name="cnt")
+    )
+    per = per[per.cnt.between(1, 5)]
+    per = _merge(per, t["customer"], "ss_customer_sk", "c_customer_sk")
+    out = per.sort_values(
+        ["cnt", "c_last_name", "ss_ticket_number"],
+        ascending=[False, True, True], na_position="first",
+    )
+    return out[
+        ["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]
+    ].reset_index(drop=True)
+
+
+def oracle_q88(t):
+    ss = t["store_sales"]
+    td = t["time_dim"]
+    hdt = t["household_demographics"]
+    stq = t["store"][t["store"].s_store_name == "store_0"]
+    bands = [
+        (8, 30, 9, 0, 4), (9, 0, 9, 30, 3), (9, 30, 10, 0, 2),
+        (10, 0, 10, 30, 4), (10, 30, 11, 0, 3), (11, 0, 11, 30, 2),
+        (11, 30, 12, 0, 4), (12, 0, 12, 30, 3),
+    ]
+    row = {}
+    names = ["h8_30_to_9", "h9_to_9_30", "h9_30_to_10", "h10_to_10_30",
+             "h10_30_to_11", "h11_to_11_30", "h11_30_to_12",
+             "h12_to_12_30"]
+    for (h1, m1, h2, m2, dep), nm in zip(bands, names):
+        tsel = td[
+            ((td.t_hour > h1) | ((td.t_hour == h1) & (td.t_minute >= m1)))
+            & ((td.t_hour < h2) | ((td.t_hour == h2) & (td.t_minute < m2)))
+        ]
+        hsel = hdt[hdt.hd_dep_count == dep]
+        j = ss.merge(tsel[["t_time_sk"]], left_on="ss_sold_time_sk",
+                     right_on="t_time_sk")
+        j = j.merge(hsel[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                    right_on="hd_demo_sk")
+        j = j.merge(stq[["s_store_sk"]], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        row[nm] = len(j)
+    return pd.DataFrame([row])
+
+
+def oracle_q90(t):
+    ws = t["web_sales"]
+    td = t["time_dim"]
+    wp = t["web_page"]
+    wp = wp[wp.wp_char_count.between(4500, 5500)]
+
+    def cnt(h_lo, h_hi):
+        tsel = td[(td.t_hour >= h_lo) & (td.t_hour < h_hi)]
+        j = ws.merge(tsel[["t_time_sk"]], left_on="ws_sold_time_sk",
+                     right_on="t_time_sk")
+        j = j.merge(wp[["wp_web_page_sk"]], left_on="ws_web_page_sk",
+                    right_on="wp_web_page_sk")
+        return len(j)
+
+    return pd.DataFrame([{"am_pm_ratio": cnt(7, 9) / cnt(19, 21)}])
+
+
+def oracle_q96(t):
+    ss = t["store_sales"]
+    td = t["time_dim"]
+    td = td[(td.t_hour == 20) & (td.t_minute >= 30)]
+    hd = t["household_demographics"]
+    hd = hd[hd.hd_dep_count == 6]
+    stq = t["store"][t["store"].s_store_name == "store_1"]
+    j = ss.merge(td[["t_time_sk"]], left_on="ss_sold_time_sk",
+                 right_on="t_time_sk")
+    j = j.merge(hd[["hd_demo_sk"]], left_on="ss_hdemo_sk",
+                right_on="hd_demo_sk")
+    j = j.merge(stq[["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    return pd.DataFrame([{"cnt": len(j)}])
+
+
+def oracle_q59(t):
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    cols = [d.lower()[:3] + "_sales" for d in days]
+    dd = t["date_dim"]
+    j = _merge(dd, t["store_sales"], "d_date_sk", "ss_sold_date_sk")
+    for d, c in zip(days, cols):
+        j[c] = j.ss_sales_price.where(j.d_day_name == d)
+    wss = (
+        j.groupby(["d_week_seq", "ss_store_sk"])[cols]
+        .sum(min_count=1).reset_index()
+    )
+    wss = wss.merge(
+        t["store"][["s_store_sk", "s_store_id", "s_store_name"]],
+        left_on="ss_store_sk", right_on="s_store_sk",
+    )
+    y1 = wss[wss.d_week_seq.between(5, 20)].copy()
+    y2 = wss[wss.d_week_seq.between(57, 72)].copy()
+    y2["d_week_seq"] = y2.d_week_seq - 52
+    m = y1.merge(y2, on=["s_store_id", "d_week_seq"],
+                 suffixes=("1", "2"))
+    out = pd.DataFrame({
+        "s_store_name": m.s_store_name1,
+        "s_store_id": m.s_store_id,
+        "d_week_seq": m.d_week_seq,
+    })
+    for c in cols:
+        out[c + "_r"] = m[c + "1"] / m[c + "2"]
+    out = out.sort_values(
+        ["s_store_name", "s_store_id", "d_week_seq"]).head(100)
+    return out.reset_index(drop=True)
+
+
+ORACLES.update({
+    "q46": oracle_q46, "q59": oracle_q59, "q68": oracle_q68,
+    "q73": oracle_q73, "q79": oracle_q79, "q88": oracle_q88,
+    "q90": oracle_q90, "q96": oracle_q96,
+})

@@ -2983,3 +2983,416 @@ def q57(s, flavor):
 
 
 QUERIES.update({"q41": q41, "q44": q44, "q47": q47, "q57": q57})
+
+
+# ---------------------------------------------------------------------------
+# q46/q59/q68/q73/q79/q88/q90/q96 block (time-of-day / household tier)
+# ---------------------------------------------------------------------------
+
+N_TIMES = 1440  # one row per minute of day
+
+_GEN_V3 = gen_tables
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V3(seed)
+    rng = np.random.default_rng(seed + 13)
+    dd = t["date_dim"]
+    dd["d_dow"] = (np.arange(len(dd)) % 7).astype(np.int32)
+    t["time_dim"] = pd.DataFrame(
+        {
+            "t_time_sk": np.arange(N_TIMES, dtype=np.int32),
+            "t_hour": (np.arange(N_TIMES) // 60).astype(np.int32),
+            "t_minute": (np.arange(N_TIMES) % 60).astype(np.int32),
+        }
+    )
+    ss = t["store_sales"]
+    n_ss = len(ss)
+    ss["ss_sold_time_sk"] = rng.integers(0, N_TIMES, n_ss).astype(
+        np.int32)
+    ss["ss_addr_sk"] = pd.array(
+        np.where(
+            rng.random(n_ss) < 0.02, np.nan,
+            rng.integers(0, N_ADDRESSES, n_ss).astype(np.float64),
+        ),
+        dtype=pd.Int32Dtype(),
+    )
+    ca = t["customer_address"]
+    ca["ca_city"] = np.array(
+        ["Midway", "Fairview", "Oakdale", "Riverside", "Centerville",
+         "Liberty"], dtype=object,
+    )[rng.integers(0, 6, len(ca))]
+    st = t["store"]
+    st["s_city"] = np.array(
+        ["Midway", "Fairview", "Oakdale"], dtype=object
+    )[np.arange(len(st)) % 3]
+    st["s_store_id"] = [f"S{i:04d}" for i in range(len(st))]
+    ws = t["web_sales"]
+    n_ws = len(ws)
+    ws["ws_sold_time_sk"] = rng.integers(0, N_TIMES, n_ws).astype(
+        np.int32)
+    ws["ws_web_page_sk"] = rng.integers(0, 20, n_ws).astype(np.int32)
+    t["web_page"] = pd.DataFrame(
+        {
+            "wp_web_page_sk": np.arange(20, dtype=np.int32),
+            "wp_char_count": (4000 + np.arange(20) * 120).astype(
+                np.int32),
+        }
+    )
+    return t
+
+
+def _city_ticket_query(s, flavor, hd_pred, amt_col, profit_col):
+    """Shared q46/q68/q79 shape: weekend tickets in qualifying cities by
+    qualifying households, per-ticket sums, re-joined to the customer's
+    current address (bought city <> home city)."""
+    dd = FilterExec(
+        s["date_dim"](),
+        InList(Col("d_dow"), (Literal(6, DataType.int32()),
+                              Literal(0, DataType.int32())))
+        & (Col("d_year") >= 1998) & (Col("d_year") <= 2000),
+    )
+    stc = FilterExec(
+        s["store"](),
+        InList(Col("s_city"),
+               (Literal("Midway", DataType.utf8()),
+                Literal("Fairview", DataType.utf8()))),
+    )
+    hd = FilterExec(s["household_demographics"](), hd_pred)
+    j = _join(flavor, dd, s["store_sales"](),
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    j = _join(flavor, stc, j, ["s_store_sk"], ["ss_store_sk"])
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    j = _join(
+        flavor,
+        ProjectExec(s["customer_address"](),
+                    [(Col("ca_address_sk"), "b_addr_sk"),
+                     (Col("ca_city"), "bought_city")]),
+        j, ["b_addr_sk"], ["ss_addr_sk"],
+    )
+    per_ticket = _agg(
+        j,
+        keys=[(Col("ss_ticket_number"), "ticket"),
+              (Col("ss_customer_sk"), "cust_sk"),
+              (Col("bought_city"), "bought_city")],
+        aggs=[(AggExpr(AggFn.SUM, Col(amt_col)), "amt"),
+              (AggExpr(AggFn.SUM, Col(profit_col)), "profit")],
+    )
+    cust = _join(
+        flavor,
+        s["customer"](),
+        per_ticket,
+        ["c_customer_sk"], ["cust_sk"],
+    )
+    home = _join(
+        flavor,
+        ProjectExec(s["customer_address"](),
+                    [(Col("ca_address_sk"), "h_addr_sk"),
+                     (Col("ca_city"), "home_city")]),
+        cust, ["h_addr_sk"], ["c_current_addr_sk"],
+    )
+    return FilterExec(
+        home, ~(Col("home_city") == Col("bought_city"))
+    )
+
+
+def q46(s, flavor):
+    """TPC-DS q46: weekend dining-out tickets where the purchase city
+    differs from the customer's home city (dep=4 or vehicles=3)."""
+    res = _city_ticket_query(
+        s, flavor,
+        (Col("hd_dep_count") == 4) | (Col("hd_vehicle_count") == 3),
+        "ss_coupon_amt", "ss_net_profit",
+    )
+    out = _project_names(
+        res,
+        ["c_last_name", "c_first_name", "ticket", "bought_city",
+         "amt", "profit"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("c_first_name"), True, True),
+         SortKey(Col("bought_city"), True, True),
+         SortKey(Col("ticket"), True, True)],
+        100,
+    )
+
+
+def q68(s, flavor):
+    """TPC-DS q68: q46's shape with dep=5/vehicles=3 households and
+    sales/list price sums."""
+    res = _city_ticket_query(
+        s, flavor,
+        (Col("hd_dep_count") == 5) | (Col("hd_vehicle_count") == 3),
+        "ss_ext_sales_price", "ss_ext_list_price",
+    )
+    out = _project_names(
+        res,
+        ["c_last_name", "c_first_name", "ticket", "bought_city",
+         "amt", "profit"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("ticket"), True, True)],
+        100,
+    )
+
+
+def q79(s, flavor):
+    """TPC-DS q79: per-ticket store profits for large-household or
+    motorized customers, keyed by store city."""
+    dd = FilterExec(
+        s["date_dim"](),
+        (Col("d_dow") == 1) & (Col("d_year") >= 1998)
+        & (Col("d_year") <= 2000),
+    )
+    hd = FilterExec(
+        s["household_demographics"](),
+        (Col("hd_dep_count") == 6) | (Col("hd_vehicle_count") > 2),
+    )
+    j = _join(flavor, dd, s["store_sales"](),
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    j = _join(
+        flavor,
+        ProjectExec(s["store"](),
+                    [(Col("s_store_sk"), "s_sk"),
+                     (Col("s_city"), "s_city")]),
+        j, ["s_sk"], ["ss_store_sk"],
+    )
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    per_ticket = _agg(
+        j,
+        keys=[(Col("ss_ticket_number"), "ticket"),
+              (Col("ss_customer_sk"), "cust_sk"),
+              (Col("s_city"), "city")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_coupon_amt")), "amt"),
+              (AggExpr(AggFn.SUM, Col("ss_net_profit")), "profit")],
+    )
+    cust = _join(flavor, s["customer"](), per_ticket,
+                 ["c_customer_sk"], ["cust_sk"])
+    out = _project_names(
+        cust,
+        ["c_last_name", "c_first_name", "city", "profit", "ticket",
+         "amt"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("c_first_name"), True, True),
+         SortKey(Col("city"), True, True),
+         SortKey(Col("profit"), True, True),
+         SortKey(Col("ticket"), True, True)],
+        100,
+    )
+
+
+def q73(s, flavor):
+    """TPC-DS q73: customers with 1-5 item tickets from high-potential
+    motorized households."""
+    dd = FilterExec(
+        s["date_dim"](),
+        (Col("d_dom") >= 1) & (Col("d_dom") <= 2)
+        & (Col("d_year") >= 1998) & (Col("d_year") <= 2000),
+    )
+    hd = FilterExec(
+        s["household_demographics"](),
+        InList(Col("hd_buy_potential"),
+               (Literal(">10000", DataType.utf8()),
+                Literal("0-500", DataType.utf8())))
+        & (Col("hd_vehicle_count") > 0),
+    )
+    j = _join(flavor, dd, s["store_sales"](),
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    per_ticket = FilterExec(
+        _agg(
+            j,
+            keys=[(Col("ss_ticket_number"), "ticket"),
+                  (Col("ss_customer_sk"), "cust_sk")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+        ),
+        (Col("cnt") >= 1) & (Col("cnt") <= 5),
+    )
+    cust = _join(flavor, s["customer"](), per_ticket,
+                 ["c_customer_sk"], ["cust_sk"])
+    out = _project_names(
+        cust,
+        ["c_last_name", "c_first_name", "ticket", "cnt"],
+    )
+    return SortExec(
+        out,
+        [SortKey(Col("cnt"), False, True),
+         SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("ticket"), True, True)],
+    )
+
+
+def _time_band_count(s, flavor, h_lo, m_lo, h_hi, m_hi, dep, out):
+    """One q88-style half-hour store-traffic counter (scalar)."""
+    td = FilterExec(
+        s["time_dim"](),
+        ((Col("t_hour") > h_lo)
+         | ((Col("t_hour") == h_lo) & (Col("t_minute") >= m_lo)))
+        & ((Col("t_hour") < h_hi)
+           | ((Col("t_hour") == h_hi) & (Col("t_minute") < m_hi))),
+    )
+    hd = FilterExec(s["household_demographics"](),
+                    Col("hd_dep_count") == dep)
+    stq = FilterExec(s["store"](), Col("s_store_name") == "store_0")
+    j = _join(flavor, td, s["store_sales"](),
+              ["t_time_sk"], ["ss_sold_time_sk"])
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    j = _join(flavor, stq, j, ["s_store_sk"], ["ss_store_sk"])
+    return ProjectExec(
+        _agg(j, keys=[],
+             aggs=[(AggExpr(AggFn.COUNT_STAR, None), out)]),
+        [(Literal(1, DataType.int32()), f"{out}_k"),
+         (Col(out), out)],
+    )
+
+
+def q88(s, flavor):
+    """TPC-DS q88: store traffic in eight half-hour bands, one scalar
+    subquery each, cross-joined into a single row."""
+    bands = [
+        (8, 30, 9, 0, 4, "h8_30_to_9"),
+        (9, 0, 9, 30, 3, "h9_to_9_30"),
+        (9, 30, 10, 0, 2, "h9_30_to_10"),
+        (10, 0, 10, 30, 4, "h10_to_10_30"),
+        (10, 30, 11, 0, 3, "h10_30_to_11"),
+        (11, 0, 11, 30, 2, "h11_to_11_30"),
+        (11, 30, 12, 0, 4, "h11_30_to_12"),
+        (12, 0, 12, 30, 3, "h12_to_12_30"),
+    ]
+    cur = None
+    for h1, m1, h2, m2, dep, out in bands:
+        nxt = _time_band_count(s, flavor, h1, m1, h2, m2, dep, out)
+        if cur is None:
+            cur = nxt
+        else:
+            cur = _join(flavor, cur, nxt,
+                        [prev_k], [f"{out}_k"])
+        prev_k = f"{out}_k"
+    return _project_names(cur, [b[5] for b in bands])
+
+
+def q90(s, flavor):
+    """TPC-DS q90: morning-to-evening web traffic ratio for mid-size
+    pages (two scalar counts joined on a constant)."""
+    def half(h_lo, h_hi, out):
+        td = FilterExec(
+            s["time_dim"](),
+            (Col("t_hour") >= h_lo) & (Col("t_hour") < h_hi),
+        )
+        wp = FilterExec(
+            s["web_page"](),
+            (Col("wp_char_count") >= 4500)
+            & (Col("wp_char_count") <= 5500),
+        )
+        j = _join(flavor, td, s["web_sales"](),
+                  ["t_time_sk"], ["ws_sold_time_sk"])
+        j = _join(flavor, wp, j, ["wp_web_page_sk"], ["ws_web_page_sk"])
+        return ProjectExec(
+            _agg(j, keys=[],
+                 aggs=[(AggExpr(AggFn.COUNT_STAR, None), out)]),
+            [(Literal(1, DataType.int32()), f"{out}_k"), (Col(out), out)],
+        )
+
+    am = half(7, 9, "amc")
+    pm = half(19, 21, "pmc")
+    both = _join(flavor, am, pm, ["amc_k"], ["pmc_k"])
+    return ProjectExec(
+        both,
+        [(Col("amc").cast(DataType.float64())
+          / Col("pmc").cast(DataType.float64()), "am_pm_ratio")],
+    )
+
+
+def q96(s, flavor):
+    """TPC-DS q96: count of evening store sales by seven-dependent
+    households at one store."""
+    td = FilterExec(
+        s["time_dim"](),
+        (Col("t_hour") == 20) & (Col("t_minute") >= 30),
+    )
+    hd = FilterExec(s["household_demographics"](),
+                    Col("hd_dep_count") == 6)
+    stq = FilterExec(s["store"](), Col("s_store_name") == "store_1")
+    j = _join(flavor, td, s["store_sales"](),
+              ["t_time_sk"], ["ss_sold_time_sk"])
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["ss_hdemo_sk"])
+    j = _join(flavor, stq, j, ["s_store_sk"], ["ss_store_sk"])
+    return _agg(
+        j, keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+    )
+
+
+def q59(s, flavor):
+    """TPC-DS q59: store weekly day-of-week sales, this year vs the
+    next (aligned at +52 weeks), as per-day ratios."""
+    days = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+            "Friday", "Saturday"]
+    cols = [d.lower()[:3] + "_sales" for d in days]
+
+    def day_sum(day):
+        return AggExpr(
+            AggFn.SUM,
+            If(Col("d_day_name") == day, Col("ss_sales_price"),
+               Literal(None, DataType.float64())),
+        )
+
+    j = _join(flavor, s["date_dim"](), s["store_sales"](),
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    wss = _agg(
+        j,
+        keys=[(Col("d_week_seq"), "d_week_seq"),
+              (Col("ss_store_sk"), "store_sk")],
+        aggs=[(day_sum(d), c) for d, c in zip(days, cols)],
+    )
+    wss = _join(
+        flavor,
+        ProjectExec(s["store"](),
+                    [(Col("s_store_sk"), "s_sk"),
+                     (Col("s_store_id"), "s_store_id"),
+                     (Col("s_store_name"), "s_store_name")]),
+        wss, ["s_sk"], ["store_sk"],
+    )
+    y1 = ProjectExec(
+        FilterExec(wss, (Col("d_week_seq") >= 5)
+                   & (Col("d_week_seq") <= 20)),
+        [(Col("s_store_id"), "id1"),
+         (Col("s_store_name"), "name1"),
+         (Col("d_week_seq"), "wk1")]
+        + [(Col(c), c + "1") for c in cols],
+    )
+    y2 = ProjectExec(
+        FilterExec(wss, (Col("d_week_seq") >= 57)
+                   & (Col("d_week_seq") <= 72)),
+        [(Col("s_store_id"), "id2"),
+         (Col("d_week_seq") - 52, "wk2")]
+        + [(Col(c), c + "2") for c in cols],
+    )
+    m = _join(flavor, y1, y2, ["id1", "wk1"], ["id2", "wk2"])
+    out = ProjectExec(
+        m,
+        [(Col("name1"), "s_store_name"),
+         (Col("id1"), "s_store_id"),
+         (Col("wk1"), "d_week_seq")]
+        + [(Col(c + "1") / Col(c + "2"), c + "_r") for c in cols],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("s_store_name"), True, True),
+         SortKey(Col("s_store_id"), True, True),
+         SortKey(Col("d_week_seq"), True, True)],
+        100,
+    )
+
+
+QUERIES.update({
+    "q46": q46, "q59": q59, "q68": q68, "q73": q73, "q79": q79,
+    "q88": q88, "q90": q90, "q96": q96,
+})
