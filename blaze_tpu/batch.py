@@ -359,35 +359,45 @@ class ColumnBatch:
 
 
 import collections
+import threading
 
 _PLACEHOLDER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _PLACEHOLDER_CACHE_CAP = 32
 _PLACEHOLDER_TRACK_ID = id(_PLACEHOLDER_CACHE)
+_PLACEHOLDER_LOCK = threading.Lock()
 
 
 def _placeholder(cap: int, dtype: DataType) -> jax.Array:
     """Shared all-zeros device column for pruned (never-read) scan
     positions. Safe to share across batches/plans: engine kernels are
-    pure functions and never mutate input buffers. LRU-bounded and
-    accounted in the device-memory tracker so grace/spill budgeting
-    sees the pinned HBM."""
+    pure functions and never mutate input buffers. LRU-bounded (under a
+    lock - prefetch worker threads race here) and accounted in the
+    device-memory tracker so grace/spill budgeting sees the pinned HBM.
+    An evicted array still referenced by an in-flight batch is briefly
+    under-counted; the window closes when that batch is released."""
     phys = dtype.physical_dtype()
     shape = (cap, 2) if dtype.is_wide_decimal else (cap,)
     key = (shape, str(phys))
-    arr = _PLACEHOLDER_CACHE.get(key)
-    if arr is not None:
-        _PLACEHOLDER_CACHE.move_to_end(key)
-        return arr
+    with _PLACEHOLDER_LOCK:
+        arr = _PLACEHOLDER_CACHE.get(key)
+        if arr is not None:
+            _PLACEHOLDER_CACHE.move_to_end(key)
+            return arr
     from blaze_tpu.runtime.memory import get_device_tracker
 
-    arr = jnp.zeros(shape, dtype=phys)
-    _PLACEHOLDER_CACHE[key] = arr
+    new = jnp.zeros(shape, dtype=phys)
     tracker = get_device_tracker()
-    tracker.track(_PLACEHOLDER_TRACK_ID, int(arr.nbytes))
-    while len(_PLACEHOLDER_CACHE) > _PLACEHOLDER_CACHE_CAP:
-        _, old = _PLACEHOLDER_CACHE.popitem(last=False)
-        tracker.release(_PLACEHOLDER_TRACK_ID, int(old.nbytes))
-    return arr
+    with _PLACEHOLDER_LOCK:
+        arr = _PLACEHOLDER_CACHE.get(key)
+        if arr is not None:  # lost a double-miss race: reuse, drop ours
+            _PLACEHOLDER_CACHE.move_to_end(key)
+            return arr
+        _PLACEHOLDER_CACHE[key] = new
+        tracker.track(_PLACEHOLDER_TRACK_ID, int(new.nbytes))
+        while len(_PLACEHOLDER_CACHE) > _PLACEHOLDER_CACHE_CAP:
+            _, old = _PLACEHOLDER_CACHE.popitem(last=False)
+            tracker.release(_PLACEHOLDER_TRACK_ID, int(old.nbytes))
+    return new
 
 
 def _decimal_unscaled_i64(arr) -> np.ndarray:

@@ -1743,3 +1743,314 @@ ORACLES.update({
     "q73": oracle_q73, "q79": oracle_q79, "q88": oracle_q88,
     "q90": oracle_q90, "q96": oracle_q96,
 })
+
+
+# ---------------------------------------------------------------------------
+# q31/q35/q39/q49/q65/q69/q74/q92/q93/q97 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q31(t):
+    dd = t["date_dim"]
+
+    def county_q(sales, date_col, addr_col, amt, qoy):
+        d = dd[(dd.d_year == 1999) & (dd.d_qoy == qoy)][["d_date_sk"]]
+        j = _merge(t[sales], d, date_col, "d_date_sk")
+        j = _merge(j, t["customer_address"][["ca_address_sk",
+                                             "ca_county"]],
+                   addr_col, "ca_address_sk")
+        return j.groupby("ca_county", dropna=False)[amt].sum()
+
+    ss = {q: county_q("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                      "ss_ext_sales_price", q) for q in (1, 2, 3)}
+    ws = {q: county_q("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                      "ws_ext_sales_price", q) for q in (1, 2, 3)}
+    m = pd.DataFrame({"ss1": ss[1], "ss2": ss[2], "ss3": ss[3],
+                      "ws1": ws[1], "ws2": ws[2], "ws3": ws[3]}).dropna()
+    m = m[(m.ws2 / m.ws1 > m.ss2 / m.ss1)
+          & (m.ws3 / m.ws2 > m.ss3 / m.ss2)]
+    m = m.reset_index().rename(columns={"index": "ca_county"})
+    out = pd.DataFrame({
+        "ca_county": m.ca_county,
+        "web_q1_q2_increase": m.ws2 / m.ws1,
+        "store_q1_q2_increase": m.ss2 / m.ss1,
+        "web_q2_q3_increase": m.ws3 / m.ws2,
+        "store_q2_q3_increase": m.ss3 / m.ss2,
+    })
+    return out.sort_values("ca_county").reset_index(drop=True)
+
+
+def oracle_q35(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_qoy < 4)][["d_date_sk"]]
+
+    def active(df, date_col, cust_col):
+        j = _merge(df, d, date_col, "d_date_sk")
+        return set(j[cust_col].dropna())
+
+    store_set = active(t["store_sales"], "ss_sold_date_sk",
+                       "ss_customer_sk")
+    other = active(t["web_sales"], "ws_sold_date_sk",
+                   "ws_bill_customer_sk") | active(
+        t["catalog_sales"], "cs_sold_date_sk", "cs_bill_customer_sk")
+    c = t["customer"]
+    c = c[c.c_customer_sk.isin(store_set)
+          & c.c_customer_sk.isin(other)]
+    j = _merge(c, t["customer_demographics"],
+               "c_current_cdemo_sk", "cd_demo_sk")
+    keys = ["cd_gender", "cd_marital_status", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    agg = (
+        j.groupby(keys, dropna=False)
+        .agg(cnt=("cd_dep_count", "size"),
+             min_dep=("cd_dep_count", "min"),
+             max_dep=("cd_dep_count", "max"),
+             avg_dep=("cd_dep_count", "mean"))
+        .reset_index()
+    )
+    out = agg.sort_values(keys, na_position="first").head(100)
+    return out[keys + ["cnt", "min_dep", "max_dep", "avg_dep"]
+               ].reset_index(drop=True)
+
+
+def oracle_q39(t):
+    dd = t["date_dim"]
+
+    def stats(moy):
+        d = dd[(dd.d_year == 1999) & (dd.d_moy == moy)][["d_date_sk"]]
+        j = _merge(t["inventory"], d, "inv_date_sk", "d_date_sk")
+        g = (
+            j.groupby(["inv_warehouse_sk", "inv_item_sk"])
+            .inv_quantity_on_hand.agg(["mean", "std", "count"])
+            .reset_index()
+        )
+        g = g[g["count"] >= 1]
+        g = g[(g["mean"] != 0) & (g["std"] / g["mean"] > 1.0)]
+        return g
+
+    m1, m2 = stats(1), stats(2)
+    m = m1.merge(m2, on=["inv_warehouse_sk", "inv_item_sk"],
+                 suffixes=("1", "2"))
+    out = pd.DataFrame({
+        "w_warehouse_sk": m.inv_warehouse_sk,
+        "i_item_sk": m.inv_item_sk,
+        "mean1": m.mean1, "cov1": m.std1 / m.mean1,
+        "mean2": m.mean2, "cov2": m.std2 / m.mean2,
+    })
+    return out.sort_values(["w_warehouse_sk", "i_item_sk"]).reset_index(
+        drop=True)
+
+
+def oracle_q49(t):
+    frames = []
+    for label, sales, rets, sk, rk, item, qty, amt, rq, ra in (
+        ("web", "web_sales", "web_returns",
+         ["ws_order_number", "ws_item_sk"],
+         ["wr_order_number", "wr_item_sk"],
+         "ws_item_sk", "ws_quantity", "ws_ext_sales_price",
+         "wr_return_quantity", "wr_return_amt"),
+        ("catalog", "catalog_sales", "catalog_returns",
+         ["cs_order_number", "cs_item_sk"],
+         ["cr_order_number", "cr_item_sk"],
+         "cs_item_sk", "cs_quantity", "cs_ext_sales_price",
+         "cr_return_quantity", "cr_return_amount"),
+        ("store", "store_sales", "store_returns",
+         ["ss_ticket_number", "ss_item_sk"],
+         ["sr_ticket_number", "sr_item_sk"],
+         "ss_item_sk", "ss_quantity", "ss_ext_sales_price",
+         "sr_return_quantity", "sr_return_amt"),
+    ):
+        j = t[sales].merge(
+            t[rets][rk + [rq, ra]], left_on=sk, right_on=rk,
+            how="left",
+        )
+        g = (
+            j.groupby(item)
+            .agg(ret_qty=(rq, lambda x: x.fillna(0).sum()),
+                 qty=(qty, "sum"),
+                 ret_amt=(ra, lambda x: x.fillna(0).sum()),
+                 amt=(amt, "sum"))
+            .reset_index()
+        )
+        g["qty_ratio"] = g.ret_qty / g.qty
+        g["amt_ratio"] = g.ret_amt / g.amt
+        g["qty_rank"] = g.qty_ratio.rank(method="min").astype(int)
+        g["amt_rank"] = g.amt_ratio.rank(method="min").astype(int)
+        top = g[(g.qty_rank <= 10) | (g.amt_rank <= 10)]
+        frames.append(pd.DataFrame({
+            "channel": label,
+            "item": top[item].astype(np.int64),
+            "return_ratio": top.amt_ratio,
+            "return_rank": top.qty_rank.astype(np.int64),
+            "currency_rank": top.amt_rank.astype(np.int64),
+        }))
+    out = pd.concat(frames, ignore_index=True)
+    out = out.sort_values(
+        ["channel", "return_rank", "currency_rank", "item"]).head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q65(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
+    j = _merge(t["store_sales"], d, "ss_sold_date_sk", "d_date_sk")
+    sb = (
+        j.groupby(["ss_store_sk", "ss_item_sk"])
+        .ss_sales_price.sum().reset_index(name="revenue")
+    )
+    sc = sb.groupby("ss_store_sk").revenue.mean().reset_index(
+        name="ave")
+    m = sb.merge(sc, on="ss_store_sk")
+    m = m[m.revenue <= 0.1 * m.ave]
+    m = m.merge(t["store"][["s_store_sk", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    m = m.merge(
+        t["item"][["i_item_sk", "i_item_desc", "i_current_price",
+                   "i_brand"]],
+        left_on="ss_item_sk", right_on="i_item_sk",
+    )
+    out = m.sort_values(
+        ["s_store_name", "i_item_desc", "revenue"]).head(100)
+    return out[
+        ["s_store_name", "i_item_desc", "revenue", "i_current_price",
+         "i_brand"]
+    ].reset_index(drop=True)
+
+
+def oracle_q69(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 2000) & dd.d_moy.between(1, 3)][["d_date_sk"]]
+
+    def active(df, date_col, cust_col):
+        j = _merge(df, d, date_col, "d_date_sk")
+        return set(j[cust_col].dropna())
+
+    store_set = active(t["store_sales"], "ss_sold_date_sk",
+                       "ss_customer_sk")
+    web_set = active(t["web_sales"], "ws_sold_date_sk",
+                     "ws_bill_customer_sk")
+    cat_set = active(t["catalog_sales"], "cs_sold_date_sk",
+                     "cs_bill_customer_sk")
+    ca = t["customer_address"]
+    ca = ca[ca.ca_state.isin(["TN", "GA", "CA"])]
+    c = t["customer"].merge(ca[["ca_address_sk"]],
+                            left_on="c_current_addr_sk",
+                            right_on="ca_address_sk")
+    c = c[c.c_customer_sk.isin(store_set)
+          & ~c.c_customer_sk.isin(web_set)
+          & ~c.c_customer_sk.isin(cat_set)]
+    j = _merge(c, t["customer_demographics"],
+               "c_current_cdemo_sk", "cd_demo_sk")
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating"]
+    agg = j.groupby(keys, dropna=False).size().reset_index(name="cnt")
+    out = agg.sort_values(keys, na_position="first").head(100)
+    return out[keys + ["cnt"]].reset_index(drop=True)
+
+
+def oracle_q74(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_year.between(1998, 1999)][["d_date_sk", "d_year"]]
+
+    def yt(df, date_col, cust_col, amt):
+        j = _merge(df, d, date_col, "d_date_sk")
+        j = _merge(j, t["customer"][["c_customer_sk", "c_customer_id",
+                                     "c_first_name", "c_last_name"]],
+                   cust_col, "c_customer_sk")
+        return (
+            j.groupby(["c_customer_sk", "c_customer_id", "c_first_name",
+                       "c_last_name", "d_year"], dropna=False)[amt]
+            .sum().reset_index(name="yt")
+        )
+
+    s_yt = yt(t["store_sales"], "ss_sold_date_sk", "ss_customer_sk",
+              "ss_sales_price")
+    w_yt = yt(t["web_sales"], "ws_sold_date_sk", "ws_bill_customer_sk",
+              "ws_ext_sales_price")
+
+    def pick(df, year):
+        return df[df.d_year == year][["c_customer_sk", "c_customer_id",
+                                      "c_first_name", "c_last_name",
+                                      "yt"]]
+
+    s1, s2 = pick(s_yt, 1998), pick(s_yt, 1999)
+    w1, w2 = pick(w_yt, 1998), pick(w_yt, 1999)
+    m = s1.merge(s2[["c_customer_sk", "yt"]], on="c_customer_sk",
+                 suffixes=("", "_s2"))
+    m = m.merge(w1[["c_customer_sk", "yt"]].rename(
+        columns={"yt": "yt_w1"}), on="c_customer_sk")
+    m = m.merge(w2[["c_customer_sk", "yt"]].rename(
+        columns={"yt": "yt_w2"}), on="c_customer_sk")
+    m = m[(m.yt > 0) & (m.yt_w1 > 0)
+          & (m.yt_w2 / m.yt_w1 > m.yt_s2 / m.yt)]
+    out = m.sort_values("c_customer_id").head(100)
+    return pd.DataFrame({
+        "customer_id": out.c_customer_id.values,
+        "first_name": out.c_first_name.values,
+        "last_name": out.c_last_name.values,
+    })
+
+
+def oracle_q92(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy <= 3)][["d_date_sk"]]
+    ws = _merge(t["web_sales"], d, "ws_sold_date_sk", "d_date_sk")
+    thr = ws.groupby("ws_item_sk").ws_ext_discount_amt.mean() * 1.3
+    j = ws.merge(thr.reset_index(name="threshold"), on="ws_item_sk")
+    over = j[j.ws_ext_discount_amt > j.threshold]
+    return pd.DataFrame(
+        [{"excess_discount": over.ws_ext_discount_amt.sum()}])
+
+
+def oracle_q93(t):
+    sr = t["store_returns"].merge(
+        t["reason"], left_on="sr_reason_sk", right_on="r_reason_sk")
+    ss = t["store_sales"]
+    j = ss.merge(
+        sr[["sr_ticket_number", "sr_item_sk", "sr_return_quantity",
+            "r_reason_desc"]],
+        left_on=["ss_ticket_number", "ss_item_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk"], how="left",
+    )
+    act = np.where(
+        j.r_reason_desc == "reason 3",
+        (j.ss_quantity - j.sr_return_quantity) * j.ss_sales_price,
+        j.ss_quantity * j.ss_sales_price,
+    )
+    j = j.assign(act_sales=act)
+    agg = (
+        j.groupby("ss_customer_sk", dropna=False)
+        .act_sales.sum().reset_index(name="sumsales")
+    )
+    out = agg.sort_values(
+        ["sumsales", "ss_customer_sk"], na_position="first").head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q97(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
+    ss = _merge(t["store_sales"], d, "ss_sold_date_sk", "d_date_sk")
+    cs = _merge(t["catalog_sales"], d, "cs_sold_date_sk", "d_date_sk")
+    # the CASE flags test the customer key itself, so NULL-customer
+    # pairs count in no bucket (matching the engine's IsNotNull checks)
+    sp = set(map(tuple, ss[["ss_customer_sk", "ss_item_sk"]]
+                 .dropna(subset=["ss_customer_sk"]).drop_duplicates()
+                 .itertuples(index=False)))
+    cp = set(map(tuple, cs[["cs_bill_customer_sk", "cs_item_sk"]]
+                 .dropna(subset=["cs_bill_customer_sk"])
+                 .drop_duplicates().itertuples(index=False)))
+    both = len(sp & cp)
+    store_only = len(sp - cp)
+    catalog_only = len(cp - sp)
+    return pd.DataFrame([{
+        "store_only": store_only, "catalog_only": catalog_only,
+        "store_and_catalog": both,
+    }])
+
+
+ORACLES.update({
+    "q31": oracle_q31, "q35": oracle_q35, "q39": oracle_q39,
+    "q49": oracle_q49, "q65": oracle_q65, "q69": oracle_q69,
+    "q74": oracle_q74, "q92": oracle_q92, "q93": oracle_q93,
+    "q97": oracle_q97,
+})

@@ -3396,3 +3396,625 @@ QUERIES.update({
     "q46": q46, "q59": q59, "q68": q68, "q73": q73, "q79": q79,
     "q88": q88, "q90": q90, "q96": q96,
 })
+
+
+# ---------------------------------------------------------------------------
+# q31/q35/q39/q49/q65/q69/q74/q92/q93/q97 block (growth ratios, returns
+# linkage, statistical inventory)
+# ---------------------------------------------------------------------------
+
+_GEN_V4 = gen_tables
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V4(seed)
+    rng = np.random.default_rng(seed + 19)
+    ws = t["web_sales"]
+    n_ws = len(ws)
+    ws["ws_bill_addr_sk"] = pd.array(
+        np.where(
+            rng.random(n_ws) < 0.02, np.nan,
+            rng.integers(0, N_ADDRESSES, n_ws).astype(np.float64),
+        ),
+        dtype=pd.Int32Dtype(),
+    )
+    ws["ws_order_number"] = np.arange(n_ws, dtype=np.int64)
+    ws["ws_quantity"] = rng.integers(1, 101, n_ws).astype(np.int32)
+    wr = t["web_returns"]
+    n_wr = len(wr)
+    widx = rng.integers(0, n_ws, n_wr)
+    wr["wr_order_number"] = widx.astype(np.int64)
+    wr["wr_item_sk"] = ws["ws_item_sk"].values[widx]
+    wr["wr_return_quantity"] = rng.integers(1, 30, n_wr).astype(
+        np.int32)
+    cr = t["catalog_returns"]
+    cr["cr_return_quantity"] = rng.integers(1, 30, len(cr)).astype(
+        np.int32)
+    sr = t["store_returns"]
+    n_sr = len(sr)
+    ss = t["store_sales"]
+    sidx = rng.integers(0, len(ss), n_sr)
+    sr["sr_ticket_number"] = ss["ss_ticket_number"].values[sidx]
+    sr["sr_item_sk"] = ss["ss_item_sk"].values[sidx]
+    sr["sr_return_quantity"] = rng.integers(1, 30, n_sr).astype(
+        np.int32)
+    sr["sr_reason_sk"] = rng.integers(1, 10, n_sr).astype(np.int32)
+    return t
+
+
+def q31(s, flavor):
+    """TPC-DS q31: counties where web sales grew faster than store
+    sales across consecutive quarters (six quarterly aggregates joined
+    on county)."""
+    def county_q(sales, date_col, addr_col, qoy, out):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_qoy") == qoy),
+            ),
+            s[sales](),
+            ["d_date_sk"], [date_col],
+        )
+        j = _join(
+            flavor,
+            s["customer_address"](),
+            j, ["ca_address_sk"], [addr_col],
+        )
+        return _agg(
+            j,
+            keys=[(Col("ca_county"), f"county_{out}")],
+            aggs=[(AggExpr(
+                AggFn.SUM,
+                Col("ss_ext_sales_price" if sales == "store_sales"
+                    else "ws_ext_sales_price")), out)],
+        )
+
+    ss1 = county_q("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                   1, "ss1")
+    ss2 = county_q("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                   2, "ss2")
+    ss3 = county_q("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                   3, "ss3")
+    ws1 = county_q("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                   1, "ws1")
+    ws2 = county_q("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                   2, "ws2")
+    ws3 = county_q("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                   3, "ws3")
+    j = _join(flavor, ss1, ss2, ["county_ss1"], ["county_ss2"])
+    j = _join(flavor, j, ss3, ["county_ss1"], ["county_ss3"])
+    j = _join(flavor, j, ws1, ["county_ss1"], ["county_ws1"])
+    j = _join(flavor, j, ws2, ["county_ss1"], ["county_ws2"])
+    j = _join(flavor, j, ws3, ["county_ss1"], ["county_ws3"])
+    grew = FilterExec(
+        j,
+        ((Col("ws2") / Col("ws1")) > (Col("ss2") / Col("ss1")))
+        & ((Col("ws3") / Col("ws2")) > (Col("ss3") / Col("ss2"))),
+    )
+    out = ProjectExec(
+        grew,
+        [(Col("county_ss1"), "ca_county"),
+         (Col("ws2") / Col("ws1"), "web_q1_q2_increase"),
+         (Col("ss2") / Col("ss1"), "store_q1_q2_increase"),
+         (Col("ws3") / Col("ws2"), "web_q2_q3_increase"),
+         (Col("ss3") / Col("ss2"), "store_q2_q3_increase")],
+    )
+    return SortExec(out, [SortKey(Col("ca_county"), True, True)])
+
+
+def q35(s, flavor):
+    """TPC-DS q35: demographic profile (count + min/max/avg dependents)
+    of customers active in store AND (web OR catalog)."""
+    def active(prefix, table, cust):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_qoy") < 4),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return ProjectExec(j, [(Col(cust), "active_sk")])
+
+    cust = _semi(
+        flavor,
+        _semi(
+            flavor,
+            s["customer"](),
+            _agg(active("ss", "store_sales", "ss_customer_sk"),
+                 keys=[(Col("active_sk"), "active_sk")], aggs=[]),
+            ["c_customer_sk"], ["active_sk"],
+        ),
+        _agg(
+            _union([
+                active("ws", "web_sales", "ws_bill_customer_sk"),
+                active("cs", "catalog_sales", "cs_bill_customer_sk"),
+            ]),
+            keys=[(Col("active_sk"), "active_sk")], aggs=[],
+        ),
+        ["c_customer_sk"], ["active_sk"],
+    )
+    j = _join(
+        flavor, s["customer_demographics"](), cust,
+        ["cd_demo_sk"], ["c_current_cdemo_sk"],
+    )
+    keys = ["cd_gender", "cd_marital_status", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    agg = _agg(
+        j,
+        keys=[(Col(k), k) for k in keys],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt"),
+              (AggExpr(AggFn.MIN, Col("cd_dep_count")), "min_dep"),
+              (AggExpr(AggFn.MAX, Col("cd_dep_count")), "max_dep"),
+              (AggExpr(AggFn.AVG, Col("cd_dep_count")), "avg_dep")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col(k), True, True) for k in keys],
+        100,
+    )
+
+
+def q39(s, flavor):
+    """TPC-DS q39: items whose warehouse inventory is volatile
+    (stdev/mean > 1) in consecutive months, self-joined pairwise."""
+    def inv_stats(moy, suffix):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") == moy),
+            ),
+            s["inventory"](),
+            ["d_date_sk"], ["inv_date_sk"],
+        )
+        stats = _agg(
+            j,
+            keys=[(Col("inv_warehouse_sk"), f"w_{suffix}"),
+                  (Col("inv_item_sk"), f"i_{suffix}")],
+            aggs=[(AggExpr(AggFn.AVG, Col("inv_quantity_on_hand")),
+                   f"mean_{suffix}"),
+                  (AggExpr(AggFn.STDDEV_SAMP,
+                           Col("inv_quantity_on_hand")),
+                   f"stdev_{suffix}")],
+        )
+        return FilterExec(
+            stats,
+            If(
+                Col(f"mean_{suffix}") == 0.0,
+                Literal(None, DataType.bool_()),
+                Col(f"stdev_{suffix}") / Col(f"mean_{suffix}") > 1.0,
+            ),
+        )
+
+    m1 = inv_stats(1, "m1")
+    m2 = inv_stats(2, "m2")
+    pair = _join(flavor, m1, m2, ["w_m1", "i_m1"], ["w_m2", "i_m2"])
+    out = ProjectExec(
+        pair,
+        [(Col("w_m1"), "w_warehouse_sk"), (Col("i_m1"), "i_item_sk"),
+         (Col("mean_m1"), "mean1"),
+         (Col("stdev_m1") / Col("mean_m1"), "cov1"),
+         (Col("mean_m2"), "mean2"),
+         (Col("stdev_m2") / Col("mean_m2"), "cov2")],
+    )
+    return SortExec(
+        out,
+        [SortKey(Col("w_warehouse_sk"), True, True),
+         SortKey(Col("i_item_sk"), True, True)],
+    )
+
+
+def q49(s, flavor):
+    """TPC-DS q49: worst return ratios per channel - currency and
+    quantity ranks, rank<=10 either way, channels unioned."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    def channel(label, sales, rets, s_keys, r_keys, item_col, qty,
+                amt, r_qty, r_amt):
+        j = HashJoinExec(
+            s[sales](), s[rets](), s_keys, r_keys, JoinType.LEFT,
+        ) if flavor == "bhj" else SortMergeJoinExec(
+            s[sales](), s[rets](), s_keys, r_keys, JoinType.LEFT,
+        )
+        ratios = ProjectExec(
+            _agg(
+                j,
+                keys=[(Col(item_col), "item")],
+                aggs=[
+                    (AggExpr(AggFn.SUM, Coalesce(
+                        (Col(r_qty), Literal(0, DataType.int32())))),
+                     "ret_qty"),
+                    (AggExpr(AggFn.SUM, Col(qty)), "qty"),
+                    (AggExpr(AggFn.SUM, Coalesce(
+                        (Col(r_amt), Literal(0.0, DataType.float64())))),
+                     "ret_amt"),
+                    (AggExpr(AggFn.SUM, Col(amt)), "amt"),
+                ],
+            ),
+            [(Col("item"), "item"),
+             (Col("ret_qty").cast(DataType.float64())
+              / Col("qty").cast(DataType.float64()), "qty_ratio"),
+             (Col("ret_amt") / Col("amt"), "amt_ratio")],
+        )
+        ranked = WindowExec(
+            WindowExec(
+                ratios,
+                partition_by=[],
+                order_by=[SortKey(Col("qty_ratio"), True, True)],
+                functions=[WindowFn("rank", None, "qty_rank")],
+            ),
+            partition_by=[],
+            order_by=[SortKey(Col("amt_ratio"), True, True)],
+            functions=[WindowFn("rank", None, "amt_rank")],
+        )
+        top = FilterExec(
+            ranked,
+            (Col("qty_rank") <= 10) | (Col("amt_rank") <= 10),
+        )
+        return ProjectExec(
+            top,
+            [(Literal(label, DataType.utf8()), "channel"),
+             (Col("item").cast(DataType.int64()), "item"),
+             (Col("amt_ratio"), "return_ratio"),
+             (Col("qty_rank").cast(DataType.int64()), "return_rank"),
+             (Col("amt_rank").cast(DataType.int64()), "currency_rank")],
+        )
+
+    web = channel(
+        "web", "web_sales", "web_returns",
+        ["ws_order_number", "ws_item_sk"],
+        ["wr_order_number", "wr_item_sk"],
+        "ws_item_sk", "ws_quantity", "ws_ext_sales_price",
+        "wr_return_quantity", "wr_return_amt",
+    )
+    catalog = channel(
+        "catalog", "catalog_sales", "catalog_returns",
+        ["cs_order_number", "cs_item_sk"],
+        ["cr_order_number", "cr_item_sk"],
+        "cs_item_sk", "cs_quantity", "cs_ext_sales_price",
+        "cr_return_quantity", "cr_return_amount",
+    )
+    store = channel(
+        "store", "store_sales", "store_returns",
+        ["ss_ticket_number", "ss_item_sk"],
+        ["sr_ticket_number", "sr_item_sk"],
+        "ss_item_sk", "ss_quantity", "ss_ext_sales_price",
+        "sr_return_quantity", "sr_return_amt",
+    )
+    both = _union([web, catalog, store])
+    return _sorted_limit(
+        both,
+        [SortKey(Col("channel"), True, True),
+         SortKey(Col("return_rank"), True, True),
+         SortKey(Col("currency_rank"), True, True),
+         SortKey(Col("item"), True, True)],
+        100,
+    )
+
+
+def q65(s, flavor):
+    """TPC-DS q65: (store, item) pairs whose revenue is at most 10% of
+    the store's average item revenue (two-level aggregate join)."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_month_seq") >= 1188) & (Col("d_month_seq") <= 1199),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    sb = _agg(
+        j,
+        keys=[(Col("ss_store_sk"), "store_sk"),
+              (Col("ss_item_sk"), "item_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_sales_price")), "revenue")],
+    )
+    sc = ProjectExec(
+        _agg(
+            sb,
+            keys=[(Col("store_sk"), "a_store_sk")],
+            aggs=[(AggExpr(AggFn.AVG, Col("revenue")), "ave")],
+        ),
+        [(Col("a_store_sk"), "a_store_sk"), (Col("ave") * 0.1, "cap")],
+    )
+    low = FilterExec(
+        _join(flavor, sc, sb, ["a_store_sk"], ["store_sk"]),
+        Col("revenue") <= Col("cap"),
+    )
+    j2 = _join(flavor, s["store"](), low,
+               ["s_store_sk"], ["store_sk"])
+    j2 = _join(flavor, s["item"](), j2, ["i_item_sk"], ["item_sk"])
+    out = _project_names(
+        j2, ["s_store_name", "i_item_desc", "revenue", "i_current_price",
+             "i_brand"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("s_store_name"), True, True),
+         SortKey(Col("i_item_desc"), True, True),
+         SortKey(Col("revenue"), True, True)],
+        100,
+    )
+
+
+def q69(s, flavor):
+    """TPC-DS q69: demographics of store customers in three states with
+    NO web or catalog activity in the window (anti joins)."""
+    def active(prefix, table, cust):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 2000)
+                & (Col("d_moy") >= 1) & (Col("d_moy") <= 3),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return _agg(
+            ProjectExec(j, [(Col(cust), "active_sk")]),
+            keys=[(Col("active_sk"), "active_sk")], aggs=[],
+        )
+
+    in_states = _join(
+        flavor,
+        FilterExec(
+            s["customer_address"](),
+            InList(Col("ca_state"),
+                   (Literal("TN", DataType.utf8()),
+                    Literal("GA", DataType.utf8()),
+                    Literal("CA", DataType.utf8()))),
+        ),
+        s["customer"](),
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    cust = _semi(
+        flavor, in_states,
+        active("ss", "store_sales", "ss_customer_sk"),
+        ["c_customer_sk"], ["active_sk"],
+    )
+    for prefix, table, cc in (
+        ("ws", "web_sales", "ws_bill_customer_sk"),
+        ("cs", "catalog_sales", "cs_bill_customer_sk"),
+    ):
+        cust = HashJoinExec(
+            cust, active(prefix, table, cc),
+            ["c_customer_sk"], ["active_sk"], JoinType.LEFT_ANTI,
+        ) if flavor == "bhj" else SortMergeJoinExec(
+            cust, active(prefix, table, cc),
+            ["c_customer_sk"], ["active_sk"], JoinType.LEFT_ANTI,
+        )
+    j = _join(
+        flavor, s["customer_demographics"](), cust,
+        ["cd_demo_sk"], ["c_current_cdemo_sk"],
+    )
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating"]
+    agg = _agg(
+        j,
+        keys=[(Col(k), k) for k in keys],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+    )
+    return _sorted_limit(
+        agg, [SortKey(Col(k), True, True) for k in keys], 100,
+    )
+
+
+def q74(s, flavor):
+    """TPC-DS q74: store-vs-web year-over-year growth per customer
+    (q11's shape on ss_sales_price totals with name output)."""
+    def year_total(prefix, table, cust, amt):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") >= 1998) & (Col("d_year") <= 1999),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(
+            flavor,
+            s["customer"](),
+            j, ["c_customer_sk"], [cust],
+        )
+        return _agg(
+            j,
+            keys=[(Col("c_customer_sk"), "sk"),
+                  (Col("c_customer_id"), "cid"),
+                  (Col("c_first_name"), "first"),
+                  (Col("c_last_name"), "last"),
+                  (Col("d_year"), "year")],
+            aggs=[(AggExpr(AggFn.SUM, Col(amt)), "year_total")],
+        )
+
+    s_yt = year_total("ss", "store_sales", "ss_customer_sk",
+                      "ss_sales_price")
+    w_yt = year_total("ws", "web_sales", "ws_bill_customer_sk",
+                      "ws_ext_sales_price")
+
+    def pick(src, year, names):
+        return RenameColumnsExec(
+            ProjectExec(
+                FilterExec(src, Col("year") == year),
+                [(Col("sk"), "sk"), (Col("cid"), "cid"),
+                 (Col("first"), "first"), (Col("last"), "last"),
+                 (Col("year_total"), "yt")],
+            ),
+            names,
+        )
+
+    s1 = pick(s_yt, 1998, ["sk1", "cid1", "first1", "last1", "yt_s1"])
+    s2 = pick(s_yt, 1999, ["sk2", "cid2", "first2", "last2", "yt_s2"])
+    w1 = pick(w_yt, 1998, ["sk3", "cid3", "first3", "last3", "yt_w1"])
+    w2 = pick(w_yt, 1999, ["sk4", "cid4", "first4", "last4", "yt_w2"])
+    m = _join(flavor, s1, s2, ["sk1"], ["sk2"])
+    m = _join(flavor, m, w1, ["sk1"], ["sk3"])
+    m = _join(flavor, m, w2, ["sk1"], ["sk4"])
+    kept = FilterExec(
+        m,
+        (Col("yt_s1") > 0.0) & (Col("yt_w1") > 0.0)
+        & ((Col("yt_w2") / Col("yt_w1"))
+           > (Col("yt_s2") / Col("yt_s1"))),
+    )
+    out = ProjectExec(
+        kept,
+        [(Col("cid1"), "customer_id"), (Col("first1"), "first_name"),
+         (Col("last1"), "last_name")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("customer_id"), True, True)],
+        100,
+    )
+
+
+def q92(s, flavor):
+    """TPC-DS q92: web discounts above 1.3x the item's window average
+    (q32's shape on web sales)."""
+    ws = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") <= 3),
+        ),
+        s["web_sales"](),
+        ["d_date_sk"], ["ws_sold_date_sk"],
+    )
+    thresholds = ProjectExec(
+        _agg(
+            ws,
+            keys=[(Col("ws_item_sk"), "t_item_sk")],
+            aggs=[(AggExpr(AggFn.AVG, Col("ws_ext_discount_amt")),
+                   "avg_disc")],
+        ),
+        [(Col("t_item_sk"), "t_item_sk"),
+         (Col("avg_disc") * 1.3, "threshold")],
+    )
+    over = FilterExec(
+        _join(flavor, thresholds, ws, ["t_item_sk"], ["ws_item_sk"]),
+        Col("ws_ext_discount_amt") > Col("threshold"),
+    )
+    return _agg(
+        over,
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("ws_ext_discount_amt")),
+               "excess_discount")],
+    )
+
+
+def q93(s, flavor):
+    """TPC-DS q93: per-customer store revenue with reason-specific
+    return netting (sale rows LEFT-joined to their returns by
+    ticket+item)."""
+    sr_r = _join(
+        flavor,
+        s["reason"](),
+        s["store_returns"](),
+        ["r_reason_sk"], ["sr_reason_sk"],
+    )
+    sr_r = ProjectExec(
+        sr_r,
+        [(Col("sr_ticket_number"), "r_ticket"),
+         (Col("sr_item_sk"), "r_item"),
+         (Col("sr_return_quantity"), "r_qty"),
+         (Col("r_reason_desc"), "r_desc")],
+    )
+    j = HashJoinExec(
+        s["store_sales"](), sr_r,
+        ["ss_ticket_number", "ss_item_sk"], ["r_ticket", "r_item"],
+        JoinType.LEFT,
+    ) if flavor == "bhj" else SortMergeJoinExec(
+        s["store_sales"](), sr_r,
+        ["ss_ticket_number", "ss_item_sk"], ["r_ticket", "r_item"],
+        JoinType.LEFT,
+    )
+    act = ProjectExec(
+        j,
+        [(Col("ss_customer_sk"), "cust"),
+         (If(
+             Col("r_desc") == "reason 3",
+             (Col("ss_quantity").cast(DataType.float64())
+              - Col("r_qty").cast(DataType.float64()))
+             * Col("ss_sales_price"),
+             Col("ss_quantity").cast(DataType.float64())
+             * Col("ss_sales_price"),
+         ), "act_sales")],
+    )
+    agg = _agg(
+        act,
+        keys=[(Col("cust"), "ss_customer_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("act_sales")), "sumsales")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("sumsales"), True, True),
+         SortKey(Col("ss_customer_sk"), True, True)],
+        100,
+    )
+
+
+def q97(s, flavor):
+    """TPC-DS q97: store/catalog purchase overlap - distinct
+    (customer, item) pairs per channel FULL-outer-joined, counted by
+    presence."""
+    def pairs(prefix, table, cust, ren):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_month_seq") >= 1188)
+                & (Col("d_month_seq") <= 1199),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return RenameColumnsExec(
+            _agg(
+                j,
+                keys=[(Col(cust), "c"), (Col(f"{prefix}_item_sk"), "i")],
+                aggs=[],
+            ),
+            ren,
+        )
+
+    ssci = pairs("ss", "store_sales", "ss_customer_sk",
+                 ["s_cust", "s_item"])
+    csci = pairs("cs", "catalog_sales", "cs_bill_customer_sk",
+                 ["c_cust", "c_item"])
+    j = HashJoinExec(
+        ssci, csci, ["s_cust", "s_item"], ["c_cust", "c_item"],
+        JoinType.FULL,
+    ) if flavor == "bhj" else SortMergeJoinExec(
+        ssci, csci, ["s_cust", "s_item"], ["c_cust", "c_item"],
+        JoinType.FULL,
+    )
+    flags = ProjectExec(
+        j,
+        [(If(IsNotNull(Col("s_cust")) & ~IsNotNull(Col("c_cust")),
+             Literal(1, DataType.int64()), Literal(0, DataType.int64())),
+          "store_only"),
+         (If(~IsNotNull(Col("s_cust")) & IsNotNull(Col("c_cust")),
+             Literal(1, DataType.int64()), Literal(0, DataType.int64())),
+          "catalog_only"),
+         (If(IsNotNull(Col("s_cust")) & IsNotNull(Col("c_cust")),
+             Literal(1, DataType.int64()), Literal(0, DataType.int64())),
+          "both")],
+    )
+    return _agg(
+        flags,
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("store_only")), "store_only"),
+              (AggExpr(AggFn.SUM, Col("catalog_only")), "catalog_only"),
+              (AggExpr(AggFn.SUM, Col("both")), "store_and_catalog")],
+    )
+
+
+QUERIES.update({
+    "q31": q31, "q35": q35, "q39": q39, "q49": q49, "q65": q65,
+    "q69": q69, "q74": q74, "q92": q92, "q93": q93, "q97": q97,
+})
