@@ -1823,7 +1823,7 @@ def oracle_q39(t):
             .inv_quantity_on_hand.agg(["mean", "std", "count"])
             .reset_index()
         )
-        g = g[g["count"] >= 1]
+        # singleton groups drop implicitly: std is NaN there
         g = g[(g["mean"] != 0) & (g["std"] / g["mean"] > 1.0)]
         return g
 
@@ -2053,4 +2053,291 @@ ORACLES.update({
     "q49": oracle_q49, "q65": oracle_q65, "q69": oracle_q69,
     "q74": oracle_q74, "q92": oracle_q92, "q93": oracle_q93,
     "q97": oracle_q97,
+})
+
+
+# ---------------------------------------------------------------------------
+# q56/q58/q60/q61/q62/q71/q82/q86/q87/q91/q99 oracles
+# ---------------------------------------------------------------------------
+
+def _oracle_item_set_channels(t, item_mask_fn):
+    it = t["item"]
+    sel_ids = set(it[item_mask_fn(it)].i_item_id)
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy == 2)][["d_date_sk"]]
+    frames = []
+    for prefix, table in (("ss", "store_sales"),
+                          ("cs", "catalog_sales"),
+                          ("ws", "web_sales")):
+        j = _merge(t[table], d, f"{prefix}_sold_date_sk", "d_date_sk")
+        j = j.merge(it[["i_item_sk", "i_item_id"]],
+                    left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+        j = j[j.i_item_id.isin(sel_ids)]
+        g = j.groupby("i_item_id")[f"{prefix}_ext_sales_price"].sum()
+        frames.append(g.reset_index(name="total_sales"))
+    allch = pd.concat(frames, ignore_index=True)
+    return allch.groupby("i_item_id").total_sales.sum().reset_index()
+
+
+def oracle_q56(t):
+    out = _oracle_item_set_channels(
+        t, lambda it: it.i_color.isin(["red", "navy", "khaki"]))
+    out = out.sort_values(["total_sales", "i_item_id"]).head(100)
+    return out[["i_item_id", "total_sales"]].reset_index(drop=True)
+
+
+def oracle_q60(t):
+    out = _oracle_item_set_channels(
+        t, lambda it: it.i_category == "Music")
+    out = out.sort_values(["i_item_id", "total_sales"]).head(100)
+    return out[["i_item_id", "total_sales"]].reset_index(drop=True)
+
+
+def oracle_q58(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_week_seq == 60][["d_date_sk"]]
+    it = t["item"][["i_item_sk", "i_item_id"]]
+
+    def rev(prefix, table):
+        j = _merge(t[table], d, f"{prefix}_sold_date_sk", "d_date_sk")
+        j = j.merge(it, left_on=f"{prefix}_item_sk",
+                    right_on="i_item_sk")
+        return j.groupby("i_item_id")[
+            f"{prefix}_ext_sales_price"].sum()
+
+    ss, cs, ws = rev("ss", "store_sales"), rev("cs", "catalog_sales"), \
+        rev("ws", "web_sales")
+    m = pd.DataFrame({"ss_rev": ss, "cs_rev": cs,
+                      "ws_rev": ws}).dropna()
+    m["average"] = (m.ss_rev + m.cs_rev + m.ws_rev) / 3.0
+    keep = m[
+        m.ss_rev.between(0.9 * m.average, 1.1 * m.average)
+        & m.cs_rev.between(0.9 * m.average, 1.1 * m.average)
+        & m.ws_rev.between(0.9 * m.average, 1.1 * m.average)
+    ].reset_index()
+    keep.columns = ["item_id"] + list(keep.columns[1:])
+    out = keep.sort_values(["item_id", "ss_rev"]).head(100)
+    return out[["item_id", "ss_rev", "cs_rev", "ws_rev", "average"]
+               ].reset_index(drop=True)
+
+
+def oracle_q61(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy == 11)][["d_date_sk"]]
+    it = t["item"][t["item"].i_category == "Books"]
+    j = _merge(t["store_sales"], d, "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(it[["i_item_sk"]], left_on="ss_item_sk",
+                right_on="i_item_sk")
+    pr = t["promotion"]
+    pr = pr[(pr.p_channel_dmail == "Y") | (pr.p_channel_email == "Y")
+            | (pr.p_channel_tv == "Y")]
+    pj = j.merge(pr[["p_promo_sk"]], left_on="ss_promo_sk",
+                 right_on="p_promo_sk")
+    promos = pj.ss_ext_sales_price.sum()
+    total = j.ss_ext_sales_price.sum()
+    return pd.DataFrame([{
+        "promotions": promos, "total": total,
+        "pct": promos / total * 100.0,
+    }])
+
+
+def _oracle_ship_latency(t, prefix, sales, entity, entity_sk,
+                         entity_fk, entity_name):
+    dd = t["date_dim"]
+    d = dd[dd.d_year == 1999][["d_date_sk"]]
+    j = _merge(t[sales], d, f"{prefix}_ship_date_sk", "d_date_sk")
+    j = j.merge(t["warehouse"][["w_warehouse_sk", "w_warehouse_name"]],
+                left_on=f"{prefix}_warehouse_sk",
+                right_on="w_warehouse_sk")
+    j = j.merge(t["ship_mode"][["sm_ship_mode_sk", "sm_type"]],
+                left_on=f"{prefix}_ship_mode_sk",
+                right_on="sm_ship_mode_sk")
+    j = j.merge(t[entity][[entity_sk, entity_name]],
+                left_on=entity_fk, right_on=entity_sk)
+    lag = j[f"{prefix}_ship_date_sk"].astype("float64") - j[
+        f"{prefix}_sold_date_sk"].astype("float64")
+    j = j.assign(
+        d30=(lag <= 30).astype(int),
+        d60=((lag > 30) & (lag <= 60)).astype(int),
+        d90=((lag > 60) & (lag <= 90)).astype(int),
+        d120=((lag > 90) & (lag <= 120)).astype(int),
+        dmore=(lag > 120).astype(int),
+    )
+    g = (
+        j.groupby(["w_warehouse_name", "sm_type", entity_name],
+                  dropna=False)
+        [["d30", "d60", "d90", "d120", "dmore"]].sum().reset_index()
+    )
+    out = g.sort_values(
+        ["w_warehouse_name", "sm_type", entity_name]).head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q62(t):
+    return _oracle_ship_latency(
+        t, "ws", "web_sales", "web_site", "web_site_sk",
+        "ws_web_site_sk", "web_name")
+
+
+def oracle_q99(t):
+    return _oracle_ship_latency(
+        t, "cs", "catalog_sales", "call_center", "cc_call_center_sk",
+        "cs_call_center_sk", "cc_name")
+
+
+def oracle_q71(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy == 12)][["d_date_sk"]]
+    frames = []
+    for prefix, table, tcol in (
+        ("ws", "web_sales", "ws_sold_time_sk"),
+        ("cs", "catalog_sales", "cs_sold_time_sk"),
+        ("ss", "store_sales", "ss_sold_time_sk"),
+    ):
+        j = _merge(t[table], d, f"{prefix}_sold_date_sk", "d_date_sk")
+        frames.append(pd.DataFrame({
+            "ext_price": j[f"{prefix}_ext_sales_price"].values,
+            "sold_item_sk": j[f"{prefix}_item_sk"].values,
+            "time_sk": j[tcol].values,
+        }))
+    allch = pd.concat(frames, ignore_index=True)
+    it = t["item"][t["item"].i_manager_id == 1]
+    j = allch.merge(
+        it[["i_item_sk", "i_brand_id", "i_brand"]],
+        left_on="sold_item_sk", right_on="i_item_sk")
+    td = t["time_dim"]
+    td = td[((td.t_hour >= 7) & (td.t_hour < 9))
+            | ((td.t_hour >= 18) & (td.t_hour < 20))]
+    j = j.merge(td[["t_time_sk", "t_hour", "t_minute"]],
+                left_on="time_sk", right_on="t_time_sk")
+    agg = (
+        j.groupby(["i_brand_id", "i_brand", "t_hour", "t_minute"])
+        .ext_price.sum().reset_index()
+    )
+    out = agg.sort_values(
+        ["ext_price", "i_brand_id", "t_hour", "t_minute"],
+        ascending=[False, True, True, True], na_position="last",
+    )
+    return out[["i_brand_id", "i_brand", "t_hour", "t_minute",
+                "ext_price"]].reset_index(drop=True)
+
+
+def oracle_q82(t):
+    it = t["item"]
+    it = it[it.i_current_price.between(30.0, 60.0)
+            & it.i_manufact_id.isin([10, 20, 30, 40, 50, 60])]
+    inv = t["inventory"]
+    inv = inv[inv.inv_quantity_on_hand.between(100, 500)]
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    j = it.merge(inv, left_on="i_item_sk", right_on="inv_item_sk")
+    j = j.merge(dd[["d_date_sk"]], left_on="inv_date_sk",
+                right_on="d_date_sk")
+    j = j.merge(t["store_sales"][["ss_item_sk"]], left_on="i_item_sk",
+                right_on="ss_item_sk")
+    out = j[["i_item_id", "i_item_desc", "i_current_price"]
+            ].drop_duplicates()
+    return out.sort_values("i_item_id").head(100).reset_index(
+        drop=True)
+
+
+def oracle_q86(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
+    j = _merge(t["web_sales"], d, "ws_sold_date_sk", "d_date_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_category", "i_class"]],
+                left_on="ws_item_sk", right_on="i_item_sk")
+    base = (
+        j.groupby(["i_category", "i_class"], dropna=False)
+        .ws_ext_sales_price.sum().reset_index(name="total_sum")
+    )
+    lvl0 = base.assign(lochierarchy=0)
+    lvl1 = (
+        base.groupby("i_category", dropna=False).total_sum.sum()
+        .reset_index().assign(i_class=pd.NA, lochierarchy=1)
+    )
+    lvl2 = pd.DataFrame([{
+        "i_category": pd.NA, "i_class": pd.NA,
+        "total_sum": base.total_sum.sum(), "lochierarchy": 2,
+    }])
+    rolled = pd.concat([lvl0, lvl1, lvl2], ignore_index=True)
+    rolled["part_cat"] = rolled.i_category.where(
+        rolled.lochierarchy == 0)
+    rolled["rank_within_parent"] = (
+        rolled.groupby(["lochierarchy", "part_cat"], dropna=False)
+        .total_sum.rank(method="min", ascending=False).astype(int)
+    )
+    out = rolled.sort_values(
+        ["lochierarchy", "i_category", "i_class",
+         "rank_within_parent"],
+        ascending=[False, True, True, True], na_position="first",
+    ).head(100)
+    return out[["i_category", "i_class", "total_sum", "lochierarchy",
+                "rank_within_parent"]].reset_index(drop=True)
+
+
+def oracle_q87(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
+
+    def pairs(df, date_col, cust_col):
+        j = _merge(df, d, date_col, "d_date_sk")
+        p = j[[cust_col, "d_date_sk"]].drop_duplicates()
+        return p, set(map(tuple, p.dropna(subset=[cust_col])
+                          .itertuples(index=False)))
+
+    sp_df, _ = pairs(t["store_sales"], "ss_sold_date_sk",
+                     "ss_customer_sk")
+    _, wp = pairs(t["web_sales"], "ws_sold_date_sk",
+                  "ws_bill_customer_sk")
+    _, cp = pairs(t["catalog_sales"], "cs_sold_date_sk",
+                  "cs_bill_customer_sk")
+    cnt = 0
+    for c, dsk in sp_df.itertuples(index=False):
+        if pd.isna(c):
+            cnt += 1  # NULL keys never match in anti joins
+        elif (c, dsk) not in wp and (c, dsk) not in cp:
+            cnt += 1
+    return pd.DataFrame([{"num_store_only": cnt}])
+
+
+def oracle_q91(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy == 11)][["d_date_sk"]]
+    j = _merge(t["catalog_returns"], d, "cr_returned_date_sk",
+               "d_date_sk")
+    j = j.merge(t["call_center"], left_on="cr_call_center_sk",
+                right_on="cc_call_center_sk")
+    j = _merge(j, t["customer"], "cr_returning_customer_sk",
+               "c_customer_sk")
+    cdm = t["customer_demographics"]
+    cdm = cdm[
+        ((cdm.cd_marital_status == "M")
+         & (cdm.cd_education_status == "College"))
+        | ((cdm.cd_marital_status == "S")
+           & (cdm.cd_education_status == "Primary"))
+    ]
+    j = _merge(j, cdm, "c_current_cdemo_sk", "cd_demo_sk")
+    hd = t["household_demographics"]
+    hd = hd[hd.hd_buy_potential == ">10000"]
+    j = j.merge(hd[["hd_demo_sk"]], left_on="c_current_hdemo_sk",
+                right_on="hd_demo_sk")
+    agg = (
+        j.groupby(["cc_name", "cd_marital_status",
+                   "cd_education_status"], dropna=False)
+        .cr_net_loss.sum().reset_index(name="net_loss")
+    )
+    out = agg.sort_values(
+        ["net_loss", "cc_name", "cd_marital_status",
+         "cd_education_status"],
+        ascending=[False, True, True, True],
+    )
+    return out[["cc_name", "cd_marital_status", "cd_education_status",
+                "net_loss"]].reset_index(drop=True)
+
+
+ORACLES.update({
+    "q56": oracle_q56, "q58": oracle_q58, "q60": oracle_q60,
+    "q61": oracle_q61, "q62": oracle_q62, "q71": oracle_q71,
+    "q82": oracle_q82, "q86": oracle_q86, "q87": oracle_q87,
+    "q91": oracle_q91, "q99": oracle_q99,
 })

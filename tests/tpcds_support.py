@@ -3614,11 +3614,8 @@ def q49(s, flavor):
 
     def channel(label, sales, rets, s_keys, r_keys, item_col, qty,
                 amt, r_qty, r_amt):
-        j = HashJoinExec(
-            s[sales](), s[rets](), s_keys, r_keys, JoinType.LEFT,
-        ) if flavor == "bhj" else SortMergeJoinExec(
-            s[sales](), s[rets](), s_keys, r_keys, JoinType.LEFT,
-        )
+        j = _join(flavor, s[sales](), s[rets](), s_keys, r_keys,
+                  JoinType.LEFT)
         ratios = ProjectExec(
             _agg(
                 j,
@@ -3781,13 +3778,9 @@ def q69(s, flavor):
         ("ws", "web_sales", "ws_bill_customer_sk"),
         ("cs", "catalog_sales", "cs_bill_customer_sk"),
     ):
-        cust = HashJoinExec(
-            cust, active(prefix, table, cc),
-            ["c_customer_sk"], ["active_sk"], JoinType.LEFT_ANTI,
-        ) if flavor == "bhj" else SortMergeJoinExec(
-            cust, active(prefix, table, cc),
-            ["c_customer_sk"], ["active_sk"], JoinType.LEFT_ANTI,
-        )
+        cust = _join(flavor, cust, active(prefix, table, cc),
+                     ["c_customer_sk"], ["active_sk"],
+                     JoinType.LEFT_ANTI)
     j = _join(
         flavor, s["customer_demographics"](), cust,
         ["cd_demo_sk"], ["c_current_cdemo_sk"],
@@ -3924,15 +3917,9 @@ def q93(s, flavor):
          (Col("sr_return_quantity"), "r_qty"),
          (Col("r_reason_desc"), "r_desc")],
     )
-    j = HashJoinExec(
-        s["store_sales"](), sr_r,
-        ["ss_ticket_number", "ss_item_sk"], ["r_ticket", "r_item"],
-        JoinType.LEFT,
-    ) if flavor == "bhj" else SortMergeJoinExec(
-        s["store_sales"](), sr_r,
-        ["ss_ticket_number", "ss_item_sk"], ["r_ticket", "r_item"],
-        JoinType.LEFT,
-    )
+    j = _join(flavor, s["store_sales"](), sr_r,
+              ["ss_ticket_number", "ss_item_sk"],
+              ["r_ticket", "r_item"], JoinType.LEFT)
     act = ProjectExec(
         j,
         [(Col("ss_customer_sk"), "cust"),
@@ -3986,13 +3973,8 @@ def q97(s, flavor):
                  ["s_cust", "s_item"])
     csci = pairs("cs", "catalog_sales", "cs_bill_customer_sk",
                  ["c_cust", "c_item"])
-    j = HashJoinExec(
-        ssci, csci, ["s_cust", "s_item"], ["c_cust", "c_item"],
-        JoinType.FULL,
-    ) if flavor == "bhj" else SortMergeJoinExec(
-        ssci, csci, ["s_cust", "s_item"], ["c_cust", "c_item"],
-        JoinType.FULL,
-    )
+    j = _join(flavor, ssci, csci, ["s_cust", "s_item"],
+              ["c_cust", "c_item"], JoinType.FULL)
     flags = ProjectExec(
         j,
         [(If(IsNotNull(Col("s_cust")) & ~IsNotNull(Col("c_cust")),
@@ -4017,4 +3999,565 @@ def q97(s, flavor):
 QUERIES.update({
     "q31": q31, "q35": q35, "q39": q39, "q49": q49, "q65": q65,
     "q69": q69, "q74": q74, "q92": q92, "q93": q93, "q97": q97,
+})
+
+
+# ---------------------------------------------------------------------------
+# q56/q58/q60/q61/q62/q71/q82/q86/q87/q91/q99 block (cross-channel item
+# sets, shipping latency, call-center returns)
+# ---------------------------------------------------------------------------
+
+_GEN_V5 = gen_tables
+
+N_SHIP_MODES = 5
+N_WEB_SITES = 6
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V5(seed)
+    rng = np.random.default_rng(seed + 23)
+    cs = t["catalog_sales"]
+    n_cs = len(cs)
+    cs["cs_bill_addr_sk"] = pd.array(
+        np.where(
+            rng.random(n_cs) < 0.02, np.nan,
+            rng.integers(0, N_ADDRESSES, n_cs).astype(np.float64),
+        ),
+        dtype=pd.Int32Dtype(),
+    )
+    cs["cs_sold_time_sk"] = rng.integers(0, N_TIMES, n_cs).astype(
+        np.int32)
+    # shipping: ship date lags the sale by 1-120 days
+    for pre, frame in (("cs", cs), ("ws", t["web_sales"])):
+        n = len(frame)
+        sold = frame[f"{pre}_sold_date_sk"].to_numpy(
+            dtype=np.float64, na_value=np.nan)
+        lag = rng.integers(1, 121, n)
+        ship = sold + lag
+        frame[f"{pre}_ship_date_sk"] = pd.array(
+            ship, dtype=pd.Int32Dtype())
+        frame[f"{pre}_ship_mode_sk"] = rng.integers(
+            0, N_SHIP_MODES, n).astype(np.int32)
+        frame[f"{pre}_warehouse_sk"] = rng.integers(
+            0, N_WAREHOUSES, n).astype(np.int32)
+    t["web_sales"]["ws_web_site_sk"] = rng.integers(
+        0, N_WEB_SITES, len(t["web_sales"])).astype(np.int32)
+    t["ship_mode"] = pd.DataFrame(
+        {
+            "sm_ship_mode_sk": np.arange(N_SHIP_MODES, dtype=np.int32),
+            "sm_type": np.array(
+                ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"],
+                dtype=object),
+        }
+    )
+    t["web_site"] = pd.DataFrame(
+        {
+            "web_site_sk": np.arange(N_WEB_SITES, dtype=np.int32),
+            "web_name": [f"site_{i}" for i in range(N_WEB_SITES)],
+        }
+    )
+    pr = t["promotion"]
+    n_pr = len(pr)
+    pr["p_channel_dmail"] = np.array(
+        ["Y", "N"], dtype=object)[rng.integers(0, 2, n_pr)]
+    pr["p_channel_tv"] = np.array(
+        ["Y", "N"], dtype=object)[rng.integers(0, 2, n_pr)]
+    cr = t["catalog_returns"]
+    n_cr = len(cr)
+    cr["cr_call_center_sk"] = rng.integers(0, 4, n_cr).astype(np.int32)
+    cr["cr_returning_customer_sk"] = pd.array(
+        np.where(
+            rng.random(n_cr) < 0.02, np.nan,
+            rng.integers(0, N_CUSTOMERS, n_cr).astype(np.float64),
+        ),
+        dtype=pd.Int32Dtype(),
+    )
+    t["customer"]["c_current_hdemo_sk"] = rng.integers(
+        0, N_HDEMO, len(t["customer"])).astype(np.int32)
+    return t
+
+
+def _item_set_channels(s, flavor, item_pred, out_key):
+    """q56/q60 shape: revenue of an item-attribute-selected set summed
+    across all three channels (item set via i_item_id semi join)."""
+    ids = _agg(
+        FilterExec(s["item"](), item_pred),
+        keys=[(Col("i_item_id"), "sel_id")], aggs=[],
+    )
+
+    def channel(prefix, table):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") == 2),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(flavor, s["item"](), j,
+                  ["i_item_sk"], [f"{prefix}_item_sk"])
+        j = _semi(flavor, j, ids, ["i_item_id"], ["sel_id"])
+        return _agg(
+            j,
+            keys=[(Col("i_item_id"), out_key)],
+            aggs=[(AggExpr(AggFn.SUM, Col(f"{prefix}_ext_sales_price")),
+                   "total_sales")],
+        )
+
+    all_ch = _union([
+        channel("ss", "store_sales"),
+        channel("cs", "catalog_sales"),
+        channel("ws", "web_sales"),
+    ])
+    return _agg(
+        all_ch,
+        keys=[(Col(out_key), out_key)],
+        aggs=[(AggExpr(AggFn.SUM, Col("total_sales")), "total_sales")],
+    )
+
+
+def q56(s, flavor):
+    """TPC-DS q56: cross-channel revenue of color-selected items."""
+    def slit(v):
+        return Literal(v, DataType.utf8())
+
+    agg = _item_set_channels(
+        s, flavor,
+        InList(Col("i_color"), (slit("red"), slit("navy"),
+                                slit("khaki"))),
+        "i_item_id",
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("total_sales"), True, True),
+         SortKey(Col("i_item_id"), True, True)],
+        100,
+    )
+
+
+def q60(s, flavor):
+    """TPC-DS q60: cross-channel revenue of one category's items."""
+    agg = _item_set_channels(
+        s, flavor, Col("i_category") == "Music", "i_item_id",
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("i_item_id"), True, True),
+         SortKey(Col("total_sales"), True, True)],
+        100,
+    )
+
+
+def q58(s, flavor):
+    """TPC-DS q58: items whose one-week revenue is within 10% across
+    all three channels simultaneously."""
+    def channel(prefix, table, out):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_week_seq") == 60),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(flavor, s["item"](), j,
+                  ["i_item_sk"], [f"{prefix}_item_sk"])
+        return _agg(
+            j,
+            keys=[(Col("i_item_id"), f"id_{out}")],
+            aggs=[(AggExpr(AggFn.SUM, Col(f"{prefix}_ext_sales_price")),
+                   out)],
+        )
+
+    ss = channel("ss", "store_sales", "ss_rev")
+    cs = channel("cs", "catalog_sales", "cs_rev")
+    ws = channel("ws", "web_sales", "ws_rev")
+    j = _join(flavor, ss, cs, ["id_ss_rev"], ["id_cs_rev"])
+    j = _join(flavor, j, ws, ["id_ss_rev"], ["id_ws_rev"])
+    avg3 = (Col("ss_rev") + Col("cs_rev") + Col("ws_rev")) / 3.0
+    within = FilterExec(
+        ProjectExec(
+            j,
+            [(Col("id_ss_rev"), "item_id"),
+             (Col("ss_rev"), "ss_rev"), (Col("cs_rev"), "cs_rev"),
+             (Col("ws_rev"), "ws_rev"), (avg3, "average")],
+        ),
+        (Col("ss_rev") >= Col("average") * 0.9)
+        & (Col("ss_rev") <= Col("average") * 1.1)
+        & (Col("cs_rev") >= Col("average") * 0.9)
+        & (Col("cs_rev") <= Col("average") * 1.1)
+        & (Col("ws_rev") >= Col("average") * 0.9)
+        & (Col("ws_rev") <= Col("average") * 1.1),
+    )
+    return _sorted_limit(
+        within,
+        [SortKey(Col("item_id"), True, True),
+         SortKey(Col("ss_rev"), True, True)],
+        100,
+    )
+
+
+def q61(s, flavor):
+    """TPC-DS q61: promotional store revenue share (two scalar sums on
+    a constant key)."""
+    def base(promo):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") == 11),
+            ),
+            s["store_sales"](),
+            ["d_date_sk"], ["ss_sold_date_sk"],
+        )
+        j = _join(
+            flavor,
+            FilterExec(s["item"](), Col("i_category") == "Books"),
+            j, ["i_item_sk"], ["ss_item_sk"],
+        )
+        if promo:
+            pr = FilterExec(
+                s["promotion"](),
+                (Col("p_channel_dmail") == "Y")
+                | (Col("p_channel_email") == "Y")
+                | (Col("p_channel_tv") == "Y"),
+            )
+            j = _join(flavor, pr, j, ["p_promo_sk"], ["ss_promo_sk"])
+        name = "promotions" if promo else "total"
+        return ProjectExec(
+            _agg(j, keys=[],
+                 aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+                        name)]),
+            [(Literal(1, DataType.int32()), f"{name}_k"),
+             (Col(name), name)],
+        )
+
+    both = _join(flavor, base(True), base(False),
+                 ["promotions_k"], ["total_k"])
+    return ProjectExec(
+        both,
+        [(Col("promotions"), "promotions"), (Col("total"), "total"),
+         (Col("promotions") / Col("total") * 100.0, "pct")],
+    )
+
+
+def _ship_latency(s, flavor, prefix, sales, entity_scan, entity_sk,
+                  entity_fk, entity_name):
+    """q62/q99 shape: shipping-lag day buckets by warehouse, ship mode
+    and site/call-center."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999),
+        ),
+        s[sales](),
+        ["d_date_sk"], [f"{prefix}_ship_date_sk"],
+    )
+    j = _join(flavor, s["warehouse"](), j,
+              ["w_warehouse_sk"], [f"{prefix}_warehouse_sk"])
+    j = _join(flavor, s["ship_mode"](), j,
+              ["sm_ship_mode_sk"], [f"{prefix}_ship_mode_sk"])
+    j = _join(flavor, entity_scan(), j, [entity_sk], [entity_fk])
+    lag = (Col(f"{prefix}_ship_date_sk").cast(DataType.int64())
+           - Col(f"{prefix}_sold_date_sk").cast(DataType.int64()))
+
+    def bucket(lo, hi, name):
+        if lo is None:
+            cond = lag <= hi
+        elif hi is None:
+            cond = lag > lo
+        else:
+            cond = (lag > lo) & (lag <= hi)
+        return (AggExpr(AggFn.SUM, If(
+            cond, Literal(1, DataType.int64()),
+            Literal(0, DataType.int64()))), name)
+
+    return _agg(
+        j,
+        keys=[(Col("w_warehouse_name"), "warehouse"),
+              (Col("sm_type"), "sm_type"),
+              (Col(entity_name), "site")],
+        aggs=[bucket(None, 30, "d30"), bucket(30, 60, "d60"),
+              bucket(60, 90, "d90"), bucket(90, 120, "d120"),
+              bucket(120, None, "dmore")],
+    )
+
+
+def q62(s, flavor):
+    """TPC-DS q62: web shipping-latency buckets."""
+    agg = _ship_latency(
+        s, flavor, "ws", "web_sales",
+        s["web_site"], "web_site_sk", "ws_web_site_sk", "web_name",
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("warehouse"), True, True),
+         SortKey(Col("sm_type"), True, True),
+         SortKey(Col("site"), True, True)],
+        100,
+    )
+
+
+def q99(s, flavor):
+    """TPC-DS q99: catalog shipping-latency buckets by call center."""
+    agg = _ship_latency(
+        s, flavor, "cs", "catalog_sales",
+        s["call_center"], "cc_call_center_sk", "cs_call_center_sk",
+        "cc_name",
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("warehouse"), True, True),
+         SortKey(Col("sm_type"), True, True),
+         SortKey(Col("site"), True, True)],
+        100,
+    )
+
+
+def q71(s, flavor):
+    """TPC-DS q71: one manager's brand revenue by breakfast/dinner
+    hours across channels."""
+    def channel(prefix, table, time_col):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999) & (Col("d_moy") == 12),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return ProjectExec(
+            j,
+            [(Col(f"{prefix}_ext_sales_price"), "ext_price"),
+             (Col(f"{prefix}_item_sk"), "sold_item_sk"),
+             (Col(time_col), "time_sk")],
+        )
+
+    all_ch = _union([
+        channel("ws", "web_sales", "ws_sold_time_sk"),
+        channel("cs", "catalog_sales", "cs_sold_time_sk"),
+        channel("ss", "store_sales", "ss_sold_time_sk"),
+    ])
+    j = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_manager_id") == 1),
+        all_ch,
+        ["i_item_sk"], ["sold_item_sk"],
+    )
+    td = FilterExec(
+        s["time_dim"](),
+        ((Col("t_hour") >= 7) & (Col("t_hour") < 9))
+        | ((Col("t_hour") >= 18) & (Col("t_hour") < 20)),
+    )
+    j = _join(flavor, td, j, ["t_time_sk"], ["time_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_brand_id"), "brand_id"),
+              (Col("i_brand"), "brand"),
+              (Col("t_hour"), "t_hour"),
+              (Col("t_minute"), "t_minute")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ext_price")), "ext_price")],
+    )
+    return SortExec(
+        agg,
+        [SortKey(Col("ext_price"), False, False),
+         SortKey(Col("brand_id"), True, True),
+         SortKey(Col("t_hour"), True, True),
+         SortKey(Col("t_minute"), True, True)],
+    )
+
+
+def q82(s, flavor):
+    """TPC-DS q82: store items with 100-500 units on hand in a price
+    window (q37's shape on store sales)."""
+    it = FilterExec(
+        s["item"](),
+        (Col("i_current_price") >= 30.0)
+        & (Col("i_current_price") <= 60.0)
+        & InList(Col("i_manufact_id"),
+                 tuple(Literal(v, DataType.int32())
+                       for v in (10, 20, 30, 40, 50, 60))),
+    )
+    inv = FilterExec(
+        s["inventory"](),
+        (Col("inv_quantity_on_hand") >= 100)
+        & (Col("inv_quantity_on_hand") <= 500),
+    )
+    j = _join(flavor, it, inv, ["i_item_sk"], ["inv_item_sk"])
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        j, ["d_date_sk"], ["inv_date_sk"],
+    )
+    j = _join(flavor, j, s["store_sales"](),
+              ["i_item_sk"], ["ss_item_sk"])
+    distinct = _agg(
+        j,
+        keys=[(Col("i_item_id"), "i_item_id"),
+              (Col("i_item_desc"), "i_item_desc"),
+              (Col("i_current_price"), "i_current_price")],
+        aggs=[],
+    )
+    return _sorted_limit(
+        distinct, [SortKey(Col("i_item_id"), True, True)], 100,
+    )
+
+
+def q86(s, flavor):
+    """TPC-DS q86 (rollup as grouping-set union): web revenue by
+    category/class with rollup rows and a within-parent rank."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_month_seq") >= 1188) & (Col("d_month_seq") <= 1199),
+        ),
+        s["web_sales"](),
+        ["d_date_sk"], ["ws_sold_date_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ws_item_sk"])
+    base = _agg(
+        j,
+        keys=[(Col("i_category"), "i_category"),
+              (Col("i_class"), "i_class")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ws_ext_sales_price")),
+               "total_sum")],
+    )
+    lvl1 = ProjectExec(
+        _agg(
+            base,
+            keys=[(Col("i_category"), "i_category")],
+            aggs=[(AggExpr(AggFn.SUM, Col("total_sum")), "total_sum")],
+        ),
+        [(Col("i_category"), "i_category"),
+         (Literal(None, DataType.utf8()), "i_class"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(1, DataType.int64()), "lochierarchy")],
+    )
+    lvl0 = ProjectExec(
+        base,
+        [(Col("i_category"), "i_category"), (Col("i_class"), "i_class"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(0, DataType.int64()), "lochierarchy")],
+    )
+    lvl2 = ProjectExec(
+        _agg(base, keys=[],
+             aggs=[(AggExpr(AggFn.SUM, Col("total_sum")),
+                    "total_sum")]),
+        [(Literal(None, DataType.utf8()), "i_category"),
+         (Literal(None, DataType.utf8()), "i_class"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(2, DataType.int64()), "lochierarchy")],
+    )
+    rolled = _union([lvl0, lvl1, lvl2])
+    ranked = WindowExec(
+        rolled,
+        partition_by=[Col("lochierarchy"), If(
+            Col("lochierarchy") == 0, Col("i_category"),
+            Literal(None, DataType.utf8()))],
+        order_by=[SortKey(Col("total_sum"), False, False)],
+        functions=[WindowFn("rank", None, "rank_within_parent")],
+    )
+    return _sorted_limit(
+        ranked,
+        [SortKey(Col("lochierarchy"), False, False),
+         SortKey(Col("i_category"), True, True),
+         SortKey(Col("i_class"), True, True),
+         SortKey(Col("rank_within_parent"), True, True)],
+        100,
+    )
+
+
+def q87(s, flavor):
+    """TPC-DS q87: store customer-days never seen in web or catalog
+    (EXCEPT as anti joins on composite keys)."""
+    def pairs(prefix, table, cust, ren):
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_month_seq") >= 1188)
+                & (Col("d_month_seq") <= 1199),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        return RenameColumnsExec(
+            _agg(
+                j,
+                keys=[(Col(cust), "c"), (Col("d_date_sk"), "d")],
+                aggs=[],
+            ),
+            ren,
+        )
+
+    ssd = pairs("ss", "store_sales", "ss_customer_sk", ["sc", "sd"])
+    wsd = pairs("ws", "web_sales", "ws_bill_customer_sk", ["wc", "wd"])
+    csd = pairs("cs", "catalog_sales", "cs_bill_customer_sk",
+                ["cc", "cd"])
+    rem = _join(flavor, ssd, wsd, ["sc", "sd"], ["wc", "wd"],
+                JoinType.LEFT_ANTI)
+    rem = _join(flavor, rem, csd, ["sc", "sd"], ["cc", "cd"],
+                JoinType.LEFT_ANTI)
+    return _agg(
+        rem, keys=[],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "num_store_only")],
+    )
+
+
+def q91(s, flavor):
+    """TPC-DS q91: call-center catalog return losses by demographic
+    segment and buy potential."""
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") == 11),
+        ),
+        s["catalog_returns"](),
+        ["d_date_sk"], ["cr_returned_date_sk"],
+    )
+    j = _join(flavor, s["call_center"](), j,
+              ["cc_call_center_sk"], ["cr_call_center_sk"])
+    j = _join(flavor, j, s["customer"](),
+              ["cr_returning_customer_sk"], ["c_customer_sk"])
+    cd = FilterExec(
+        s["customer_demographics"](),
+        ((Col("cd_marital_status") == "M")
+         & (Col("cd_education_status") == "College"))
+        | ((Col("cd_marital_status") == "S")
+           & (Col("cd_education_status") == "Primary")),
+    )
+    j = _join(flavor, cd, j, ["cd_demo_sk"], ["c_current_cdemo_sk"])
+    hd = FilterExec(
+        s["household_demographics"](),
+        Col("hd_buy_potential") == ">10000",
+    )
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["c_current_hdemo_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("cc_name"), "call_center"),
+              (Col("cd_marital_status"), "marital"),
+              (Col("cd_education_status"), "education")],
+        aggs=[(AggExpr(AggFn.SUM, Col("cr_net_loss")), "net_loss")],
+    )
+    return SortExec(
+        agg,
+        [SortKey(Col("net_loss"), False, False),
+         SortKey(Col("call_center"), True, True),
+         SortKey(Col("marital"), True, True),
+         SortKey(Col("education"), True, True)],
+    )
+
+
+QUERIES.update({
+    "q56": q56, "q58": q58, "q60": q60, "q61": q61, "q62": q62,
+    "q71": q71, "q82": q82, "q86": q86, "q87": q87, "q91": q91,
+    "q99": q99,
 })
