@@ -124,8 +124,18 @@ class DeviceEvaluator:
     # ------------------------------------------------------------------
     def _literal(self, e: ir.Literal) -> CV:
         if e.value is None:
+            # zeros must carry the literal's PHYSICAL dtype: a NULL
+            # int32 literal column that materialized as int8 would
+            # poison positional unions with narrowed arithmetic
+            # (1999 scatter-cast through int8 -> -49)
+            if e.dtype is None:
+                phys, shape = jnp.int8, (self.capacity,)
+            elif e.dtype.is_wide_decimal:
+                phys, shape = jnp.int64, (self.capacity, 2)
+            else:
+                phys, shape = e.dtype.physical_dtype(), (self.capacity,)
             return (
-                jnp.zeros(self.capacity, dtype=jnp.int8),
+                jnp.zeros(shape, dtype=phys),
                 jnp.zeros(self.capacity, dtype=jnp.bool_),
             )
         if e.dtype.is_string_like:

@@ -157,3 +157,35 @@ def test_sort_fetch_zero_roundtrip():
     # and None still round-trips as None
     plan2 = SortExec(reader, [SortKey(Col("a"))])
     assert plan_from_proto(plan_to_proto(plan2)).fetch is None
+
+
+def test_null_literal_carries_physical_dtype():
+    """A typed NULL literal column must materialize with its declared
+    physical dtype: unions are positional, so an int8-zeros stand-in
+    poisons sibling int32 columns (1999 scatter-cast via int8 -> -49)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.exprs import Col, Literal
+    from blaze_tpu.ops import (
+        CoalescePartitionsExec, MemoryScanExec, ProjectExec, UnionExec,
+    )
+    from blaze_tpu.runtime.executor import run_plan
+    from blaze_tpu.types import DataType
+
+    rb = pa.record_batch({"y": np.array([1999, 2000], dtype=np.int32)})
+    cb = ColumnBatch.from_arrow(rb)
+    real = ProjectExec(
+        MemoryScanExec([[cb]], cb.schema), [(Col("y"), "y")]
+    )
+    nulls = ProjectExec(
+        MemoryScanExec([[cb]], cb.schema),
+        [(Literal(None, DataType.int32()), "y")],
+    )
+    out = run_plan(
+        CoalescePartitionsExec(UnionExec([nulls, real]))
+    ).to_pandas()
+    vals = sorted(v for v in out.y.tolist() if v is not None
+                  and not (isinstance(v, float) and v != v))
+    assert vals == [1999, 2000], out

@@ -2341,3 +2341,365 @@ ORACLES.update({
     "q82": oracle_q82, "q86": oracle_q86, "q87": oracle_q87,
     "q91": oracle_q91, "q99": oracle_q99,
 })
+
+
+# ---------------------------------------------------------------------------
+# q66/q67/q70/q72/q75/q76/q77/q78 oracles
+# ---------------------------------------------------------------------------
+
+def oracle_q66(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_year == 1999][["d_date_sk", "d_moy"]]
+    sm = t["ship_mode"]
+    sm = sm[sm.sm_type.isin(["EXPRESS", "REGULAR"])]
+    frames = []
+    for prefix, table in (("ws", "web_sales"), ("cs", "catalog_sales")):
+        j = _merge(t[table], d, f"{prefix}_sold_date_sk", "d_date_sk")
+        j = j.merge(sm[["sm_ship_mode_sk"]],
+                    left_on=f"{prefix}_ship_mode_sk",
+                    right_on="sm_ship_mode_sk")
+        j = j.merge(
+            t["warehouse"][["w_warehouse_sk", "w_warehouse_name"]],
+            left_on=f"{prefix}_warehouse_sk",
+            right_on="w_warehouse_sk")
+        for m in range(1, 13):
+            j[f"m{m}_sales"] = j[f"{prefix}_ext_sales_price"].where(
+                j.d_moy == m)
+        g = j.groupby("w_warehouse_name")[
+            [f"m{m}_sales" for m in range(1, 13)]
+        ].sum(min_count=1).reset_index()
+        frames.append(g)
+    allch = pd.concat(frames, ignore_index=True)
+    out = allch.groupby("w_warehouse_name")[
+        [f"m{m}_sales" for m in range(1, 13)]
+    ].sum(min_count=1).reset_index()
+    return out.sort_values("w_warehouse_name").head(100).reset_index(
+        drop=True)
+
+
+def oracle_q67(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][
+        ["d_date_sk", "d_year", "d_qoy", "d_moy"]]
+    j = _merge(t["store_sales"], d, "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(
+        t["item"][["i_item_sk", "i_category", "i_class", "i_brand",
+                   "i_product_name"]],
+        left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_id"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j["sumsales"] = j.ss_sales_price * j.ss_quantity
+    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
+                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    base = (
+        j.groupby(base_cols, dropna=False)
+        .sumsales.sum().reset_index()
+    )
+    levels = []
+    for k in range(len(base_cols) + 1):
+        if k == len(base_cols):
+            lv = base.copy()
+        elif k == 0:
+            lv = pd.DataFrame(
+                [{c: pd.NA for c in base_cols}
+                 | {"sumsales": base.sumsales.sum()}])
+        else:
+            lv = (
+                base.groupby(base_cols[:k], dropna=False)
+                .sumsales.sum().reset_index()
+            )
+            for c in base_cols[k:]:
+                lv[c] = pd.NA
+        levels.append(lv[base_cols + ["sumsales"]])
+    rolled = pd.concat(levels, ignore_index=True)
+    rolled["rk"] = (
+        rolled.groupby("i_category", dropna=False)
+        .sumsales.rank(method="min", ascending=False).astype(int)
+    )
+    top = rolled[rolled.rk <= 100]
+    out = top.sort_values(
+        base_cols + ["sumsales", "rk"], na_position="first").head(100)
+    return out[base_cols + ["sumsales", "rk"]].reset_index(drop=True)
+
+
+def oracle_q70(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
+    j = _merge(t["store_sales"], d, "ss_sold_date_sk", "d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_state", "s_county"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    by_state = j.groupby("s_state").ss_net_profit.sum().reset_index(
+        name="sp")
+    by_state["rnk"] = by_state.sp.rank(
+        method="min", ascending=False).astype(int)
+    top_states = set(by_state[by_state.rnk <= 5].s_state)
+    q = j[j.s_state.isin(top_states)]
+    base = (
+        q.groupby(["s_state", "s_county"], dropna=False)
+        .ss_net_profit.sum().reset_index(name="total_sum")
+    )
+    lvl0 = base.assign(lochierarchy=0)
+    lvl1 = (
+        base.groupby("s_state", dropna=False).total_sum.sum()
+        .reset_index().assign(s_county=pd.NA, lochierarchy=1)
+    )
+    lvl2 = pd.DataFrame([{
+        "s_state": pd.NA, "s_county": pd.NA,
+        "total_sum": base.total_sum.sum(), "lochierarchy": 2,
+    }])
+    rolled = pd.concat([lvl0, lvl1, lvl2], ignore_index=True)
+    rolled["part_state"] = rolled.s_state.where(
+        rolled.lochierarchy == 0)
+    rolled["rank_within_parent"] = (
+        rolled.groupby(["lochierarchy", "part_state"], dropna=False)
+        .total_sum.rank(method="min", ascending=False).astype(int)
+    )
+    out = rolled.sort_values(
+        ["lochierarchy", "s_state", "s_county", "rank_within_parent"],
+        ascending=[False, True, True, True], na_position="first",
+    ).head(100)
+    return out[["s_state", "s_county", "total_sum", "lochierarchy",
+                "rank_within_parent"]].reset_index(drop=True)
+
+
+def oracle_q72(t):
+    dd = t["date_dim"]
+    d99 = dd[dd.d_year == 1999][["d_date_sk", "d_week_seq"]]
+    cs = _merge(t["catalog_sales"], d99, "cs_sold_date_sk",
+                "d_date_sk").rename(columns={"d_week_seq": "sold_week"})
+    cs = cs[(cs.cs_ship_date_sk.astype("float64")
+             - cs.cs_sold_date_sk.astype("float64")) > 5]
+    inv = t["inventory"].merge(
+        t["warehouse"][["w_warehouse_sk", "w_warehouse_name"]],
+        left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+    inv = inv.merge(dd[["d_date_sk", "d_week_seq"]],
+                    left_on="inv_date_sk", right_on="d_date_sk"
+                    ).rename(columns={"d_week_seq": "inv_week"})
+    j = cs.merge(inv, left_on="cs_item_sk", right_on="inv_item_sk")
+    j = j[(j.inv_quantity_on_hand < j.cs_quantity)
+          & (j.inv_week == j.sold_week)]
+    hd = t["household_demographics"]
+    hd = hd[hd.hd_buy_potential == ">10000"]
+    j = j.merge(hd[["hd_demo_sk"]], left_on="cs_bill_hdemo_sk",
+                right_on="hd_demo_sk")
+    cdm = t["customer_demographics"]
+    cdm = cdm[cdm.cd_marital_status == "M"]
+    j = j.merge(cdm[["cd_demo_sk"]], left_on="cs_bill_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_desc"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    agg = (
+        j.groupby(["i_item_desc", "w_warehouse_name", "sold_week"],
+                  dropna=False)
+        .size().reset_index(name="no_promo")
+    )
+    out = agg.sort_values(
+        ["no_promo", "i_item_desc", "w_warehouse_name", "sold_week"],
+        ascending=[False, True, True, True],
+    ).head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q75(t):
+    frames = []
+    it = t["item"]
+    it = it[it.i_category == "Books"][["i_item_sk", "i_brand_id"]]
+    dd = t["date_dim"]
+    d = dd[dd.d_year.between(1998, 1999)][["d_date_sk", "d_year"]]
+    for prefix, table, rets, sk, rk, qty, amt, rq, ra in (
+        ("cs", "catalog_sales", "catalog_returns",
+         ["cs_order_number", "cs_item_sk"],
+         ["cr_order_number", "cr_item_sk"],
+         "cs_quantity", "cs_ext_sales_price",
+         "cr_return_quantity", "cr_return_amount"),
+        ("ss", "store_sales", "store_returns",
+         ["ss_ticket_number", "ss_item_sk"],
+         ["sr_ticket_number", "sr_item_sk"],
+         "ss_quantity", "ss_ext_sales_price",
+         "sr_return_quantity", "sr_return_amt"),
+        ("ws", "web_sales", "web_returns",
+         ["ws_order_number", "ws_item_sk"],
+         ["wr_order_number", "wr_item_sk"],
+         "ws_quantity", "ws_ext_sales_price",
+         "wr_return_quantity", "wr_return_amt"),
+    ):
+        j = _merge(t[table], d, f"{prefix}_sold_date_sk", "d_date_sk")
+        j = j.merge(it, left_on=f"{prefix}_item_sk",
+                    right_on="i_item_sk")
+        j = j.merge(t[rets][rk + [rq, ra]], left_on=sk, right_on=rk,
+                    how="left")
+        frames.append(pd.DataFrame({
+            "d_year": j.d_year,
+            "i_brand_id": j.i_brand_id,
+            "sales_cnt": j[qty] - j[rq].fillna(0),
+            "sales_amt": j[amt] - j[ra].fillna(0),
+        }))
+    allch = pd.concat(frames, ignore_index=True)
+    by_year = (
+        allch.groupby(["d_year", "i_brand_id"], dropna=False)
+        [["sales_cnt", "sales_amt"]].sum().reset_index()
+    )
+    prev = by_year[by_year.d_year == 1998]
+    curr = by_year[by_year.d_year == 1999]
+    m = prev.merge(curr, on="i_brand_id", suffixes=("_p", "_c"))
+    m = m[m.sales_cnt_c / m.sales_cnt_p < 0.9]
+    out = pd.DataFrame({
+        "prev_year": m.d_year_p, "year": m.d_year_c,
+        "i_brand_id": m.i_brand_id,
+        "prev_yr_cnt": m.sales_cnt_p, "curr_yr_cnt": m.sales_cnt_c,
+        "sales_cnt_diff": m.sales_cnt_c - m.sales_cnt_p,
+        "sales_amt_diff": m.sales_amt_c - m.sales_amt_p,
+    })
+    out = out.sort_values(["sales_cnt_diff", "i_brand_id"]).head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q76(t):
+    frames = []
+    for label, prefix, table, null_col, amt in (
+        ("store", "ss", "store_sales", "ss_customer_sk",
+         "ss_ext_sales_price"),
+        ("web", "ws", "web_sales", "ws_bill_customer_sk",
+         "ws_ext_sales_price"),
+        ("catalog", "cs", "catalog_sales", "cs_bill_addr_sk",
+         "cs_ext_sales_price"),
+    ):
+        df = t[table]
+        df = df[df[null_col].isna()]
+        j = _merge(df, t["date_dim"][["d_date_sk", "d_year"]],
+                   f"{prefix}_sold_date_sk", "d_date_sk")
+        j = j.merge(t["item"][["i_item_sk", "i_category"]],
+                    left_on=f"{prefix}_item_sk", right_on="i_item_sk")
+        frames.append(pd.DataFrame({
+            "channel": label, "col_name": null_col,
+            "d_year": j.d_year, "i_category": j.i_category,
+            "ext_sales_price": j[amt],
+        }))
+    allch = pd.concat(frames, ignore_index=True)
+    agg = (
+        allch.groupby(["channel", "col_name", "d_year", "i_category"],
+                      dropna=False)
+        .agg(sales_cnt=("ext_sales_price", "size"),
+             sales_amt=("ext_sales_price", "sum"))
+        .reset_index()
+    )
+    out = agg.sort_values(
+        ["channel", "col_name", "d_year", "i_category"],
+        na_position="first").head(100)
+    return out.reset_index(drop=True)
+
+
+def oracle_q77(t):
+    dd = t["date_dim"]
+    d = dd[(dd.d_year == 1999) & (dd.d_moy <= 2)][["d_date_sk"]]
+
+    def agg_side(table, date_col, key_col, cols):
+        j = _merge(t[table], d, date_col, "d_date_sk")
+        return j.groupby(key_col)[cols].sum()
+
+    ss = agg_side("store_sales", "ss_sold_date_sk", "ss_store_sk",
+                  ["ss_ext_sales_price", "ss_net_profit"])
+    sr = agg_side("store_returns", "sr_returned_date_sk",
+                  "sr_store_sk", ["sr_return_amt", "sr_net_loss"])
+    store = ss.join(sr, how="left").fillna(0).reset_index()
+    store = pd.DataFrame({
+        "channel": "store channel",
+        "id": store.ss_store_sk.astype("Int64"),
+        "sales": store.ss_ext_sales_price,
+        "returns_": store.sr_return_amt,
+        "profit": store.ss_net_profit - store.sr_net_loss,
+    })
+    csj = _merge(t["catalog_sales"], d, "cs_sold_date_sk", "d_date_sk")
+    crj = _merge(t["catalog_returns"], d, "cr_returned_date_sk",
+                 "d_date_sk")
+    catalog = pd.DataFrame([{
+        "channel": "catalog channel", "id": pd.NA,
+        "sales": csj.cs_ext_sales_price.sum(),
+        "returns_": crj.cr_return_amount.sum(),
+        "profit": csj.cs_ext_discount_amt.sum()
+        - crj.cr_net_loss.sum(),
+    }])
+    ws = agg_side("web_sales", "ws_sold_date_sk", "ws_web_page_sk",
+                  ["ws_ext_sales_price", "ws_ext_discount_amt"])
+    wrg = agg_side("web_returns", "wr_returned_date_sk",
+                   "wr_web_page_sk", ["wr_return_amt", "wr_net_loss"])
+    web = ws.join(wrg, how="left").fillna(0).reset_index()
+    web = pd.DataFrame({
+        "channel": "web channel",
+        "id": web.ws_web_page_sk.astype("Int64"),
+        "sales": web.ws_ext_sales_price,
+        "returns_": web.wr_return_amt,
+        "profit": web.ws_ext_discount_amt - web.wr_net_loss,
+    })
+    detail = pd.concat([store, catalog, web], ignore_index=True)
+    by_ch = (
+        detail.groupby("channel", dropna=False)
+        [["sales", "returns_", "profit"]].sum().reset_index()
+    )
+    by_ch["id"] = pd.NA
+    grand = pd.DataFrame([{
+        "channel": pd.NA, "id": pd.NA,
+        "sales": detail.sales.sum(),
+        "returns_": detail.returns_.sum(),
+        "profit": detail.profit.sum(),
+    }])
+    rolled = pd.concat(
+        [detail, by_ch[["channel", "id", "sales", "returns_",
+                        "profit"]], grand],
+        ignore_index=True,
+    )
+    out = rolled.sort_values(
+        ["channel", "id", "sales"], na_position="first").head(100)
+    return out[["channel", "id", "sales", "returns_", "profit"]
+               ].reset_index(drop=True)
+
+
+def oracle_q78(t):
+    dd = t["date_dim"]
+    d = dd[dd.d_year == 1999][["d_date_sk"]]
+
+    def channel(table, date_col, sk, rk, rets, cust, qty, amt):
+        j = _merge(t[table], d, date_col, "d_date_sk")
+        r = t[rets][rk].drop_duplicates()
+        m = j.merge(r, left_on=sk, right_on=rk, how="left",
+                    indicator=True)
+        m = m[m._merge == "left_only"]
+        return (
+            m.groupby([sk[1], cust], dropna=False)
+            .agg(qty=(qty, "sum"), amt=(amt, "sum")).reset_index()
+        )
+
+    ss = channel("store_sales", "ss_sold_date_sk",
+                 ["ss_ticket_number", "ss_item_sk"],
+                 ["sr_ticket_number", "sr_item_sk"], "store_returns",
+                 "ss_customer_sk", "ss_quantity", "ss_ext_sales_price")
+    ws = channel("web_sales", "ws_sold_date_sk",
+                 ["ws_order_number", "ws_item_sk"],
+                 ["wr_order_number", "wr_item_sk"], "web_returns",
+                 "ws_bill_customer_sk", "ws_quantity",
+                 "ws_ext_sales_price")
+    # SQL join keys never match NULL; pandas merge would pair NaNs
+    ss = ss.dropna(subset=["ss_customer_sk"])
+    ws = ws.dropna(subset=["ws_bill_customer_sk"])
+    m = ws.merge(
+        ss,
+        left_on=["ws_item_sk", "ws_bill_customer_sk"],
+        right_on=["ss_item_sk", "ss_customer_sk"],
+        suffixes=("_w", "_s"),
+    )
+    out = pd.DataFrame({
+        "item": m.ss_item_sk.astype(np.int64),
+        "cust": m.ss_customer_sk.astype(np.int64),
+        "ss_qty": m.qty_s,
+        "ratio": m.qty_w / m.qty_s,
+        "ss_amt": m.amt_s, "ws_amt": m.amt_w,
+    })
+    out = out.sort_values(["ratio", "item", "cust"]).head(100)
+    return out.reset_index(drop=True)
+
+
+ORACLES.update({
+    "q66": oracle_q66, "q67": oracle_q67, "q70": oracle_q70,
+    "q72": oracle_q72, "q75": oracle_q75, "q76": oracle_q76,
+    "q77": oracle_q77, "q78": oracle_q78,
+})

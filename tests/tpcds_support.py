@@ -4561,3 +4561,628 @@ QUERIES.update({
     "q71": q71, "q82": q82, "q86": q86, "q87": q87, "q91": q91,
     "q99": q99,
 })
+
+
+# ---------------------------------------------------------------------------
+# q66/q67/q70/q72/q75/q76/q77/q78 block (pivots, rollups, channel P&L)
+# ---------------------------------------------------------------------------
+
+_GEN_V6 = gen_tables
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V6(seed)
+    rng = np.random.default_rng(seed + 29)
+    st = t["store"]
+    st["s_county"] = np.array(
+        ["Rich County", "Ziebach County", "Walker County"],
+        dtype=object)[np.arange(len(st)) % 3]
+    cs = t["catalog_sales"]
+    n_cs = len(cs)
+    cs["cs_bill_hdemo_sk"] = rng.integers(0, N_HDEMO, n_cs).astype(
+        np.int32)
+    cs["cs_bill_cdemo_sk"] = rng.integers(0, N_CDEMO, n_cs).astype(
+        np.int32)
+    wr = t["web_returns"]
+    wr["wr_web_page_sk"] = rng.integers(0, 20, len(wr)).astype(
+        np.int32)
+    return t
+
+
+def q66(s, flavor):
+    """TPC-DS q66: warehouse monthly shipped value for two carriers,
+    web+catalog unioned, pivoted into 12 month columns."""
+    def channel(prefix, table):
+        j = _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 1999),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(
+            flavor,
+            FilterExec(
+                s["ship_mode"](),
+                InList(Col("sm_type"),
+                       (Literal("EXPRESS", DataType.utf8()),
+                        Literal("REGULAR", DataType.utf8()))),
+            ),
+            j, ["sm_ship_mode_sk"], [f"{prefix}_ship_mode_sk"],
+        )
+        j = _join(flavor, s["warehouse"](), j,
+                  ["w_warehouse_sk"], [f"{prefix}_warehouse_sk"])
+        amt = Col(f"{prefix}_ext_sales_price")
+        return _agg(
+            j,
+            keys=[(Col("w_warehouse_name"), "wname")],
+            aggs=[
+                (AggExpr(AggFn.SUM, If(
+                    Col("d_moy") == m, amt,
+                    Literal(None, DataType.float64()))), f"m{m}_sales")
+                for m in range(1, 13)
+            ],
+        )
+
+    both = _union([channel("ws", "web_sales"),
+                   channel("cs", "catalog_sales")])
+    total = _agg(
+        both,
+        keys=[(Col("wname"), "w_warehouse_name")],
+        aggs=[(AggExpr(AggFn.SUM, Col(f"m{m}_sales")), f"m{m}_sales")
+              for m in range(1, 13)],
+    )
+    return _sorted_limit(
+        total, [SortKey(Col("w_warehouse_name"), True, True)], 100,
+    )
+
+
+def q67(s, flavor):
+    """TPC-DS q67 (rollup as grouping-set union): store sales over the
+    full (category,class,brand,product,year,qoy,moy,store) hierarchy,
+    rank<=100 within category."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_month_seq") >= 1188) & (Col("d_month_seq") <= 1199),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    j = _join(
+        flavor,
+        ProjectExec(s["store"](), [(Col("s_store_sk"), "st_sk"),
+                                   (Col("s_store_id"), "s_store_id")]),
+        j, ["st_sk"], ["ss_store_sk"],
+    )
+    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
+                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    sales_expr = Col("ss_sales_price") * Col("ss_quantity").cast(
+        DataType.float64())
+    base = _agg(
+        j,
+        keys=[(Col(c), c) for c in base_cols],
+        aggs=[(AggExpr(AggFn.SUM, sales_expr), "sumsales")],
+    )
+
+    def level(k):
+        """Rollup level keeping the first k hierarchy columns."""
+        keep = base_cols[:k]
+        exprs = [(Col(c), c) for c in keep]
+        for c in base_cols[k:]:
+            dt = (DataType.utf8() if c.startswith(("i_", "s_"))
+                  else DataType.int32())
+            exprs.append((Literal(None, dt), c))
+        exprs.append((Col("sumsales"), "sumsales"))
+        if k == len(base_cols):
+            return ProjectExec(base, exprs)
+        agg = _agg(
+            base,
+            keys=[(Col(c), c) for c in keep],
+            aggs=[(AggExpr(AggFn.SUM, Col("sumsales")), "sumsales")],
+        )
+        return ProjectExec(agg, exprs)
+
+    rolled = _union([level(k) for k in range(len(base_cols) + 1)])
+    ranked = WindowExec(
+        rolled,
+        partition_by=[Col("i_category")],
+        order_by=[SortKey(Col("sumsales"), False, False)],
+        functions=[WindowFn("rank", None, "rk")],
+    )
+    top = FilterExec(ranked, Col("rk") <= 100)
+    return _sorted_limit(
+        top,
+        [SortKey(Col("i_category"), True, True),
+         SortKey(Col("i_class"), True, True),
+         SortKey(Col("i_brand"), True, True),
+         SortKey(Col("i_product_name"), True, True),
+         SortKey(Col("d_year"), True, True),
+         SortKey(Col("d_qoy"), True, True),
+         SortKey(Col("d_moy"), True, True),
+         SortKey(Col("s_store_id"), True, True),
+         SortKey(Col("sumsales"), True, True),
+         SortKey(Col("rk"), True, True)],
+        100,
+    )
+
+
+def q70(s, flavor):
+    """TPC-DS q70: store profit rollup over top-5-profit states
+    (ranked state subquery feeds a semi join)."""
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+
+    def profit_base():
+        j = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_month_seq") >= 1188)
+                & (Col("d_month_seq") <= 1199),
+            ),
+            s["store_sales"](),
+            ["d_date_sk"], ["ss_sold_date_sk"],
+        )
+        return _join(
+            flavor,
+            ProjectExec(s["store"](),
+                        [(Col("s_store_sk"), "st_sk"),
+                         (Col("s_state"), "s_state"),
+                         (Col("s_county"), "s_county")]),
+            j, ["st_sk"], ["ss_store_sk"],
+        )
+
+    by_state = _agg(
+        profit_base(),
+        keys=[(Col("s_state"), "r_state")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_net_profit")), "sp")],
+    )
+    ranked_states = ProjectExec(
+        FilterExec(
+            WindowExec(
+                by_state,
+                partition_by=[],
+                order_by=[SortKey(Col("sp"), False, False)],
+                functions=[WindowFn("rank", None, "rnk")],
+            ),
+            Col("rnk") <= 5,
+        ),
+        [(Col("r_state"), "r_state")],
+    )
+    qualified = _semi(
+        flavor, profit_base(), ranked_states,
+        ["s_state"], ["r_state"],
+    )
+    base = _agg(
+        qualified,
+        keys=[(Col("s_state"), "s_state"), (Col("s_county"), "s_county")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_net_profit")),
+               "total_sum")],
+    )
+    lvl0 = ProjectExec(
+        base,
+        [(Col("s_state"), "s_state"), (Col("s_county"), "s_county"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(0, DataType.int64()), "lochierarchy")],
+    )
+    lvl1 = ProjectExec(
+        _agg(base, keys=[(Col("s_state"), "s_state")],
+             aggs=[(AggExpr(AggFn.SUM, Col("total_sum")),
+                    "total_sum")]),
+        [(Col("s_state"), "s_state"),
+         (Literal(None, DataType.utf8()), "s_county"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(1, DataType.int64()), "lochierarchy")],
+    )
+    lvl2 = ProjectExec(
+        _agg(base, keys=[],
+             aggs=[(AggExpr(AggFn.SUM, Col("total_sum")),
+                    "total_sum")]),
+        [(Literal(None, DataType.utf8()), "s_state"),
+         (Literal(None, DataType.utf8()), "s_county"),
+         (Col("total_sum"), "total_sum"),
+         (Literal(2, DataType.int64()), "lochierarchy")],
+    )
+    rolled = _union([lvl0, lvl1, lvl2])
+    ranked = WindowExec(
+        rolled,
+        partition_by=[Col("lochierarchy"), If(
+            Col("lochierarchy") == 0, Col("s_state"),
+            Literal(None, DataType.utf8()))],
+        order_by=[SortKey(Col("total_sum"), False, False)],
+        functions=[WindowFn("rank", None, "rank_within_parent")],
+    )
+    return _sorted_limit(
+        ranked,
+        [SortKey(Col("lochierarchy"), False, False),
+         SortKey(Col("s_state"), True, True),
+         SortKey(Col("s_county"), True, True),
+         SortKey(Col("rank_within_parent"), True, True)],
+        100,
+    )
+
+
+def q72(s, flavor):
+    """TPC-DS q72: catalog orders whose warehouse stock in the sale
+    week cannot cover the ordered quantity, by buy-potential/marital
+    segment, only slow shipments (>5 day lag)."""
+    j = _join(
+        flavor,
+        ProjectExec(
+            FilterExec(s["date_dim"](), Col("d_year") == 1999),
+            [(Col("d_date_sk"), "sold_sk"),
+             (Col("d_week_seq"), "sold_week")],
+        ),
+        s["catalog_sales"](),
+        ["sold_sk"], ["cs_sold_date_sk"],
+    )
+    j = FilterExec(
+        j,
+        (Col("cs_ship_date_sk").cast(DataType.int64())
+         - Col("cs_sold_date_sk").cast(DataType.int64())) > 5,
+    )
+    inv = _join(
+        flavor, s["warehouse"](), s["inventory"](),
+        ["w_warehouse_sk"], ["inv_warehouse_sk"],
+    )
+    inv = _join(
+        flavor,
+        ProjectExec(s["date_dim"](),
+                    [(Col("d_date_sk"), "inv_d_sk"),
+                     (Col("d_week_seq"), "inv_week")]),
+        inv, ["inv_d_sk"], ["inv_date_sk"],
+    )
+    j = _join(
+        flavor, j, inv, ["cs_item_sk"], ["inv_item_sk"],
+    )
+    j = FilterExec(
+        j,
+        (Col("inv_quantity_on_hand") < Col("cs_quantity"))
+        & (Col("inv_week") == Col("sold_week")),
+    )
+    hd = FilterExec(
+        s["household_demographics"](),
+        Col("hd_buy_potential") == ">10000",
+    )
+    j = _join(flavor, hd, j, ["hd_demo_sk"], ["cs_bill_hdemo_sk"])
+    cd = FilterExec(
+        s["customer_demographics"](), Col("cd_marital_status") == "M",
+    )
+    j = _join(flavor, cd, j, ["cd_demo_sk"], ["cs_bill_cdemo_sk"])
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["cs_item_sk"])
+    agg = _agg(
+        j,
+        keys=[(Col("i_item_desc"), "i_item_desc"),
+              (Col("w_warehouse_name"), "w_warehouse_name"),
+              (Col("sold_week"), "d_week_seq")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "no_promo")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("no_promo"), False, False),
+         SortKey(Col("i_item_desc"), True, True),
+         SortKey(Col("w_warehouse_name"), True, True),
+         SortKey(Col("d_week_seq"), True, True)],
+        100,
+    )
+
+
+def q75(s, flavor):
+    """TPC-DS q75: brand-level net sales (sales minus returned
+    quantity/amount) per channel, year-over-year decline."""
+    def channel(prefix, table, rets, s_keys, r_keys, qty, amt, r_qty,
+                r_amt):
+        sales = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") >= 1998) & (Col("d_year") <= 1999),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        sales = _join(
+            flavor,
+            FilterExec(s["item"](), Col("i_category") == "Books"),
+            sales, ["i_item_sk"], [f"{prefix}_item_sk"],
+        )
+        j = _join(flavor, sales, s[rets](), s_keys, r_keys,
+                  JoinType.LEFT)
+        return ProjectExec(
+            j,
+            [(Col("d_year"), "d_year"),
+             (Col("i_brand_id"), "i_brand_id"),
+             (Col(qty) - Coalesce(
+                 (Col(r_qty), Literal(0, DataType.int32()))),
+              "sales_cnt"),
+             (Col(amt) - Coalesce(
+                 (Col(r_amt), Literal(0.0, DataType.float64()))),
+              "sales_amt")],
+        )
+
+    allch = _union([
+        channel("cs", "catalog_sales", "catalog_returns",
+                ["cs_order_number", "cs_item_sk"],
+                ["cr_order_number", "cr_item_sk"],
+                "cs_quantity", "cs_ext_sales_price",
+                "cr_return_quantity", "cr_return_amount"),
+        channel("ss", "store_sales", "store_returns",
+                ["ss_ticket_number", "ss_item_sk"],
+                ["sr_ticket_number", "sr_item_sk"],
+                "ss_quantity", "ss_ext_sales_price",
+                "sr_return_quantity", "sr_return_amt"),
+        channel("ws", "web_sales", "web_returns",
+                ["ws_order_number", "ws_item_sk"],
+                ["wr_order_number", "wr_item_sk"],
+                "ws_quantity", "ws_ext_sales_price",
+                "wr_return_quantity", "wr_return_amt"),
+    ])
+    by_year = _agg(
+        allch,
+        keys=[(Col("d_year"), "d_year"),
+              (Col("i_brand_id"), "i_brand_id")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sales_cnt")), "sales_cnt"),
+              (AggExpr(AggFn.SUM, Col("sales_amt")), "sales_amt")],
+    )
+    prev = RenameColumnsExec(
+        FilterExec(by_year, Col("d_year") == 1998),
+        ["py", "pb", "prev_cnt", "prev_amt"],
+    )
+    curr = RenameColumnsExec(
+        FilterExec(by_year, Col("d_year") == 1999),
+        ["cy", "cb", "curr_cnt", "curr_amt"],
+    )
+    m = _join(flavor, prev, curr, ["pb"], ["cb"])
+    decline = FilterExec(
+        m,
+        Col("curr_cnt").cast(DataType.float64())
+        / Col("prev_cnt").cast(DataType.float64()) < 0.9,
+    )
+    out = ProjectExec(
+        decline,
+        [(Col("py"), "prev_year"), (Col("cy"), "year"),
+         (Col("pb"), "i_brand_id"),
+         (Col("prev_cnt"), "prev_yr_cnt"),
+         (Col("curr_cnt"), "curr_yr_cnt"),
+         (Col("curr_cnt") - Col("prev_cnt"), "sales_cnt_diff"),
+         (Col("curr_amt") - Col("prev_amt"), "sales_amt_diff")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("sales_cnt_diff"), True, True),
+         SortKey(Col("i_brand_id"), True, True)],
+        100,
+    )
+
+
+def q76(s, flavor):
+    """TPC-DS q76: volume and value of sales rows with NULL keys,
+    per channel/year/category."""
+    def channel(label, prefix, table, null_col, amt):
+        j = _join(
+            flavor,
+            s["date_dim"](),
+            FilterExec(s[table](), ~IsNotNull(Col(null_col))),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        j = _join(flavor, s["item"](), j,
+                  ["i_item_sk"], [f"{prefix}_item_sk"])
+        return ProjectExec(
+            j,
+            [(Literal(label, DataType.utf8()), "channel"),
+             (Literal(null_col, DataType.utf8()), "col_name"),
+             (Col("d_year"), "d_year"),
+             (Col("i_category"), "i_category"),
+             (Col(amt), "ext_sales_price")],
+        )
+
+    allch = _union([
+        channel("store", "ss", "store_sales", "ss_customer_sk",
+                "ss_ext_sales_price"),
+        channel("web", "ws", "web_sales", "ws_bill_customer_sk",
+                "ws_ext_sales_price"),
+        channel("catalog", "cs", "catalog_sales", "cs_bill_addr_sk",
+                "cs_ext_sales_price"),
+    ])
+    agg = _agg(
+        allch,
+        keys=[(Col("channel"), "channel"),
+              (Col("col_name"), "col_name"),
+              (Col("d_year"), "d_year"),
+              (Col("i_category"), "i_category")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "sales_cnt"),
+              (AggExpr(AggFn.SUM, Col("ext_sales_price")),
+               "sales_amt")],
+    )
+    return _sorted_limit(
+        agg,
+        [SortKey(Col("channel"), True, True),
+         SortKey(Col("col_name"), True, True),
+         SortKey(Col("d_year"), True, True),
+         SortKey(Col("i_category"), True, True)],
+        100,
+    )
+
+
+def q77(s, flavor):
+    """TPC-DS q77: per-channel profit & loss (sales vs returns) with
+    channel totals (rollup as union)."""
+    dd = lambda: FilterExec(  # noqa: E731
+        s["date_dim"](),
+        (Col("d_year") == 1999) & (Col("d_moy") <= 2),
+    )
+
+    def side(table, date_col, key_col, out_key, aggs):
+        j = _join(flavor, dd(), s[table](), ["d_date_sk"], [date_col])
+        return _agg(
+            j, keys=[(Col(key_col), out_key)], aggs=aggs,
+        )
+
+    ss = side("store_sales", "ss_sold_date_sk", "ss_store_sk", "s_sk",
+              [(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")), "sales"),
+               (AggExpr(AggFn.SUM, Col("ss_net_profit")), "profit")])
+    sr = side("store_returns", "sr_returned_date_sk", "sr_store_sk",
+              "r_sk",
+              [(AggExpr(AggFn.SUM, Col("sr_return_amt")), "returns_"),
+               (AggExpr(AggFn.SUM, Col("sr_net_loss")), "loss")])
+    store = ProjectExec(
+        _join(flavor, ss, sr, ["s_sk"], ["r_sk"], JoinType.LEFT),
+        [(Literal("store channel", DataType.utf8()), "channel"),
+         (Col("s_sk").cast(DataType.int64()), "id"),
+         (Col("sales"), "sales"),
+         (Coalesce((Col("returns_"),
+                    Literal(0.0, DataType.float64()))), "returns_"),
+         (Col("profit") - Coalesce(
+             (Col("loss"), Literal(0.0, DataType.float64()))),
+          "profit")],
+    )
+    cs_tot = ProjectExec(
+        _agg(_join(flavor, dd(), s["catalog_sales"](),
+                   ["d_date_sk"], ["cs_sold_date_sk"]),
+             keys=[],
+             aggs=[(AggExpr(AggFn.SUM, Col("cs_ext_sales_price")),
+                    "sales"),
+                   (AggExpr(AggFn.SUM, Col("cs_ext_discount_amt")),
+                    "profit")]),
+        [(Literal(1, DataType.int32()), "k"), (Col("sales"), "sales"),
+         (Col("profit"), "profit")],
+    )
+    cr_tot = ProjectExec(
+        _agg(_join(flavor, dd(), s["catalog_returns"](),
+                   ["d_date_sk"], ["cr_returned_date_sk"]),
+             keys=[],
+             aggs=[(AggExpr(AggFn.SUM, Col("cr_return_amount")),
+                    "returns_"),
+                   (AggExpr(AggFn.SUM, Col("cr_net_loss")), "loss")]),
+        [(Literal(1, DataType.int32()), "rk"),
+         (Col("returns_"), "returns_"), (Col("loss"), "loss")],
+    )
+    catalog = ProjectExec(
+        _join(flavor, cs_tot, cr_tot, ["k"], ["rk"]),
+        [(Literal("catalog channel", DataType.utf8()), "channel"),
+         (Literal(None, DataType.int64()), "id"),
+         (Col("sales"), "sales"), (Col("returns_"), "returns_"),
+         (Col("profit") - Col("loss"), "profit")],
+    )
+    ws_side = side("web_sales", "ws_sold_date_sk", "ws_web_page_sk",
+                   "p_sk",
+                   [(AggExpr(AggFn.SUM, Col("ws_ext_sales_price")),
+                     "sales"),
+                    (AggExpr(AggFn.SUM, Col("ws_ext_discount_amt")),
+                     "profit")])
+    wr_side = side("web_returns", "wr_returned_date_sk",
+                   "wr_web_page_sk", "rp_sk",
+                   [(AggExpr(AggFn.SUM, Col("wr_return_amt")),
+                     "returns_"),
+                    (AggExpr(AggFn.SUM, Col("wr_net_loss")), "loss")])
+    web = ProjectExec(
+        _join(flavor, ws_side, wr_side, ["p_sk"], ["rp_sk"],
+              JoinType.LEFT),
+        [(Literal("web channel", DataType.utf8()), "channel"),
+         (Col("p_sk").cast(DataType.int64()), "id"),
+         (Col("sales"), "sales"),
+         (Coalesce((Col("returns_"),
+                    Literal(0.0, DataType.float64()))), "returns_"),
+         (Col("profit") - Coalesce(
+             (Col("loss"), Literal(0.0, DataType.float64()))),
+          "profit")],
+    )
+    detail = _union([store, catalog, web])
+    by_channel = ProjectExec(
+        _agg(detail,
+             keys=[(Col("channel"), "channel")],
+             aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+                   (AggExpr(AggFn.SUM, Col("returns_")), "returns_"),
+                   (AggExpr(AggFn.SUM, Col("profit")), "profit")]),
+        [(Col("channel"), "channel"),
+         (Literal(None, DataType.int64()), "id"),
+         (Col("sales"), "sales"), (Col("returns_"), "returns_"),
+         (Col("profit"), "profit")],
+    )
+    grand = ProjectExec(
+        _agg(detail, keys=[],
+             aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+                   (AggExpr(AggFn.SUM, Col("returns_")), "returns_"),
+                   (AggExpr(AggFn.SUM, Col("profit")), "profit")]),
+        [(Literal(None, DataType.utf8()), "channel"),
+         (Literal(None, DataType.int64()), "id"),
+         (Col("sales"), "sales"), (Col("returns_"), "returns_"),
+         (Col("profit"), "profit")],
+    )
+    rolled = _union([detail, by_channel, grand])
+    return _sorted_limit(
+        rolled,
+        [SortKey(Col("channel"), True, True),
+         SortKey(Col("id"), True, True),
+         SortKey(Col("sales"), True, True)],
+        100,
+    )
+
+
+def q78(s, flavor):
+    """TPC-DS q78: customer-item yearly sales with NO return, store vs
+    web ratio (anti-joined returns, FULL-ish comparison via inner join
+    on both channels present)."""
+    def channel(prefix, table, rets, s_keys, r_keys, cust, qty, amt,
+                ren):
+        sales = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 1999),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        no_ret = _join(flavor, sales, s[rets](), s_keys, r_keys,
+                       JoinType.LEFT_ANTI)
+        return RenameColumnsExec(
+            _agg(
+                no_ret,
+                keys=[(Col(f"{prefix}_item_sk"), "item"),
+                      (Col(cust), "cust")],
+                aggs=[(AggExpr(AggFn.SUM, Col(qty)), "qty"),
+                      (AggExpr(AggFn.SUM, Col(amt)), "amt")],
+            ),
+            ren,
+        )
+
+    ss = channel("ss", "store_sales", "store_returns",
+                 ["ss_ticket_number", "ss_item_sk"],
+                 ["sr_ticket_number", "sr_item_sk"],
+                 "ss_customer_sk", "ss_quantity",
+                 "ss_ext_sales_price",
+                 ["ss_item", "ss_cust", "ss_qty", "ss_amt"])
+    ws = channel("ws", "web_sales", "web_returns",
+                 ["ws_order_number", "ws_item_sk"],
+                 ["wr_order_number", "wr_item_sk"],
+                 "ws_bill_customer_sk", "ws_quantity",
+                 "ws_ext_sales_price",
+                 ["ws_item", "ws_cust", "ws_qty", "ws_amt"])
+    m = _join(flavor, ws, ss, ["ws_item", "ws_cust"],
+              ["ss_item", "ss_cust"])
+    out = ProjectExec(
+        m,
+        [(Col("ss_item").cast(DataType.int64()), "item"),
+         (Col("ss_cust").cast(DataType.int64()), "cust"),
+         (Col("ss_qty"), "ss_qty"),
+         (Col("ws_qty").cast(DataType.float64())
+          / Col("ss_qty").cast(DataType.float64()), "ratio"),
+         (Col("ss_amt"), "ss_amt"), (Col("ws_amt"), "ws_amt")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("ratio"), True, True),
+         SortKey(Col("item"), True, True),
+         SortKey(Col("cust"), True, True)],
+        100,
+    )
+
+
+QUERIES.update({
+    "q66": q66, "q67": q67, "q70": q70, "q72": q72, "q75": q75,
+    "q76": q76, "q77": q77, "q78": q78,
+})
