@@ -1,0 +1,18 @@
+"""Generated bindings for the REFERENCE engine's wire format.
+
+`refplan_pb2.py` is protoc output generated from the reference's
+plan-serde contract (/root/reference/native-engine/plan-serde/proto/
+plan.proto, the `plan.protobuf` package: PhysicalPlanNode :26-43,
+TaskDefinition :508-513). It is regenerated — never hand-edited — with:
+
+    cp <reference>/native-engine/plan-serde/proto/plan.proto /tmp/refplan.proto
+    protoc --python_out=blaze_tpu/plan/refpb -I /tmp refplan.proto
+
+The engine's own schema (`blaze_tpu/plan/plan.proto`) stays the native
+format; this package exists so a deployment already speaking the
+reference's protocol (the Spark extension tier emitting TaskDefinition
+bytes over JNI, NativeRDD.scala:41-44) can drive this engine without
+changes — see `blaze_tpu.plan.refcompat` for the decoder.
+"""
+
+from blaze_tpu.plan.refpb import refplan_pb2  # noqa: F401

@@ -36,17 +36,17 @@ class TaskExecutionError(RuntimeError):
         self.__cause__ = cause
 
 
-def decode_task(task_bytes: bytes, ctx: ExecContext):
-    """Decode a serialized TaskDefinition into a runnable (op, partition)
-    pair, fusing the tree exactly like driver-built plans (decoded tasks
-    are the production entry, so they must hit the same one-dispatch
-    pipeline programs; reference: the decoded plan IS the executed plan,
-    exec.rs:137-165) and installing its resources into the context."""
-    from blaze_tpu.plan.serde import task_from_proto
+def prepare_decoded_task(decoded, ctx: ExecContext):
+    """Shared decode tail for every wire format (engine-native and
+    reference-compat): fuse the tree exactly like driver-built plans
+    (decoded tasks are the production entry, so they must hit the same
+    one-dispatch pipeline programs; reference: the decoded plan IS the
+    executed plan, exec.rs:137-165), attach scan hints, and install the
+    task's resources into the context."""
     from blaze_tpu.ops.fused import fuse_pipelines
     from blaze_tpu.planner.colprune import install as install_scan_hints
 
-    op, partition, task_id, resources = task_from_proto(task_bytes)
+    op, partition, task_id, resources = decoded
     op = fuse_pipelines(op)
     # freshly-decoded tree: scans are private to this task, so filter
     # pushdown (not just column pruning) is safe to attach
@@ -56,6 +56,14 @@ def decode_task(task_bytes: bytes, ctx: ExecContext):
     for rid, provider in resources.items():
         ctx.resources.setdefault(rid, provider)
     return op, partition
+
+
+def decode_task(task_bytes: bytes, ctx: ExecContext):
+    """Decode engine-native TaskDefinition bytes into a runnable
+    (op, partition) pair."""
+    from blaze_tpu.plan.serde import task_from_proto
+
+    return prepare_decoded_task(task_from_proto(task_bytes), ctx)
 
 
 def execute_task(task_bytes: bytes,

@@ -1,0 +1,371 @@
+"""Reference wire-format compatibility: TaskDefinition bytes built with
+the REFERENCE's own proto schema (plan.protobuf, reference
+plan.proto:26-43/:508-513) decode and execute on this engine, matching
+the engine-native-proto result — the SURVEY §7 "Spark tier stays
+untouched" contract, proven the way the reference's own decoder tests
+would (from_proto.rs:162-560 arms)."""
+
+import os
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    ProjectExec,
+)
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.refcompat import (
+    execute_reference_task,
+    plan_from_ref,
+    task_from_reference_proto,
+)
+from blaze_tpu.plan.refpb import refplan_pb2 as rp
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.executor import execute_task
+from blaze_tpu.types import DataType
+
+
+# ---------------------------------------------------------------------------
+# reference-format message builders (what the Spark tier's proto emission
+# produces, NativeParquetScanExec.scala:61-107 / NativeProjectExec.scala:61-77)
+# ---------------------------------------------------------------------------
+
+def _col(name):
+    e = rp.PhysicalExprNode()
+    e.column.name = name
+    return e
+
+
+def _lit_f32(v):
+    e = rp.PhysicalExprNode()
+    e.literal.float32_value = v
+    return e
+
+
+def _lit_i32(v):
+    e = rp.PhysicalExprNode()
+    e.literal.int32_value = v
+    return e
+
+
+def _bin(op, l, r):
+    e = rp.PhysicalExprNode()
+    e.binary_expr.op = op
+    e.binary_expr.l.CopyFrom(l)
+    e.binary_expr.r.CopyFrom(r)
+    return e
+
+
+def _cast_f32(inner):
+    e = rp.PhysicalExprNode()
+    e.cast.expr.CopyFrom(inner)
+    e.cast.arrow_type.FLOAT32.SetInParent()
+    return e
+
+
+def _agg(fn, arg):
+    e = rp.PhysicalExprNode()
+    e.aggregate_expr.aggr_function = fn
+    e.aggregate_expr.expr.CopyFrom(arg)
+    return e
+
+
+def _ref_schema(fields):
+    s = rp.Schema()
+    for name, ty in fields:
+        f = s.columns.add()
+        f.name = name
+        f.nullable = True
+        getattr(f.arrow_type, ty).SetInParent()
+    return s
+
+
+def _scan_node(path, fields, projection=None):
+    node = rp.PhysicalPlanNode()
+    conf = node.parquet_scan.base_conf
+    g = conf.file_groups.add()
+    f = g.files.add()
+    f.path = path
+    f.size = os.path.getsize(path)
+    conf.schema.CopyFrom(_ref_schema(fields))
+    if projection is not None:
+        conf.projection.extend(projection)
+    return node
+
+
+@pytest.fixture(scope="module")
+def store_sales(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    n = 50_000
+    item = rng.integers(0, 40, n).astype(np.int32)
+    qty = rng.integers(1, 10, n).astype(np.int32)
+    price = (rng.random(n) * 100).astype(np.float32)
+    path = str(tmp_path_factory.mktemp("ref") / "store_sales.parquet")
+    pq.write_table(
+        pa.table({"item": item, "qty": qty, "price": price}),
+        path,
+        row_group_size=8192,
+    )
+    return path, item, qty, price
+
+
+FIELDS = [("item", "INT32"), ("qty", "INT32"), ("price", "FLOAT32")]
+
+
+def _q6_reference_task(path):
+    """FINAL agg <- PARTIAL agg <- Projection <- Filter <- ParquetScan,
+    the reference's canonical single-stage aggregation stack (DataFusion
+    partial/final pair, from_proto.rs:452-545)."""
+    scan = _scan_node(path, FIELDS)
+
+    filt = rp.PhysicalPlanNode()
+    filt.filter.input.CopyFrom(scan)
+    filt.filter.expr.CopyFrom(
+        _bin(
+            "And",
+            _bin("Gt", _col("price"), _lit_f32(50.0)),
+            _bin("Lt", _col("qty"), _lit_i32(8)),
+        )
+    )
+
+    proj = rp.PhysicalPlanNode()
+    proj.projection.input.CopyFrom(filt)
+    proj.projection.expr.append(
+        _bin("Multiply", _col("price"), _cast_f32(_col("qty")))
+    )
+    proj.projection.expr_name.append("rev")
+    proj.projection.expr.append(_col("item"))
+    proj.projection.expr_name.append("item")
+
+    partial = rp.PhysicalPlanNode()
+    hp = partial.hash_aggregate
+    hp.mode = rp.PARTIAL
+    hp.input.CopyFrom(proj)
+    hp.group_expr.append(_col("item"))
+    hp.group_expr_name.append("item")
+    hp.aggr_expr.append(_agg(rp.SUM, _col("rev")))
+    hp.aggr_expr_name.append("total")
+    hp.aggr_expr.append(_agg(rp.COUNT, _col("rev")))
+    hp.aggr_expr_name.append("cnt")
+
+    final = rp.PhysicalPlanNode()
+    hf = final.hash_aggregate
+    hf.mode = rp.FINAL
+    hf.input.CopyFrom(partial)
+    hf.group_expr.append(_col("item"))
+    hf.group_expr_name.append("item")
+    hf.aggr_expr.append(_agg(rp.SUM, _col("rev")))
+    hf.aggr_expr_name.append("total")
+    hf.aggr_expr.append(_agg(rp.COUNT, _col("cnt")))
+    hf.aggr_expr_name.append("cnt")
+
+    task = rp.TaskDefinition()
+    task.task_id.job_id = "ref-q6"
+    task.task_id.stage_id = 0
+    task.task_id.partition_id = 0
+    task.plan.CopyFrom(final)
+    return task.SerializeToString()
+
+
+def _q6_engine_task(path):
+    scan = ParquetScanExec([[FileRange(path)]])
+    plan = HashAggregateExec(
+        ProjectExec(
+            FilterExec(
+                scan, (Col("price") > 50.0) & (Col("qty") < 8)
+            ),
+            [
+                (Col("price") * Col("qty").cast(DataType.float32()),
+                 "rev"),
+                (Col("item"), "item"),
+            ],
+        ),
+        keys=[(Col("item"), "item")],
+        aggs=[
+            (AggExpr(AggFn.SUM, Col("rev")), "total"),
+            (AggExpr(AggFn.COUNT, Col("rev")), "cnt"),
+        ],
+        mode=AggMode.COMPLETE,
+    )
+    return task_to_proto(plan, 0)
+
+
+def _rows(batches):
+    tbl = pa.Table.from_batches(list(batches))
+    d = {}
+    for item, total, cnt in zip(
+        tbl.column("item").to_pylist(),
+        tbl.column("total").to_pylist(),
+        tbl.column("cnt").to_pylist(),
+    ):
+        d[item] = (total, cnt)
+    return d
+
+
+def test_q6_reference_task_matches_engine_native(store_sales):
+    path, item, qty, price = store_sales
+    got = _rows(execute_reference_task(_q6_reference_task(path)))
+    exp = _rows(execute_task(_q6_engine_task(path)))
+    assert set(got) == set(exp)
+    for k in exp:
+        assert got[k][1] == exp[k][1], k
+        np.testing.assert_allclose(got[k][0], exp[k][0], rtol=1e-6)
+    # and both match the direct computation
+    live = (price > 50.0) & (qty < 8)
+    assert sum(c for _, c in got.values()) == int(live.sum())
+
+
+def test_shuffle_writer_reference_task(store_sales, tmp_path):
+    path, item, qty, price = store_sales
+    data_file = str(tmp_path / "shuffle.data")
+    index_file = str(tmp_path / "shuffle.index")
+
+    node = rp.PhysicalPlanNode()
+    sw = node.shuffle_writer
+    sw.input.CopyFrom(_scan_node(path, FIELDS))
+    sw.output_partitioning.hash_expr.append(_col("item"))
+    sw.output_partitioning.partition_count = 4
+    sw.output_data_file = data_file
+    sw.output_index_file = index_file
+
+    task = rp.TaskDefinition()
+    task.task_id.job_id = "ref-shuffle"
+    task.task_id.partition_id = 0
+    task.plan.CopyFrom(node)
+    task.output_partitioning.CopyFrom(sw.output_partitioning)
+
+    list(execute_reference_task(task.SerializeToString()))
+
+    assert os.path.exists(data_file) and os.path.exists(index_file)
+    # the index is the reference's i64-LE offsets format
+    # (shuffle_writer_exec.rs:437-506); partitions concatenated in .data
+    raw = open(index_file, "rb").read()
+    offsets = struct.unpack(f"<{len(raw) // 8}q", raw)
+    assert offsets[0] == 0
+    assert offsets[-1] == os.path.getsize(data_file)
+    assert len(offsets) == 4 + 1
+
+    # read every partition back through the engine's segmented-IPC
+    # reader and check the shuffle moved every row exactly once
+    from blaze_tpu.io.ipc import decode_ipc_parts
+
+    total = 0
+    items = []
+    for p in range(4):
+        lo, hi = offsets[p], offsets[p + 1]
+        with open(data_file, "rb") as fh:
+            fh.seek(lo)
+            raw_segment = fh.read(hi - lo)
+        for rb in decode_ipc_parts(raw_segment):
+            total += rb.num_rows
+            items.extend(rb.column("item").to_pylist())
+    assert total == len(item)
+    assert sorted(set(items)) == sorted(set(item.tolist()))
+
+
+def test_sort_and_join_reference_nodes_decode(store_sales):
+    """SMJ / HJ / sort / union / rename / empty-partitions arms decode to
+    the engine's operators with the right shapes."""
+    path, *_ = store_sales
+    scan = _scan_node(path, FIELDS)
+
+    sort = rp.PhysicalPlanNode()
+    sort.sort.input.CopyFrom(scan)
+    se = sort.sort.expr.add()
+    se.sort.expr.CopyFrom(_col("item"))
+    se.sort.asc = True
+    se.sort.nulls_first = True
+
+    join = rp.PhysicalPlanNode()
+    sj = join.sort_merge_join
+    sj.left.CopyFrom(sort)
+    sj.right.CopyFrom(sort)
+    on = sj.on.add()
+    on.left.name = "item"
+    on.right.name = "item"
+    sj.join_type = rp.SEMI
+    op = plan_from_ref(join)
+    from blaze_tpu.ops import SortMergeJoinExec as EngineSMJ
+
+    assert isinstance(op, EngineSMJ)
+    assert op.join_type.name == "LEFT_SEMI"
+
+    hj = rp.PhysicalPlanNode()
+    h = hj.hash_join
+    h.left.CopyFrom(scan)
+    h.right.CopyFrom(scan)
+    jon = h.on.add()
+    jon.left.name = "item"
+    jon.right.name = "item"
+    h.join_type = rp.INNER
+    h.partition_mode = rp.COLLECT_LEFT
+    from blaze_tpu.ops import HashJoinExec as EngineHJ
+
+    assert isinstance(plan_from_ref(hj), EngineHJ)
+
+    ren = rp.PhysicalPlanNode()
+    ren.rename_columns.input.CopyFrom(scan)
+    ren.rename_columns.renamed_column_names.extend(["a", "b", "c"])
+    assert list(plan_from_ref(ren).schema.names()) == ["a", "b", "c"]
+
+    un = rp.PhysicalPlanNode()
+    un.union.children.append(scan)
+    un.union.children.append(scan)
+    u = plan_from_ref(un)
+    assert u.partition_count == 2
+
+    ep = rp.PhysicalPlanNode()
+    ep.empty_partitions.schema.CopyFrom(_ref_schema(FIELDS))
+    ep.empty_partitions.num_partitions = 3
+    e = plan_from_ref(ep)
+    assert e.partition_count == 3
+
+
+def test_unsupported_nodes_raise_not_implemented(store_sales):
+    """Unknown constructs raise NotImplementedError (the fallback
+    trigger), never a silent wrong decode."""
+    path, *_ = store_sales
+    e = rp.PhysicalExprNode()
+    e.scalar_function.fun = rp.MD5  # no engine kernel
+    node = rp.PhysicalPlanNode()
+    node.filter.input.CopyFrom(_scan_node(path, FIELDS))
+    node.filter.expr.CopyFrom(e)
+    with pytest.raises(NotImplementedError):
+        plan_from_ref(node)
+
+
+def test_projection_with_indices_and_pruning(store_sales):
+    """Scan projection by field index (NativeParquetScanExec.scala:
+    105-107) + logical pruning predicate decode."""
+    path, item, qty, price = store_sales
+    node = _scan_node(path, FIELDS, projection=[2, 1])
+    ps = node.parquet_scan
+    # pruning: price >= 0 (keeps everything; exercises the arm)
+    pe = ps.pruning_predicate
+    pe.binary_expr.op = "GtEq"
+    pe.binary_expr.l.column.name = "price"
+    pe.binary_expr.r.literal.float32_value = 0.0
+
+    op = plan_from_ref(node)
+    assert op.schema.names()[:2] == ["price", "qty"] or set(
+        op.schema.names()
+    ) >= {"price", "qty"}
+
+    task = rp.TaskDefinition()
+    task.plan.CopyFrom(node)
+    out = pa.Table.from_batches(
+        list(execute_reference_task(task.SerializeToString()))
+    )
+    assert out.num_rows == len(price)
+    np.testing.assert_allclose(
+        np.sort(out.column("price").to_numpy(zero_copy_only=False)),
+        np.sort(price),
+        rtol=1e-6,
+    )
