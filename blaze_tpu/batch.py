@@ -299,12 +299,21 @@ class ColumnBatch:
                 if mask is not None:
                     codes = np.where(mask, 0, codes)
                 dict_arr = col.dictionary
-                if dict_arr is None:
-                    dict_arr = pa.array([], type=to_arrow_type(dt))
-                indices = pa.array(codes, mask=mask)
-                arr = pa.DictionaryArray.from_arrays(
-                    indices, dict_arr
-                ).cast(to_arrow_type(dt))
+                if dict_arr is None and len(codes):
+                    # pruned placeholder column (codes=0 with no
+                    # dictionary) reaching a materializing consumer
+                    # (DebugExec logging, sort spill, grace-join
+                    # externalization): render all-null rather than
+                    # indexing an empty dictionary - the values were
+                    # never read, so nulls are the honest rendering
+                    arr = pa.nulls(len(codes), type=to_arrow_type(dt))
+                else:
+                    if dict_arr is None:
+                        dict_arr = pa.array([], type=to_arrow_type(dt))
+                    indices = pa.array(codes, mask=mask)
+                    arr = pa.DictionaryArray.from_arrays(
+                        indices, dict_arr
+                    ).cast(to_arrow_type(dt))
             elif dt.id is TypeId.DECIMAL:
                 if vals.ndim == 2:
                     arr = _decimal_from_limbs(
@@ -360,6 +369,7 @@ class ColumnBatch:
 
 import collections
 import threading
+import weakref
 
 _PLACEHOLDER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _PLACEHOLDER_CACHE_CAP = 32
@@ -373,8 +383,9 @@ def _placeholder(cap: int, dtype: DataType) -> jax.Array:
     pure functions and never mutate input buffers. LRU-bounded (under a
     lock - prefetch worker threads race here) and accounted in the
     device-memory tracker so grace/spill budgeting sees the pinned HBM.
-    An evicted array still referenced by an in-flight batch is briefly
-    under-counted; the window closes when that batch is released."""
+    Evicted arrays release their tracked bytes via weakref finalizer -
+    only once the LAST in-flight batch referencing them drops - so the
+    accounting never under-counts live HBM."""
     phys = dtype.physical_dtype()
     shape = (cap, 2) if dtype.is_wide_decimal else (cap,)
     key = (shape, str(phys))
@@ -394,9 +405,19 @@ def _placeholder(cap: int, dtype: DataType) -> jax.Array:
             return arr
         _PLACEHOLDER_CACHE[key] = new
         tracker.track(_PLACEHOLDER_TRACK_ID, int(new.nbytes))
+        evicted = []
         while len(_PLACEHOLDER_CACHE) > _PLACEHOLDER_CACHE_CAP:
             _, old = _PLACEHOLDER_CACHE.popitem(last=False)
-            tracker.release(_PLACEHOLDER_TRACK_ID, int(old.nbytes))
+            evicted.append(old)
+    for old in evicted:
+        nbytes = int(old.nbytes)
+        try:
+            # release only when the last in-flight reference drops
+            weakref.finalize(
+                old, tracker.release, _PLACEHOLDER_TRACK_ID, nbytes
+            )
+        except TypeError:  # object not weak-referenceable
+            tracker.release(_PLACEHOLDER_TRACK_ID, nbytes)
     return new
 
 

@@ -55,6 +55,19 @@ class ParquetScanExec(PhysicalOp):
                     [aschema.field(n) for n in self.projection]
                 )
             schema = from_arrow_schema(aschema)
+        elif self.projection and list(schema.names()) != self.projection:
+            # a producer following the reference's NativeParquetScanExec
+            # contract sends the FULL file schema plus a projection of
+            # field indices (NativeParquetScanExec.scala:105-107); the
+            # operator's schema is the PROJECTED one - normalizing here
+            # keeps every downstream consumer (output schema, pruned-
+            # batch assembly) positionally consistent
+            schema = Schema(
+                [
+                    schema.fields[schema.index_of(n)]
+                    for n in self.projection
+                ]
+            )
         self._schema = schema
 
     @property

@@ -202,9 +202,16 @@ def _walk(op, req: Optional[Set[int]], acc: "_Acc") -> None:
             child_req |= expr_cols(op.exprs[i][0], child.schema)
         _walk(child, child_req, acc)
         return
-    if isinstance(op, (RenameColumnsExec, LimitExec, DebugExec,
+    if isinstance(op, (RenameColumnsExec, LimitExec,
                        CoalescePartitionsExec)):
         _walk(op.children[0], None if req is None else set(req), acc)
+        return
+    if isinstance(op, DebugExec):
+        # DebugExec materializes EVERY batch via to_arrow() for logging
+        # (the reference logs full batches too, debug_exec.rs:44-58), so
+        # a pruned placeholder column would crash the log path - require
+        # all child columns
+        _walk(op.children[0], None, acc)
         return
     if isinstance(op, SortExec):
         child = op.children[0]

@@ -216,10 +216,16 @@ def put_packed_padded(entries: Sequence[Tuple[np.ndarray, int, int]]
             view[:n] = vals.astype(np.uint8).reshape(vals.shape)
             view[n:] = 1 if fill else 0
         elif pairs and dt == np.float64:
+            # the pair tail encodes only 0.0; a nonzero fill would be
+            # silently wrong, so enforce the contract (ValueError, not
+            # assert: must survive python -O)
+            if fill:
+                raise ValueError(
+                    "f64-pair padding supports fill=0 only (got "
+                    f"{fill!r})"
+                )
             pb = _f64_to_pair_bytes(np.ascontiguousarray(vals))
             seg[: pb.size] = pb
-            # only values columns carry f64 (fill is always 0 there);
-            # zero pairs reconstruct to exactly 0.0
             seg[pb.size:] = 0
         else:
             view = seg.view(dt).reshape(shape)

@@ -326,3 +326,87 @@ def test_widening_cast_still_pushable(pq_file):
     plan = FilterExec(sc, Col("b").cast(DT.float64()) > 50.0)
     install(plan, with_filters=True)
     assert [f[0] for f in sc._hint_filters] == ["b"]
+
+
+def test_debug_exec_requires_all_columns(tmp_path):
+    """DebugExec materializes every batch via to_arrow() for logging, so
+    pruning a column above it must not leave a placeholder the log path
+    can't render (advisor repro: Project(Debug(scan)) dropping a string
+    column crashed with ArrowIndexError)."""
+    tbl = pa.table({
+        "s": pa.array(["aa", "bb", "cc", "dd"]),
+        "v": np.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    path = str(tmp_path / "dbg.parquet")
+    pq.write_table(tbl, path)
+    from blaze_tpu.ops import DebugExec
+
+    plan = ProjectExec(
+        DebugExec(scan(path), "dbg"),
+        [(Col("v") * 2.0, "v2")],  # the string column is never read
+    )
+    blob = task_to_proto(plan, 0)
+    rows = list(execute_task(blob))
+    out = pa.Table.from_batches(rows)
+    np.testing.assert_allclose(
+        np.sort(out.column("v2").to_numpy(zero_copy_only=False)),
+        [2.0, 4.0, 6.0, 8.0],
+    )
+
+
+def test_reference_projection_contract_pruned_batches(tmp_path):
+    """Full-schema-plus-projection-indices construction (the reference's
+    NativeParquetScanExec contract) yields correctly positioned pruned
+    batches (advisor finding: from_arrow_pruned indexed the full
+    schema)."""
+    tbl = pa.table({
+        "a": np.arange(8, dtype=np.int32),
+        "b": np.arange(8, dtype=np.float32) * 1.5,
+        "c": np.arange(8, dtype=np.int64) + 100,
+    })
+    path = str(tmp_path / "proj.parquet")
+    pq.write_table(tbl, path)
+    from blaze_tpu.types import Schema, Field
+    from blaze_tpu.types import DataType as DT
+
+    full = Schema([
+        Field("a", DT.int32(), True),
+        Field("b", DT.float32(), True),
+        Field("c", DT.int64(), True),
+    ])
+    sc = ParquetScanExec([[FileRange(path)]], full, projection=["c", "b"])
+    assert list(sc.schema.names()) == ["c", "b"]
+    plan = ProjectExec(sc, [(Col("c") + 1, "c1")])
+    blob = task_to_proto(plan, 0)
+    out = pa.Table.from_batches(list(execute_task(blob)))
+    np.testing.assert_array_equal(
+        np.sort(out.column("c1").to_numpy(zero_copy_only=False)),
+        np.arange(8) + 101,
+    )
+
+
+def test_pruned_placeholder_renders_null_in_to_arrow(tmp_path):
+    """Root-cause guard for the placeholder-rendering defect class: any
+    materializing consumer (sort spill, grace externalization, host
+    fallback) may call to_arrow() on a batch whose pruned string column
+    is a placeholder; it must render all-null, not crash."""
+    from blaze_tpu.batch import ColumnBatch
+
+    tbl = pa.table({
+        "s": pa.array(["x", "y", "z"]),
+        "v": np.array([1.0, 2.0, 3.0]),
+    })
+    path = str(tmp_path / "ph.parquet")
+    pq.write_table(tbl, path)
+    sc = scan(path)
+    # prune "s" the way the planner hints do
+    sc._hint_required = {1}
+    from blaze_tpu.ops.base import ExecContext
+
+    batches = list(sc.execute(0, ExecContext()))
+    assert len(batches) == 1
+    rb = batches[0].to_arrow()
+    assert rb.column("s").null_count == 3  # placeholder -> nulls
+    np.testing.assert_allclose(
+        rb.column("v").to_numpy(zero_copy_only=False), [1.0, 2.0, 3.0]
+    )

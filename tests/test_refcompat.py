@@ -354,9 +354,9 @@ def test_projection_with_indices_and_pruning(store_sales):
     pe.binary_expr.r.literal.float32_value = 0.0
 
     op = plan_from_ref(node)
-    assert op.schema.names()[:2] == ["price", "qty"] or set(
-        op.schema.names()
-    ) >= {"price", "qty"}
+    # projected scan's output schema is exactly the projection, in
+    # projection order (full-schema-plus-indices contract)
+    assert list(op.schema.names()) == ["price", "qty"]
 
     task = rp.TaskDefinition()
     task.plan.CopyFrom(node)
