@@ -56,6 +56,19 @@ class ParquetScanExec(PhysicalOp):
                 )
             schema = from_arrow_schema(aschema)
         elif self.projection and list(schema.names()) != self.projection:
+            # index-bound pruning-predicate columns were bound against
+            # the FULL file schema; rewrite them to name references
+            # before the schema narrows so stats pruning keeps reading
+            # the right row-group columns
+            if pruning_predicate is not None:
+                full = schema
+                pruning_predicate = ir.transform(
+                    pruning_predicate,
+                    lambda e: ir.Col(full.fields[e.index].name)
+                    if isinstance(e, ir.BoundCol)
+                    else e,
+                )
+                self.pruning_predicate = pruning_predicate
             # a producer following the reference's NativeParquetScanExec
             # contract sends the FULL file schema plus a projection of
             # field indices (NativeParquetScanExec.scala:105-107); the

