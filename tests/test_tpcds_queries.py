@@ -1,4 +1,4 @@
-"""TPC-DS whole-query differential matrix: 43 queries from q1-q55.
+"""TPC-DS whole-query differential matrix: ALL 99 queries.
 
 Mirror of the reference's correctness CI (tpcds.yml:105-147): every query
 runs twice - broadcast hash joins and forced sort-merge joins - and both
@@ -7,9 +7,8 @@ the same query (Spark join/NULL semantics hand-enforced: NULL join keys
 never match, NULL groups are kept, AVG ignores NULLs). Comparison is
 order-insensitive where the query's sort key is non-unique.
 
-Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows - 43 queries
-x 2 flavors keeps the default suite ~11 minutes; raise to 1M+ for
-scale runs; returns/web/catalog scale proportionally).
+Scale: BLAZE_TPCDS_ROWS (default 200k store_sales rows; raise to 1M+
+for scale runs; returns/web/catalog scale proportionally).
 """
 
 import os
@@ -2702,4 +2701,489 @@ ORACLES.update({
     "q66": oracle_q66, "q67": oracle_q67, "q70": oracle_q70,
     "q72": oracle_q72, "q75": oracle_q75, "q76": oracle_q76,
     "q77": oracle_q77, "q78": oracle_q78,
+})
+
+
+# ---------------------------------------------------------------------------
+# final-block oracles: q81/q83/q84/q94/q95
+# ---------------------------------------------------------------------------
+
+def oracle_q81(t):
+    dd = t["date_dim"][t["date_dim"].d_year == 2000]
+    cr = _merge(t["catalog_returns"], dd[["d_date_sk"]],
+                "cr_returned_date_sk", "d_date_sk")
+    cr = _merge(cr, t["customer_address"][["ca_address_sk", "ca_state"]],
+                "cr_returning_addr_sk", "ca_address_sk")
+    ctr = (
+        cr.groupby(["cr_returning_customer_sk", "ca_state"],
+                   dropna=False)
+        .cr_return_amount.sum().reset_index(name="ctr_total_return")
+    )
+    avg = (
+        ctr.groupby("ca_state")
+        .ctr_total_return.mean().reset_index(name="avg_r")
+    )
+    m = _merge(ctr, avg, "ca_state", "ca_state")
+    m = m[m.ctr_total_return > 1.2 * m.avg_r]
+    m = _merge(
+        m,
+        t["customer"][["c_customer_sk", "c_customer_id", "c_first_name",
+                       "c_last_name", "c_current_addr_sk"]],
+        "cr_returning_customer_sk", "c_customer_sk",
+    )
+    ca = t["customer_address"]
+    ga = ca[ca.ca_state == "GA"][["ca_address_sk"]]
+    m = _merge(m, ga, "c_current_addr_sk", "ca_address_sk")
+    out = m[["c_customer_id", "c_first_name", "c_last_name",
+             "ctr_total_return"]]
+    return (
+        out.sort_values(["c_customer_id", "ctr_total_return"])
+        .head(100).reset_index(drop=True)
+    )
+
+
+def oracle_q83(t):
+    dd = t["date_dim"][t["date_dim"].d_week_seq.isin([20, 60, 100])]
+    it = t["item"][["i_item_sk", "i_item_id"]]
+
+    def channel(table, date_col, item_col, qty_col, name):
+        j = _merge(t[table], dd[["d_date_sk"]], date_col, "d_date_sk")
+        j = _merge(j, it, item_col, "i_item_sk")
+        return (
+            j.groupby("i_item_id")[qty_col].sum()
+            .reset_index(name=name)
+        )
+
+    sr = channel("store_returns", "sr_returned_date_sk", "sr_item_sk",
+                 "sr_return_quantity", "sr_qty")
+    cr = channel("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+                 "cr_return_quantity", "cr_qty")
+    wr = channel("web_returns", "wr_returned_date_sk", "wr_item_sk",
+                 "wr_return_quantity", "wr_qty")
+    m = sr.merge(cr, on="i_item_id").merge(wr, on="i_item_id")
+    avg3 = (m.sr_qty + m.cr_qty + m.wr_qty) / 3.0
+    out = pd.DataFrame({
+        "item_id": m.i_item_id,
+        "sr_qty": m.sr_qty,
+        "sr_dev": m.sr_qty / avg3 * 100.0,
+        "cr_qty": m.cr_qty,
+        "cr_dev": m.cr_qty / avg3 * 100.0,
+        "wr_qty": m.wr_qty,
+        "wr_dev": m.wr_qty / avg3 * 100.0,
+        "average": avg3,
+    })
+    return (
+        out.sort_values(["item_id", "sr_qty"]).head(100)
+        .reset_index(drop=True)
+    )
+
+
+def oracle_q84(t):
+    ib = t["income_band"]
+    ib = ib[(ib.ib_lower_bound >= 30_000)
+            & (ib.ib_upper_bound <= 80_000)]
+    hd = _merge(t["household_demographics"], ib[["ib_income_band_sk"]],
+                "hd_income_band_sk", "ib_income_band_sk")
+    ca = t["customer_address"]
+    cust = _merge(
+        t["customer"], ca[ca.ca_city == "Midway"][["ca_address_sk"]],
+        "c_current_addr_sk", "ca_address_sk",
+    )
+    cust = _merge(cust, hd[["hd_demo_sk"]], "c_current_hdemo_sk",
+                  "hd_demo_sk")
+    cust = _merge(
+        cust, t["customer_demographics"][["cd_demo_sk"]],
+        "c_current_cdemo_sk", "cd_demo_sk",
+    )
+    j = _merge(cust, t["store_returns"][["sr_cdemo_sk"]],
+               "cd_demo_sk", "sr_cdemo_sk")
+    out = pd.DataFrame({
+        "customer_id": j.c_customer_id,
+        "customername": j.c_last_name,
+    })
+    return (
+        out.sort_values("customer_id").head(100).reset_index(drop=True)
+    )
+
+
+def _oracle_ws_shipped(t, state):
+    dd = t["date_dim"][t["date_dim"].d_year == 1999]
+    ws = _merge(t["web_sales"], dd[["d_date_sk"]],
+                "ws_ship_date_sk", "d_date_sk")
+    ca = t["customer_address"]
+    ws = _merge(ws, ca[ca.ca_state == state][["ca_address_sk"]],
+                "ws_ship_addr_sk", "ca_address_sk")
+    sites = t["web_site"]
+    return _merge(
+        ws, sites[sites.web_name == "site_0"][["web_site_sk"]],
+        "ws_web_site_sk", "web_site_sk",
+    )
+
+
+def _oracle_multi_wh_orders(t):
+    ws = t["web_sales"][["ws_order_number", "ws_warehouse_sk"]]
+    per = ws.drop_duplicates()
+    counts = per.groupby("ws_order_number").size()
+    return set(counts[counts > 1].index)
+
+
+def _oracle_order_stats(base):
+    return pd.DataFrame({
+        "order_count": [base.ws_order_number.nunique()],
+        "total_shipping_cost": [
+            base.ws_ext_ship_cost.sum() if len(base) else np.nan],
+        "total_net_profit": [
+            base.ws_net_profit.sum() if len(base) else np.nan],
+    })
+
+
+def oracle_q94(t):
+    base = _oracle_ws_shipped(t, "CA")
+    multi = _oracle_multi_wh_orders(t)
+    base = base[base.ws_order_number.isin(multi)]
+    returned = set(t["web_returns"].wr_order_number.dropna())
+    base = base[~base.ws_order_number.isin(returned)]
+    return _oracle_order_stats(base)
+
+
+def oracle_q95(t):
+    base = _oracle_ws_shipped(t, "TX")
+    multi = _oracle_multi_wh_orders(t)
+    base = base[base.ws_order_number.isin(multi)]
+    returned_multi = set(
+        t["web_returns"].wr_order_number.dropna()
+    ) & multi
+    base = base[base.ws_order_number.isin(returned_multi)]
+    return _oracle_order_stats(base)
+
+
+ORACLES.update({
+    "q81": oracle_q81, "q83": oracle_q83, "q84": oracle_q84,
+    "q94": oracle_q94, "q95": oracle_q95,
+})
+
+
+# ---------------------------------------------------------------------------
+# final-block oracles: q23/q24/q54/q64/q80/q85
+# ---------------------------------------------------------------------------
+
+def oracle_q23(t):
+    dd = t["date_dim"]
+    ss = _merge(t["store_sales"], dd[dd.d_year == 2000][["d_date_sk"]],
+                "ss_sold_date_sk", "d_date_sk")
+    freq = ss.groupby("ss_item_sk").size()
+    frequent = set(freq[freq > 2].index)
+
+    ss2 = _merge(
+        t["store_sales"],
+        dd[dd.d_year.isin([2000, 2001])][["d_date_sk"]],
+        "ss_sold_date_sk", "d_date_sk",
+    )
+    ss2 = ss2.dropna(subset=["ss_customer_sk"])
+    csales = (
+        ss2.assign(v=ss2.ss_quantity.astype(float) * ss2.ss_sales_price)
+        .groupby("ss_customer_sk").v.sum()
+    )
+    cmax = csales.max()
+    best = set(csales[csales > 0.5 * cmax].index)
+
+    month = dd[(dd.d_year == 2000) & (dd.d_moy == 3)][["d_date_sk"]]
+
+    def channel(table, prefix, cust_col):
+        df = _merge(t[table], month, f"{prefix}_sold_date_sk",
+                    "d_date_sk")
+        df = df[df[f"{prefix}_item_sk"].isin(frequent)]
+        df = df[df[cust_col].isin(best)]
+        return (
+            df[f"{prefix}_quantity"].astype(float)
+            * df[f"{prefix}_list_price"]
+        ).sum() if len(df) else np.nan
+
+    a = channel("catalog_sales", "cs", "cs_bill_customer_sk")
+    b = channel("web_sales", "ws", "ws_bill_customer_sk")
+    vals = [v for v in (a, b) if not pd.isna(v)]
+    total = sum(vals) if vals else np.nan
+    return pd.DataFrame({"total": [total]})
+
+
+def oracle_q24(t):
+    m = t["store_sales"].merge(
+        t["store_returns"][["sr_ticket_number", "sr_item_sk"]],
+        left_on=["ss_ticket_number", "ss_item_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk"],
+    )
+    st = t["store"]
+    m = _merge(m, st[st.s_market_id <= 5][
+        ["s_store_sk", "s_store_name", "s_state"]],
+        "ss_store_sk", "s_store_sk")
+    m = _merge(m, t["item"][["i_item_sk", "i_color"]],
+               "ss_item_sk", "i_item_sk")
+    m = _merge(
+        m,
+        t["customer"][["c_customer_sk", "c_first_name", "c_last_name",
+                       "c_current_addr_sk"]],
+        "ss_customer_sk", "c_customer_sk",
+    )
+    ca = t["customer_address"][["ca_address_sk", "ca_state"]]
+    m = m.merge(
+        ca.dropna(subset=["ca_state"]),
+        left_on=["c_current_addr_sk", "s_state"],
+        right_on=["ca_address_sk", "ca_state"],
+    )
+    ssales = (
+        m.groupby(
+            ["c_last_name", "c_first_name", "s_store_name", "i_color"],
+            dropna=False,
+        ).ss_net_paid.sum().reset_index(name="netpaid")
+    )
+    avg_paid = ssales.netpaid.mean()
+    out = ssales[ssales.netpaid > 0.05 * avg_paid]
+    return (
+        out.sort_values(
+            ["c_last_name", "c_first_name", "s_store_name", "i_color"],
+            na_position="first",
+        ).head(100).reset_index(drop=True)
+    )
+
+
+def oracle_q54(t):
+    dd = t["date_dim"]
+
+    def channel(table, prefix, cust_col):
+        return t[table][[f"{prefix}_sold_date_sk",
+                         f"{prefix}_item_sk", cust_col]].rename(
+            columns={f"{prefix}_sold_date_sk": "sold_date_sk",
+                     f"{prefix}_item_sk": "item_sk",
+                     cust_col: "customer_sk"})
+
+    both = pd.concat(
+        [channel("catalog_sales", "cs", "cs_bill_customer_sk"),
+         channel("web_sales", "ws", "ws_bill_customer_sk")],
+        ignore_index=True,
+    )
+    it = t["item"]
+    both = _merge(both, it[it.i_category == "Books"][["i_item_sk"]],
+                  "item_sk", "i_item_sk")
+    month = dd[(dd.d_year == 1999) & (dd.d_moy == 3)][["d_date_sk"]]
+    both = _merge(both, month, "sold_date_sk", "d_date_sk")
+    my_customers = both.dropna(subset=["customer_sk"])[
+        "customer_sk"].unique()
+    cust = t["customer"][
+        t["customer"].c_customer_sk.isin(my_customers)]
+    cust = _merge(cust, t["customer_address"][
+        ["ca_address_sk", "ca_county", "ca_state"]],
+        "c_current_addr_sk", "ca_address_sk")
+    cust = cust.merge(
+        t["store"][["s_county", "s_state"]].drop_duplicates(),
+        left_on=["ca_county", "ca_state"],
+        right_on=["s_county", "s_state"],
+    )
+    window = dd[(dd.d_month_seq >= 1191)
+                & (dd.d_month_seq <= 1193)][["d_date_sk"]]
+    ss = _merge(t["store_sales"], window, "ss_sold_date_sk",
+                "d_date_sk")
+    rev = _merge(cust[["c_customer_sk"]].drop_duplicates(), ss,
+                 "c_customer_sk", "ss_customer_sk")
+    per = rev.groupby("c_customer_sk").ss_ext_sales_price.sum()
+    seg = np.trunc(per.values / 50.0).astype(np.int64)
+    hist = pd.Series(seg).value_counts().sort_index()
+    out = pd.DataFrame({
+        "segment": hist.index.astype(np.int64),
+        "num_customers": hist.values,
+        "segment_base": hist.index.astype(np.int64) * 50,
+    })
+    return (
+        out.sort_values(["segment", "num_customers"]).head(100)
+        .reset_index(drop=True)
+    )
+
+
+def oracle_q64(t):
+    cs = t["catalog_sales"].merge(
+        t["catalog_returns"][["cr_order_number", "cr_item_sk",
+                              "cr_return_amount", "cr_net_loss"]],
+        left_on=["cs_order_number", "cs_item_sk"],
+        right_on=["cr_order_number", "cr_item_sk"],
+    )
+    ui = cs.groupby("cs_item_sk").agg(
+        sale=("cs_ext_list_price", "sum"),
+        ramt=("cr_return_amount", "sum"),
+        rloss=("cr_net_loss", "sum"),
+    )
+    ui_items = set(ui[ui.sale > (ui.ramt + ui.rloss) * 2.0].index)
+
+    def cross_sales(year, prefix):
+        m = t["store_sales"].merge(
+            t["store_returns"][["sr_ticket_number", "sr_item_sk"]],
+            left_on=["ss_ticket_number", "ss_item_sk"],
+            right_on=["sr_ticket_number", "sr_item_sk"],
+        )
+        m = m[m.ss_item_sk.isin(ui_items)]
+        dd = t["date_dim"]
+        m = _merge(m, dd[dd.d_year == year][["d_date_sk"]],
+                   "ss_sold_date_sk", "d_date_sk")
+        m = _merge(m, t["store"][["s_store_sk", "s_store_name",
+                                  "s_zip"]],
+                   "ss_store_sk", "s_store_sk")
+        m = _merge(m, t["customer"][[
+            "c_customer_sk", "c_current_hdemo_sk",
+            "c_current_addr_sk"]],
+            "ss_customer_sk", "c_customer_sk")
+        m = _merge(m, t["household_demographics"][[
+            "hd_demo_sk", "hd_income_band_sk"]],
+            "c_current_hdemo_sk", "hd_demo_sk")
+        m = _merge(m, t["income_band"][["ib_income_band_sk"]],
+                   "hd_income_band_sk", "ib_income_band_sk")
+        m = _merge(m, t["customer_address"][["ca_address_sk"]],
+                   "c_current_addr_sk", "ca_address_sk")
+        ca2 = t["customer_address"][["ca_address_sk", "ca_state"]]
+        ca2 = ca2.rename(columns={"ca_address_sk": "ca2_address_sk",
+                                  "ca_state": "ca2_state"})
+        m = _merge(m, ca2, "ss_addr_sk", "ca2_address_sk")
+        it = t["item"]
+        m = _merge(
+            m,
+            it[it.i_color.isin(["red", "navy", "khaki"])][
+                ["i_item_sk", "i_product_name"]],
+            "ss_item_sk", "i_item_sk",
+        )
+        g = m.groupby(
+            ["i_product_name", "i_item_sk", "s_store_name", "s_zip"],
+            dropna=False,
+        ).agg(
+            cnt=("ss_item_sk", "size"),
+            s1=("ss_ext_wholesale_cost", "sum"),
+            s2=("ss_ext_list_price", "sum"),
+            s3=("ss_coupon_amt", "sum"),
+        ).reset_index()
+        return g.rename(columns={
+            "i_product_name": f"{prefix}_product_name",
+            "i_item_sk": f"{prefix}_item_sk",
+            "s_store_name": f"{prefix}_store_name",
+            "s_zip": f"{prefix}_store_zip",
+            "cnt": f"{prefix}_cnt", "s1": f"{prefix}_s1",
+            "s2": f"{prefix}_s2", "s3": f"{prefix}_s3",
+        })
+
+    cs1 = cross_sales(1999, "y1")
+    cs2 = cross_sales(2000, "y2")
+    j = cs1.merge(
+        cs2,
+        left_on=["y1_item_sk", "y1_store_name", "y1_store_zip"],
+        right_on=["y2_item_sk", "y2_store_name", "y2_store_zip"],
+    )
+    j = j[j.y2_cnt <= j.y1_cnt]
+    out = j[["y1_product_name", "y1_store_name", "y1_store_zip",
+             "y1_cnt", "y1_s1", "y2_cnt", "y2_s1"]]
+    return (
+        out.sort_values(["y1_product_name", "y1_store_name", "y1_s1"],
+                        na_position="first")
+        .head(100).reset_index(drop=True)
+    )
+
+
+def oracle_q80(t):
+    dd = t["date_dim"]
+    month = dd[(dd.d_year == 2000) & (dd.d_moy == 8)][["d_date_sk"]]
+    it = t["item"]
+    items = it[it.i_current_price > 50.0][["i_item_sk"]]
+    pr = t["promotion"]
+    promos = pr[pr.p_channel_tv == "N"][["p_promo_sk"]]
+
+    def channel(label, sales_t, ret_t, skeys, rkeys, prefix, id_col,
+                ret_amt, ret_loss):
+        sales = t[sales_t].merge(
+            t[ret_t][rkeys + [ret_amt, ret_loss]],
+            left_on=skeys, right_on=rkeys, how="left",
+        )
+        sales = _merge(sales, month, f"{prefix}_sold_date_sk",
+                       "d_date_sk")
+        sales = _merge(sales, items, f"{prefix}_item_sk", "i_item_sk")
+        sales = _merge(sales, promos, f"{prefix}_promo_sk",
+                       "p_promo_sk")
+        return pd.DataFrame({
+            "channel": label,
+            "id": sales[id_col].astype(np.int64),
+            "sales": sales[f"{prefix}_ext_sales_price"],
+            "returns": sales[ret_amt].fillna(0.0),
+            "profit": (sales[f"{prefix}_net_profit"]
+                       - sales[ret_loss].fillna(0.0)),
+        })
+
+    both = pd.concat([
+        channel("store channel", "store_sales", "store_returns",
+                ["ss_ticket_number", "ss_item_sk"],
+                ["sr_ticket_number", "sr_item_sk"],
+                "ss", "ss_store_sk", "sr_return_amt", "sr_net_loss"),
+        channel("catalog channel", "catalog_sales", "catalog_returns",
+                ["cs_order_number", "cs_item_sk"],
+                ["cr_order_number", "cr_item_sk"],
+                "cs", "cs_call_center_sk", "cr_return_amount",
+                "cr_net_loss"),
+        channel("web channel", "web_sales", "web_returns",
+                ["ws_order_number", "ws_item_sk"],
+                ["wr_order_number", "wr_item_sk"],
+                "ws", "ws_web_site_sk", "wr_return_amt", "wr_net_loss"),
+    ], ignore_index=True)
+    out = both.groupby(["channel", "id"], dropna=False).agg(
+        sales=("sales", "sum"), returns=("returns", "sum"),
+        profit=("profit", "sum"),
+    ).reset_index()
+    return (
+        out.sort_values(["channel", "id"]).head(100)
+        .reset_index(drop=True)
+    )
+
+
+def oracle_q85(t):
+    m = t["web_sales"].merge(
+        t["web_returns"],
+        left_on=["ws_order_number", "ws_item_sk"],
+        right_on=["wr_order_number", "wr_item_sk"],
+    )
+    m = _merge(m, t["web_page"][["wp_web_page_sk"]],
+               "ws_web_page_sk", "wp_web_page_sk")
+    cd = t["customer_demographics"]
+    cd1 = cd[["cd_demo_sk", "cd_marital_status",
+              "cd_education_status"]].rename(columns={
+        "cd_demo_sk": "cd1_demo_sk",
+        "cd_marital_status": "cd1_marital",
+        "cd_education_status": "cd1_edu"})
+    m = _merge(m, cd1, "wr_refunded_cdemo_sk", "cd1_demo_sk")
+    m = m.merge(
+        cd[["cd_demo_sk", "cd_marital_status"]],
+        left_on=["wr_returning_cdemo_sk", "cd1_marital"],
+        right_on=["cd_demo_sk", "cd_marital_status"],
+    )
+    m = _merge(m, t["customer_address"][["ca_address_sk", "ca_state"]],
+               "wr_refunded_addr_sk", "ca_address_sk")
+    dd = t["date_dim"]
+    m = _merge(m, dd[dd.d_year == 2000][["d_date_sk"]],
+               "ws_sold_date_sk", "d_date_sk")
+    m = _merge(m, t["reason"][["r_reason_sk", "r_reason_desc"]],
+               "wr_reason_sk", "r_reason_sk")
+    band = (
+        ((m.cd1_marital == "M") & (m.cd1_edu == "4 yr Degree")
+         & (m.ws_sales_price >= 100.0) & (m.ws_sales_price <= 150.0))
+        | ((m.cd1_marital == "S") & (m.cd1_edu == "College")
+           & (m.ws_sales_price >= 50.0) & (m.ws_sales_price <= 100.0))
+    )
+    geo = (
+        (m.ca_state.isin(["TN", "GA"]) & (m.ws_net_profit >= 100.0))
+        | (m.ca_state.isin(["CA", "TX"]) & (m.ws_net_profit >= 50.0))
+    )
+    m = m[band & geo]
+    out = m.groupby("r_reason_desc").agg(
+        avg_qty=("ws_quantity", "mean"),
+        avg_cash=("wr_refunded_cash", "mean"),
+        avg_fee=("wr_fee", "mean"),
+    ).reset_index().rename(columns={"r_reason_desc": "reason"})
+    return (
+        out.sort_values("reason").head(100).reset_index(drop=True)
+    )
+
+
+ORACLES.update({
+    "q23": oracle_q23, "q24": oracle_q24, "q54": oracle_q54,
+    "q64": oracle_q64, "q80": oracle_q80, "q85": oracle_q85,
 })

@@ -1,15 +1,15 @@
-"""TPC-DS queries (43 of q1-q55) as engine plan builders over
-synthetic tables.
+"""ALL 99 TPC-DS queries as engine plan builders over synthetic tables.
 
 The reference's correctness backbone is whole-query differential testing:
 99 TPC-DS queries x {broadcast-join, forced-SMJ} validated against
 vanilla Spark (.github/workflows/tpcds.yml:105-147, dev/run-tpcds-test:
-38-57). This module is that harness engine side for 39 queries from q1-q55: each query
-is a full multi-stage plan (CTE-depth joins, agg-over-join-over-agg,
-unions, semi/anti joins, decorrelated subqueries - the same rewrites
-Spark's optimizer performs) built twice, once with broadcast hash joins
-and once with forced sort-merge joins. Oracles live in
-test_tpcds_queries.py as independent pandas implementations.
+38-57). This module is that harness engine-side, at full 99-query
+coverage: each query is a full multi-stage plan (CTE-depth joins,
+agg-over-join-over-agg, unions, semi/anti joins, decorrelated
+subqueries - the same rewrites Spark's optimizer performs) built twice,
+once with broadcast hash joins and once with forced sort-merge joins.
+Oracles live in test_tpcds_queries.py as independent pandas
+implementations.
 
 Scale is configurable (BLAZE_TPCDS_ROWS, default 200k store_sales
 rows - raise to 1M+ for scale runs);
@@ -5185,4 +5185,880 @@ def q78(s, flavor):
 QUERIES.update({
     "q66": q66, "q67": q67, "q70": q70, "q72": q72, "q75": q75,
     "q76": q76, "q77": q77, "q78": q78,
+})
+
+
+# ---------------------------------------------------------------------------
+# final block: q23/q24/q54/q64/q80/q81/q83/q84/q85/q94/q95
+# (the multi-CTE monsters; completes the reference CI's 99-query matrix,
+# tpcds.yml:105-114)
+# ---------------------------------------------------------------------------
+
+_GEN_V7 = gen_tables
+N_INCOME_BANDS = 20
+
+
+def gen_tables(seed: int = 20260729):  # noqa: F811 - extend again
+    t = _GEN_V7(seed)
+    rng = np.random.default_rng(seed + 37)
+
+    t["income_band"] = pd.DataFrame(
+        {
+            "ib_income_band_sk": np.arange(
+                N_INCOME_BANDS, dtype=np.int32),
+            "ib_lower_bound": (
+                np.arange(N_INCOME_BANDS) * 10_000).astype(np.int32),
+            "ib_upper_bound": (
+                (np.arange(N_INCOME_BANDS) + 1) * 10_000).astype(
+                np.int32),
+        }
+    )
+    hd = t["household_demographics"]
+    hd["hd_income_band_sk"] = rng.integers(
+        0, N_INCOME_BANDS, len(hd)).astype(np.int32)
+
+    ss = t["store_sales"]
+    ss["ss_net_paid"] = np.round(rng.random(len(ss)) * 250, 2)
+
+    ws = t["web_sales"]
+    n_ws = len(ws)
+    ws["ws_sales_price"] = np.round(rng.random(n_ws) * 200, 2)
+    ws["ws_list_price"] = np.round(rng.random(n_ws) * 250, 2)
+    ws["ws_promo_sk"] = rng.integers(0, N_PROMOS, n_ws).astype(np.int32)
+    ws["ws_net_profit"] = np.round(rng.random(n_ws) * 300 - 50, 2)
+    ws["ws_ship_addr_sk"] = rng.integers(
+        0, N_ADDRESSES, n_ws).astype(np.int32)
+    ws["ws_ext_ship_cost"] = np.round(rng.random(n_ws) * 80, 2)
+
+    cs = t["catalog_sales"]
+    cs["cs_net_profit"] = np.round(rng.random(len(cs)) * 300 - 50, 2)
+
+    sr = t["store_returns"]
+    sr["sr_cdemo_sk"] = rng.integers(0, N_CDEMO, len(sr)).astype(
+        np.int32)
+
+    wr = t["web_returns"]
+    n_wr = len(wr)
+    wr["wr_reason_sk"] = rng.integers(1, 10, n_wr).astype(np.int32)
+    wr["wr_refunded_cdemo_sk"] = rng.integers(
+        0, N_CDEMO, n_wr).astype(np.int32)
+    wr["wr_returning_cdemo_sk"] = rng.integers(
+        0, N_CDEMO, n_wr).astype(np.int32)
+    wr["wr_refunded_addr_sk"] = rng.integers(
+        0, N_ADDRESSES, n_wr).astype(np.int32)
+    wr["wr_fee"] = np.round(rng.random(n_wr) * 40, 2)
+    wr["wr_refunded_cash"] = np.round(rng.random(n_wr) * 120, 2)
+
+    cr = t["catalog_returns"]
+    cr["cr_returning_addr_sk"] = rng.integers(
+        0, N_ADDRESSES, len(cr)).astype(np.int32)
+
+    cust = t["customer"]
+    countries = np.array(
+        ["UNITED STATES", "CANADA", "MEXICO", "FRANCE"], dtype=object)
+    cust["c_birth_country"] = countries[
+        rng.integers(0, 4, len(cust))]
+    ca = t["customer_address"]
+    ca["ca_country"] = countries[rng.integers(0, 4, len(ca))]
+
+    st = t["store"]
+    st["s_market_id"] = (np.arange(len(st)) % 10 + 1).astype(np.int32)
+
+    # q94/q95 need multi-row web orders (so an order can touch several
+    # warehouses). Earlier blocks made order == row index; collapsing
+    # 3 rows per order keeps web-return alignment (wr_order_number was
+    # the ws row index) by the same division.
+    ws["ws_order_number"] = (
+        np.arange(n_ws, dtype=np.int64) // 3
+    )
+    wr["wr_order_number"] = (
+        wr["wr_order_number"].to_numpy(dtype=np.int64) // 3
+    )
+    return t
+
+
+def q81(s, flavor):
+    """TPC-DS q81: catalog-return customers whose state-total returns
+    exceed 1.2x their state's average (q1's shape over catalog returns
+    + address state), reported for GA-resident customers."""
+    def ctr():
+        j = _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == 2000),
+            s["catalog_returns"](),
+            ["d_date_sk"], ["cr_returned_date_sk"],
+        )
+        j = _join(
+            flavor, s["customer_address"](), j,
+            ["ca_address_sk"], ["cr_returning_addr_sk"],
+        )
+        return _agg(
+            j,
+            keys=[(Col("cr_returning_customer_sk"),
+                   "ctr_customer_sk"),
+                  (Col("ca_state"), "ctr_state")],
+            aggs=[(AggExpr(AggFn.SUM, Col("cr_return_amount")),
+                   "ctr_total_return")],
+        )
+
+    avg_by_state = ProjectExec(
+        _agg(
+            ctr(),
+            keys=[(Col("ctr_state"), "avg_state")],
+            aggs=[(AggExpr(AggFn.AVG, Col("ctr_total_return")),
+                   "avg_r")],
+        ),
+        [(Col("avg_state"), "avg_state"),
+         (Col("avg_r") * 1.2, "threshold")],
+    )
+    over = FilterExec(
+        _join(flavor, avg_by_state, ctr(),
+              ["avg_state"], ["ctr_state"]),
+        Col("ctr_total_return") > Col("threshold"),
+    )
+    cust = _join(
+        flavor, over, s["customer"](),
+        ["ctr_customer_sk"], ["c_customer_sk"],
+    )
+    ga = _join(
+        flavor,
+        FilterExec(s["customer_address"](), Col("ca_state") == "GA"),
+        cust,
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    out = _project_names(
+        ga, ["c_customer_id", "c_first_name", "c_last_name",
+             "ctr_total_return"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("c_customer_id"), True, True),
+         SortKey(Col("ctr_total_return"), True, True)],
+        100,
+    )
+
+
+def q83(s, flavor):
+    """TPC-DS q83: returned quantity per item across the three return
+    channels for a fixed set of weeks, each channel's share of the
+    three-channel average."""
+    weeks = (Literal(20, DataType.int32()),
+             Literal(60, DataType.int32()),
+             Literal(100, DataType.int32()))
+
+    def channel(table, date_col, item_col, qty_col, out_name):
+        dates = FilterExec(
+            s["date_dim"](), InList(Col("d_week_seq"), weeks)
+        )
+        j = _join(flavor, dates, s[table](),
+                  ["d_date_sk"], [date_col])
+        j = _join(flavor, s["item"](), j,
+                  ["i_item_sk"], [item_col])
+        return _agg(
+            j,
+            keys=[(Col("i_item_id"), "item_id")],
+            aggs=[(AggExpr(AggFn.SUM, Col(qty_col)), out_name)],
+        )
+
+    sr = channel("store_returns", "sr_returned_date_sk",
+                 "sr_item_sk", "sr_return_quantity", "sr_qty")
+    cr = RenameColumnsExec(
+        channel("catalog_returns", "cr_returned_date_sk",
+                "cr_item_sk", "cr_return_quantity", "cr_qty"),
+        ["cr_item_id", "cr_qty"],
+    )
+    wr = RenameColumnsExec(
+        channel("web_returns", "wr_returned_date_sk",
+                "wr_item_sk", "wr_return_quantity", "wr_qty"),
+        ["wr_item_id", "wr_qty"],
+    )
+    j = _join(flavor, sr, cr, ["item_id"], ["cr_item_id"])
+    j = _join(flavor, j, wr, ["item_id"], ["wr_item_id"])
+    total3 = (
+        (Col("sr_qty") + Col("cr_qty") + Col("wr_qty"))
+        .cast(DataType.float64()) / 3.0
+    )
+    out = ProjectExec(
+        j,
+        [(Col("item_id"), "item_id"),
+         (Col("sr_qty"), "sr_qty"),
+         (Col("sr_qty").cast(DataType.float64()) / total3 * 100.0,
+          "sr_dev"),
+         (Col("cr_qty"), "cr_qty"),
+         (Col("cr_qty").cast(DataType.float64()) / total3 * 100.0,
+          "cr_dev"),
+         (Col("wr_qty"), "wr_qty"),
+         (Col("wr_qty").cast(DataType.float64()) / total3 * 100.0,
+          "wr_dev"),
+         (total3, "average")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("item_id"), True, True),
+         SortKey(Col("sr_qty"), True, True)],
+        100,
+    )
+
+
+def q84(s, flavor):
+    """TPC-DS q84: customers in one city whose household income band
+    sits in a bounded range, linked to their store returns through the
+    demographics row."""
+    ib = FilterExec(
+        s["income_band"](),
+        (Col("ib_lower_bound") >= 30_000)
+        & (Col("ib_upper_bound") <= 80_000),
+    )
+    hd = _join(flavor, ib, s["household_demographics"](),
+               ["ib_income_band_sk"], ["hd_income_band_sk"])
+    cust = _join(
+        flavor,
+        FilterExec(s["customer_address"](),
+                   Col("ca_city") == "Midway"),
+        s["customer"](),
+        ["ca_address_sk"], ["c_current_addr_sk"],
+    )
+    cust = _join(flavor, hd, cust,
+                 ["hd_demo_sk"], ["c_current_hdemo_sk"])
+    cust = _join(flavor, s["customer_demographics"](), cust,
+                 ["cd_demo_sk"], ["c_current_cdemo_sk"])
+    j = _join(flavor, cust, s["store_returns"](),
+              ["cd_demo_sk"], ["sr_cdemo_sk"])
+    out = ProjectExec(
+        j,
+        [(Col("c_customer_id"), "customer_id"),
+         (Col("c_last_name"), "customername")],
+    )
+    return _sorted_limit(
+        out, [SortKey(Col("customer_id"), True, True)], 100,
+    )
+
+
+def _ws_shipped_base(s, flavor, state):
+    """q94/q95 shared base: web orders shipped in a date window to one
+    state through one site."""
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 1999),
+        s["web_sales"](),
+        ["d_date_sk"], ["ws_ship_date_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["customer_address"](), Col("ca_state") == state),
+        j,
+        ["ca_address_sk"], ["ws_ship_addr_sk"],
+    )
+    return _join(
+        flavor,
+        FilterExec(s["web_site"](), Col("web_name") == "site_0"),
+        j,
+        ["web_site_sk"], ["ws_web_site_sk"],
+    )
+
+
+def _order_count_stats(base, flavor):
+    """count(distinct order) + sums over the filtered rows, cross-joined
+    (constant key) into one row - the Spark plan for q94/q95's scalar
+    trio. GLOBAL aggregates (no keys) so an empty filtered base still
+    yields SQL's single row (count 0, NULL sums)."""
+    per_order = _agg(
+        ProjectExec(base, [(Col("ws_order_number"), "o")]),
+        keys=[(Col("o"), "o")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "dummy")],
+    )
+    n_orders = ProjectExec(
+        _agg(
+            per_order, keys=[],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "order_count")],
+        ),
+        [(Literal(1, DataType.int32()), "k"),
+         (Col("order_count"), "order_count")],
+    )
+    sums = ProjectExec(
+        _agg(
+            base, keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("ws_ext_ship_cost")),
+                   "total_shipping_cost"),
+                  (AggExpr(AggFn.SUM, Col("ws_net_profit")),
+                   "total_net_profit")],
+        ),
+        [(Literal(1, DataType.int32()), "k2"),
+         (Col("total_shipping_cost"), "total_shipping_cost"),
+         (Col("total_net_profit"), "total_net_profit")],
+    )
+    crossed = _join(flavor, n_orders, sums, ["k"], ["k2"])
+    return _project_names(
+        crossed,
+        ["order_count", "total_shipping_cost", "total_net_profit"],
+    )
+
+
+def _multi_wh_orders(s):
+    """Orders touching >= 2 distinct warehouses: dedupe
+    (order, warehouse), keep orders with > 1 surviving row (the
+    `exists ws2 ... different warehouse` rewrite shared by q94/q95)."""
+    return FilterExec(
+        _agg(
+            _agg(
+                _project_names(s["web_sales"](),
+                               ["ws_order_number", "ws_warehouse_sk"]),
+                keys=[(Col("ws_order_number"), "o"),
+                      (Col("ws_warehouse_sk"), "w")],
+                aggs=[(AggExpr(AggFn.COUNT_STAR, None), "c1")],
+            ),
+            keys=[(Col("o"), "o")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "n_wh")],
+        ),
+        Col("n_wh") > 1,
+    )
+
+
+def q94(s, flavor):
+    """TPC-DS q94: shipped web orders that span >= 2 warehouses and were
+    never returned; count distinct orders + cost/profit totals."""
+    base = _ws_shipped_base(s, flavor, "CA")
+    base = _semi(flavor, base, _multi_wh_orders(s),
+                 ["ws_order_number"], ["o"])
+    # not exists wr
+    base = _join(
+        flavor, base, s["web_returns"](),
+        ["ws_order_number"], ["wr_order_number"],
+        JoinType.LEFT_ANTI,
+    )
+    return _order_count_stats(base, flavor)
+
+
+def q95(s, flavor):
+    """TPC-DS q95: shipped web orders where BOTH the order and its
+    return ride the multi-warehouse order set."""
+    base = _ws_shipped_base(s, flavor, "TX")
+    base = _semi(flavor, base, _multi_wh_orders(s),
+                 ["ws_order_number"], ["o"])
+    returned_multi = _semi(
+        flavor,
+        _agg(
+            _project_names(s["web_returns"](), ["wr_order_number"]),
+            keys=[(Col("wr_order_number"), "ro")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cr1")],
+        ),
+        _multi_wh_orders(s),
+        ["ro"], ["o"],
+    )
+    base = _semi(flavor, base, returned_multi,
+                 ["ws_order_number"], ["ro"])
+    return _order_count_stats(base, flavor)
+
+
+QUERIES.update({
+    "q81": q81, "q83": q83, "q84": q84, "q94": q94, "q95": q95,
+})
+
+
+def _slit(v):
+    return Literal(v, DataType.utf8())
+
+
+def q23(s, flavor):
+    """TPC-DS q23 (single-variant): catalog+web revenue in one month
+    from frequently-store-sold items bought by the best store
+    customers - three CTEs (frequent item set, max per-customer store
+    sales as a global scalar, best-customer set) feeding a unioned
+    final aggregate."""
+    frequent = FilterExec(
+        _agg(
+            _join(
+                flavor,
+                FilterExec(s["date_dim"](), Col("d_year") == 2000),
+                s["store_sales"](),
+                ["d_date_sk"], ["ss_sold_date_sk"],
+            ),
+            keys=[(Col("ss_item_sk"), "fi_item_sk")],
+            aggs=[(AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+        ),
+        Col("cnt") > 2,
+    )
+
+    def cust_sales():
+        # NULL customers are filtered BEFORE grouping (the best-customer
+        # set feeds a semi join where NULL can never match; the synthetic
+        # data's 1% NULL rate would otherwise make the NULL group the
+        # max and empty the whole result)
+        return _agg(
+            _join(
+                flavor,
+                FilterExec(
+                    s["date_dim"](),
+                    InList(Col("d_year"),
+                           (Literal(2000, DataType.int32()),
+                            Literal(2001, DataType.int32()))),
+                ),
+                FilterExec(s["store_sales"](),
+                           IsNotNull(Col("ss_customer_sk"))),
+                ["d_date_sk"], ["ss_sold_date_sk"],
+            ),
+            keys=[(Col("ss_customer_sk"), "csales_cust")],
+            aggs=[(AggExpr(
+                AggFn.SUM,
+                Col("ss_quantity").cast(DataType.float64())
+                * Col("ss_sales_price")), "csales")],
+        )
+
+    max_sales = ProjectExec(
+        _agg(
+            cust_sales(), keys=[],
+            aggs=[(AggExpr(AggFn.MAX, Col("csales")), "tpcds_cmax")],
+        ),
+        [(Literal(1, DataType.int32()), "mk"),
+         (Col("tpcds_cmax"), "tpcds_cmax")],
+    )
+    best = ProjectExec(
+        FilterExec(
+            _join(
+                flavor, max_sales,
+                ProjectExec(
+                    cust_sales(),
+                    [(Literal(1, DataType.int32()), "ck"),
+                     (Col("csales_cust"), "csales_cust"),
+                     (Col("csales"), "csales")],
+                ),
+                ["mk"], ["ck"],
+            ),
+            Col("csales") > Col("tpcds_cmax") * 0.5,
+        ),
+        [(Col("csales_cust"), "best_cust")],
+    )
+
+    def channel(table, prefix, cust_col):
+        sales = _join(
+            flavor,
+            FilterExec(
+                s["date_dim"](),
+                (Col("d_year") == 2000) & (Col("d_moy") == 3),
+            ),
+            s[table](),
+            ["d_date_sk"], [f"{prefix}_sold_date_sk"],
+        )
+        sales = _semi(flavor, sales, frequent,
+                      [f"{prefix}_item_sk"], ["fi_item_sk"])
+        sales = _semi(flavor, sales, best, [cust_col], ["best_cust"])
+        return ProjectExec(
+            sales,
+            [(Col(f"{prefix}_quantity").cast(DataType.float64())
+              * Col(f"{prefix}_list_price"), "sales")],
+        )
+
+    both = _union([
+        channel("catalog_sales", "cs", "cs_bill_customer_sk"),
+        channel("web_sales", "ws", "ws_bill_customer_sk"),
+    ])
+    total = _agg(
+        both, keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("sales")), "total")],
+    )
+    return LimitExec(total, 100)
+
+
+def q24(s, flavor):
+    """TPC-DS q24: per-customer store revenue by item color through a
+    sales-returns ticket join, reported where a customer+store's paid
+    total beats 5% of the global average (scalar cross join)."""
+    j = _join(
+        flavor, s["store_sales"](), s["store_returns"](),
+        ["ss_ticket_number", "ss_item_sk"],
+        ["sr_ticket_number", "sr_item_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(s["store"](), Col("s_market_id") <= 5),
+        j,
+        ["s_store_sk"], ["ss_store_sk"],
+    )
+    j = _join(flavor, s["item"](), j, ["i_item_sk"], ["ss_item_sk"])
+    j = _join(flavor, s["customer"](), j,
+              ["c_customer_sk"], ["ss_customer_sk"])
+    # customer lives in the store's state (the query's zip linkage,
+    # state-keyed here): multi-key join incl. a string key
+    j = _join(
+        flavor, j, s["customer_address"](),
+        ["c_current_addr_sk", "s_state"],
+        ["ca_address_sk", "ca_state"],
+    )
+    ssales = _agg(
+        j,
+        keys=[(Col("c_last_name"), "c_last_name"),
+              (Col("c_first_name"), "c_first_name"),
+              (Col("s_store_name"), "s_store_name"),
+              (Col("i_color"), "i_color")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_net_paid")), "netpaid")],
+    )
+    avg_paid = ProjectExec(
+        _agg(
+            ssales, keys=[],
+            aggs=[(AggExpr(AggFn.AVG, Col("netpaid")), "avg_paid")],
+        ),
+        [(Literal(1, DataType.int32()), "ak"),
+         (Col("avg_paid"), "avg_paid")],
+    )
+    keyed = ProjectExec(
+        ssales,
+        [(Literal(1, DataType.int32()), "sk_"),
+         (Col("c_last_name"), "c_last_name"),
+         (Col("c_first_name"), "c_first_name"),
+         (Col("s_store_name"), "s_store_name"),
+         (Col("i_color"), "i_color"),
+         (Col("netpaid"), "netpaid")],
+    )
+    out = FilterExec(
+        _join(flavor, avg_paid, keyed, ["ak"], ["sk_"]),
+        Col("netpaid") > Col("avg_paid") * 0.05,
+    )
+    out = _project_names(
+        out, ["c_last_name", "c_first_name", "s_store_name",
+              "i_color", "netpaid"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("c_last_name"), True, True),
+         SortKey(Col("c_first_name"), True, True),
+         SortKey(Col("s_store_name"), True, True),
+         SortKey(Col("i_color"), True, True)],
+        100,
+    )
+
+
+def q54(s, flavor):
+    """TPC-DS q54: customers who bought Books from catalog/web in one
+    month, their store revenue in the following quarter at home-county
+    stores, histogrammed into $50 segments."""
+    def channel(table, prefix, cust_col):
+        return ProjectExec(
+            s[table](),
+            [(Col(f"{prefix}_sold_date_sk"), "sold_date_sk"),
+             (Col(f"{prefix}_item_sk"), "item_sk"),
+             (Col(cust_col), "customer_sk")],
+        )
+
+    both = _union([
+        channel("catalog_sales", "cs", "cs_bill_customer_sk"),
+        channel("web_sales", "ws", "ws_bill_customer_sk"),
+    ])
+    j = _join(
+        flavor,
+        FilterExec(s["item"](), Col("i_category") == "Books"),
+        both, ["i_item_sk"], ["item_sk"],
+    )
+    j = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_year") == 1999) & (Col("d_moy") == 3),
+        ),
+        j, ["d_date_sk"], ["sold_date_sk"],
+    )
+    my_customers = _agg(
+        j,
+        keys=[(Col("customer_sk"), "c_sk")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "c1")],
+    )
+    cust = _join(flavor, my_customers, s["customer"](),
+                 ["c_sk"], ["c_customer_sk"])
+    cust = _join(flavor, cust, s["customer_address"](),
+                 ["c_current_addr_sk"], ["ca_address_sk"])
+    cust = _join(
+        flavor, cust, s["store"](),
+        ["ca_county", "ca_state"], ["s_county", "s_state"],
+    )
+    # the county/state join is semi-join-shaped: stores sharing a
+    # (county, state) pair must not duplicate a customer (the SQL is
+    # `WHERE EXISTS`-equivalent; the oracle dedupes both sides)
+    cust = _agg(
+        cust,
+        keys=[(Col("c_sk"), "c_sk")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "c2")],
+    )
+    # month_seq of 1999-03 is (1999-1900)*12 + 2 = 1190; the revenue
+    # window is the following quarter (Spark constant-folds the
+    # subqueries to these literals)
+    rev = _join(
+        flavor,
+        FilterExec(
+            s["date_dim"](),
+            (Col("d_month_seq") >= 1191)
+            & (Col("d_month_seq") <= 1193),
+        ),
+        s["store_sales"](),
+        ["d_date_sk"], ["ss_sold_date_sk"],
+    )
+    rev = _join(flavor, cust, rev, ["c_sk"], ["ss_customer_sk"])
+    per_cust = _agg(
+        rev,
+        keys=[(Col("c_sk"), "c_sk")],
+        aggs=[(AggExpr(AggFn.SUM, Col("ss_ext_sales_price")),
+               "revenue")],
+    )
+    seg = ProjectExec(
+        per_cust,
+        [((Col("revenue") / 50.0).cast(DataType.int32()), "segment")],
+    )
+    hist = _agg(
+        seg,
+        keys=[(Col("segment"), "segment")],
+        aggs=[(AggExpr(AggFn.COUNT_STAR, None), "num_customers")],
+    )
+    out = ProjectExec(
+        hist,
+        [(Col("segment"), "segment"),
+         (Col("num_customers"), "num_customers"),
+         (Col("segment") * 50, "segment_base")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("segment"), True, True),
+         SortKey(Col("num_customers"), True, True)],
+        100,
+    )
+
+
+def q64(s, flavor):
+    """TPC-DS q64: cross-channel item resale - store sales+returns of
+    items whose catalog refunds stay under a third of catalog revenue,
+    decorated with household income band and both addresses, self-joined
+    across two years on (item, store) requiring the second year's count
+    not to grow."""
+    cs_ui = ProjectExec(
+        FilterExec(
+            _agg(
+                _join(
+                    flavor, s["catalog_sales"](), s["catalog_returns"](),
+                    ["cs_order_number", "cs_item_sk"],
+                    ["cr_order_number", "cr_item_sk"],
+                ),
+                keys=[(Col("cs_item_sk"), "ui_item_sk")],
+                aggs=[
+                    (AggExpr(AggFn.SUM, Col("cs_ext_list_price")),
+                     "sale"),
+                    (AggExpr(AggFn.SUM,
+                             Col("cr_return_amount")
+                             + Col("cr_net_loss")), "refund"),
+                ],
+            ),
+            Col("sale") > Col("refund") * 2.0,
+        ),
+        [(Col("ui_item_sk"), "ui_item_sk")],
+    )
+
+    def cross_sales(year, prefix):
+        j = _join(
+            flavor, s["store_sales"](), s["store_returns"](),
+            ["ss_ticket_number", "ss_item_sk"],
+            ["sr_ticket_number", "sr_item_sk"],
+        )
+        j = _semi(flavor, j, cs_ui, ["ss_item_sk"], ["ui_item_sk"])
+        j = _join(
+            flavor,
+            FilterExec(s["date_dim"](), Col("d_year") == year),
+            j, ["d_date_sk"], ["ss_sold_date_sk"],
+        )
+        j = _join(flavor, s["store"](), j,
+                  ["s_store_sk"], ["ss_store_sk"])
+        j = _join(flavor, s["customer"](), j,
+                  ["c_customer_sk"], ["ss_customer_sk"])
+        j = _join(flavor, s["household_demographics"](), j,
+                  ["hd_demo_sk"], ["c_current_hdemo_sk"])
+        j = _join(flavor, s["income_band"](), j,
+                  ["ib_income_band_sk"], ["hd_income_band_sk"])
+        j = _join(flavor, j, s["customer_address"](),
+                  ["c_current_addr_sk"], ["ca_address_sk"])
+        ca2 = RenameColumnsExec(
+            _project_names(s["customer_address"](),
+                           ["ca_address_sk", "ca_state"]),
+            ["ca2_address_sk", "ca2_state"],
+        )
+        j = _join(flavor, j, ca2, ["ss_addr_sk"], ["ca2_address_sk"])
+        j = _join(
+            flavor,
+            FilterExec(
+                s["item"](),
+                InList(Col("i_color"),
+                       (_slit("red"), _slit("navy"), _slit("khaki"))),
+            ),
+            j, ["i_item_sk"], ["ss_item_sk"],
+        )
+        return _agg(
+            j,
+            keys=[(Col("i_product_name"), f"{prefix}_product_name"),
+                  (Col("i_item_sk"), f"{prefix}_item_sk"),
+                  (Col("s_store_name"), f"{prefix}_store_name"),
+                  (Col("s_zip"), f"{prefix}_store_zip")],
+            aggs=[
+                (AggExpr(AggFn.COUNT_STAR, None), f"{prefix}_cnt"),
+                (AggExpr(AggFn.SUM, Col("ss_ext_wholesale_cost")),
+                 f"{prefix}_s1"),
+                (AggExpr(AggFn.SUM, Col("ss_ext_list_price")),
+                 f"{prefix}_s2"),
+                (AggExpr(AggFn.SUM, Col("ss_coupon_amt")),
+                 f"{prefix}_s3"),
+            ],
+        )
+
+    cs1 = cross_sales(1999, "y1")
+    cs2 = cross_sales(2000, "y2")
+    j = _join(
+        flavor, cs1, cs2,
+        ["y1_item_sk", "y1_store_name", "y1_store_zip"],
+        ["y2_item_sk", "y2_store_name", "y2_store_zip"],
+    )
+    j = FilterExec(j, Col("y2_cnt") <= Col("y1_cnt"))
+    out = _project_names(
+        j,
+        ["y1_product_name", "y1_store_name", "y1_store_zip",
+         "y1_cnt", "y1_s1", "y2_cnt", "y2_s1"],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("y1_product_name"), True, True),
+         SortKey(Col("y1_store_name"), True, True),
+         SortKey(Col("y1_s1"), True, True)],
+        100,
+    )
+
+
+def q80(s, flavor):
+    """TPC-DS q80: per-channel per-outlet sales/returns/profit for one
+    month of promoted high-ticket items; sales LEFT-join returns, three
+    channels unioned."""
+    dates = FilterExec(
+        s["date_dim"](),
+        (Col("d_year") == 2000) & (Col("d_moy") == 8),
+    )
+    items = FilterExec(s["item"](), Col("i_current_price") > 50.0)
+    promos = FilterExec(s["promotion"](), Col("p_channel_tv") == "N")
+
+    def channel(label, sales_t, ret_t, skeys, rkeys, prefix, rprefix,
+                id_col, ret_amt, ret_loss):
+        j = _join(flavor, s[sales_t](), s[ret_t](), skeys, rkeys,
+                  JoinType.LEFT)
+        j = _join(flavor, dates, j,
+                  ["d_date_sk"], [f"{prefix}_sold_date_sk"])
+        j = _join(flavor, items, j, ["i_item_sk"],
+                  [f"{prefix}_item_sk"])
+        j = _join(flavor, promos, j, ["p_promo_sk"],
+                  [f"{prefix}_promo_sk"])
+        pre = ProjectExec(
+            j,
+            [(_slit(label), "channel"),
+             (Col(id_col).cast(DataType.int64()), "id"),
+             (Col(f"{prefix}_ext_sales_price"), "sales"),
+             (Coalesce((Col(ret_amt),
+                        Literal(0.0, DataType.float64()))), "returns"),
+             (Col(f"{prefix}_net_profit")
+              - Coalesce((Col(ret_loss),
+                          Literal(0.0, DataType.float64()))),
+              "profit")],
+        )
+        return pre
+
+    both = _union([
+        channel("store channel", "store_sales", "store_returns",
+                ["ss_ticket_number", "ss_item_sk"],
+                ["sr_ticket_number", "sr_item_sk"],
+                "ss", "sr", "ss_store_sk",
+                "sr_return_amt", "sr_net_loss"),
+        channel("catalog channel", "catalog_sales", "catalog_returns",
+                ["cs_order_number", "cs_item_sk"],
+                ["cr_order_number", "cr_item_sk"],
+                "cs", "cr", "cs_call_center_sk",
+                "cr_return_amount", "cr_net_loss"),
+        channel("web channel", "web_sales", "web_returns",
+                ["ws_order_number", "ws_item_sk"],
+                ["wr_order_number", "wr_item_sk"],
+                "ws", "wr", "ws_web_site_sk",
+                "wr_return_amt", "wr_net_loss"),
+    ])
+    out = _agg(
+        both,
+        keys=[(Col("channel"), "channel"), (Col("id"), "id")],
+        aggs=[(AggExpr(AggFn.SUM, Col("sales")), "sales"),
+              (AggExpr(AggFn.SUM, Col("returns")), "returns"),
+              (AggExpr(AggFn.SUM, Col("profit")), "profit")],
+    )
+    return _sorted_limit(
+        out,
+        [SortKey(Col("channel"), True, True),
+         SortKey(Col("id"), True, True)],
+        100,
+    )
+
+
+def q85(s, flavor):
+    """TPC-DS q85: web returns linked to their sale rows, double
+    demographics join (refunding + returning person must share marital
+    status), address/state bands OR'd with profit bands, grouped by
+    return reason."""
+    j = _join(
+        flavor, s["web_sales"](), s["web_returns"](),
+        ["ws_order_number", "ws_item_sk"],
+        ["wr_order_number", "wr_item_sk"],
+    )
+    j = _join(flavor, s["web_page"](), j,
+              ["wp_web_page_sk"], ["ws_web_page_sk"])
+    cd1 = RenameColumnsExec(
+        _project_names(
+            s["customer_demographics"](),
+            ["cd_demo_sk", "cd_marital_status", "cd_education_status"],
+        ),
+        ["cd1_demo_sk", "cd1_marital", "cd1_edu"],
+    )
+    j = _join(flavor, cd1, j,
+              ["cd1_demo_sk"], ["wr_refunded_cdemo_sk"])
+    # returning person must match the refunded person's marital status
+    j = _join(
+        flavor, j, s["customer_demographics"](),
+        ["wr_returning_cdemo_sk", "cd1_marital"],
+        ["cd_demo_sk", "cd_marital_status"],
+    )
+    j = _join(flavor, s["customer_address"](), j,
+              ["ca_address_sk"], ["wr_refunded_addr_sk"])
+    j = _join(
+        flavor,
+        FilterExec(s["date_dim"](), Col("d_year") == 2000),
+        j, ["d_date_sk"], ["ws_sold_date_sk"],
+    )
+    j = _join(flavor, s["reason"](), j,
+              ["r_reason_sk"], ["wr_reason_sk"])
+    band = (
+        ((Col("cd1_marital") == "M")
+         & (Col("cd1_edu") == "4 yr Degree")
+         & (Col("ws_sales_price") >= 100.0)
+         & (Col("ws_sales_price") <= 150.0))
+        | ((Col("cd1_marital") == "S")
+           & (Col("cd1_edu") == "College")
+           & (Col("ws_sales_price") >= 50.0)
+           & (Col("ws_sales_price") <= 100.0))
+    )
+    geo = (
+        (InList(Col("ca_state"), (_slit("TN"), _slit("GA")))
+         & (Col("ws_net_profit") >= 100.0))
+        | (InList(Col("ca_state"), (_slit("CA"), _slit("TX")))
+           & (Col("ws_net_profit") >= 50.0))
+    )
+    j = FilterExec(j, band & geo)
+    out = _agg(
+        j,
+        keys=[(Col("r_reason_desc"), "reason")],
+        aggs=[(AggExpr(AggFn.AVG,
+                       Col("ws_quantity").cast(DataType.float64())),
+               "avg_qty"),
+              (AggExpr(AggFn.AVG, Col("wr_refunded_cash")), "avg_cash"),
+              (AggExpr(AggFn.AVG, Col("wr_fee")), "avg_fee")],
+    )
+    return _sorted_limit(
+        out, [SortKey(Col("reason"), True, True)], 100,
+    )
+
+
+QUERIES.update({
+    "q23": q23, "q24": q24, "q54": q54, "q64": q64, "q80": q80,
+    "q85": q85,
 })
