@@ -610,7 +610,10 @@ class HashAggregateExec(PhysicalOp):
                 merging, aug.layout(), force_lexsort=fl, group_cap=gc,
             ),
             (aug.device_buffers(), aug.selection, aug.num_rows),
-            lambda o, ng: (o, host_int(ng)),
+            # keyless: exactly one group, no collision/overflow retry -
+            # skip the blocking scalar sync (a tunnel round trip each)
+            (lambda o, ng: (o, 1)) if not self.keys
+            else (lambda o, ng: (o, host_int(ng))),
             gcap,
         )
         cols: List[Column] = []

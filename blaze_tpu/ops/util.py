@@ -103,6 +103,15 @@ def unify_dictionaries(batches: List[ColumnBatch]) -> List[ColumnBatch]:
                 mapping = np.asarray(
                     pa.compute.index_in(old, value_set=unified).fill_null(0)
                 ).astype(np.int32)
+            # pad the mapping to a power-of-two capacity so the remap
+            # program depends only on (bucket, codes-shape), not the
+            # exact dictionary size — otherwise every distinct
+            # dictionary length compiles a fresh XLA executable
+            # (hundreds over a TPC-DS run; jaxlib's CPU client
+            # segfaults after enough cumulative compilations).
+            pad_cap = 1 << max(0, (len(mapping) - 1)).bit_length()
+            if pad_cap > len(mapping):
+                mapping = np.pad(mapping, (0, pad_cap - len(mapping)))
             c = b.columns[ci]
             new_codes = jnp.take(
                 jnp.asarray(mapping),

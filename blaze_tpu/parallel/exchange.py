@@ -152,8 +152,10 @@ class ShuffleExchangeExec(PhysicalOp):
             # map tasks run concurrently like Spark executor threads
             # (device dispatch is async; host encode/IO overlaps)
             n = child.partition_count
+            from blaze_tpu.runtime.dispatch import task_threads
+
             with cf.ThreadPoolExecutor(
-                max_workers=min(4, max(1, n))
+                max_workers=task_threads(n)
             ) as pool:
                 outputs = list(pool.map(run_map, range(n)))
             self._map_outputs = outputs

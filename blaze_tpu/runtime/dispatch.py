@@ -37,7 +37,13 @@ _counts: Dict[str, int] = {}
 _KERNELS: "collections.OrderedDict[Tuple, Callable]" = (
     collections.OrderedDict()
 )
-_KERNEL_CACHE_CAP = 1024
+# Bounded for executable memory. Entries evicted LRU recompile
+# transparently. BLAZE_KERNEL_CACHE_CAP overrides (0 = unbounded).
+import os as _os
+
+_KERNEL_CACHE_CAP = int(
+    _os.environ.get("BLAZE_KERNEL_CACHE_CAP", 256)
+) or (1 << 30)
 
 
 def record(kind: str, n: int = 1) -> None:
@@ -122,6 +128,21 @@ def kernel_cache_size() -> int:
 
 def clear_kernel_cache() -> None:
     _KERNELS.clear()
+
+
+def task_threads(n_tasks: int, cap: int = 4) -> int:
+    """Concurrency for device-dispatching task pools (exchange map
+    stages, the scheduler). One process shares one device, so threads
+    buy IO/encode overlap, not compute throughput. BLAZE_TASK_THREADS
+    overrides (set to 1 to serialize every device-touching task - the
+    workaround for jaxlib CPU-client races under concurrent
+    compilation, see tests/conftest.py)."""
+    import os
+
+    env = os.environ.get("BLAZE_TASK_THREADS")
+    if env:
+        cap = max(1, int(env))
+    return min(cap, max(1, n_tasks))
 
 
 def device_get(tree: Any) -> Any:

@@ -61,6 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
+    if os.environ.get("BLAZE_DISABLE_NATIVE"):
+        return None
     path = _build_lib()
     if path is None:
         return None

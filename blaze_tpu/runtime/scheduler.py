@@ -46,7 +46,11 @@ def run_plan_parallel(
 
     n = op.partition_count
     results: List[List[pa.RecordBatch]] = [[] for _ in range(n)]
-    with cf.ThreadPoolExecutor(max_workers=max(1, parallelism)) as pool:
+    from blaze_tpu.runtime.dispatch import task_threads
+
+    with cf.ThreadPoolExecutor(
+        max_workers=task_threads(n, cap=max(1, parallelism))
+    ) as pool:
         futs = {pool.submit(task, p): p for p in range(n)}
         for fut in cf.as_completed(futs):
             results[futs[fut]] = fut.result()

@@ -20,21 +20,31 @@ import pytest
 # backend_compile_and_load (reproduced 3/3 on the TPC-DS matrix). The
 # mitigation is compile-cache hygiene: periodically drop every cached
 # executable so the C++ client's live-executable count stays bounded.
-# Cleared jit wrappers transparently recompile, so this trades some
-# recompilation time for a bounded-resource process.
-_CACHE_CLEAR_EVERY = 20
+# jax.clear_caches() alone is NOT enough - the engine's process-wide
+# kernel cache (runtime/dispatch._KERNELS) pins the jit wrappers, and
+# through them the compiled executables, alive. Cleared jit wrappers
+# transparently recompile, so this trades some recompilation time for a
+# bounded-resource process.
+_CACHE_CLEAR_EVERY = 10
 _test_counter = {"n": 0}
 
 
 @pytest.fixture(autouse=True)
 def _compile_cache_hygiene():
     yield
+    import os
+
+    if os.environ.get("BLAZE_NO_CACHE_CLEAR"):
+        return
     _test_counter["n"] += 1
     if _test_counter["n"] % _CACHE_CLEAR_EVERY == 0:
         import gc
 
         import jax
 
+        from blaze_tpu.runtime import dispatch
+
+        dispatch.clear_kernel_cache()
         jax.clear_caches()
         gc.collect()
 
