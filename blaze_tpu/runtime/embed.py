@@ -1,0 +1,86 @@
+"""In-process C-ABI embedding: execute_task behind Arrow C-Data.
+
+The reference's defining boundary is an IN-PROCESS pointer handoff: the
+native engine exports each finished batch as an Arrow C-Data
+`ArrowSchema`/`ArrowArray` pair straight into its embedder's memory
+(exec.rs:233-243 `export_array_into_raw`; consumer side
+FFIHelper.scala:57-130 imports the pair). The socket gateway
+(runtime/gateway.py) proves the same contract over TCP but copies every
+byte; this module is the zero-copy tier: `cpp/blaze_embed.cpp` hosts
+CPython in the embedder process, calls `open_stream`/`export_next`
+here, and pyarrow's `_export_to_c` hands the embedder raw buffer
+pointers plus a release callback - no IPC, no serialization, one
+process.
+
+Contract (mirrors BlazeCallNativeWrapper.nextBatch semantics,
+NativeSupports.scala:285-301):
+  open_stream(blob)              -> opaque stream object
+  export_next(stream, s_ptr, a_ptr) -> 1 batch exported | 0 exhausted
+  on error: raises - the C layer converts to blz_last_error().
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+
+class _Stream:
+    __slots__ = ("it", "current")
+
+    def __init__(self, it: Iterator):
+        self.it = it
+        # the previously exported batch is parked here so its buffers
+        # outlive the consumer's copy window even if the consumer calls
+        # export_next again before invoking the release callback
+        self.current = None
+
+
+def open_stream(blob: bytes) -> _Stream:
+    """Decode a TaskDefinition and start executing it; batches stream
+    out through export_next."""
+    from blaze_tpu.runtime.executor import execute_task
+
+    return _Stream(iter(execute_task(bytes(blob))))
+
+
+def export_next(stream: _Stream, schema_ptr: int, array_ptr: int) -> int:
+    """Export the next batch into caller-allocated ArrowSchema /
+    ArrowArray structs (addresses as ints). Returns 1 if a batch was
+    exported, 0 when the stream is exhausted."""
+    rb = next(stream.it, None)
+    if rb is None:
+        stream.current = None
+        return 0
+    # ownership note: _export_to_c moves ownership of the buffers into
+    # the C structs; pyarrow keeps them alive until the consumer calls
+    # the embedded release callback, so `current` is belt-and-braces for
+    # consumers that defer the release past the next call
+    rb._export_to_c(array_ptr, schema_ptr)
+    stream.current = rb
+    return 1
+
+
+def run_task_checksums(blob: bytes) -> list:
+    """Debug/parity helper: execute the same blob in-process and return
+    per-column float checksums (sum of valid values; dictionary columns
+    sum their codes) - what cpp/blaze_embed_main.cpp prints, computed
+    the pyarrow way. Tests compare the two."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from blaze_tpu.runtime.executor import execute_task
+
+    sums: Optional[list] = None
+    rows = 0
+    for rb in execute_task(blob):
+        rows += rb.num_rows
+        vals = []
+        for col in rb.columns:
+            if pa.types.is_dictionary(col.type):
+                col = col.indices
+            if pa.types.is_boolean(col.type):
+                col = col.cast(pa.int8())
+            vals.append(float(pc.sum(col).as_py() or 0.0))
+        sums = vals if sums is None else [a + b
+                                          for a, b in zip(sums, vals)]
+    return [rows] + (sums or [])
