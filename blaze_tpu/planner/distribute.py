@@ -1,0 +1,239 @@
+"""Exchange insertion: lower a single-process plan onto the shuffle tier.
+
+The reference never executes a join or final aggregate without an
+exchange underneath - Spark's planner guarantees co-partitioning via
+ArrowShuffleExchangeExec and broadcast via ArrowBroadcastExchangeExec
+(ArrowShuffleExchangeExec301.scala:78, ArrowBroadcastExchangeExec.scala:
+139-256), and the TPC-DS CI exercises every query through those real
+shuffles (tpcds.yml:139-147). This rule is that planner step engine-side:
+
+- sort-merge joins get HASH ShuffleExchangeExec on BOTH children, keyed
+  by the join keys with the same partition count -> co-partitioned,
+  partition-wise join (SURVEY 2.3 "partition-wise join alignment");
+- broadcast hash joins get BroadcastExchangeExec on the build side;
+- COMPLETE hash aggregates split into PARTIAL -> hash exchange on the
+  group keys -> FINAL (keyless: single-partition exchange), the
+  reference's NativeHashAggregateExec mode mapping
+  (NativeHashAggregateExec.scala:98-161);
+- a global Limit(Sort(...)) root coalesces partitions below the sort so
+  top-N stays global.
+
+Exchanges preserve schema exactly, so children are swapped in place and
+bound column indices stay valid.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import AggExpr
+from blaze_tpu.ops import (
+    AggMode,
+    HashAggregateExec,
+    HashJoinExec,
+    LimitExec,
+    SortExec,
+    SortMergeJoinExec,
+)
+from blaze_tpu.ops.base import PhysicalOp
+from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
+from blaze_tpu.ops.union import CoalescePartitionsExec
+from blaze_tpu.parallel.exchange import (
+    BroadcastExchangeExec,
+    ShuffleExchangeExec,
+)
+
+
+def _hash_exchange(child: PhysicalOp, key_indices, num_partitions,
+                   shuffle_dir) -> ShuffleExchangeExec:
+    keys = [
+        ir.BoundCol(i, child.schema.fields[i].dtype)
+        for i in key_indices
+    ]
+    return ShuffleExchangeExec(
+        child, keys, num_partitions, mode="hash",
+        shuffle_dir=shuffle_dir,
+    )
+
+
+def insert_exchanges(op: PhysicalOp, num_partitions: int = 4,
+                     shuffle_dir: Optional[str] = None) -> PhysicalOp:
+    """Rewrite `op` so every join/final-aggregate runs over the shuffle
+    tier. Returns the (possibly new) root."""
+    seen: Dict[int, PhysicalOp] = {}
+    root = _rewrite(op, num_partitions, shuffle_dir, seen)
+    return _fix_global_limit(root)
+
+
+def _rewrite(op: PhysicalOp, n: int, shuffle_dir,
+             seen: Dict[int, PhysicalOp]) -> PhysicalOp:
+    if id(op) in seen:  # shared subtree (CTE reuse): rewrite once
+        return seen[id(op)]
+    seen[id(op)] = op  # break cycles while recursing
+    for i, c in enumerate(op.children):
+        op.children[i] = _rewrite(c, n, shuffle_dir, seen)
+
+    new: PhysicalOp = op
+    if isinstance(op, (SortMergeJoinExec, StreamingSortMergeJoinExec)):
+        from blaze_tpu.ops.joins import JoinType
+
+        if op.join_type is JoinType.LEFT_ANTI_NULL_AWARE:
+            # NAAJ semantics are GLOBAL (any build-side NULL empties the
+            # whole result, joins.py:574); hash bucketing would evaluate
+            # them per partition. Run it single-partition instead.
+            for i in (0, 1):
+                if op.children[i].partition_count > 1:
+                    op.children[i] = CoalescePartitionsExec(
+                        op.children[i]
+                    )
+        else:
+            for i, keys in ((0, op.left_keys), (1, op.right_keys)):
+                ex: PhysicalOp = _hash_exchange(
+                    op.children[i], keys, n, shuffle_dir
+                )
+                if isinstance(op, StreamingSortMergeJoinExec):
+                    # the streaming join's window eviction assumes both
+                    # inputs arrive key-sorted; a hash exchange orders
+                    # by partition id only, so restore sortedness per
+                    # partition (Spark plants the same per-partition
+                    # sort under SMJ after its exchanges)
+                    from blaze_tpu.ops.sort import SortKey
+
+                    ex = SortExec(
+                        ex,
+                        [SortKey(ir.BoundCol(
+                            k, ex.schema.fields[k].dtype
+                        )) for k in keys],
+                    )
+                op.children[i] = ex
+    elif isinstance(op, HashJoinExec):
+        if not getattr(op.children[0], "is_broadcast", False):
+            op.children[0] = BroadcastExchangeExec(op.children[0])
+    elif (
+        isinstance(op, HashAggregateExec)
+        and op.mode is AggMode.COMPLETE
+    ):
+        partial = HashAggregateExec(
+            op.children[0], keys=op.keys, aggs=op.aggs,
+            mode=AggMode.PARTIAL,
+        )
+        if op.keys:
+            exchange: PhysicalOp = _hash_exchange(
+                partial, list(range(len(op.keys))), n, shuffle_dir
+            )
+        else:
+            exchange = ShuffleExchangeExec(
+                partial, [], 1, mode="single", shuffle_dir=shuffle_dir
+            )
+        key_names = [name for _, name in op.keys]
+        new = HashAggregateExec(
+            exchange,
+            keys=[(ir.Col(kn), kn) for kn in key_names],
+            aggs=[(AggExpr(a.fn, None), name) for a, name in op.aggs],
+            mode=AggMode.FINAL,
+        )
+    seen[id(op)] = new
+    return new
+
+
+def lower_to_mesh(op: PhysicalOp, mesh=None) -> PhysicalOp:
+    """Lower aggregate shapes onto the ICI tier: a grouped aggregate
+    whose inputs are slice-resident becomes one `MeshGroupByExec` pjit
+    program (partial agg -> all_to_all key exchange over ICI -> owner
+    merge) instead of a host shuffle. Two recognized shapes:
+
+      FINAL-agg over hash-ShuffleExchange over PARTIAL-agg  (the
+        sandwich insert_exchanges plants; VERDICT r3 item 8)
+      COMPLETE agg  (what a decoded single-stage TaskDefinition carries
+        - the reference splits stages at exchanges, plan.proto has no
+        exchange node, so in-task sandwiches only exist pre-serde)
+
+    tryConvert semantics (BlazeConverters.scala:137-157): any gate
+    failure - string keys, unsupported agg fn, more child partitions
+    than devices, no mesh - leaves the node untouched."""
+    from blaze_tpu.parallel.mesh import device_count
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+
+    if mesh is None and device_count() <= 1:
+        return op
+    seen: Dict[int, PhysicalOp] = {}
+
+    def rewrite(node: PhysicalOp) -> PhysicalOp:
+        if id(node) in seen:
+            return seen[id(node)]
+        seen[id(node)] = node
+        for i, c in enumerate(node.children):
+            node.children[i] = rewrite(c)
+        new = _try_mesh_groupby(node, mesh, MeshGroupByExec)
+        seen[id(node)] = new
+        return new
+
+    return rewrite(op)
+
+
+def _try_mesh_groupby(node: PhysicalOp, mesh, MeshGroupByExec
+                      ) -> PhysicalOp:
+    from blaze_tpu.exprs.ir import AggFn
+
+    shapes = _match_agg_shape(node)
+    if shapes is None:
+        return node
+    child, keys, aggs = shapes
+    supported = {AggFn.SUM, AggFn.COUNT, AggFn.COUNT_STAR,
+                 AggFn.MIN, AggFn.MAX, AggFn.AVG}
+    if any(a.fn not in supported for a, _ in aggs):
+        return node
+    try:
+        mg = MeshGroupByExec(child, keys, aggs, mesh=mesh)
+        if child.partition_count > mg.partition_count:
+            return node
+        return mg
+    except (NotImplementedError, AssertionError):
+        return node  # per-node fallback, reference tryConvert semantics
+
+
+def _match_agg_shape(node: PhysicalOp):
+    """Returns (source_child, keys, complete_aggs) for the two
+    recognized aggregate shapes, else None."""
+    if not isinstance(node, HashAggregateExec) or not node.keys:
+        return None
+    if node.mode is AggMode.COMPLETE:
+        return node.children[0], node.keys, node.aggs
+    if node.mode is not AggMode.FINAL:
+        return None
+    ex = node.children[0]
+    if not isinstance(ex, ShuffleExchangeExec) or ex.mode != "hash":
+        return None
+    partial = ex.children[0]
+    if (not isinstance(partial, HashAggregateExec)
+            or partial.mode is not AggMode.PARTIAL
+            or len(partial.keys) != len(node.keys)):
+        return None
+    # reconstruct the COMPLETE aggregate list: the FINAL node merges
+    # positionally (child=None), the PARTIAL node holds the original
+    # input-bound expressions
+    aggs = [
+        (AggExpr(pa_.fn, pa_.child), name)
+        for (pa_, _), (_, name) in zip(partial.aggs, node.aggs)
+    ]
+    return partial.children[0], partial.keys, aggs
+
+
+def _fix_global_limit(root: PhysicalOp) -> PhysicalOp:
+    """Top-N and global limits must see ONE partition (Spark plants the
+    single-partition exchange the same way for CollectLimit /
+    TakeOrdered)."""
+    if isinstance(root, LimitExec):
+        inner = root.children[0]
+        if isinstance(inner, SortExec):
+            if inner.children[0].partition_count > 1:
+                inner.children[0] = CoalescePartitionsExec(
+                    inner.children[0]
+                )
+        elif inner.partition_count > 1:
+            root.children[0] = CoalescePartitionsExec(inner)
+    elif isinstance(root, SortExec):
+        if root.children[0].partition_count > 1:
+            root.children[0] = CoalescePartitionsExec(root.children[0])
+    return root

@@ -283,3 +283,87 @@ def test_all_to_all_repartition_slack_and_skew_retry():
     for d in range(n_dev):
         if d != 3:
             assert not np.asarray(live_s)[d].any()
+
+
+def test_lower_to_mesh_complete_aggregate():
+    """planner.distribute.lower_to_mesh sends a COMPLETE grouped
+    aggregate (the shape a decoded single-stage TaskDefinition carries)
+    to MeshGroupByExec, and the mesh result matches the per-partition
+    engine result merged in pandas."""
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+    from blaze_tpu.planner.distribute import lower_to_mesh
+
+    scan = multi_partition_scan(n_parts=8, rows_per=300)
+    plan = HashAggregateExec(
+        scan,
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    lowered = lower_to_mesh(plan)
+    assert isinstance(lowered, MeshGroupByExec)
+    got = (
+        run_plan(lowered).to_pandas().sort_values("k")
+        .reset_index(drop=True)
+    )
+    df = run_plan(scan).to_pandas()
+    want = (
+        df.groupby("k").agg(s=("v", "sum"), n=("v", "size"))
+        .reset_index().sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_allclose(got["s"], want["s"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def test_lower_to_mesh_exchange_sandwich_and_fallback():
+    """The FINAL-over-hash-exchange-over-PARTIAL sandwich that
+    insert_exchanges plants lowers to ONE MeshGroupByExec; string-keyed
+    aggregates stay on the file-shuffle tier (tryConvert fallback)."""
+    from blaze_tpu.exprs.ir import AggExpr as _AE
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+    from blaze_tpu.planner.distribute import insert_exchanges, lower_to_mesh
+
+    scan = multi_partition_scan(n_parts=4, rows_per=200)
+    plan = HashAggregateExec(
+        scan,
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.MAX, Col("v")), "m")],
+        mode=AggMode.COMPLETE,
+    )
+    import tempfile
+
+    sandwich = insert_exchanges(plan, 4,
+                                shuffle_dir=tempfile.mkdtemp())
+    # sanity: insert_exchanges really made FINAL / exchange / PARTIAL
+    assert sandwich.mode is AggMode.FINAL
+    lowered = lower_to_mesh(sandwich)
+    assert isinstance(lowered, MeshGroupByExec)
+    got = (
+        run_plan(lowered).to_pandas().sort_values("k")
+        .reset_index(drop=True)
+    )
+    df = run_plan(multi_partition_scan(n_parts=4,
+                                       rows_per=200)).to_pandas()
+    want = (
+        df.groupby("k").agg(s=("v", "sum"), m=("v", "max"))
+        .reset_index().sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_allclose(got["s"], want["s"])
+    np.testing.assert_array_equal(got["m"], want["m"])
+
+    # string keys gate out (host hashing tier): node left untouched
+    strings = pa.record_batch(
+        {"name": pa.array(["a", "b", "a", "c"]).dictionary_encode(),
+         "v": pa.array([1, 2, 3, 4], type=pa.int64())}
+    )
+    cb = ColumnBatch.from_arrow(strings)
+    splan = HashAggregateExec(
+        MemoryScanExec([[cb]], cb.schema),
+        keys=[(Col("name"), "name")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    assert lower_to_mesh(splan) is splan
