@@ -1,0 +1,417 @@
+"""Second, independent differential oracle: stdlib sqlite3.
+
+VERDICT r2 Missing #5: the matrix's pandas oracles live in the same file
+as the engine plans, written by the same author - a shared misreading of
+a query would pass both sides. The reference avoids this by validating
+against a genuinely separate engine (vanilla Spark,
+dev/run-tpcds-test:38-57). This module is that second engine: the same
+synthetic tables are loaded into an in-memory SQLite database (3.40:
+CTEs + window functions) and each query is expressed a THIRD way - as
+SQL - executed by SQLite's own planner/runtime. The test asserts
+sqlite(SQL) == pandas oracle; the main matrix separately asserts
+engine == pandas oracle, so all three formulations must agree.
+
+Coverage: a 22-query cross-section (scan/agg, multi-join, decorrelated
+AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
+whose oracles lean on pandas-specific mechanics stay pandas-only.
+"""
+
+import os
+import sqlite3
+
+import pandas as pd
+import pytest
+
+from tests.tpcds_support import gen_tables
+from tests.test_tpcds_queries import ORACLES, assert_frames_match
+
+# ---------------------------------------------------------------------------
+# SQL formulations (column lists match the oracle outputs positionally)
+# ---------------------------------------------------------------------------
+
+SQL = {}
+
+SQL["q1"] = """
+WITH ctr AS (
+  SELECT sr_customer_sk AS cust, sr_store_sk AS store,
+         SUM(sr_return_amt) AS total
+  FROM store_returns
+  JOIN date_dim ON sr_returned_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY sr_customer_sk, sr_store_sk
+)
+SELECT c_customer_id
+FROM ctr
+JOIN (SELECT store AS s2, AVG(total) AS avg_r FROM ctr
+      WHERE store IS NOT NULL GROUP BY store) ON store = s2
+JOIN store ON store = s_store_sk AND s_state = 'TN'
+JOIN customer ON cust = c_customer_sk
+WHERE total > 1.2 * avg_r
+ORDER BY c_customer_id LIMIT 100
+"""
+
+SQL["q3"] = """
+SELECT d_year, i_brand_id AS brand_id, i_brand AS brand,
+       SUM(ss_ext_sales_price) AS sum_agg
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_moy = 11
+JOIN item ON ss_item_sk = i_item_sk AND i_manufact_id = 128
+GROUP BY d_year, i_brand_id, i_brand
+ORDER BY d_year, sum_agg DESC, brand_id LIMIT 100
+"""
+
+SQL["q6"] = """
+SELECT ca_state AS state, COUNT(*) AS cnt
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk
+JOIN item ON ss_item_sk = i_item_sk
+JOIN customer ON ss_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE d_month_seq IN (SELECT DISTINCT d_month_seq FROM date_dim
+                      WHERE d_year = 1999 AND d_moy = 1)
+  AND i_current_price > 1.2 * (
+      SELECT AVG(i_current_price) FROM item i2
+      WHERE i2.i_category = item.i_category)
+GROUP BY ca_state
+HAVING COUNT(*) >= 10
+ORDER BY cnt, state LIMIT 100
+"""
+
+SQL["q7"] = """
+SELECT i_item_id, AVG(ss_quantity) AS agg1, AVG(ss_list_price) AS agg2,
+       AVG(ss_coupon_amt) AS agg3, AVG(ss_sales_price) AS agg4
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 2000
+JOIN customer_demographics ON ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+JOIN promotion ON ss_promo_sk = p_promo_sk
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+JOIN item ON ss_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q13"] = """
+SELECT AVG(ss_quantity) AS avg_qty, AVG(ss_ext_sales_price) AS avg_esp,
+       AVG(ss_ext_wholesale_cost) AS avg_wc,
+       SUM(ss_ext_wholesale_cost) AS sum_wc
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 2000
+JOIN customer_demographics ON ss_cdemo_sk = cd_demo_sk
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'College')
+    OR (cd_marital_status = 'S' AND cd_education_status = 'Primary'))
+JOIN store ON ss_store_sk = s_store_sk
+WHERE (ss_sales_price BETWEEN 50.0 AND 150.0)
+   OR (ss_sales_price BETWEEN 10.0 AND 60.0)
+"""
+
+SQL["q15"] = """
+SELECT ca_zip, SUM(cs_ext_sales_price) AS s
+FROM catalog_sales
+JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  AND d_year = 1999 AND d_moy BETWEEN 1 AND 3
+JOIN customer ON cs_bill_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE substr(ca_zip, 1, 5) IN
+        ('85669', '86197', '88274', '83405', '86475')
+   OR ca_state IN ('CA', 'GA')
+   OR cs_ext_sales_price > 500.0
+GROUP BY ca_zip ORDER BY ca_zip LIMIT 100
+"""
+
+SQL["q19"] = """
+SELECT i_brand_id AS brand_id, i_brand AS brand,
+       SUM(ss_ext_sales_price) AS ext_price
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  AND d_year = 1999 AND d_moy = 11
+JOIN item ON ss_item_sk = i_item_sk AND i_manager_id <= 20
+JOIN customer ON ss_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+JOIN store ON ss_store_sk = s_store_sk
+WHERE substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+GROUP BY i_brand_id, i_brand
+ORDER BY ext_price DESC, brand_id LIMIT 100
+"""
+
+SQL["q25"] = """
+SELECT i_item_id, SUM(ss_net_profit) AS store_profit,
+       SUM(sr_net_loss) AS return_loss,
+       SUM(cs_ext_sales_price) AS catalog_sales
+FROM catalog_sales
+JOIN store_returns ON cs_bill_customer_sk = sr_customer_sk
+  AND cs_item_sk = sr_item_sk
+JOIN store_sales ON sr_customer_sk = ss_customer_sk
+  AND sr_item_sk = ss_item_sk
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1998
+JOIN item ON ss_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q26"] = """
+SELECT i_item_id, AVG(cs_quantity) AS agg1, AVG(cs_list_price) AS agg2,
+       AVG(cs_coupon_amt) AS agg3, AVG(cs_sales_price) AS agg4
+FROM catalog_sales
+JOIN date_dim ON cs_sold_date_sk = d_date_sk AND d_year = 2000
+JOIN customer_demographics ON cs_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'F' AND cd_marital_status = 'M'
+  AND cd_education_status = '4 yr Degree'
+JOIN promotion ON cs_promo_sk = p_promo_sk
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+JOIN item ON cs_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q29"] = """
+SELECT i_item_id, SUM(ss_quantity) AS store_qty, COUNT(*) AS paths
+FROM catalog_sales
+JOIN store_returns ON cs_bill_customer_sk = sr_customer_sk
+  AND cs_item_sk = sr_item_sk
+JOIN store_sales ON sr_customer_sk = ss_customer_sk
+  AND sr_item_sk = ss_item_sk
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+JOIN item ON ss_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q42"] = """
+SELECT d_year, i_category, SUM(ss_ext_sales_price) AS total
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  AND d_year = 1999 AND d_moy = 11
+JOIN item ON ss_item_sk = i_item_sk AND i_manager_id = 1
+GROUP BY d_year, i_category
+ORDER BY total DESC, d_year, i_category LIMIT 100
+"""
+
+SQL["q43"] = """
+SELECT s_store_name,
+  SUM(CASE WHEN d_day_name = 'Sunday' THEN ss_ext_sales_price END)
+    AS sun_sales,
+  SUM(CASE WHEN d_day_name = 'Monday' THEN ss_ext_sales_price END)
+    AS mon_sales,
+  SUM(CASE WHEN d_day_name = 'Tuesday' THEN ss_ext_sales_price END)
+    AS tue_sales,
+  SUM(CASE WHEN d_day_name = 'Wednesday' THEN ss_ext_sales_price END)
+    AS wed_sales,
+  SUM(CASE WHEN d_day_name = 'Thursday' THEN ss_ext_sales_price END)
+    AS thu_sales,
+  SUM(CASE WHEN d_day_name = 'Friday' THEN ss_ext_sales_price END)
+    AS fri_sales,
+  SUM(CASE WHEN d_day_name = 'Saturday' THEN ss_ext_sales_price END)
+    AS sat_sales
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+JOIN store ON ss_store_sk = s_store_sk
+GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
+"""
+
+_BRAND_MONTH = """
+SELECT i_brand_id AS brand_id, i_brand AS brand,
+       SUM(ss_ext_sales_price) AS ext_price
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 12
+JOIN item ON ss_item_sk = i_item_sk AND ({cond})
+GROUP BY i_brand_id, i_brand
+ORDER BY ext_price DESC, brand_id LIMIT 100
+"""
+
+SQL["q52"] = _BRAND_MONTH.format(cond="i_manager_id = 1")
+SQL["q55"] = _BRAND_MONTH.format(
+    cond="i_manager_id BETWEEN 20 AND 40")
+
+SQL["q61"] = """
+WITH sales AS (
+  SELECT ss_ext_sales_price AS price, ss_promo_sk
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 11
+  JOIN item ON ss_item_sk = i_item_sk AND i_category = 'Books'
+)
+SELECT
+  (SELECT SUM(price) FROM sales
+   JOIN promotion ON ss_promo_sk = p_promo_sk
+   WHERE p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+      OR p_channel_tv = 'Y') AS promotions,
+  (SELECT SUM(price) FROM sales) AS total,
+  (SELECT SUM(price) FROM sales
+   JOIN promotion ON ss_promo_sk = p_promo_sk
+   WHERE p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+      OR p_channel_tv = 'Y') * 100.0
+    / (SELECT SUM(price) FROM sales) AS pct
+"""
+
+SQL["q79"] = """
+SELECT c_last_name, c_first_name, s_city, profit, ss_ticket_number, amt
+FROM (
+  SELECT ss_ticket_number, ss_customer_sk, s_city,
+         SUM(ss_coupon_amt) AS amt, SUM(ss_net_profit) AS profit
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_dow = 1 AND d_year BETWEEN 1998 AND 2000
+  JOIN store ON ss_store_sk = s_store_sk
+  JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+    AND (hd_dep_count = 6 OR hd_vehicle_count > 2)
+  GROUP BY ss_ticket_number, ss_customer_sk, s_city
+)
+JOIN customer ON ss_customer_sk = c_customer_sk
+ORDER BY c_last_name, c_first_name, s_city, profit, ss_ticket_number
+LIMIT 100
+"""
+
+SQL["q84"] = """
+SELECT c_customer_id AS customer_id, c_last_name AS customername
+FROM customer
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+  AND ca_city = 'Midway'
+JOIN household_demographics ON c_current_hdemo_sk = hd_demo_sk
+JOIN income_band ON hd_income_band_sk = ib_income_band_sk
+  AND ib_lower_bound >= 30000 AND ib_upper_bound <= 80000
+JOIN customer_demographics ON c_current_cdemo_sk = cd_demo_sk
+JOIN store_returns ON cd_demo_sk = sr_cdemo_sk
+ORDER BY customer_id LIMIT 100
+"""
+
+_Q88_BAND = """
+  (SELECT COUNT(*) FROM store_sales
+   JOIN time_dim ON ss_sold_time_sk = t_time_sk
+     AND (t_hour > {h1} OR (t_hour = {h1} AND t_minute >= {m1}))
+     AND (t_hour < {h2} OR (t_hour = {h2} AND t_minute < {m2}))
+   JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+     AND hd_dep_count = {dep}
+   JOIN store ON ss_store_sk = s_store_sk
+     AND s_store_name = 'store_0') AS {name}
+"""
+
+SQL["q88"] = "SELECT\n" + ",\n".join(
+    _Q88_BAND.format(h1=h1, m1=m1, h2=h2, m2=m2, dep=dep, name=name)
+    for (h1, m1, h2, m2, dep), name in zip(
+        [(8, 30, 9, 0, 4), (9, 0, 9, 30, 3), (9, 30, 10, 0, 2),
+         (10, 0, 10, 30, 4), (10, 30, 11, 0, 3), (11, 0, 11, 30, 2),
+         (11, 30, 12, 0, 4), (12, 0, 12, 30, 3)],
+        ["h8_30_to_9", "h9_to_9_30", "h9_30_to_10", "h10_to_10_30",
+         "h10_30_to_11", "h11_to_11_30", "h11_30_to_12",
+         "h12_to_12_30"])
+)
+
+SQL["q90"] = """
+SELECT
+  (SELECT COUNT(*) * 1.0 FROM web_sales
+   JOIN time_dim ON ws_sold_time_sk = t_time_sk
+     AND t_hour >= 7 AND t_hour < 9
+   JOIN web_page ON ws_web_page_sk = wp_web_page_sk
+     AND wp_char_count BETWEEN 4500 AND 5500)
+  /
+  (SELECT COUNT(*) FROM web_sales
+   JOIN time_dim ON ws_sold_time_sk = t_time_sk
+     AND t_hour >= 19 AND t_hour < 21
+   JOIN web_page ON ws_web_page_sk = wp_web_page_sk
+     AND wp_char_count BETWEEN 4500 AND 5500) AS am_pm_ratio
+"""
+
+SQL["q91"] = """
+SELECT cc_name, cd_marital_status, cd_education_status,
+       SUM(cr_net_loss) AS net_loss
+FROM catalog_returns
+JOIN date_dim ON cr_returned_date_sk = d_date_sk
+  AND d_year = 1999 AND d_moy = 11
+JOIN call_center ON cr_call_center_sk = cc_call_center_sk
+JOIN customer ON cr_returning_customer_sk = c_customer_sk
+JOIN customer_demographics ON c_current_cdemo_sk = cd_demo_sk
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'College')
+    OR (cd_marital_status = 'S' AND cd_education_status = 'Primary'))
+JOIN household_demographics ON c_current_hdemo_sk = hd_demo_sk
+  AND hd_buy_potential = '>10000'
+GROUP BY cc_name, cd_marital_status, cd_education_status
+ORDER BY net_loss DESC LIMIT 100
+"""
+
+SQL["q92"] = """
+WITH ws AS (
+  SELECT ws_item_sk, ws_ext_discount_amt
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 3
+)
+SELECT SUM(ws_ext_discount_amt) AS excess_discount
+FROM ws
+JOIN (SELECT ws_item_sk AS tk,
+             AVG(ws_ext_discount_amt) * 1.3 AS threshold
+      FROM ws GROUP BY ws_item_sk) ON ws_item_sk = tk
+WHERE ws_ext_discount_amt > threshold
+"""
+
+SQL["q93"] = """
+SELECT ss_customer_sk, SUM(act_sales) AS sumsales
+FROM (
+  SELECT ss_customer_sk,
+         CASE WHEN r_reason_desc = 'reason 3'
+              THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+              ELSE ss_quantity * ss_sales_price END AS act_sales
+  FROM store_sales
+  LEFT JOIN (SELECT sr_ticket_number, sr_item_sk, sr_return_quantity,
+                    r_reason_desc
+             FROM store_returns
+             JOIN reason ON sr_reason_sk = r_reason_sk)
+    ON ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+)
+GROUP BY ss_customer_sk
+ORDER BY sumsales, ss_customer_sk LIMIT 100
+"""
+
+SQL["q96"] = """
+SELECT COUNT(*) AS cnt
+FROM store_sales
+JOIN time_dim ON ss_sold_time_sk = t_time_sk
+  AND t_hour = 20 AND t_minute >= 30
+JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+  AND hd_dep_count = 6
+JOIN store ON ss_store_sk = s_store_sk AND s_store_name = 'store_1'
+"""
+
+SQL["q99"] = """
+SELECT w_warehouse_name, sm_type, cc_name,
+  SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+           THEN 1 ELSE 0 END) AS d30,
+  SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+            AND cs_ship_date_sk - cs_sold_date_sk <= 60
+           THEN 1 ELSE 0 END) AS d60,
+  SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+            AND cs_ship_date_sk - cs_sold_date_sk <= 90
+           THEN 1 ELSE 0 END) AS d90,
+  SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 90
+            AND cs_ship_date_sk - cs_sold_date_sk <= 120
+           THEN 1 ELSE 0 END) AS d120,
+  SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 120
+           THEN 1 ELSE 0 END) AS dmore
+FROM catalog_sales
+JOIN date_dim ON cs_ship_date_sk = d_date_sk AND d_year = 1999
+JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+JOIN ship_mode ON cs_ship_mode_sk = sm_ship_mode_sk
+JOIN call_center ON cs_call_center_sk = cc_call_center_sk
+GROUP BY w_warehouse_name, sm_type, cc_name
+ORDER BY w_warehouse_name, sm_type, cc_name LIMIT 100
+"""
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def db():
+    tables = gen_tables()
+    conn = sqlite3.connect(":memory:")
+    for name, df in tables.items():
+        df.to_sql(name, conn, index=False)
+    yield tables, conn
+    conn.close()
+
+
+@pytest.mark.parametrize("q", sorted(SQL, key=lambda s: int(s[1:])))
+def test_sqlite_agrees_with_pandas_oracle(db, q):
+    tables, conn = db
+    got = pd.read_sql_query(SQL[q], conn)
+    exp = ORACLES[q](tables)
+    got.columns = list(exp.columns)
+    assert_frames_match(got, exp, f"{q}/sqlite")
