@@ -30,6 +30,33 @@ from blaze_tpu.ops.ipc_writer import collect_ipc
 from blaze_tpu.ops.shuffle_writer import ShuffleWriterExec
 
 
+class _SampledReplay(PhysicalOp):
+    """One-shot child stand-in for a range map task: yields the batches
+    the sampling pass already pulled, then resumes the same iterator -
+    so the child subtree runs once overall instead of once for the
+    sample and once for the map."""
+
+    def __init__(self, child: PhysicalOp, partition: int,
+                 consumed: list, it):
+        self.children = [child]
+        self._partition = partition
+        self._consumed = consumed
+        self._it = it
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def partition_count(self) -> int:
+        return self.children[0].partition_count
+
+    def execute(self, partition: int, ctx: ExecContext):
+        assert partition == self._partition
+        yield from self._consumed
+        yield from self._it
+
+
 class ShuffleExchangeExec(PhysicalOp):
     """Full repartitioning exchange (reference
     ArrowShuffleExchangeExec301.scala): hash / single / round_robin."""
@@ -52,6 +79,7 @@ class ShuffleExchangeExec(PhysicalOp):
         )
         self._map_outputs: Optional[List[Tuple[str, str]]] = None
         self._range_bounds: Optional[List[Tuple]] = None
+        self._sample_replay: dict = {}
         self._lock = threading.Lock()
 
     def _compute_range_bounds(self, ctx: ExecContext) -> List[Tuple]:
@@ -69,12 +97,16 @@ class ShuffleExchangeExec(PhysicalOp):
 
         child = self.children[0]
         frames = []
+        self._sample_replay = {}
         for p in range(child.partition_count):
             taken = 0
-            for cb in child.execute(p, ctx):
+            consumed = []
+            it = child.execute(p, ctx)
+            for cb in it:
                 from blaze_tpu.ops.util import ensure_compacted
 
                 cb = ensure_compacted(cb)
+                consumed.append(cb)
                 if cb.num_rows == 0:
                     continue
                 rb = cb.to_arrow()
@@ -86,6 +118,11 @@ class ShuffleExchangeExec(PhysicalOp):
                 taken += cb.num_rows
                 if taken >= self.SAMPLE_ROWS_PER_PARTITION:
                     break
+            # the map stage replays what the sample pass already pulled
+            # and continues the same iterator - the child subtree is
+            # executed ONCE, not twice (Spark re-runs the scan for its
+            # sample job; we keep the batches, they are already here)
+            self._sample_replay[p] = (consumed, it)
         sample = (
             pd.concat(frames, ignore_index=True)
             if frames
@@ -135,8 +172,16 @@ class ShuffleExchangeExec(PhysicalOp):
                 last_err = None
                 for attempt in range(self.MAX_TASK_ATTEMPTS):
                     try:
+                        # first attempt of a range map task resumes the
+                        # sample pass's iterator (one child execution
+                        # total); a retry pops nothing and re-executes
+                        # the child from scratch
+                        src = child
+                        replay = self._sample_replay.pop(map_id, None)
+                        if replay is not None:
+                            src = _SampledReplay(child, map_id, *replay)
                         writer = ShuffleWriterExec(
-                            child, self.keys, self.num_partitions,
+                            src, self.keys, self.num_partitions,
                             data, index, self.mode,
                             range_bounds=bounds,
                             sort_ascending=self.sort_ascending,
