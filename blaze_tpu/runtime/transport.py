@@ -59,6 +59,11 @@ class _Handler(socketserver.BaseRequestHandler):
             offset, length = _REQ_RANGE.unpack(
                 _recv_exact(self.request, _REQ_RANGE.size)
             )
+            if offset < 0:  # stat request: size in the length field,
+                # no payload (offset is never negative for reads)
+                size = server.stat(path)
+                self.request.sendall(_RESP_HEAD.pack(0, size))
+                return
             f, total = server.open_range(path, offset, length)
         except Exception:
             try:
@@ -128,6 +133,14 @@ class BlockServer:
         with f:
             return f.read(total)
 
+    def stat(self, path: str) -> int:
+        real = os.path.realpath(path)
+        if not any(
+            real == r or real.startswith(r + os.sep) for r in self.roots
+        ):
+            raise PermissionError(f"{path} outside served roots")
+        return os.path.getsize(real)
+
 
 class _SocketStream(io.RawIOBase):
     """File-like over the response payload; feeds decode_ipc_stream the
@@ -183,6 +196,23 @@ def open_remote_stream(seg: RemoteSegment,
     except Exception:
         sock.close()
         raise
+
+
+def remote_stat(host: str, port: int, path: str,
+                timeout: float = 60.0) -> int:
+    """File size over the block protocol (offset=-1 stat request)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        p = path.encode("utf-8")
+        sock.sendall(_REQ_HEAD.pack(len(p)) + p + _REQ_RANGE.pack(-1, 0))
+        status, size = _RESP_HEAD.unpack(
+            _recv_exact(sock, _RESP_HEAD.size)
+        )
+        if status != 0:
+            raise IOError(f"stat failed: {path}")
+        return size
+    finally:
+        sock.close()
 
 
 def iter_remote_batches(seg: RemoteSegment):
