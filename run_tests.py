@@ -19,9 +19,11 @@ each chunk a fresh pytest subprocess, so no process crosses the
 compile-volume cliff and one crash cannot take out the run. Exit code 0
 iff every chunk passed.
 
-Usage: python run_tests.py [--rows N] [--fast]
+Usage: python run_tests.py [--rows N] [--fast] [--scale]
   --rows N   BLAZE_TPCDS_ROWS for the matrices (default: env or 200000)
   --fast     20k-row matrices (quick signal, ~3x faster)
+  --scale    additionally run a 6-query subset at 2M store_sales rows
+             (the reference CI's 1GB-dataset class, tpcds.yml:119-121)
 """
 
 import argparse
@@ -105,6 +107,7 @@ def main():
                     default=int(os.environ.get("BLAZE_TPCDS_ROWS",
                                                200_000)))
     ap.add_argument("--fast", action="store_true")
+    ap.add_argument("--scale", action="store_true")
     args = ap.parse_args()
     rows = 20_000 if args.fast else args.rows
 
@@ -138,6 +141,18 @@ def main():
              k_expr(group, suffixed=False)],
             rows=min(rows, 20_000),
         )
+
+    if args.scale:
+        # 2M store_sales rows (returns/web/catalog proportional) - the
+        # reference CI's 1GB-dataset tier; monsters included
+        scale_qs = ["q3", "q7", "q23", "q64", "q80", "q94"]
+        for group in chunks(scale_qs, 2):
+            ok &= run(
+                f"scale 2M {group[0]}..{group[-1]}",
+                ["tests/test_tpcds_queries.py", "-k",
+                 k_expr(group, suffixed=True)],
+                rows=2_000_000,
+            )
 
     print(f"\n{'GREEN' if ok else 'RED'} in {time.time() - t0:.0f}s")
     return 0 if ok else 1
