@@ -183,6 +183,11 @@ def murmur3_strings_chain(arr, hashes: np.ndarray) -> np.ndarray:
             hashes[i] = np.uint32(hash_bytes_host(b, int(hashes[i])))
         return hashes
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.dictionary_decode()
+    if pa.types.is_large_string(arr.type):
+        # the C walk reads int32 offsets; large_string carries int64
+        arr = arr.cast(pa.string())
     if arr.offset != 0:
         arr = pa.concat_arrays([arr])  # re-materialize at offset 0
     bufs = arr.buffers()
