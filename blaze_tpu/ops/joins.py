@@ -168,9 +168,19 @@ class _JoinCore:
         cap = self.build.capacity
 
         def build():
-            def kernel(values, valids):
+            def kernel(values, valids, num_rows):
                 cols = list(zip(values, valids, dtypes))
                 h = hash_columns_device(cols, cap).astype(jnp.int32)
+                # padding rows must not enter the index: a build table
+                # well under its shape bucket would otherwise
+                # contribute cap-num_rows phantom candidates per probe
+                # row whose key equals the padding value (observed 11x
+                # pair expansion on a 131k-row dim table in a 1M
+                # bucket). INT32_MAX herds them into one run at the
+                # top; a genuine probe hash there still verifies by
+                # exact key + liveness in emit_pairs.
+                live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                h = jnp.where(live, h, jnp.int32(0x7FFFFFFF))
                 order = jnp.argsort(h, stable=True)
                 return jnp.take(h, order), order
 
@@ -178,7 +188,8 @@ class _JoinCore:
 
         fn = cached_kernel(("join_index", dtypes, cap), build)
         self._index = fn(
-            tuple(v for v, _, _ in bufs), tuple(m for _, m, _ in bufs)
+            tuple(v for v, _, _ in bufs), tuple(m for _, m, _ in bufs),
+            self.build.num_rows,
         )
 
     def probe(self, probe_cb: ColumnBatch, probe_keys: List[int]):
