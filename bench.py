@@ -121,8 +121,14 @@ def main():
         if platform is not None:
             break
         errors.append(err)
+        if err and "timed out" in err:
+            # a hung tunnel hangs every probe; don't burn the whole
+            # retry budget at PROBE_TIMEOUT a pop
+            break
     res = None
-    degraded = False
+    # a default backend of "cpu" means the chip never registered -
+    # that IS the degraded path even though the probe "succeeded"
+    degraded = platform is None or platform == "cpu"
     if platform is not None:
         res, err = run_child()
         if res is None:

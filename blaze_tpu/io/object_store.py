@@ -131,10 +131,14 @@ class RemoteBlockStore(ObjectStore):
     def _with_retries(self, fn):
         import time
 
+        from blaze_tpu.runtime.transport import BlockProtocolError
+
         last = None
         for attempt in range(self.retries):
             try:
                 return fn()
+            except (BlockProtocolError, PermissionError):
+                raise  # deterministic: a retry cannot fix these
             except (ConnectionError, TimeoutError, OSError) as e:
                 last = e
                 time.sleep(self.base_delay * (2 ** attempt))

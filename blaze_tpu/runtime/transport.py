@@ -47,6 +47,11 @@ class RemoteSegment:
 _CHUNK = 1 << 20
 
 
+class BlockProtocolError(IOError):
+    """Server answered with an error status - deterministic (bad path,
+    scoping violation), so callers must NOT retry it."""
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: BlockServer = self.server.block_server  # type: ignore
@@ -189,7 +194,7 @@ def open_remote_stream(seg: RemoteSegment,
         head = _recv_exact(sock, _RESP_HEAD.size)
         status, length = _RESP_HEAD.unpack(head)
         if status != 0:
-            raise IOError(
+            raise BlockProtocolError(
                 f"block fetch failed: {seg.path}@{seg.offset}"
             )
         return _SocketStream(sock, length)
@@ -209,7 +214,7 @@ def remote_stat(host: str, port: int, path: str,
             _recv_exact(sock, _RESP_HEAD.size)
         )
         if status != 0:
-            raise IOError(f"stat failed: {path}")
+            raise BlockProtocolError(f"stat failed: {path}")
         return size
     finally:
         sock.close()
