@@ -39,6 +39,7 @@ from blaze_tpu.ops import (
 from blaze_tpu.ops.base import PhysicalOp
 from blaze_tpu.ops.streaming_smj import StreamingSortMergeJoinExec
 from blaze_tpu.ops.union import CoalescePartitionsExec
+from blaze_tpu.ops.window import WindowExec
 from blaze_tpu.parallel.exchange import (
     BroadcastExchangeExec,
     ShuffleExchangeExec,
@@ -110,6 +111,28 @@ def _rewrite(op: PhysicalOp, n: int, shuffle_dir,
     elif isinstance(op, HashJoinExec):
         if not getattr(op.children[0], "is_broadcast", False):
             op.children[0] = BroadcastExchangeExec(op.children[0])
+    elif isinstance(op, WindowExec):
+        child = op.children[0]
+        if child.partition_count > 1:
+            if op.partition_by and all(
+                isinstance(e, ir.BoundCol) for e in op.partition_by
+            ):
+                # Spark plants a hash exchange on the window's
+                # PARTITION BY so each frame is computed whole
+                op.children[0] = _hash_exchange(
+                    child, [e.index for e in op.partition_by], n,
+                    shuffle_dir,
+                )
+            else:
+                # no partition keys (global frames): single partition
+                op.children[0] = CoalescePartitionsExec(child)
+    elif isinstance(op, SortExec):
+        # a pre-existing sort in the plan is a GLOBAL ordering
+        # requirement (top-n inputs, order-sensitive windows); sorts
+        # this pass itself plants under streaming SMJ are created after
+        # recursion and are never revisited, so they stay per-partition
+        if op.children[0].partition_count > 1:
+            op.children[0] = CoalescePartitionsExec(op.children[0])
     elif (
         isinstance(op, HashAggregateExec)
         and op.mode is AggMode.COMPLETE

@@ -35,7 +35,10 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 TPCDS_CHUNK = 12
-EXCHANGE_CHUNK = 5
+# exchange queries compile far more programs per test (4-partition maps,
+# spills, readers); 5 monster queries in one process crossed the
+# compile-volume cliff in the first green-run attempt - 2 stays clear
+EXCHANGE_CHUNK = 2
 
 
 def tpcds_query_names():

@@ -31,12 +31,20 @@ from blaze_tpu.runtime.executor import run_plan
 from tests.tpcds_support import QUERIES, gen_tables
 from tests.test_tpcds_queries import ORACLES, assert_frames_match
 
-# join/agg-heavy, window-free subset (windows need their own partition
-# alignment and stay single-partition in this engine)
+# join/agg-heavy subset plus window/sort queries (insert_exchanges
+# hash-partitions windows on their PARTITION BY and keeps global sorts
+# single-partition, mirroring Spark's required-distribution planning)
 EXCHANGE_QUERIES = [
     "q1", "q2", "q3", "q5", "q6", "q7", "q8", "q13", "q15", "q19",
     "q23", "q24", "q25", "q26", "q29", "q54", "q64", "q80", "q81",
     "q83", "q84", "q85", "q91", "q94", "q95",
+    # window / global-sort shapes. q67/q86 are excluded: their RANK
+    # orders by a float SUM whose value depends on summation order, and
+    # exchange partitioning changes that order - near-equal sums flip
+    # ranks nondeterministically (the in-memory matrix still covers
+    # both; Spark's own validator rounds results for the same reason).
+    "q12", "q20", "q36", "q44", "q47", "q49", "q51", "q53", "q57",
+    "q63", "q70", "q89", "q98",
 ]
 
 N_EXCHANGE_PARTITIONS = 4
