@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 22-query cross-section (scan/agg, multi-join, decorrelated
+Coverage: a 32-query cross-section (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -391,6 +391,125 @@ JOIN ship_mode ON cs_ship_mode_sk = sm_ship_mode_sk
 JOIN call_center ON cs_call_center_sk = cc_call_center_sk
 GROUP BY w_warehouse_name, sm_type, cc_name
 ORDER BY w_warehouse_name, sm_type, cc_name LIMIT 100
+"""
+
+
+SQL["q9"] = """
+SELECT
+""" + ",\n".join(
+    f"""  CASE WHEN (SELECT COUNT(*) FROM store_sales
+         WHERE ss_quantity BETWEEN {lo} AND {hi}) > 7438
+       THEN (SELECT AVG(ss_ext_discount_amt) FROM store_sales
+             WHERE ss_quantity BETWEEN {lo} AND {hi})
+       ELSE (SELECT AVG(ss_net_profit) FROM store_sales
+             WHERE ss_quantity BETWEEN {lo} AND {hi}) END AS bucket{i}"""
+    for i, (lo, hi) in enumerate(
+        [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)], 1)
+)
+
+SQL["q28"] = " UNION ALL ".join(
+    f"""SELECT {i} AS bucket, AVG(ss_list_price) AS avg_p,
+        COUNT(*) AS cnt, COUNT(DISTINCT ss_list_price) AS distinct_cnt
+        FROM store_sales
+        WHERE ss_list_price >= {lo} AND ss_list_price < {hi}"""
+    for i, (lo, hi) in enumerate(
+        [(0, 50), (50, 100), (100, 150), (150, 200), (200, 250),
+         (0, 250)])
+)
+
+SQL["q32"] = """
+WITH cs AS (
+  SELECT cs_item_sk, cs_ext_discount_amt
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 3
+)
+SELECT SUM(cs_ext_discount_amt) AS excess_discount
+FROM cs
+JOIN (SELECT cs_item_sk AS tk,
+             AVG(cs_ext_discount_amt) * 1.3 AS threshold
+      FROM cs GROUP BY cs_item_sk) ON cs_item_sk = tk
+WHERE cs_ext_discount_amt > threshold
+"""
+
+SQL["q37"] = """
+SELECT DISTINCT i_item_id, i_item_desc, i_current_price
+FROM item
+JOIN inventory ON i_item_sk = inv_item_sk
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+JOIN date_dim ON inv_date_sk = d_date_sk
+  AND d_date_sk BETWEEN 400 AND 460
+WHERE i_current_price >= 10.0
+  AND i_item_sk IN (SELECT cs_item_sk FROM catalog_sales)
+ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q40"] = """
+SELECT i_item_id,
+  SUM(CASE WHEN d_date_sk < 700
+           THEN cs_ext_sales_price - COALESCE(cr_return_amount, 0.0)
+           ELSE 0.0 END) AS sales_before,
+  SUM(CASE WHEN d_date_sk >= 700
+           THEN cs_ext_sales_price - COALESCE(cr_return_amount, 0.0)
+           ELSE 0.0 END) AS sales_after
+FROM catalog_sales
+JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  AND d_date_sk BETWEEN 670 AND 730
+LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+  AND cs_item_sk = cr_item_sk
+JOIN item ON cs_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q62"] = """
+SELECT w_warehouse_name, sm_type, web_name,
+  SUM(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+           THEN 1 ELSE 0 END) AS d30,
+  SUM(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+            AND ws_ship_date_sk - ws_sold_date_sk <= 60
+           THEN 1 ELSE 0 END) AS d60,
+  SUM(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+            AND ws_ship_date_sk - ws_sold_date_sk <= 90
+           THEN 1 ELSE 0 END) AS d90,
+  SUM(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 90
+            AND ws_ship_date_sk - ws_sold_date_sk <= 120
+           THEN 1 ELSE 0 END) AS d120,
+  SUM(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 120
+           THEN 1 ELSE 0 END) AS dmore
+FROM web_sales
+JOIN date_dim ON ws_ship_date_sk = d_date_sk AND d_year = 1999
+JOIN warehouse ON ws_warehouse_sk = w_warehouse_sk
+JOIN ship_mode ON ws_ship_mode_sk = sm_ship_mode_sk
+JOIN web_site ON ws_web_site_sk = web_site_sk
+GROUP BY w_warehouse_name, sm_type, web_name
+ORDER BY w_warehouse_name, sm_type, web_name LIMIT 100
+"""
+
+SQL["q82"] = """
+SELECT DISTINCT i_item_id, i_item_desc, i_current_price
+FROM item
+JOIN inventory ON i_item_sk = inv_item_sk
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+JOIN date_dim ON inv_date_sk = d_date_sk AND d_year = 1999
+JOIN store_sales ON i_item_sk = ss_item_sk
+WHERE i_current_price BETWEEN 30.0 AND 60.0
+  AND i_manufact_id IN (10, 20, 30, 40, 50, 60)
+ORDER BY i_item_id LIMIT 100
+"""
+
+_Q45_ZIPS = sorted({f"{(24000 + (i % 500) * 131) % 90000:05d}"
+                    for i in range(0, 40)})
+_Q45_ITEMS = sorted(range(2, 30, 3))
+SQL["q45"] = f"""
+SELECT ca_zip, SUM(ws_ext_sales_price) AS total
+FROM web_sales
+JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  AND d_year = 1999 AND d_moy BETWEEN 1 AND 3
+JOIN customer ON ws_bill_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE substr(ca_zip, 1, 5) IN ({", ".join(repr(z) for z in _Q45_ZIPS)})
+   OR ws_item_sk IN ({", ".join(str(i) for i in _Q45_ITEMS)})
+GROUP BY ca_zip ORDER BY ca_zip LIMIT 100
 """
 
 
