@@ -69,34 +69,46 @@ class IpcReaderExec(PhysicalOp):
             provider(partition) if callable(provider)
             else provider[partition]
         )
+        from blaze_tpu.runtime.prefetch import prefetch
         from blaze_tpu.runtime.transport import (
             RemoteSegment,
             iter_remote_batches,
         )
 
-        rows = 0
-        for src in sources:
-            if isinstance(src, RemoteSegment):
-                # remote block streamed off another host's BlockServer
-                # (reference remote-fetch path, ipc_reader_exec.rs:283-326)
-                for rb in iter_remote_batches(src):
+        def batches() -> Iterator[ColumnBatch]:
+            rows = 0
+            for src in sources:
+                if isinstance(src, RemoteSegment):
+                    # remote block streamed off another host's
+                    # BlockServer (reference remote-fetch path,
+                    # ipc_reader_exec.rs:283-326)
+                    for rb in iter_remote_batches(src):
+                        rows += rb.num_rows
+                        yield ColumnBatch.from_arrow(rb)
+                    continue
+                if isinstance(src, FileSegment):
+                    it = read_file_segment(
+                        src.path, src.offset, src.length
+                    )
+                elif isinstance(src, (bytes, bytearray, memoryview)):
+                    it = decode_ipc_parts(bytes(src))
+                elif isinstance(src, pa.RecordBatch):
+                    it = iter((src,))
+                elif hasattr(src, "read"):
+                    # remote stream (the reference's
+                    # ReadableByteChannel path)
+                    from blaze_tpu.io.ipc import decode_ipc_stream
+
+                    it = decode_ipc_stream(src)
+                else:
+                    raise TypeError(f"bad IPC source {type(src)}")
+                for rb in it:
                     rows += rb.num_rows
                     yield ColumnBatch.from_arrow(rb)
-                continue
-            if isinstance(src, FileSegment):
-                it = read_file_segment(src.path, src.offset, src.length)
-            elif isinstance(src, (bytes, bytearray, memoryview)):
-                it = decode_ipc_parts(bytes(src))
-            elif isinstance(src, pa.RecordBatch):
-                it = iter((src,))
-            elif hasattr(src, "read"):
-                # remote stream (the reference's ReadableByteChannel path)
-                from blaze_tpu.io.ipc import decode_ipc_stream
+            ctx.metrics.add("ipc_rows_read", rows)
 
-                it = decode_ipc_stream(src)
-            else:
-                raise TypeError(f"bad IPC source {type(src)}")
-            for rb in it:
-                rows += rb.num_rows
-                yield ColumnBatch.from_arrow(rb)
-        ctx.metrics.add("ipc_rows_read", rows)
+        # overlap zstd decode + H2D of segment i+1 with downstream
+        # device compute on segment i - the reduce-side counterpart of
+        # the scan's double-buffered pipeline (reference: the tokio
+        # pump, exec.rs:196-255)
+        yield from prefetch(batches(), depth=2)
