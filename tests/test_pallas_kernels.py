@@ -47,3 +47,42 @@ def test_supports():
     assert supports("int32", 1024)
     assert not supports("utf8", 4096)
     assert not supports("int64", 1000)  # not block-aligned
+
+
+def test_masked_stats_interpret():
+    """Fused sum/min/max/count over a masked column == numpy, incl. the
+    all-masked empty selection (identities + count 0)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from blaze_tpu.ops.kernels import stats_pallas as sp
+
+    rng = np.random.default_rng(17)
+    n = 4096
+    vals = (rng.random(n).astype(np.float32) - 0.5) * 1000
+    mask = (rng.random(n) < 0.7)
+
+    assert sp.supports(n, jnp.float32)
+    out = np.asarray(sp.masked_stats(
+        jnp.asarray(vals), jnp.asarray(mask), interpret=True))
+    sel = vals[mask]
+    np.testing.assert_allclose(out[0], sel.sum(), rtol=1e-5)
+    assert out[1] == sel.min()
+    assert out[2] == sel.max()
+    assert out[3] == len(sel)
+
+    empty = np.asarray(sp.masked_stats(
+        jnp.asarray(vals), jnp.zeros(n, dtype=bool), interpret=True))
+    assert empty[0] == 0.0 and empty[3] == 0.0
+    assert np.isinf(empty[1]) and np.isinf(empty[2])
+
+    # int32 values path + multi-chunk shape (> _CHUNK_ROWS)
+    big_n = 1 << 20
+    ivals = rng.integers(-1000, 1000, big_n).astype(np.int32)
+    imask = rng.random(big_n) < 0.5
+    got = np.asarray(sp.masked_stats(
+        jnp.asarray(ivals), jnp.asarray(imask), interpret=True))
+    isel = ivals[imask]
+    np.testing.assert_allclose(got[0], isel.sum(), rtol=1e-4)
+    assert got[1] == isel.min() and got[2] == isel.max()
+    assert got[3] == len(isel)
