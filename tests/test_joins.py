@@ -301,3 +301,46 @@ def test_bhj_build_emitting_concurrent_probe_partitions(jt):
         key=lambda r: tuple((v is None, v) for v in r),
     )
     assert got == ref
+
+
+def test_build_padding_does_not_inflate_pair_expansion():
+    """A dim table far below its shape bucket must not contribute
+    phantom candidates: the FK join's output capacity stays at the
+    true match count's bucket (was 11x before the fix - padding rows
+    hashed as zeros and matched every probe row with key 0)."""
+    from blaze_tpu.config import EngineConfig, get_config, set_config
+
+    saved = get_config()
+    set_config(EngineConfig(batch_size=1 << 16,
+                            shape_buckets=(1 << 16,)))
+    try:
+        rng = np.random.default_rng(13)
+        n_items, n = 300, 40_000  # 300 rows padded into a 65536 bucket
+        item = pa.record_batch({
+            "i_item": np.arange(n_items, dtype=np.int32),
+            "i_brand": (np.arange(n_items) % 17).astype(np.int32),
+        })
+        fact = pa.record_batch({
+            "item": rng.integers(0, n_items, n).astype(np.int32),
+            "v": rng.random(n).astype(np.float32),
+        })
+        icb = ColumnBatch.from_arrow(item)
+        fcb = ColumnBatch.from_arrow(fact)
+        join = HashJoinExec(
+            MemoryScanExec([[icb]], icb.schema),
+            MemoryScanExec([[fcb]], fcb.schema),
+            ["i_item"], ["item"], JoinType.INNER,
+        )
+        outs = list(join.execute(0, ExecContext()))
+        # output rides a selection vector at pair capacity; the live
+        # row count is what compaction keeps
+        from blaze_tpu.ops.util import ensure_compacted
+
+        total_rows = sum(
+            ensure_compacted(cb).num_rows for cb in outs
+        )
+        total_cap = sum(cb.capacity for cb in outs)
+        assert total_rows == n  # every probe row matches exactly once
+        assert total_cap <= 2 * (1 << 16), total_cap
+    finally:
+        set_config(saved)
