@@ -51,6 +51,13 @@ class EngineConfig:
     # kernels at) full input capacity. Overflow (more groups than slots)
     # re-dispatches an unsliced kernel - correctness never depends on it.
     agg_group_capacity: int = 65536
+    # Evaluate pushed-down filter conjuncts host-side during parquet
+    # decode (pyarrow C++), compacting rows before padding/transfer.
+    # Halves transfer bytes at 50% selectivity but costs host CPU; the
+    # right default depends on the host->device link (keep on for a
+    # network-attached chip, consider off when decode is the
+    # bottleneck). Row-group STATS pruning is unaffected by this flag.
+    host_filter_pushdown: bool = True
 
     def bucket_for(self, num_rows: int) -> int:
         for b in self.shape_buckets:

@@ -152,7 +152,7 @@ class ParquetScanExec(PhysicalOp):
                 ):
                     ctx.metrics.add("input_rows", rb.num_rows)
                     ctx.metrics.add("input_batches", 1)
-                    if filters:
+                    if filters and cfg.host_filter_pushdown:
                         before = rb.num_rows
                         rb = _apply_host_filters(rb, filters)
                         ctx.metrics.add(
