@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 32-query cross-section (scan/agg, multi-join, decorrelated
+Coverage: a 38-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -510,6 +510,90 @@ JOIN customer_address ON c_current_addr_sk = ca_address_sk
 WHERE substr(ca_zip, 1, 5) IN ({", ".join(repr(z) for z in _Q45_ZIPS)})
    OR ws_item_sk IN ({", ".join(str(i) for i in _Q45_ITEMS)})
 GROUP BY ca_zip ORDER BY ca_zip LIMIT 100
+"""
+
+
+_DEV_WINDOW = """
+WITH agg AS (
+  SELECT {group_cols}, SUM(ss_sales_price) AS sum_sales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+  JOIN item ON ss_item_sk = i_item_sk
+    AND i_category IN ('Books', 'Home', 'Sports')
+  JOIN store ON ss_store_sk = s_store_sk
+  GROUP BY {group_cols}
+), w AS (
+  SELECT *, AVG(sum_sales) OVER (PARTITION BY {part_cols}) AS avg_sales
+  FROM agg
+)
+SELECT {out_cols} FROM w
+WHERE avg_sales > 0 AND ABS(sum_sales - avg_sales) / avg_sales > 0.1
+ORDER BY {order_cols} LIMIT 100
+"""
+
+SQL["q53"] = _DEV_WINDOW.format(
+    group_cols="i_manufact_id, d_qoy",
+    part_cols="i_manufact_id",
+    out_cols="i_manufact_id, sum_sales, avg_sales",
+    order_cols="avg_sales, sum_sales, i_manufact_id",
+)
+SQL["q63"] = _DEV_WINDOW.format(
+    group_cols="i_manager_id, d_moy",
+    part_cols="i_manager_id",
+    out_cols="i_manager_id, sum_sales, avg_sales",
+    order_cols="i_manager_id, avg_sales, sum_sales",
+)
+SQL["q89"] = _DEV_WINDOW.format(
+    group_cols=("i_category, i_class, i_brand, s_store_name, "
+                "s_company_name, d_moy"),
+    part_cols="i_category, i_brand, s_store_name, s_company_name",
+    out_cols=("i_category, i_class, i_brand, s_store_name, "
+              "s_company_name, d_moy, sum_sales, avg_sales"),
+    order_cols=("sum_sales - avg_sales, s_store_name, i_category, "
+                "i_class, i_brand, d_moy"),
+)
+
+_CLASS_RATIO = """
+WITH rev AS (
+  SELECT i_item_id, i_item_desc, i_category, i_current_price,
+         SUM({prefix}_ext_sales_price) AS itemrevenue
+  FROM {table}
+  JOIN date_dim ON {prefix}_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  JOIN item ON {prefix}_item_sk = i_item_sk
+    AND i_category IN ('Books', 'Home', 'Sports')
+  GROUP BY i_item_id, i_item_desc, i_category, i_current_price
+)
+SELECT i_item_id, i_category, itemrevenue,
+       itemrevenue * 100.0
+         / SUM(itemrevenue) OVER (PARTITION BY i_category)
+         AS revenueratio
+FROM rev ORDER BY i_category, i_item_id LIMIT 100
+"""
+
+SQL["q12"] = _CLASS_RATIO.format(prefix="ws", table="web_sales")
+SQL["q20"] = _CLASS_RATIO.format(prefix="cs", table="catalog_sales")
+
+SQL["q98"] = """
+WITH rev AS (
+  SELECT i_item_id, i_item_desc, i_category, i_class,
+         i_current_price, SUM(ss_ext_sales_price) AS itemrevenue
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  JOIN item ON ss_item_sk = i_item_sk
+    AND i_category IN ('Books', 'Home', 'Sports')
+  GROUP BY i_item_id, i_item_desc, i_category, i_class,
+           i_current_price
+)
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue,
+       itemrevenue * 100.0
+         / SUM(itemrevenue) OVER (PARTITION BY i_class)
+         AS revenueratio
+FROM rev
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
 """
 
 
