@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 38-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 39-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -594,6 +594,43 @@ SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
 FROM rev
 ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
 LIMIT 100
+"""
+
+
+SQL["q51"] = """
+WITH web_daily AS (
+  SELECT ws_item_sk AS item_sk, d_date_sk AS date_sk,
+         SUM(ws_ext_sales_price) AS rev
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  GROUP BY ws_item_sk, d_date_sk
+), web AS (
+  SELECT item_sk, date_sk,
+         SUM(rev) OVER (PARTITION BY item_sk ORDER BY date_sk
+                        ROWS UNBOUNDED PRECEDING) AS cume
+  FROM web_daily
+), store_daily AS (
+  SELECT ss_item_sk AS item_sk, d_date_sk AS date_sk,
+         SUM(ss_ext_sales_price) AS rev
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  GROUP BY ss_item_sk, d_date_sk
+), store AS (
+  SELECT item_sk, date_sk,
+         SUM(rev) OVER (PARTITION BY item_sk ORDER BY date_sk
+                        ROWS UNBOUNDED PRECEDING) AS cume
+  FROM store_daily
+)
+SELECT COALESCE(web.item_sk, store.item_sk) AS item_sk,
+       COALESCE(web.date_sk, store.date_sk) AS date_sk,
+       web.cume AS web_cume, store.cume AS store_cume
+FROM web
+FULL OUTER JOIN store ON web.item_sk = store.item_sk
+  AND web.date_sk = store.date_sk
+WHERE COALESCE(web.cume, 0.0) > COALESCE(store.cume, 0.0)
+ORDER BY 1, 2 LIMIT 200
 """
 
 
