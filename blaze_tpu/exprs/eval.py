@@ -221,6 +221,8 @@ class DeviceEvaluator:
         raise NotImplementedError(op)
 
     def _compare(self, op, lv, rv, lt, rt, m) -> CV:
+        if lt.is_wide_decimal or rt.is_wide_decimal:
+            return self._compare_wide(op, lv, rv, lt, rt, m)
         ct = promote(lt, rt) if lt != rt else lt
         phys = _np_dtype(ct)
         lv = lv.astype(phys)
@@ -252,6 +254,43 @@ class DeviceEvaluator:
             Op.LTE: lambda: lv <= rv,
             Op.GT: lambda: lv > rv,
             Op.GTE: lambda: lv >= rv,
+        }
+        return table[op](), m
+
+    def _compare_wide(self, op, lv, rv, lt, rt, m) -> CV:
+        """decimal(>18) comparisons on device: two-limb lexicographic
+        compare - signed high limb, unsigned low limb (the (cap, 2)
+        [lo, hi] layout wide columns carry). Same-scale operands only;
+        the typing gate (expr_computes_wide_decimal) routes
+        scale-mismatched comparisons to the host tier, so this sees
+        aligned unscaled integers. A narrow (<=18 digit) decimal side
+        sign-extends into limbs for free."""
+        if (lt.id is TypeId.DECIMAL and rt.id is TypeId.DECIMAL
+                and lt.scale != rt.scale):
+            raise NotImplementedError(
+                "wide decimal comparison needs equal scales"
+            )
+        min64 = jnp.int64(np.int64(-(2 ** 63)))
+
+        def limbs(v):
+            if v.ndim == 2:
+                return v[:, 0], v[:, 1]
+            v64 = v.astype(jnp.int64)
+            return v64, v64 >> jnp.int64(63)  # sign-extended high limb
+
+        llo, lhi = limbs(lv)
+        rlo, rhi = limbs(rv)
+        ulo_l = jnp.bitwise_xor(llo, min64)  # unsigned-order low limbs
+        ulo_r = jnp.bitwise_xor(rlo, min64)
+        eq = (lhi == rhi) & (llo == rlo)
+        lt_ = (lhi < rhi) | ((lhi == rhi) & (ulo_l < ulo_r))
+        table = {
+            Op.EQ: lambda: eq,
+            Op.NEQ: lambda: ~eq,
+            Op.LT: lambda: lt_,
+            Op.LTE: lambda: lt_ | eq,
+            Op.GT: lambda: ~(lt_ | eq),
+            Op.GTE: lambda: ~lt_,
         }
         return table[op](), m
 

@@ -99,6 +99,36 @@ def expr_computes_wide_decimal(e: ir.Expr, schema: Schema) -> bool:
     tier (the window the reference uses, BlazeConverters tryConvert)."""
     if isinstance(e, (ir.BoundCol, ir.Col, ir.Literal)):
         return False
+    if (
+        isinstance(e, ir.BinaryOp)
+        and e.op in ir.COMPARISON_OPS
+        and all(
+            isinstance(c, (ir.BoundCol, ir.Col, ir.Literal))
+            for c in ir.children(e)
+        )
+    ):
+        # comparisons stay on device: the evaluator's two-limb
+        # lexicographic compare handles wide pairs - provided all
+        # operands are integers-at-one-scale (unscaled values are then
+        # directly comparable; rescaling would need 128-bit multiplies,
+        # and floats cannot ride the limb compare at all)
+        scales = set()
+        ok = True
+        for c in ir.children(e):
+            try:
+                dt = infer_dtype(c, schema)
+            except Exception:
+                ok = False
+                break
+            if dt.id is TypeId.DECIMAL:
+                scales.add(dt.scale)
+            elif dt.is_floating or dt.is_string_like:
+                ok = False
+                break
+            else:
+                scales.add(0)  # integer comparand = scale 0
+        if ok and len(scales) <= 1:
+            return False
     for c in ir.children(e):
         if expr_computes_wide_decimal(c, schema):
             return True

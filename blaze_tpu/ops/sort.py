@@ -49,11 +49,6 @@ class SortExec(PhysicalOp):
                     k.nulls_first)
             for k in keys
         ]
-        for k in self.keys:
-            if infer_dtype(k.expr, child.schema).is_wide_decimal:
-                raise NotImplementedError(
-                    "sort keys of decimal(>18) are host-tier work"
-                )
         self.fetch = fetch
 
     @property
@@ -288,6 +283,23 @@ def sort_batch(cb: ColumnBatch, keys: List[SortKey]) -> ColumnBatch:
     for k in keys:
         col = _key_column(cb, k.expr)
         values = col.values
+        if col.dtype.is_wide_decimal:
+            # (cap, 2) [lo, hi] limb pairs become TWO adjacent sort
+            # lanes - high limb signed, low limb remapped to unsigned
+            # order (top-bit flip) - and the radix-style lexsort's
+            # minor-to-major passes make them one 128-bit key
+            lo = values[:, 0]
+            hi = values[:, 1]
+            lo_sortable = jnp.bitwise_xor(
+                lo, jnp.int64(np.int64(-(2 ** 63)))
+            )
+            key_cols.append(
+                (hi, col.validity, k.ascending, k.nulls_first)
+            )
+            key_cols.append(
+                (lo_sortable, col.validity, k.ascending, k.nulls_first)
+            )
+            continue
         if col.dtype.is_dictionary_encoded and col.dictionary is not None:
             values = _lexicographic_codes(col)
         key_cols.append((values, col.validity, k.ascending, k.nulls_first))
