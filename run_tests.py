@@ -37,8 +37,9 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 TPCDS_CHUNK = 12
 # exchange queries compile far more programs per test (4-partition maps,
 # spills, readers); 5 monster queries in one process crossed the
-# compile-volume cliff in the first green-run attempt - 2 stays clear
-EXCHANGE_CHUNK = 2
+# compile-volume cliff in the first green-run attempt, and the q64+q80
+# pair still did at 2 - every exchange query gets its own process
+EXCHANGE_CHUNK = 1
 
 
 def tpcds_query_names():
