@@ -181,3 +181,59 @@ def test_random_join_agg_through_exchanges(seed, tmp_path):
         else:
             np.testing.assert_array_equal(
                 a, b, err_msg=f"seed={seed} jt={jt} col={c}")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_window_through_exchanges(seed, tmp_path):
+    """Random rank/running-sum windows agree with and without a hash
+    exchange on their PARTITION BY underneath (the distribution rule
+    Spark would plant)."""
+    import pyarrow as pa
+
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.ops.sort import SortKey
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+    from blaze_tpu.planner.distribute import insert_exchanges
+
+    rng = np.random.default_rng(3000 + seed)
+    n = int(rng.integers(300, 1200))
+    df = pd.DataFrame({
+        "p": rng.integers(0, 12, n).astype(np.int64),
+        # unique order key: rank/row_number become deterministic
+        "o": rng.permutation(n).astype(np.int64),
+        "v": rng.integers(-50, 50, n).astype(np.int64),
+    })
+
+    def plan(parts):
+        cbs = []
+        bounds = np.linspace(0, len(df), parts + 1, dtype=int)
+        for i in range(parts):
+            chunk = df.iloc[bounds[i]:bounds[i + 1]]
+            rb = pa.RecordBatch.from_pandas(
+                chunk.reset_index(drop=True), preserve_index=False)
+            cbs.append([ColumnBatch.from_arrow(rb)])
+        scan = MemoryScanExec(cbs, cbs[0][0].schema)
+        return WindowExec(
+            scan,
+            partition_by=[Col("p")],
+            order_by=[SortKey(Col("o"), seed % 2 == 0, True)],
+            functions=[
+                WindowFn("row_number", None, "rn"),
+                WindowFn("sum", Col("v"), "run",
+                         frame=("rows", None, 0)),
+            ],
+        )
+
+    plain = run_plan(plan(1)).to_pandas().sort_values(
+        ["p", "o"]).reset_index(drop=True)
+    # multi-partition scan -> the rule must plant a hash exchange on p
+    ex_plan = insert_exchanges(plan(3), 4, shuffle_dir=str(tmp_path))
+    exchanged = run_plan(ex_plan).to_pandas().sort_values(
+        ["p", "o"]).reset_index(drop=True)
+    assert len(plain) == len(exchanged) == n
+    for c in ("rn", "run"):
+        np.testing.assert_array_equal(
+            plain[c].to_numpy(), exchanged[c].to_numpy(),
+            err_msg=f"seed={seed} col={c}",
+        )

@@ -38,6 +38,12 @@ EXCHANGE_QUERIES = [
     "q1", "q2", "q3", "q5", "q6", "q7", "q8", "q13", "q15", "q19",
     "q23", "q24", "q25", "q26", "q29", "q54", "q64", "q80", "q81",
     "q83", "q84", "q85", "q91", "q94", "q95",
+    "q4", "q9", "q10", "q11", "q14", "q16", "q17", "q18", "q21",
+    "q22", "q27", "q28", "q30", "q31", "q32", "q33", "q34", "q35",
+    "q37", "q38", "q39", "q40", "q41", "q43", "q45", "q46", "q48",
+    "q50", "q52", "q55", "q58", "q61", "q62", "q65", "q66", "q68",
+    "q69", "q71", "q72", "q73", "q76", "q77", "q79", "q82", "q87",
+    "q88", "q90", "q92", "q93", "q96", "q97", "q99",
     # window / global-sort shapes. q67/q86 are excluded: their RANK
     # orders by a float SUM whose value depends on summation order, and
     # exchange partitioning changes that order - near-equal sums flip
