@@ -17,10 +17,16 @@ sum/count, +inf/-inf for min/max); an all-masked column reports
 count 0 and the caller maps min/max to NULL, exactly like the
 aggregate's masked reductions.
 
-Gate: used on the TPU backend for f32/i32 columns via `supports()`;
-interpret mode pins semantics on the CPU test mesh
-(tests/test_pallas_kernels.py). Hardware legalization check pending
-chip access (ROADMAP: the tunnel was down all round).
+Status: a STANDALONE fast path with its own API - `supports()` gates
+eligibility (f32/i32, bucket-aligned) but nothing dispatches to it yet;
+wiring into the keyless-aggregate path waits on hardware legalization
+(the tunnel was down all round - ROADMAP). Interpret mode pins
+semantics on the CPU test mesh (tests/test_pallas_kernels.py).
+
+Accuracy: per-chunk partials accumulate in f32 (512K-row chunks keep
+counts exact; value sums carry f32 rounding - rtol ~1e-5); the
+cross-chunk combine runs in f64 outside the kernel. Callers needing
+exact integer sums must keep the XLA int64 path.
 """
 
 from __future__ import annotations
@@ -99,7 +105,9 @@ def masked_stats(values: jax.Array, mask: jax.Array,
     parts = jax.lax.map(
         lambda b: _call(b[0], b[1], interpret), (v3, m3)
     )  # (n_chunks, 1, 4)
-    parts = parts.reshape(n_chunks, 4)
+    # combine across chunks in f64: counts stay exact past 2^24 rows
+    # and the sum-of-partials adds no further f32 rounding
+    parts = parts.reshape(n_chunks, 4).astype(jnp.float64)
     return jnp.stack([
         jnp.sum(parts[:, 0]),
         jnp.min(parts[:, 1]),

@@ -149,9 +149,13 @@ class SortExec(PhysicalOp):
 
         def _component(col, k, rows) -> List[tuple]:
             """(null_rank, +-value) per requested row; native Python
-            numbers (ints keep full precision - no float64 round trip)."""
+            numbers (ints keep full precision - no float64 round trip).
+            Wide-decimal (cap, 2) [lo, hi] limb pairs reassemble into
+            exact 128-bit Python ints, matching the device sort's
+            hi-major/unsigned-lo order."""
             arr = np.asarray(col.values)
             is_float = np.issubdtype(arr.dtype, np.floating)
+            wide = arr.ndim == 2
             vals = arr[rows].tolist()
             if col.validity is not None:
                 valid = np.asarray(col.validity)[rows].tolist()
@@ -162,7 +166,10 @@ class SortExec(PhysicalOp):
                 if not ok:
                     out.append((0 if k.nulls_first else 2, 0))
                     continue
-                if is_float and v != v:  # NaN greatest
+                if wide:
+                    lo, hi = v
+                    v = (hi << 64) | (lo & 0xFFFFFFFFFFFFFFFF)
+                elif is_float and v != v:  # NaN greatest
                     v = float("inf")
                 out.append((1, v if k.ascending else -v))
             return out

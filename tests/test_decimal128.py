@@ -254,3 +254,33 @@ def test_wide_decimal_device_sort():
                 assert got[-2:] == [None, None]
             as_int = [int(x.scaleb(2)) for x in rest]
             assert as_int == sorted(as_int, reverse=not asc), (asc, nf)
+
+
+def test_wide_decimal_external_sort_run_merge():
+    """Oversized wide-decimal sorts (spilled runs + k-way merge) order
+    exactly like python ints, both directions - the run-merge
+    comparator reassembles limb pairs into 128-bit ints."""
+    from blaze_tpu.config import EngineConfig, get_config, set_config
+    from blaze_tpu.ops import SortExec
+    from blaze_tpu.ops.sort import SortKey
+
+    saved = get_config()
+    set_config(EngineConfig(batch_size=64, max_materialize_rows=128,
+                            shape_buckets=(64, 128, 256)))
+    try:
+        rng = np.random.default_rng(5)
+        vals = [int(x) << int(s)
+                for x, s in zip(rng.integers(-(1 << 60), 1 << 60, 600),
+                                rng.integers(0, 50, 600))]
+        rb = wide_batch(vals)
+        import decimal
+
+        for asc in (True, False):
+            plan = SortExec(scan_of(rb), [SortKey(Col("d"), asc)])
+            with decimal.localcontext() as ctx:
+                ctx.prec = 60
+                got = [int(x.scaleb(2))
+                       for x in run_plan(plan).column("d").to_pylist()]
+            assert got == sorted(vals, reverse=not asc), asc
+    finally:
+        set_config(saved)

@@ -6098,11 +6098,19 @@ def gen_tables(seed: int = 20260729):  # noqa: F811 - caching wrapper
                     out[fn[:-8]] = r.read_pandas()
         return out
     tables = _gen_tables_uncached(seed)
+    # normalize EVERY process's view through the Arrow round trip:
+    # without this, the cache-building process would test pandas
+    # extension dtypes (Float64/pd.NA) while cache-hit processes test
+    # plain numpy float64/NaN - run-order-dependent frames
+    arrow_tables = {
+        name: _pa.Table.from_pandas(df, preserve_index=False)
+        for name, df in tables.items()
+    }
+    tables = {name: t.to_pandas() for name, t in arrow_tables.items()}
     try:  # publish best-effort; concurrent builders race benignly
         tmp = root + f".tmp{os.getpid()}"
         os.makedirs(tmp, exist_ok=True)
-        for name, df in tables.items():
-            tbl = _pa.Table.from_pandas(df, preserve_index=False)
+        for name, tbl in arrow_tables.items():
             with _pa.ipc.new_file(
                 os.path.join(tmp, f"{name}.feather"), tbl.schema
             ) as w:
