@@ -58,11 +58,14 @@ def exchange_query_names():
     out = subprocess.run(
         [sys.executable, "-c",
          "import sys; sys.path.insert(0, %r); "
-         "from tests.test_tpcds_exchange import EXCHANGE_QUERIES; "
-         "print(' '.join(EXCHANGE_QUERIES))" % REPO],
+         "from tests.test_tpcds_exchange import (EXCHANGE_QUERIES, "
+         "PARQUET_QUERIES); "
+         "print(' '.join(EXCHANGE_QUERIES)); "
+         "print(' '.join(PARQUET_QUERIES))" % REPO],
         capture_output=True, text=True, env=_env(), check=True,
     )
-    return out.stdout.split()
+    lines = out.stdout.splitlines()
+    return lines[0].split(), lines[1].split()
 
 
 def _env(rows=None):
@@ -137,12 +140,24 @@ def main():
     # exchange flavor: correctness of the shuffle tier, not scale - 20k
     # rows keeps each chunk's 4-partition spill/merge cycle quick
     # (scale coverage comes from the in-memory matrix + test_shuffle)
-    enames = exchange_query_names()
-    for i, group in enumerate(chunks(enames, EXCHANGE_CHUNK)):
+    enames, pq_names = exchange_query_names()
+    shuffle_fn = ("tests/test_tpcds_exchange.py::"
+                  "test_query_through_shuffle_exchanges")
+    parquet_fn = ("tests/test_tpcds_exchange.py::"
+                  "test_query_through_parquet_and_exchanges")
+    for group in chunks(enames, EXCHANGE_CHUNK):
         ok &= run(
             f"exchange matrix {group[0]}..{group[-1]}",
-            ["tests/test_tpcds_exchange.py", "-k",
-             k_expr(group, suffixed=False)],
+            [shuffle_fn, "-k", k_expr(group, suffixed=False)],
+            rows=min(rows, 20_000),
+        )
+    # parquet-scan flavor: own process per query (the monsters sit
+    # near the compile-volume cliff even alone; two flavors in one
+    # process pushed q64 over it)
+    for group in chunks(pq_names, EXCHANGE_CHUNK):
+        ok &= run(
+            f"exchange parquet {group[0]}..{group[-1]}",
+            [parquet_fn, "-k", k_expr(group, suffixed=False)],
             rows=min(rows, 20_000),
         )
 

@@ -109,7 +109,10 @@ def test_query_through_shuffle_exchanges(env, q, tmp_path):
     assert_frames_match(got, exp, f"{q}/shuffle")
 
 
-@pytest.mark.parametrize("q", ["q1", "q6", "q23", "q64", "q80", "q94"])
+PARQUET_QUERIES = ["q1", "q6", "q23", "q64", "q80", "q94"]
+
+
+@pytest.mark.parametrize("q", PARQUET_QUERIES)
 def test_query_through_parquet_and_exchanges(env, q, tmp_path):
     tables, _, pq_scans = env
     got = _run(pq_scans, q, tmp_path)
