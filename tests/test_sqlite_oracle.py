@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 39-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 44-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -631,6 +631,104 @@ FULL OUTER JOIN store ON web.item_sk = store.item_sk
   AND web.date_sk = store.date_sk
 WHERE COALESCE(web.cume, 0.0) > COALESCE(store.cume, 0.0)
 ORDER BY 1, 2 LIMIT 200
+"""
+
+
+SQL["q16"] = """
+WITH sold AS (
+  SELECT cs_item_sk, cs_ext_sales_price
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy BETWEEN 2 AND 4
+  WHERE cs_item_sk NOT IN
+    (SELECT cr_item_sk FROM catalog_returns
+     WHERE cr_item_sk IS NOT NULL)
+), dist AS (
+  SELECT cs_item_sk, SUM(cs_ext_sales_price) AS net
+  FROM sold GROUP BY cs_item_sk
+)
+SELECT COUNT(*) AS order_count, SUM(net) AS total_net FROM dist
+"""
+
+SQL["q22"] = """
+WITH inv AS (
+  SELECT i_brand, i_manufact_id, inv_quantity_on_hand AS q
+  FROM inventory
+  JOIN date_dim ON inv_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1188 AND 1199
+  JOIN item ON inv_item_sk = i_item_sk
+)
+SELECT i_brand AS brand, i_manufact_id AS manufact_id, AVG(q) AS qoh
+FROM inv GROUP BY i_brand, i_manufact_id
+UNION ALL
+SELECT i_brand, NULL, AVG(q) FROM inv GROUP BY i_brand
+UNION ALL
+SELECT NULL, NULL, AVG(q) FROM inv
+"""
+
+SQL["q33"] = """
+WITH books AS (
+  SELECT i_item_sk, i_manufact_id FROM item
+  WHERE i_category = 'Books'
+), ch AS (
+  SELECT i_manufact_id, SUM(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 3
+  JOIN books ON ss_item_sk = i_item_sk
+  GROUP BY i_manufact_id
+  UNION ALL
+  SELECT i_manufact_id, SUM(cs_ext_sales_price)
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 3
+  JOIN books ON cs_item_sk = i_item_sk
+  GROUP BY i_manufact_id
+  UNION ALL
+  SELECT i_manufact_id, SUM(ws_ext_sales_price)
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 3
+  JOIN books ON ws_item_sk = i_item_sk
+  GROUP BY i_manufact_id
+)
+SELECT i_manufact_id, SUM(total_sales) AS total_sales
+FROM ch GROUP BY i_manufact_id
+ORDER BY total_sales DESC, i_manufact_id LIMIT 100
+"""
+
+SQL["q41"] = """
+SELECT DISTINCT i_product_name
+FROM item
+WHERE i_manufact_id BETWEEN 100 AND 140
+  AND i_manufact IN (
+    SELECT i_manufact FROM item
+    WHERE (i_color IN ('red', 'blue') AND i_units IN ('Oz', 'Case')
+           AND i_size IN ('small', 'large'))
+       OR (i_color IN ('green', 'navy') AND i_units IN ('Ton', 'Each')
+           AND i_size IN ('medium', 'petite'))
+  )
+ORDER BY i_product_name LIMIT 100
+"""
+
+SQL["q65"] = """
+WITH sb AS (
+  SELECT ss_store_sk, ss_item_sk, SUM(ss_sales_price) AS revenue
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1188 AND 1199
+  GROUP BY ss_store_sk, ss_item_sk
+), sc AS (
+  SELECT ss_store_sk AS sk2, AVG(revenue) AS ave
+  FROM sb GROUP BY ss_store_sk
+)
+SELECT s_store_name, i_item_desc, revenue, i_current_price, i_brand
+FROM sb
+JOIN sc ON ss_store_sk = sk2
+JOIN store ON ss_store_sk = s_store_sk
+JOIN item ON ss_item_sk = i_item_sk
+WHERE revenue <= 0.1 * ave
+ORDER BY s_store_name, i_item_desc, revenue LIMIT 100
 """
 
 
