@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 44-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 48-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -729,6 +729,86 @@ JOIN store ON ss_store_sk = s_store_sk
 JOIN item ON ss_item_sk = i_item_sk
 WHERE revenue <= 0.1 * ave
 ORDER BY s_store_name, i_item_desc, revenue LIMIT 100
+"""
+
+
+SQL["q30"] = """
+WITH ctr AS (
+  SELECT c_customer_sk, c_customer_id, ca_state,
+         SUM(wr_return_amt) AS total
+  FROM web_returns
+  JOIN date_dim ON wr_returned_date_sk = d_date_sk AND d_year = 1999
+  JOIN customer ON wr_returning_customer_sk = c_customer_sk
+  JOIN customer_address ON c_current_addr_sk = ca_address_sk
+  GROUP BY c_customer_sk, c_customer_id, ca_state
+)
+SELECT c_customer_id, total
+FROM ctr
+JOIN (SELECT ca_state AS st2, AVG(total) AS avg_r FROM ctr
+      WHERE ca_state IS NOT NULL GROUP BY ca_state)
+  ON ca_state = st2
+WHERE total > 1.2 * avg_r
+ORDER BY c_customer_id LIMIT 100
+"""
+
+SQL["q34"] = """
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM (
+  SELECT ss_ticket_number, ss_customer_sk, COUNT(*) AS cnt
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+  JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+    AND hd_buy_potential IN ('>10000', '0-500')
+  GROUP BY ss_ticket_number, ss_customer_sk
+)
+JOIN customer ON ss_customer_sk = c_customer_sk
+WHERE cnt BETWEEN 3 AND 8
+ORDER BY c_last_name, c_first_name, ss_ticket_number LIMIT 1000
+"""
+
+SQL["q73"] = """
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM (
+  SELECT ss_ticket_number, ss_customer_sk, COUNT(*) AS cnt
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_dom BETWEEN 1 AND 2 AND d_year BETWEEN 1998 AND 2000
+  JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+    AND hd_buy_potential IN ('>10000', '0-500')
+    AND hd_vehicle_count > 0
+  GROUP BY ss_ticket_number, ss_customer_sk
+)
+JOIN customer ON ss_customer_sk = c_customer_sk
+WHERE cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name, ss_ticket_number
+"""
+
+_Q8_LIST = [f"{(24000 + (i % 500) * 131) % 90000:05d}"
+            for i in range(0, 400)][:200]
+SQL["q8"] = f"""
+WITH good_zips AS (
+  SELECT substr(ca_zip, 1, 5) AS zip5
+  FROM customer_address
+  WHERE substr(ca_zip, 1, 5) IN
+    ({", ".join(repr(z) for z in sorted(set(_Q8_LIST)))})
+  INTERSECT
+  SELECT zip5 FROM (
+    SELECT substr(ca_zip, 1, 5) AS zip5, COUNT(*) AS cnt
+    FROM customer_address
+    JOIN customer ON ca_address_sk = c_current_addr_sk
+      AND c_preferred_cust_flag = 'Y'
+    GROUP BY substr(ca_zip, 1, 5)
+    HAVING COUNT(*) > 10
+  )
+)
+SELECT s_store_name, SUM(ss_net_profit) AS net_profit
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  AND d_year = 1998 AND d_moy = 2
+JOIN store ON ss_store_sk = s_store_sk
+WHERE substr(s_zip, 1, 2) IN
+  (SELECT DISTINCT substr(zip5, 1, 2) FROM good_zips)
+GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
 """
 
 
