@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 48-query cross-section (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 53-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -809,6 +809,121 @@ JOIN store ON ss_store_sk = s_store_sk
 WHERE substr(s_zip, 1, 2) IN
   (SELECT DISTINCT substr(zip5, 1, 2) FROM good_zips)
 GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
+"""
+
+
+SQL["q35"] = """
+SELECT cd_gender, cd_marital_status, cd_dep_count,
+       cd_dep_employed_count, cd_dep_college_count,
+       COUNT(*) AS cnt, MIN(cd_dep_count) AS min_dep,
+       MAX(cd_dep_count) AS max_dep, AVG(cd_dep_count) AS avg_dep
+FROM customer
+JOIN customer_demographics ON c_current_cdemo_sk = cd_demo_sk
+WHERE c_customer_sk IN (
+    SELECT ss_customer_sk FROM store_sales
+    JOIN date_dim ON ss_sold_date_sk = d_date_sk
+      AND d_year = 1999 AND d_qoy < 4)
+  AND c_customer_sk IN (
+    SELECT ws_bill_customer_sk FROM web_sales
+    JOIN date_dim ON ws_sold_date_sk = d_date_sk
+      AND d_year = 1999 AND d_qoy < 4
+    UNION
+    SELECT cs_bill_customer_sk FROM catalog_sales
+    JOIN date_dim ON cs_sold_date_sk = d_date_sk
+      AND d_year = 1999 AND d_qoy < 4)
+GROUP BY cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+ORDER BY cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+LIMIT 100
+"""
+
+SQL["q38"] = """
+SELECT COUNT(*) AS num_customers FROM (
+  SELECT ss_customer_sk FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  WHERE ss_customer_sk IS NOT NULL
+  INTERSECT
+  SELECT cs_bill_customer_sk FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+  INTERSECT
+  SELECT ws_bill_customer_sk FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy <= 2
+)
+"""
+
+SQL["q69"] = """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       cd_purchase_estimate, cd_credit_rating, COUNT(*) AS cnt
+FROM customer
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+  AND ca_state IN ('TN', 'GA', 'CA')
+JOIN customer_demographics ON c_current_cdemo_sk = cd_demo_sk
+WHERE c_customer_sk IN (
+    SELECT ss_customer_sk FROM store_sales
+    JOIN date_dim ON ss_sold_date_sk = d_date_sk
+      AND d_year = 2000 AND d_moy BETWEEN 1 AND 3)
+  AND c_customer_sk NOT IN (
+    SELECT ws_bill_customer_sk FROM web_sales
+    JOIN date_dim ON ws_sold_date_sk = d_date_sk
+      AND d_year = 2000 AND d_moy BETWEEN 1 AND 3
+    WHERE ws_bill_customer_sk IS NOT NULL)
+  AND c_customer_sk NOT IN (
+    SELECT cs_bill_customer_sk FROM catalog_sales
+    JOIN date_dim ON cs_sold_date_sk = d_date_sk
+      AND d_year = 2000 AND d_moy BETWEEN 1 AND 3
+    WHERE cs_bill_customer_sk IS NOT NULL)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+LIMIT 100
+"""
+
+SQL["q87"] = """
+WITH d AS (
+  SELECT d_date_sk FROM date_dim
+  WHERE d_month_seq BETWEEN 1188 AND 1199
+), sp AS (
+  SELECT DISTINCT ss_customer_sk AS c, ss_sold_date_sk AS dt
+  FROM store_sales JOIN d ON ss_sold_date_sk = d_date_sk
+)
+SELECT
+  (SELECT COUNT(*) FROM sp WHERE c IS NULL)
+  + (SELECT COUNT(*) FROM (
+      SELECT c, dt FROM sp WHERE c IS NOT NULL
+      EXCEPT
+      SELECT DISTINCT ws_bill_customer_sk, ws_sold_date_sk
+      FROM web_sales JOIN d ON ws_sold_date_sk = d_date_sk
+      EXCEPT
+      SELECT DISTINCT cs_bill_customer_sk, cs_sold_date_sk
+      FROM catalog_sales JOIN d ON cs_sold_date_sk = d_date_sk
+    )) AS num_store_only
+"""
+
+SQL["q97"] = """
+WITH d AS (
+  SELECT d_date_sk FROM date_dim
+  WHERE d_month_seq BETWEEN 1188 AND 1199
+), sp AS (
+  SELECT DISTINCT ss_customer_sk AS c, ss_item_sk AS i
+  FROM store_sales JOIN d ON ss_sold_date_sk = d_date_sk
+  WHERE ss_customer_sk IS NOT NULL
+), cp AS (
+  SELECT DISTINCT cs_bill_customer_sk AS c, cs_item_sk AS i
+  FROM catalog_sales JOIN d ON cs_sold_date_sk = d_date_sk
+  WHERE cs_bill_customer_sk IS NOT NULL
+)
+SELECT
+  (SELECT COUNT(*) FROM (SELECT * FROM sp EXCEPT SELECT * FROM cp))
+    AS store_only,
+  (SELECT COUNT(*) FROM (SELECT * FROM cp EXCEPT SELECT * FROM sp))
+    AS catalog_only,
+  (SELECT COUNT(*) FROM (SELECT * FROM sp INTERSECT
+                         SELECT * FROM cp)) AS store_and_catalog
 """
 
 
