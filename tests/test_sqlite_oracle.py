@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 53-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 58-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -924,6 +924,96 @@ SELECT
     AS catalog_only,
   (SELECT COUNT(*) FROM (SELECT * FROM sp INTERSECT
                          SELECT * FROM cp)) AS store_and_catalog
+"""
+
+
+SQL["q17"] = """
+SELECT i_item_id, COUNT(ss_quantity) AS qty_count,
+       AVG(ss_quantity) AS qty_avg,
+       CASE WHEN COUNT(ss_quantity) > 1 THEN
+         sqrt((SUM(1.0 * ss_quantity * ss_quantity)
+               - 1.0 * SUM(ss_quantity) * SUM(ss_quantity)
+                 / COUNT(ss_quantity))
+              / (COUNT(ss_quantity) - 1))
+       END AS qty_stdev
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1998
+JOIN store_returns ON ss_item_sk = sr_item_sk
+JOIN item ON ss_item_sk = i_item_sk
+GROUP BY i_item_id ORDER BY i_item_id LIMIT 100
+"""
+
+SQL["q18"] = """
+WITH j AS (
+  SELECT i_item_id, ca_state, cs_ext_sales_price AS p
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk AND d_year = 1998
+  JOIN customer ON cs_bill_customer_sk = c_customer_sk
+  JOIN customer_address ON c_current_addr_sk = ca_address_sk
+  JOIN item ON cs_item_sk = i_item_sk
+)
+SELECT i_item_id, ca_state, AVG(p) AS a
+FROM j GROUP BY i_item_id, ca_state
+UNION ALL
+SELECT NULL, ca_state, AVG(p) FROM j GROUP BY ca_state
+UNION ALL
+SELECT NULL, NULL, AVG(p) FROM j
+"""
+
+SQL["q27"] = """
+WITH j AS (
+  SELECT i_item_id, s_state, ss_quantity AS q, ss_list_price AS lp
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 2000
+  JOIN customer_demographics ON ss_cdemo_sk = cd_demo_sk
+    AND cd_gender = 'M' AND cd_marital_status = 'S'
+    AND cd_education_status = 'College'
+  JOIN store ON ss_store_sk = s_store_sk
+  JOIN item ON ss_item_sk = i_item_sk
+)
+SELECT i_item_id, s_state, AVG(q) AS agg1, AVG(lp) AS agg2
+FROM j GROUP BY i_item_id, s_state
+UNION ALL
+SELECT i_item_id, NULL, AVG(q), AVG(lp) FROM j GROUP BY i_item_id
+UNION ALL
+SELECT NULL, NULL, AVG(q), AVG(lp) FROM j
+"""
+
+SQL["q36"] = """
+WITH j AS (
+  SELECT i_category, i_class, ss_net_profit AS np,
+         ss_ext_sales_price AS sp
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+  JOIN item ON ss_item_sk = i_item_sk
+)
+SELECT i_category, i_class, SUM(np) / SUM(sp) AS gross_margin
+FROM j GROUP BY i_category, i_class
+UNION ALL
+SELECT i_category, NULL, SUM(np) / SUM(sp) FROM j GROUP BY i_category
+UNION ALL
+SELECT NULL, NULL, SUM(np) / SUM(sp) FROM j
+"""
+
+SQL["q50"] = """
+SELECT s_store_name,
+  SUM(CASE WHEN sr_returned_date_sk - d_date_sk <= 30
+           THEN 1 ELSE 0 END) AS d30,
+  SUM(CASE WHEN sr_returned_date_sk - d_date_sk > 30
+            AND sr_returned_date_sk - d_date_sk <= 60
+           THEN 1 ELSE 0 END) AS d60,
+  SUM(CASE WHEN sr_returned_date_sk - d_date_sk > 60
+            AND sr_returned_date_sk - d_date_sk <= 90
+           THEN 1 ELSE 0 END) AS d90,
+  SUM(CASE WHEN sr_returned_date_sk - d_date_sk > 90
+           THEN 1 ELSE 0 END) AS d90plus
+FROM store_returns
+JOIN store_sales ON sr_customer_sk = ss_customer_sk
+  AND sr_item_sk = ss_item_sk
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+JOIN store ON ss_store_sk = s_store_sk
+WHERE sr_returned_date_sk >= d_date_sk
+GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
 """
 
 
