@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 58-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 61-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1014,6 +1014,75 @@ JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
 JOIN store ON ss_store_sk = s_store_sk
 WHERE sr_returned_date_sk >= d_date_sk
 GROUP BY s_store_name ORDER BY s_store_name LIMIT 100
+"""
+
+
+_YEAR_TOTAL = """
+  SELECT c_customer_sk AS sk, c_customer_id AS cid, d_year,
+         SUM(({p}_ext_list_price - {p}_ext_discount_amt) / 2.0)
+           AS year_total
+  FROM {table}
+  JOIN date_dim ON {p}_sold_date_sk = d_date_sk
+  JOIN customer ON {p}_bill_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk, c_customer_id, d_year
+"""
+_YEAR_TOTAL_SS = _YEAR_TOTAL.replace(
+    "{p}_bill_customer_sk", "ss_customer_sk"
+).format(p="ss", table="store_sales")
+
+_YOY = """
+WITH s_yt AS ({s_yt}), o_yt AS ({o_yt})
+SELECT s1.cid
+FROM s_yt s1
+JOIN s_yt s2 ON s1.sk = s2.sk AND s2.d_year = 1999
+JOIN o_yt o1 ON s1.sk = o1.sk AND o1.d_year = 1998
+JOIN o_yt o2 ON s1.sk = o2.sk AND o2.d_year = 1999
+WHERE s1.d_year = 1998 AND s1.year_total > 0 AND o1.year_total > 0
+  AND o2.year_total / o1.year_total
+      > s2.year_total / s1.year_total
+ORDER BY s1.cid LIMIT 100
+"""
+
+SQL["q4"] = _YOY.format(
+    s_yt=_YEAR_TOTAL_SS,
+    o_yt=_YEAR_TOTAL.format(p="cs", table="catalog_sales"),
+)
+SQL["q11"] = _YOY.format(
+    s_yt=_YEAR_TOTAL_SS,
+    o_yt=_YEAR_TOTAL.format(p="ws", table="web_sales"),
+)
+
+SQL["q31"] = """
+WITH ssq AS (
+  SELECT ca_county, d_qoy, SUM(ss_ext_sales_price) AS s
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+    AND d_qoy IN (1, 2, 3)
+  JOIN customer_address ON ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy
+), wsq AS (
+  SELECT ca_county, d_qoy, SUM(ws_ext_sales_price) AS s
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk AND d_year = 1999
+    AND d_qoy IN (1, 2, 3)
+  JOIN customer_address ON ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy
+)
+SELECT ss1.ca_county,
+       ws2.s / ws1.s AS web_q1_q2_increase,
+       ss2.s / ss1.s AS store_q1_q2_increase,
+       ws3.s / ws2.s AS web_q2_q3_increase,
+       ss3.s / ss2.s AS store_q2_q3_increase
+FROM ssq ss1
+JOIN ssq ss2 ON ss1.ca_county = ss2.ca_county AND ss2.d_qoy = 2
+JOIN ssq ss3 ON ss1.ca_county = ss3.ca_county AND ss3.d_qoy = 3
+JOIN wsq ws1 ON ss1.ca_county = ws1.ca_county AND ws1.d_qoy = 1
+JOIN wsq ws2 ON ss1.ca_county = ws2.ca_county AND ws2.d_qoy = 2
+JOIN wsq ws3 ON ss1.ca_county = ws3.ca_county AND ws3.d_qoy = 3
+WHERE ss1.d_qoy = 1
+  AND ws2.s / ws1.s > ss2.s / ss1.s
+  AND ws3.s / ws2.s > ss3.s / ss2.s
+ORDER BY ss1.ca_county
 """
 
 
