@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 61-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 63-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1083,6 +1083,75 @@ WHERE ss1.d_qoy = 1
   AND ws2.s / ws1.s > ss2.s / ss1.s
   AND ws3.s / ws2.s > ss3.s / ss2.s
 ORDER BY ss1.ca_county
+"""
+
+
+SQL["q2"] = """
+WITH both_ch AS (
+  SELECT ws_sold_date_sk AS sold_date_sk,
+         ws_ext_sales_price AS sales_price FROM web_sales
+  UNION ALL
+  SELECT cs_sold_date_sk, cs_ext_sales_price FROM catalog_sales
+), weekly AS (
+  SELECT d_week_seq,
+         SUM(CASE WHEN d_day_name = 'Sunday' THEN sales_price END) AS sun_sales,
+         SUM(CASE WHEN d_day_name = 'Monday' THEN sales_price END) AS mon_sales,
+         SUM(CASE WHEN d_day_name = 'Tuesday' THEN sales_price END) AS tue_sales,
+         SUM(CASE WHEN d_day_name = 'Wednesday' THEN sales_price END) AS wed_sales,
+         SUM(CASE WHEN d_day_name = 'Thursday' THEN sales_price END) AS thu_sales,
+         SUM(CASE WHEN d_day_name = 'Friday' THEN sales_price END) AS fri_sales,
+         SUM(CASE WHEN d_day_name = 'Saturday' THEN sales_price END) AS sat_sales
+  FROM date_dim JOIN both_ch ON d_date_sk = sold_date_sk
+  GROUP BY d_week_seq
+), wk AS (
+  SELECT DISTINCT d_week_seq, d_year FROM date_dim
+)
+SELECT y1.d_week_seq AS d_week_seq1,
+       ROUND(y1.sun_sales / y2.sun_sales, 2) AS sun_r,
+       ROUND(y1.mon_sales / y2.mon_sales, 2) AS mon_r,
+       ROUND(y1.tue_sales / y2.tue_sales, 2) AS tue_r,
+       ROUND(y1.wed_sales / y2.wed_sales, 2) AS wed_r,
+       ROUND(y1.thu_sales / y2.thu_sales, 2) AS thu_r,
+       ROUND(y1.fri_sales / y2.fri_sales, 2) AS fri_r,
+       ROUND(y1.sat_sales / y2.sat_sales, 2) AS sat_r
+FROM weekly y1
+JOIN wk w1 ON y1.d_week_seq = w1.d_week_seq AND w1.d_year = 1998
+JOIN weekly y2
+JOIN wk w2 ON y2.d_week_seq = w2.d_week_seq AND w2.d_year = 1999
+WHERE y2.d_week_seq = y1.d_week_seq + 53
+ORDER BY y1.d_week_seq
+"""
+
+SQL["q59"] = """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         SUM(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price END) AS sun_sales,
+         SUM(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price END) AS mon_sales,
+         SUM(CASE WHEN d_day_name = 'Tuesday' THEN ss_sales_price END) AS tue_sales,
+         SUM(CASE WHEN d_day_name = 'Wednesday' THEN ss_sales_price END) AS wed_sales,
+         SUM(CASE WHEN d_day_name = 'Thursday' THEN ss_sales_price END) AS thu_sales,
+         SUM(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price END) AS fri_sales,
+         SUM(CASE WHEN d_day_name = 'Saturday' THEN ss_sales_price END) AS sat_sales
+  FROM date_dim JOIN store_sales ON d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk
+), named AS (
+  SELECT wss.*, s_store_id, s_store_name
+  FROM wss JOIN store ON ss_store_sk = s_store_sk
+)
+SELECT y1.s_store_name, y1.s_store_id, y1.d_week_seq,
+       y1.sun_sales / y2.sun_sales AS sun_r,
+       y1.mon_sales / y2.mon_sales AS mon_r,
+       y1.tue_sales / y2.tue_sales AS tue_r,
+       y1.wed_sales / y2.wed_sales AS wed_r,
+       y1.thu_sales / y2.thu_sales AS thu_r,
+       y1.fri_sales / y2.fri_sales AS fri_r,
+       y1.sat_sales / y2.sat_sales AS sat_r
+FROM named y1
+JOIN named y2 ON y1.s_store_id = y2.s_store_id
+  AND y2.d_week_seq - 52 = y1.d_week_seq
+WHERE y1.d_week_seq BETWEEN 5 AND 20
+  AND y2.d_week_seq BETWEEN 57 AND 72
+ORDER BY y1.s_store_name, y1.s_store_id, y1.d_week_seq LIMIT 100
 """
 
 
