@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 63-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 67-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1152,6 +1152,124 @@ JOIN named y2 ON y1.s_store_id = y2.s_store_id
 WHERE y1.d_week_seq BETWEEN 5 AND 20
   AND y2.d_week_seq BETWEEN 57 AND 72
 ORDER BY y1.s_store_name, y1.s_store_id, y1.d_week_seq LIMIT 100
+"""
+
+
+SQL["q48"] = """
+SELECT SUM(ss_quantity) AS total_qty
+FROM store_sales
+JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+JOIN customer_demographics ON ss_cdemo_sk = cd_demo_sk
+JOIN customer ON ss_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE (cd_marital_status = 'M' AND cd_education_status = '4 yr Degree'
+       AND ss_sales_price BETWEEN 100.0 AND 150.0)
+   OR (cd_marital_status = 'D' AND cd_education_status = '2 yr Degree'
+       AND ss_sales_price BETWEEN 50.0 AND 100.0)
+   OR (ca_state IN ('TN', 'GA')
+       AND ss_net_profit BETWEEN 0.0 AND 100.0)
+"""
+
+SQL["q56"] = """
+WITH sel AS (
+  SELECT DISTINCT i_item_id FROM item WHERE {cond}
+), ch AS (
+  SELECT i_item_id, SUM(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON ss_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+  UNION ALL
+  SELECT i_item_id, SUM(cs_ext_sales_price)
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON cs_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+  UNION ALL
+  SELECT i_item_id, SUM(ws_ext_sales_price)
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON ws_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+)
+SELECT i_item_id, SUM(total_sales) AS total_sales
+FROM ch GROUP BY i_item_id
+ORDER BY {order} LIMIT 100
+""".format(
+    cond="i_color IN ('red', 'navy', 'khaki')",
+    order="total_sales, i_item_id",
+)
+
+SQL["q60"] = """
+WITH sel AS (
+  SELECT DISTINCT i_item_id FROM item WHERE {cond}
+), ch AS (
+  SELECT i_item_id, SUM(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON ss_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+  UNION ALL
+  SELECT i_item_id, SUM(cs_ext_sales_price)
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON cs_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+  UNION ALL
+  SELECT i_item_id, SUM(ws_ext_sales_price)
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 2
+  JOIN item ON ws_item_sk = i_item_sk
+  WHERE i_item_id IN (SELECT i_item_id FROM sel)
+  GROUP BY i_item_id
+)
+SELECT i_item_id, SUM(total_sales) AS total_sales
+FROM ch GROUP BY i_item_id
+ORDER BY {order} LIMIT 100
+""".format(
+    cond="i_category = 'Music'",
+    order="i_item_id, total_sales",
+)
+
+SQL["q76"] = """
+WITH allch AS (
+  SELECT 'store' AS channel, 'ss_customer_sk' AS col_name,
+         d_year, i_category, ss_ext_sales_price AS p
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  JOIN item ON ss_item_sk = i_item_sk
+  WHERE ss_customer_sk IS NULL
+  UNION ALL
+  SELECT 'web', 'ws_bill_customer_sk', d_year, i_category,
+         ws_ext_sales_price
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  JOIN item ON ws_item_sk = i_item_sk
+  WHERE ws_bill_customer_sk IS NULL
+  UNION ALL
+  SELECT 'catalog', 'cs_bill_addr_sk', d_year, i_category,
+         cs_ext_sales_price
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  JOIN item ON cs_item_sk = i_item_sk
+  WHERE cs_bill_addr_sk IS NULL
+)
+SELECT channel, col_name, d_year, i_category,
+       COUNT(*) AS sales_cnt, SUM(p) AS sales_amt
+FROM allch
+GROUP BY channel, col_name, d_year, i_category
+ORDER BY channel, col_name, d_year, i_category LIMIT 100
 """
 
 
