@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 67-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 69-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1271,6 +1271,61 @@ FROM allch
 GROUP BY channel, col_name, d_year, i_category
 ORDER BY channel, col_name, d_year, i_category LIMIT 100
 """
+
+
+SQL["q46"] = """
+WITH per AS (
+  SELECT ss_ticket_number, ss_customer_sk, ca_city AS bought_city,
+         SUM({amt}) AS amt, SUM({profit}) AS profit
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_dow IN (6, 0) AND d_year BETWEEN 1998 AND 2000
+  JOIN store ON ss_store_sk = s_store_sk
+    AND s_city IN ('Midway', 'Fairview')
+  JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+    AND ({hd})
+  JOIN customer_address ON ss_addr_sk = ca_address_sk
+  GROUP BY ss_ticket_number, ss_customer_sk, ca_city
+)
+SELECT c_last_name, c_first_name, ss_ticket_number, bought_city,
+       amt, profit
+FROM per
+JOIN customer ON ss_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE ca_city <> bought_city
+ORDER BY {order} LIMIT 100
+""".format(
+    amt="ss_coupon_amt", profit="ss_net_profit",
+    hd="hd_dep_count = 4 OR hd_vehicle_count = 3",
+    order="c_last_name, c_first_name, bought_city, ss_ticket_number",
+)
+
+SQL["q68"] = """
+WITH per AS (
+  SELECT ss_ticket_number, ss_customer_sk, ca_city AS bought_city,
+         SUM({amt}) AS amt, SUM({profit}) AS profit
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_dow IN (6, 0) AND d_year BETWEEN 1998 AND 2000
+  JOIN store ON ss_store_sk = s_store_sk
+    AND s_city IN ('Midway', 'Fairview')
+  JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+    AND ({hd})
+  JOIN customer_address ON ss_addr_sk = ca_address_sk
+  GROUP BY ss_ticket_number, ss_customer_sk, ca_city
+)
+SELECT c_last_name, c_first_name, ss_ticket_number, bought_city,
+       amt, profit
+FROM per
+JOIN customer ON ss_customer_sk = c_customer_sk
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+WHERE ca_city <> bought_city
+ORDER BY {order} LIMIT 100
+""".format(
+    amt="ss_ext_sales_price", profit="ss_ext_list_price",
+    hd="hd_dep_count = 5 OR hd_vehicle_count = 3",
+    order="c_last_name, ss_ticket_number",
+)
 
 
 # ---------------------------------------------------------------------------
