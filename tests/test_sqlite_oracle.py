@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 69-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 72-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1326,6 +1326,79 @@ ORDER BY {order} LIMIT 100
     hd="hd_dep_count = 5 OR hd_vehicle_count = 3",
     order="c_last_name, ss_ticket_number",
 )
+
+
+SQL["q21"] = """
+SELECT w_warehouse_name, i_item_id,
+       SUM(CASE WHEN inv_date_sk < 500
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_before,
+       SUM(CASE WHEN inv_date_sk >= 500
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_after
+FROM inventory
+JOIN date_dim ON inv_date_sk = d_date_sk
+  AND d_date_sk BETWEEN 470 AND 530
+JOIN warehouse ON inv_warehouse_sk = w_warehouse_sk
+JOIN item ON inv_item_sk = i_item_sk
+GROUP BY w_warehouse_name, i_item_id
+HAVING inv_before > 0
+  AND 1.0 * inv_after / inv_before >= 2.0 / 3.0
+  AND 1.0 * inv_after / inv_before <= 3.0 / 2.0
+ORDER BY w_warehouse_name, i_item_id LIMIT 100
+"""
+
+SQL["q81"] = """
+WITH ctr AS (
+  SELECT cr_returning_customer_sk AS cust, ca_state,
+         SUM(cr_return_amount) AS total
+  FROM catalog_returns
+  JOIN date_dim ON cr_returned_date_sk = d_date_sk AND d_year = 2000
+  JOIN customer_address ON cr_returning_addr_sk = ca_address_sk
+  GROUP BY cr_returning_customer_sk, ca_state
+)
+SELECT c_customer_id, c_first_name, c_last_name, total
+FROM ctr
+JOIN (SELECT ca_state AS st2, AVG(total) AS avg_r FROM ctr
+      WHERE ca_state IS NOT NULL GROUP BY ca_state)
+  ON ctr.ca_state = st2
+JOIN customer ON cust = c_customer_sk
+JOIN customer_address ca2 ON c_current_addr_sk = ca2.ca_address_sk
+  AND ca2.ca_state = 'GA'
+WHERE total > 1.2 * avg_r
+ORDER BY c_customer_id, total LIMIT 100
+"""
+
+SQL["q83"] = """
+WITH d AS (
+  SELECT d_date_sk FROM date_dim
+  WHERE d_week_seq IN (20, 60, 100)
+), sr AS (
+  SELECT i_item_id, SUM(sr_return_quantity) AS qty
+  FROM store_returns
+  JOIN d ON sr_returned_date_sk = d_date_sk
+  JOIN item ON sr_item_sk = i_item_sk GROUP BY i_item_id
+), cr AS (
+  SELECT i_item_id, SUM(cr_return_quantity) AS qty
+  FROM catalog_returns
+  JOIN d ON cr_returned_date_sk = d_date_sk
+  JOIN item ON cr_item_sk = i_item_sk GROUP BY i_item_id
+), wr AS (
+  SELECT i_item_id, SUM(wr_return_quantity) AS qty
+  FROM web_returns
+  JOIN d ON wr_returned_date_sk = d_date_sk
+  JOIN item ON wr_item_sk = i_item_sk GROUP BY i_item_id
+)
+SELECT sr.i_item_id AS item_id, sr.qty AS sr_qty,
+       sr.qty / ((sr.qty + cr.qty + wr.qty) / 3.0) * 100.0 AS sr_dev,
+       cr.qty AS cr_qty,
+       cr.qty / ((sr.qty + cr.qty + wr.qty) / 3.0) * 100.0 AS cr_dev,
+       wr.qty AS wr_qty,
+       wr.qty / ((sr.qty + cr.qty + wr.qty) / 3.0) * 100.0 AS wr_dev,
+       (sr.qty + cr.qty + wr.qty) / 3.0 AS average
+FROM sr
+JOIN cr ON sr.i_item_id = cr.i_item_id
+JOIN wr ON sr.i_item_id = wr.i_item_id
+ORDER BY item_id, sr_qty LIMIT 100
+"""
 
 
 # ---------------------------------------------------------------------------
