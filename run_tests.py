@@ -69,9 +69,22 @@ def exchange_query_names():
 
 
 def _env(rows=None):
+    import tempfile
+
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # persistent XLA compilation cache, shared across chunk processes:
+    # cache hits skip backend_compile_and_load entirely, which both
+    # speeds re-runs ~4x on the heavy exchange queries and removes most
+    # exposure to the jaxlib compile-volume segfault (q64 died right at
+    # the cliff under CPU contention even alone; warm it passes in 1/4
+    # the time with a fraction of the live compilations)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "blaze_jax_cache"),
+    )
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     if rows is not None:
         env["BLAZE_TPCDS_ROWS"] = str(rows)
     return env
