@@ -11,7 +11,7 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 72-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
+Coverage: a 75-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
 AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
 whose oracles lean on pandas-specific mechanics stay pandas-only.
 """
@@ -1398,6 +1398,94 @@ FROM sr
 JOIN cr ON sr.i_item_id = cr.i_item_id
 JOIN wr ON sr.i_item_id = wr.i_item_id
 ORDER BY item_id, sr_qty LIMIT 100
+"""
+
+
+SQL["q58"] = """
+WITH d AS (
+  SELECT d_date_sk FROM date_dim WHERE d_week_seq = 60
+), ss AS (
+  SELECT i_item_id, SUM(ss_ext_sales_price) AS rev
+  FROM store_sales JOIN d ON ss_sold_date_sk = d_date_sk
+  JOIN item ON ss_item_sk = i_item_sk GROUP BY i_item_id
+), cs AS (
+  SELECT i_item_id, SUM(cs_ext_sales_price) AS rev
+  FROM catalog_sales JOIN d ON cs_sold_date_sk = d_date_sk
+  JOIN item ON cs_item_sk = i_item_sk GROUP BY i_item_id
+), ws AS (
+  SELECT i_item_id, SUM(ws_ext_sales_price) AS rev
+  FROM web_sales JOIN d ON ws_sold_date_sk = d_date_sk
+  JOIN item ON ws_item_sk = i_item_sk GROUP BY i_item_id
+)
+SELECT ss.i_item_id AS item_id, ss.rev AS ss_rev, cs.rev AS cs_rev,
+       ws.rev AS ws_rev, (ss.rev + cs.rev + ws.rev) / 3.0 AS average
+FROM ss
+JOIN cs ON ss.i_item_id = cs.i_item_id
+JOIN ws ON ss.i_item_id = ws.i_item_id
+WHERE ss.rev BETWEEN 0.9 * (ss.rev + cs.rev + ws.rev) / 3.0
+                 AND 1.1 * (ss.rev + cs.rev + ws.rev) / 3.0
+  AND cs.rev BETWEEN 0.9 * (ss.rev + cs.rev + ws.rev) / 3.0
+                 AND 1.1 * (ss.rev + cs.rev + ws.rev) / 3.0
+  AND ws.rev BETWEEN 0.9 * (ss.rev + cs.rev + ws.rev) / 3.0
+                 AND 1.1 * (ss.rev + cs.rev + ws.rev) / 3.0
+ORDER BY item_id, ss_rev LIMIT 100
+"""
+
+SQL["q71"] = """
+WITH allch AS (
+  SELECT ws_ext_sales_price AS ext_price, ws_item_sk AS item_sk,
+         ws_sold_time_sk AS time_sk
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 12
+  UNION ALL
+  SELECT cs_ext_sales_price, cs_item_sk, cs_sold_time_sk
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 12
+  UNION ALL
+  SELECT ss_ext_sales_price, ss_item_sk, ss_sold_time_sk
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+    AND d_year = 1999 AND d_moy = 12
+)
+SELECT i_brand_id, i_brand, t_hour, t_minute,
+       SUM(ext_price) AS ext_price
+FROM allch
+JOIN item ON item_sk = i_item_sk AND i_manager_id = 1
+JOIN time_dim ON time_sk = t_time_sk
+  AND (t_hour BETWEEN 7 AND 8 OR t_hour BETWEEN 18 AND 19)
+GROUP BY i_brand_id, i_brand, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+"""
+
+SQL["q44"] = """
+WITH base AS (
+  SELECT ss_item_sk, ss_customer_sk, ss_net_profit
+  FROM store_sales WHERE ss_store_sk = 4
+), nullavg AS (
+  SELECT AVG(ss_net_profit) AS na FROM base
+  WHERE ss_customer_sk IS NULL
+), by_item AS (
+  SELECT ss_item_sk, AVG(ss_net_profit) AS rank_col
+  FROM base GROUP BY ss_item_sk
+), q AS (
+  SELECT ss_item_sk, rank_col FROM by_item, nullavg
+  WHERE rank_col > 0.9 * na
+), ranked AS (
+  SELECT ss_item_sk,
+         RANK() OVER (ORDER BY rank_col ASC) AS rnk_a,
+         RANK() OVER (ORDER BY rank_col DESC) AS rnk_d
+  FROM q
+)
+SELECT a.rnk_a AS a_rnk, ia.i_product_name AS best_performing,
+       id.i_product_name AS worst_performing
+FROM ranked a
+JOIN ranked d ON a.rnk_a = d.rnk_d
+JOIN item ia ON a.ss_item_sk = ia.i_item_sk
+JOIN item id ON d.ss_item_sk = id.i_item_sk
+WHERE a.rnk_a <= 10
+ORDER BY a_rnk
 """
 
 
