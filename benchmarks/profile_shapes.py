@@ -1,0 +1,75 @@
+"""Profile join_agg and grouped_agg shapes: dispatch counts + cProfile."""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import pyarrow as pa
+
+from blaze_tpu.config import EngineConfig, set_config
+
+N = int(os.environ.get("N", 8 << 20))
+chunk = min(N, 1 << 20)
+set_config(EngineConfig(batch_size=chunk, shape_buckets=(4096, 65536, 1 << 20, chunk, N)))
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.exprs.ir import Literal
+from blaze_tpu.ops import AggMode, HashAggregateExec, MemoryScanExec, ProjectExec
+from blaze_tpu.ops.joins import HashJoinExec, JoinType
+from blaze_tpu.ops.fused import fuse_pipelines
+from blaze_tpu.runtime import dispatch
+from blaze_tpu.runtime.executor import run_plan
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.types import DataType
+
+rng = np.random.default_rng(42)
+n_items = 1 << 17
+item_sk = rng.integers(0, n_items, N).astype(np.int32)
+qty = rng.integers(1, 10, N).astype(np.int32)
+price = (rng.random(N) * 100).astype(np.float32)
+part_sk = rng.integers(0, 1 << 10, N).astype(np.int32)
+i_item_sk = np.arange(n_items, dtype=np.int32)
+i_brand = rng.integers(0, 4096, n_items).astype(np.int32)
+
+fact_cb = ColumnBatch.from_arrow(pa.record_batch({"item": item_sk, "qty": qty, "price": price, "part": part_sk}))
+item_cb = ColumnBatch.from_arrow(pa.record_batch({"i_item": i_item_sk, "i_brand": i_brand}))
+
+def fact_scan(): return MemoryScanExec([[fact_cb]], fact_cb.schema)
+def item_scan(): return MemoryScanExec([[item_cb]], item_cb.schema)
+
+join_plan = fuse_pipelines(HashAggregateExec(
+    ProjectExec(
+        HashJoinExec(item_scan(), ProjectExec(fact_scan(), [(Col("item"), "item"), (Col("price"), "price")]),
+                     [Col("i_item")], [Col("item")], JoinType.INNER),
+        [(Col("i_brand"), "brand"), (Col("price"), "price")]),
+    keys=[(Col("brand"), "brand")],
+    aggs=[(AggExpr(AggFn.SUM, Col("price")), "rev"), (AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+    mode=AggMode.COMPLETE))
+
+grp_expr = (Col("item") % Literal(4096, DataType.int32()))
+grouped_plan = fuse_pipelines(HashAggregateExec(
+    ProjectExec(fact_scan(), [(grp_expr, "g"), (Col("price"), "price"), (Col("qty"), "qty")]),
+    keys=[(Col("g"), "g")],
+    aggs=[(AggExpr(AggFn.SUM, Col("price")), "s"), (AggExpr(AggFn.MIN, Col("price")), "lo"),
+          (AggExpr(AggFn.MAX, Col("price")), "hi"), (AggExpr(AggFn.AVG, Col("qty")), "aq")],
+    mode=AggMode.COMPLETE))
+
+for name, plan in [("join_agg", join_plan), ("grouped_agg", grouped_plan)]:
+    run_plan(plan)  # warmup/compile
+    with dispatch.counting() as c:
+        t0 = time.perf_counter()
+        run_plan(plan)
+        t1 = time.perf_counter()
+    print(f"{name}: {t1-t0:.3f}s  counts={c.counts}")
+
+if os.environ.get("PROFILE"):
+    import cProfile, pstats
+    which = os.environ["PROFILE"]
+    plan = join_plan if which == "join" else grouped_plan
+    pr = cProfile.Profile()
+    pr.enable()
+    run_plan(plan)
+    pr.disable()
+    pstats.Stats(pr).sort_stats("cumulative").print_stats(40)

@@ -51,6 +51,18 @@ class EngineConfig:
     # kernels at) full input capacity. Overflow (more groups than slots)
     # re-dispatches an unsliced kernel - correctness never depends on it.
     agg_group_capacity: int = 65536
+    # Grouping-core selection for hash aggregates: "scatter" (open-
+    # addressing hash table built from scatter/gather, sort-free - the
+    # O(n) path), "sort" (stable lexsort + boundary detection), or
+    # "auto" (scatter on the CPU backend where an 8M-row sort costs
+    # ~3.5s vs ~0.1s for the table; sort on TPU until the scatter
+    # variant is benchmarked on real hardware). Env override:
+    # BLAZE_GROUP_CORE.
+    group_core: str = "auto"
+    # Join-core selection for the unique-build fast path (hash-table
+    # probe, no sort/searchsorted/pair-expansion): same choices and
+    # rationale as group_core. Env override: BLAZE_JOIN_CORE.
+    join_core: str = "auto"
     # Evaluate pushed-down filter conjuncts host-side during parquet
     # decode (pyarrow C++), compacting rows before padding/transfer.
     # Halves transfer bytes at 50% selectivity but costs host CPU; the
@@ -71,6 +83,26 @@ class EngineConfig:
         d = self.tmp_dirs[0]
         os.makedirs(d, exist_ok=True)
         return d
+
+
+def resolve_core_choice(env_var: str, cfg_value: str) -> str:
+    """Shared resolution for the grouping/join core knobs: env override
+    beats config; "auto" picks the scatter core on CPU (where the sort
+    it replaces costs 20-35x more) and the sort core on TPU until the
+    scatter variant is benchmarked on real hardware. Unknown values
+    raise so a typo'd knob can't silently measure the wrong core."""
+    mode = os.environ.get(env_var) or cfg_value
+    if mode not in ("auto", "scatter", "sort"):
+        raise ValueError(
+            f"{env_var}/config must be auto|scatter|sort, got {mode!r}"
+        )
+    if mode == "auto":
+        import jax
+
+        return (
+            "scatter" if jax.default_backend() == "cpu" else "sort"
+        )
+    return mode
 
 
 _CONFIG: EngineConfig = EngineConfig()

@@ -219,11 +219,13 @@ class FusedAggregateExec(PhysicalOp):
         for cb in self.children[0].execute(partition, ctx):
             layout = cb.layout()
             cap = layout[0]
+            from blaze_tpu.ops.hash_aggregate import _group_core_choice
+
             base_key = (
                 "fusedagg", self.pipeline.structure_key(),
                 tuple((e, n) for e, n in self.agg.keys),
                 tuple((a.fn, a.child) for a, _ in self.agg.aggs),
-                layout,
+                layout, _group_core_choice(),
             )
 
             def fetch(outs, n_groups):

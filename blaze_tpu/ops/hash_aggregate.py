@@ -51,6 +51,15 @@ class AggMode(enum.Enum):
     COMPLETE = "complete"
 
 
+def _group_core_choice() -> str:
+    """Grouping-core knob (config.group_core / env BLAZE_GROUP_CORE)."""
+    from blaze_tpu.config import resolve_core_choice
+
+    return resolve_core_choice(
+        "BLAZE_GROUP_CORE", get_config().group_core
+    )
+
+
 class _SchemaStub:
     """Placeholder child carrying only a schema (internal op wiring)."""
 
@@ -598,7 +607,7 @@ class HashAggregateExec(PhysicalOp):
         base_key = ("hashagg", self.mode.value,
                     tuple((a.fn, a.child) for a, _ in self.aggs),
                     tuple(key_exprs_l), tuple(child_map.items()),
-                    aug.layout(), merging)
+                    aug.layout(), merging, _group_core_choice())
         gcap = (1 if not self.keys
                 else min(aug.capacity, get_config().agg_group_capacity))
         if gcap >= aug.capacity:
@@ -710,6 +719,10 @@ class HashAggregateExec(PhysicalOp):
             None if force_lexsort
             else self._narrow_key_dtypes(in_schema, key_exprs)
         )
+        use_scatter = (
+            hash_dtypes is not None
+            and _group_core_choice() == "scatter"
+        )
 
         # Segment-output capacity: with a small static group bound the
         # reductions scatter into out_cap slots instead of `capacity`
@@ -730,8 +743,48 @@ class HashAggregateExec(PhysicalOp):
 
             keys_cv = [ev.evaluate(e) for e in key_exprs]
             collision = jnp.asarray(False)
+            if n_keys and use_scatter:
+                # ---- group ids by hash-table insertion (sort-free) ----
+                # every live row resolves to a slot via exact-key probing
+                # (ops/hash_table.py), so unlike the hash-lane sort path
+                # there is no collision sentinel: equality is verified,
+                # not inferred from hash adjacency
+                from blaze_tpu.ops import hash_table as ht
+
+                h = hash_columns_device(
+                    [
+                        (v, m, dt)
+                        for (v, m), dt in zip(keys_cv, hash_dtypes)
+                    ],
+                    capacity,
+                ).astype(jnp.int32)
+                # table sized to the group-slot capacity, not the row
+                # capacity: dense_group_ids scans the whole table, so a
+                # row-capacity table costs ~0.5s/8M rows in cumsum+
+                # nonzero alone. More distinct keys than the small
+                # table holds trips `overflow`, which reuses the
+                # group-capacity retry (re-run unsliced -> full table).
+                full_t = ht.table_size_for(capacity)
+                small_t = ht.table_size_for(min(capacity, 2 * out_cap))
+                tsize = min(small_t, full_t)
+                slot, rep_tab, overflow = ht.group_slots(
+                    h,
+                    [(v, m) for v, m in keys_cv],
+                    live,
+                    capacity,
+                    tsize,
+                    max_rounds=16 if tsize < full_t else None,
+                )
+                gid_sorted, n_groups, bpos = ht.dense_group_ids(
+                    slot, rep_tab, live, capacity, out_cap
+                )
+                n_groups = jnp.where(
+                    overflow, jnp.int32(out_cap + 1), n_groups
+                )
+                idx = None  # identity: rows stay in input order
+                s_live = live
             # ---- group ids by stable sort + boundary detection ----
-            if n_keys and hash_dtypes is not None:
+            elif n_keys and hash_dtypes is not None:
                 # narrow-key fast path: ONE stable i32 sort by the key
                 # hash; true-key boundary detection below splits hash
                 # collisions into correct runs, and a collision between
@@ -772,7 +825,7 @@ class HashAggregateExec(PhysicalOp):
                 order = jnp.lexsort(tuple(reversed(priority)))
                 idx = order
                 hash_neq = None
-            if n_keys:
+            if n_keys and not use_scatter:
                 s_live = jnp.take(live, idx)
                 prev_live = jnp.concatenate(
                     [jnp.zeros(1, dtype=jnp.bool_), s_live[:-1]]
@@ -824,8 +877,8 @@ class HashAggregateExec(PhysicalOp):
                 bpos = jnp.nonzero(
                     boundary, size=out_cap, fill_value=0
                 )[0]
-            else:
-                idx = jnp.arange(capacity, dtype=jnp.int32)
+            elif not n_keys:
+                idx = None
                 s_live = live
                 gid_sorted = jnp.where(live, 0, out_cap - 1)
                 n_groups = jnp.asarray(1, jnp.int32)
@@ -833,11 +886,11 @@ class HashAggregateExec(PhysicalOp):
 
             outs = []
             for (v, m) in keys_cv:
-                sv = jnp.take(v, idx)
+                sv = _tk(v, idx)
                 kv = jnp.take(sv, bpos)
                 km = None
                 if m is not None:
-                    km = jnp.take(jnp.take(m, idx), bpos)
+                    km = jnp.take(_tk(m, idx), bpos)
                 outs.append((kv, km))
 
             segops = _SegOps(gid_sorted, out_cap, n_keys == 0)
@@ -893,8 +946,8 @@ class HashAggregateExec(PhysicalOp):
         if merging:
             pos, width = state_offsets[i]
             states = [
-                (jnp.take(cols[pos + k][0], idx, axis=0),
-                 jnp.take(cols[pos + k][1], idx)
+                (_tk(cols[pos + k][0], idx),
+                 _tk(cols[pos + k][1], idx)
                  if cols[pos + k][1] is not None else None)
                 for k in range(width)
             ]
@@ -908,8 +961,8 @@ class HashAggregateExec(PhysicalOp):
             c = seg(live_f.astype(jnp.int64))
             return [(c, None)]
         cv, cm = ev.evaluate(child_map[i])
-        cv = jnp.take(cv, idx, axis=0)
-        cm_s = jnp.take(cm, idx) if cm is not None else None
+        cv = _tk(cv, idx)
+        cm_s = _tk(cm, idx) if cm is not None else None
         contrib = live_f if cm_s is None else (live_f & cm_s)
         if fn is AggFn.COUNT:
             return [(seg(contrib.astype(jnp.int64)), None)]
@@ -1054,6 +1107,15 @@ class HashAggregateExec(PhysicalOp):
         s1 = seg(jnp.where(live_f, s1v, 0.0))
         s2 = seg(jnp.where(live_f, s2v, 0.0))
         return [_finalize_var(fn, n, s1, s2)]
+
+
+def _tk(x, idx):
+    """Permute by the grouping order; `idx is None` means identity (the
+    scatter core keeps rows in input order - skipping the gather saves a
+    full-capacity pass per aggregated column)."""
+    if idx is None:
+        return x
+    return jnp.take(x, idx, axis=0)
 
 
 def _null_last_key(v, m):

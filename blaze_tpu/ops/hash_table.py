@@ -1,0 +1,322 @@
+"""Device open-addressing hash table: sort-free grouping and join lookup.
+
+Why: the engine's original grouping/join cores are sort-based (one lexsort
+of the full input per aggregate, argsort+searchsorted per join build/probe).
+Sorts are O(n log n) with a large constant on XLA:CPU (an 8M-row argsort
+measures ~3.5s on one core vs ~0.03s for a scatter over the same rows) and
+the sort result is only used to assign group ids / locate matches. This
+module replaces that with the classic vectorized open-addressing scheme,
+built entirely from scatter/gather primitives that XLA executes in O(n):
+
+  insert:  every live row hashes to a home slot in a power-of-two table;
+           rounds of `table.at[slot].min(row_index)` claim empty slots
+           (ties resolved by the min), a gather-back + exact key
+           comparison resolves rows whose key already owns the slot, and
+           unresolved rows advance to the next slot (linear probing)
+           inside one `lax.while_loop`. Occupied slots are never
+           overwritten, so the linear-probe invariant (no empty slot
+           between a key's home and its resting slot) holds and lookups
+           may stop at the first empty slot.
+  lookup:  probe rows walk the same chain, comparing true key values at
+           each step - hash collisions cost extra steps, never wrong
+           answers.
+
+Equality is exact (not hash equality): NaN matches NaN (Spark normalizes
+NaN keys), and NULL handling is caller-chosen: grouping treats NULL as a
+key value (SQL GROUP BY: NULL groups with NULL), joins never match NULL.
+
+Reference counterpart: the DataFusion hash-join/hash-aggregate RawTable
+paths the reference reuses (from_proto.rs:349-545). The design here is
+deliberately not a row-cursor translation: every step is a whole-array
+scatter/gather so one XLA program handles the entire batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def table_size_for(capacity: int) -> int:
+    """Power-of-two table with load factor <= 0.5 at full capacity, so
+    insertion always terminates (an empty slot exists on every probe
+    chain) and expected chains stay O(1)."""
+    t = 1024
+    while t < 2 * capacity:
+        t <<= 1
+    return t
+
+
+def _pairwise_eq(av, am, bv, bm, null_equal: bool):
+    """Exact equality of key values gathered from two row sets.
+
+    `av/am` and `bv/bm` are aligned (already gathered) value/validity
+    arrays. NaN == NaN; NULL semantics per `null_equal`."""
+    eq = av == bv
+    if jnp.issubdtype(av.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(av) & jnp.isnan(bv))
+    if am is None and bm is None:
+        return eq
+    at = am if am is not None else jnp.ones(av.shape[0], jnp.bool_)
+    bt = bm if bm is not None else jnp.ones(bv.shape[0], jnp.bool_)
+    if null_equal:
+        # (both valid and equal) or (both null)
+        return jnp.where(at & bt, eq, at == bt)
+    return eq & at & bt
+
+
+def _keys_at(key_cols, idx):
+    """Gather (values, validity) of every key column at row indices."""
+    out = []
+    for v, m in key_cols:
+        out.append(
+            (
+                jnp.take(v, idx, axis=0),
+                jnp.take(m, idx) if m is not None else None,
+            )
+        )
+    return out
+
+
+def insert(
+    h: jax.Array,
+    key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
+    live: jax.Array,
+    capacity: int,
+    table_size: int,
+    null_equal: bool,
+    max_rounds: Optional[int] = None,
+):
+    """Insert all live rows; equal keys share one slot.
+
+    `max_rounds` bounds the probe loop for UNDERSIZED tables (a table
+    smaller than 2*capacity cannot guarantee an empty slot on every
+    chain, so insertion of more distinct keys than fit would never
+    terminate): when the bound trips, the leftover rows surface in the
+    `overflow` flag and the caller re-runs with a full-size table (the
+    same ladder that handles group-capacity overflow).
+
+    Returns (slot, rep_tab, dup, overflow):
+      slot     i32[capacity]  resolved slot per row (undefined for dead)
+      rep_tab  i32[table_size] first (minimum) row index owning each
+               slot; `capacity` marks an empty slot
+      dup      bool scalar    any live row's key was already present
+               (its representative is a different row)
+      overflow bool scalar    rows left unresolved by the round bound
+    """
+    cap = capacity
+    mask = jnp.uint32(table_size - 1)
+    rowidx = jnp.arange(cap, dtype=jnp.int32)
+    empty = jnp.int32(cap)
+    slot0 = jnp.asarray(
+        h.astype(jnp.uint32) & mask, dtype=jnp.int32
+    )
+
+    def keys_match(rep, self_keys):
+        reps = jnp.clip(rep, 0, cap - 1)
+        rep_keys = _keys_at(key_cols, reps)
+        ok = jnp.ones(cap, dtype=jnp.bool_)
+        for (bv, bm), (sv, sm) in zip(rep_keys, self_keys):
+            ok = ok & _pairwise_eq(sv, sm, bv, bm, null_equal)
+        return ok
+
+    self_keys = [(v, m) for v, m in key_cols]
+
+    def cond(state):
+        _, _, _, active, _, rounds = state
+        more = jnp.any(active)
+        if max_rounds is not None:
+            more = more & (rounds < max_rounds)
+        return more
+
+    def body(state):
+        tab, slot, final_slot, active, dup, rounds = state
+        occupant = jnp.take(tab, slot)
+        # claim only EMPTY slots: occupied slots are immutable, which
+        # preserves the linear-probe invariant lookups depend on
+        cand = jnp.where(
+            active & (occupant == empty), rowidx, empty
+        )
+        tab = tab.at[slot].min(cand, mode="drop")
+        rep = jnp.take(tab, slot)
+        found = active & (rep != empty) & keys_match(rep, self_keys)
+        dup = dup | jnp.any(found & (rep != rowidx))
+        final_slot = jnp.where(found, slot, final_slot)
+        active = active & ~found
+        nxt = jnp.asarray(
+            (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask,
+            dtype=jnp.int32,
+        )
+        slot = jnp.where(active, nxt, slot)
+        return tab, slot, final_slot, active, dup, rounds + 1
+
+    tab0 = jnp.full(table_size, empty, dtype=jnp.int32)
+    state = (
+        tab0,
+        slot0,
+        jnp.zeros(cap, dtype=jnp.int32),
+        live,
+        jnp.asarray(False),
+        jnp.asarray(0, jnp.int32),
+    )
+    tab, _, final_slot, active, dup, _ = lax.while_loop(
+        cond, body, state
+    )
+    return final_slot, tab, dup, jnp.any(active)
+
+
+def group_slots(
+    h: jax.Array,
+    key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
+    live: jax.Array,
+    capacity: int,
+    table_size: int,
+    max_rounds: Optional[int] = None,
+):
+    """Slot assignment for GROUPING (null_equal semantics).
+
+    Single-integer-key inputs get a direct-indexing branch: when the
+    live value range fits the table (dictionary codes, `x % N` bucket
+    ids, narrow ints - the overwhelmingly common TPC-DS group keys),
+    slot = value - min(value) with one reserved slot for NULL, skipping
+    the probe loop entirely (one scatter instead of ~2 rounds of
+    scatter+gather+compare). The branch decision is data-dependent, so
+    both variants compile under one `lax.cond`; out-of-range or
+    multi-key inputs take the hash-insert path.
+
+    Returns (slot, rep_tab, overflow)."""
+    cap = capacity
+    single_int = (
+        len(key_cols) == 1
+        and key_cols[0][0].ndim == 1
+        and jnp.issubdtype(key_cols[0][0].dtype, jnp.integer)
+    )
+    if not single_int:
+        slot, tab, _dup, overflow = insert(
+            h, key_cols, live, cap, table_size, True, max_rounds
+        )
+        return slot, tab, overflow
+
+    v, m = key_cols[0]
+    valid = live if m is None else (live & m)
+    vv = v.astype(jnp.int64)
+    big = jnp.int64(1) << jnp.int64(62)
+    kmin = jnp.min(jnp.where(valid, vv, big))
+    kmax = jnp.max(jnp.where(valid, vv, -big))
+    diff = kmax - kmin
+    # reserve one slot for the NULL group when the key is nullable.
+    # int64 wrap on an astronomically wide range makes diff negative,
+    # which the >= 0 guard rejects (a true range >= 2^63 can never wrap
+    # into [0, table_size))
+    need = diff + (2 if m is not None else 1)
+    in_range = (diff >= 0) & (need <= table_size) & jnp.any(valid)
+
+    def direct(_):
+        raw = jnp.clip(vv - kmin, 0, table_size - 1)
+        null_slot = jnp.clip(diff + 1, 0, table_size - 1)
+        slot = jnp.where(valid, raw, null_slot).astype(jnp.int32)
+        cand = jnp.where(
+            live, jnp.arange(cap, dtype=jnp.int32), jnp.int32(cap)
+        )
+        tab = jnp.full(table_size, cap, dtype=jnp.int32)
+        tab = tab.at[slot].min(cand, mode="drop")
+        return slot, tab, jnp.asarray(False)
+
+    def hashed(_):
+        slot, tab, _dup, overflow = insert(
+            h, key_cols, live, cap, table_size, True, max_rounds
+        )
+        return slot, tab, overflow
+
+    return lax.cond(in_range, direct, hashed, operand=None)
+
+
+def lookup(
+    rep_tab: jax.Array,
+    h_probe: jax.Array,
+    probe_key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
+    build_key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
+    probe_live: jax.Array,
+    build_capacity: int,
+    null_equal: bool = False,
+):
+    """Find each probe row's matching build row (first inserted row of
+    the equal key), walking the probe chain to the first empty slot.
+
+    Returns (match_idx i32[pcap] - build row index, clip-safe garbage
+    when unmatched - and matched bool[pcap])."""
+    table_size = rep_tab.shape[0]
+    mask = jnp.uint32(table_size - 1)
+    pcap = h_probe.shape[0]
+    empty = jnp.int32(build_capacity)
+    slot0 = jnp.asarray(
+        h_probe.astype(jnp.uint32) & mask, dtype=jnp.int32
+    )
+
+    def keys_match(rep):
+        reps = jnp.clip(rep, 0, build_capacity - 1)
+        rep_keys = _keys_at(build_key_cols, reps)
+        ok = jnp.ones(pcap, dtype=jnp.bool_)
+        for (bv, bm), (pv, pm) in zip(rep_keys, probe_key_cols):
+            ok = ok & _pairwise_eq(pv, pm, bv, bm, null_equal)
+        return ok
+
+    def cond(state):
+        _, active, _, _ = state
+        return jnp.any(active)
+
+    def body(state):
+        slot, active, match, matched = state
+        rep = jnp.take(rep_tab, slot)
+        is_empty = rep == empty
+        hit = active & ~is_empty & keys_match(rep)
+        match = jnp.where(hit, rep, match)
+        matched = matched | hit
+        active = active & ~is_empty & ~hit
+        nxt = jnp.asarray(
+            (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask,
+            dtype=jnp.int32,
+        )
+        slot = jnp.where(active, nxt, slot)
+        return slot, active, match, matched
+
+    state = (
+        slot0,
+        probe_live,
+        jnp.zeros(pcap, dtype=jnp.int32),
+        jnp.zeros(pcap, dtype=jnp.bool_),
+    )
+    _, _, match, matched = lax.while_loop(cond, body, state)
+    return match, matched
+
+
+def dense_group_ids(
+    slot: jax.Array,
+    rep_tab: jax.Array,
+    live: jax.Array,
+    capacity: int,
+    out_cap: int,
+):
+    """Compact occupied slots to dense group ids [0, n_groups).
+
+    Returns (row_gid i32[capacity] - dead rows park in out_cap-1,
+    n_groups i32 scalar, bpos i32[out_cap] - representative row index
+    per group, zero-padded)."""
+    occupied = rep_tab != jnp.int32(capacity)
+    gid_of_slot = jnp.cumsum(occupied.astype(jnp.int32)) - 1
+    row_gid = jnp.where(
+        live,
+        jnp.take(gid_of_slot, slot),
+        jnp.int32(out_cap - 1),
+    )
+    n_groups = jnp.sum(occupied.astype(jnp.int32))
+    occ_slots = jnp.nonzero(
+        occupied, size=out_cap, fill_value=0
+    )[0]
+    bpos = jnp.clip(
+        jnp.take(rep_tab, occ_slots), 0, capacity - 1
+    )
+    return row_gid, n_groups, bpos

@@ -135,22 +135,49 @@ def _key_hash_cols(cols: List[Column]) -> List[Tuple]:
     return out
 
 
+def _join_core_choice() -> str:
+    """Join-core knob (config.join_core / env BLAZE_JOIN_CORE)."""
+    from blaze_tpu.config import resolve_core_choice
+
+    return resolve_core_choice(
+        "BLAZE_JOIN_CORE", get_config().join_core
+    )
+
+
 class _JoinCore:
     """Shared vectorized equi-join over one materialized build batch.
 
-    Dispatch budget per probe batch (the tunnel-RTT model of
-    runtime/dispatch.py): one build-index kernel per build relation, then
-    per probe batch ONE counting kernel + ONE blocking scalar readback
-    (the dynamic pair count that picks the static output bucket) + ONE
-    emission kernel that expands, verifies, gathers both sides and folds
-    the matched flags - instead of the ~20 eager ops a naive translation
-    of the reference's cursor loop would dispatch."""
+    Two cores behind one interface:
+
+    - "table" (unique build keys): the build relation inserts into an
+      open-addressing hash table (ops/hash_table.py, one bounded
+      scatter/gather probe loop); each probe batch then runs ONE lookup
+      kernel (no sort, no searchsorted, no pair expansion, and NO
+      blocking host sync - output capacity is statically the probe
+      capacity) and ONE emission kernel that only gathers the build
+      side: probe columns pass through untouched. Duplicate build keys
+      are detected at insert time (one scalar sync per build relation)
+      and demote to the sorted core.
+    - "sorted": build rows sort by key hash; per probe batch ONE
+      counting kernel + ONE blocking scalar readback (the dynamic pair
+      count picks the static output bucket) + ONE emission kernel that
+      expands candidate runs, verifies equality and gathers both sides.
+
+    Either way the dispatch budget per probe batch is O(1) kernels
+    (the tunnel-RTT model of runtime/dispatch.py) - instead of the ~20
+    eager ops a naive translation of the reference's cursor loop
+    would dispatch."""
 
     def __init__(self, build: ColumnBatch, build_keys: List[int]):
         self.build = build
         self.build_keys = build_keys
         self.matched_build = jnp.zeros(build.capacity, dtype=jnp.bool_)
         self._index = None
+        # remembered demotion: duplicate build keys mean the table core
+        # can never apply to this build relation - don't re-attempt (and
+        # re-pay the insert pass + blocking dup sync) per probe batch
+        # when dictionary-encoded keys force an index rebuild
+        self._table_demoted = False
 
     def _ensure_index(self, build_cols: List[Column]):
         # the index is probe-invariant unless a build key is
@@ -161,24 +188,70 @@ class _JoinCore:
             c.dtype.is_dictionary_encoded for c in build_cols
         ):
             return
-        # NULL keys hash like values and are rejected later by the equality
-        # check, so collisions only cost verification work
         bufs = _key_hash_cols(build_cols)
         dtypes = tuple(d for _, _, d in bufs)
         cap = self.build.capacity
+
+        if not self._table_demoted and _join_core_choice() == "scatter":
+            from blaze_tpu.ops import hash_table as ht
+
+            eq_layout = tuple(
+                (c.values.dtype.str, c.validity is not None)
+                for c in build_cols
+            )
+            tsize = ht.table_size_for(cap)
+
+            def build_table():
+                def kernel(values, valids, eq_bufs, num_rows):
+                    cols = list(zip(values, valids, dtypes))
+                    h = hash_columns_device(cols, cap).astype(
+                        jnp.int32
+                    )
+                    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                    key_cols = _unflatten_eq(eq_layout, eq_bufs)
+                    # NULL join keys never match: keep them (and the
+                    # shape-bucket padding rows) out of the table
+                    for _, m in key_cols:
+                        if m is not None:
+                            live = live & m
+                    _slot, tab, dup, _ovf = ht.insert(
+                        h, key_cols, live, cap, tsize,
+                        null_equal=False,
+                    )
+                    return tab, dup
+
+                return kernel
+
+            fn = cached_kernel(
+                ("join_table", dtypes, eq_layout, cap), build_table
+            )
+            tab, dup = fn(
+                tuple(v for v, _, _ in bufs),
+                tuple(m for _, m, _ in bufs),
+                _flatten_cols(build_cols),
+                self.build.num_rows,
+            )
+            # one blocking scalar per build relation: unique keys take
+            # the table core; duplicates demote to the sorted core
+            if not host_int(dup):
+                self._index = ("table", tab)
+                return
+            self._table_demoted = True
 
         def build():
             def kernel(values, valids, num_rows):
                 cols = list(zip(values, valids, dtypes))
                 h = hash_columns_device(cols, cap).astype(jnp.int32)
-                # padding rows must not enter the index: a build table
-                # well under its shape bucket would otherwise
-                # contribute cap-num_rows phantom candidates per probe
-                # row whose key equals the padding value (observed 11x
-                # pair expansion on a 131k-row dim table in a 1M
-                # bucket). INT32_MAX herds them into one run at the
-                # top; a genuine probe hash there still verifies by
-                # exact key + liveness in emit_pairs.
+                # NULL keys hash like values and are rejected later by
+                # the equality check, so collisions only cost
+                # verification work. Padding rows must not enter the
+                # index: a build table well under its shape bucket
+                # would otherwise contribute cap-num_rows phantom
+                # candidates per probe row whose key equals the padding
+                # value (observed 11x pair expansion on a 131k-row dim
+                # table in a 1M bucket). INT32_MAX herds them into one
+                # run at the top; a genuine probe hash there still
+                # verifies by exact key + liveness in emit_pairs.
                 live = jnp.arange(cap, dtype=jnp.int32) < num_rows
                 h = jnp.where(live, h, jnp.int32(0x7FFFFFFF))
                 order = jnp.argsort(h, stable=True)
@@ -187,10 +260,11 @@ class _JoinCore:
             return kernel
 
         fn = cached_kernel(("join_index", dtypes, cap), build)
-        self._index = fn(
+        h_sorted, order = fn(
             tuple(v for v, _, _ in bufs), tuple(m for _, m, _ in bufs),
             self.build.num_rows,
         )
+        self._index = ("sorted", h_sorted, order)
 
     def probe(self, probe_cb: ColumnBatch, probe_keys: List[int]):
         """Hash the probe keys and size the pair expansion (one host
@@ -206,11 +280,65 @@ class _JoinCore:
             unified_b.append(b2)
             unified_p.append(p2)
         self._ensure_index(unified_b)
-        h_sorted, order = self._index
-
         pbufs = _key_hash_cols(unified_p)
         pdtypes = tuple(d for _, _, d in pbufs)
         pcap = probe_cb.capacity
+
+        if self._index[0] == "table":
+            from blaze_tpu.ops import hash_table as ht
+
+            tab = self._index[1]
+            bcap = self.build.capacity
+            b_eq_layout = tuple(
+                (c.values.dtype.str, c.validity is not None)
+                for c in unified_b
+            )
+            p_eq_layout = tuple(
+                (c.values.dtype.str, c.validity is not None)
+                for c in unified_p
+            )
+
+            def build_lookup():
+                def kernel(values, valids, b_eq, p_eq, tab, num_rows):
+                    cols = list(zip(values, valids, pdtypes))
+                    h = hash_columns_device(cols, pcap).astype(
+                        jnp.int32
+                    )
+                    live = (
+                        jnp.arange(pcap, dtype=jnp.int32) < num_rows
+                    )
+                    pkeys = _unflatten_eq(p_eq_layout, p_eq)
+                    for _, m in pkeys:
+                        if m is not None:
+                            live = live & m  # NULL never matches
+                    return ht.lookup(
+                        tab, h, pkeys,
+                        _unflatten_eq(b_eq_layout, b_eq),
+                        live, bcap, null_equal=False,
+                    )
+
+                return kernel
+
+            fn = cached_kernel(
+                ("join_lookup", pdtypes, b_eq_layout, p_eq_layout,
+                 bcap, pcap),
+                build_lookup,
+            )
+            match_idx, matched = fn(
+                tuple(v for v, _, _ in pbufs),
+                tuple(m for _, m, _ in pbufs),
+                _flatten_cols(unified_b),
+                _flatten_cols(unified_p),
+                tab,
+                probe_cb.num_rows,
+            )
+            # NO host sync: output capacity is statically the probe
+            # capacity (each probe row matches at most one build row)
+            return (
+                "table", probe_cb, match_idx, matched, pcap
+            )
+
+        _tag, h_sorted, order = self._index
 
         def build_counts():
             def kernel(values, valids, h_sorted, num_rows):
@@ -235,7 +363,8 @@ class _JoinCore:
         total = host_int(total_dev)
         pair_cap = max(get_config().bucket_for(total), 1)
         return (
-            probe_cb, unified_b, unified_p, counts, lo, order, pair_cap
+            "sorted", probe_cb, unified_b, unified_p, counts, lo,
+            order, pair_cap,
         )
 
     def emit_pairs(self, probe_state, out_build_cols: List[Column],
@@ -244,7 +373,12 @@ class _JoinCore:
         both sides' output columns, fold matched flags. Returns
         (out_columns, valid, pair_cap, matched_probe) and updates
         matched_build."""
-        (probe_cb, unified_b, unified_p, counts, lo, order,
+        if probe_state[0] == "table":
+            return self._emit_table(
+                probe_state, out_build_cols, out_probe_cols,
+                build_first,
+            )
+        (_tag, probe_cb, unified_b, unified_p, counts, lo, order,
          pair_cap) = probe_state
         bcap = self.build.capacity
         pcap = probe_cb.capacity
@@ -359,6 +493,78 @@ class _JoinCore:
         else:
             out_cols = pcols + bcols
         return out_cols, valid, pair_cap, matched_p
+
+    def _emit_table(self, probe_state, out_build_cols: List[Column],
+                    out_probe_cols: List[Column], build_first: bool):
+        """Table-core emission: output row i IS probe row i (unique
+        build keys guarantee at most one match per probe row), so the
+        probe columns pass through untouched and only the build side
+        gathers - plus one scatter to fold matched-build flags."""
+        _tag, probe_cb, match_idx, matched, pair_cap = probe_state
+        bcap = self.build.capacity
+        pcap = probe_cb.capacity
+        b_layout = tuple(
+            (c.values.dtype.str, c.validity is not None)
+            for c in out_build_cols
+        )
+
+        def build_emit():
+            def kernel(match_idx, matched, bout_bufs, probe_rows,
+                       matched_build):
+                live_p = (
+                    jnp.arange(pcap, dtype=jnp.int32) < probe_rows
+                )
+                valid = matched & live_p
+                pair_b = jnp.clip(match_idx, 0, bcap - 1)
+                mb = matched_build | (
+                    jnp.zeros(bcap, jnp.int32)
+                    .at[pair_b]
+                    .add(valid.astype(jnp.int32), mode="drop")
+                    > 0
+                )
+                out = []
+                it = iter(bout_bufs)
+                for _, has_m in b_layout:
+                    v = next(it)
+                    out.append(jnp.take(v, pair_b, axis=0))
+                    if has_m:
+                        out.append(
+                            jnp.take(next(it), pair_b, axis=0)
+                        )
+                    else:
+                        out.append(None)
+                return out, valid, mb
+
+            return kernel
+
+        fn = cached_kernel(
+            ("join_emit_table", b_layout, bcap, pcap,
+             len(out_build_cols)),
+            build_emit,
+        )
+        bout, valid, mb = fn(
+            match_idx, matched, _flatten_cols(out_build_cols),
+            probe_cb.num_rows, self.matched_build,
+        )
+        self.matched_build = mb
+        bcols = _rewrap_cols(out_build_cols, bout)
+        pcols = list(out_probe_cols)
+        if build_first:
+            out_cols = bcols + pcols
+        else:
+            out_cols = pcols + bcols
+        return out_cols, valid, pair_cap, valid
+
+
+def _unflatten_eq(layout, bufs):
+    """Inverse of _flatten_cols for (values, validity) key pairs."""
+    out = []
+    it = iter(bufs)
+    for _, has_m in layout:
+        v = next(it)
+        m = next(it) if has_m else None
+        out.append((v, m))
+    return out
 
 
 def _flatten_cols(cols: List[Column]):
@@ -482,7 +688,7 @@ class HashJoinExec(PhysicalOp):
         )
         for pb in right.execute(partition, ctx):
             state = core.probe(pb, self.right_keys)
-            pb = state[0]
+            pb = state[1]
             bcols = build.columns if emit_pairs else []
             pcols = pb.columns if emit_pairs else []
             out_cols, valid, pair_cap, matched_p = core.emit_pairs(
@@ -696,7 +902,7 @@ class SortMergeJoinExec(PhysicalOp):
         core = _JoinCore(build, self.right_keys)
         probe = concat_batches(left_batches, schema=left.schema)
         state = core.probe(probe, self.left_keys)
-        probe = state[0]
+        probe = state[1]
         emit = jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
                       JoinType.FULL)
         bcols = build.columns if emit else []

@@ -210,7 +210,7 @@ class StreamingSortMergeJoinExec(PhysicalOp):
                 continue
             core = entry.ensure_core(self.right_keys)
             state = core.probe(probe, self.left_keys)
-            probe = state[0]
+            probe = state[1]
             out_cols, valid, pair_cap, matched_p = core.emit_pairs(
                 state,
                 entry.batch.columns if emit else [],
