@@ -73,3 +73,52 @@ if os.environ.get("PROFILE"):
     run_plan(plan)
     pr.disable()
     pstats.Stats(pr).sort_stats("cumulative").print_stats(40)
+
+# ---- expr_chain + window shapes (bench parity) ----
+if os.environ.get("EXTRA"):
+    from blaze_tpu.exprs.ir import ScalarFn
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+    from blaze_tpu.ops.sort import SortKey
+
+    rev = Col("price") * Col("qty").cast(DataType.float32())
+    score = ScalarFn("ln", (rev + Literal(1.0, DataType.float32()),)) * ScalarFn(
+        "sqrt", (ScalarFn("abs", (Col("price") - Literal(50.0, DataType.float32()),)),))
+    expr_plan = fuse_pipelines(HashAggregateExec(
+        ProjectExec(fact_scan(), [(score.cast(DataType.float64()), "sc")]),
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("sc")), "s"), (AggExpr(AggFn.MAX, Col("sc")), "m")],
+        mode=AggMode.COMPLETE))
+
+    window_plan = HashAggregateExec(
+        WindowExec(
+            ProjectExec(fact_scan(), [(Col("part"), "part"), (Col("price"), "price")]),
+            partition_by=[Col("part")],
+            order_by=[SortKey(Col("price"), ascending=False)],
+            functions=[WindowFn("row_number", None, "rk"),
+                       WindowFn("sum", Col("price"), "run", frame=("rows", None, 0))]),
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("rk").cast(DataType.float64())), "rksum"),
+              (AggExpr(AggFn.SUM, Col("run")), "runsum")],
+        mode=AggMode.COMPLETE)
+
+    for name, plan in [("expr_chain", expr_plan), ("window", window_plan)]:
+        run_plan(plan)
+        with dispatch.counting() as c:
+            t0 = time.perf_counter()
+            run_plan(plan)
+            t1 = time.perf_counter()
+        print(f"{name}: {t1-t0:.3f}s  counts={c.counts}")
+    # numpy baselines
+    t0 = time.perf_counter()
+    r = price * qty.astype(np.float32)
+    sc = (np.log(r + np.float32(1.0)) * np.sqrt(np.abs(price - np.float32(50.0)))).astype(np.float64)
+    out = (float(sc.sum()), float(sc.max()))
+    print(f"expr_chain numpy: {time.perf_counter()-t0:.3f}s")
+    import pandas as pd
+    fact_df = pd.DataFrame({"part": part_sk, "price": price})
+    t0 = time.perf_counter()
+    gsort = fact_df.sort_values(["part", "price"], ascending=[True, False]).groupby("part", sort=False)["price"]
+    rk = gsort.cumcount() + 1
+    run = gsort.cumsum()
+    out = (float(rk.sum()), float(run.sum()))
+    print(f"window pandas: {time.perf_counter()-t0:.3f}s")

@@ -63,6 +63,12 @@ class EngineConfig:
     # probe, no sort/searchsorted/pair-expansion): same choices and
     # rationale as group_core. Env override: BLAZE_JOIN_CORE.
     join_core: str = "auto"
+    # Multi-key argsort selection: "scatter" here means the packed-u64
+    # single-lane value sort (one XLA sort per key); "sort" the 3-lane
+    # index lexsort ladder. "auto" = packed on CPU; the lexsort ladder
+    # on TPU, whose no-X64 rewrite pass lacks full u64 support (see
+    # exprs/hashing.py:83). Env override: BLAZE_SORT_CORE.
+    sort_core: str = "auto"
     # Evaluate pushed-down filter conjuncts host-side during parquet
     # decode (pyarrow C++), compacting rows before padding/transfer.
     # Halves transfer bytes at 50% selectivity but costs host CPU; the
