@@ -209,80 +209,204 @@ class FusedAggregateExec(PhysicalOp):
         return f"FusedAggregateExec[{self.pipeline.describe()} -> partial]"
 
     def execute(self, partition: int, ctx: ExecContext):
-        from blaze_tpu.runtime.dispatch import host_int
+        from blaze_tpu.ops.joins import HashJoinExec, JoinType
 
-        from blaze_tpu.config import get_config
-        from blaze_tpu.ops.hash_aggregate import run_grouped_kernel
-        from blaze_tpu.runtime.pack import get_packed
-
+        leaf = self.children[0]
+        if (
+            isinstance(leaf, HashJoinExec)
+            and leaf.join_type is JoinType.INNER
+        ):
+            # INNER join below the fused aggregate: probe per batch and
+            # gather the build side INSIDE the fused kernel, so the
+            # joined batch never materializes and XLA dead-codes build
+            # columns no stage/aggregate references
+            yield from self._execute_join_fused(leaf, partition, ctx)
+            return
         first = True
-        for cb in self.children[0].execute(partition, ctx):
-            layout = cb.layout()
-            cap = layout[0]
-            from blaze_tpu.ops.hash_aggregate import _group_core_choice
-
-            base_key = (
-                "fusedagg", self.pipeline.structure_key(),
-                tuple((e, n) for e, n in self.agg.keys),
-                tuple((a.fn, a.child) for a, _ in self.agg.aggs),
-                layout, _group_core_choice(),
-            )
-
-            def fetch(outs, n_groups):
-                # the single-batch-per-partition hot path: states +
-                # count in ONE packed transfer (a single device round
-                # trip however many state columns). Later batches
-                # (multi-batch stream headed for the device FINAL merge)
-                # stay device-resident and pay only the scalar sync.
-                # `first` stays set until a NON-EMPTY batch was
-                # host-fetched, so a filtered-out leading batch doesn't
-                # push the sole survivor onto the per-column-fetch path.
-                if self.fetch_host and first:
-                    flat = [n_groups]
-                    for v, m in outs:
-                        flat.append(v)
-                        flat.append(m)
-                    host = get_packed(flat)
-                    host_outs = [
-                        (host[1 + 2 * i], host[2 + 2 * i])
-                        for i in range(len(outs))
-                    ]
-                    return host_outs, int(host[0])
-                if not self.agg.keys:
-                    # keyless partial: exactly one group, no collision /
-                    # overflow retry possible - skip the per-batch
-                    # blocking scalar sync (each one is a full tunnel
-                    # round trip on a network-attached chip)
-                    return outs, 1
-                return outs, host_int(n_groups)
-
-            # group-capacity slicing: state arrays leave the kernel cut
-            # to a static slot count so a small grouped result never
-            # crosses the wire (or feeds downstream kernels) at input
-            # capacity. Overflow / hash-collision sentinels re-dispatch
-            # (run_grouped_kernel owns the shared retry ladder).
-            gcap = (1 if not self.agg.keys
-                    else min(cap, get_config().agg_group_capacity))
-            if gcap >= cap:
-                gcap = None
-            host_outs, n = run_grouped_kernel(
-                base_key,
-                lambda fl, gc: self._build_kernel(
+        for cb in leaf.execute(partition, ctx):
+            out, first = self._run_agg(
+                ("fusedagg", cb.layout()),
+                lambda fl, gc, layout=cb.layout(): self._build_kernel(
                     layout, force_lexsort=fl, group_cap=gc
                 ),
                 (cb.device_buffers(), cb.selection, cb.num_rows),
-                fetch,
-                gcap,
+                cb.layout()[0],
+                first,
             )
-            if self.fetch_host and first and n > 0:
-                first = False
-            if n == 0:
-                continue
-            cols = [
-                Column(f.dtype, v, m, None)
-                for f, (v, m) in zip(self._schema.fields, host_outs)
-            ]
-            yield ColumnBatch(self._schema, cols, n)
+            if out is not None:
+                yield out
+
+    def _execute_join_fused(self, join, partition: int,
+                            ctx: ExecContext):
+        from blaze_tpu.ops.joins import _JoinCore, _flatten_cols
+
+        build = join._collect_build(ctx)
+        core = _JoinCore(build, join.left_keys)
+        first = True
+        for pb in join.children[1].execute(partition, ctx):
+            tstate, pb = core.table_state(pb, join.right_keys)
+            if tstate is None:
+                # duplicate build keys / sort core: fall back to the
+                # materialized pair emission + the standard fused kernel
+                state = core.probe(pb, join.right_keys)
+                pb = state[1]
+                out_cols, valid, pair_cap, _mp = core.emit_pairs(
+                    state, list(build.columns), list(pb.columns),
+                    build_first=True,
+                )
+                cb = ColumnBatch(join.schema, out_cols, pair_cap, valid)
+                out, first = self._run_agg(
+                    ("fusedagg", cb.layout()),
+                    lambda fl, gc, layout=cb.layout():
+                        self._build_kernel(
+                            layout, force_lexsort=fl, group_cap=gc
+                        ),
+                    (cb.device_buffers(), cb.selection, cb.num_rows),
+                    cb.layout()[0],
+                    first,
+                )
+            else:
+                _pb, unified_b, unified_p, tab, mode = tstate
+                p_layout = pb.layout()
+                b_layout = build.layout()
+                eq_layout = lambda cols: tuple(
+                    (c.values.dtype.str, c.validity is not None)
+                    for c in cols
+                )
+                b_eq_layout = eq_layout(unified_b)
+                p_eq_layout = eq_layout(unified_p)
+                out, first = self._run_agg(
+                    ("fusedagg_join", mode, p_layout, b_layout,
+                     b_eq_layout, p_eq_layout),
+                    lambda fl, gc: self._build_join_kernel(
+                        mode, p_layout, b_layout, b_eq_layout,
+                        p_eq_layout, force_lexsort=fl, group_cap=gc,
+                    ),
+                    (build.device_buffers(), pb.device_buffers(),
+                     _flatten_cols(unified_b),
+                     _flatten_cols(unified_p),
+                     tab, pb.num_rows),
+                    p_layout[0],
+                    first,
+                )
+            if out is not None:
+                yield out
+
+    def _run_agg(self, key_suffix, build_kernel, args, cap: int,
+                 first: bool):
+        """Shared per-batch aggregate dispatch: run under the retry
+        ladder, fetch per the host-finalize policy, wrap the output.
+        Returns (ColumnBatch | None, first)."""
+        from blaze_tpu.runtime.dispatch import host_int
+
+        from blaze_tpu.config import get_config
+        from blaze_tpu.ops.hash_aggregate import (
+            _group_core_choice,
+            run_grouped_kernel,
+        )
+        from blaze_tpu.runtime.pack import get_packed
+
+        base_key = (
+            key_suffix, self.pipeline.structure_key(),
+            tuple((e, n) for e, n in self.agg.keys),
+            tuple((a.fn, a.child) for a, _ in self.agg.aggs),
+            _group_core_choice(),
+        )
+
+        def fetch(outs, n_groups):
+            # the single-batch-per-partition hot path: states + count
+            # in ONE packed transfer (a single device round trip
+            # however many state columns). Later batches (multi-batch
+            # stream headed for the device FINAL merge) stay
+            # device-resident and pay only the scalar sync. `first`
+            # stays set until a NON-EMPTY batch was host-fetched, so a
+            # filtered-out leading batch doesn't push the sole
+            # survivor onto the per-column-fetch path.
+            if self.fetch_host and first:
+                flat = [n_groups]
+                for v, m in outs:
+                    flat.append(v)
+                    flat.append(m)
+                host = get_packed(flat)
+                host_outs = [
+                    (host[1 + 2 * i], host[2 + 2 * i])
+                    for i in range(len(outs))
+                ]
+                return host_outs, int(host[0])
+            if not self.agg.keys:
+                # keyless partial: exactly one group, no collision /
+                # overflow retry possible - skip the per-batch
+                # blocking scalar sync (each one is a full tunnel
+                # round trip on a network-attached chip)
+                return outs, 1
+            return outs, host_int(n_groups)
+
+        # group-capacity slicing: state arrays leave the kernel cut
+        # to a static slot count so a small grouped result never
+        # crosses the wire (or feeds downstream kernels) at input
+        # capacity. Overflow / hash-collision sentinels re-dispatch
+        # (run_grouped_kernel owns the shared retry ladder).
+        gcap = (1 if not self.agg.keys
+                else min(cap, get_config().agg_group_capacity))
+        if gcap >= cap:
+            gcap = None
+        host_outs, n = run_grouped_kernel(
+            base_key, build_kernel, args, fetch, gcap,
+        )
+        if self.fetch_host and first and n > 0:
+            first = False
+        if n == 0:
+            return None, first
+        cols = [
+            Column(f.dtype, v, m, None)
+            for f, (v, m) in zip(self._schema.fields, host_outs)
+        ]
+        return ColumnBatch(self._schema, cols, n), first
+
+    def _build_join_kernel(self, mode, p_layout, b_layout, b_eq_layout,
+                           p_eq_layout, force_lexsort: bool = False,
+                           group_cap=None):
+        """Fused INNER-join feed, lookup included: hash the probe keys,
+        walk the build hash table, gather the build side at the match
+        indices, splice probe buffers through untouched, then run the
+        standard stage+aggregate composition over the joined column
+        view (selection = the matched flags). One kernel covers
+        lookup+join+stages+aggregate; build columns nothing downstream
+        reads are dead code XLA eliminates - column pruning for free."""
+        from blaze_tpu.ops.joins import _table_lookup, _unflatten_eq
+
+        joined_layout = (
+            p_layout[0], tuple(b_layout[1]) + tuple(p_layout[1])
+        )
+        inner = self._build_kernel(
+            joined_layout, force_lexsort=force_lexsort,
+            group_cap=group_cap,
+        )
+        pcap = p_layout[0]
+        bcap = b_layout[0]
+        b_cols_desc = b_layout[1]
+
+        def kernel(b_bufs, p_bufs, b_eq, p_eq, tab, num_rows):
+            live = jnp.arange(pcap, dtype=jnp.int32) < num_rows
+            pkeys = _unflatten_eq(p_eq_layout, p_eq)
+            for _, m in pkeys:
+                if m is not None:
+                    live = live & m  # NULL join keys never match
+            match_idx, matched = _table_lookup(
+                mode, tab, pkeys, _unflatten_eq(b_eq_layout, b_eq),
+                live, bcap,
+            )
+            g = jnp.clip(match_idx, 0, bcap - 1)
+            joined = []
+            it = iter(b_bufs)
+            for _tid, _prec, _scale, has_mask in b_cols_desc:
+                joined.append(jnp.take(next(it), g, axis=0))
+                if has_mask:
+                    joined.append(jnp.take(next(it), g, axis=0))
+            joined.extend(p_bufs)
+            return inner(tuple(joined), matched, num_rows)
+
+        return kernel
 
     def _build_kernel(self, layout, force_lexsort: bool = False,
                       group_cap=None):

@@ -751,13 +751,6 @@ class HashAggregateExec(PhysicalOp):
                 # not inferred from hash adjacency
                 from blaze_tpu.ops import hash_table as ht
 
-                h = hash_columns_device(
-                    [
-                        (v, m, dt)
-                        for (v, m), dt in zip(keys_cv, hash_dtypes)
-                    ],
-                    capacity,
-                ).astype(jnp.int32)
                 # table sized to the group-slot capacity, not the row
                 # capacity: dense_group_ids scans the whole table, so a
                 # row-capacity table costs ~0.5s/8M rows in cumsum+
@@ -768,7 +761,6 @@ class HashAggregateExec(PhysicalOp):
                 small_t = ht.table_size_for(min(capacity, 2 * out_cap))
                 tsize = min(small_t, full_t)
                 slot, rep_tab, overflow = ht.group_slots(
-                    h,
                     [(v, m) for v, m in keys_cv],
                     live,
                     capacity,

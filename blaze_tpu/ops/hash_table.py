@@ -50,6 +50,76 @@ def table_size_for(capacity: int) -> int:
     return t
 
 
+def probe_table_size(capacity: int) -> int:
+    """Table sizing for JOIN probes: the lookup while_loop runs one
+    full-probe-array pass per round until the LONGEST chain resolves,
+    so load factor directly multiplies probe cost (measured at 131k
+    build keys / 8M probes on XLA:CPU: 1.51s at load 0.5, 0.36s at
+    load 0.125). Aim for 8x the build size, capped at 2^23 slots
+    (32MB) so giant builds degrade to the guaranteed-terminating 2x.
+    Grouping keeps the 2x table: dense_group_ids scans the whole
+    table, so oversizing it costs more than the shorter chains save."""
+    t = table_size_for(capacity)
+    while t < 8 * capacity and t < (1 << 23):
+        t <<= 1
+    return t
+
+
+def cheap_hash(
+    key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
+    capacity: int,
+) -> jax.Array:
+    """Fast intra-engine mixer for PRIVATE table slots (Fibonacci
+    multiply + xorshift finalizer, ~3x cheaper than the full murmur3
+    pipeline at 8M rows). NOT for shuffle partitioning - row placement
+    across executors is a bit-compat contract that must stay
+    spark-murmur3 (exprs/hashing.py). Collisions only cost extra probe
+    rounds, never wrong answers (exact-key verification)."""
+    phi = jnp.uint32(0x9E3779B9)
+    acc = jnp.full(capacity, jnp.uint32(0x243F6A88))
+    for v, m in key_cols:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            # narrow to normalized f32 bits: -0.0 == 0.0 and NaN
+            # payloads collapse so equal keys hash equal; f64 pairs
+            # distinct only beyond f32 precision merely share a chain
+            # (exact comparison still separates them)
+            f32 = v.astype(jnp.float32)
+            f32 = jnp.where(f32 == 0.0, jnp.float32(0.0), f32)
+            f32 = jnp.where(
+                jnp.isnan(f32), jnp.float32(jnp.nan), f32
+            )
+            u = jax.lax.bitcast_convert_type(f32, jnp.uint32)
+        elif v.dtype == jnp.bool_:
+            u = v.astype(jnp.uint32)
+        else:
+            # ALL integer widths route through the int64 fold so the
+            # hash is a function of the VALUE, not the storage width:
+            # an i32 build key then hashes identically to an equal i64
+            # probe key and the generic table joins mixed-width keys
+            # correctly (equality already promotes)
+            b = v.astype(jnp.int64).astype(jnp.uint64)
+            u = (b ^ (b >> jnp.uint64(32))).astype(jnp.uint32)
+        u = u * phi
+        if m is not None:
+            u = jnp.where(m, u, jnp.uint32(0x85EBCA6B))
+        acc = ((acc << jnp.uint32(5)) | (acc >> jnp.uint32(27))) ^ u
+    acc = acc ^ (acc >> jnp.uint32(16))
+    acc = acc * jnp.uint32(0x85EBCA6B)
+    acc = acc ^ (acc >> jnp.uint32(13))
+    return acc.astype(jnp.int32)
+
+
+def _tri_slot(u0, r, mask):
+    """Probe slot r of the triangular (quadratic) sequence
+    h, h+1, h+3, h+6, ... (offsets r(r+1)/2). Triangular offsets visit
+    every slot of a power-of-two table exactly once per period, so
+    termination guarantees carry over from linear probing, but probe
+    sequences from clustered home slots diverge immediately - measured
+    max chain at 131k keys / 1M slots drops from 8 (linear) to ~4."""
+    off = (r * (r + jnp.uint32(1))) >> jnp.uint32(1)
+    return jnp.asarray((u0 + off) & mask, dtype=jnp.int32)
+
+
 def _pairwise_eq(av, am, bv, bm, null_equal: bool):
     """Exact equality of key values gathered from two row sets.
 
@@ -125,15 +195,21 @@ def insert(
 
     self_keys = [(v, m) for v, m in key_cols]
 
+    # lean carry: the probing slot is DERIVED from the round counter
+    # (linear probing: slot_r = home + r); only the resolved slot,
+    # activity and the table ride the carry
+    u0 = slot0.astype(jnp.uint32)
+
     def cond(state):
-        _, _, _, active, _, rounds = state
+        _, _, active, _, rounds = state
         more = jnp.any(active)
         if max_rounds is not None:
-            more = more & (rounds < max_rounds)
+            more = more & (rounds < jnp.uint32(max_rounds))
         return more
 
     def body(state):
-        tab, slot, final_slot, active, dup, rounds = state
+        tab, final_slot, active, dup, rounds = state
+        slot = _tri_slot(u0, rounds, mask)
         occupant = jnp.take(tab, slot)
         # claim only EMPTY slots: occupied slots are immutable, which
         # preserves the linear-probe invariant lookups depend on
@@ -146,30 +222,196 @@ def insert(
         dup = dup | jnp.any(found & (rep != rowidx))
         final_slot = jnp.where(found, slot, final_slot)
         active = active & ~found
-        nxt = jnp.asarray(
-            (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask,
-            dtype=jnp.int32,
-        )
-        slot = jnp.where(active, nxt, slot)
-        return tab, slot, final_slot, active, dup, rounds + 1
+        return tab, final_slot, active, dup, rounds + jnp.uint32(1)
 
     tab0 = jnp.full(table_size, empty, dtype=jnp.int32)
     state = (
         tab0,
-        slot0,
         jnp.zeros(cap, dtype=jnp.int32),
         live,
         jnp.asarray(False),
-        jnp.asarray(0, jnp.int32),
+        jnp.uint32(0),
     )
-    tab, _, final_slot, active, dup, _ = lax.while_loop(
+    tab, final_slot, active, dup, _ = lax.while_loop(
         cond, body, state
     )
     return final_slot, tab, dup, jnp.any(active)
 
 
-def group_slots(
+def key_u32(v: jax.Array, m) -> Optional[jax.Array]:
+    """Exact 32-bit encoding of a single narrow join key, or None when
+    the dtype doesn't fit. Equality of encodings == SQL equality of
+    keys: floats normalize -0.0 to +0.0 and every NaN payload to the
+    canonical quiet NaN (Spark joins match NaN with NaN)."""
+    if v.ndim != 1:
+        return None
+    if v.dtype == jnp.float32:
+        # f64 is NOT eligible: narrowing would merge keys distinct
+        # beyond f32 precision, and unlike hashing this encoding IS the
+        # equality check
+        f = jnp.where(v == 0.0, jnp.float32(0.0), v)
+        bits = jax.lax.bitcast_convert_type(f, jnp.uint32)
+        return jnp.where(jnp.isnan(f), jnp.uint32(0x7FC00000), bits)
+    if v.dtype == jnp.bool_:
+        return v.astype(jnp.uint32)
+    if jnp.issubdtype(v.dtype, jnp.integer) and v.dtype.itemsize <= 4:
+        return v.astype(jnp.int32).astype(jnp.uint32)
+    return None
+
+
+_KR_EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def insert_kr(
+    k32: jax.Array,
     h: jax.Array,
+    live: jax.Array,
+    capacity: int,
+    table_size: int,
+):
+    """Single-narrow-key insert into a fused (key32 << 32 | row) u64
+    table: each probe round is ONE gather + compare (no second
+    indirection through build-key columns), which matters because the
+    while_loop runs for the LONGEST chain and every round is a full
+    pass over the input. Returns (tab u64[table_size], dup).
+
+    Caveat: a key whose encoding is 0xFFFFFFFF with row index
+    0xFFFFFFFF would alias the EMPTY sentinel; row indices are < 2^31,
+    so no live entry can equal EMPTY."""
+    cap = capacity
+    mask = jnp.uint32(table_size - 1)
+    rowidx = jnp.arange(cap, dtype=jnp.uint32)
+    entries = (k32.astype(jnp.uint64) << jnp.uint64(32)) | (
+        rowidx.astype(jnp.uint64)
+    )
+    u0 = h.astype(jnp.uint32) & mask
+
+    def cond(state):
+        _, active, _, _ = state
+        return jnp.any(active)
+
+    def body(state):
+        tab, active, dup, r = state
+        slot = _tri_slot(u0, r, mask)
+        occupant = jnp.take(tab, slot)
+        cand = jnp.where(
+            active & (occupant == _KR_EMPTY), entries, _KR_EMPTY
+        )
+        tab = tab.at[slot].min(cand, mode="drop")
+        entry = jnp.take(tab, slot)
+        same_key = (entry >> jnp.uint64(32)).astype(
+            jnp.uint32
+        ) == k32
+        found = active & (entry != _KR_EMPTY) & same_key
+        dup = dup | jnp.any(
+            found
+            & ((entry & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+               != rowidx)
+        )
+        active = active & ~found
+        return tab, active, dup, r + jnp.uint32(1)
+
+    tab0 = jnp.full(table_size, _KR_EMPTY, dtype=jnp.uint64)
+    tab, _, dup, _ = lax.while_loop(
+        cond, body, (tab0, live, jnp.asarray(False), jnp.uint32(0))
+    )
+    return tab, dup
+
+
+def lookup_kr(
+    tab: jax.Array,
+    k32: jax.Array,
+    h: jax.Array,
+    probe_live: jax.Array,
+):
+    """Probe a fused key-row table: one gather + one compare per round.
+    Returns (match_idx i32 - -1-clipped garbage when unmatched - and
+    matched bool)."""
+    table_size = tab.shape[0]
+    mask = jnp.uint32(table_size - 1)
+    pcap = k32.shape[0]
+    u0 = h.astype(jnp.uint32) & mask
+
+    def round_(r, u0_, k32_, active, match):
+        slot = _tri_slot(u0_, r, mask)
+        entry = jnp.take(tab, slot)
+        is_empty = entry == _KR_EMPTY
+        hit = active & ~is_empty & (
+            (entry >> jnp.uint64(32)).astype(jnp.uint32) == k32_
+        )
+        match = jnp.where(
+            hit,
+            (entry & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32),
+            match,
+        )
+        active = active & ~is_empty & ~hit
+        return active, match
+
+    # unrolled head rounds: the vast majority of probes resolve within
+    # two steps (hit or empty slot) as straight-line code with no
+    # loop-carry traffic
+    active = probe_live
+    match = jnp.full(pcap, -1, dtype=jnp.int32)
+    active, match = round_(jnp.uint32(0), u0, k32, active, match)
+    active, match = round_(jnp.uint32(1), u0, k32, active, match)
+
+    # compacted tail: the ~1% of probes still active (clustered or
+    # displaced keys) gather into a pcap/16 sub-problem so the
+    # remaining rounds touch 16x less memory; if the stragglers ever
+    # exceed the buffer (adversarial clustering), fall back to
+    # full-width rounds - correctness never depends on the estimate
+    tail_cap = max(1024, pcap // 16)
+    n_active = jnp.sum(active)
+
+    def full_width(args):
+        active_, match_ = args
+
+        def cond(state):
+            _, a, _ = state
+            return jnp.any(a)
+
+        def body(state):
+            r, a, m = state
+            a, m = round_(r, u0, k32, a, m)
+            return r + jnp.uint32(1), a, m
+
+        _, _, m = lax.while_loop(
+            cond, body, (jnp.uint32(2), active_, match_)
+        )
+        return m
+
+    def compacted(args):
+        active_, match_ = args
+        idxs = jnp.nonzero(
+            active_, size=tail_cap, fill_value=pcap
+        )[0]
+        safe = jnp.clip(idxs, 0, pcap - 1)
+        s_u0 = jnp.take(u0, safe)
+        s_k32 = jnp.take(k32, safe)
+        s_act = idxs < pcap
+        s_match = jnp.full(tail_cap, -1, dtype=jnp.int32)
+
+        def cond(state):
+            _, a, _ = state
+            return jnp.any(a)
+
+        def body(state):
+            r, a, m = state
+            a, m = round_(r, s_u0, s_k32, a, m)
+            return r + jnp.uint32(1), a, m
+
+        _, _, s_match = lax.while_loop(
+            cond, body, (jnp.uint32(2), s_act, s_match)
+        )
+        return match_.at[idxs].set(s_match, mode="drop")
+
+    match = lax.cond(
+        n_active > tail_cap, full_width, compacted, (active, match)
+    )
+    return match, match >= 0
+
+
+def group_slots(
     key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
     live: jax.Array,
     capacity: int,
@@ -187,6 +429,9 @@ def group_slots(
     both variants compile under one `lax.cond`; out-of-range or
     multi-key inputs take the hash-insert path.
 
+    Hashing happens lazily inside the hash branch (cheap_hash): the
+    direct branch never pays for it.
+
     Returns (slot, rep_tab, overflow)."""
     cap = capacity
     single_int = (
@@ -194,11 +439,16 @@ def group_slots(
         and key_cols[0][0].ndim == 1
         and jnp.issubdtype(key_cols[0][0].dtype, jnp.integer)
     )
-    if not single_int:
+
+    def hash_insert():
+        h = cheap_hash(key_cols, cap)
         slot, tab, _dup, overflow = insert(
             h, key_cols, live, cap, table_size, True, max_rounds
         )
         return slot, tab, overflow
+
+    if not single_int:
+        return hash_insert()
 
     v, m = key_cols[0]
     valid = live if m is None else (live & m)
@@ -226,10 +476,7 @@ def group_slots(
         return slot, tab, jnp.asarray(False)
 
     def hashed(_):
-        slot, tab, _dup, overflow = insert(
-            h, key_cols, live, cap, table_size, True, max_rounds
-        )
-        return slot, tab, overflow
+        return hash_insert()
 
     return lax.cond(in_range, direct, hashed, operand=None)
 
@@ -264,33 +511,40 @@ def lookup(
             ok = ok & _pairwise_eq(pv, pm, bv, bm, null_equal)
         return ok
 
-    def cond(state):
-        _, active, _, _ = state
-        return jnp.any(active)
+    # lean carry: the probe slot is DERIVED from the round counter
+    # (linear probing: slot_r = home + r), and the matched flag lives in
+    # the match sentinel (-1 = no match) - every array dropped from the
+    # carry saves a full-probe-array rewrite per round
+    u0 = slot0.astype(jnp.uint32)
 
-    def body(state):
-        slot, active, match, matched = state
+    def round_(r, active, match):
+        slot = _tri_slot(u0, r, mask)
         rep = jnp.take(rep_tab, slot)
         is_empty = rep == empty
         hit = active & ~is_empty & keys_match(rep)
         match = jnp.where(hit, rep, match)
-        matched = matched | hit
         active = active & ~is_empty & ~hit
-        nxt = jnp.asarray(
-            (slot.astype(jnp.uint32) + jnp.uint32(1)) & mask,
-            dtype=jnp.int32,
-        )
-        slot = jnp.where(active, nxt, slot)
-        return slot, active, match, matched
+        return active, match
 
-    state = (
-        slot0,
-        probe_live,
-        jnp.zeros(pcap, dtype=jnp.int32),
-        jnp.zeros(pcap, dtype=jnp.bool_),
+    def cond(state):
+        _, active, _ = state
+        return jnp.any(active)
+
+    def body(state):
+        r, active, match = state
+        active, match = round_(r, active, match)
+        return r + jnp.uint32(1), active, match
+
+    # unroll the first two rounds: they resolve the vast majority of
+    # probes as straight-line code with no loop-carry copies
+    active = probe_live
+    match = jnp.full(pcap, -1, dtype=jnp.int32)
+    active, match = round_(jnp.uint32(0), active, match)
+    active, match = round_(jnp.uint32(1), active, match)
+    _, _, match = lax.while_loop(
+        cond, body, (jnp.uint32(2), active, match)
     )
-    _, _, match, matched = lax.while_loop(cond, body, state)
-    return match, matched
+    return match, match >= 0
 
 
 def dense_group_ids(

@@ -178,6 +178,9 @@ class _JoinCore:
         # re-pay the insert pass + blocking dup sync) per probe batch
         # when dictionary-encoded keys force an index rebuild
         self._table_demoted = False
+        # kr -> generic downgrade (probe key wider than the 32-bit kr
+        # encoding); remembered for the same reason
+        self._force_generic = False
 
     def _ensure_index(self, build_cols: List[Column]):
         # the index is probe-invariant unless a build key is
@@ -188,25 +191,33 @@ class _JoinCore:
             c.dtype.is_dictionary_encoded for c in build_cols
         ):
             return
-        bufs = _key_hash_cols(build_cols)
-        dtypes = tuple(d for _, _, d in bufs)
         cap = self.build.capacity
 
-        if not self._table_demoted and _join_core_choice() == "scatter":
+        if (
+            not self._table_demoted
+            and _join_core_choice() == "scatter"
+            # wide-decimal keys are host-tier work either way; the
+            # sorted path below carries the NotImplementedError guard
+            and not any(c.dtype.is_wide_decimal for c in build_cols)
+        ):
             from blaze_tpu.ops import hash_table as ht
 
             eq_layout = tuple(
                 (c.values.dtype.str, c.validity is not None)
                 for c in build_cols
             )
-            tsize = ht.table_size_for(cap)
+            # size off the LIVE row count (host-known), not the padded
+            # shape-bucket capacity: a 131k-row dim table in a 1M
+            # bucket would otherwise get an 8M-slot table whose random
+            # gathers fall out of cache
+            tsize = ht.probe_table_size(
+                max(1, int(self.build.num_rows))
+            )
+
+            kr = _kr_eligible(build_cols) and not self._force_generic
 
             def build_table():
-                def kernel(values, valids, eq_bufs, num_rows):
-                    cols = list(zip(values, valids, dtypes))
-                    h = hash_columns_device(cols, cap).astype(
-                        jnp.int32
-                    )
+                def kernel(eq_bufs, num_rows):
                     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
                     key_cols = _unflatten_eq(eq_layout, eq_bufs)
                     # NULL join keys never match: keep them (and the
@@ -214,6 +225,15 @@ class _JoinCore:
                     for _, m in key_cols:
                         if m is not None:
                             live = live & m
+                    h = ht.cheap_hash(key_cols, cap)
+                    if kr:
+                        # fused (key32|row) entries: probes need ONE
+                        # gather per round instead of table->row->key
+                        k32 = ht.key_u32(*key_cols[0])
+                        tab, dup = ht.insert_kr(
+                            k32, h, live, cap, tsize
+                        )
+                        return tab, dup
                     _slot, tab, dup, _ovf = ht.insert(
                         h, key_cols, live, cap, tsize,
                         null_equal=False,
@@ -223,20 +243,21 @@ class _JoinCore:
                 return kernel
 
             fn = cached_kernel(
-                ("join_table", dtypes, eq_layout, cap), build_table
+                ("join_table", eq_layout, cap, tsize, kr), build_table
             )
             tab, dup = fn(
-                tuple(v for v, _, _ in bufs),
-                tuple(m for _, m, _ in bufs),
                 _flatten_cols(build_cols),
                 self.build.num_rows,
             )
             # one blocking scalar per build relation: unique keys take
             # the table core; duplicates demote to the sorted core
             if not host_int(dup):
-                self._index = ("table", tab)
+                self._index = ("table_kr" if kr else "table", tab)
                 return
             self._table_demoted = True
+
+        bufs = _key_hash_cols(build_cols)
+        dtypes = tuple(d for _, _, d in bufs)
 
         def build():
             def kernel(values, valids, num_rows):
@@ -266,6 +287,52 @@ class _JoinCore:
         )
         self._index = ("sorted", h_sorted, order)
 
+    def _check_probe_dtypes(self, unified_b, unified_p):
+        """The kr table's 32-bit key encoding cannot express a wider
+        probe key (i64/f64 vs an i32/f32 build): rebuild as a GENERIC
+        table, whose cheap_hash is value-consistent across widths and
+        whose equality check promotes - mixed-width keys then join
+        correctly (the sorted core's murmur3 is dtype-semantic, Spark
+        hashInt vs hashLong, and would silently miss them)."""
+        if self._index[0] != "table_kr":
+            return
+        if all(
+            b.values.dtype == p.values.dtype
+            for b, p in zip(unified_b, unified_p)
+        ):
+            return
+        self._force_generic = True
+        self._index = None
+        self._ensure_index(unified_b)
+
+    def table_state(self, probe_cb: ColumnBatch,
+                    probe_keys: List[int]):
+        """Table-core state WITHOUT dispatching the lookup kernel, for
+        callers that fuse the lookup into their own program (the fused
+        join+aggregate path). Returns ((probe_cb, unified_b, unified_p,
+        tab, mode) | None, probe_cb): `mode` is "table" (row-index
+        table, ht.lookup) or "table_kr" (fused key|row u64 entries,
+        ht.lookup_kr); None means the core resolved to sorted
+        (duplicate keys or the sort knob) and the caller should use
+        probe()/emit_pairs()."""
+        probe_cb = ensure_compacted(probe_cb)
+        build_cols = [self.build.columns[i] for i in self.build_keys]
+        probe_cols = [probe_cb.columns[i] for i in probe_keys]
+        unified_b, unified_p = [], []
+        for bc, pc_ in zip(build_cols, probe_cols):
+            b2, p2 = _unify_key_pair(bc, pc_)
+            unified_b.append(b2)
+            unified_p.append(p2)
+        self._ensure_index(unified_b)
+        self._check_probe_dtypes(unified_b, unified_p)
+        if self._index[0] not in ("table", "table_kr"):
+            return None, probe_cb
+        return (
+            (probe_cb, unified_b, unified_p, self._index[1],
+             self._index[0]),
+            probe_cb,
+        )
+
     def probe(self, probe_cb: ColumnBatch, probe_keys: List[int]):
         """Hash the probe keys and size the pair expansion (one host
         sync). Returns the state tuple for emit_pairs(); emission - and
@@ -280,13 +347,11 @@ class _JoinCore:
             unified_b.append(b2)
             unified_p.append(p2)
         self._ensure_index(unified_b)
-        pbufs = _key_hash_cols(unified_p)
-        pdtypes = tuple(d for _, _, d in pbufs)
+        self._check_probe_dtypes(unified_b, unified_p)
         pcap = probe_cb.capacity
 
-        if self._index[0] == "table":
-            from blaze_tpu.ops import hash_table as ht
-
+        if self._index[0] in ("table", "table_kr"):
+            mode = self._index[0]
             tab = self._index[1]
             bcap = self.build.capacity
             b_eq_layout = tuple(
@@ -299,11 +364,7 @@ class _JoinCore:
             )
 
             def build_lookup():
-                def kernel(values, valids, b_eq, p_eq, tab, num_rows):
-                    cols = list(zip(values, valids, pdtypes))
-                    h = hash_columns_device(cols, pcap).astype(
-                        jnp.int32
-                    )
+                def kernel(b_eq, p_eq, tab, num_rows):
                     live = (
                         jnp.arange(pcap, dtype=jnp.int32) < num_rows
                     )
@@ -311,22 +372,20 @@ class _JoinCore:
                     for _, m in pkeys:
                         if m is not None:
                             live = live & m  # NULL never matches
-                    return ht.lookup(
-                        tab, h, pkeys,
+                    return _table_lookup(
+                        mode, tab, pkeys,
                         _unflatten_eq(b_eq_layout, b_eq),
-                        live, bcap, null_equal=False,
+                        live, bcap,
                     )
 
                 return kernel
 
             fn = cached_kernel(
-                ("join_lookup", pdtypes, b_eq_layout, p_eq_layout,
-                 bcap, pcap),
+                ("join_lookup", mode, b_eq_layout, p_eq_layout, bcap,
+                 pcap),
                 build_lookup,
             )
             match_idx, matched = fn(
-                tuple(v for v, _, _ in pbufs),
-                tuple(m for _, m, _ in pbufs),
                 _flatten_cols(unified_b),
                 _flatten_cols(unified_p),
                 tab,
@@ -339,6 +398,8 @@ class _JoinCore:
             )
 
         _tag, h_sorted, order = self._index
+        pbufs = _key_hash_cols(unified_p)
+        pdtypes = tuple(d for _, _, d in pbufs)
 
         def build_counts():
             def kernel(values, valids, h_sorted, num_rows):
@@ -554,6 +615,32 @@ class _JoinCore:
         else:
             out_cols = pcols + bcols
         return out_cols, valid, pair_cap, valid
+
+
+def _kr_eligible(cols: List[Column]) -> bool:
+    """Single narrow key -> the fused (key|row) u64 table applies."""
+    if len(cols) != 1:
+        return False
+    dt = cols[0].values.dtype
+    return bool(
+        dt == jnp.bool_
+        or dt == jnp.float32
+        or (jnp.issubdtype(dt, jnp.integer) and dt.itemsize <= 4)
+    )
+
+
+def _table_lookup(mode, tab, pkeys, bkeys, live, bcap):
+    """Mode-dispatched table probe shared by the standalone lookup
+    kernel and the fused join+aggregate kernel."""
+    from blaze_tpu.ops import hash_table as ht
+
+    h = ht.cheap_hash(pkeys, live.shape[0])
+    if mode == "table_kr":
+        k32 = ht.key_u32(*pkeys[0])
+        return ht.lookup_kr(tab, k32, h, live)
+    return ht.lookup(
+        tab, h, pkeys, bkeys, live, bcap, null_equal=False
+    )
 
 
 def _unflatten_eq(layout, bufs):

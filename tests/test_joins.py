@@ -344,3 +344,42 @@ def test_build_padding_does_not_inflate_pair_expansion():
         assert total_cap <= 2 * (1 << 16), total_cap
     finally:
         set_config(saved)
+
+
+def test_mixed_width_join_keys_demote_table_core():
+    """An i64 probe key against an i32 build key cannot use the table
+    cores (dtype-dependent hash/encoding would miss matches or crash
+    the kr encoder); it must demote to the sorted core and stay
+    correct. Regression: review r4 found ht.key_u32(None) crash."""
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.joins import HashJoinExec, JoinType
+    from blaze_tpu.ops.util import ensure_compacted
+
+    build = pa.record_batch({
+        "k": np.array([1, 2, 3, -4, 5], dtype=np.int32),
+        "b": np.array([10, 20, 30, 40, 50], dtype=np.int32),
+    })
+    probe = pa.record_batch({
+        "k": np.array([3, -4, -4, 99, 1, 2**40], dtype=np.int64),
+        "p": np.arange(6, dtype=np.int32),
+    })
+    bcb = ColumnBatch.from_arrow(build)
+    pcb = ColumnBatch.from_arrow(probe)
+    join = HashJoinExec(
+        MemoryScanExec([[bcb]], bcb.schema),
+        MemoryScanExec([[pcb]], pcb.schema),
+        ["k"], ["k"], JoinType.INNER,
+    )
+    rows = []
+    for cb in join.execute(0, ExecContext()):
+        t = ensure_compacted(cb).to_arrow()
+        rows += list(
+            zip(t.column("b").to_pylist(), t.column("p").to_pylist())
+        )
+    # 3->30, -4 matches twice, 1->10; 99 and 2^40 match nothing
+    assert sorted(rows) == [(10, 4), (30, 0), (40, 1), (40, 2)]
