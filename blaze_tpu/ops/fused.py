@@ -269,12 +269,10 @@ class FusedAggregateExec(PhysicalOp):
                 _pb, unified_b, unified_p, tab, mode = tstate
                 p_layout = pb.layout()
                 b_layout = build.layout()
-                eq_layout = lambda cols: tuple(
-                    (c.values.dtype.str, c.validity is not None)
-                    for c in cols
-                )
-                b_eq_layout = eq_layout(unified_b)
-                p_eq_layout = eq_layout(unified_p)
+                from blaze_tpu.ops.joins import _eq_layout
+
+                b_eq_layout = _eq_layout(unified_b)
+                p_eq_layout = _eq_layout(unified_p)
                 out, first = self._run_agg(
                     ("fusedagg_join", mode, p_layout, b_layout,
                      b_eq_layout, p_eq_layout),

@@ -35,6 +35,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -259,7 +261,11 @@ def key_u32(v: jax.Array, m) -> Optional[jax.Array]:
     return None
 
 
-_KR_EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# numpy scalar, NOT jnp: a concrete jnp array at module level gets
+# lifted into every closing jaxpr as a runtime input, which breaks
+# re-execution of cached kernels (jit fastpath supplies one fewer
+# buffer than the compiled program expects)
+_KR_EMPTY = _np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def insert_kr(

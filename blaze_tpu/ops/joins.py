@@ -202,10 +202,7 @@ class _JoinCore:
         ):
             from blaze_tpu.ops import hash_table as ht
 
-            eq_layout = tuple(
-                (c.values.dtype.str, c.validity is not None)
-                for c in build_cols
-            )
+            eq_layout = _eq_layout(build_cols)
             # size off the LIVE row count (host-known), not the padded
             # shape-bucket capacity: a 131k-row dim table in a 1M
             # bucket would otherwise get an 8M-slot table whose random
@@ -354,14 +351,8 @@ class _JoinCore:
             mode = self._index[0]
             tab = self._index[1]
             bcap = self.build.capacity
-            b_eq_layout = tuple(
-                (c.values.dtype.str, c.validity is not None)
-                for c in unified_b
-            )
-            p_eq_layout = tuple(
-                (c.values.dtype.str, c.validity is not None)
-                for c in unified_p
-            )
+            b_eq_layout = _eq_layout(unified_b)
+            p_eq_layout = _eq_layout(unified_p)
 
             def build_lookup():
                 def kernel(b_eq, p_eq, tab, num_rows):
@@ -398,7 +389,23 @@ class _JoinCore:
             )
 
         _tag, h_sorted, order = self._index
-        pbufs = _key_hash_cols(unified_p)
+        # hash-time cast for mixed-width keys: murmur3 is dtype-semantic
+        # (Spark hashInt != hashLong for equal values), so a wider probe
+        # key hashes into the wrong run and silently misses. Casting the
+        # probe to the build dtype FOR BUCKETING ONLY is safe: values
+        # outside the build dtype's range wrap into some run whose
+        # candidates the emit kernel's exact (promoting) equality check
+        # rejects, and in-range/representable values cast losslessly.
+        hash_p = [
+            p2 if p2.values.dtype == b2.values.dtype
+            or p2.dtype.is_dictionary_encoded
+            else Column(
+                b2.dtype, p2.values.astype(b2.values.dtype),
+                p2.validity, p2.dictionary,
+            )
+            for b2, p2 in zip(unified_b, unified_p)
+        ]
+        pbufs = _key_hash_cols(hash_p)
         pdtypes = tuple(d for _, _, d in pbufs)
 
         def build_counts():
@@ -615,6 +622,15 @@ class _JoinCore:
         else:
             out_cols = pcols + bcols
         return out_cols, valid, pair_cap, valid
+
+
+def _eq_layout(cols: List[Column]) -> Tuple:
+    """Hashable layout of (values dtype, has-validity) per key column -
+    MUST stay the single source for both kernel cache keys and
+    _unflatten_eq buffer reconstruction."""
+    return tuple(
+        (c.values.dtype.str, c.validity is not None) for c in cols
+    )
 
 
 def _kr_eligible(cols: List[Column]) -> bool:

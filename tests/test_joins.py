@@ -383,3 +383,27 @@ def test_mixed_width_join_keys_demote_table_core():
         )
     # 3->30, -4 matches twice, 1->10; 99 and 2^40 match nothing
     assert sorted(rows) == [(10, 4), (30, 0), (40, 1), (40, 2)]
+
+    # DUPLICATE build keys demote to the sorted core, which must also
+    # join mixed-width keys correctly (hash-time cast of the probe to
+    # the build dtype; murmur3 is dtype-semantic so an uncast i64 probe
+    # would silently miss every run)
+    build2 = pa.record_batch({
+        "k": np.array([1, 1, 2, -4], dtype=np.int32),
+        "b": np.array([10, 11, 20, 40], dtype=np.int32),
+    })
+    b2cb = ColumnBatch.from_arrow(build2)
+    join2 = HashJoinExec(
+        MemoryScanExec([[b2cb]], b2cb.schema),
+        MemoryScanExec([[pcb]], pcb.schema),
+        ["k"], ["k"], JoinType.INNER,
+    )
+    rows2 = []
+    for cb in join2.execute(0, ExecContext()):
+        t = ensure_compacted(cb).to_arrow()
+        rows2 += list(
+            zip(t.column("b").to_pylist(), t.column("p").to_pylist())
+        )
+    # probe [3,-4,-4,99,1,2^40]: 1 matches b=10 and b=11, -4 (twice)
+    # matches b=40
+    assert sorted(rows2) == [(10, 4), (11, 4), (40, 1), (40, 2)]
