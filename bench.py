@@ -241,10 +241,12 @@ def main():
                 "vs_baseline": 0.0,
             }
     if degraded:
+        prior = res.get("error")  # keep salvage diagnostics
         res["error"] = (
             "TPU backend unavailable; degraded measurement "
             f"(probe budget {PROBE_BUDGET}s, spent {probe_s}s, "
             f"{attempt} attempts). " + "; ".join(e or "?" for e in errors)
+            + (f" | {prior}" if prior else "")
         )
     print(json.dumps(res))
 
@@ -350,6 +352,39 @@ def _tpu_core_probe(n=1 << 20):
                     out[f"{knob}_{mode}_s"] = f"error: {e}"[:120]
                 finally:
                     os.environ.pop(env, None)
+        # Pallas one-hot segmented reduce vs the XLA scatter (Mosaic
+        # compile + perf): decides whether BLAZE_SEGREDUCE=pallas goes
+        # default-on next round
+        try:
+            import jax.numpy as jnp
+
+            from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+            k = 4096
+            gid = jnp.asarray(
+                np.random.default_rng(8).integers(
+                    0, k, n
+                ).astype(np.int32)
+            )
+            vv = jnp.asarray(
+                np.random.default_rng(9).random(n).astype(np.float32)
+            )
+            f1 = jax.jit(lambda: sr.segment_sum(gid, vv, k))
+            jax.block_until_ready(f1())
+            t0 = time.perf_counter()
+            jax.block_until_ready(f1())
+            out["pallas_segsum_s"] = round(
+                time.perf_counter() - t0, 4
+            )
+            f2 = jax.jit(
+                lambda: jax.ops.segment_sum(vv, gid, num_segments=k)
+            )
+            jax.block_until_ready(f2())
+            t0 = time.perf_counter()
+            jax.block_until_ready(f2())
+            out["xla_segsum_s"] = round(time.perf_counter() - t0, 4)
+        except Exception as e:  # noqa: BLE001
+            out["pallas_segsum_s"] = f"error: {e}"[:120]
     except Exception:  # noqa: BLE001
         return out
     return out

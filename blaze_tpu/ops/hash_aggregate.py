@@ -140,9 +140,27 @@ class _SegOps:
     matters enormously on TPU where scatters serialize."""
 
     def __init__(self, gid, out_cap: int, keyless: bool):
+        import os
+
         self.gid = gid
         self.out_cap = out_cap
         self.scalar = keyless and out_cap == 1
+        # opt-in MXU path: the one-hot-contraction Pallas kernel
+        # (ops/kernels/segreduce_pallas.py) replaces the XLA scatter
+        # for f32 min/max over bounded key domains. Default off until
+        # the end-of-round bench's tpu_core_probe validates it on a
+        # real chip (scatters serialize on TPU; the contraction rides
+        # the MXU).
+        self._pallas = os.environ.get("BLAZE_SEGREDUCE") == "pallas"
+
+    def _pallas_ok(self, x) -> bool:
+        if not self._pallas or self.scalar or x.ndim != 1:
+            return False
+        if x.dtype != jnp.float32:
+            return False
+        from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+        return sr.supports(x.shape[0], self.out_cap)
 
     def sum(self, x):
         if self.scalar:
@@ -154,6 +172,12 @@ class _SegOps:
     def min(self, x):
         if self.scalar:
             return jnp.min(x, axis=0, keepdims=True)
+        if self._pallas_ok(x):
+            from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+            return sr.segment_minmax(
+                self.gid, x, self.out_cap, is_min=True
+            )
         return jax.ops.segment_min(
             x, self.gid, num_segments=self.out_cap
         )
@@ -161,6 +185,12 @@ class _SegOps:
     def max(self, x):
         if self.scalar:
             return jnp.max(x, axis=0, keepdims=True)
+        if self._pallas_ok(x):
+            from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+            return sr.segment_minmax(
+                self.gid, x, self.out_cap, is_min=False
+            )
         return jax.ops.segment_max(
             x, self.gid, num_segments=self.out_cap
         )

@@ -450,14 +450,8 @@ class _JoinCore:
          pair_cap) = probe_state
         bcap = self.build.capacity
         pcap = probe_cb.capacity
-        b_layout = tuple(
-            (c.values.dtype.str, c.validity is not None)
-            for c in out_build_cols
-        )
-        p_layout = tuple(
-            (c.values.dtype.str, c.validity is not None)
-            for c in out_probe_cols
-        )
+        b_layout = _eq_layout(out_build_cols)
+        p_layout = _eq_layout(out_probe_cols)
         k_layout = tuple(
             (b2.values.dtype.str, b2.validity is not None,
              p2.values.dtype.str, p2.validity is not None)
@@ -571,10 +565,7 @@ class _JoinCore:
         _tag, probe_cb, match_idx, matched, pair_cap = probe_state
         bcap = self.build.capacity
         pcap = probe_cb.capacity
-        b_layout = tuple(
-            (c.values.dtype.str, c.validity is not None)
-            for c in out_build_cols
-        )
+        b_layout = _eq_layout(out_build_cols)
 
         def build_emit():
             def kernel(match_idx, matched, bout_bufs, probe_rows,

@@ -1,0 +1,163 @@
+"""Pallas kernel: mask compaction (the filter pipeline-breaker).
+
+Reference counterpart: the selection-vector materialization inside
+FilterExec that the reference gets from DataFusion's `filter` compute
+kernel (from_proto.rs FilterExec arm); SURVEY 7 names compaction as the
+second TPU-first Pallas target. The engine usually DEFERS selection
+(batch.ColumnBatch.selection rides through fused kernels), but pipeline
+breakers (shuffle writers, external spill, host hand-off) must
+physically drop dead rows.
+
+A naive gather-by-sorted-indices serializes on TPU. This kernel keeps
+everything matrix-shaped:
+
+  per row-block (1024 rows):
+    pos[i]  = cumsum(keep)[i] - 1          (block-local target slot)
+    out[j]  = sum_i v[i] * (pos[i] == j & keep[i])   - an MXU
+              contraction against the block-local permutation one-hot
+  per block it also emits the block's keep-count.
+
+Cross-block stitching happens in jnp glue (`compact_column`): block
+outputs are dense prefixes, so one gather with indices derived from the
+per-block count prefix sum concatenates them - the gather touches only
+surviving rows. Ints ride the same f32 contraction exactly up to 2^24;
+wider ints split into two 16-bit planes contracted separately and
+recombined (exact for the full int32 range).
+
+Tested with interpret=True on CPU (tests/test_pallas_kernels.py);
+hardware enablement follows the same bench-gated path as the
+segmented-reduce kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_ROWS_BLK = 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _compact_kernel(v_ref, keep_ref, out_ref, cnt_ref):
+    v = v_ref[:].reshape(_ROWS_BLK).astype(jnp.float32)
+    keep = keep_ref[:].reshape(_ROWS_BLK)
+    kept = keep != 0
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    pos = jnp.where(kept, pos, -1)
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (_ROWS_BLK, _ROWS_BLK), 1
+    )
+    oh = (pos[:, None] == cols).astype(jnp.float32)
+    out = jax.lax.dot_general(
+        v[None, :], oh,
+        (((1,), (0,)), ((), ())),
+        # HIGHEST: default MXU precision truncates operands to bf16,
+        # which would corrupt the "moved exactly once" guarantee (and
+        # the int32 plane reconstruction) on real hardware
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).reshape(_ROWS_BLK)
+    out_ref[:] = out.reshape(out_ref.shape)
+    cnt_ref[0, 0] = jnp.sum(keep.astype(jnp.int32))
+
+
+def _call_compact(v2, keep2, n_blocks: int):
+    blk = (_ROWS_BLK // _LANES, _LANES)
+    return pl.pallas_call(
+        _compact_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (1,) + blk, lambda b: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1,) + blk, lambda b: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1,) + blk, lambda b: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda b: (b, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (n_blocks,) + blk, jnp.float32
+            ),
+            jax.ShapeDtypeStruct((n_blocks, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(v2, keep2)
+
+
+def supports(capacity: int) -> bool:
+    return capacity % _ROWS_BLK == 0
+
+
+@jax.jit
+def compact_column_f32(v: jax.Array, keep: jax.Array):
+    """Compact one f32 column by a boolean mask.
+
+    Returns (compacted, n_live): `compacted` has the input's length,
+    live rows packed at the front, zeros after. Exact for f32 (the
+    one-hot contraction moves each value once, no arithmetic)."""
+    cap = v.shape[0]
+    n_blocks = cap // _ROWS_BLK
+    shape3 = (n_blocks, _ROWS_BLK // _LANES, _LANES)
+    blocks, cnts = _call_compact(
+        v.astype(jnp.float32).reshape(shape3),
+        keep.astype(jnp.int32).reshape(shape3),
+        n_blocks,
+    )
+    flat = blocks.reshape(n_blocks, _ROWS_BLK)
+    cnts = cnts.reshape(n_blocks)
+    # stitch: global position of block b's local slot j is
+    # offset[b] + j; invert to a single gather of surviving rows
+    offsets = jnp.cumsum(cnts) - cnts
+    n_live = jnp.sum(cnts)
+    out_pos = jnp.arange(cap, dtype=jnp.int32)
+    # for each output slot, which (block, local) produced it?
+    blk_of = jnp.searchsorted(
+        jnp.cumsum(cnts), out_pos, side="right"
+    ).astype(jnp.int32)
+    blk_of = jnp.clip(blk_of, 0, n_blocks - 1)
+    local = out_pos - jnp.take(offsets, blk_of)
+    src = blk_of * _ROWS_BLK + jnp.clip(local, 0, _ROWS_BLK - 1)
+    gathered = jnp.take(flat.reshape(cap), src)
+    return (
+        jnp.where(out_pos < n_live, gathered, jnp.float32(0.0)),
+        n_live,
+    )
+
+
+@jax.jit
+def compact_column_i32(v: jax.Array, keep: jax.Array):
+    """Exact int32 compaction: two 16-bit planes ride the f32
+    contraction (each plane < 2^16 is exactly representable) and
+    recombine."""
+    cap = v.shape[0]
+    vi = v.astype(jnp.int32)
+    lo = (vi & jnp.int32(0xFFFF)).astype(jnp.float32)
+    hi = jax.lax.shift_right_logical(
+        vi, jnp.int32(16)
+    ).astype(jnp.float32)
+    clo, n_live = compact_column_f32(lo, keep)
+    chi, _ = compact_column_f32(hi, keep)
+    out = (
+        chi.astype(jnp.int32) << jnp.int32(16)
+    ) | clo.astype(jnp.int32)
+    out_pos = jnp.arange(cap, dtype=jnp.int32)
+    return jnp.where(out_pos < n_live, out, jnp.int32(0)), n_live

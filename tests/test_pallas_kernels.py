@@ -86,3 +86,91 @@ def test_masked_stats_interpret():
     np.testing.assert_allclose(got[0], isel.sum(), rtol=1e-4)
     assert got[1] == isel.min() and got[2] == isel.max()
     assert got[3] == len(isel)
+
+
+def test_pallas_segment_sum_interpret():
+    from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+    rng = np.random.default_rng(5)
+    cap, k = 4096, 512
+    gid = rng.integers(0, k, cap).astype(np.int32)
+    # park some rows out of range: they must contribute nowhere
+    gid[::97] = k + 3
+    v = (rng.random(cap) * 100 - 50).astype(np.float32)
+    assert sr.supports(cap, k)
+    got = np.asarray(sr.segment_sum(jnp.asarray(gid), jnp.asarray(v), k))
+    exp = np.zeros(k, np.float64)
+    for g, x in zip(gid, v):
+        if g < k:
+            exp[g] += x
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_segment_minmax_interpret():
+    from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+    rng = np.random.default_rng(6)
+    cap, k = 2048, 512
+    gid = rng.integers(0, k, cap).astype(np.int32)
+    v = (rng.random(cap) * 1000 - 500).astype(np.float32)
+    lo = np.asarray(
+        sr.segment_minmax(jnp.asarray(gid), jnp.asarray(v), k, True)
+    )
+    hi = np.asarray(
+        sr.segment_minmax(jnp.asarray(gid), jnp.asarray(v), k, False)
+    )
+    for g in range(k):
+        sel = v[gid == g]
+        if len(sel):
+            assert lo[g] == sel.min()
+            assert hi[g] == sel.max()
+        else:
+            assert lo[g] == np.inf and hi[g] == -np.inf
+
+
+def test_pallas_compact_interpret():
+    from blaze_tpu.ops.kernels import compact_pallas as cp
+
+    rng = np.random.default_rng(7)
+    cap = 4096
+    v = (rng.random(cap) * 100 - 50).astype(np.float32)
+    keep = rng.random(cap) < 0.37
+    assert cp.supports(cap)
+    out, n = cp.compact_column_f32(jnp.asarray(v), jnp.asarray(keep))
+    out = np.asarray(out)
+    n = int(n)
+    exp = v[keep]
+    assert n == len(exp)
+    np.testing.assert_array_equal(out[:n], exp)
+    assert (out[n:] == 0).all()
+
+
+def test_pallas_compact_i32_exact_full_range():
+    from blaze_tpu.ops.kernels import compact_pallas as cp
+
+    rng = np.random.default_rng(8)
+    cap = 2048
+    v = rng.integers(-(2**31), 2**31, cap).astype(np.int32)
+    keep = rng.random(cap) < 0.5
+    out, n = cp.compact_column_i32(jnp.asarray(v), jnp.asarray(keep))
+    out = np.asarray(out)
+    n = int(n)
+    np.testing.assert_array_equal(out[:n], v[keep])
+
+
+def test_pallas_segment_sum_matches_engine_segops():
+    """Parity with the aggregate's XLA segment path (the operator-suite
+    cross-check VERDICT r3 asked for)."""
+    import jax
+
+    from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+    rng = np.random.default_rng(9)
+    cap, k = 8192, 1024
+    gid = jnp.asarray(rng.integers(0, k, cap).astype(np.int32))
+    v = jnp.asarray((rng.random(cap) * 10).astype(np.float32))
+    xla = jax.ops.segment_sum(v, gid, num_segments=k)
+    pls = sr.segment_sum(gid, v, k)
+    np.testing.assert_allclose(
+        np.asarray(pls), np.asarray(xla), rtol=1e-4, atol=1e-3
+    )
