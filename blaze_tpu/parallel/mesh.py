@@ -41,3 +41,14 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def allgather_rows(x, n_dev: int, trailing: bool = True):
+    """Gather a data-sharded array onto every process, normalized to
+    [n_dev, ...] regardless of how allgather stacks the shards. The
+    shared normalization for the launcher workloads and the mesh
+    operators (divergent private copies drift)."""
+    from jax.experimental import multihost_utils
+
+    g = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return g.reshape((n_dev, -1) if trailing else (n_dev,))

@@ -41,7 +41,14 @@ class MeshGroupByExec(PhysicalOp):
                  keys: Sequence[Tuple[ir.Expr, str]],
                  aggs: Sequence[Tuple[AggExpr, str]],
                  filter_pred: ir.Expr = None,
-                 mesh=None):
+                 mesh=None,
+                 fallback: PhysicalOp = None):
+        # data-dependent ineligibility (nullable inputs materializing
+        # actual validity masks) only surfaces at execution: `fallback`
+        # is the ORIGINAL aggregate plan to run instead - the runtime
+        # half of tryConvert semantics
+        self.fallback = fallback
+        self._use_fallback = False
         self.children = [child]
         self.mesh = mesh or get_mesh()
         in_schema = child.schema
@@ -99,22 +106,44 @@ class MeshGroupByExec(PhysicalOp):
         assert child.partition_count <= n_dev, (
             "more partitions than devices; use the exchange tier"
         )
-        per_part = [
-            concat_batches(
+        per_part = []
+        for p in range(child.partition_count):
+            b = concat_batches(
                 list(child.execute(p, ctx)), schema=child.schema
             )
-            for p in range(child.partition_count)
-        ]
-        for b in per_part:
+            # fail fast BEFORE materializing the remaining partitions:
+            # a nullable input detected here falls back to the
+            # original plan, and everything collected so far is sunk
+            # cost
             for c in b.columns:
                 if c.validity is not None:
                     raise NotImplementedError(
                         "mesh group-by handles non-nullable columns; "
                         "nullable inputs use the exchange tier"
                     )
+            per_part.append(b)
         # pad to a common capacity and stack [n_dev, cap] per column
         cap = max(max((b.capacity for b in per_part), default=1), 1)
         ncols = len(child.schema)
+        from blaze_tpu.parallel.mesh import data_sharding
+
+        sharding = data_sharding(self.mesh)
+        multi = jax.process_count() > 1
+
+        def to_mesh(global_np):
+            # single-controller: a plain device array suffices. Multi-
+            # process SPMD: every rank holds the full logical value (the
+            # task decodes rank-symmetrically), so build the global
+            # array from each rank's addressable shards - a plain
+            # jnp.asarray would be process-local and the pjit would
+            # reject it
+            if not multi:
+                return jnp.asarray(global_np)
+            return jax.make_array_from_callback(
+                global_np.shape, sharding,
+                lambda idx: global_np[idx],
+            )
+
         stacked = []
         for ci in range(ncols):
             phys = child.schema.fields[ci].dtype.physical_dtype()
@@ -126,8 +155,8 @@ class MeshGroupByExec(PhysicalOp):
                 rows.append(v)
             for _ in range(n_dev - len(per_part)):
                 rows.append(np.zeros(cap, dtype=phys))
-            stacked.append(jnp.asarray(np.stack(rows)))
-        num_rows = jnp.asarray(
+            stacked.append(to_mesh(np.stack(rows)))
+        num_rows = to_mesh(
             np.array(
                 [b.num_rows for b in per_part]
                 + [0] * (n_dev - len(per_part)),
@@ -135,12 +164,31 @@ class MeshGroupByExec(PhysicalOp):
             )
         )
         key_out, agg_out, counts = self._gb(stacked, num_rows)
+        if multi:
+            # every rank needs every device's output slice (execute()
+            # may be asked for any partition): allgather the small
+            # grouped results
+            from blaze_tpu.parallel.mesh import allgather_rows
+
+            key_out = [allgather_rows(k, n_dev) for k in key_out]
+            agg_out = [allgather_rows(a, n_dev) for a in agg_out]
+            counts = allgather_rows(counts, n_dev, trailing=False)
         self._result = (key_out, agg_out, np.asarray(counts))
         ctx.metrics.add("mesh_groupby_groups", int(self._result[2].sum()))
         return self._result
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
+        if self.fallback is not None and not self._use_fallback:
+            try:
+                self._run(ctx)
+            except NotImplementedError:
+                self._use_fallback = True
+                self._result = None
+        if self._use_fallback:
+            if partition < self.fallback.partition_count:
+                yield from self.fallback.execute(partition, ctx)
+            return
         key_out, agg_out, counts = self._run(ctx)
         n = int(counts[partition])
         if n == 0:

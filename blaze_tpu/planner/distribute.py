@@ -208,8 +208,18 @@ def _try_mesh_groupby(node: PhysicalOp, mesh, MeshGroupByExec
     if any(a.fn not in supported for a, _ in aggs):
         return node
     try:
-        mg = MeshGroupByExec(child, keys, aggs, mesh=mesh)
+        # `fallback=node`: ineligibility that only shows at execution
+        # (actual validity masks on nullable inputs) re-runs the
+        # original aggregate - tryConvert's runtime half
+        mg = MeshGroupByExec(child, keys, aggs, mesh=mesh,
+                             fallback=node)
         if child.partition_count > mg.partition_count:
+            return node
+        if node.partition_count > mg.partition_count:
+            # consumers pull mg.partition_count partitions; a fallback
+            # wider than the mesh (FINAL sandwich whose exchange has
+            # more reducers than devices) would silently lose the
+            # groups hashed to the excess partitions
             return node
         return mg
     except (NotImplementedError, AssertionError):

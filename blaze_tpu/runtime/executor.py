@@ -43,10 +43,36 @@ def prepare_decoded_task(decoded, ctx: ExecContext):
     one-dispatch pipeline programs; reference: the decoded plan IS the
     executed plan, exec.rs:137-165), attach scan hints, and install the
     task's resources into the context."""
+    import os
+
     from blaze_tpu.ops.fused import fuse_pipelines
     from blaze_tpu.planner.colprune import install as install_scan_hints
 
     op, partition, task_id, resources = decoded
+    # Mesh lowering first (it matches raw aggregate shapes the fusion
+    # rewrite would consume): with >1 visible device, eligible grouped
+    # aggregates become one pjit program over the ICI mesh
+    # (planner/distribute.lower_to_mesh). ONLY single-partition plans
+    # qualify at this boundary: a TaskDefinition carries ONE partition
+    # of its stage, and the SPMD group-by aggregates the WHOLE child -
+    # lowering a multi-partition task would double-count its siblings'
+    # data. The lowered tree is coalesced so the task's one partition
+    # carries every group (the mesh op's output is per-device
+    # group-disjoint). BLAZE_MESH_LOWERING=off restores the
+    # file-fabric path; single-device is a no-op.
+    if (
+        os.environ.get("BLAZE_MESH_LOWERING", "auto") != "off"
+        and op.partition_count == 1
+    ):
+        from blaze_tpu.ops.union import CoalescePartitionsExec
+        from blaze_tpu.planner.distribute import lower_to_mesh
+
+        lowered = lower_to_mesh(op)
+        op = (
+            CoalescePartitionsExec(lowered)
+            if lowered.partition_count != 1
+            else lowered
+        )
     op = fuse_pipelines(op)
     # freshly-decoded tree: scans are private to this task, so filter
     # pushdown (not just column pruning) is safe to attach
@@ -60,7 +86,10 @@ def prepare_decoded_task(decoded, ctx: ExecContext):
 
 def decode_task(task_bytes: bytes, ctx: ExecContext):
     """Decode engine-native TaskDefinition bytes into a runnable
-    (op, partition) pair."""
+    (op, partition) pair.
+
+    Mesh lowering happens inside prepare_decoded_task (before fusion),
+    so every wire format shares it."""
     from blaze_tpu.plan.serde import task_from_proto
 
     return prepare_decoded_task(task_from_proto(task_bytes), ctx)

@@ -117,18 +117,12 @@ def _worker_main(coordinator: str, num_processes: int, process_id: int,
     )
     key_out, agg_out, counts = gb([keys, vals], rows)
 
-    # gather the global result on every process, normalized to
-    # [n_dev, ...] regardless of how allgather stacks the shards
-    def gather(x, trailing: bool):
-        g = np.asarray(
-            multihost_utils.process_allgather(x, tiled=True)
-        )
-        return g.reshape((n_dev, -1) if trailing else (n_dev,))
+    from blaze_tpu.parallel.mesh import allgather_rows
 
-    ko = gather(key_out, True)
-    so = gather(agg_out[0], True)
-    no = gather(agg_out[1], True)
-    cn = gather(counts, False)
+    ko = allgather_rows(key_out, n_dev)
+    so = allgather_rows(agg_out[0], n_dev)
+    no = allgather_rows(agg_out[1], n_dev)
+    cn = allgather_rows(counts, n_dev, trailing=False)
 
     # numpy reference over the full logical input
     ref: dict = {}
@@ -159,6 +153,115 @@ def _worker_main(coordinator: str, num_processes: int, process_id: int,
     return 0
 
 
+def _worker_task_main(coordinator: str, num_processes: int,
+                      process_id: int,
+                      local_device_count: int) -> int:
+    """Decoded-TaskDefinition workload: every rank decodes the SAME
+    serialized task (rank-symmetric seed), execute_task applies the
+    default mesh lowering (runtime/executor.decode_task), and the
+    MeshGroupByExec runs as one SPMD program over the global
+    2-process mesh. Each rank validates the union of all partitions
+    against a numpy reference - proving the production task boundary,
+    not just the raw collective, works across processes."""
+    jax, mesh = initialize_worker(
+        coordinator, num_processes, process_id,
+        local_device_count=local_device_count,
+        platform=os.environ.get("BLAZE_LAUNCH_PLATFORM") or None,
+    )
+    import numpy as np
+
+    jax.config.update("jax_enable_x64", True)
+
+    import pyarrow as pa
+
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+    from blaze_tpu.plan.serde import task_to_proto
+    from blaze_tpu.runtime.executor import (
+        decode_task,
+        execute_partition,
+    )
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(21)
+    n = 512
+    k = rng.integers(0, 23, n).astype(np.int64)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    # a REAL serialized task needs a serializable scan: write the
+    # deterministic table once (atomic rename - both ranks may race)
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+
+    path = os.path.join(
+        tempfile.gettempdir(), "blz_launch_task_seed21.parquet"
+    )
+    if not os.path.exists(path):
+        tmp = tempfile.NamedTemporaryFile(
+            dir=tempfile.gettempdir(), suffix=".parquet",
+            delete=False,
+        )
+        tmp.close()
+        pq.write_table(pa.table({"k": k, "v": v}), tmp.name)
+        os.replace(tmp.name, path)
+    plan = HashAggregateExec(
+        ParquetScanExec([[FileRange(path)]]),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.COUNT_STAR, None), "c")],
+        mode=AggMode.COMPLETE,
+    )
+    blob = task_to_proto(plan, 0)
+
+    ctx = ExecContext()
+    op, _part = decode_task(blob, ctx)
+
+    def find_mesh(o):
+        if isinstance(o, MeshGroupByExec):
+            return o
+        for c in o.children:
+            m = find_mesh(c)
+            if m is not None:
+                return m
+        return None
+
+    assert find_mesh(op) is not None, op.display()
+    assert op.partition_count == 1, op.partition_count
+
+    got = {}
+    for p in range(op.partition_count):
+        for rb in execute_partition(op, p, ctx):
+            for kk, ss, cc in zip(
+                rb.column("k").to_pylist(),
+                rb.column("s").to_pylist(),
+                rb.column("c").to_pylist(),
+            ):
+                assert kk not in got, "group owned by two partitions"
+                got[int(kk)] = (int(ss), int(cc))
+    ref = {}
+    for kk, vv in zip(k, v):
+        s, c = ref.get(int(kk), (0, 0))
+        ref[int(kk)] = (s + int(vv), c + 1)
+    assert got == ref, (len(got), len(ref))
+    print(
+        json.dumps(
+            {
+                "process": process_id,
+                "global_devices": n_dev,
+                "groups": len(got),
+                "lowered": True,
+                "ok": True,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def _free_port() -> int:
     import socket
 
@@ -168,7 +271,8 @@ def _free_port() -> int:
 
 
 def launch_local(num_processes: int = 2, devices_per_process: int = 4,
-                 port: Optional[int] = None, timeout: float = 300.0):
+                 port: Optional[int] = None, timeout: float = 300.0,
+                 workload: str = "groupby"):
     """Spawn num_processes local workers (one-per-host stand-in); each
     contributes devices_per_process virtual CPU devices to the global
     mesh. Returns the list of per-process JSON results. Fails FAST with
@@ -205,7 +309,7 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
                     sys.executable, "-m",
                     "blaze_tpu.runtime.launcher",
                     f"127.0.0.1:{port}", str(num_processes), str(pid),
-                    str(devices_per_process),
+                    str(devices_per_process), workload,
                 ],
                 env=env,
                 stdout=log,
@@ -260,8 +364,13 @@ def launch_local(num_processes: int = 2, devices_per_process: int = 4,
 
 
 if __name__ == "__main__":
+    _main = (
+        _worker_task_main
+        if len(sys.argv) > 5 and sys.argv[5] == "task"
+        else _worker_main
+    )
     raise SystemExit(
-        _worker_main(
+        _main(
             sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
             int(sys.argv[4]),
         )

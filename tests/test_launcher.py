@@ -12,3 +12,17 @@ def test_two_process_global_mesh_groupby():
     for r in results:
         assert r["ok"] and r["global_devices"] == 8
     assert results[0]["groups"] == results[1]["groups"] > 0
+
+
+def test_two_process_decoded_task_through_mesh_tier():
+    """The production task boundary across processes: each rank decodes
+    the same serialized TaskDefinition, runtime/executor.decode_task
+    auto-lowers it onto the global 2-process mesh (MeshGroupByExec),
+    and the SPMD result validates against numpy on every rank."""
+    results = launch_local(
+        num_processes=2, devices_per_process=4, workload="task"
+    )
+    assert len(results) == 2
+    for r in results:
+        assert r["ok"] and r["lowered"] and r["global_devices"] == 8
+        assert r["groups"] == 23
