@@ -336,11 +336,16 @@ def main():
         "roundtrips |",
         "|---|---|---|---|---|---|---|---|",
     ]
-    for name, te, ts, tc, counts, _ in results:
-        rt = sum(
+    def roundtrips(counts):
+        # the ONE definition of a device round trip for both the md
+        # table and trend.csv - two copies would drift
+        return sum(
             v for k, v in counts.items()
             if k in ("dispatches", "d2h_syncs", "d2h_fetches")
         )
+
+    for name, te, ts, tc, counts, _ in results:
+        rt = roundtrips(counts)
         lines.append(
             f"| {name} | {te:.3f} | {ts:.3f} | {tc:.3f} | {n/te:,.0f} |"
             f" {tc/te:.2f}x | {tc/ts:.2f}x | {rt} ({counts}) |"
@@ -361,6 +366,39 @@ def main():
         f.write("\n".join(lines) + "\n")
     print("\n".join(lines))
     print(f"\nwritten: {path}")
+
+    # cross-round trend artifact (VERDICT r3 item 10): one CSV row per
+    # config per run, appended forever - the analog of the reference's
+    # benchmark-results/ history, so a perf regression between rounds
+    # is a diff in one file instead of a by-hand comparison of MDs
+    import csv
+    import subprocess
+
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        commit = "unknown"
+    trend = os.path.join(out_dir, "trend.csv")
+    new_file = not os.path.exists(trend)
+    with open(trend, "a", newline="") as f:
+        w = csv.writer(f)
+        if new_file:
+            w.writerow(
+                ["date", "commit", "backend", "rows", "config",
+                 "engine_s", "cpu_best_s", "vs_cpu",
+                 "device_roundtrips"]
+            )
+        for name, te, ts, tc, counts, _ in results:
+            rt = roundtrips(counts)
+            w.writerow(
+                [datetime.date.today().isoformat(), commit, backend,
+                 n, name, round(te, 4), round(tc, 4),
+                 round(tc / te, 3), rt]
+            )
+    print(f"trend appended: {trend}")
 
 
 if __name__ == "__main__":

@@ -160,7 +160,8 @@ def _rewrite(op: PhysicalOp, n: int, shuffle_dir,
     return new
 
 
-def lower_to_mesh(op: PhysicalOp, mesh=None) -> PhysicalOp:
+def lower_to_mesh(op: PhysicalOp, mesh=None,
+                  root_only: bool = False) -> PhysicalOp:
     """Lower aggregate shapes onto the ICI tier: a grouped aggregate
     whose inputs are slice-resident becomes one `MeshGroupByExec` pjit
     program (partial agg -> all_to_all key exchange over ICI -> owner
@@ -180,6 +181,12 @@ def lower_to_mesh(op: PhysicalOp, mesh=None) -> PhysicalOp:
 
     if mesh is None and device_count() <= 1:
         return op
+    if root_only:
+        # task-boundary mode: only a ROOT aggregate may change its
+        # partitioning - a mid-tree rewrite would hand Sort/Limit/
+        # Window parents n_dev partitions where the plan promised one,
+        # silently turning global semantics per-partition
+        return _try_mesh_groupby(op, mesh, MeshGroupByExec)
     seen: Dict[int, PhysicalOp] = {}
 
     def rewrite(node: PhysicalOp) -> PhysicalOp:
@@ -206,6 +213,18 @@ def _try_mesh_groupby(node: PhysicalOp, mesh, MeshGroupByExec
     supported = {AggFn.SUM, AggFn.COUNT, AggFn.COUNT_STAR,
                  AggFn.MIN, AggFn.MAX, AggFn.AVG}
     if any(a.fn not in supported for a, _ in aggs):
+        return node
+    # cheap partition gates BEFORE constructing the (pjit-program-
+    # building) mesh op: a sandwich with more reducers than devices is
+    # the common insert_exchanges default and must not pay plan-time
+    # construction just to be discarded
+    from blaze_tpu.parallel.mesh import device_count
+
+    n_dev = (
+        int(mesh.shape["data"]) if mesh is not None
+        else device_count()
+    )
+    if child.partition_count > n_dev or node.partition_count > n_dev:
         return node
     try:
         # `fallback=node`: ineligibility that only shows at execution

@@ -26,6 +26,17 @@ from blaze_tpu.ops.util import ensure_compacted
 log = logging.getLogger("blaze_tpu.executor")
 
 
+def _process_count() -> int:
+    """jax.process_count without forcing backend init side effects
+    beyond what execution needs anyway."""
+    import jax
+
+    try:
+        return jax.process_count()
+    except Exception:  # noqa: BLE001 - uninitialized distributed
+        return 1
+
+
 class TaskExecutionError(RuntimeError):
     def __init__(self, task_id: str, partition: int, cause: BaseException):
         super().__init__(
@@ -60,14 +71,22 @@ def prepare_decoded_task(decoded, ctx: ExecContext):
     # carries every group (the mesh op's output is per-device
     # group-disjoint). BLAZE_MESH_LOWERING=off restores the
     # file-fabric path; single-device is a no-op.
-    if (
-        os.environ.get("BLAZE_MESH_LOWERING", "auto") != "off"
-        and op.partition_count == 1
-    ):
+    # Mode: "auto" lowers only in a single-controller process (in a
+    # multi-process group, ranks decode DIFFERENT tasks - the
+    # task-per-partition cluster model - and a one-sided collective
+    # would deadlock the group); "on" asserts the caller decodes
+    # rank-symmetric tasks (the launcher's SPMD workload); "off"
+    # disables. Root-only: a mid-tree rewrite would change the
+    # partitioning under Sort/Limit/Window parents.
+    mode = os.environ.get("BLAZE_MESH_LOWERING", "auto")
+    lower_ok = mode == "on" or (
+        mode == "auto" and _process_count() == 1
+    )
+    if lower_ok and op.partition_count == 1:
         from blaze_tpu.ops.union import CoalescePartitionsExec
         from blaze_tpu.planner.distribute import lower_to_mesh
 
-        lowered = lower_to_mesh(op)
+        lowered = lower_to_mesh(op, root_only=True)
         op = (
             CoalescePartitionsExec(lowered)
             if lowered.partition_count != 1

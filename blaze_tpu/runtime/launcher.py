@@ -217,6 +217,11 @@ def _worker_task_main(coordinator: str, num_processes: int,
     )
     blob = task_to_proto(plan, 0)
 
+    # every rank decodes the SAME task (asserted by construction above:
+    # one deterministic blob), so rank-symmetric collectives are safe -
+    # attest it, because "auto" refuses to lower in a multi-process
+    # group where ranks may hold different tasks
+    os.environ["BLAZE_MESH_LOWERING"] = "on"
     ctx = ExecContext()
     op, _part = decode_task(blob, ctx)
 
