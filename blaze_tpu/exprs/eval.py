@@ -309,6 +309,8 @@ class DeviceEvaluator:
 
     def _decimal_arith(self, op, lv, rv, lt, rt, out_t, m) -> CV:
         def unscaled(v, t):
+            if t.is_wide_decimal:
+                return v, t.scale  # (cap, 2) limb pair
             if t.id is TypeId.DECIMAL:
                 return v.astype(jnp.int64), t.scale
             if t.is_integer:
@@ -318,14 +320,28 @@ class DeviceEvaluator:
         lu, ls = unscaled(lv, lt)
         ru, rs = unscaled(rv, rt)
         if ls is None or rs is None or op is Op.DIV:
-            lf = lv.astype(jnp.float64) / (
-                10.0 ** lt.scale if lt.id is TypeId.DECIMAL else 1.0
-            )
-            rf = rv.astype(jnp.float64) / (
-                10.0 ** rt.scale if rt.id is TypeId.DECIMAL else 1.0
-            )
+            def to_f(v, t):
+                from blaze_tpu.exprs import int128 as i128
+
+                if t.is_wide_decimal:
+                    f = i128.to_float64(v[:, 0], v[:, 1])
+                else:
+                    f = v.astype(jnp.float64)
+                return f / (
+                    10.0 ** t.scale if t.id is TypeId.DECIMAL else 1.0
+                )
+
+            lf = to_f(lv, lt)
+            rf = to_f(rv, rt)
             return self._div(lf, rf, DataType.float64(), m) if op is Op.DIV \
                 else (_apply_float_op(op, lf, rf), m)
+        if (
+            lt.is_wide_decimal or rt.is_wide_decimal
+            or out_t.is_wide_decimal
+        ):
+            return self._decimal_arith_wide(
+                op, lu, ru, lt, rt, ls, rs, out_t, m
+            )
         target = out_t.scale
         lu = lu * (10 ** (target - ls)) if op in (Op.ADD, Op.SUB) else lu
         ru = ru * (10 ** (target - rs)) if op in (Op.ADD, Op.SUB) else ru
@@ -343,6 +359,45 @@ class DeviceEvaluator:
         if op is Op.MOD:
             return self._mod(lu, ru, out_t, m)
         raise NotImplementedError(f"decimal {op}")
+
+    def _decimal_arith_wide(self, op, lu, ru, lt, rt, ls, rs,
+                            out_t, m) -> CV:
+        """128-bit decimal +/-/* on device (exprs/int128.py): limb-pair
+        or narrow operands enter as sign+magnitude, rescale to the
+        result scale, combine, and overflow beyond decimal(38) NULLs
+        the row (Spark non-ANSI). Rounding on the multiply's
+        rescale-down is HALF_UP, matching the host tier."""
+        from blaze_tpu.exprs import int128 as i128
+
+        def mag(v, t):
+            if t.is_wide_decimal:
+                return i128.from_limbs(v[:, 0], v[:, 1])
+            return i128.from_narrow(v)
+
+        a = mag(lu, lt)
+        b = mag(ru, rt)
+        target = out_t.scale
+        if op in (Op.ADD, Op.SUB):
+            alo, ahi, o1 = i128.rescale_up(a[0], a[1], target - ls)
+            blo, bhi, o2 = i128.rescale_up(b[0], b[1], target - rs)
+            bneg = b[2] ^ (op is Op.SUB)
+            mlo, mhi, neg, ok = i128.signed_add(
+                (alo, ahi, a[2]), (blo, bhi, bneg)
+            )
+            ok = ok & ~o1 & ~o2
+        elif op is Op.MUL:
+            down = ls + rs - target
+            assert down >= 0, (ls, rs, target)
+            mlo, mhi, neg, ok = i128.signed_mul(a, b, down)
+        else:
+            raise NotImplementedError(f"wide decimal {op}")
+        lo, hi = i128.to_limbs(mlo, mhi, neg)
+        mask = and_validity(m, ok)
+        # a wide operand always promotes to a wide result (promote()
+        # keeps max precision > 18, and DIV was routed to float64
+        # above), so the output is the stacked limb pair
+        assert out_t.is_wide_decimal, out_t
+        return jnp.stack([lo, hi], axis=1), mask
 
     def _logic(self, e: ir.BinaryOp) -> CV:
         lv, lm = self._eval(e.left)

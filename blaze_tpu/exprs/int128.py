@@ -1,0 +1,324 @@
+"""Vectorized 128-bit decimal arithmetic on device.
+
+Reference counterpart: the reference gets full Decimal128 +/-/* from
+arrow-rs compute kernels (from_proto.rs Decimal arms; the 16-byte slot
+of shuffle_writer_exec.rs:196-220). The engine's wide decimals are
+(capacity, 2) [lo, hi] int64 limb pairs (types.is_wide_decimal); until
+round 4, VALUE arithmetic on them routed to the host tier. This module
+does it in jnp so wide +/-/* stays on device.
+
+Internal model: sign-magnitude. Magnitudes ride as TWO uint64 lanes
+(lo, hi); signs as bool. Two's-complement limb pairs convert at the
+boundaries. Everything is elementwise over row vectors - no lax control
+flow except static Python loops - so it fuses into the surrounding
+expression kernel.
+
+Overflow semantics: Spark non-ANSI - a result beyond decimal(38)
+becomes NULL (the `ok` lane returned by each op). Rounding is HALF_UP
+(away from zero), matching the host tier's _reassemble_decimal.
+
+Static-per-trace quantities: rescale exponents. Spark's analyzer fixes
+result scales at plan time, so every 10^k here is a Python int constant
+folded into the program.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_DEC38_MAX = 10**38 - 1
+
+U64 = jnp.uint64
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+def _u(x):
+    return jax.lax.bitcast_convert_type(x, jnp.uint64)
+
+
+def _i(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# boundary conversions: two's-complement limb pair <-> sign+magnitude
+# ---------------------------------------------------------------------------
+
+def from_limbs(lo_i64, hi_i64):
+    """(lo, hi) int64 two's-complement -> (mlo, mhi u64, neg bool)."""
+    neg = hi_i64 < 0
+    ulo = _u(lo_i64)
+    uhi = _u(hi_i64)
+    # 128-bit negate: ~x + 1; the +1 carries into the high limb
+    # exactly when the low limb is zero
+    nlo = ~ulo + U64(1)
+    nhi = ~uhi + jnp.where(ulo == 0, U64(1), U64(0))
+    mlo = jnp.where(neg, nlo, ulo)
+    mhi = jnp.where(neg, nhi, uhi)
+    return mlo, mhi, neg
+
+
+def to_limbs(mlo, mhi, neg):
+    """sign+magnitude -> (lo, hi) int64 two's complement."""
+    nlo = ~mlo + U64(1)
+    carry = mlo == 0
+    nhi = ~mhi + jnp.where(carry, U64(1), U64(0))
+    lo = jnp.where(neg, nlo, mlo)
+    hi = jnp.where(neg, nhi, mhi)
+    return _i(lo), _i(hi)
+
+
+def from_narrow(v_i64):
+    """int64 unscaled value -> sign+magnitude pair."""
+    neg = v_i64 < 0
+    # abs is safe: |INT64_MIN| = 2^63 fits uint64
+    mag = jnp.where(neg, _u(-v_i64), _u(v_i64))
+    # -INT64_MIN wraps to itself; its bit pattern IS 2^63 unsigned
+    return mag, jnp.zeros_like(mag), neg
+
+
+# ---------------------------------------------------------------------------
+# magnitude primitives
+# ---------------------------------------------------------------------------
+
+def _mag_add(alo, ahi, blo, bhi):
+    """u128 + u128 -> (lo, hi, overflow_bit)."""
+    lo = alo + blo
+    c = lo < alo  # low-limb carry
+    hi_sum = ahi + bhi
+    ovf1 = hi_sum < ahi
+    hi = hi_sum + jnp.where(c, U64(1), U64(0))
+    ovf2 = c & (hi < hi_sum)  # carry wrapped the high limb
+    return lo, hi, ovf1 | ovf2
+
+
+def _mag_sub(alo, ahi, blo, bhi):
+    """u128 - u128 (requires a >= b) -> (lo, hi)."""
+    lo = alo - blo
+    borrow = alo < blo
+    hi = ahi - bhi - jnp.where(borrow, U64(1), U64(0))
+    return lo, hi
+
+
+def _mag_cmp_lt(alo, ahi, blo, bhi):
+    return (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+
+
+def _mag_cmp_gt(alo, ahi, blo, bhi):
+    return _mag_cmp_lt(blo, bhi, alo, ahi)
+
+
+def _split32(x_u64):
+    return x_u64 & _MASK32, x_u64 >> np.uint64(32)
+
+
+def _mag_mul_by_u64(mlo, mhi, m: int):
+    """u128 x u64-constant -> (lo, hi, overflow). `m` is a Python int
+    (0 < m < 2^64), so limb products fold to constants where possible.
+    Overflow = any bits at 2^128 and above."""
+    assert 0 < m < (1 << 64)
+    m0 = np.uint64(m & 0xFFFFFFFF)
+    m1 = np.uint64(m >> 32)
+    a0, a1 = _split32(mlo)
+    a2, a3 = _split32(mhi)
+    # partial products: limb i of a times limb j of m lands at 32*(i+j)
+    res = [jnp.zeros_like(mlo) for _ in range(6)]
+    for i, ai in enumerate((a0, a1, a2, a3)):
+        for j, mj in enumerate((m0, m1)):
+            if int(mj) == 0:
+                continue
+            p = ai * mj  # < 2^64: 32-bit x 32-bit
+            res[i + j] = res[i + j] + (p & _MASK32)
+            res[i + j + 1] = res[i + j + 1] + (p >> np.uint64(32))
+    # carry-normalize (each res lane < a few * 2^32, sums stay < 2^64)
+    for k in range(5):
+        res[k + 1] = res[k + 1] + (res[k] >> np.uint64(32))
+        res[k] = res[k] & _MASK32
+    lo = res[0] | (res[1] << np.uint64(32))
+    hi = res[2] | (res[3] << np.uint64(32))
+    ovf = (res[4] | res[5]) != 0
+    return lo, hi, ovf
+
+
+def _mag_mul(alo, ahi, blo, bhi):
+    """u128 x u128 -> (lo, hi, overflow). Full 4x4 32-bit limb product
+    with everything at or above 2^128 folded into the overflow bit."""
+    a = _split32(alo) + _split32(ahi)
+    b = _split32(blo) + _split32(bhi)
+    res = [jnp.zeros_like(alo) for _ in range(8)]
+    ovf = jnp.zeros(alo.shape, dtype=jnp.bool_)
+    for i in range(4):
+        for j in range(4):
+            p = a[i] * b[j]
+            k = i + j
+            if k >= 4:
+                ovf = ovf | (p != 0)
+                continue
+            res[k] = res[k] + (p & _MASK32)
+            if k + 1 >= 4:
+                ovf = ovf | ((p >> np.uint64(32)) != 0)
+            else:
+                res[k + 1] = res[k + 1] + (p >> np.uint64(32))
+    for k in range(3):
+        res[k + 1] = res[k + 1] + (res[k] >> np.uint64(32))
+        res[k] = res[k] & _MASK32
+    ovf = ovf | ((res[3] >> np.uint64(32)) != 0)
+    res[3] = res[3] & _MASK32
+    lo = res[0] | (res[1] << np.uint64(32))
+    hi = res[2] | (res[3] << np.uint64(32))
+    return lo, hi, ovf
+
+
+def _pow10_limbs(k: int) -> Tuple[np.uint64, np.uint64]:
+    v = 10**k
+    return np.uint64(v & ((1 << 64) - 1)), np.uint64(v >> 64)
+
+
+def _mag_divmod_u32(mlo, mhi, d: int):
+    """u128 // u32-constant with remainder (vectorized long division
+    high->low over four 32-bit limbs; every intermediate fits u64)."""
+    assert 0 < d < (1 << 32)
+    du = np.uint64(d)
+    limbs = list(_split32(mlo)) + list(_split32(mhi))  # [l0..l3]
+    q = [None] * 4
+    rem = jnp.zeros_like(mlo)
+    for idx in (3, 2, 1, 0):
+        cur = (rem << np.uint64(32)) | limbs[idx]
+        q[idx] = cur // du
+        rem = cur % du
+    qlo = q[0] | (q[1] << np.uint64(32))
+    qhi = q[2] | (q[3] << np.uint64(32))
+    return qlo, qhi, rem  # rem < d
+
+
+def div_pow10_half_up(mlo, mhi, k: int):
+    """u128 magnitude // 10^k with HALF_UP (round-half-away-from-zero
+    on the magnitude) -> (lo, hi). k is a static Python int >= 0."""
+    if k == 0:
+        return mlo, mhi
+    # chain 10^9-sized chunks; accumulate the FULL remainder (vs the
+    # whole 10^k divisor) in 128 bits so the final half-comparison is
+    # exact - rounding digit-at-a-time would be wrong (0.45 -> 0.5 ->
+    # 1 instead of 0)
+    qlo, qhi = mlo, mhi
+    rlo = jnp.zeros_like(mlo)
+    rhi = jnp.zeros_like(mhi)
+    divided = 1  # product of divisors applied so far (Python int)
+    left = k
+    while left > 0:
+        step = min(9, left)
+        d = 10**step
+        qlo, qhi, rem = _mag_divmod_u32(qlo, qhi, d)
+        if divided == 1:
+            rlo, rhi = rem, jnp.zeros_like(rem)
+        else:
+            # full remainder so far = rem * (divisors so far) + prior.
+            # rem < 10^9 and divided <= 10^29, so the product fits 128
+            # bits (10^38 < 2^127); split `divided` into <= 2^64
+            # chunks for the by-constant multiply
+            plo, phi = rem, jnp.zeros_like(rem)
+            dleft = divided
+            while dleft > 1:
+                chunk = min(dleft, 10**19)
+                # divided is a power of 10, so chunks divide exactly
+                while dleft % chunk:
+                    chunk //= 10
+                plo, phi, _ = _mag_mul_by_u64(plo, phi, chunk)
+                dleft //= chunk
+            rlo, rhi, _ = _mag_add(rlo, rhi, plo, phi)
+        divided *= d
+        left -= step
+    # HALF_UP: q += (2*rem >= 10^k)
+    tlo, thi, _ = _mag_add(rlo, rhi, rlo, rhi)  # 2*rem < 2*10^38 < 2^128
+    dlo, dhi = _pow10_limbs(k)
+    ge = ~_mag_cmp_lt(
+        tlo, thi, jnp.full_like(tlo, dlo), jnp.full_like(thi, dhi)
+    )
+    qlo2 = qlo + jnp.where(ge, U64(1), U64(0))
+    qhi2 = qhi + jnp.where(ge & (qlo2 == 0), U64(1), U64(0))
+    return qlo2, qhi2
+
+
+def rescale_up(mlo, mhi, k: int):
+    """u128 magnitude x 10^k -> (lo, hi, overflow); k static >= 0."""
+    if k == 0:
+        return mlo, mhi, jnp.zeros(mlo.shape, dtype=jnp.bool_)
+    ovf = jnp.zeros(mlo.shape, dtype=jnp.bool_)
+    left = k
+    while left > 0:
+        step = min(19, left)  # 10^19 < 2^64
+        mlo, mhi, o = _mag_mul_by_u64(mlo, mhi, 10**step)
+        ovf = ovf | o
+        left -= step
+    return mlo, mhi, ovf
+
+
+_D38_LO, _D38_HI = _pow10_limbs(38)  # 10^38 limbs
+
+
+def exceeds_dec38(mlo, mhi):
+    """|x| > 10^38 - 1 (the Spark non-ANSI NULL-on-overflow bound)."""
+    return ~_mag_cmp_lt(
+        mlo, mhi,
+        jnp.full_like(mlo, _D38_LO), jnp.full_like(mhi, _D38_HI),
+    )
+
+
+# ---------------------------------------------------------------------------
+# signed ops over (mlo, mhi, neg) triples
+# ---------------------------------------------------------------------------
+
+def signed_add(a, b):
+    """(mag, sign) + (mag, sign) -> (mlo, mhi, neg, ok)."""
+    alo, ahi, aneg = a
+    blo, bhi, bneg = b
+    same = aneg == bneg
+    slo, shi, ovf = _mag_add(alo, ahi, blo, bhi)
+    # opposite signs: larger magnitude wins
+    a_lt_b = _mag_cmp_lt(alo, ahi, blo, bhi)
+    dlo1, dhi1 = _mag_sub(blo, bhi, alo, ahi)
+    dlo2, dhi2 = _mag_sub(alo, ahi, blo, bhi)
+    dlo = jnp.where(a_lt_b, dlo1, dlo2)
+    dhi = jnp.where(a_lt_b, dhi1, dhi2)
+    mlo = jnp.where(same, slo, dlo)
+    mhi = jnp.where(same, shi, dhi)
+    neg = jnp.where(same, aneg, jnp.where(a_lt_b, bneg, aneg))
+    zero = (mlo == 0) & (mhi == 0)
+    neg = neg & ~zero
+    ok = ~(same & ovf) & ~exceeds_dec38(mlo, mhi)
+    return mlo, mhi, neg, ok
+
+
+def signed_mul(a, b, down: int):
+    """(mag, sign) x (mag, sign), then HALF_UP divide by 10^down
+    (static) -> (mlo, mhi, neg, ok)."""
+    alo, ahi, aneg = a
+    blo, bhi, bneg = b
+    mlo, mhi, ovf = _mag_mul(alo, ahi, blo, bhi)
+    if down > 0:
+        # the truncated product must itself fit 128 bits for the
+        # divide to see true limbs; a product that overflowed is
+        # unrecoverable here even when the rescaled value would fit -
+        # Spark's BigDecimal keeps arbitrary precision. Documented
+        # deviation: those rows NULL (they need >38-digit
+        # intermediates, beyond the decimal128 slot either engine
+        # ships over the wire).
+        mlo, mhi = div_pow10_half_up(mlo, mhi, down)
+    neg = (aneg ^ bneg)
+    zero = (mlo == 0) & (mhi == 0)
+    neg = neg & ~zero
+    ok = ~ovf & ~exceeds_dec38(mlo, mhi)
+    return mlo, mhi, neg, ok
+
+
+def to_float64(lo_i64, hi_i64):
+    """two's-complement limb pair -> f64 approximation (for the
+    decimal DIV -> float64 path)."""
+    mlo, mhi, neg = from_limbs(lo_i64, hi_i64)
+    f = mlo.astype(jnp.float64) + mhi.astype(jnp.float64) * (2.0**64)
+    return jnp.where(neg, -f, f)

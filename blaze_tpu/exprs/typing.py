@@ -129,6 +129,33 @@ def expr_computes_wide_decimal(e: ir.Expr, schema: Schema) -> bool:
                 scales.add(0)  # integer comparand = scale 0
         if ok and len(scales) <= 1:
             return False
+    if (
+        isinstance(e, ir.BinaryOp)
+        and e.op in (Op.ADD, Op.SUB, Op.MUL, Op.DIV)
+        and all(
+            isinstance(c, (ir.BoundCol, ir.Col, ir.Literal))
+            for c in ir.children(e)
+        )
+    ):
+        # +,-,* (and / -> float64) over wide decimals run on device
+        # since round 4: 128-bit limb arithmetic with Spark overflow-
+        # NULL and HALF_UP rounding (exprs/int128.py, evaluator
+        # _decimal_arith_wide). Only direct column/literal operands
+        # qualify - nested wide arithmetic still composes through the
+        # host tier (each node's output would need limb-pair
+        # propagation through the expression cache).
+        ok = True
+        for c in ir.children(e):
+            try:
+                dt = infer_dtype(c, schema)
+            except Exception:
+                ok = False
+                break
+            if not (dt.id is TypeId.DECIMAL or dt.is_integer):
+                ok = False
+                break
+        if ok:
+            return False
     for c in ir.children(e):
         if expr_computes_wide_decimal(c, schema):
             return True

@@ -131,25 +131,40 @@ class WindowExec(PhysicalOp):
                 continue
             ftype, lo, hi = fr
             if ftype == "range":
-                # only the SQL default frame (RANGE UNBOUNDED..CURRENT)
-                if not (lo is None and hi == 0):
-                    raise NotImplementedError(
-                        "RANGE frames other than UNBOUNDED..CURRENT"
-                    )
-            elif ftype == "rows":
-                if f.kind in ("min", "max"):
-                    # bounded/following min/max needs a sparse-table
-                    # pass; only the running frame is supported
-                    if not (lo is None and hi == 0):
-                        raise NotImplementedError(
-                            "min/max ROWS frames other than "
-                            "UNBOUNDED..CURRENT"
-                        )
+                if lo is None and hi == 0:
+                    pass  # SQL default frame: UNBOUNDED..CURRENT (ties)
                 else:
-                    if lo is not None and lo < 0:
-                        raise NotImplementedError("negative frame lo")
-                    if hi is not None and hi < 0:
-                        raise NotImplementedError("negative frame hi")
+                    # RANGE with VALUE offsets: exactly one numeric
+                    # order key narrow enough for the u32 order
+                    # encoding the bound search packs (round 4;
+                    # int64/f64 order keys stay host-tier work)
+                    if len(self.order_by) != 1:
+                        raise NotImplementedError(
+                            "RANGE value offsets need exactly one "
+                            "ORDER BY key"
+                        )
+                    odt = infer_dtype(self.order_by[0].expr, schema)
+                    narrow = (
+                        odt.id.value in ("int8", "int16", "int32",
+                                         "date32", "float32")
+                        or (odt.is_integer
+                            and odt.physical_dtype().itemsize <= 4)
+                    )
+                    if not narrow:
+                        raise NotImplementedError(
+                            "RANGE value offsets over wide order "
+                            "keys are host-tier work"
+                        )
+                    for off in (lo, hi):
+                        if off is not None and off < 0:
+                            raise NotImplementedError(
+                                "negative RANGE offset"
+                            )
+            elif ftype == "rows":
+                if lo is not None and lo < 0:
+                    raise NotImplementedError("negative frame lo")
+                if hi is not None and hi < 0:
+                    raise NotImplementedError("negative frame hi")
             else:
                 raise NotImplementedError(f"frame type {ftype}")
         for e in self.partition_by + [k.expr for k in self.order_by] + [
@@ -223,6 +238,7 @@ class WindowExec(PhysicalOp):
         schema = self.children[0].schema
         part_exprs = self.partition_by
         order_exprs = [k.expr for k in self.order_by]
+        order_keys = self.order_by
         fns = self.functions
 
         def kernel(bufs, num_rows):
@@ -332,6 +348,161 @@ class WindowExec(PhysicalOp):
                 _, out = jax.lax.associative_scan(op, (pb, x))
                 return out
 
+            def agg_over(vals64, contrib, lo_idx, hi_idx):
+                """SUM of vals64 over explicit row spans [lo_idx,
+                hi_idx] (partition-clamped by the caller); empty spans
+                (hi < lo) contribute zero."""
+                x = jnp.where(contrib, vals64, jnp.zeros_like(vals64))
+                S = part_prefix(x)
+                hi_c = jnp.clip(hi_idx, 0, cap - 1)
+                s_hi = jnp.take(S, hi_c)
+                s_lo_prev = jnp.where(
+                    lo_idx > seg_start,
+                    jnp.take(S, jnp.clip(lo_idx - 1, 0, cap - 1)),
+                    jnp.zeros_like(s_hi),
+                )
+                return jnp.where(
+                    hi_idx >= lo_idx, s_hi - s_lo_prev,
+                    jnp.zeros_like(s_hi),
+                )
+
+            def rmq(v, contrib, lo_idx, hi_idx, is_min,
+                    max_len=None):
+                """min/max over explicit spans via a sparse table:
+                doubling passes up to log2(max frame length), then per
+                level a masked combine of the two power-of-two covers
+                (classic RMQ). No (K, cap) stack materializes - each
+                level is consumed as it's built - and bounded ROWS
+                frames pass max_len so only log2(w) levels exist at
+                all. Empty spans return the neutral (caller masks by
+                count)."""
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    neutral = jnp.asarray(
+                        jnp.inf if is_min else -jnp.inf, v.dtype
+                    )
+                else:
+                    info = jnp.iinfo(v.dtype)
+                    neutral = jnp.asarray(
+                        info.max if is_min else info.min, v.dtype
+                    )
+                x = jnp.where(contrib, v, neutral)
+                red = jnp.minimum if is_min else jnp.maximum
+                length = jnp.maximum(hi_idx - lo_idx + 1, 1)
+                k = (
+                    jnp.int32(31)
+                    - jax.lax.clz(length.astype(jnp.int32))
+                )
+                pow2 = jnp.int32(1) << k
+                left = jnp.clip(lo_idx, 0, cap - 1)
+                right = jnp.clip(hi_idx - pow2 + 1, 0, cap - 1)
+                bound = min(max_len or cap, cap)
+                out = jnp.full(cap, neutral, v.dtype)
+                level = x
+                span = 1
+                j = 0
+                while True:
+                    sel = k == j
+                    out = jnp.where(
+                        sel,
+                        red(jnp.take(level, left),
+                            jnp.take(level, right)),
+                        out,
+                    )
+                    if span >= bound:
+                        break
+                    shifted = jnp.concatenate(
+                        [level[span:],
+                         jnp.full((span,), neutral, v.dtype)]
+                    )
+                    level = red(level, shifted)
+                    span <<= 1
+                    j += 1
+                return jnp.where(hi_idx >= lo_idx, out, neutral)
+
+            def range_value_bounds(lo_off, hi_off):
+                """Frame spans for RANGE with VALUE offsets: rows are
+                sorted by (partition, null-rank, order value), so each
+                bound is one searchsorted over
+                (gid:31 | null-rank:1 | order-key:32) packed u64 keys.
+                Without the null-rank bit, a NULL row's arbitrary
+                payload would break key monotonicity and corrupt the
+                binary search for every row in its partition.
+                NULL-ordered rows themselves use their tie run (SQL:
+                a null frame is its null peers)."""
+                from blaze_tpu.ops.util import _order_key_u32
+
+                sk = order_keys[0]
+                ov, om = ev.evaluate(sk.expr)
+                asc = sk.ascending
+                if om is None:
+                    null_rank = jnp.zeros(cap, dtype=jnp.uint64)
+                else:
+                    # physical order: nulls_first sorts nulls before
+                    # values, nulls_last after
+                    valid_rank = (
+                        jnp.uint64(1) if sk.nulls_first
+                        else jnp.uint64(0)
+                    )
+                    null_rank = jnp.where(
+                        om, valid_rank, valid_rank ^ jnp.uint64(1)
+                    )
+
+                def packed(values):
+                    enc = _order_key_u32(values, asc)
+                    return (
+                        (gid.astype(jnp.uint64) << jnp.uint64(33))
+                        | (null_rank << jnp.uint64(32))
+                        | enc.astype(jnp.uint64)
+                    )
+
+                def bound_val(off, toward_hi):
+                    # bound arithmetic in a WIDER domain so it cannot
+                    # wrap: int keys compute in int64 then saturate to
+                    # the key dtype's range (saturation preserves the
+                    # span: every stored value is in-range); float
+                    # keys saturate naturally to +/-inf
+                    plus = toward_hi == asc
+                    if jnp.issubdtype(ov.dtype, jnp.floating):
+                        d = jnp.asarray(off, ov.dtype)
+                        return ov + d if plus else ov - d
+                    w = ov.astype(jnp.int64)
+                    d = jnp.asarray(int(off), jnp.int64)
+                    b = w + d if plus else w - d
+                    info = jnp.iinfo(ov.dtype)
+                    return jnp.clip(b, info.min, info.max).astype(
+                        ov.dtype
+                    )
+
+                keys_sorted = packed(ov)
+                if lo_off is None:
+                    lo_idx = seg_start
+                else:
+                    lo_idx = jnp.searchsorted(
+                        keys_sorted,
+                        packed(bound_val(lo_off, toward_hi=False)),
+                        side="left",
+                    ).astype(jnp.int32)
+                if hi_off is None:
+                    hi_idx = seg_end - 1
+                else:
+                    hi_idx = (
+                        jnp.searchsorted(
+                            keys_sorted,
+                            packed(bound_val(hi_off, toward_hi=True)),
+                            side="right",
+                        ).astype(jnp.int32)
+                        - 1
+                    )
+                if om is not None:
+                    # null order values: the frame is the null peer run
+                    lo_idx = jnp.where(
+                        om, lo_idx, run_start.astype(jnp.int32)
+                    )
+                    hi_idx = jnp.where(
+                        om, hi_idx, (run_end - 1).astype(jnp.int32)
+                    )
+                return lo_idx, hi_idx
+
             outs = []
             for f in fns:
                 if f.kind == "row_number":
@@ -405,33 +576,84 @@ class WindowExec(PhysicalOp):
                         )
                         continue
                     ftype, lo, hi = frame
+                    range_value = ftype == "range" and not (
+                        lo is None and hi == 0
+                    )
                     if f.kind in ("min", "max"):
-                        # running (UNBOUNDED lo) min/max; range frames
-                        # read the value at the tie-run end
-                        running = running_minmax(
-                            v, contrib, f.kind == "min"
-                        )
-                        cnt = frame_agg_sumlike(
-                            contrib.astype(jnp.int64), live, lo, 0
-                        )
-                        if ftype == "range":
+                        is_min = f.kind == "min"
+                        if ftype == "rows" and lo is None and hi == 0:
+                            # running frame: the associative scan is
+                            # one pass, cheaper than the sparse table
+                            running = running_minmax(v, contrib, is_min)
+                            cnt = frame_agg_sumlike(
+                                contrib.astype(jnp.int64), live, lo, 0
+                            )
+                            outs.append((running, cnt > 0))
+                            continue
+                        if ftype == "range" and not range_value:
+                            # RANGE UNBOUNDED..CURRENT: running value
+                            # at the tie-run end
+                            running = running_minmax(v, contrib, is_min)
+                            cnt = frame_agg_sumlike(
+                                contrib.astype(jnp.int64), live,
+                                None, 0,
+                            )
                             at = jnp.clip(run_end - 1, 0, cap - 1)
-                            running = jnp.take(running, at)
-                            cnt = jnp.take(cnt, at)
-                        outs.append((running, cnt > 0))
+                            outs.append((
+                                jnp.take(running, at),
+                                jnp.take(cnt, at) > 0,
+                            ))
+                            continue
+                        # bounded sliding (ROWS a PRECEDING..b
+                        # FOLLOWING) or RANGE value offsets: explicit
+                        # spans through the sparse-table RMQ
+                        if range_value:
+                            lo_idx, hi_idx = range_value_bounds(lo, hi)
+                            max_len = None
+                        else:
+                            lo_idx = (
+                                seg_start if lo is None
+                                else jnp.maximum(pos - lo, seg_start)
+                            )
+                            hi_idx = (
+                                seg_end - 1 if hi is None
+                                else jnp.minimum(pos + hi, seg_end - 1)
+                            )
+                            max_len = (
+                                int(lo) + int(hi) + 1
+                                if lo is not None and hi is not None
+                                else None
+                            )
+                        red = rmq(
+                            v, contrib, lo_idx, hi_idx, is_min,
+                            max_len=max_len,
+                        )
+                        cnt = agg_over(
+                            contrib.astype(jnp.int64), live,
+                            lo_idx, hi_idx,
+                        )
+                        outs.append((red, cnt > 0))
                         continue
                     vals = v
                     if jnp.issubdtype(v.dtype, jnp.integer):
                         vals = v.astype(jnp.int64)
-                    s = frame_agg_sumlike(vals, contrib, lo, hi)
-                    c = frame_agg_sumlike(
-                        contrib.astype(jnp.int64), live, lo, hi
-                    )
-                    if ftype == "range":
-                        # ties share the frame ending at the run end
-                        at = jnp.clip(run_end - 1, 0, cap - 1)
-                        s = jnp.take(s, at)
-                        c = jnp.take(c, at)
+                    if range_value:
+                        lo_idx, hi_idx = range_value_bounds(lo, hi)
+                        s = agg_over(vals, contrib, lo_idx, hi_idx)
+                        c = agg_over(
+                            contrib.astype(jnp.int64), live,
+                            lo_idx, hi_idx,
+                        )
+                    else:
+                        s = frame_agg_sumlike(vals, contrib, lo, hi)
+                        c = frame_agg_sumlike(
+                            contrib.astype(jnp.int64), live, lo, hi
+                        )
+                        if ftype == "range":
+                            # ties share the frame ending at the run end
+                            at = jnp.clip(run_end - 1, 0, cap - 1)
+                            s = jnp.take(s, at)
+                            c = jnp.take(c, at)
                     anyv = c > 0
                     if f.kind == "count":
                         outs.append((c, None))

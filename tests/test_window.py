@@ -280,3 +280,172 @@ def test_window_fn_serde_roundtrip(tmp_path):
     assert fns[0].offset == 3
     assert fns[1].frame == ("rows", 2, None)
     assert fns[2].offset == 5
+
+
+def _py_frame_ref(df, kind, frame, asc=True):
+    """Brute-force per-row frame evaluation over (k, o)-sorted rows."""
+    s = df.sort_values(["k", "o"], ascending=[True, asc],
+                       kind="stable").reset_index(drop=True)
+    ftype, lo, hi = frame
+    out = []
+    for i, row in s.iterrows():
+        part = s[s.k == row.k]
+        if ftype == "rows":
+            pstart, pend = part.index[0], part.index[-1]
+            l = pstart if lo is None else max(i - lo, pstart)
+            r = pend if hi is None else min(i + hi, pend)
+            win = s.loc[l:r, "v"]
+        else:  # range with value offsets on the order column
+            # lo = PRECEDING offset, hi = FOLLOWING offset; under desc
+            # ordering "preceding" means larger order values
+            if asc:
+                lo_b = -np.inf if lo is None else (row.o - lo)
+                hi_b = np.inf if hi is None else (row.o + hi)
+            else:
+                lo_b = -np.inf if hi is None else (row.o - hi)
+                hi_b = np.inf if lo is None else (row.o + lo)
+            win = part[(part.o >= lo_b) & (part.o <= hi_b)]["v"]
+        if len(win) == 0:
+            out.append(None)
+        elif kind == "sum":
+            out.append(int(win.sum()))
+        elif kind == "min":
+            out.append(int(win.min()))
+        elif kind == "max":
+            out.append(int(win.max()))
+        elif kind == "count":
+            out.append(len(win))
+        else:
+            out.append(float(win.mean()))
+    return out
+
+
+def test_bounded_sliding_minmax_rows_frames(df):
+    """min/max over ROWS a PRECEDING..b FOLLOWING (sparse-table RMQ) -
+    previously only the running frame was supported."""
+    for frame in [("rows", 2, 2), ("rows", 0, 3), ("rows", 5, 0),
+                  ("rows", None, 2), ("rows", 1, None)]:
+        got = run_window(
+            df,
+            [WindowFn("min", Col("v"), "lo", frame=frame),
+             WindowFn("max", Col("v"), "hi", frame=frame)],
+        )
+        assert got["lo"].tolist() == _py_frame_ref(df, "min", frame)
+        assert got["hi"].tolist() == _py_frame_ref(df, "max", frame)
+
+
+def test_range_value_offset_frames(df):
+    """RANGE BETWEEN x PRECEDING AND y FOLLOWING with VALUE offsets on
+    the order column: sum/avg/count/min/max; ties share frames. The
+    order key must be narrow (int<=32/f32/date32) - int64 order keys
+    stay host-tier."""
+    df = df.assign(o=df["o"].astype(np.int32))
+    for frame in [("range", 3, 3), ("range", 0, 5), ("range", 2, 0),
+                  ("range", None, 4), ("range", 1, None)]:
+        got = run_window(
+            df,
+            [WindowFn("sum", Col("v"), "s", frame=frame),
+             WindowFn("count", Col("v"), "c", frame=frame),
+             WindowFn("min", Col("v"), "lo", frame=frame),
+             WindowFn("max", Col("v"), "hi", frame=frame),
+             WindowFn("avg", Col("v"), "a", frame=frame)],
+        )
+        assert got["s"].tolist() == _py_frame_ref(df, "sum", frame)
+        assert got["c"].tolist() == _py_frame_ref(df, "count", frame)
+        assert got["lo"].tolist() == _py_frame_ref(df, "min", frame)
+        assert got["hi"].tolist() == _py_frame_ref(df, "max", frame)
+        ref_avg = _py_frame_ref(df, "avg", frame)
+        for g, r in zip(got["a"].tolist(), ref_avg):
+            assert (g is None) == (r is None)
+            if r is not None:
+                assert abs(g - r) < 1e-9
+
+
+def test_range_value_offsets_descending_order():
+    """DESC ordering: PRECEDING means larger order values."""
+    df = pd.DataFrame({
+        "k": [1, 1, 1, 1, 1],
+        "o": np.array([10, 8, 8, 5, 1], np.int32),
+        "v": [1, 2, 3, 4, 5],
+    })
+    op = WindowExec(
+        scan_of(df),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("o"), ascending=False)],
+        functions=[WindowFn("sum", Col("v"), "s",
+                            frame=("range", 2, 0))],
+    )
+    got = run_plan(op).to_pandas()
+    # sorted desc by o: [10, 8, 8, 5, 1]; frame = o in [row.o, row.o+2]
+    # o=10: {10} -> 1; o=8 (both): {10,8,8} -> 6; o=5: {5} -> 4;
+    # o=1: {1} -> 5
+    assert got["s"].tolist() == [1, 6, 6, 4, 5]
+
+
+def test_range_value_offsets_float_order_key():
+    df = pd.DataFrame({
+        "k": np.ones(7, np.int32),
+        "o": np.array([0.5, 1.0, 1.5, 2.5, 2.5, 4.0, 100.0],
+                      np.float32),
+        "v": np.arange(1, 8, dtype=np.int64),
+    })
+    op = WindowExec(
+        scan_of(df),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("o"))],
+        functions=[WindowFn("sum", Col("v"), "s",
+                            frame=("range", 1.0, 1.0))],
+    )
+    got = run_plan(op).to_pandas()
+    exp = []
+    for o in df["o"]:
+        sel = df[(df.o >= o - 1.0) & (df.o <= o + 1.0)]
+        exp.append(int(sel.v.sum()))
+    assert got["s"].tolist() == exp
+
+
+def test_range_value_offsets_with_null_order_rows():
+    """Nulls-first NULL order rows with negative values after them:
+    without the null-rank bit in the packed search keys the binary
+    search corrupts every frame in the partition (review r4 repro)."""
+    import pyarrow as pa
+
+    df = pa.table({
+        "k": pa.array([1, 1, 1], pa.int32()),
+        "o": pa.array([None, -5, 3], pa.int32()),
+        "v": pa.array([7, 1, 1], pa.int64()),
+    })
+    cb = ColumnBatch.from_arrow(df.to_batches()[0])
+    op = WindowExec(
+        MemoryScanExec([[cb]], cb.schema),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("o"), ascending=True, nulls_first=True)],
+        functions=[WindowFn("sum", Col("v"), "s",
+                            frame=("range", 1, 1))],
+    )
+    got = run_plan(op).to_pandas()
+    # null row's frame = its null peers (just itself); -5's frame =
+    # {-5} only; 3's frame = {3}
+    assert got["s"].tolist() == [7, 1, 1]
+
+
+def test_range_value_offsets_int32_extreme_no_wrap():
+    """Bounds saturate instead of wrapping at the dtype edge."""
+    df = pd.DataFrame({
+        "k": np.ones(3, np.int32),
+        "o": np.array([2147483640, 2147483646, -2147483648],
+                      np.int32),
+        "v": np.array([1, 2, 4], np.int64),
+    })
+    op = WindowExec(
+        scan_of(df),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("o"))],
+        functions=[WindowFn("sum", Col("v"), "s",
+                            frame=("range", 0, 10))],
+    )
+    got = run_plan(op).to_pandas()
+    # sorted: [-2^31, 2147483640, 2147483646]; frames: {-2^31}'s
+    # [v, v+10] -> itself; 2147483640's [., +10] saturates at the max
+    # and includes 2147483646; 2147483646's frame includes itself only
+    assert got["s"].tolist() == [4, 3, 2]
