@@ -287,6 +287,10 @@ def _sort_indices_packed(keys, num_rows, capacity: int) -> jax.Array:
                 mv, jnp.uint64(1),
                 jnp.uint64(0 if nulls_first else 2),
             )
+            # NULL rows carry arbitrary payload values; zero them so
+            # the null run keeps the previous pass's (stable) order
+            # instead of shuffling by garbage
+            u = jnp.where(mv, u, jnp.uint32(0))
         else:
             rank = jnp.uint64(1)
         rank = jnp.where(lv, rank, jnp.uint64(3))
@@ -354,6 +358,12 @@ def sort_indices(
         if validity is not None:
             mv = jnp.take(validity, idx, axis=0)
             rank = jnp.where(mv, 1, 0 if nulls_first else 2)
+            # NULL rows carry arbitrary payload values: neutralize the
+            # value and tie lanes so the null run keeps the previous
+            # pass's (stable) order instead of shuffling by garbage
+            zero = jnp.zeros_like(v[:1])[0]
+            v = jnp.where(mv, v, zero)
+            tie = jnp.where(mv, tie, jnp.int8(0))
         else:
             rank = jnp.ones_like(v, dtype=jnp.int32)
         rank = jnp.where(lv.astype(bool), rank, 3)

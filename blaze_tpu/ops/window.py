@@ -306,26 +306,26 @@ class WindowExec(PhysicalOp):
                 )
                 return g - jnp.take(gshift, seg_start)
 
-            def frame_agg_sumlike(vals64, contrib, lo, hi):
-                """SUM over ROWS frame [i-lo, i+hi] clamped to the
-                partition (None = unbounded); also used for counts."""
-                x = jnp.where(contrib, vals64, jnp.zeros_like(vals64))
-                S = part_prefix(x)  # S[i] = sum seg_start..i
+            def rows_frame_idx(lo, hi):
+                """ROWS-offset frame -> explicit clamped index spans
+                (None = unbounded to the partition edge)."""
+                lo_idx = (
+                    seg_start if lo is None
+                    else jnp.maximum(pos - lo, seg_start)
+                )
                 hi_idx = (
                     seg_end - 1 if hi is None
                     else jnp.minimum(pos + hi, seg_end - 1)
                 )
-                hi_idx = jnp.clip(hi_idx, 0, cap - 1)
-                s_hi = jnp.take(S, hi_idx)
-                if lo is None:
-                    return s_hi
-                lo_idx = jnp.maximum(pos - lo, seg_start)
-                s_lo_prev = jnp.where(
-                    lo_idx > seg_start,
-                    jnp.take(S, jnp.clip(lo_idx - 1, 0, cap - 1)),
-                    jnp.zeros_like(s_hi),
-                )
-                return s_hi - s_lo_prev
+                return lo_idx, hi_idx
+
+            def frame_agg_sumlike(vals64, contrib, lo, hi):
+                """SUM over ROWS frame [i-lo, i+hi] clamped to the
+                partition (None = unbounded); also used for counts.
+                Thin wrapper over agg_over so the span-sum logic lives
+                once."""
+                lo_idx, hi_idx = rows_frame_idx(lo, hi)
+                return agg_over(vals64, contrib, lo_idx, hi_idx)
 
             def running_minmax(v, contrib, is_min):
                 """Partition-reset running min/max via associative scan."""
@@ -494,14 +494,31 @@ class WindowExec(PhysicalOp):
                         - 1
                     )
                 if om is not None:
-                    # null order values: the frame is the null peer run
-                    lo_idx = jnp.where(
-                        om, lo_idx, run_start.astype(jnp.int32)
-                    )
-                    hi_idx = jnp.where(
-                        om, hi_idx, (run_end - 1).astype(jnp.int32)
-                    )
+                    # null order values: an OFFSET bound collapses to
+                    # the null peer run's edge (offsets are undefined
+                    # on null); an UNBOUNDED side still reaches the
+                    # partition edge
+                    if lo_off is not None:
+                        lo_idx = jnp.where(
+                            om, lo_idx, run_start.astype(jnp.int32)
+                        )
+                    if hi_off is not None:
+                        hi_idx = jnp.where(
+                            om, hi_idx, (run_end - 1).astype(jnp.int32)
+                        )
                 return lo_idx, hi_idx
+
+            frame_bounds_cache = {}
+
+            def cached_range_bounds(lo, hi):
+                """One key-pack + two searchsorted per DISTINCT frame,
+                however many functions share it."""
+                key = (lo, hi)
+                if key not in frame_bounds_cache:
+                    frame_bounds_cache[key] = range_value_bounds(
+                        lo, hi
+                    )
+                return frame_bounds_cache[key]
 
             outs = []
             for f in fns:
@@ -608,17 +625,12 @@ class WindowExec(PhysicalOp):
                         # FOLLOWING) or RANGE value offsets: explicit
                         # spans through the sparse-table RMQ
                         if range_value:
-                            lo_idx, hi_idx = range_value_bounds(lo, hi)
+                            lo_idx, hi_idx = cached_range_bounds(
+                                lo, hi
+                            )
                             max_len = None
                         else:
-                            lo_idx = (
-                                seg_start if lo is None
-                                else jnp.maximum(pos - lo, seg_start)
-                            )
-                            hi_idx = (
-                                seg_end - 1 if hi is None
-                                else jnp.minimum(pos + hi, seg_end - 1)
-                            )
+                            lo_idx, hi_idx = rows_frame_idx(lo, hi)
                             max_len = (
                                 int(lo) + int(hi) + 1
                                 if lo is not None and hi is not None
@@ -638,7 +650,7 @@ class WindowExec(PhysicalOp):
                     if jnp.issubdtype(v.dtype, jnp.integer):
                         vals = v.astype(jnp.int64)
                     if range_value:
-                        lo_idx, hi_idx = range_value_bounds(lo, hi)
+                        lo_idx, hi_idx = cached_range_bounds(lo, hi)
                         s = agg_over(vals, contrib, lo_idx, hi_idx)
                         c = agg_over(
                             contrib.astype(jnp.int64), live,

@@ -44,14 +44,17 @@ EXCHANGE_QUERIES = [
     "q50", "q52", "q55", "q58", "q61", "q62", "q65", "q66", "q68",
     "q69", "q71", "q72", "q73", "q76", "q77", "q79", "q82", "q87",
     "q88", "q90", "q92", "q93", "q96", "q97", "q99",
-    # window / global-sort shapes. q67/q86 are excluded: their RANK
-    # orders by a float SUM whose value depends on summation order, and
-    # exchange partitioning changes that order - near-equal sums flip
-    # ranks nondeterministically (the in-memory matrix still covers
-    # both; Spark's own validator rounds results for the same reason).
+    # window / global-sort shapes. q67/q86 RANK over float SUMs whose
+    # value depends on summation order; exchange partitioning changes
+    # that order, so near-equal sums may legitimately flip ranks. They
+    # run with a rank-tolerant comparison (below) instead of being
+    # excluded: sums must match within float tolerance and every rank
+    # must be achievable under a tolerance perturbation of the sums.
     "q12", "q20", "q36", "q44", "q47", "q49", "q51", "q53", "q57",
-    "q63", "q70", "q89", "q98",
+    "q63", "q70", "q89", "q98", "q67", "q86",
 ]
+
+RANK_TOLERANT = {"q67", "q86"}
 
 N_EXCHANGE_PARTITIONS = 4
 
@@ -100,12 +103,130 @@ def _run(scans, q, tmp_path):
     return run_plan(plan).to_pandas()
 
 
+def _rank_bounds(sums, value, rel=1e-6):
+    """Achievable (min_rank, max_rank) for `value` among `sums` when
+    every sum may be perturbed by up to `rel` relative error (the
+    summation-order sensitivity exchange partitioning introduces)."""
+    import numpy as np
+
+    s = np.asarray(sums, dtype=float)
+    tol = rel * np.maximum(np.abs(s), np.abs(value)) + 1e-9
+    strictly_above = int(np.sum(s > value + tol))
+    at_least = int(np.sum(s >= value - tol))
+    return strictly_above + 1, at_least
+
+
+def _assert_rank_tolerant_q86(got, exp_full, tables):
+    import numpy as np
+
+    key = ["lochierarchy", "i_category", "i_class"]
+    g = got.copy()
+    e = exp_full.copy()
+    for c in key:
+        g[c] = g[c].astype("string").fillna("\0")
+        e[c] = e[c].astype("string").fillna("\0")
+    m = g.merge(
+        e[key + ["total_sum"]], on=key, suffixes=("", "_e"),
+        how="left",
+    )
+    assert len(m) == len(g) and not m["total_sum_e"].isna().any()
+    assert np.allclose(
+        m["total_sum"].astype(float),
+        m["total_sum_e"].astype(float), rtol=1e-6,
+    )
+    # rank partitions: (lochierarchy, category-for-level-0); the
+    # bounds use the FULL partition from the oracle frame, not the
+    # head(100)-clipped rows the query emits
+    m["part_cat"] = m["i_category"].where(
+        m["lochierarchy"] == "0", "\1"
+    )
+    e["part_cat"] = e["i_category"].where(
+        e["lochierarchy"] == "0", "\1"
+    )
+    for (lh, pc), rows in m.groupby(["lochierarchy", "part_cat"],
+                                    dropna=False):
+        esel = e[(e["lochierarchy"] == lh) & (e["part_cat"] == pc)]
+        sums = esel["total_sum"].astype(float).to_numpy()
+        for _, r in rows.iterrows():
+            lo, hi = _rank_bounds(sums, float(r["total_sum_e"]))
+            assert lo <= int(r["rank_within_parent"]) <= hi, (
+                (lh, pc), r["rank_within_parent"], lo, hi,
+            )
+
+
+def _assert_rank_tolerant_q67(got, rolled):
+    import numpy as np
+
+    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
+                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+
+    def canon_col(s):
+        # numeric hierarchy columns arrive as float (nullable-int ->
+        # pandas float) on one side and int/NA objects on the other:
+        # canonicalize through Float64 so "1999" == "1999.0"
+        num = pd.to_numeric(s, errors="coerce")
+        if (num.notna() == s.notna()).all():
+            return num.astype("Float64").astype("string").fillna("\0")
+        return s.astype("string").fillna("\0")
+
+    g = got.copy()
+    e = rolled.copy()
+    for c in base_cols:
+        g[c] = canon_col(g[c])
+        e[c] = canon_col(e[c])
+    g = g.reset_index().rename(columns={"index": "_row"})
+    # rollup rows are NOT unique on the raw hierarchy columns when the
+    # data itself contains NULLs (a base row with NULL d_moy collides
+    # with the level that aggregates moy away): merge may fan out, so
+    # a got row is valid if ANY candidate matches its sum within
+    # tolerance and justifies its rank
+    m = g.merge(e[base_cols + ["sumsales"]], on=base_cols,
+                suffixes=("", "_e"), how="left")
+    assert not m["sumsales_e"].isna().any()
+    m["sum_ok"] = np.isclose(
+        m["sumsales"].astype(float), m["sumsales_e"].astype(float),
+        rtol=1e-6,
+    )
+    cat_sums_cache = {}
+    for row_id, cands in m.groupby("_row"):
+        ok_cands = cands[cands["sum_ok"]]
+        assert len(ok_cands) > 0, (row_id, cands.to_dict("records"))
+        rk = int(ok_cands.iloc[0]["rk"])
+        assert rk <= 100
+        cat = ok_cands.iloc[0]["i_category"]
+        if cat not in cat_sums_cache:
+            cat_sums_cache[cat] = e[e.i_category == cat][
+                "sumsales"].astype(float).to_numpy()
+        cat_sums = cat_sums_cache[cat]
+        achievable = False
+        for _, c in ok_cands.iterrows():
+            lo, hi = _rank_bounds(cat_sums, float(c["sumsales_e"]))
+            if lo <= rk <= hi:
+                achievable = True
+                break
+        assert achievable, (cat, rk)
+
+
 @pytest.mark.parametrize("q", EXCHANGE_QUERIES)
 def test_query_through_shuffle_exchanges(env, q, tmp_path):
     tables, mem_scans, _ = env
     got = _run(mem_scans, q, tmp_path)
     exp = ORACLES[q](tables)
     exp.columns = list(got.columns)
+    if q in RANK_TOLERANT:
+        from tests.test_tpcds_queries import (
+            q67_rolled_frame,
+            q86_rolled_frame,
+        )
+
+        assert len(got) == len(exp), (q, len(got), len(exp))
+        if q == "q86":
+            _assert_rank_tolerant_q86(
+                got, q86_rolled_frame(tables), tables
+            )
+        else:
+            _assert_rank_tolerant_q67(got, q67_rolled_frame(tables))
+        return
     assert_frames_match(got, exp, f"{q}/shuffle")
 
 

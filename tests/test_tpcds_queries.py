@@ -2239,7 +2239,9 @@ def oracle_q82(t):
         drop=True)
 
 
-def oracle_q86(t):
+def q86_rolled_frame(t):
+    """q86's full ranked rollup BEFORE the head(100) - also consumed by
+    the exchange tier's rank-tolerant comparison."""
     dd = t["date_dim"]
     d = dd[dd.d_month_seq.between(1188, 1199)][["d_date_sk"]]
     j = _merge(t["web_sales"], d, "ws_sold_date_sk", "d_date_sk")
@@ -2265,6 +2267,11 @@ def oracle_q86(t):
         rolled.groupby(["lochierarchy", "part_cat"], dropna=False)
         .total_sum.rank(method="min", ascending=False).astype(int)
     )
+    return rolled
+
+
+def oracle_q86(t):
+    rolled = q86_rolled_frame(t)
     out = rolled.sort_values(
         ["lochierarchy", "i_category", "i_class",
          "rank_within_parent"],
@@ -2376,7 +2383,9 @@ def oracle_q66(t):
         drop=True)
 
 
-def oracle_q67(t):
+def q67_rolled_frame(t):
+    """q67's full ranked rollup BEFORE the rk<=100 filter/limit - also
+    consumed by the exchange tier's rank-tolerant comparison."""
     dd = t["date_dim"]
     d = dd[dd.d_month_seq.between(1188, 1199)][
         ["d_date_sk", "d_year", "d_qoy", "d_moy"]]
@@ -2415,6 +2424,13 @@ def oracle_q67(t):
         rolled.groupby("i_category", dropna=False)
         .sumsales.rank(method="min", ascending=False).astype(int)
     )
+    return rolled
+
+
+def oracle_q67(t):
+    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
+                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    rolled = q67_rolled_frame(t)
     top = rolled[rolled.rk <= 100]
     out = top.sort_values(
         base_cols + ["sumsales", "rk"], na_position="first").head(100)

@@ -449,3 +449,36 @@ def test_range_value_offsets_int32_extreme_no_wrap():
     # [v, v+10] -> itself; 2147483640's [., +10] saturates at the max
     # and includes 2147483646; 2147483646's frame includes itself only
     assert got["s"].tolist() == [4, 3, 2]
+
+
+def test_range_mixed_offset_unbounded_with_null_rows():
+    """A NULL order row's OFFSET bound collapses to its null peer run,
+    but an UNBOUNDED side still reaches the partition edge (review r4:
+    both sides were wrongly clamped to the peer run)."""
+    import pyarrow as pa
+
+    df = pa.table({
+        "k": pa.array([1, 1, 1, 1], pa.int32()),
+        "o": pa.array([None, None, 2, 5], pa.int32()),
+        "v": pa.array([10, 20, 1, 2], pa.int64()),
+    })
+    cb = ColumnBatch.from_arrow(df.to_batches()[0])
+    op = WindowExec(
+        MemoryScanExec([[cb]], cb.schema),
+        partition_by=[Col("k")],
+        order_by=[SortKey(Col("o"), ascending=True, nulls_first=True)],
+        functions=[
+            WindowFn("sum", Col("v"), "s",
+                     frame=("range", 1, None)),   # x PREC .. UNB FOLL
+            WindowFn("sum", Col("v"), "t",
+                     frame=("range", None, 1)),   # UNB PREC .. y FOLL
+        ],
+    )
+    got = run_plan(op).to_pandas()
+    # rows sorted: [NULL(10), NULL(20), 2(1), 5(2)]
+    # frame (1 PREC, UNB FOLL): null rows -> [peer-run start .. part
+    # end] = 10+20+1+2 = 33; o=2 -> [2-1, end] = 3; o=5 -> [4, end] = 2
+    assert got["s"].tolist() == [33, 33, 3, 2]
+    # frame (UNB PREC, 1 FOLL): null rows -> [part start .. peer-run
+    # end] = 30; o=2 -> [start, 3] = 31; o=5 -> [start, 6] = 33
+    assert got["t"].tolist() == [30, 30, 31, 33]
