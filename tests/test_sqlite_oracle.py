@@ -11,9 +11,10 @@ SQL - executed by SQLite's own planner/runtime. The test asserts
 sqlite(SQL) == pandas oracle; the main matrix separately asserts
 engine == pandas oracle, so all three formulations must agree.
 
-Coverage: a 75-query cross-section (incl. EXISTS/EXCEPT/INTERSECT set shapes) (incl. window functions) (scan/agg, multi-join, decorrelated
-AVG subqueries, pivots, time-band unions, left-anti shapes). Queries
-whose oracles lean on pandas-specific mechanics stay pandas-only.
+Coverage: ALL 99 TPC-DS queries (round 4 closed the last 24) - set
+shapes (EXISTS/EXCEPT/INTERSECT), window functions, rollup unions,
+multi-channel concats, decorrelated AVG subqueries, pivots, time-band
+unions, left-anti shapes, order-stat aggregates.
 """
 
 import os
@@ -1487,6 +1488,848 @@ JOIN item id ON d.ss_item_sk = id.i_item_sk
 WHERE a.rnk_a <= 10
 ORDER BY a_rnk
 """
+
+
+
+
+# ---------------------------------------------------------------------------
+# round-4 additions: the 24 formulations that closed the 99/99 matrix
+# ---------------------------------------------------------------------------
+
+SQL["q5"] = """
+WITH ch AS (
+  SELECT 'store channel' AS channel, ss_sold_date_sk AS date_sk,
+         ss_item_sk AS id, ss_ext_sales_price AS sales_price,
+         0.0 AS return_amt FROM store_sales
+  UNION ALL
+  SELECT 'store channel', sr_returned_date_sk, sr_item_sk, 0.0,
+         sr_return_amt FROM store_returns
+  UNION ALL
+  SELECT 'catalog channel', cs_sold_date_sk, cs_item_sk,
+         cs_ext_sales_price, 0.0 FROM catalog_sales
+  UNION ALL
+  SELECT 'catalog channel', cr_returned_date_sk, cr_item_sk, 0.0,
+         cr_return_amount FROM catalog_returns
+  UNION ALL
+  SELECT 'web channel', ws_sold_date_sk, ws_item_sk,
+         ws_ext_sales_price, 0.0 FROM web_sales
+  UNION ALL
+  SELECT 'web channel', wr_returned_date_sk, wr_item_sk, 0.0,
+         wr_return_amt FROM web_returns
+),
+detail AS (
+  SELECT channel, id, SUM(sales_price) AS sales,
+         SUM(return_amt) AS returns_
+  FROM ch JOIN date_dim ON date_sk = d_date_sk AND d_year = 1998
+  GROUP BY channel, id
+)
+SELECT channel, id, sales, returns_ FROM detail
+UNION ALL
+SELECT channel, NULL, SUM(sales), SUM(returns_) FROM detail
+GROUP BY channel
+UNION ALL
+SELECT NULL, NULL, SUM(sales), SUM(returns_) FROM detail
+"""
+
+SQL["q10"] = """
+WITH d AS (SELECT d_date_sk FROM date_dim
+           WHERE d_year = 2000 AND d_moy BETWEEN 1 AND 4)
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       cd_purchase_estimate, cd_credit_rating, COUNT(*) AS cnt
+FROM customer
+JOIN customer_address ON c_current_addr_sk = ca_address_sk
+     AND ca_county IN ('Rich County', 'Walker County')
+JOIN customer_demographics ON c_current_cdemo_sk = cd_demo_sk
+WHERE c_customer_sk IN (
+        SELECT ss_customer_sk FROM store_sales
+        JOIN d ON ss_sold_date_sk = d_date_sk)
+  AND c_customer_sk IN (
+        SELECT ws_bill_customer_sk FROM web_sales
+        JOIN d ON ws_sold_date_sk = d_date_sk
+        UNION
+        SELECT cs_bill_customer_sk FROM catalog_sales
+        JOIN d ON cs_sold_date_sk = d_date_sk)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender NULLS FIRST, cd_marital_status NULLS FIRST,
+         cd_education_status NULLS FIRST,
+         cd_purchase_estimate NULLS FIRST,
+         cd_credit_rating NULLS FIRST
+LIMIT 100
+"""
+
+SQL["q14"] = """
+WITH cross_pairs AS (
+  SELECT i_brand_id, i_manufact_id FROM store_sales
+  JOIN item ON ss_item_sk = i_item_sk
+  INTERSECT
+  SELECT i_brand_id, i_manufact_id FROM catalog_sales
+  JOIN item ON cs_item_sk = i_item_sk
+  INTERSECT
+  SELECT i_brand_id, i_manufact_id FROM web_sales
+  JOIN item ON ws_item_sk = i_item_sk
+),
+cross_items AS (
+  SELECT i_item_sk FROM item
+  JOIN cross_pairs USING (i_brand_id, i_manufact_id)
+),
+all_sales AS (
+  SELECT ss_item_sk AS item_sk, ss_ext_sales_price AS sales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+  UNION ALL
+  SELECT cs_item_sk, cs_ext_sales_price FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk AND d_year = 1999
+  UNION ALL
+  SELECT ws_item_sk, ws_ext_sales_price FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk AND d_year = 1999
+),
+by_brand AS (
+  SELECT i_brand_id AS brand_id, SUM(sales) AS sales,
+         COUNT(*) AS number_sales
+  FROM all_sales
+  JOIN item ON item_sk = i_item_sk
+  WHERE item_sk IN (SELECT i_item_sk FROM cross_items)
+  GROUP BY i_brand_id
+),
+detail AS (
+  SELECT * FROM by_brand
+  WHERE sales > (SELECT AVG(sales) FROM all_sales)
+)
+SELECT brand_id, sales, number_sales FROM detail
+UNION ALL
+SELECT NULL, SUM(sales), SUM(number_sales) FROM detail
+"""
+
+SQL["q23"] = """
+WITH frequent AS (
+  SELECT ss_item_sk FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY ss_item_sk HAVING COUNT(*) > 2
+),
+csales AS (
+  SELECT ss_customer_sk AS cust,
+         SUM(CAST(ss_quantity AS REAL) * ss_sales_price) AS v
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_year IN (2000, 2001)
+  WHERE ss_customer_sk IS NOT NULL
+  GROUP BY ss_customer_sk
+),
+best AS (
+  SELECT cust FROM csales
+  WHERE v > 0.5 * (SELECT MAX(v) FROM csales)
+),
+month AS (SELECT d_date_sk FROM date_dim
+          WHERE d_year = 2000 AND d_moy = 3)
+SELECT (SELECT SUM(CAST(cs_quantity AS REAL) * cs_list_price)
+        FROM catalog_sales
+        JOIN month ON cs_sold_date_sk = d_date_sk
+        WHERE cs_item_sk IN (SELECT ss_item_sk FROM frequent)
+          AND cs_bill_customer_sk IN (SELECT cust FROM best))
+     + (SELECT SUM(CAST(ws_quantity AS REAL) * ws_list_price)
+        FROM web_sales
+        JOIN month ON ws_sold_date_sk = d_date_sk
+        WHERE ws_item_sk IN (SELECT ss_item_sk FROM frequent)
+          AND ws_bill_customer_sk IN (SELECT cust FROM best))
+       AS total
+"""
+
+SQL["q24"] = """
+WITH ssales AS (
+  SELECT c_last_name, c_first_name, s_store_name, i_color,
+         SUM(ss_net_paid) AS netpaid
+  FROM store_sales
+  JOIN store_returns ON ss_ticket_number = sr_ticket_number
+       AND ss_item_sk = sr_item_sk
+  JOIN store ON ss_store_sk = s_store_sk AND s_market_id <= 5
+  JOIN item ON ss_item_sk = i_item_sk
+  JOIN customer ON ss_customer_sk = c_customer_sk
+  JOIN customer_address ON c_current_addr_sk = ca_address_sk
+       AND ca_state IS NOT NULL AND s_state = ca_state
+  GROUP BY c_last_name, c_first_name, s_store_name, i_color
+)
+SELECT c_last_name, c_first_name, s_store_name, i_color, netpaid
+FROM ssales
+WHERE netpaid > 0.05 * (SELECT AVG(netpaid) FROM ssales)
+ORDER BY c_last_name NULLS FIRST, c_first_name NULLS FIRST,
+         s_store_name NULLS FIRST, i_color NULLS FIRST
+LIMIT 100
+"""
+
+SQL["q39"] = """
+WITH stats AS (
+  SELECT d_moy AS moy, inv_warehouse_sk AS w, inv_item_sk AS i,
+         AVG(CAST(inv_quantity_on_hand AS REAL)) AS mean,
+         COUNT(*) AS n,
+         SUM(CAST(inv_quantity_on_hand AS REAL)
+             * inv_quantity_on_hand) AS s2,
+         SUM(CAST(inv_quantity_on_hand AS REAL)) AS s1
+  FROM inventory
+  JOIN date_dim ON inv_date_sk = d_date_sk AND d_year = 1999
+       AND d_moy IN (1, 2)
+  GROUP BY d_moy, inv_warehouse_sk, inv_item_sk
+),
+cov AS (
+  SELECT moy, w, i, mean,
+         SQRT((s2 - s1 * s1 / n) / (n - 1)) / mean AS cov
+  FROM stats WHERE n > 1 AND mean != 0
+)
+SELECT a.w AS w_warehouse_sk, a.i AS i_item_sk,
+       a.mean AS mean1, a.cov AS cov1,
+       b.mean AS mean2, b.cov AS cov2
+FROM cov a JOIN cov b ON a.w = b.w AND a.i = b.i
+     AND a.moy = 1 AND b.moy = 2
+WHERE a.cov > 1.0 AND b.cov > 1.0
+ORDER BY a.w, a.i
+"""
+
+_Q47_LIKE = """
+WITH agg AS (
+  SELECT i_category, i_brand, {entity_cols}, d_year, d_moy,
+         SUM({sum_col}) AS sum_sales
+  FROM {sales}
+  JOIN date_dim ON {date_col} = d_date_sk
+       AND d_year BETWEEN 1998 AND 2000
+  JOIN item ON {item_fk} = i_item_sk
+  JOIN {entity} ON {entity_fk} = {entity_sk}
+  GROUP BY i_category, i_brand, {entity_cols}, d_year, d_moy
+),
+win AS (
+  SELECT *,
+         AVG(sum_sales) OVER (
+           PARTITION BY i_category, i_brand, {entity_cols}, d_year
+         ) AS avg_monthly_sales,
+         LAG(sum_sales) OVER (
+           PARTITION BY i_category, i_brand, {entity_cols}
+           ORDER BY d_year, d_moy) AS psum,
+         LEAD(sum_sales) OVER (
+           PARTITION BY i_category, i_brand, {entity_cols}
+           ORDER BY d_year, d_moy) AS nsum
+  FROM agg
+)
+SELECT i_category, i_brand, {entity_cols}, d_year, d_moy, sum_sales,
+       avg_monthly_sales, psum, nsum
+FROM win
+WHERE d_year = 1999 AND avg_monthly_sales > 0
+  AND ABS(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+ORDER BY sum_sales - avg_monthly_sales, i_category NULLS FIRST,
+         i_brand NULLS FIRST, {order_tail}, d_year, d_moy
+LIMIT 100
+"""
+
+SQL["q47"] = _Q47_LIKE.format(
+    sales="store_sales", date_col="ss_sold_date_sk",
+    item_fk="ss_item_sk", sum_col="ss_sales_price",
+    entity="store", entity_sk="s_store_sk", entity_fk="ss_store_sk",
+    entity_cols="s_store_name, s_company_name",
+    order_tail="s_store_name NULLS FIRST, s_company_name NULLS FIRST",
+)
+
+SQL["q57"] = _Q47_LIKE.format(
+    sales="catalog_sales", date_col="cs_sold_date_sk",
+    item_fk="cs_item_sk", sum_col="cs_sales_price",
+    entity="call_center", entity_sk="cc_call_center_sk",
+    entity_fk="cs_call_center_sk", entity_cols="cc_name",
+    order_tail="cc_name NULLS FIRST",
+)
+
+SQL["q49"] = """
+WITH chan AS (
+  SELECT 'web' AS channel, ws_item_sk AS item, ws_quantity AS qty,
+         ws_ext_sales_price AS amt, wr_return_quantity AS rqty,
+         wr_return_amt AS ramt
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_order_number = wr_order_number
+       AND ws_item_sk = wr_item_sk
+  UNION ALL
+  SELECT 'catalog', cs_item_sk, cs_quantity, cs_ext_sales_price,
+         cr_return_quantity, cr_return_amount
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+       AND cs_item_sk = cr_item_sk
+  UNION ALL
+  SELECT 'store', ss_item_sk, ss_quantity, ss_ext_sales_price,
+         sr_return_quantity, sr_return_amt
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_ticket_number = sr_ticket_number
+       AND ss_item_sk = sr_item_sk
+),
+g AS (
+  SELECT channel, item,
+         CAST(SUM(COALESCE(rqty, 0)) AS REAL) / SUM(qty) AS qty_ratio,
+         SUM(COALESCE(ramt, 0.0)) / SUM(amt) AS amt_ratio
+  FROM chan GROUP BY channel, item
+),
+r AS (
+  SELECT channel, item, amt_ratio,
+         RANK() OVER (PARTITION BY channel
+                      ORDER BY qty_ratio NULLS LAST) AS return_rank,
+         RANK() OVER (PARTITION BY channel
+                      ORDER BY amt_ratio NULLS LAST) AS currency_rank
+  FROM g
+)
+SELECT channel, item, amt_ratio AS return_ratio, return_rank,
+       currency_rank
+FROM r
+WHERE return_rank <= 10 OR currency_rank <= 10
+ORDER BY channel, return_rank, currency_rank, item
+LIMIT 100
+"""
+
+
+
+
+SQL["q54"] = """
+WITH my_customers AS (
+  SELECT DISTINCT customer_sk FROM (
+    SELECT cs_sold_date_sk AS sold_date_sk, cs_item_sk AS item_sk,
+           cs_bill_customer_sk AS customer_sk FROM catalog_sales
+    UNION ALL
+    SELECT ws_sold_date_sk, ws_item_sk, ws_bill_customer_sk
+    FROM web_sales
+  )
+  JOIN item ON item_sk = i_item_sk AND i_category = 'Books'
+  JOIN date_dim ON sold_date_sk = d_date_sk
+       AND d_year = 1999 AND d_moy = 3
+  WHERE customer_sk IS NOT NULL
+),
+eligible AS (
+  SELECT DISTINCT c_customer_sk
+  FROM customer
+  JOIN my_customers ON c_customer_sk = customer_sk
+  JOIN customer_address ON c_current_addr_sk = ca_address_sk
+  JOIN (SELECT DISTINCT s_county, s_state FROM store)
+       ON ca_county = s_county AND ca_state = s_state
+),
+rev AS (
+  SELECT c_customer_sk AS cust,
+         SUM(ss_ext_sales_price) AS revenue
+  FROM eligible
+  JOIN store_sales ON c_customer_sk = ss_customer_sk
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_month_seq BETWEEN 1191 AND 1193
+  GROUP BY c_customer_sk
+)
+SELECT CAST(revenue / 50.0 AS INTEGER) AS segment,
+       COUNT(*) AS num_customers,
+       CAST(revenue / 50.0 AS INTEGER) * 50 AS segment_base
+FROM rev
+GROUP BY CAST(revenue / 50.0 AS INTEGER)
+ORDER BY segment, num_customers
+LIMIT 100
+"""
+
+SQL["q64"] = """
+WITH ui AS (
+  SELECT cs_item_sk AS item
+  FROM catalog_sales
+  JOIN catalog_returns ON cs_order_number = cr_order_number
+       AND cs_item_sk = cr_item_sk
+  GROUP BY cs_item_sk
+  HAVING SUM(cs_ext_list_price)
+         > (SUM(cr_return_amount) + SUM(cr_net_loss)) * 2.0
+),
+cs_base AS (
+  SELECT d_year, i_product_name, ss_item_sk, s_store_name, s_zip,
+         ss_ext_wholesale_cost, ss_ext_list_price, ss_coupon_amt
+  FROM store_sales
+  JOIN store_returns ON ss_ticket_number = sr_ticket_number
+       AND ss_item_sk = sr_item_sk
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_year IN (1999, 2000)
+  JOIN store ON ss_store_sk = s_store_sk
+  JOIN customer ON ss_customer_sk = c_customer_sk
+  JOIN household_demographics ON c_current_hdemo_sk = hd_demo_sk
+  JOIN income_band ON hd_income_band_sk = ib_income_band_sk
+  JOIN customer_address ca1 ON c_current_addr_sk = ca1.ca_address_sk
+  JOIN customer_address ca2 ON ss_addr_sk = ca2.ca_address_sk
+  JOIN item ON ss_item_sk = i_item_sk
+       AND i_color IN ('red', 'navy', 'khaki')
+  WHERE ss_item_sk IN (SELECT item FROM ui)
+),
+per_year AS (
+  SELECT d_year, i_product_name, ss_item_sk, s_store_name, s_zip,
+         COUNT(*) AS cnt, SUM(ss_ext_wholesale_cost) AS s1,
+         SUM(ss_ext_list_price) AS s2, SUM(ss_coupon_amt) AS s3
+  FROM cs_base
+  GROUP BY d_year, i_product_name, ss_item_sk, s_store_name, s_zip
+)
+SELECT y1.i_product_name, y1.s_store_name, y1.s_zip,
+       y1.cnt AS y1_cnt, y1.s1 AS y1_s1, y2.cnt AS y2_cnt,
+       y2.s1 AS y2_s1
+FROM per_year y1
+JOIN per_year y2 ON y1.ss_item_sk = y2.ss_item_sk
+     AND y1.s_store_name = y2.s_store_name AND y1.s_zip = y2.s_zip
+     AND y1.d_year = 1999 AND y2.d_year = 2000
+WHERE y2.cnt <= y1.cnt
+ORDER BY y1.i_product_name NULLS FIRST, y1.s_store_name NULLS FIRST,
+         y1.s1 NULLS FIRST
+LIMIT 100
+"""
+
+SQL["q66"] = """
+WITH both_ch AS (
+  SELECT w_warehouse_name AS wn, d_moy,
+         ws_ext_sales_price AS price
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk AND d_year = 1999
+  JOIN ship_mode ON ws_ship_mode_sk = sm_ship_mode_sk
+       AND sm_type IN ('EXPRESS', 'REGULAR')
+  JOIN warehouse ON ws_warehouse_sk = w_warehouse_sk
+  UNION ALL
+  SELECT w_warehouse_name, d_moy, cs_ext_sales_price
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk AND d_year = 1999
+  JOIN ship_mode ON cs_ship_mode_sk = sm_ship_mode_sk
+       AND sm_type IN ('EXPRESS', 'REGULAR')
+  JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+)
+SELECT wn AS w_warehouse_name,
+       SUM(CASE WHEN d_moy = 1 THEN price END) AS m1_sales,
+       SUM(CASE WHEN d_moy = 2 THEN price END) AS m2_sales,
+       SUM(CASE WHEN d_moy = 3 THEN price END) AS m3_sales,
+       SUM(CASE WHEN d_moy = 4 THEN price END) AS m4_sales,
+       SUM(CASE WHEN d_moy = 5 THEN price END) AS m5_sales,
+       SUM(CASE WHEN d_moy = 6 THEN price END) AS m6_sales,
+       SUM(CASE WHEN d_moy = 7 THEN price END) AS m7_sales,
+       SUM(CASE WHEN d_moy = 8 THEN price END) AS m8_sales,
+       SUM(CASE WHEN d_moy = 9 THEN price END) AS m9_sales,
+       SUM(CASE WHEN d_moy = 10 THEN price END) AS m10_sales,
+       SUM(CASE WHEN d_moy = 11 THEN price END) AS m11_sales,
+       SUM(CASE WHEN d_moy = 12 THEN price END) AS m12_sales
+FROM both_ch
+GROUP BY wn
+ORDER BY wn
+LIMIT 100
+"""
+
+SQL["q67"] = """
+WITH base AS (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id,
+         SUM(ss_sales_price * CAST(ss_quantity AS REAL)) AS sumsales
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_month_seq BETWEEN 1188 AND 1199
+  JOIN item ON ss_item_sk = i_item_sk
+  JOIN store ON ss_store_sk = s_store_sk
+  GROUP BY i_category, i_class, i_brand, i_product_name, d_year,
+           d_qoy, d_moy, s_store_id
+),
+rolled AS (
+  SELECT * FROM base
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, NULL, SUM(sumsales) FROM base
+  GROUP BY 1, 2, 3, 4, 5, 6, 7
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         NULL, NULL, SUM(sumsales) FROM base GROUP BY 1, 2, 3, 4, 5, 6
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, NULL,
+         NULL, NULL, SUM(sumsales) FROM base GROUP BY 1, 2, 3, 4, 5
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, NULL, NULL,
+         NULL, NULL, SUM(sumsales) FROM base GROUP BY 1, 2, 3, 4
+  UNION ALL
+  SELECT i_category, i_class, i_brand, NULL, NULL, NULL, NULL, NULL,
+         SUM(sumsales) FROM base GROUP BY 1, 2, 3
+  UNION ALL
+  SELECT i_category, i_class, NULL, NULL, NULL, NULL, NULL, NULL,
+         SUM(sumsales) FROM base GROUP BY 1, 2
+  UNION ALL
+  SELECT i_category, NULL, NULL, NULL, NULL, NULL, NULL, NULL,
+         SUM(sumsales) FROM base GROUP BY 1
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL,
+         SUM(sumsales) FROM base
+),
+ranked AS (
+  SELECT *, RANK() OVER (PARTITION BY i_category
+                         ORDER BY sumsales DESC) AS rk
+  FROM rolled
+)
+SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+       d_moy, s_store_id, sumsales, rk
+FROM ranked WHERE rk <= 100
+ORDER BY i_category NULLS FIRST, i_class NULLS FIRST,
+         i_brand NULLS FIRST, i_product_name NULLS FIRST,
+         d_year NULLS FIRST, d_qoy NULLS FIRST, d_moy NULLS FIRST,
+         s_store_id NULLS FIRST, sumsales NULLS FIRST, rk
+LIMIT 100
+"""
+
+SQL["q70"] = """
+WITH j AS (
+  SELECT s_state, s_county, ss_net_profit
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_month_seq BETWEEN 1188 AND 1199
+  JOIN store ON ss_store_sk = s_store_sk
+),
+top_states AS (
+  SELECT s_state FROM (
+    SELECT s_state,
+           RANK() OVER (ORDER BY SUM(ss_net_profit) DESC) AS rnk
+    FROM j GROUP BY s_state
+  ) WHERE rnk <= 5
+),
+base AS (
+  SELECT s_state, s_county, SUM(ss_net_profit) AS total_sum
+  FROM j WHERE s_state IN (SELECT s_state FROM top_states)
+  GROUP BY s_state, s_county
+),
+rolled AS (
+  SELECT s_state, s_county, total_sum, 0 AS lochierarchy FROM base
+  UNION ALL
+  SELECT s_state, NULL, SUM(total_sum), 1 FROM base GROUP BY s_state
+  UNION ALL
+  SELECT NULL, NULL, SUM(total_sum), 2 FROM base
+),
+ranked AS (
+  SELECT *, RANK() OVER (
+    PARTITION BY lochierarchy,
+                 CASE WHEN lochierarchy = 0 THEN s_state END
+    ORDER BY total_sum DESC) AS rank_within_parent
+  FROM rolled
+)
+SELECT s_state, s_county, total_sum, lochierarchy, rank_within_parent
+FROM ranked
+ORDER BY lochierarchy DESC, s_state NULLS FIRST,
+         s_county NULLS FIRST, rank_within_parent
+LIMIT 100
+"""
+
+SQL["q72"] = """
+SELECT i_item_desc, w_warehouse_name, sold_week.d_week_seq AS week,
+       COUNT(*) AS no_promo
+FROM catalog_sales
+JOIN date_dim sold_week ON cs_sold_date_sk = sold_week.d_date_sk
+     AND sold_week.d_year = 1999
+JOIN inventory ON cs_item_sk = inv_item_sk
+JOIN warehouse ON inv_warehouse_sk = w_warehouse_sk
+JOIN date_dim inv_week ON inv_date_sk = inv_week.d_date_sk
+     AND inv_week.d_week_seq = sold_week.d_week_seq
+JOIN household_demographics ON cs_bill_hdemo_sk = hd_demo_sk
+     AND hd_buy_potential = '>10000'
+JOIN customer_demographics ON cs_bill_cdemo_sk = cd_demo_sk
+     AND cd_marital_status = 'M'
+JOIN item ON cs_item_sk = i_item_sk
+WHERE CAST(cs_ship_date_sk AS REAL) - cs_sold_date_sk > 5
+  AND inv_quantity_on_hand < cs_quantity
+GROUP BY i_item_desc, w_warehouse_name, sold_week.d_week_seq
+ORDER BY no_promo DESC, i_item_desc, w_warehouse_name, week
+LIMIT 100
+"""
+
+SQL["q74"] = """
+WITH s_yt AS (
+  SELECT c_customer_sk, c_customer_id, c_first_name, c_last_name,
+         d_year, SUM(ss_sales_price) AS yt
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_year BETWEEN 1998 AND 1999
+  JOIN customer ON ss_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk, c_customer_id, c_first_name, c_last_name,
+           d_year
+),
+w_yt AS (
+  SELECT c_customer_sk, d_year, SUM(ws_ext_sales_price) AS yt
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+       AND d_year BETWEEN 1998 AND 1999
+  JOIN customer ON ws_bill_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk, d_year
+)
+SELECT s1.c_customer_id AS customer_id,
+       s1.c_first_name AS first_name, s1.c_last_name AS last_name
+FROM s_yt s1
+JOIN s_yt s2 ON s1.c_customer_sk = s2.c_customer_sk
+     AND s1.d_year = 1998 AND s2.d_year = 1999
+JOIN w_yt w1 ON s1.c_customer_sk = w1.c_customer_sk
+     AND w1.d_year = 1998
+JOIN w_yt w2 ON s1.c_customer_sk = w2.c_customer_sk
+     AND w2.d_year = 1999
+WHERE s1.yt > 0 AND w1.yt > 0 AND w2.yt / w1.yt > s2.yt / s1.yt
+ORDER BY s1.c_customer_id
+LIMIT 100
+"""
+
+SQL["q75"] = """
+WITH allch AS (
+  SELECT d_year, i_brand_id,
+         cs_quantity - COALESCE(cr_return_quantity, 0) AS sales_cnt,
+         cs_ext_sales_price - COALESCE(cr_return_amount, 0.0)
+           AS sales_amt
+  FROM catalog_sales
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+       AND d_year BETWEEN 1998 AND 1999
+  JOIN item ON cs_item_sk = i_item_sk AND i_category = 'Books'
+  LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+       AND cs_item_sk = cr_item_sk
+  UNION ALL
+  SELECT d_year, i_brand_id,
+         ss_quantity - COALESCE(sr_return_quantity, 0),
+         ss_ext_sales_price - COALESCE(sr_return_amt, 0.0)
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+       AND d_year BETWEEN 1998 AND 1999
+  JOIN item ON ss_item_sk = i_item_sk AND i_category = 'Books'
+  LEFT JOIN store_returns ON ss_ticket_number = sr_ticket_number
+       AND ss_item_sk = sr_item_sk
+  UNION ALL
+  SELECT d_year, i_brand_id,
+         ws_quantity - COALESCE(wr_return_quantity, 0),
+         ws_ext_sales_price - COALESCE(wr_return_amt, 0.0)
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+       AND d_year BETWEEN 1998 AND 1999
+  JOIN item ON ws_item_sk = i_item_sk AND i_category = 'Books'
+  LEFT JOIN web_returns ON ws_order_number = wr_order_number
+       AND ws_item_sk = wr_item_sk
+),
+by_year AS (
+  SELECT d_year, i_brand_id, SUM(sales_cnt) AS cnt,
+         SUM(sales_amt) AS amt
+  FROM allch GROUP BY d_year, i_brand_id
+)
+SELECT p.d_year AS prev_year, c.d_year AS year, c.i_brand_id,
+       p.cnt AS prev_yr_cnt, c.cnt AS curr_yr_cnt,
+       c.cnt - p.cnt AS sales_cnt_diff, c.amt - p.amt AS sales_amt_diff
+FROM by_year p
+JOIN by_year c ON p.i_brand_id = c.i_brand_id
+     AND p.d_year = 1998 AND c.d_year = 1999
+WHERE CAST(c.cnt AS REAL) / p.cnt < 0.9
+ORDER BY sales_cnt_diff, c.i_brand_id
+LIMIT 100
+"""
+
+SQL["q77"] = """
+WITH d AS (SELECT d_date_sk FROM date_dim
+           WHERE d_year = 1999 AND d_moy <= 2),
+ss AS (
+  SELECT ss_store_sk AS id, SUM(ss_ext_sales_price) AS sales,
+         SUM(ss_net_profit) AS profit
+  FROM store_sales JOIN d ON ss_sold_date_sk = d_date_sk
+  GROUP BY ss_store_sk
+),
+sr AS (
+  SELECT sr_store_sk AS id, SUM(sr_return_amt) AS returns_,
+         SUM(sr_net_loss) AS loss
+  FROM store_returns JOIN d ON sr_returned_date_sk = d_date_sk
+  GROUP BY sr_store_sk
+),
+ws AS (
+  SELECT ws_web_page_sk AS id, SUM(ws_ext_sales_price) AS sales,
+         SUM(ws_ext_discount_amt) AS profit
+  FROM web_sales JOIN d ON ws_sold_date_sk = d_date_sk
+  GROUP BY ws_web_page_sk
+),
+wr AS (
+  SELECT wr_web_page_sk AS id, SUM(wr_return_amt) AS returns_,
+         SUM(wr_net_loss) AS loss
+  FROM web_returns JOIN d ON wr_returned_date_sk = d_date_sk
+  GROUP BY wr_web_page_sk
+),
+detail AS (
+  SELECT 'store channel' AS channel, ss.id AS id, ss.sales,
+         COALESCE(sr.returns_, 0.0) AS returns_,
+         ss.profit - COALESCE(sr.loss, 0.0) AS profit
+  FROM ss LEFT JOIN sr ON ss.id = sr.id
+  UNION ALL
+  SELECT 'catalog channel', NULL,
+         (SELECT SUM(cs_ext_sales_price) FROM catalog_sales
+          JOIN d ON cs_sold_date_sk = d_date_sk),
+         (SELECT SUM(cr_return_amount) FROM catalog_returns
+          JOIN d ON cr_returned_date_sk = d_date_sk),
+         (SELECT SUM(cs_ext_discount_amt) FROM catalog_sales
+          JOIN d ON cs_sold_date_sk = d_date_sk)
+         - (SELECT SUM(cr_net_loss) FROM catalog_returns
+            JOIN d ON cr_returned_date_sk = d_date_sk)
+  UNION ALL
+  SELECT 'web channel', ws.id, ws.sales,
+         COALESCE(wr.returns_, 0.0),
+         ws.profit - COALESCE(wr.loss, 0.0)
+  FROM ws LEFT JOIN wr ON ws.id = wr.id
+),
+rolled AS (
+  SELECT channel, id, sales, returns_, profit FROM detail
+  UNION ALL
+  SELECT channel, NULL, SUM(sales), SUM(returns_), SUM(profit)
+  FROM detail GROUP BY channel
+  UNION ALL
+  SELECT NULL, NULL, SUM(sales), SUM(returns_), SUM(profit)
+  FROM detail
+)
+SELECT channel, id, sales, returns_, profit FROM rolled
+ORDER BY channel NULLS FIRST, id NULLS FIRST, sales NULLS FIRST
+LIMIT 100
+"""
+
+SQL["q78"] = """
+WITH ss AS (
+  SELECT ss_item_sk AS item, ss_customer_sk AS cust,
+         SUM(ss_quantity) AS qty, SUM(ss_ext_sales_price) AS amt
+  FROM store_sales
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk AND d_year = 1999
+  WHERE NOT EXISTS (SELECT 1 FROM store_returns
+                    WHERE sr_ticket_number = ss_ticket_number
+                      AND sr_item_sk = ss_item_sk)
+    AND ss_customer_sk IS NOT NULL
+  GROUP BY ss_item_sk, ss_customer_sk
+),
+ws AS (
+  SELECT ws_item_sk AS item, ws_bill_customer_sk AS cust,
+         SUM(ws_quantity) AS qty, SUM(ws_ext_sales_price) AS amt
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk AND d_year = 1999
+  WHERE NOT EXISTS (SELECT 1 FROM web_returns
+                    WHERE wr_order_number = ws_order_number
+                      AND wr_item_sk = ws_item_sk)
+    AND ws_bill_customer_sk IS NOT NULL
+  GROUP BY ws_item_sk, ws_bill_customer_sk
+)
+SELECT ss.item, ss.cust, ss.qty AS ss_qty,
+       CAST(ws.qty AS REAL) / ss.qty AS ratio,
+       ss.amt AS ss_amt, ws.amt AS ws_amt
+FROM ws JOIN ss ON ws.item = ss.item AND ws.cust = ss.cust
+ORDER BY ratio, ss.item, ss.cust
+LIMIT 100
+"""
+
+SQL["q80"] = """
+WITH month AS (SELECT d_date_sk FROM date_dim
+               WHERE d_year = 2000 AND d_moy = 8),
+items AS (SELECT i_item_sk FROM item WHERE i_current_price > 50.0),
+promos AS (SELECT p_promo_sk FROM promotion WHERE p_channel_tv = 'N'),
+both_ch AS (
+  SELECT 'store channel' AS channel, ss_store_sk AS id,
+         ss_ext_sales_price AS sales,
+         COALESCE(sr_return_amt, 0.0) AS returns,
+         ss_net_profit - COALESCE(sr_net_loss, 0.0) AS profit
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_ticket_number = sr_ticket_number
+       AND ss_item_sk = sr_item_sk
+  JOIN month ON ss_sold_date_sk = d_date_sk
+  JOIN items ON ss_item_sk = i_item_sk
+  JOIN promos ON ss_promo_sk = p_promo_sk
+  UNION ALL
+  SELECT 'catalog channel', cs_call_center_sk, cs_ext_sales_price,
+         COALESCE(cr_return_amount, 0.0),
+         cs_net_profit - COALESCE(cr_net_loss, 0.0)
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+       AND cs_item_sk = cr_item_sk
+  JOIN month ON cs_sold_date_sk = d_date_sk
+  JOIN items ON cs_item_sk = i_item_sk
+  JOIN promos ON cs_promo_sk = p_promo_sk
+  UNION ALL
+  SELECT 'web channel', ws_web_site_sk, ws_ext_sales_price,
+         COALESCE(wr_return_amt, 0.0),
+         ws_net_profit - COALESCE(wr_net_loss, 0.0)
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_order_number = wr_order_number
+       AND ws_item_sk = wr_item_sk
+  JOIN month ON ws_sold_date_sk = d_date_sk
+  JOIN items ON ws_item_sk = i_item_sk
+  JOIN promos ON ws_promo_sk = p_promo_sk
+)
+SELECT channel, id, SUM(sales) AS sales, SUM(returns) AS returns,
+       SUM(profit) AS profit
+FROM both_ch
+GROUP BY channel, id
+ORDER BY channel, id
+LIMIT 100
+"""
+
+SQL["q85"] = """
+SELECT r_reason_desc AS reason,
+       AVG(CAST(ws_quantity AS REAL)) AS avg_qty,
+       AVG(wr_refunded_cash) AS avg_cash,
+       AVG(wr_fee) AS avg_fee
+FROM web_sales
+JOIN web_returns ON ws_order_number = wr_order_number
+     AND ws_item_sk = wr_item_sk
+JOIN web_page ON ws_web_page_sk = wp_web_page_sk
+JOIN customer_demographics cd1 ON wr_refunded_cdemo_sk = cd1.cd_demo_sk
+JOIN customer_demographics cd2 ON wr_returning_cdemo_sk = cd2.cd_demo_sk
+     AND cd1.cd_marital_status = cd2.cd_marital_status
+JOIN customer_address ON wr_refunded_addr_sk = ca_address_sk
+JOIN date_dim ON ws_sold_date_sk = d_date_sk AND d_year = 2000
+JOIN reason ON wr_reason_sk = r_reason_sk
+WHERE ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_education_status = '4 yr Degree'
+        AND ws_sales_price BETWEEN 100.0 AND 150.0)
+    OR (cd1.cd_marital_status = 'S'
+        AND cd1.cd_education_status = 'College'
+        AND ws_sales_price BETWEEN 50.0 AND 100.0))
+  AND ((ca_state IN ('TN', 'GA') AND ws_net_profit >= 100.0)
+    OR (ca_state IN ('CA', 'TX') AND ws_net_profit >= 50.0))
+GROUP BY r_reason_desc
+ORDER BY reason
+LIMIT 100
+"""
+
+SQL["q86"] = """
+WITH base AS (
+  SELECT i_category, i_class, SUM(ws_ext_sales_price) AS total_sum
+  FROM web_sales
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+       AND d_month_seq BETWEEN 1188 AND 1199
+  JOIN item ON ws_item_sk = i_item_sk
+  GROUP BY i_category, i_class
+),
+rolled AS (
+  SELECT i_category, i_class, total_sum, 0 AS lochierarchy FROM base
+  UNION ALL
+  SELECT i_category, NULL, SUM(total_sum), 1 FROM base
+  GROUP BY i_category
+  UNION ALL
+  SELECT NULL, NULL, SUM(total_sum), 2 FROM base
+),
+ranked AS (
+  SELECT *, RANK() OVER (
+    PARTITION BY lochierarchy,
+                 CASE WHEN lochierarchy = 0 THEN i_category END
+    ORDER BY total_sum DESC) AS rank_within_parent
+  FROM rolled
+)
+SELECT i_category, i_class, total_sum, lochierarchy,
+       rank_within_parent
+FROM ranked
+ORDER BY lochierarchy DESC, i_category NULLS FIRST,
+         i_class NULLS FIRST, rank_within_parent
+LIMIT 100
+"""
+
+_Q94_LIKE = """
+WITH multi AS (
+  SELECT ws_order_number FROM
+    (SELECT DISTINCT ws_order_number, ws_warehouse_sk FROM web_sales)
+  GROUP BY ws_order_number HAVING COUNT(*) > 1
+),
+base AS (
+  SELECT ws_order_number, ws_ext_ship_cost, ws_net_profit
+  FROM web_sales
+  JOIN date_dim ON ws_ship_date_sk = d_date_sk AND d_year = 1999
+  JOIN customer_address ON ws_ship_addr_sk = ca_address_sk
+       AND ca_state = '{state}'
+  JOIN web_site ON ws_web_site_sk = web_site_sk
+       AND web_name = 'site_0'
+  WHERE ws_order_number IN (SELECT ws_order_number FROM multi)
+    AND ws_order_number {neg} IN
+        (SELECT wr_order_number FROM web_returns
+         WHERE wr_order_number IS NOT NULL)
+)
+SELECT COUNT(DISTINCT ws_order_number) AS order_count,
+       SUM(ws_ext_ship_cost) AS total_shipping_cost,
+       SUM(ws_net_profit) AS total_net_profit
+FROM base
+"""
+
+SQL["q94"] = _Q94_LIKE.format(state="CA", neg="NOT")
+SQL["q95"] = _Q94_LIKE.format(state="TX", neg="")
 
 
 # ---------------------------------------------------------------------------

@@ -107,8 +107,6 @@ def _rank_bounds(sums, value, rel=1e-6):
     """Achievable (min_rank, max_rank) for `value` among `sums` when
     every sum may be perturbed by up to `rel` relative error (the
     summation-order sensitivity exchange partitioning introduces)."""
-    import numpy as np
-
     s = np.asarray(sums, dtype=float)
     tol = rel * np.maximum(np.abs(s), np.abs(value)) + 1e-9
     strictly_above = int(np.sum(s > value + tol))
@@ -116,9 +114,7 @@ def _rank_bounds(sums, value, rel=1e-6):
     return strictly_above + 1, at_least
 
 
-def _assert_rank_tolerant_q86(got, exp_full, tables):
-    import numpy as np
-
+def _assert_rank_tolerant_q86(got, exp_full):
     key = ["lochierarchy", "i_category", "i_class"]
     g = got.copy()
     e = exp_full.copy()
@@ -155,10 +151,7 @@ def _assert_rank_tolerant_q86(got, exp_full, tables):
 
 
 def _assert_rank_tolerant_q67(got, rolled):
-    import numpy as np
-
-    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
-                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    from tests.test_tpcds_queries import Q67_BASE_COLS as base_cols
 
     def canon_col(s):
         # numeric hierarchy columns arrive as float (nullable-int ->
@@ -221,9 +214,7 @@ def test_query_through_shuffle_exchanges(env, q, tmp_path):
 
         assert len(got) == len(exp), (q, len(got), len(exp))
         if q == "q86":
-            _assert_rank_tolerant_q86(
-                got, q86_rolled_frame(tables), tables
-            )
+            _assert_rank_tolerant_q86(got, q86_rolled_frame(tables))
         else:
             _assert_rank_tolerant_q67(got, q67_rolled_frame(tables))
         return

@@ -2383,6 +2383,11 @@ def oracle_q66(t):
         drop=True)
 
 
+Q67_BASE_COLS = ["i_category", "i_class", "i_brand",
+                 "i_product_name", "d_year", "d_qoy", "d_moy",
+                 "s_store_id"]
+
+
 def q67_rolled_frame(t):
     """q67's full ranked rollup BEFORE the rk<=100 filter/limit - also
     consumed by the exchange tier's rank-tolerant comparison."""
@@ -2397,8 +2402,7 @@ def q67_rolled_frame(t):
     j = j.merge(t["store"][["s_store_sk", "s_store_id"]],
                 left_on="ss_store_sk", right_on="s_store_sk")
     j["sumsales"] = j.ss_sales_price * j.ss_quantity
-    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
-                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    base_cols = Q67_BASE_COLS
     base = (
         j.groupby(base_cols, dropna=False)
         .sumsales.sum().reset_index()
@@ -2428,8 +2432,7 @@ def q67_rolled_frame(t):
 
 
 def oracle_q67(t):
-    base_cols = ["i_category", "i_class", "i_brand", "i_product_name",
-                 "d_year", "d_qoy", "d_moy", "s_store_id"]
+    base_cols = Q67_BASE_COLS
     rolled = q67_rolled_frame(t)
     top = rolled[rolled.rk <= 100]
     out = top.sort_values(
