@@ -716,13 +716,17 @@ class HashAggregateExec(PhysicalOp):
         return ColumnBatch(self._schema, cols, n)
 
     # ------------------------------------------------------------------
-    def _narrow_key_dtypes(self, in_schema, key_exprs):
+    def _narrow_key_dtypes(self, in_schema, key_exprs,
+                           allow_floats: bool = False):
         """Hash dtypes for the narrow-key grouping fast path, or None
         when ineligible. Eligible: fixed-width non-float keys (ints,
         dates, timestamps, bool, decimal<=18, dictionary codes) - the
         sort then runs on ONE i32 hash lane instead of K emulated-64-bit
         lanes (ROADMAP 'aggregate/sort key widths'). Floats keep the
-        lexsort path (NaN/-0.0 normalization)."""
+        lexsort path there (NaN/-0.0 normalization), but the SCATTER
+        core compares exact key values (_pairwise_eq groups NaN with
+        NaN; cheap_hash normalizes -0.0/NaN payloads), so it passes
+        allow_floats=True."""
         from blaze_tpu.exprs.hashing import device_hash_supported
 
         dtypes = []
@@ -731,7 +735,10 @@ class HashAggregateExec(PhysicalOp):
             if dt.is_dictionary_encoded:
                 dt = DataType.int32()  # group equality == code equality
             if dt.id in (TypeId.FLOAT32, TypeId.FLOAT64):
-                return None
+                if not allow_floats:
+                    return None
+                dtypes.append(dt)
+                continue
             if dt.is_wide_decimal or not device_hash_supported(dt):
                 return None
             dtypes.append(dt)
@@ -745,13 +752,21 @@ class HashAggregateExec(PhysicalOp):
         aggs = self.aggs
         n_keys = len(key_exprs)
         state_offsets = self._state_offsets(in_schema) if merging else None
+        use_scatter = False
+        if not force_lexsort and _group_core_choice() == "scatter":
+            # the scatter core's exact-equality probing also handles
+            # float keys (NaN groups with NaN, -0.0 == 0.0), which the
+            # hash-lane sort cannot
+            use_scatter = (
+                self._narrow_key_dtypes(
+                    in_schema, key_exprs, allow_floats=True
+                )
+                is not None
+            )
+        # hash-lane dtypes only matter when the scatter gate fails
         hash_dtypes = (
-            None if force_lexsort
+            None if force_lexsort or use_scatter
             else self._narrow_key_dtypes(in_schema, key_exprs)
-        )
-        use_scatter = (
-            hash_dtypes is not None
-            and _group_core_choice() == "scatter"
         )
 
         # Segment-output capacity: with a small static group bound the

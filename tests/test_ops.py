@@ -363,3 +363,78 @@ def test_narrow_key_grouping_matches_lexsort():
     assert len(got) == len(ref)
     assert got["s"].tolist() == ref["s"].tolist()
     assert got["n"].tolist() == ref["n"].tolist()
+
+
+def test_float_group_keys_scatter_core_matches_sort_core():
+    """Float GROUP BY keys run on the scatter core (exact-equality
+    probing: NaN groups with NaN, -0.0 == 0.0); results must match the
+    lexsort core bit-for-bit."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu import ColumnBatch
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec, MemoryScanExec
+    from blaze_tpu.runtime.executor import run_plan
+
+    rng = np.random.default_rng(23)
+    n = 5000
+    keys = rng.choice(
+        [1.5, -0.0, 0.0, np.nan, 2.25, -7.5, np.inf], n
+    ).astype(np.float32)
+    vals = rng.integers(0, 100, n).astype(np.int64)
+    mask = rng.random(n) < 0.1
+    rb = pa.record_batch({
+        "k": pa.array(
+            [None if m else float(k) for k, m in zip(keys, mask)],
+            pa.float32(),
+        ),
+        "v": pa.array(vals, pa.int64()),
+    })
+
+    def agg():
+        cb = ColumnBatch.from_arrow(rb)
+        return run_plan(HashAggregateExec(
+            MemoryScanExec([[cb]], cb.schema),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "c")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    def as_dict(t):
+        # NULL and NaN are DISTINCT groups: read through Arrow, where
+        # to_pylist preserves None vs float('nan')
+        out = {}
+        for k, s, c in zip(
+            t.column("k").to_pylist(),
+            t.column("s").to_pylist(),
+            t.column("c").to_pylist(),
+        ):
+            key = (
+                "null" if k is None
+                else "nan" if k != k
+                else float(k)
+            )
+            assert key not in out, (key, out)
+            out[key] = (int(s), int(c))
+        return out
+
+    outs = {}
+    prior = os.environ.get("BLAZE_GROUP_CORE")
+    for core in ("scatter", "sort"):
+        os.environ["BLAZE_GROUP_CORE"] = core
+        try:
+            outs[core] = as_dict(agg())
+        finally:
+            # RESTORE (not pop): an externally pinned core must stay
+            # pinned for the rest of the process
+            if prior is None:
+                os.environ.pop("BLAZE_GROUP_CORE", None)
+            else:
+                os.environ["BLAZE_GROUP_CORE"] = prior
+    assert outs["scatter"] == outs["sort"]
+    # -0.0 and 0.0 must be ONE group
+    assert sum(1 for k in outs["scatter"] if k == 0.0) == 1
