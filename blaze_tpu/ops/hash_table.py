@@ -443,7 +443,12 @@ def group_slots(
     single_int = (
         len(key_cols) == 1
         and key_cols[0][0].ndim == 1
-        and jnp.issubdtype(key_cols[0][0].dtype, jnp.integer)
+        and (
+            jnp.issubdtype(key_cols[0][0].dtype, jnp.integer)
+            # bool keys (2-3 groups incl. NULL) are the direct path's
+            # best case; they cast to int32 below
+            or key_cols[0][0].dtype == jnp.bool_
+        )
     )
 
     def hash_insert():
@@ -458,11 +463,16 @@ def group_slots(
 
     v, m = key_cols[0]
     valid = live if m is None else (live & m)
-    vv = v.astype(jnp.int64)
-    big = jnp.int64(1) << jnp.int64(62)
-    kmin = jnp.min(jnp.where(valid, vv, big))
-    kmax = jnp.max(jnp.where(valid, vv, -big))
-    diff = kmax - kmin
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.int32)
+    info = jnp.iinfo(v.dtype)
+    # scalar min/max reductions stay in the ORIGINAL dtype; only the
+    # two scalars widen - converting 8M rows to int64 for arithmetic
+    # that (inside the taken branch) provably fits 2^23 costs ~0.1s/8M
+    # on one core
+    kmin = jnp.min(jnp.where(valid, v, info.max))
+    kmax = jnp.max(jnp.where(valid, v, info.min))
+    diff = kmax.astype(jnp.int64) - kmin.astype(jnp.int64)
     # reserve one slot for the NULL group when the key is nullable.
     # int64 wrap on an astronomically wide range makes diff negative,
     # which the >= 0 guard rejects (a true range >= 2^63 can never wrap
@@ -471,9 +481,20 @@ def group_slots(
     in_range = (diff >= 0) & (need <= table_size) & jnp.any(valid)
 
     def direct(_):
-        raw = jnp.clip(vv - kmin, 0, table_size - 1)
-        null_slot = jnp.clip(diff + 1, 0, table_size - 1)
-        slot = jnp.where(valid, raw, null_slot).astype(jnp.int32)
+        # per-row subtraction: int32/int64 keys subtract in their own
+        # dtype (VALID rows cannot wrap: range < table_size <= 2^23 in
+        # the taken branch; invalid rows may wrap but are overridden by
+        # null_slot/clip). int8/int16 widen to int32 first - their own
+        # range CAN overflow the narrow dtype (e.g. int8 span 254).
+        vw = v if v.dtype.itemsize >= 4 else v.astype(jnp.int32)
+        raw = jnp.clip(
+            (vw - kmin.astype(vw.dtype)).astype(jnp.int32),
+            0, table_size - 1,
+        )
+        null_slot = jnp.clip(diff + 1, 0, table_size - 1).astype(
+            jnp.int32
+        )
+        slot = jnp.where(valid, raw, null_slot)
         cand = jnp.where(
             live, jnp.arange(cap, dtype=jnp.int32), jnp.int32(cap)
         )
