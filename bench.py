@@ -210,7 +210,8 @@ def run_child(platform=None, timeout=None):
             except json.JSONDecodeError:
                 continue
     res = _salvage_partials("\n".join(out_lines))
-    stderr_tail = err_lines[-1][:200] if err_lines else "no stderr"
+    nonblank = [ln for ln in err_lines if ln.strip()]
+    stderr_tail = nonblank[-1][:200] if nonblank else "no stderr"
     cause = (
         f"child timed out after {timeout:.0f}s" if timed_out
         else f"child died rc={proc.returncode} ({stderr_tail})"

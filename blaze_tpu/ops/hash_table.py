@@ -12,11 +12,12 @@ built entirely from scatter/gather primitives that XLA executes in O(n):
            rounds of `table.at[slot].min(row_index)` claim empty slots
            (ties resolved by the min), a gather-back + exact key
            comparison resolves rows whose key already owns the slot, and
-           unresolved rows advance to the next slot (linear probing)
-           inside one `lax.while_loop`. Occupied slots are never
-           overwritten, so the linear-probe invariant (no empty slot
-           between a key's home and its resting slot) holds and lookups
-           may stop at the first empty slot.
+           unresolved rows advance to the next slot of their triangular
+           (quadratic) probe sequence inside one `lax.while_loop`.
+           Occupied slots are never overwritten, so the probe-sequence
+           invariant (no empty slot EARLIER in a key's triangular
+           sequence than its resting slot) holds and lookups may stop
+           at the first empty slot they encounter on that sequence.
   lookup:  probe rows walk the same chain, comparing true key values at
            each step - hash collisions cost extra steps, never wrong
            answers.
@@ -198,8 +199,8 @@ def insert(
     self_keys = [(v, m) for v, m in key_cols]
 
     # lean carry: the probing slot is DERIVED from the round counter
-    # (linear probing: slot_r = home + r); only the resolved slot,
-    # activity and the table ride the carry
+    # (triangular probing: slot_r = home + r(r+1)/2); only the resolved
+    # slot, activity and the table ride the carry
     u0 = slot0.astype(jnp.uint32)
 
     def cond(state):
@@ -214,7 +215,7 @@ def insert(
         slot = _tri_slot(u0, rounds, mask)
         occupant = jnp.take(tab, slot)
         # claim only EMPTY slots: occupied slots are immutable, which
-        # preserves the linear-probe invariant lookups depend on
+        # preserves the probe-sequence invariant lookups depend on
         cand = jnp.where(
             active & (occupant == empty), rowidx, empty
         )
@@ -539,9 +540,9 @@ def lookup(
         return ok
 
     # lean carry: the probe slot is DERIVED from the round counter
-    # (linear probing: slot_r = home + r), and the matched flag lives in
-    # the match sentinel (-1 = no match) - every array dropped from the
-    # carry saves a full-probe-array rewrite per round
+    # (triangular probing: slot_r = home + r(r+1)/2), and the matched
+    # flag lives in the match sentinel (-1 = no match) - every array
+    # dropped from the carry saves a full-probe-array rewrite per round
     u0 = slot0.astype(jnp.uint32)
 
     def round_(r, active, match):

@@ -9,20 +9,24 @@ breakers (shuffle writers, external spill, host hand-off) must
 physically drop dead rows.
 
 A naive gather-by-sorted-indices serializes on TPU. This kernel keeps
-everything matrix-shaped:
+the index computation matrix-shaped:
 
   per row-block (1024 rows):
     pos[i]  = cumsum(keep)[i] - 1          (block-local target slot)
-    out[j]  = sum_i v[i] * (pos[i] == j & keep[i])   - an MXU
-              contraction against the block-local permutation one-hot
+    out[j]  = sum_i idx[i] * (pos[i] == j & keep[i])   - an MXU
+              contraction of block-LOCAL ROW INDICES against the
+              permutation one-hot
   per block it also emits the block's keep-count.
 
-Cross-block stitching happens in jnp glue (`compact_column`): block
-outputs are dense prefixes, so one gather with indices derived from the
-per-block count prefix sum concatenates them - the gather touches only
-surviving rows. Ints ride the same f32 contraction exactly up to 2^24;
-wider ints split into two 16-bit planes contracted separately and
-recombined (exact for the full int32 range).
+The kernel compacts INDICES, not data: local indices are in [0, 1024),
+always exact in f32, so the IEEE 0*NaN hazard of contracting raw data
+(one non-finite row anywhere in a block would poison every surviving
+row of that block) cannot arise. Cross-block stitching happens in jnp
+glue (`_compact_perm`): block outputs are dense prefixes, so indices
+derived from the per-block count prefix sum compose into one global
+source-row permutation. Data columns of ANY dtype then move by a
+single bit-exact gather - one kernel launch serves every column
+compacted by the same mask.
 
 Tested with interpret=True on CPU (tests/test_pallas_kernels.py);
 hardware enablement follows the same bench-gated path as the
@@ -108,24 +112,30 @@ def supports(capacity: int) -> bool:
 
 
 @jax.jit
-def compact_column_f32(v: jax.Array, keep: jax.Array):
-    """Compact one f32 column by a boolean mask.
+def _compact_perm(keep: jax.Array):
+    """Compute the compaction PERMUTATION: for every global output slot,
+    the global source row, plus the live count.
 
-    Returns (compacted, n_live): `compacted` has the input's length,
-    live rows packed at the front, zeros after. Exact for f32 (the
-    one-hot contraction moves each value once, no arithmetic)."""
-    cap = v.shape[0]
+    The kernel contracts block-LOCAL row indices (values in [0, 1024),
+    always exactly representable in f32 - so the IEEE 0*NaN hazard of
+    contracting raw data can never arise) against the one-hot; data
+    columns then move by a plain gather. One kernel launch serves every
+    column and dtype compacted by the same mask."""
+    cap = keep.shape[0]
     n_blocks = cap // _ROWS_BLK
     shape3 = (n_blocks, _ROWS_BLK // _LANES, _LANES)
+    local_idx = jnp.broadcast_to(
+        jnp.arange(_ROWS_BLK, dtype=jnp.float32), (n_blocks, _ROWS_BLK)
+    )
     blocks, cnts = _call_compact(
-        v.astype(jnp.float32).reshape(shape3),
+        local_idx.reshape(shape3),
         keep.astype(jnp.int32).reshape(shape3),
         n_blocks,
     )
     flat = blocks.reshape(n_blocks, _ROWS_BLK)
     cnts = cnts.reshape(n_blocks)
     # stitch: global position of block b's local slot j is
-    # offset[b] + j; invert to a single gather of surviving rows
+    # offset[b] + j; invert so each output slot knows its source
     offsets = jnp.cumsum(cnts) - cnts
     n_live = jnp.sum(cnts)
     out_pos = jnp.arange(cap, dtype=jnp.int32)
@@ -135,8 +145,24 @@ def compact_column_f32(v: jax.Array, keep: jax.Array):
     ).astype(jnp.int32)
     blk_of = jnp.clip(blk_of, 0, n_blocks - 1)
     local = out_pos - jnp.take(offsets, blk_of)
-    src = blk_of * _ROWS_BLK + jnp.clip(local, 0, _ROWS_BLK - 1)
-    gathered = jnp.take(flat.reshape(cap), src)
+    slot = blk_of * _ROWS_BLK + jnp.clip(local, 0, _ROWS_BLK - 1)
+    src = blk_of * _ROWS_BLK + jnp.take(
+        flat.reshape(cap), slot
+    ).astype(jnp.int32)
+    return src, n_live
+
+
+@jax.jit
+def compact_column_f32(v: jax.Array, keep: jax.Array):
+    """Compact one f32 column by a boolean mask.
+
+    Returns (compacted, n_live): `compacted` has the input's length,
+    live rows packed at the front, zeros after. Exact for EVERY f32
+    bit pattern including NaN/inf - values move by gather through the
+    index permutation, never through arithmetic."""
+    src, n_live = _compact_perm(keep)
+    out_pos = jnp.arange(v.shape[0], dtype=jnp.int32)
+    gathered = jnp.take(v.astype(jnp.float32), src)
     return (
         jnp.where(out_pos < n_live, gathered, jnp.float32(0.0)),
         n_live,
@@ -145,19 +171,8 @@ def compact_column_f32(v: jax.Array, keep: jax.Array):
 
 @jax.jit
 def compact_column_i32(v: jax.Array, keep: jax.Array):
-    """Exact int32 compaction: two 16-bit planes ride the f32
-    contraction (each plane < 2^16 is exactly representable) and
-    recombine."""
-    cap = v.shape[0]
-    vi = v.astype(jnp.int32)
-    lo = (vi & jnp.int32(0xFFFF)).astype(jnp.float32)
-    hi = jax.lax.shift_right_logical(
-        vi, jnp.int32(16)
-    ).astype(jnp.float32)
-    clo, n_live = compact_column_f32(lo, keep)
-    chi, _ = compact_column_f32(hi, keep)
-    out = (
-        chi.astype(jnp.int32) << jnp.int32(16)
-    ) | clo.astype(jnp.int32)
-    out_pos = jnp.arange(cap, dtype=jnp.int32)
-    return jnp.where(out_pos < n_live, out, jnp.int32(0)), n_live
+    """Exact int32 compaction via the same index permutation."""
+    src, n_live = _compact_perm(keep)
+    out_pos = jnp.arange(v.shape[0], dtype=jnp.int32)
+    gathered = jnp.take(v.astype(jnp.int32), src)
+    return jnp.where(out_pos < n_live, gathered, jnp.int32(0)), n_live

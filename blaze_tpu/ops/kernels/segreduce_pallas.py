@@ -54,9 +54,16 @@ def _sum_kernel(gid_ref, v_ref, out_ref):
     cols = jax.lax.broadcasted_iota(
         jnp.int32, (_ROWS_BLK, _K_BLK), 1
     ) + k0
-    oh = (gid[:, None] == cols).astype(jnp.float32)
+    hit = gid[:, None] == cols
+    # IEEE hazard: 0 * NaN/inf = NaN, so one non-finite row anywhere in
+    # the block would poison EVERY segment the contraction touches. The
+    # MXU dot runs over sanitized values only; non-finite rows re-enter
+    # through a where-masked VPU reduction (a select, not a multiply,
+    # so unselected NaN/inf rows truly contribute nowhere).
+    finite = jnp.isfinite(v)
     part = jax.lax.dot_general(
-        v[None, :], oh,
+        jnp.where(finite, v, jnp.float32(0.0))[None, :],
+        hit.astype(jnp.float32),
         (((1,), (0,)), ((), ())),
         # HIGHEST: default precision truncates f32 operands to bf16 on
         # the MXU, which would silently diverge from the XLA scatter
@@ -64,6 +71,12 @@ def _sum_kernel(gid_ref, v_ref, out_ref):
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     ).reshape(_K_BLK)
+    part = part + jnp.sum(
+        jnp.where(
+            hit & ~finite[:, None], v[:, None], jnp.float32(0.0)
+        ),
+        axis=0,
+    )
 
     @pl.when(rb == 0)
     def _init():

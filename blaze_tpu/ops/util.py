@@ -253,6 +253,13 @@ def _order_key_u32(v: jax.Array, asc: bool) -> jax.Array:
         )
     elif v.dtype == jnp.bool_:
         u = v.astype(jnp.uint32)
+    elif jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+        # already in unsigned order: no sign flip. The signed path's
+        # astype(int32) would wrap values >= 2^31 and the flip would
+        # then order them BELOW small values. (types.py defines no
+        # unsigned TypeId today, so this is future-proofing, but the
+        # packed-sort eligibility gate admits any <=4-byte integer.)
+        u = v.astype(jnp.uint32)
     else:
         u = v.astype(jnp.int32).astype(jnp.uint32) ^ jnp.uint32(
             0x80000000

@@ -174,3 +174,54 @@ def test_pallas_segment_sum_matches_engine_segops():
     np.testing.assert_allclose(
         np.asarray(pls), np.asarray(xla), rtol=1e-4, atol=1e-3
     )
+
+
+def test_pallas_segment_sum_nonfinite_isolated():
+    """ADVICE r4: a NaN/inf value anywhere in a 1024-row block must
+    poison ONLY its own segment, never the whole block's segments
+    (IEEE 0*NaN=NaN would leak through a raw one-hot contraction)."""
+    from blaze_tpu.ops.kernels import segreduce_pallas as sr
+
+    rng = np.random.default_rng(10)
+    cap, k = 2048, 512
+    gid = rng.integers(0, k, cap).astype(np.int32)
+    v = (rng.random(cap) * 10).astype(np.float32)
+    gid[7], v[7] = 3, np.nan           # NaN lands in segment 3
+    gid[1500], v[1500] = 5, np.inf     # +inf lands in segment 5
+    gid[11], v[11] = k + 2, np.nan     # dead NaN row: contributes nowhere
+    got = np.asarray(sr.segment_sum(jnp.asarray(gid), jnp.asarray(v), k))
+    exp = np.zeros(k, np.float64)
+    for g, x in zip(gid, v):
+        if g < k:
+            exp[g] += np.float64(x)
+    assert np.isnan(got[3]) and np.isnan(exp[3])
+    assert got[5] == np.inf
+    fin = np.isfinite(exp)
+    assert fin.sum() == k - 2
+    np.testing.assert_allclose(got[fin], exp[fin], rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_compact_preserves_nonfinite():
+    """ADVICE r4: compacting a float column containing NaN/inf (kept or
+    dropped) must move every surviving value bit-exactly."""
+    from blaze_tpu.ops.kernels import compact_pallas as cp
+
+    rng = np.random.default_rng(11)
+    cap = 2048
+    v = (rng.random(cap) * 100 - 50).astype(np.float32)
+    v[3] = np.nan
+    v[4] = np.inf
+    v[5] = -np.inf
+    v[1024] = np.nan          # dropped NaN in the second block
+    keep = rng.random(cap) < 0.5
+    keep[3] = keep[4] = keep[5] = True
+    keep[1024] = False
+    out, n = cp.compact_column_f32(jnp.asarray(v), jnp.asarray(keep))
+    out = np.asarray(out)
+    n = int(n)
+    exp = v[keep]
+    assert n == len(exp)
+    np.testing.assert_array_equal(
+        out[:n].view(np.uint32), exp.view(np.uint32)
+    )
+    assert (out[n:] == 0).all()
