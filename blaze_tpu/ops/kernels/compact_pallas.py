@@ -22,7 +22,7 @@ The kernel compacts INDICES, not data: local indices are in [0, 1024),
 always exact in f32, so the IEEE 0*NaN hazard of contracting raw data
 (one non-finite row anywhere in a block would poison every surviving
 row of that block) cannot arise. Cross-block stitching happens in jnp
-glue (`_compact_perm`): block outputs are dense prefixes, so indices
+glue (`compact_perm`): block outputs are dense prefixes, so indices
 derived from the per-block count prefix sum compose into one global
 source-row permutation. Data columns of ANY dtype then move by a
 single bit-exact gather - one kernel launch serves every column
@@ -112,7 +112,7 @@ def supports(capacity: int) -> bool:
 
 
 @jax.jit
-def _compact_perm(keep: jax.Array):
+def compact_perm(keep: jax.Array):
     """Compute the compaction PERMUTATION: for every global output slot,
     the global source row, plus the live count.
 
@@ -160,7 +160,7 @@ def compact_column_f32(v: jax.Array, keep: jax.Array):
     live rows packed at the front, zeros after. Exact for EVERY f32
     bit pattern including NaN/inf - values move by gather through the
     index permutation, never through arithmetic."""
-    src, n_live = _compact_perm(keep)
+    src, n_live = compact_perm(keep)
     out_pos = jnp.arange(v.shape[0], dtype=jnp.int32)
     gathered = jnp.take(v.astype(jnp.float32), src)
     return (
@@ -172,7 +172,24 @@ def compact_column_f32(v: jax.Array, keep: jax.Array):
 @jax.jit
 def compact_column_i32(v: jax.Array, keep: jax.Array):
     """Exact int32 compaction via the same index permutation."""
-    src, n_live = _compact_perm(keep)
+    src, n_live = compact_perm(keep)
     out_pos = jnp.arange(v.shape[0], dtype=jnp.int32)
     gathered = jnp.take(v.astype(jnp.int32), src)
     return jnp.where(out_pos < n_live, gathered, jnp.int32(0)), n_live
+
+
+def compact_columns(cols, keep):
+    """Compact many columns by ONE mask: the permutation kernel runs
+    once, each column moves by a single gather. `cols` is a sequence of
+    1-D arrays (any dtype, same capacity as `keep`); returns
+    ([compacted...], n_live) with dead tail slots zeroed."""
+    src, n_live = compact_perm(keep)
+    cap = keep.shape[0]
+    out_pos = jnp.arange(cap, dtype=jnp.int32)
+    outs = []
+    for v in cols:
+        g = jnp.take(v, src)
+        outs.append(
+            jnp.where(out_pos < n_live, g, jnp.zeros((), g.dtype))
+        )
+    return outs, n_live

@@ -59,7 +59,8 @@ def _sum_kernel(gid_ref, v_ref, out_ref):
     # the block would poison EVERY segment the contraction touches. The
     # MXU dot runs over sanitized values only; non-finite rows re-enter
     # through a where-masked VPU reduction (a select, not a multiply,
-    # so unselected NaN/inf rows truly contribute nowhere).
+    # so unselected NaN/inf rows truly contribute nowhere) - gated by
+    # pl.when so the all-finite common case pays nothing extra.
     finite = jnp.isfinite(v)
     part = jax.lax.dot_general(
         jnp.where(finite, v, jnp.float32(0.0))[None, :],
@@ -71,18 +72,22 @@ def _sum_kernel(gid_ref, v_ref, out_ref):
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     ).reshape(_K_BLK)
-    part = part + jnp.sum(
-        jnp.where(
-            hit & ~finite[:, None], v[:, None], jnp.float32(0.0)
-        ),
-        axis=0,
-    )
 
     @pl.when(rb == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
     out_ref[:] = out_ref[:] + part.reshape(out_ref.shape)
+
+    @pl.when(jnp.any(~finite))
+    def _nonfinite_fixup():
+        corr = jnp.sum(
+            jnp.where(
+                hit & ~finite[:, None], v[:, None], jnp.float32(0.0)
+            ),
+            axis=0,
+        )
+        out_ref[:] = out_ref[:] + corr.reshape(out_ref.shape)
 
 
 def _minmax_kernel(gid_ref, v_ref, out_ref, *, is_min: bool):

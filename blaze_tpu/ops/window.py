@@ -13,9 +13,13 @@ Supported: row_number, rank, dense_rank, ntile(n), percent_rank,
 cume_dist, lag/lead(offset k), and sum/min/max/count/avg over
 - the whole partition (frame=None),
 - ROWS BETWEEN a PRECEDING AND b FOLLOWING (("rows", lo, hi); None =
-  UNBOUNDED; min/max need lo=None i.e. a running frame),
+  UNBOUNDED; bounded min/max ride a sparse-table RMQ over the sorted
+  runs, so any lo/hi combination is supported),
 - RANGE UNBOUNDED PRECEDING .. CURRENT ROW (("range", None, 0) - the
-  SQL default frame with ORDER BY; ties share the frame result).
+  SQL default frame with ORDER BY; ties share the frame result),
+- RANGE BETWEEN x PRECEDING AND y FOLLOWING value offsets over a
+  single numeric order key (("range", lo, hi): frame bounds located
+  by searchsorted over the packed order keys).
 Rows are emitted in (partition, order) sorted order - the order Spark's
 WindowExec produces.
 """

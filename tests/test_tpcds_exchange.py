@@ -44,6 +44,7 @@ EXCHANGE_QUERIES = [
     "q50", "q52", "q55", "q58", "q61", "q62", "q65", "q66", "q68",
     "q69", "q71", "q72", "q73", "q76", "q77", "q79", "q82", "q87",
     "q88", "q90", "q92", "q93", "q96", "q97", "q99",
+    "q42", "q56", "q59", "q60", "q74", "q75", "q78",
     # window / global-sort shapes. q67/q86 RANK over float SUMs whose
     # value depends on summation order; exchange partitioning changes
     # that order, so near-equal sums may legitimately flip ranks. They
