@@ -418,6 +418,76 @@ def lookup_kr(
     return match, match >= 0
 
 
+# int32 row indices are < 2^31, so INT32_MAX can never be a live row
+_DIRECT_EMPTY = _np.int32(0x7FFFFFFF)
+
+
+def insert_direct(
+    keys: jax.Array,
+    live: jax.Array,
+    capacity: int,
+    base: jax.Array,
+    table_size: int,
+):
+    """Dense-domain dimension table: tab[key - base] = row index.
+
+    The TPC-DS dimension pattern (Spark's LongHashedRelation takes the
+    same dense-array fast path): surrogate keys are near-contiguous
+    ints, so the "hash table" degenerates to ONE 4-byte-per-slot array
+    that fits in L2 for typical dims (131k keys = 512KB vs the 8MB
+    key|row u64 table), and probing is a single gather with no hash,
+    no probe rounds, no key comparison - slot identity IS key equality.
+
+    `base`/`table_size`: base is the (dynamic, device-scalar) minimum
+    live key; table_size the static power-of-two >= key span, so one
+    compiled kernel serves every relation with the same span bucket.
+    Returns (tab i32[table_size], dup): dup=True means two live rows
+    share a key (the caller demotes to the sorted core, exactly like
+    the hash insert's duplicate detection)."""
+    cap = capacity
+    idx = jnp.clip(
+        keys.astype(jnp.int64) - base.astype(jnp.int64),
+        0, table_size - 1,
+    ).astype(jnp.int32)
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    tab = jnp.full(table_size, _DIRECT_EMPTY, dtype=jnp.int32)
+    tab = tab.at[idx].min(
+        jnp.where(live, rows, _DIRECT_EMPTY), mode="drop"
+    )
+    rep = jnp.take(tab, idx)
+    dup = jnp.any(live & (rep != rows))
+    return tab, dup
+
+
+def lookup_direct(
+    tab: jax.Array,
+    base: jax.Array,
+    span: jax.Array,
+    keys: jax.Array,
+    probe_live: jax.Array,
+):
+    """Probe a dense-domain table: one subtract + range check + gather.
+    Returns (match_idx i32, matched bool) - the lookup_kr contract."""
+    table_size = tab.shape[0]
+    idx = keys.astype(jnp.int64) - base.astype(jnp.int64)
+    in_range = (idx >= 0) & (idx < span.astype(jnp.int64))
+    rep = jnp.take(
+        tab,
+        jnp.clip(idx, 0, table_size - 1).astype(jnp.int32),
+    )
+    matched = probe_live & in_range & (rep != _DIRECT_EMPTY)
+    return jnp.where(matched, rep, jnp.int32(-1)), matched
+
+
+def direct_table_size(span: int) -> int:
+    """Static power-of-two table size for a key span (>= 1024 so span
+    jitter across relations reuses one compiled kernel)."""
+    t = 1024
+    while t < span:
+        t <<= 1
+    return t
+
+
 def group_slots(
     key_cols: Sequence[Tuple[jax.Array, Optional[jax.Array]]],
     live: jax.Array,
@@ -586,7 +656,13 @@ def dense_group_ids(
 
     Returns (row_gid i32[capacity] - dead rows park in out_cap-1,
     n_groups i32 scalar, bpos i32[out_cap] - representative row index
-    per group, zero-padded)."""
+    per group, zero-padded).
+
+    The production scatter core no longer calls this: hash_aggregate
+    reduces on RAW slots and compacts only the (out_cap,)-sized states
+    (inlining the occupied/nonzero/bpos math here, minus the full-row
+    gid gather). This remains the reference formulation and the
+    bench's tpu_core_probe measurement target."""
     occupied = rep_tab != jnp.int32(capacity)
     gid_of_slot = jnp.cumsum(occupied.astype(jnp.int32)) - 1
     row_gid = jnp.where(

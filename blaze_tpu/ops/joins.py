@@ -169,10 +169,17 @@ class _JoinCore:
     would dispatch."""
 
     def __init__(self, build: ColumnBatch, build_keys: List[int]):
+        import threading
+
         self.build = build
         self.build_keys = build_keys
         self.matched_build = jnp.zeros(build.capacity, dtype=jnp.bool_)
         self._index = None
+        # a core may be shared across concurrently executing probe
+        # partitions (fused.py caches it on the join op); index
+        # (re)builds and downgrades mutate self._index, so they run
+        # under this lock and readers capture a local snapshot
+        self._index_lock = threading.Lock()
         # remembered demotion: duplicate build keys mean the table core
         # can never apply to this build relation - don't re-attempt (and
         # re-pay the insert pass + blocking dup sync) per probe batch
@@ -193,13 +200,17 @@ class _JoinCore:
             return
         cap = self.build.capacity
 
-        if (
+        # one eligibility decision for both table attempts below: when
+        # True, the first block always runs and defines eq_layout /
+        # tsize / kr / ht for the second
+        scatter_ok = (
             not self._table_demoted
             and _join_core_choice() == "scatter"
             # wide-decimal keys are host-tier work either way; the
             # sorted path below carries the NotImplementedError guard
             and not any(c.dtype.is_wide_decimal for c in build_cols)
-        ):
+        )
+        if scatter_ok:
             from blaze_tpu.ops import hash_table as ht
 
             eq_layout = _eq_layout(build_cols)
@@ -213,6 +224,100 @@ class _JoinCore:
 
             kr = _kr_eligible(build_cols) and not self._force_generic
 
+            # dense-domain dimension keys (TPC-DS surrogate keys are
+            # near-contiguous ints; Spark's LongHashedRelation has the
+            # same dense-array fast path): replace the hash table with
+            # a direct key->row array. Probing drops from hash + probe
+            # rounds over an 8x-oversized u64 table to ONE gather into
+            # a 4-byte-per-slot array that fits in L2 (measured at
+            # 131k keys / 8M probes on XLA:CPU: 398ms -> 47ms).
+            if (
+                kr
+                and len(build_cols) == 1
+                and jnp.issubdtype(
+                    build_cols[0].values.dtype, jnp.integer
+                )
+                # dictionary-encoded keys rebuild the index per probe
+                # batch (per-batch code unification): the extra kmin/
+                # kmax host sync per batch would outweigh the direct
+                # table's probe win on a tunnel-RTT dispatch model
+                and not build_cols[0].dtype.is_dictionary_encoded
+                and int(self.build.num_rows) > 0
+            ):
+                def build_span():
+                    def kernel(eq_bufs, num_rows):
+                        live = (
+                            jnp.arange(cap, dtype=jnp.int32) < num_rows
+                        )
+                        ((v, m),) = _unflatten_eq(eq_layout, eq_bufs)
+                        if m is not None:
+                            live = live & m
+                        info = jnp.iinfo(v.dtype)
+                        kmin = jnp.min(jnp.where(live, v, info.max))
+                        kmax = jnp.max(jnp.where(live, v, info.min))
+                        return jnp.stack(
+                            [kmin.astype(jnp.int64),
+                             kmax.astype(jnp.int64)]
+                        )
+
+                    return kernel
+
+                span_fn = cached_kernel(
+                    ("join_keyspan", eq_layout, cap), build_span
+                )
+                kmin, kmax = (
+                    int(x) for x in np.asarray(
+                        span_fn(
+                            _flatten_cols(build_cols),
+                            self.build.num_rows,
+                        )
+                    )
+                )
+                span = kmax - kmin + 1
+                nrows = int(self.build.num_rows)
+                # sparse domains would waste memory and cache; beyond
+                # 8x the row count (or 16M slots) the u64 table wins
+                if 0 < span <= min(1 << 24, max(4096, 8 * nrows)):
+                    tsize_d = ht.direct_table_size(span)
+
+                    def build_direct():
+                        def kernel(eq_bufs, base, num_rows):
+                            live = (
+                                jnp.arange(cap, dtype=jnp.int32)
+                                < num_rows
+                            )
+                            ((v, m),) = _unflatten_eq(
+                                eq_layout, eq_bufs
+                            )
+                            if m is not None:
+                                live = live & m
+                            return ht.insert_direct(
+                                v, live, cap, base, tsize_d
+                            )
+
+                        return kernel
+
+                    dfn = cached_kernel(
+                        ("join_table_direct", eq_layout, cap, tsize_d),
+                        build_direct,
+                    )
+                    base = jnp.asarray(kmin, jnp.int64)
+                    tab, dup = dfn(
+                        _flatten_cols(build_cols), base,
+                        self.build.num_rows,
+                    )
+                    if not host_int(dup):
+                        self._index = (
+                            "table_direct",
+                            (tab, base, jnp.asarray(span, jnp.int64)),
+                        )
+                        return
+                    # duplicate build keys: no single-row table core
+                    # applies - demote straight to the sorted core
+                    # (don't re-pay an insert + sync on the kr table)
+                    self._table_demoted = True
+
+        if scatter_ok and not self._table_demoted:
             def build_table():
                 def kernel(eq_bufs, num_rows):
                     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
@@ -291,6 +396,19 @@ class _JoinCore:
         whose equality check promotes - mixed-width keys then join
         correctly (the sorted core's murmur3 is dtype-semantic, Spark
         hashInt vs hashLong, and would silently miss them)."""
+        if self._index[0] == "table_direct":
+            # the direct lookup subtracts in int64, so ANY integer
+            # probe width is exact; a non-integer probe (float-unified
+            # keys) would truncate and must rebuild generic
+            if all(
+                jnp.issubdtype(p.values.dtype, jnp.integer)
+                for p in unified_p
+            ):
+                return
+            self._force_generic = True
+            self._index = None
+            self._ensure_index(unified_b)
+            return
         if self._index[0] != "table_kr":
             return
         if all(
@@ -308,8 +426,10 @@ class _JoinCore:
         callers that fuse the lookup into their own program (the fused
         join+aggregate path). Returns ((probe_cb, unified_b, unified_p,
         tab, mode) | None, probe_cb): `mode` is "table" (row-index
-        table, ht.lookup) or "table_kr" (fused key|row u64 entries,
-        ht.lookup_kr); None means the core resolved to sorted
+        table, ht.lookup), "table_kr" (fused key|row u64 entries,
+        ht.lookup_kr), or "table_direct" (dense-domain key->row array,
+        ht.lookup_direct, tab = (array, base, span));
+        None means the core resolved to sorted
         (duplicate keys or the sort knob) and the caller should use
         probe()/emit_pairs()."""
         probe_cb = ensure_compacted(probe_cb)
@@ -320,13 +440,14 @@ class _JoinCore:
             b2, p2 = _unify_key_pair(bc, pc_)
             unified_b.append(b2)
             unified_p.append(p2)
-        self._ensure_index(unified_b)
-        self._check_probe_dtypes(unified_b, unified_p)
-        if self._index[0] not in ("table", "table_kr"):
+        with self._index_lock:
+            self._ensure_index(unified_b)
+            self._check_probe_dtypes(unified_b, unified_p)
+            index = self._index
+        if index[0] not in ("table", "table_kr", "table_direct"):
             return None, probe_cb
         return (
-            (probe_cb, unified_b, unified_p, self._index[1],
-             self._index[0]),
+            (probe_cb, unified_b, unified_p, index[1], index[0]),
             probe_cb,
         )
 
@@ -343,21 +464,28 @@ class _JoinCore:
             b2, p2 = _unify_key_pair(bc, pc_)
             unified_b.append(b2)
             unified_p.append(p2)
-        self._ensure_index(unified_b)
-        self._check_probe_dtypes(unified_b, unified_p)
+        with self._index_lock:
+            self._ensure_index(unified_b)
+            self._check_probe_dtypes(unified_b, unified_p)
+            index = self._index
         pcap = probe_cb.capacity
 
-        if self._index[0] in ("table", "table_kr"):
-            mode = self._index[0]
-            tab = self._index[1]
+        if index[0] in ("table", "table_kr", "table_direct"):
+            mode = index[0]
+            tab = index[1]
             bcap = self.build.capacity
             b_eq_layout = _eq_layout(unified_b)
             p_eq_layout = _eq_layout(unified_p)
 
             def build_lookup():
                 def kernel(b_eq, p_eq, tab, num_rows):
+                    # num_rows=None: full probe batch (constant mask
+                    # folds into the downstream selects)
                     live = (
-                        jnp.arange(pcap, dtype=jnp.int32) < num_rows
+                        jnp.ones(pcap, dtype=jnp.bool_)
+                        if num_rows is None
+                        else jnp.arange(pcap, dtype=jnp.int32)
+                        < num_rows
                     )
                     pkeys = _unflatten_eq(p_eq_layout, p_eq)
                     for _, m in pkeys:
@@ -380,7 +508,8 @@ class _JoinCore:
                 _flatten_cols(unified_b),
                 _flatten_cols(unified_p),
                 tab,
-                probe_cb.num_rows,
+                None if probe_cb.num_rows == pcap
+                else probe_cb.num_rows,
             )
             # NO host sync: output capacity is statically the probe
             # capacity (each probe row matches at most one build row)
@@ -388,7 +517,7 @@ class _JoinCore:
                 "table", probe_cb, match_idx, matched, pcap
             )
 
-        _tag, h_sorted, order = self._index
+        _tag, h_sorted, order = index
         # hash-time cast for mixed-width keys: murmur3 is dtype-semantic
         # (Spark hashInt != hashLong for equal values), so a wider probe
         # key hashes into the wrong run and silently misses. Casting the
@@ -641,6 +770,11 @@ def _table_lookup(mode, tab, pkeys, bkeys, live, bcap):
     kernel and the fused join+aggregate kernel."""
     from blaze_tpu.ops import hash_table as ht
 
+    if mode == "table_direct":
+        # no hash, no probe rounds: callers already folded NULL masks
+        # into `live`
+        tab_arr, base, span = tab
+        return ht.lookup_direct(tab_arr, base, span, pkeys[0][0], live)
     h = ht.cheap_hash(pkeys, live.shape[0])
     if mode == "table_kr":
         k32 = ht.key_u32(*pkeys[0])
