@@ -11,7 +11,16 @@ read this). A C++ client (cpp/blaze_client.cpp) drives it in tests,
 proving the L4 gateway contract without Python on the embedder side.
 
 Framing:
-  request:  u64-LE blob_len | TaskDefinition protobuf bytes
+  request:  u64-LE header | [manifest] | TaskDefinition protobuf bytes
+            header low 62 bits = blob_len; bit 63 set = the blob is in
+            the REFERENCE wire format (plan/refcompat.py decodes it -
+            the reference's own plan.proto:508-513 TaskDefinition);
+            bit 62 set = a resource manifest precedes the blob:
+            u32-LE json_len | JSON {resource_id: [[source...] per
+            partition]}, source = {"file": p, "offset": o, "length": l}
+            (shuffle FileSegment) or {"b64": "..."} (raw IPC part
+            bytes) - the socket-tier analog of the reference's JVM
+            resource registry (JniBridge.java:31).
   response: per batch, one segmented-IPC part (u64-LE part_len | zstd
             Arrow IPC stream)
             then u64-LE 0 (end of stream)
@@ -20,6 +29,8 @@ Framing:
 
 from __future__ import annotations
 
+import base64
+import json
 import socketserver
 import struct
 import threading
@@ -29,24 +40,72 @@ from blaze_tpu.runtime.transport import _recv_exact
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
 _ERR = 0xFFFFFFFFFFFFFFFF
+_FLAG_REF = 1 << 63
+_FLAG_MANIFEST = 1 << 62
 MAX_TASK_BYTES = 64 << 20
+
+
+def _manifest_resources(manifest: dict):
+    """Decode a JSON resource manifest into ExecContext providers."""
+    from blaze_tpu.ops.ipc_reader import FileSegment
+
+    def src(d):
+        if "file" in d:
+            return FileSegment(
+                d["file"], int(d.get("offset", 0)),
+                int(d["length"]),
+            )
+        if "b64" in d:
+            return base64.b64decode(d["b64"])
+        raise ValueError(f"unknown manifest source {sorted(d)}")
+
+    return {
+        rid: [[src(s) for s in part] for part in parts]
+        for rid, parts in manifest.items()
+    }
 
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         from blaze_tpu.io.ipc import encode_ipc_segment
-        from blaze_tpu.runtime.executor import execute_task
+        from blaze_tpu.runtime.executor import ExecContext, execute_task
 
         sock = self.request
         try:
-            (blob_len,) = _U64.unpack(_recv_exact(sock, _U64.size))
+            (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
+            is_ref = bool(header & _FLAG_REF)
+            has_manifest = bool(header & _FLAG_MANIFEST)
+            blob_len = header & ~(_FLAG_REF | _FLAG_MANIFEST)
             if blob_len > MAX_TASK_BYTES:
                 raise ValueError("task too large")
+            manifest_raw = None
+            if has_manifest:
+                (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
+                if mlen > MAX_TASK_BYTES:
+                    raise ValueError("manifest too large")
+                manifest_raw = _recv_exact(sock, mlen)
             blob = _recv_exact(sock, blob_len)
         except Exception:
             return
         try:
-            for rb in execute_task(blob):
+            # manifest SEMANTIC failures (bad JSON, missing keys) get
+            # the documented error frame - only framing failures above
+            # drop the connection
+            resources = (
+                _manifest_resources(json.loads(manifest_raw))
+                if manifest_raw is not None else {}
+            )
+            ctx = ExecContext()
+            ctx.resources.update(resources)
+            if is_ref:
+                from blaze_tpu.plan.refcompat import (
+                    execute_reference_task,
+                )
+
+                batches = execute_reference_task(blob, ctx=ctx)
+            else:
+                batches = execute_task(blob, ctx=ctx)
+            for rb in batches:
                 part = encode_ipc_segment(rb)
                 sock.sendall(part)  # already u64-LE length-prefixed
             sock.sendall(_U64.pack(0))

@@ -8,7 +8,15 @@
 // decompression, and writes the raw part stream to a file for the
 // harness to decode and differential-check.
 //
-// Usage: blaze_client HOST PORT TASK_FILE OUT_FILE
+// Usage: blaze_client HOST PORT TASK_FILE OUT_FILE [--ref]
+//                     [--manifest FILE]
+//   --ref            TASK_FILE is in the REFERENCE wire format
+//                    (header bit 63; the engine decodes it through its
+//                    reference-compat tier)
+//   --manifest FILE  ship a JSON resource manifest (header bit 62;
+//                    u32-LE length + bytes before the task blob) -
+//                    registers ipc_reader sources, the socket analog
+//                    of the reference's JVM resource registry
 // Exit:  0 ok, 2 engine-reported error, 1 transport/usage error.
 //
 // Build: g++ -O2 -o blaze_client blaze_client.cpp -lzstd
@@ -49,13 +57,27 @@ static bool recv_all(int fd, void* buf, size_t n) {
 }
 
 int main(int argc, char** argv) {
-  if (argc != 5) {
+  if (argc < 5) {
     std::fprintf(stderr,
-                 "usage: blaze_client HOST PORT TASK_FILE OUT_FILE\n");
+                 "usage: blaze_client HOST PORT TASK_FILE OUT_FILE "
+                 "[--ref] [--manifest FILE]\n");
     return 1;
   }
   const char* host = argv[1];
   int port = std::atoi(argv[2]);
+  bool ref_format = false;
+  const char* manifest_path = nullptr;
+  for (int i = 5; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--ref") == 0) {
+      ref_format = true;
+    } else if (std::strcmp(argv[i], "--manifest") == 0 &&
+               i + 1 < argc) {
+      manifest_path = argv[++i];
+    } else {
+      std::fprintf(stderr, "unknown arg %s\n", argv[i]);
+      return 1;
+    }
+  }
 
   std::ifstream task(argv[3], std::ios::binary);
   if (!task) {
@@ -64,6 +86,22 @@ int main(int argc, char** argv) {
   }
   std::vector<char> blob((std::istreambuf_iterator<char>(task)),
                          std::istreambuf_iterator<char>());
+  std::vector<char> manifest;
+  if (manifest_path) {
+    std::ifstream mf(manifest_path, std::ios::binary);
+    if (!mf) {
+      std::fprintf(stderr, "cannot read %s\n", manifest_path);
+      return 1;
+    }
+    manifest.assign(std::istreambuf_iterator<char>(mf),
+                    std::istreambuf_iterator<char>());
+    // the u32 length prefix cannot represent more (and the server
+    // caps manifests at 64 MiB anyway)
+    if (manifest.size() > 0xFFFFFFFFull) {
+      std::fprintf(stderr, "manifest too large\n");
+      return 1;
+    }
+  }
 
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return 1;
@@ -79,9 +117,22 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  uint64_t blob_len = blob.size();  // u64-LE on every supported target
-  if (!send_all(fd, &blob_len, 8) ||
-      !send_all(fd, blob.data(), blob.size())) {
+  uint64_t header = blob.size();  // u64-LE on every supported target
+  if (ref_format) header |= (1ull << 63);
+  if (manifest_path) header |= (1ull << 62);
+  if (!send_all(fd, &header, 8)) {
+    std::fprintf(stderr, "send failed\n");
+    return 1;
+  }
+  if (manifest_path) {
+    uint32_t mlen = static_cast<uint32_t>(manifest.size());
+    if (!send_all(fd, &mlen, 4) ||
+        !send_all(fd, manifest.data(), manifest.size())) {
+      std::fprintf(stderr, "send failed\n");
+      return 1;
+    }
+  }
+  if (!send_all(fd, blob.data(), blob.size())) {
     std::fprintf(stderr, "send failed\n");
     return 1;
   }
