@@ -590,29 +590,54 @@ def plan_to_proto(op: PhysicalOp) -> pb.PlanProto:
 def task_to_proto(op: PhysicalOp, partition: int,
                   task_id: str = "task",
                   file_resources=None) -> bytes:
-    """`file_resources`: {resource_id: [FileSegment,...]} shipped with the
-    task so IpcReader leaves resolve without an in-process registry
-    (cross-process/host execution)."""
+    """`file_resources`: {resource_id: [FileSegment | RemoteSegment,
+    ...]} shipped with the task so IpcReader leaves resolve without an
+    in-process registry (cross-process/host execution). List order is
+    preserved on the wire - it IS the read order."""
+    from blaze_tpu.runtime.transport import RemoteSegment
+
     t = pb.TaskDefinitionProto(partition=partition, task_id=task_id)
     t.plan.CopyFrom(plan_to_proto(op))
     for rid, segments in (file_resources or {}).items():
         rp = t.file_resources.add(resource_id=rid)
         for seg in segments:
-            rp.segments.add(
-                path=seg.path, start=seg.offset, length=seg.length
-            )
+            o = rp.ordered.add()
+            if isinstance(seg, RemoteSegment):
+                o.remote.host = seg.host
+                o.remote.port = seg.port
+                o.remote.path = seg.path
+                o.remote.start = seg.offset
+                o.remote.length = seg.length
+            else:
+                o.local.path = seg.path
+                o.local.start = seg.offset
+                o.local.length = seg.length
     return t.SerializeToString()
 
 
 def task_from_proto(data: bytes):
     from blaze_tpu.ops.ipc_reader import FileSegment
+    from blaze_tpu.runtime.transport import RemoteSegment
 
     t = pb.TaskDefinitionProto()
     t.ParseFromString(data)
     resources = {}
     for rp in t.file_resources:
+        # legacy local-only field first, then the ordered mixed list
         segs = [
             FileSegment(s.path, s.start, s.length) for s in rp.segments
         ]
+        for o in rp.ordered:
+            if o.WhichOneof("kind") == "remote":
+                r = o.remote
+                segs.append(
+                    RemoteSegment(r.host, r.port, r.path, r.start,
+                                  r.length)
+                )
+            else:
+                segs.append(
+                    FileSegment(o.local.path, o.local.start,
+                                o.local.length)
+                )
         resources[rp.resource_id] = (lambda ss: (lambda p: ss))(segs)
     return plan_from_proto(t.plan), t.partition, t.task_id, resources

@@ -1,0 +1,181 @@
+"""A full TPC-DS query distributed across 2 real worker PROCESSES with
+REMOTE shuffle reads.
+
+VERDICT r4 item 7: compose what exists - MiniCluster workers (separate
+interpreters, disjoint private data dirs), __WORKER_LOCAL__ shuffle
+outputs, and blz:// RemoteSegment block streams - into one multi-stage
+TPC-DS query (q3: store_sales x date_dim x item -> brand revenue
+rollup, tpcds_support.q3). Map tasks join map-side and hash-shuffle
+into their claiming worker's PRIVATE directory; reduce tasks receive
+RemoteSegment sources serialized INSIDE the TaskDefinition
+(plan.proto ResourceSegmentsProto.remote_segments) and stream every
+block over the writers' BlockServers - the reference's netty remote
+shuffle-read path (SURVEY 2.4), with no shared data filesystem.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    IpcReaderExec,
+    IpcReadMode,
+    ProjectExec,
+    ShuffleWriterExec,
+)
+from blaze_tpu.ops.joins import HashJoinExec, JoinType
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.cluster import WORKER_LOCAL_PREFIX, MiniCluster
+from blaze_tpu.runtime.transport import RemoteSegment
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("BLZ_SKIP_CLUSTER") == "1",
+    reason="cluster tests disabled",
+)
+
+CLUSTER_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+N_REDUCE = 3
+
+
+def test_q3_two_processes_remote_shuffle_reads(tmp_path):
+    from tests.tpcds_support import gen_tables
+    from tests.test_tpcds_queries import ORACLES
+
+    tables = gen_tables()
+    # two store_sales splits -> two map tasks (one per worker when both
+    # are idle); dims replicated to every map (the reference's
+    # broadcast-join distribution for q3)
+    ss = tables["store_sales"]
+    halves = np.array_split(np.arange(len(ss)), 2)
+    paths = {}
+    for name in ("date_dim", "item"):
+        p = str(tmp_path / f"{name}.parquet")
+        pq.write_table(
+            pa.Table.from_pandas(tables[name], preserve_index=False), p
+        )
+        paths[name] = p
+    ss_paths = []
+    for i, idx in enumerate(halves):
+        p = str(tmp_path / f"ss{i}.parquet")
+        pq.write_table(
+            pa.Table.from_pandas(
+                ss.iloc[idx], preserve_index=False
+            ), p,
+        )
+        ss_paths.append(p)
+
+    def map_plan(mid: int):
+        """q3 map side: BHJ date_dim + item onto one store_sales split,
+        project the rollup columns, hash-shuffle by brand_id into the
+        claiming worker's PRIVATE directory."""
+        dates = FilterExec(
+            ParquetScanExec([[FileRange(paths["date_dim"])]]),
+            Col("d_moy") == 11,
+        )
+        items = FilterExec(
+            ParquetScanExec([[FileRange(paths["item"])]]),
+            Col("i_manufact_id") == 128,
+        )
+        j = HashJoinExec(
+            dates, ParquetScanExec([[FileRange(ss_paths[mid])]]),
+            ["d_date_sk"], ["ss_sold_date_sk"], JoinType.INNER,
+        )
+        j2 = HashJoinExec(
+            items, j, ["i_item_sk"], ["ss_item_sk"], JoinType.INNER,
+        )
+        proj = ProjectExec(
+            j2,
+            [(Col("d_year"), "d_year"),
+             (Col("i_brand_id"), "brand_id"),
+             (Col("i_brand"), "brand"),
+             (Col("ss_ext_sales_price"), "price")],
+        )
+        return ShuffleWriterExec(
+            proj, [Col("brand_id")], N_REDUCE,
+            WORKER_LOCAL_PREFIX + f"/q3-m{mid}.data",
+            WORKER_LOCAL_PREFIX + f"/q3-m{mid}.index",
+        )
+
+    with MiniCluster(num_workers=2, env=CLUSTER_ENV) as cluster:
+        plans = [map_plan(m) for m in range(2)]
+        mid_schema = plans[0].children[0].schema
+        _tables, metas = cluster.run_tasks(
+            [task_to_proto(p, 0, f"q3-map-{m}")
+             for m, p in enumerate(plans)],
+            timeout=600, return_metas=True,
+        )
+        # every map wrote into a PRIVATE worker dir, exported only via
+        # its BlockServer
+        assert all(m and m["outputs"] for m in metas)
+        for m in metas:
+            for out in m["outputs"]:
+                assert "blz-worker" in out["data"]
+
+        # reduce tasks: the shuffle blocks ride the task proto as
+        # RemoteSegments; whichever worker claims a reduce streams them
+        # from BOTH writers' block servers over the blz:// fabric
+        reduce_tasks = []
+        for r in range(N_REDUCE):
+            segs = []
+            for m in metas:
+                for out in m["outputs"]:
+                    off, length = out["ranges"][r]
+                    if length:
+                        segs.append(RemoteSegment(
+                            m["host"], m["port"], out["data"],
+                            off, length,
+                        ))
+            reader = IpcReaderExec(
+                f"q3-r{r}", mid_schema, N_REDUCE,
+                IpcReadMode.CHANNEL_AND_FILE_SEGMENT,
+            )
+            agg = HashAggregateExec(
+                reader,
+                keys=[(Col("d_year"), "d_year"),
+                      (Col("brand_id"), "brand_id"),
+                      (Col("brand"), "brand")],
+                aggs=[(AggExpr(AggFn.SUM, Col("price")), "sum_agg")],
+                mode=AggMode.COMPLETE,
+            )
+            reduce_tasks.append(task_to_proto(
+                agg, r, f"q3-reduce-{r}",
+                file_resources={f"q3-r{r}": segs},
+            ))
+        parts = cluster.run_tasks(reduce_tasks, timeout=600)
+
+    got = pd.concat(
+        [t.to_pandas() for t in parts if t.num_rows], ignore_index=True
+    )
+    # hash(brand_id) partitioning keeps each (year, brand) group in
+    # exactly one reducer
+    assert not got.duplicated(["d_year", "brand_id", "brand"]).any()
+    # driver-side final order: q3's ORDER BY d_year, sum_agg DESC,
+    # brand_id LIMIT 100 over the handful of surviving groups
+    got = got.sort_values(
+        ["d_year", "sum_agg", "brand_id"],
+        ascending=[True, False, True],
+    ).head(100).reset_index(drop=True)
+
+    exp = ORACLES["q3"](tables).reset_index(drop=True)
+    exp_cols = list(exp.columns)
+    got = got[exp_cols]
+    assert len(got) == len(exp)
+    for c in exp_cols:
+        if exp[c].dtype.kind == "f" or got[c].dtype.kind == "f":
+            assert np.allclose(
+                got[c].astype(float).to_numpy(),
+                exp[c].astype(float).to_numpy(),
+                rtol=1e-6, equal_nan=True,
+            ), c
+        else:
+            assert got[c].tolist() == exp[c].tolist(), c
