@@ -360,12 +360,22 @@ def _tpu_core_probe(n=1 << 20):
     """On a real chip, time the scatter vs sort grouping cores and the
     packed vs ladder argsort at 1M rows - the measurement that decides
     next round's `auto` defaults (they currently guess sort on TPU).
-    Returns a dict of seconds, or {} on any failure."""
+
+    Each knob's two modes are also VALIDATED against each other
+    (`<knob>_valid`): config.resolve_core_choice only trusts a probe
+    whose results agreed on this chip, so a mis-compiling core can
+    never be selected on timing alone. The artifact also records
+    `device_kind` so a measurement from one chip generation cannot
+    steer another. Returns a dict, or {} on any failure."""
     import numpy as np
 
     import jax
 
     out = {}
+    try:
+        out["device_kind"] = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        pass
     try:
         rng = np.random.default_rng(7)
         g = np.asarray(rng.integers(0, 4096, n), dtype=np.int32)
@@ -374,6 +384,7 @@ def _tpu_core_probe(n=1 << 20):
             ("group", "BLAZE_GROUP_CORE", ("scatter", "sort")),
             ("sort", "BLAZE_SORT_CORE", ("scatter", "sort")),
         ):
+            results = {}
             for mode in modes:
                 os.environ[env] = mode
                 try:
@@ -423,7 +434,8 @@ def _tpu_core_probe(n=1 << 20):
                                 [(gg, None, True, True)], n, n
                             )
                     f = jax.jit(fn)
-                    jax.block_until_ready(f())
+                    r = jax.block_until_ready(f())
+                    results[mode] = np.asarray(r)
                     t0 = time.perf_counter()
                     jax.block_until_ready(f())
                     out[f"{knob}_{mode}_s"] = round(
@@ -433,6 +445,19 @@ def _tpu_core_probe(n=1 << 20):
                     out[f"{knob}_{mode}_s"] = f"error: {e}"[:120]
                 finally:
                     os.environ.pop(env, None)
+            # cross-validate: both cores must agree on this chip
+            # (group sums within float tolerance; sort permutations
+            # exactly - stable sorts over identical keys are unique)
+            if len(results) == 2:
+                a, b = results["scatter"], results["sort"]
+                try:
+                    out[f"{knob}_valid"] = bool(
+                        np.allclose(a, b, rtol=1e-5, atol=1e-3)
+                        if a.dtype.kind == "f"
+                        else np.array_equal(a, b)
+                    )
+                except Exception:  # noqa: BLE001
+                    out[f"{knob}_valid"] = False
         # Pallas one-hot segmented reduce vs the XLA scatter (Mosaic
         # compile + perf): decides whether BLAZE_SEGREDUCE=pallas goes
         # default-on next round
@@ -941,7 +966,24 @@ def child(n_rows):
     # cold chip) core probe - a kill mid-probe can't lose the battery
     print(json.dumps(out), flush=True)
     if backend != "cpu":
-        out["tpu_core_probe"] = _tpu_core_probe()
+        probe = _tpu_core_probe()
+        out["tpu_core_probe"] = probe
+        if probe:
+            # record the measurement so config.resolve_core_choice's
+            # `auto` derives future core defaults from data, not the
+            # guess (the driver commits round-end working-tree changes)
+            try:
+                bdir = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks",
+                )
+                os.makedirs(bdir, exist_ok=True)
+                with open(
+                    os.path.join(bdir, "tpu_core_probe.json"), "w"
+                ) as f:
+                    json.dump(probe, f, indent=1)
+            except OSError:
+                pass
         print(json.dumps(out), flush=True)
 
 
