@@ -120,12 +120,21 @@ def run_grouped_kernel(base_key, build, args, fetch_n, gcap):
 
     `build(force_lexsort, group_cap)` returns the python kernel to jit;
     `fetch_n(outs, n_groups) -> (outs', n)` owns the host sync policy."""
+    import os
+
     force_lex = False
+    # BLAZE_AGG_TIER1 <= 0 disables the small first tier (one fewer
+    # compiled kernel variant per aggregate shape): the test suite sets
+    # it because jaxlib's CPU client segfaults under cumulative
+    # compile volume (docs/JAXLIB_SEGFAULT.md) and the ladder's extra
+    # variants pushed the largest exchange-tier query over the cliff
+    tier1 = int(os.environ.get("BLAZE_AGG_TIER1", "4096"))
     if gcap is None:
         tiers = [None]
+    elif tier1 <= 0 or tier1 >= gcap:
+        tiers = [gcap, None]
     else:
-        first = min(gcap, 4096)
-        tiers = ([first] if first == gcap else [first, gcap]) + [None]
+        tiers = [tier1, gcap, None]
     ti = 0
     while True:
         gc = tiers[ti]

@@ -85,6 +85,13 @@ def _env(rows=None):
         os.path.join(tempfile.gettempdir(), "blaze_jax_cache"),
     )
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    # disable the aggregate ladder's small first tier in the suite:
+    # its extra kernel variant per aggregate shape pushed q64's
+    # exchange run over the jaxlib compile-volume cliff even in a
+    # fresh process (round 5). Correctness coverage for the ladder
+    # lives in tests/test_ops.py::test_group_capacity_ladder, which
+    # runs with the production default.
+    env.setdefault("BLAZE_AGG_TIER1", "0")
     if rows is not None:
         env["BLAZE_TPCDS_ROWS"] = str(rows)
     return env

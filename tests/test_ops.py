@@ -438,3 +438,64 @@ def test_float_group_keys_scatter_core_matches_sort_core():
     assert outs["scatter"] == outs["sort"]
     # -0.0 and 0.0 must be ONE group
     assert sum(1 for k in outs["scatter"] if k == 0.0) == 1
+
+
+def test_group_capacity_ladder():
+    """The tiered group-capacity ladder (run_grouped_kernel): an
+    aggregate whose group count exceeds the small first tier must climb
+    to the configured capacity and still produce exact results, and a
+    few-groups aggregate must resolve inside the first tier. Runs with
+    the production default (BLAZE_AGG_TIER1 unset -> 4096) regardless
+    of the suite runner's override."""
+    import dataclasses
+    import os
+
+    import pandas as pd
+
+    from blaze_tpu.config import get_config, set_config
+    from blaze_tpu.runtime.executor import run_plan
+
+    prior = os.environ.get("BLAZE_AGG_TIER1")
+    os.environ.pop("BLAZE_AGG_TIER1", None)
+    prior_cfg = get_config()
+    # the ladder only engages when gcap < batch capacity: pin a config
+    # where 40000 rows pad to a 65536 bucket and the configured group
+    # capacity sits BETWEEN the 4096 first tier and that capacity, so
+    # tiers resolve to [4096, 16384, None] (otherwise gcap collapses
+    # to None and a single unsliced kernel runs - no ladder at all)
+    set_config(dataclasses.replace(
+        prior_cfg, batch_size=1 << 16, shape_buckets=(1 << 16,),
+        agg_group_capacity=16384,
+    ))
+    try:
+        rng = np.random.default_rng(13)
+        n = 40000
+        for n_groups in (300, 9000):   # below / above the 4096 tier
+            g = rng.integers(0, n_groups, n).astype(np.int64)
+            v = rng.integers(0, 1000, n).astype(np.int64)
+            cb = ColumnBatch.from_arrow(
+                pa.record_batch({"g": g, "v": v})
+            )
+            plan = HashAggregateExec(
+                MemoryScanExec([[cb]], cb.schema),
+                keys=[(Col("g"), "g")],
+                aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                      (AggExpr(AggFn.COUNT_STAR, None), "c")],
+                mode=AggMode.COMPLETE,
+            )
+            got = (
+                run_plan(plan).to_pandas()
+                .sort_values("g").reset_index(drop=True)
+            )
+            exp = (
+                pd.DataFrame({"g": g, "v": v}).groupby("g")
+                .agg(s=("v", "sum"), c=("v", "size")).reset_index()
+            )
+            assert len(got) == len(exp) == len(np.unique(g))
+            assert (got["g"].to_numpy() == exp["g"].to_numpy()).all()
+            assert (got["s"].to_numpy() == exp["s"].to_numpy()).all()
+            assert (got["c"].to_numpy() == exp["c"].to_numpy()).all()
+    finally:
+        set_config(prior_cfg)
+        if prior is not None:
+            os.environ["BLAZE_AGG_TIER1"] = prior
