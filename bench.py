@@ -534,7 +534,16 @@ def child(n_rows):
     set_config(
         EngineConfig(
             batch_size=chunk,
-            shape_buckets=(4096, 65536, 1 << 20, chunk, n_rows),
+            # intermediate buckets between 64k and 1M: the cold-scan
+            # path's host filter pushdown compacts ~40%-selective
+            # chunks to ~390k rows, which would otherwise pad straight
+            # back to the 1M bucket and forfeit the compaction
+            # sorted set: bucket_for picks the FIRST bucket >= n, so a
+            # small dev-mode n_rows must not hide behind a larger
+            # intermediate bucket
+            shape_buckets=tuple(sorted(
+                {4096, 65536, 262144, 524288, 1 << 20, chunk, n_rows}
+            )),
         )
     )
 
