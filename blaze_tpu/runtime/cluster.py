@@ -58,15 +58,24 @@ class MiniCluster:
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         for i in range(self.num_workers):
             data_dir = tempfile.mkdtemp(prefix=f"blz-worker{i}-")
+            # stderr to a FILE, never a pipe: nothing drains a pipe, so
+            # a chatty worker (jax compile-cache warnings scale with
+            # kernel count) would fill the 64KB buffer and block
+            # forever mid-compile - task timeouts with no .err file
+            # were this deadlock
+            errlog = open(
+                os.path.join(self.spool, f"worker{i}.stderr"), "wb"
+            )
             self._procs.append(
                 subprocess.Popen(
                     [sys.executable, "-m", "blaze_tpu.runtime.cluster",
                      self.spool, data_dir],
                     env=env,
                     stdout=subprocess.DEVNULL,
-                    stderr=subprocess.PIPE,
+                    stderr=errlog,
                 )
             )
+            errlog.close()  # the child holds its own descriptor
 
     def stop(self) -> None:
         open(os.path.join(self.spool, "SHUTDOWN"), "w").close()
