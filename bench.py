@@ -496,18 +496,27 @@ def _tpu_core_probe(n=1 << 20):
     return out
 
 
-def timed(fn, iters=5, warmup=1):
-    """median-of-N: the tunnel's wire bandwidth and this host's single
-    shared core are both noisy; the median reflects the steady state."""
+def timed(fn, iters=None, warmup=1):
+    """median-of-k with warm-up separated from steady state: the
+    tunnel's wire bandwidth and this host's single shared core are both
+    noisy; the median reflects the steady state and the relative spread
+    (max-min)/median makes each number's noise band part of the
+    artifact (VERDICT r5 weak #1: a 0.66x-vs-1.13x swing on one shape
+    must be explainable from the JSON alone).
+
+    Returns (median_s, rel_spread, k, out)."""
+    k = iters or int(os.environ.get("BLAZE_BENCH_ITERS", 5))
     for _ in range(warmup):
-        out = fn()
+        out = fn()  # warm-up: compile + cache fill, excluded from stats
     ts = []
-    for _ in range(iters):
+    for _ in range(k):
         t0 = time.perf_counter()
         out = fn()
         ts.append(time.perf_counter() - t0)
     ts.sort()
-    return ts[len(ts) // 2], out
+    median = ts[len(ts) // 2]
+    spread = (ts[-1] - ts[0]) / median if median > 0 else 0.0
+    return median, spread, k, out
 
 
 def child(n_rows):
@@ -782,7 +791,7 @@ def child(n_rows):
     }
 
     # ---- 4. window: per-partition rank + running revenue ----
-    window_plan = HashAggregateExec(
+    window_plan = fuse_pipelines(HashAggregateExec(
         WindowExec(
             ProjectExec(fact_scan(),
                         [(Col("part"), "part"), (Col("price"), "price")]),
@@ -799,7 +808,7 @@ def child(n_rows):
                "rksum"),
               (AggExpr(AggFn.SUM, Col("run")), "runsum")],
         mode=AggMode.COMPLETE,
-    )
+    ))
 
     def window_engine():
         t = run_plan(window_plan)
@@ -892,13 +901,14 @@ def child(n_rows):
     backend = jax.default_backend()
     for name, q in queries.items():
         try:
-            t_eng, engine_out = timed(q["engine"])
+            t_eng, eng_spread, k, engine_out = timed(q["engine"])
             cpu_best = None
+            cpu_spread = 0.0
             cpu_out = None
             for impl in q["cpu"]:
-                t_c, out_c = timed(impl)
+                t_c, s_c, _, out_c = timed(impl)
                 if cpu_best is None or t_c < cpu_best:
-                    cpu_best, cpu_out = t_c, out_c
+                    cpu_best, cpu_spread, cpu_out = t_c, s_c, out_c
             if not q["close"](engine_out, cpu_out):
                 raise AssertionError(
                     f"result mismatch: {engine_out!r} != {cpu_out!r}"
@@ -922,7 +932,15 @@ def child(n_rows):
             "engine_s": round(t_eng, 4),
             "cpu_s": round(cpu_best, 4),
             "vs": round(ratio, 3),
+            "median": round(t_eng, 4),
+            "spread": round(max(eng_spread, cpu_spread), 3),
+            "k": k,
         }
+        # a shape whose run-to-run noise exceeds its margin over 1x
+        # cannot support a "beats/loses to CPU" claim - flag it in the
+        # artifact instead of leaving the discrepancy to archaeology
+        if max(eng_spread, cpu_spread) > abs(ratio - 1.0):
+            detail[name]["noisy"] = True
         if hbm_bw:
             detail[name]["hbm_util_est"] = round(
                 q["rows"] * bytes_per_row.get(name, 8)
@@ -996,8 +1014,70 @@ def child(n_rows):
         print(json.dumps(out), flush=True)
 
 
+def smoke():
+    """Commit-time bench guard (<= 60s): run the CPU battery at small
+    rows and assert (a) a parseable JSON result line, (b) every shape
+    succeeded with its oracle check, (c) the e2e dispatch budget holds.
+    Wired into run_tests.py so bench breakage fails at commit time, not
+    at round end. Exit code 0 iff all assertions hold."""
+    rows = int(os.environ.get("BLAZE_BENCH_SMOKE_ROWS", 1 << 18))
+    env = _repo_env(platform="cpu")
+    env["BLAZE_BENCH_ITERS"] = env.get("BLAZE_BENCH_ITERS", "3")
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-u", os.path.abspath(__file__), "--child",
+         str(rows)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    result = None
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    problems = []
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()
+        problems.append(
+            f"child rc={out.returncode} "
+            f"({tail[-1][:200] if tail else 'no stderr'})"
+        )
+    if result is None:
+        problems.append("no parseable JSON line on stdout")
+    else:
+        if result.get("failed_queries"):
+            problems.append(
+                f"failed queries: {result['failed_queries']}"
+            )
+        for name, d in (result.get("queries") or {}).items():
+            for field in ("median", "spread", "k"):
+                if "error" not in d and field not in d:
+                    problems.append(f"{name}: missing {field!r}")
+        counts = result.get("e2e_dispatch_counts") or {}
+        if not counts:
+            problems.append("no e2e_dispatch_counts in artifact")
+        elif counts.get("dispatches", 99) > 8:
+            problems.append(
+                f"e2e dispatch budget blown: {counts} (want <= 8)"
+            )
+    status = "OK" if not problems else "FAIL"
+    print(json.dumps({
+        "smoke": status,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "rows": rows,
+        "problems": problems,
+        "result": result,
+    }), flush=True)
+    return 0 if not problems else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        sys.exit(smoke())
     else:
         main()

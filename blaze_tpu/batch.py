@@ -208,31 +208,45 @@ class ColumnBatch:
                     entries.append(
                         (~null_np, cap, 1)  # padding rows stay "valid"
                     )
-            col_meta.append((dt, has_validity, dictionary))
-        from blaze_tpu.runtime.pack import put_packed_padded
+            col_meta.append((dt, has_validity, dictionary, True))
+        from blaze_tpu.runtime.pack import put_packed_padded_lazy
 
-        dev_bufs = iter(put_packed_padded(entries))
-        cols: List[Column] = []
-        for dt, has_validity, dictionary in col_meta:
-            values = next(dev_bufs)
-            validity = next(dev_bufs) if has_validity else None
-            cols.append(Column(dt, values, validity, dictionary))
-        return ColumnBatch(schema, cols, n)
+        buf, metas, pairs = put_packed_padded_lazy(entries)
+        if buf is None:  # zero-column schema
+            return ColumnBatch(schema, [], n)
+        return PackedColumnBatch(schema, n, cap, buf, metas, pairs,
+                                 col_meta)
 
     @staticmethod
     def from_arrow_pruned(rb, schema: Schema, present: Sequence[int],
                           capacity: Optional[int] = None) -> "ColumnBatch":
         """Build a batch with `schema` positions intact from a RecordBatch
         holding only the columns at `present` (ascending). Pruned
-        positions get shared device-resident zero placeholders - never
-        decoded, never transferred - valid only when no consumer reads
-        them (guaranteed by planner/colprune's conservative analysis)."""
+        positions get zero placeholders - never decoded, never
+        transferred (constant-folded zeros inside fused kernels, shared
+        device arrays on the classic path) - valid only when no consumer
+        reads them (guaranteed by planner/colprune's conservative
+        analysis)."""
         sub = ColumnBatch.from_arrow(rb, capacity)
+        pres = set(present)
+        if isinstance(sub, PackedColumnBatch) and sub.is_packed:
+            # keep the packed wire buffer lazy: a fused consumer splices
+            # unpack + placeholders + its whole chain into one dispatch
+            it = iter(sub._col_meta)
+            full_meta = []
+            for i, field in enumerate(schema):
+                if i in pres:
+                    full_meta.append(next(it))
+                else:
+                    full_meta.append((field.dtype, False, None, False))
+            return PackedColumnBatch(
+                schema, rb.num_rows, sub.capacity, sub._buf,
+                sub._metas, sub._pairs, full_meta,
+            )
         cap = sub.capacity if sub.columns else (
             capacity or get_config().bucket_for(rb.num_rows)
         )
         it = iter(sub.columns)
-        pres = set(present)
         cols: List[Column] = []
         for i, field in enumerate(schema):
             if i in pres:
@@ -365,6 +379,157 @@ class ColumnBatch:
         """Host-side row slice (used by spill/IPC writers)."""
         rb = self.to_arrow().slice(start, length)
         return ColumnBatch.from_arrow(rb)
+
+
+class PackedColumnBatch(ColumnBatch):
+    """A ColumnBatch whose device columns still live inside the single
+    packed H2D wire buffer (runtime/pack.put_packed_padded_lazy).
+
+    Two consumption modes:
+
+    - `packed_view()` (pipeline fusion): the fused operator composes the
+      buffer splitter into its OWN jitted kernel, so transfer-unpack +
+      the whole operator chain is ONE dispatch per batch. Pruned scan
+      positions materialize as jnp.zeros inside the kernel - XLA folds
+      the constants and dead-codes unread columns.
+    - `.columns` / `device_buffers()` (any classic operator): first
+      access runs the shared cached unpack kernel once (exactly the old
+      put_packed_padded dispatch) and the batch behaves as a plain
+      ColumnBatch thereafter.
+
+    `col_meta` is `[(dtype, has_validity, dictionary, packed)]` per
+    schema position; `packed=False` marks a colprune placeholder that has
+    no segment in the wire buffer."""
+
+    def __init__(self, schema: Schema, num_rows: int, cap: int,
+                 buf: jax.Array, metas: Tuple, pairs: bool, col_meta):
+        self.schema = schema
+        self.num_rows = num_rows
+        self.selection = None
+        self._cap = cap
+        self._buf = buf
+        self._metas = metas
+        self._pairs = pairs
+        self._col_meta = list(col_meta)
+        self._cols: Optional[List[Column]] = None
+
+    # -- lazy plain-batch view ----------------------------------------
+    @property
+    def is_packed(self) -> bool:
+        return self._cols is None
+
+    @property
+    def columns(self) -> List[Column]:  # type: ignore[override]
+        if self._cols is None:
+            self._unpack()
+        return self._cols
+
+    @columns.setter
+    def columns(self, cols) -> None:
+        self._cols = list(cols)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def layout(self) -> Tuple:
+        return (
+            self._cap,
+            tuple(
+                (dt.id.value, dt.precision, dt.scale, has_validity)
+                for dt, has_validity, _, _ in self._col_meta
+            ),
+        )
+
+    def dictionaries(self) -> List[Optional[object]]:
+        return [d for _, _, d, _ in self._col_meta]
+
+    def _unpack(self) -> None:
+        from blaze_tpu.runtime.pack import unpack_kernel
+
+        arrays = iter(unpack_kernel(self._metas, self._pairs)(self._buf))
+        cols: List[Column] = []
+        for dt, has_validity, dictionary, packed in self._col_meta:
+            if not packed:
+                cols.append(Column(dt, _placeholder(self._cap, dt)))
+                continue
+            values = next(arrays)
+            validity = next(arrays) if has_validity else None
+            cols.append(Column(dt, values, validity, dictionary))
+        self._cols = cols
+
+    # -- fused-kernel view --------------------------------------------
+    def packed_view(self) -> Optional["PackedView"]:
+        """The fusion contract, or None once the batch was unpacked."""
+        if self._cols is not None:
+            return None
+        return PackedView(
+            self._buf,
+            (
+                self._metas,
+                self._pairs,
+                tuple(
+                    (dt.id.value, dt.precision, dt.scale,
+                     has_validity, packed)
+                    for dt, has_validity, _, packed in self._col_meta
+                ),
+            ),
+            self._build_unflatten,
+            self.layout(),
+        )
+
+    def _build_unflatten(self):
+        from blaze_tpu.runtime.pack import build_unpack_at
+
+        split = build_unpack_at(self._metas, self._pairs)
+        # capture only what unflatten reads: the closure lives in the
+        # process-global kernel cache, so it must not pin this batch's
+        # pyarrow dictionaries in host memory
+        col_meta = [
+            (dt, has_validity, packed)
+            for dt, has_validity, _, packed in self._col_meta
+        ]
+        cap = self._cap
+
+        def unflatten(u8):
+            arrays = iter(split(u8))
+            bufs: List[jax.Array] = []
+            for dt, has_validity, packed in col_meta:
+                if not packed:
+                    phys = dt.physical_dtype()
+                    shape = (
+                        (cap, 2) if dt.is_wide_decimal else (cap,)
+                    )
+                    bufs.append(jnp.zeros(shape, dtype=phys))
+                    continue
+                bufs.append(next(arrays))
+                if has_validity:
+                    bufs.append(next(arrays))
+            return bufs
+
+        return unflatten
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedView:
+    """What a fused kernel needs from a still-packed batch: the wire
+    buffer (the kernel's traced input), a hashable cache-key component,
+    a builder returning the traceable u8 -> device_buffers splitter, and
+    the batch's layout descriptor (feeds the classic inner kernel)."""
+
+    buf: jax.Array = dataclasses.field(compare=False)
+    key: Tuple = ()
+    build_unflatten: object = dataclasses.field(
+        default=None, compare=False
+    )
+    layout: Tuple = ()
+
+
+def packed_view(cb: ColumnBatch) -> Optional[PackedView]:
+    """PackedView of a batch when fusion can consume it directly."""
+    if isinstance(cb, PackedColumnBatch):
+        return cb.packed_view()
+    return None
 
 
 import collections

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from blaze_tpu.types import DataType, Schema, TypeId
-from blaze_tpu.batch import Column, ColumnBatch
+from blaze_tpu.batch import Column, ColumnBatch, packed_view
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.ir import AggExpr, AggFn
 from blaze_tpu.exprs.eval import DeviceEvaluator
@@ -42,7 +42,6 @@ from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.ops.filter import FilterExec
 from blaze_tpu.ops.project import ProjectExec, _unflatten_cvs
-from blaze_tpu.ops.rename import RenameColumnsExec
 from blaze_tpu.runtime.dispatch import cached_kernel
 
 
@@ -59,19 +58,6 @@ def _expr_needs_host(e: ir.Expr, schema: Schema) -> bool:
                 return True
         except Exception:
             return True
-    return False
-
-
-def _stage_fusable(op: PhysicalOp) -> bool:
-    if isinstance(op, RenameColumnsExec):
-        return True
-    if isinstance(op, FilterExec):
-        return not _expr_needs_host(op.predicate, op.children[0].schema)
-    if isinstance(op, ProjectExec):
-        child_schema = op.children[0].schema
-        return not any(
-            _expr_needs_host(e, child_schema) for e, _ in op.exprs
-        )
     return False
 
 
@@ -114,12 +100,23 @@ class FusedPipelineExec(PhysicalOp):
             yield self._run(cb)
 
     def _run(self, cb: ColumnBatch) -> ColumnBatch:
-        layout = cb.layout()
-        fn = cached_kernel(
-            ("fusedpipe", self.structure_key(), layout),
-            lambda: self._build_kernel(layout),
-        )
-        out_bufs, sel = fn(cb.device_buffers(), cb.selection)
+        pv = packed_view(cb)
+        if pv is not None:
+            # still-packed scan batch: the H2D wire-buffer split traces
+            # INTO this kernel - transfer-unpack + the whole stage chain
+            # is one dispatch (and never materializes pruned columns)
+            fn = cached_kernel(
+                ("fusedpipe_packed", self.structure_key(), pv.key),
+                lambda: self._build_kernel_packed(pv),
+            )
+            out_bufs, sel = fn(pv.buf, cb.selection)
+        else:
+            layout = cb.layout()
+            fn = cached_kernel(
+                ("fusedpipe", self.structure_key(), layout),
+                lambda: self._build_kernel(layout),
+            )
+            out_bufs, sel = fn(cb.device_buffers(), cb.selection)
         # dictionaries for passthrough string columns
         dicts = self._out_dictionaries(cb)
         cols: List[Column] = []
@@ -160,10 +157,19 @@ class FusedPipelineExec(PhysicalOp):
 
         return kernel
 
+    def _build_kernel_packed(self, pv):
+        unflatten = pv.build_unflatten()
+        inner = self._build_kernel(pv.layout)
+
+        def kernel(buf, selection):
+            return inner(unflatten(buf), selection)
+
+        return kernel
+
     def _out_dictionaries(self, cb: ColumnBatch):
         """Track dictionaries of string columns through the stage chain
         (only passthrough BoundCol survives fusion for strings)."""
-        dicts = [c.dictionary for c in cb.columns]
+        dicts = cb.dictionaries()
         for st in self.stages:
             if isinstance(st, ProjectExec):
                 new = []
@@ -222,20 +228,100 @@ class FusedAggregateExec(PhysicalOp):
             # columns no stage/aggregate references
             yield from self._execute_join_fused(leaf, partition, ctx)
             return
+        if self.fetch_host and not self.agg.keys:
+            plan = _keyless_merge_plan(
+                self.agg.aggs, self.agg.schema.fields
+            )
+            if plan is not None:
+                yield from self._execute_keyless_carry(
+                    leaf, partition, ctx, plan
+                )
+                return
         first = True
         for cb in leaf.execute(partition, ctx):
+            pv = packed_view(cb)
+            if pv is not None:
+                key_suffix = ("fusedagg_packed", pv.key)
+                build = (
+                    lambda fl, gc, pv=pv: self._build_kernel_packed(
+                        pv, force_lexsort=fl, group_cap=gc
+                    )
+                )
+                args = (pv.buf, cb.selection,
+                        None if cb.num_rows == cb.capacity
+                        else cb.num_rows)
+            else:
+                layout = cb.layout()
+                key_suffix = ("fusedagg", layout)
+                build = (
+                    lambda fl, gc, layout=layout: self._build_kernel(
+                        layout, force_lexsort=fl, group_cap=gc
+                    )
+                )
+                args = (cb.device_buffers(), cb.selection,
+                        None if cb.num_rows == cb.capacity
+                        else cb.num_rows)
             out, first = self._run_agg(
-                ("fusedagg", cb.layout()),
-                lambda fl, gc, layout=cb.layout(): self._build_kernel(
-                    layout, force_lexsort=fl, group_cap=gc
-                ),
-                (cb.device_buffers(), cb.selection,
-                 None if cb.num_rows == cb.capacity else cb.num_rows),
-                cb.layout()[0],
-                first,
+                key_suffix, build, args, cb.capacity, first,
             )
             if out is not None:
                 yield out
+
+    def _execute_keyless_carry(self, leaf, partition: int,
+                               ctx: ExecContext, plan):
+        """Keyless COMPLETE rewrite, streamed: ONE dispatch per input
+        batch and ZERO extra dispatches at end of stream.
+
+        The per-batch kernel computes the batch's partial state, merges
+        it with the device-resident carry from the previous batch
+        (SUM/COUNT add, MIN/MAX combine - masked-out states hold the
+        reduction's neutral element so the merge needs no validity
+        branching), AND packs the merged state into a tiny uint8 buffer.
+        Only the LAST batch's packed buffer ever crosses the wire: one
+        plain host fetch, no d2h pack dispatch, no per-batch sync -
+        exactly the reference's one-native-call-per-task dispatch shape
+        (exec.rs:196-255) with the final merge folded into the stream."""
+        agg_sig = tuple((a.fn, a.child) for a, _ in self.agg.aggs)
+        carry = None
+        packed = None
+        for cb in leaf.execute(partition, ctx):
+            pv = packed_view(cb)
+            if pv is not None:
+                shape_key = ("packed", pv.key)
+                build_inner = (
+                    lambda pv=pv: self._build_kernel_packed(
+                        pv, group_cap=1
+                    )
+                )
+                bufs = pv.buf
+            else:
+                layout = cb.layout()
+                shape_key = ("plain", layout)
+                build_inner = (
+                    lambda layout=layout: self._build_kernel(
+                        layout, group_cap=1
+                    )
+                )
+                bufs = cb.device_buffers()
+            with_carry = carry is not None
+            fn = cached_kernel(
+                ("fusedagg_carry", shape_key,
+                 self.pipeline.structure_key(), agg_sig, tuple(plan),
+                 with_carry),
+                lambda: _build_carry_kernel(
+                    build_inner(), plan, with_carry
+                ),
+            )
+            num_rows = (
+                None if cb.num_rows == cb.capacity else cb.num_rows
+            )
+            if with_carry:
+                carry, packed = fn(bufs, cb.selection, num_rows, carry)
+            else:
+                carry, packed = fn(bufs, cb.selection, num_rows)
+        if carry is None:
+            return  # empty stream: HostFinalAggExec emits the global row
+        yield _fetch_packed_states(carry, packed, self._schema)
 
     def _execute_join_fused(self, join, partition: int,
                             ctx: ExecContext):
@@ -423,6 +509,20 @@ class FusedAggregateExec(PhysicalOp):
 
         return kernel
 
+    def _build_kernel_packed(self, pv, force_lexsort: bool = False,
+                             group_cap=None):
+        """Packed-input variant: H2D wire-buffer split + stage chain +
+        partial aggregate in ONE traced program."""
+        unflatten = pv.build_unflatten()
+        inner = self._build_kernel(
+            pv.layout, force_lexsort=force_lexsort, group_cap=group_cap
+        )
+
+        def kernel(buf, selection, num_rows):
+            return inner(unflatten(buf), selection, num_rows)
+
+        return kernel
+
     def _build_kernel(self, layout, force_lexsort: bool = False,
                       group_cap=None):
         pipe_kernel = self.pipeline._build_kernel(layout)
@@ -452,6 +552,248 @@ class FusedAggregateExec(PhysicalOp):
             return agg_kernel(mid_bufs, sel, num_rows)
 
         return kernel
+
+
+def _fetch_packed_states(states, packed, schema: Schema) -> ColumnBatch:
+    """Turn a kernel's (state cols, in-kernel-packed u8) pair into a
+    host-resident single-row state batch: ONE plain fetch, no pack
+    dispatch (the kernel already packed)."""
+    from blaze_tpu.runtime.dispatch import record
+    from blaze_tpu.runtime.pack import unpack_host
+
+    specs = []
+    for v, m in states:
+        specs.append((str(np.dtype(v.dtype)), tuple(v.shape)))
+        if m is not None:
+            specs.append((str(np.dtype(m.dtype)), tuple(m.shape)))
+    record("d2h_fetches")
+    host = iter(unpack_host(np.asarray(packed), specs))
+    cols: List[Column] = []
+    for (v, m), field in zip(states, schema.fields):
+        hv = next(host)
+        hm = next(host) if m is not None else None
+        cols.append(Column(field.dtype, hv, hm, None))
+    return ColumnBatch(schema, cols, len(cols[0].values) if cols else 1)
+
+
+class FusedWindowAggExec(PhysicalOp):
+    """Whole-task fusion of a KEYLESS aggregate over a window: folded
+    stage chain + the shared (partition, order) argsort + gather + every
+    frame pass + the keyless partial aggregate + state packing, ONE
+    program per partition.
+
+    Beyond the dispatch count, the fusion lets XLA dead-code the sorted
+    gather of every window column the aggregate never reads - the
+    dominant cost of a checksum/rollup consumer over a wide window. The
+    sort permutation rides the window's cross-execution cache
+    (WindowExec._sort_cache), so repeated queries over the same staged
+    table skip the argsort entirely. Emits one single-row partial-state
+    batch per partition for HostFinalAggExec."""
+
+    def __init__(self, window, agg):
+        self.window = window
+        self.children = list(window.children)
+        self.agg = agg  # keyless PARTIAL HashAggregateExec
+        self._schema = agg.schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return "FusedWindowAggExec[window -> keyless partial]"
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        from blaze_tpu.config import get_config, resolve_core_choice
+        from blaze_tpu.ops.sort import SortKey
+        from blaze_tpu.ops.util import concat_batches
+
+        win = self.window
+        src = self.children[0]
+        cb = concat_batches(
+            list(src.execute(partition, ctx)), schema=src.schema,
+        )
+        if cb.num_rows == 0:
+            return  # HostFinalAggExec emits the keyless global row
+        keys = [
+            SortKey(e, True, True) for e in win.partition_by
+        ] + list(win.order_by)
+        core = resolve_core_choice(
+            "BLAZE_SORT_CORE", get_config().sort_core
+        )
+        layout = cb.layout()
+        bufs = cb.device_buffers()
+        pipe = win._fused_pipeline
+        base = ("fusedwinagg",
+                pipe.structure_key() if pipe is not None else None,
+                tuple(win.partition_by),
+                tuple((k.expr, k.ascending, k.nulls_first)
+                      for k in win.order_by),
+                tuple((f.kind, f.source, f.offset, f.frame)
+                      for f in win.functions),
+                tuple((a.fn, a.child) for a, _ in self.agg.aggs),
+                layout, core)
+        # full batch: a constant row count lets every live-mask fold
+        num_rows = (
+            None if cb.num_rows == cb.capacity else cb.num_rows
+        )
+        idx = win._cached_sort_idx(bufs, cb.num_rows)
+        if idx is None:
+            fn = cached_kernel(
+                base + ("sort", num_rows is None),
+                lambda: self._build_kernel(layout, keys, with_idx=False),
+            )
+            idx, outs, packed = fn(bufs, num_rows)
+            win._store_sort_idx(bufs, cb.num_rows, idx)
+        else:
+            fn = cached_kernel(
+                base + ("reuse", num_rows is None),
+                lambda: self._build_kernel(layout, keys, with_idx=True),
+            )
+            outs, packed = fn(bufs, num_rows, idx)
+        yield _fetch_packed_states(outs, packed, self._schema)
+
+    def _build_kernel(self, layout, keys, with_idx: bool):
+        from blaze_tpu.runtime.pack import pack_in_kernel
+
+        win = self.window
+        body, mid_layout = win._fused_body(
+            layout, keys, win._fused_pipeline
+        )
+        win_schema = win.schema
+        cap = layout[0]
+        win_layout = (
+            cap,
+            tuple(
+                (f.dtype.id.value, f.dtype.precision, f.dtype.scale,
+                 True)
+                for f in win_schema
+            ),
+        )
+        agg = self.agg
+        child_map = {
+            i: a.child
+            for i, (a, _) in enumerate(agg.aggs)
+            if a.child is not None
+        }
+        agg_kernel = agg._build_kernel(
+            win_schema, cap, [], child_map, False, win_layout,
+            group_cap=1,
+        )
+
+        def run(bufs, num_rows, idx):
+            if num_rows is None:
+                num_rows = cap  # python constant: live masks fold
+            idx, sorted_bufs, outs = body(bufs, num_rows, idx)
+            flat = []
+            it = iter(sorted_bufs)
+            for _tid, _p, _s, has_m in mid_layout[1]:
+                flat.append(next(it))
+                flat.append(
+                    next(it) if has_m
+                    else jnp.ones(cap, dtype=jnp.bool_)
+                )
+            for v, m in outs:
+                flat.append(v)
+                flat.append(
+                    m if m is not None
+                    else jnp.ones(cap, dtype=jnp.bool_)
+                )
+            states, _n = agg_kernel(flat, None, num_rows)
+            pk = []
+            for v, m in states:
+                pk.append(v)
+                if m is not None:
+                    pk.append(m)
+            return idx, states, pack_in_kernel(pk)
+
+        if with_idx:
+            def kernel(bufs, num_rows, idx):
+                _, states, packed = run(bufs, num_rows, idx)
+                return states, packed
+
+            return kernel
+
+        def kernel(bufs, num_rows):
+            return run(bufs, num_rows, None)
+
+        return kernel
+
+
+def _keyless_merge_plan(aggs, partial_fields):
+    """Per-state-column merge ops for the keyless streaming carry, or
+    None when an aggregate's partial state cannot be merged by a pure
+    elementwise combine (FIRST/LAST: their (value, validity) state
+    cannot distinguish "no rows yet" from "first value was NULL").
+
+    Ops: "add" (sums/counts/moments/decimal chunks - an empty state
+    holds 0, the additive neutral), "min"/"max" (an empty state holds
+    the respective neutral: +-inf or the integer extreme). Validity
+    merges as OR on every masked state column."""
+    from blaze_tpu.ops.hash_aggregate import (
+        _parse_dsum_scale,
+        _state_width,
+    )
+
+    plan: List[str] = []
+    pos = 0
+    for a, _ in aggs:
+        dscale = _parse_dsum_scale(partial_fields[pos].name)
+        w = _state_width(a.fn, dscale is not None)
+        fn = a.fn
+        if fn in (AggFn.COUNT, AggFn.COUNT_STAR, AggFn.SUM, AggFn.AVG,
+                  AggFn.VAR_SAMP, AggFn.VAR_POP, AggFn.STDDEV_SAMP,
+                  AggFn.STDDEV_POP):
+            plan.extend(["add"] * w)
+        elif fn is AggFn.MIN:
+            plan.append("min")
+        elif fn is AggFn.MAX:
+            plan.append("max")
+        else:  # FIRST/LAST (order-sensitive) or unknown
+            return None
+        pos += w
+    return plan
+
+
+def _build_carry_kernel(inner, plan, with_carry: bool):
+    """Wrap a keyless fused-aggregate kernel with carry merging and
+    in-kernel state packing (see _execute_keyless_carry)."""
+    from blaze_tpu.runtime.pack import pack_in_kernel
+
+    def merge(carry, outs):
+        merged = []
+        for op, (cv, cm), (nv, nm) in zip(plan, carry, outs):
+            if op == "min":
+                v = jnp.minimum(cv, nv)
+            elif op == "max":
+                v = jnp.maximum(cv, nv)
+            else:
+                v = cv + nv
+            m = None if cm is None else (cm | nm)
+            merged.append((v, m))
+        return merged
+
+    def finish(outs):
+        flat = []
+        for v, m in outs:
+            flat.append(v)
+            if m is not None:
+                flat.append(m)
+        return outs, pack_in_kernel(flat)
+
+    if not with_carry:
+        def kernel(bufs, selection, num_rows):
+            outs, _n = inner(bufs, selection, num_rows)
+            return finish(outs)
+
+        return kernel
+
+    def kernel(bufs, selection, num_rows, carry):
+        outs, _n = inner(bufs, selection, num_rows)
+        return finish(merge(carry, outs))
+
+    return kernel
 
 
 class _IterChild(PhysicalOp):
@@ -632,72 +974,9 @@ class HostFinalAggExec(PhysicalOp):
         return out, valid
 
 
-def _agg_exprs_fusable(agg) -> bool:
-    child_schema = agg.children[0].schema
-    exprs = [e for e, _ in agg.keys] + [
-        a.child for a, _ in agg.aggs if a.child is not None
-    ]
-    for e in exprs:
-        if _expr_needs_host(e, child_schema):
-            return False
-        try:
-            if infer_dtype(e, child_schema).is_string_like:
-                return False
-        except Exception:
-            return False
-    return True
-
-
-def _collect_chain(op: PhysicalOp):
-    """Peel the maximal fusable stateless chain below `op`'s child."""
-    chain: List[PhysicalOp] = []
-    t = op
-    while (
-        isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
-        and len(t.children) == 1
-        and _stage_fusable(t)
-    ):
-        chain.append(t)
-        t = t.children[0]
-    return chain, t
-
-
 def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
-    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
-    folding PARTIAL aggregates into the chain below them, and rewriting
-    COMPLETE aggregates into device-PARTIAL + host-FINAL."""
-    from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
+    """The plan-level fusion pass - moved to planner/fuse.py (this
+    re-export keeps the historical entry point working)."""
+    from blaze_tpu.planner.fuse import fuse_pipelines as _pass
 
-    if (
-        isinstance(op, HashAggregateExec)
-        and len(op.children) == 1
-        and op.mode in (AggMode.PARTIAL, AggMode.COMPLETE)
-        and _agg_exprs_fusable(op)
-    ):
-        chain, leaf = _collect_chain(op.children[0])
-        if op.mode is AggMode.PARTIAL:
-            if chain:
-                pipeline = FusedPipelineExec(
-                    fuse_pipelines(leaf), list(reversed(chain))
-                )
-                return FusedAggregateExec(pipeline, op)
-            # no chain to fold - leave the plain streaming partial
-        else:  # COMPLETE -> fused device PARTIAL + host FINAL
-            pipeline = FusedPipelineExec(
-                fuse_pipelines(leaf), list(reversed(chain))
-            )
-            partial = HashAggregateExec(
-                pipeline,
-                keys=[(e, n) for e, n in op.keys],
-                aggs=[(a, n) for a, n in op.aggs],
-                mode=AggMode.PARTIAL,
-            )
-            return HostFinalAggExec(
-                FusedAggregateExec(pipeline, partial, fetch_host=True),
-                op,
-            )
-    chain, t = _collect_chain(op)
-    if len(chain) >= 2:
-        return FusedPipelineExec(fuse_pipelines(t), list(reversed(chain)))
-    op.children = [fuse_pipelines(c) for c in op.children]
-    return op
+    return _pass(op)

@@ -140,6 +140,8 @@ def concat_batches(batches: List[ColumnBatch],
     schema = batches[0].schema
     total = sum(b.num_rows for b in batches)
     cap = get_config().bucket_for(total)
+    if len(batches) == 1 and batches[0].capacity == cap:
+        return batches[0]  # already compact at the right bucket
     ncols = len(schema)
     any_mask = [
         any(b.columns[ci].validity is not None for b in batches)

@@ -106,6 +106,16 @@ class WindowExec(PhysicalOp):
                  functions: Sequence[WindowFn]):
         self.children = [child]
         schema = child.schema
+        # window-input schema: fixed here even when the fusion pass later
+        # rebases children[0] to the chain leaf (planner/fuse folds a
+        # Project/Rename chain into this operator's kernel)
+        self._in_schema = schema
+        self._fused_pipeline = None
+        # (partition, order)-spec sort permutations cached across
+        # executions keyed on input buffer identity - the window analog
+        # of the join build-index cache (joins._ensure_index): repeated
+        # queries over the same staged table skip the argsort entirely
+        self._sort_cache = {}
         self.partition_by = [bind_opt(e, schema) for e in partition_by]
         self.order_by = [
             SortKey(bind_opt(k.expr, schema), k.ascending, k.nulls_first)
@@ -206,17 +216,48 @@ class WindowExec(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
-        cb = concat_batches(
-            list(self.children[0].execute(partition, ctx)),
-            schema=self.children[0].schema,
-        )
-        if cb.num_rows == 0:
-            return
         keys = [
             SortKey(e, True, True) for e in self.partition_by
         ] + list(self.order_by)
+        pipe = self._fused_pipeline
+        if self._sort_fusable(keys):
+            # whole-task fusion: ONE kernel evaluates any folded stage
+            # chain, computes the shared argsort, gathers every column,
+            # and runs all frame passes - no materialized sorted
+            # intermediate, no per-column eager gather dispatches (the
+            # sort_batch/take_batch path), and every function shares the
+            # one (partition, order) argsort
+            src = self.children[0]  # the chain leaf when pipe is folded
+            cb = concat_batches(
+                list(src.execute(partition, ctx)), schema=src.schema,
+            )
+            if cb.num_rows == 0:
+                return
+            yield self._apply_fused(cb, keys, pipe)
+            return
+        if pipe is not None:
+            # host-tier sort keys: run the folded chain as a plain
+            # operator (children[0] may be an instrumented wrapper)
+            pipe.children = list(self.children)
+            src = pipe
+        else:
+            src = self.children[0]
+        cb = concat_batches(
+            list(src.execute(partition, ctx)), schema=self._in_schema,
+        )
+        if cb.num_rows == 0:
+            return
         cb = sort_batch(cb, keys)
         yield self._apply(cb)
+
+    def _sort_fusable(self, keys) -> bool:
+        """True when the sort needs no host tier: dictionary-encoded
+        (string) keys must remap codes to lexicographic ranks on the
+        host, so they keep the classic sort_batch path."""
+        for k in keys:
+            if infer_dtype(k.expr, self._in_schema).is_dictionary_encoded:
+                return False
+        return True
 
     # ------------------------------------------------------------------
     def _apply(self, cb: ColumnBatch) -> ColumnBatch:
@@ -232,14 +273,157 @@ class WindowExec(PhysicalOp):
         outs = fn(cb.device_buffers(), cb.num_rows)
         cols = list(cb.columns)
         for f, (v, m) in zip(self.functions, outs):
-            dt = self._fn_dtype(f, self.children[0].schema)
+            dt = self._fn_dtype(f, self._in_schema)
             cols.append(Column(dt, v, m, None))
         return ColumnBatch(self._schema, cols, cb.num_rows)
+
+    def _cached_sort_idx(self, bufs, num_rows):
+        """Device sort permutation cached on input-buffer identity (jax
+        arrays are immutable, so identical buffers imply an identical
+        permutation for this operator's fixed (partition, order) spec).
+        Returns the cached idx array or None."""
+        import weakref
+
+        key = (tuple(id(b) for b in bufs), num_rows)
+        hit = self._sort_cache.get(key)
+        if hit is None:
+            return None
+        refs, idx = hit
+        if all(r() is b for r, b in zip(refs, bufs)):
+            return idx
+        self._sort_cache.pop(key, None)
+        return None
+
+    def _store_sort_idx(self, bufs, num_rows, idx) -> None:
+        import weakref
+
+        try:
+            refs = tuple(weakref.ref(b) for b in bufs)
+        except TypeError:
+            return
+        key = (tuple(id(b) for b in bufs), num_rows)
+        self._sort_cache[key] = (refs, idx)
+        while len(self._sort_cache) > 2:  # tiny LRU: HBM is precious
+            self._sort_cache.pop(next(iter(self._sort_cache)))
+
+    def _apply_fused(self, cb: ColumnBatch, keys, pipe) -> ColumnBatch:
+        from blaze_tpu.config import get_config, resolve_core_choice
+        from blaze_tpu.runtime.dispatch import cached_kernel
+
+        # the in-kernel argsort reads the sort-core knob at trace time
+        core = resolve_core_choice(
+            "BLAZE_SORT_CORE", get_config().sort_core
+        )
+        layout = cb.layout()
+        bufs = cb.device_buffers()
+        base = ("window_fused",
+                pipe.structure_key() if pipe is not None else None,
+                tuple(self.partition_by),
+                tuple((k.expr, k.ascending, k.nulls_first)
+                      for k in self.order_by),
+                tuple((f.kind, f.source, f.offset, f.frame)
+                      for f in self.functions),
+                layout, core)
+        idx = self._cached_sort_idx(bufs, cb.num_rows)
+        if idx is None:
+            fn = cached_kernel(
+                base + ("sort",),
+                lambda: self._build_fused_kernel(
+                    layout, keys, pipe, with_idx=False
+                ),
+            )
+            idx, sorted_bufs, outs = fn(bufs, cb.num_rows)
+            self._store_sort_idx(bufs, cb.num_rows, idx)
+        else:
+            fn = cached_kernel(
+                base + ("reuse",),
+                lambda: self._build_fused_kernel(
+                    layout, keys, pipe, with_idx=True
+                ),
+            )
+            sorted_bufs, outs = fn(bufs, cb.num_rows, idx)
+        cols: List[Column] = []
+        it = iter(sorted_bufs)
+        if pipe is not None:
+            dicts = pipe._out_dictionaries(cb)
+            for field, d in zip(self._in_schema, dicts):
+                cols.append(Column(field.dtype, next(it), next(it), d))
+        else:
+            for c in cb.columns:
+                v = next(it)
+                m = next(it) if c.validity is not None else None
+                cols.append(Column(c.dtype, v, m, c.dictionary))
+        for f, (v, m) in zip(self.functions, outs):
+            dt = self._fn_dtype(f, self._in_schema)
+            cols.append(Column(dt, v, m, None))
+        return ColumnBatch(self._schema, cols, cb.num_rows)
+
+    def _fused_body(self, layout, keys, pipe):
+        """Traceable core shared by the fused-window kernel and the
+        window+aggregate whole-task fusion (ops/fused.
+        FusedWindowAggExec): [folded stage chain +] shared argsort +
+        gather + every frame pass. Returns `body(bufs, num_rows, idx)`
+        -> `(idx, mid_layout, sorted_bufs, outs)`; pass `idx=None` to
+        compute the sort in-kernel, or a cached permutation to skip
+        it."""
+        from blaze_tpu.ops.project import _unflatten_cvs
+        from blaze_tpu.ops.util import sort_indices
+
+        schema = self._in_schema
+        if pipe is not None:
+            pipe_kernel = pipe._build_kernel(layout)
+            mid_layout = (
+                layout[0],
+                tuple(
+                    (f.dtype.id.value, f.dtype.precision,
+                     f.dtype.scale, True)
+                    for f in schema
+                ),
+            )
+        else:
+            pipe_kernel = None
+            mid_layout = layout
+        inner = self._build_kernel(mid_layout)
+
+        def body(bufs, num_rows, idx):
+            if pipe_kernel is not None:
+                bufs, _sel = pipe_kernel(bufs, None)
+            cols = _unflatten_cvs(mid_layout, bufs)
+            cap = mid_layout[0]
+            ev = DeviceEvaluator(schema, cols, cap)
+            key_cols = []
+            for k in keys:
+                v, m = ev.evaluate(k.expr)
+                key_cols.append((v, m, k.ascending, k.nulls_first))
+            if idx is None:
+                idx = sort_indices(key_cols, num_rows, cap)
+            sorted_bufs = [jnp.take(b, idx, axis=0) for b in bufs]
+            return idx, sorted_bufs, inner(sorted_bufs, num_rows)
+
+        return body, mid_layout
+
+    def _build_fused_kernel(self, layout, keys, pipe, with_idx: bool):
+        """[folded stage chain +] argsort + gather + every window
+        function in one program. `with_idx` builds the permutation-reuse
+        variant (takes the cached idx instead of sorting)."""
+        body, _mid = self._fused_body(layout, keys, pipe)
+
+        if with_idx:
+            def kernel(bufs, num_rows, idx):
+                _, sorted_bufs, outs = body(bufs, num_rows, idx)
+                return sorted_bufs, outs
+
+            return kernel
+
+        def kernel(bufs, num_rows):
+            return body(bufs, num_rows, None)
+
+        return kernel
 
     def _build_kernel(self, layout):
         from blaze_tpu.ops.project import _unflatten_cvs
 
-        schema = self.children[0].schema
+        schema = self._in_schema
         part_exprs = self.partition_by
         order_exprs = [k.expr for k in self.order_by]
         order_keys = self.order_by
@@ -328,6 +512,15 @@ class WindowExec(PhysicalOp):
                 partition (None = unbounded); also used for counts.
                 Thin wrapper over agg_over so the span-sum logic lives
                 once."""
+                if lo is None and hi == 0:
+                    # running frame: the partition-reset prefix sums ARE
+                    # the per-row results - skip agg_over's span gathers
+                    # (take(S, pos) is an 8M-row gather XLA won't
+                    # simplify away)
+                    x = jnp.where(
+                        contrib, vals64, jnp.zeros_like(vals64)
+                    )
+                    return part_prefix(x)
                 lo_idx, hi_idx = rows_frame_idx(lo, hi)
                 return agg_over(vals64, contrib, lo_idx, hi_idx)
 

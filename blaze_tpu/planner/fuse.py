@@ -1,0 +1,195 @@
+"""Plan-level pipeline-fusion pass: maximal operator chains -> ONE
+device dispatch per task batch.
+
+The reference executes one native call per task (exec.rs:196-255) -
+DataFusion streams the whole operator chain inside it - so dispatch
+count, not operator count, is its per-query overhead model. This pass
+gives the engine the same shape at the XLA level (SURVEY 7): it walks a
+physical plan top-down and rewrites every maximal chain of
+row-count-compatible operators into a node whose entire chain traces
+into a single jitted, `dispatch.cached_kernel`-cached XLA executable:
+
+- stateless chains (Filter -> Project -> Rename, any length) become
+  `FusedPipelineExec` - one program evaluating every stage over the
+  deferred selection vector;
+- a PARTIAL hash aggregate folds into the chain below it
+  (`FusedAggregateExec`): stage evaluation + sort/scatter grouping +
+  segmented reduction in one program per input batch;
+- a COMPLETE aggregate rewrites into device-PARTIAL + host-FINAL
+  (`HostFinalAggExec`), and - keyless - into the streaming-carry form
+  whose per-batch kernel also merges the running state and packs it for
+  the single end-of-stream fetch (one dispatch per batch, zero extra
+  for the final merge);
+- an INNER hash join directly under a fused aggregate probes and
+  gathers the build side inside the same program
+  (`FusedAggregateExec._execute_join_fused`);
+- a Window over a Project/Rename chain folds the chain into its own
+  kernel (`WindowExec._fused_pipeline`): stages + the shared
+  (partition, order) argsort + gather + every frame pass in one
+  program, with the sort permutation cached across executions on
+  input-buffer identity.
+
+Batches still packed in the H2D wire buffer (batch.PackedColumnBatch)
+feed fused kernels WITHOUT the separate unpack dispatch: the buffer
+splitter traces into the consuming kernel, so a parquet-scan chunk costs
+exactly one dispatch end to end.
+
+Execution nodes live in ops/fused.py; this module owns the rewrite
+rules. `ops.fused.fuse_pipelines` re-exports the pass for callers that
+predate the split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from blaze_tpu.ops.base import PhysicalOp
+from blaze_tpu.ops.filter import FilterExec
+from blaze_tpu.ops.project import ProjectExec
+from blaze_tpu.ops.rename import RenameColumnsExec
+
+
+def _stage_fusable(op: PhysicalOp) -> bool:
+    from blaze_tpu.ops.fused import _expr_needs_host
+
+    if isinstance(op, RenameColumnsExec):
+        return True
+    if isinstance(op, FilterExec):
+        return not _expr_needs_host(op.predicate, op.children[0].schema)
+    if isinstance(op, ProjectExec):
+        child_schema = op.children[0].schema
+        return not any(
+            _expr_needs_host(e, child_schema) for e, _ in op.exprs
+        )
+    return False
+
+
+def _agg_exprs_fusable(agg) -> bool:
+    from blaze_tpu.exprs.typing import infer_dtype
+    from blaze_tpu.ops.fused import _expr_needs_host
+
+    child_schema = agg.children[0].schema
+    exprs = [e for e, _ in agg.keys] + [
+        a.child for a, _ in agg.aggs if a.child is not None
+    ]
+    for e in exprs:
+        if _expr_needs_host(e, child_schema):
+            return False
+        try:
+            if infer_dtype(e, child_schema).is_string_like:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _collect_chain(op: PhysicalOp, allow_filter: bool = True
+                   ) -> Tuple[List[PhysicalOp], PhysicalOp]:
+    """Peel the maximal fusable stateless chain below `op`'s child.
+    `allow_filter=False` restricts to row-count-preserving stages
+    (Project/Rename) - what a Window fold can absorb, since its
+    in-kernel argsort sees every input row."""
+    chain: List[PhysicalOp] = []
+    t = op
+    while (
+        isinstance(t, (FilterExec, ProjectExec, RenameColumnsExec))
+        and len(t.children) == 1
+        and (allow_filter or not isinstance(t, FilterExec))
+        and _stage_fusable(t)
+    ):
+        chain.append(t)
+        t = t.children[0]
+    return chain, t
+
+
+def _window_agg_fusable(win) -> bool:
+    """A window qualifies for whole-task window+aggregate fusion when
+    its sort runs fully on device (no dictionary-key host remap)."""
+    from blaze_tpu.ops.sort import SortKey
+
+    keys = [
+        SortKey(e, True, True) for e in win.partition_by
+    ] + list(win.order_by)
+    return win._sort_fusable(keys)
+
+
+def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
+    """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
+    folding PARTIAL aggregates into the chain below them, rewriting
+    COMPLETE aggregates into device-PARTIAL + host-FINAL, and folding
+    Project/Rename chains into Window kernels."""
+    from blaze_tpu.ops.fused import (
+        FusedAggregateExec,
+        FusedPipelineExec,
+        FusedWindowAggExec,
+        HostFinalAggExec,
+    )
+    from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
+    from blaze_tpu.ops.window import WindowExec
+
+    if (
+        isinstance(op, HashAggregateExec)
+        and len(op.children) == 1
+        and op.mode in (AggMode.PARTIAL, AggMode.COMPLETE)
+        and _agg_exprs_fusable(op)
+    ):
+        chain, leaf = _collect_chain(op.children[0])
+        if op.mode is AggMode.PARTIAL:
+            if chain:
+                pipeline = FusedPipelineExec(
+                    fuse_pipelines(leaf), list(reversed(chain))
+                )
+                return FusedAggregateExec(pipeline, op)
+            # no chain to fold - leave the plain streaming partial
+        else:  # COMPLETE -> fused device PARTIAL + host FINAL
+            if not chain and not op.keys and isinstance(leaf, WindowExec):
+                # keyless rollup directly over a window: fold the whole
+                # task - window chain + argsort + frames + aggregate -
+                # into ONE kernel (FusedWindowAggExec); XLA dead-codes
+                # sorted columns the aggregate never reads
+                win = fuse_pipelines(leaf)
+                if isinstance(win, WindowExec) and _window_agg_fusable(
+                    win
+                ):
+                    partial = HashAggregateExec(
+                        win,
+                        keys=[],
+                        aggs=[(a, n) for a, n in op.aggs],
+                        mode=AggMode.PARTIAL,
+                    )
+                    return HostFinalAggExec(
+                        FusedWindowAggExec(win, partial), op
+                    )
+                leaf = win
+            pipeline = FusedPipelineExec(
+                fuse_pipelines(leaf), list(reversed(chain))
+            )
+            partial = HashAggregateExec(
+                pipeline,
+                keys=[(e, n) for e, n in op.keys],
+                aggs=[(a, n) for a, n in op.aggs],
+                mode=AggMode.PARTIAL,
+            )
+            return HostFinalAggExec(
+                FusedAggregateExec(pipeline, partial, fetch_host=True),
+                op,
+            )
+    if (
+        isinstance(op, WindowExec)
+        and op._fused_pipeline is None
+    ):
+        chain, leaf = _collect_chain(
+            op.children[0], allow_filter=False
+        )
+        if chain:
+            leaf = fuse_pipelines(leaf)
+            op.children = [leaf]
+            op._fused_pipeline = FusedPipelineExec(
+                leaf, list(reversed(chain))
+            )
+            return op
+    chain, t = _collect_chain(op)
+    if len(chain) >= 2:
+        return FusedPipelineExec(fuse_pipelines(t), list(reversed(chain)))
+    op.children = [fuse_pipelines(c) for c in op.children]
+    return op

@@ -104,6 +104,9 @@ def cached_kernel(key: Tuple, build: Callable[[], Callable],
         fn = _KERNELS.get(key)
         if fn is not None:
             _KERNELS.move_to_end(key)
+            # cache-hit accounting (vs kernel_builds): a steady-state
+            # query stream should be all hits - tests pin this
+            _counts["kernel_hits"] = _counts.get("kernel_hits", 0) + 1
     if fn is None:
         with _lock:
             fn = _KERNELS.get(key)

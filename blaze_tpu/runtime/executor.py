@@ -137,6 +137,10 @@ def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
             "executing task %s partition %d:\n%s",
             ctx.task_id, partition, op.display(),
         )
+    from blaze_tpu.runtime import dispatch
+
+    counter = dispatch.counting()
+    counter.__enter__()
     try:
         for cb in op.execute(partition, ctx):
             cb = ensure_compacted(cb)
@@ -153,6 +157,14 @@ def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
         raise
     except Exception as e:
         raise TaskExecutionError(ctx.task_id, partition, e) from e
+    finally:
+        # per-task dispatch/transfer/kernel-cache accounting in the
+        # metric tree (delta of the process-global counters, so
+        # concurrent tasks in other threads land here too - same
+        # caveat as dispatch.counting itself)
+        counter.__exit__(None, None, None)
+        for k, v in counter.counts.items():
+            ctx.metrics.add("dispatch." + k, v)
 
 
 def run_plan(op: PhysicalOp, ctx: Optional[ExecContext] = None

@@ -84,8 +84,18 @@ def exclusive_elapsed(node: MetricNode) -> int:
 def render_metrics(root: MetricNode, indent: str = "") -> str:
     """Spark-UI-style rendering of the mirrored metric tree: one line
     per operator with rows/batches and inclusive + EXCLUSIVE time
-    (reference counterpart: the SQLMetric panel fed by metrics.rs)."""
+    (reference counterpart: the SQLMetric panel fed by metrics.rs).
+    Root-level counters - per-task dispatch/transfer/kernel-cache
+    accounting recorded by the executor (`dispatch.*`: dispatches,
+    h2d_batches, d2h_fetches, kernel_builds vs kernel_hits) - render
+    first: dispatch count IS the perf model (runtime/dispatch.py), so
+    it belongs in the same report as operator times."""
     lines = []
+    if root.counters:
+        stats = ", ".join(
+            f"{k}={v}" for k, v in sorted(root.counters.items())
+        )
+        lines.append(f"[task: {stats}]")
 
     def walk(node: MetricNode, depth: int) -> None:
         c = node.counters

@@ -111,10 +111,31 @@ def _as(ptr_type, arr: np.ndarray):
 # zstd with Python fallback
 # ---------------------------------------------------------------------------
 
+# Last-resort frame marker when NO zstd implementation exists in the
+# environment (neither the C++ host lib nor the `zstandard` module -
+# toolchain-less containers). Frames start with these 8 bytes followed by
+# the raw payload; a real zstd frame starts with magic 28 B5 2F FD, so
+# the two can never be confused. Wire bit-compat with the reference is
+# only claimed when a zstd tier exists - this keeps the shuffle/cluster
+# machinery functional (self-consistent) instead of crashing.
+_RAW_FRAME_MAGIC = b"BLZRAW\x00\x01"
+
+
+def _py_zstd():
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
 def zstd_compress(data: bytes, level: int = 1) -> bytes:
     lib = get_lib()
     if lib is None:
-        import zstandard
+        zstandard = _py_zstd()
+        if zstandard is None:
+            return _RAW_FRAME_MAGIC + data
 
         return zstandard.ZstdCompressor(level=level).compress(data)
     src = np.frombuffer(data, dtype=np.uint8)
@@ -130,9 +151,18 @@ def zstd_compress(data: bytes, level: int = 1) -> bytes:
 
 
 def zstd_decompress(data: bytes, hint: Optional[int] = None) -> bytes:
+    if data[:8] == _RAW_FRAME_MAGIC:
+        # raw fallback frame (zstd-less writer); readable regardless of
+        # which zstd tier THIS process has
+        return data[8:]
     lib = get_lib()
     if lib is None:
-        import zstandard
+        zstandard = _py_zstd()
+        if zstandard is None:
+            raise IOError(
+                "zstd frame received but no zstd implementation is "
+                "available (install zstandard or the C++ host lib)"
+            )
 
         return zstandard.ZstdDecompressor().decompressobj().decompress(data)
     src = np.frombuffer(data, dtype=np.uint8)

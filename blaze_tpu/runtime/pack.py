@@ -198,8 +198,29 @@ def put_packed_padded(entries: Sequence[Tuple[np.ndarray, int, int]]
     `np.zeros(cap); padded[:n] = vals` per column) with the transfer
     packing copy - the padded column is written directly into its
     aligned segment of the single wire buffer."""
-    if not entries:
+    dev, metas, pairs = put_packed_padded_lazy(entries)
+    if dev is None:
         return []
+    fn = cached_kernel(
+        ("h2d_unpack_at", metas, pairs),
+        lambda: _build_unpack_at(metas, pairs),
+    )
+    return list(fn(dev))
+
+
+def put_packed_padded_lazy(
+    entries: Sequence[Tuple[np.ndarray, int, int]]
+) -> Tuple[Optional[jax.Array], Tuple, bool]:
+    """Pad + pack + transfer WITHOUT the unpack dispatch.
+
+    Returns `(device_u8_buffer, metas, f64_pairs)`; the caller either
+    splits the buffer later with `unpack_kernel(metas, pairs)` (one
+    dispatch, the classic path) or - the pipeline-fusion fast path -
+    composes `build_unpack_at(metas, pairs)` into its OWN jitted kernel
+    so transfer-unpacking and the consuming operator chain cost a single
+    dispatch total (batch.PackedColumnBatch owns that deferral)."""
+    if not entries:
+        return None, (), _f64_pairs()
     pairs = _f64_pairs()
     norm = []
     for vals, cap, fill in entries:
@@ -233,11 +254,23 @@ def put_packed_padded(entries: Sequence[Tuple[np.ndarray, int, int]]
             view[n:] = fill
     record("h2d_batches")
     dev = jax.device_put(buf)
-    fn = cached_kernel(
+    return dev, metas, pairs
+
+
+def unpack_kernel(metas, pairs: bool):
+    """The cached one-dispatch splitter for a lazily packed buffer (same
+    cache key as the classic put_packed_padded path, so both share one
+    compiled executable per layout)."""
+    return cached_kernel(
         ("h2d_unpack_at", metas, pairs),
         lambda: _build_unpack_at(metas, pairs),
     )
-    return list(fn(dev))
+
+
+def build_unpack_at(metas, pairs: bool):
+    """Traceable u8-buffer splitter for composing into a larger jitted
+    kernel (pipeline fusion: unpack + operator chain = one program)."""
+    return _build_unpack_at(metas, pairs)
 
 
 def get_packed(arrays: Sequence[object],
@@ -282,3 +315,41 @@ def get_packed(arrays: Sequence[object],
         out[i] = vals.reshape(shape)
         off += nb
     return out  # type: ignore[return-value]
+
+
+def pack_in_kernel(arrays: Sequence[jax.Array]) -> jax.Array:
+    """Traceable packer: concatenate typed device arrays into one uint8
+    buffer INSIDE an enclosing jitted kernel (f64 travels as exact
+    double-single pairs off-CPU, mirroring `_build_pack`). Pair with
+    `unpack_host` so a kernel's small auxiliary outputs (streaming
+    aggregate carry states) reach the host in one fetch with no extra
+    pack dispatch."""
+    return _build_pack(None, _f64_pairs())(list(arrays))
+
+
+def unpack_host(host_u8: np.ndarray,
+                specs: Sequence[Tuple[str, Tuple[int, ...]]]
+                ) -> List[np.ndarray]:
+    """Split a host copy of a `pack_in_kernel` buffer back into typed
+    arrays per `(dtype_str, shape)` specs (the wire format of
+    `_build_pack`: contiguous, unaligned, bool as u8, f64 as f32 pairs
+    off-CPU)."""
+    pairs = _f64_pairs()
+    out: List[np.ndarray] = []
+    off = 0
+    for dt_s, shape in specs:
+        dt = np.dtype(dt_s)
+        n = int(np.prod(shape)) if shape else 1
+        nb = n * (1 if dt == np.bool_ else dt.itemsize)
+        if pairs and dt == np.float64:
+            nb = n * 8  # two f32 per element
+        seg = host_u8[off: off + nb]
+        if dt == np.bool_:
+            vals = seg.view(np.uint8).astype(np.bool_)
+        elif pairs and dt == np.float64:
+            vals = _pair_bytes_to_f64(seg, n)
+        else:
+            vals = seg.view(dt)
+        out.append(vals.reshape(shape))
+        off += nb
+    return out

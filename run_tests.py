@@ -156,6 +156,23 @@ def main():
     ok = True
     t0 = time.time()
 
+    # bench smoke first: a broken bench must fail at commit time, not
+    # silently at round end (ISSUE 1 satellite; <= 60s at small rows)
+    ts = time.time()
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=360,
+    )
+    smoke_ok = p.returncode == 0
+    tail = p.stdout.strip().splitlines()
+    print(f"[{'OK ' if smoke_ok else 'FAIL'}] bench smoke "
+          f"({time.time() - ts:.0f}s) :: "
+          f"{tail[-1][:160] if tail else '(no output)'}", flush=True)
+    if not smoke_ok:
+        print("\n".join(tail[-20:]))
+    ok &= smoke_ok
+
     ok &= run(
         "core suite",
         ["tests/",
