@@ -961,6 +961,100 @@ def child(n_rows):
     except Exception:  # noqa: BLE001
         e2e_counts = {}
 
+    # ---- serving tier: queries/sec through the gateway service at
+    # concurrency 1/4/16, with and without the plan-fingerprint result
+    # cache (ISSUE 2 satellite). Same {median, spread, k} form as the
+    # battery; qps derives from the median round time. A small
+    # dedicated table keeps a single query cheap so the shape measures
+    # SERVING overhead (admission, wire, cache), not kernel time. ----
+    try:
+        import threading
+
+        from blaze_tpu.runtime.gateway import TaskGatewayServer
+        from blaze_tpu.service import QueryService, ServiceClient
+
+        n_svc = min(n_rows, 1 << 16)
+        svc_path = "/tmp/blaze_bench_service.parquet"
+        pq.write_table(
+            pa.table({"item": item_sk[:n_svc], "qty": qty[:n_svc],
+                      "price": price[:n_svc]}),
+            svc_path, compression="zstd",
+        )
+        svc_blob = task_to_proto(
+            q6_plan(ParquetScanExec([[FileRange(svc_path)]])), 0
+        )
+        per_client = 4
+
+        def service_round(host, port, conc):
+            errs = []
+
+            def client():
+                try:
+                    with ServiceClient(host, port) as cl:
+                        for _ in range(per_client):
+                            cl.run(svc_blob)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ts = [threading.Thread(target=client)
+                  for _ in range(conc)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise RuntimeError(errs[0])
+
+        for cache_on in (True, False):
+            svc = QueryService(
+                max_concurrency=16, enable_cache=cache_on
+            )
+            try:
+                with TaskGatewayServer(service=svc) as srv:
+                    host, port = srv.address
+                    for conc in (1, 4, 16):
+                        name = (
+                            f"service_qps_c{conc}_"
+                            f"{'cache' if cache_on else 'nocache'}"
+                        )
+                        try:
+                            med, spread, k, _ = timed(
+                                lambda: service_round(
+                                    host, port, conc
+                                ),
+                                iters=3,
+                            )
+                            detail[name] = {
+                                "median": round(med, 4),
+                                "spread": round(spread, 3),
+                                "k": k,
+                                "qps": round(
+                                    conc * per_client / med, 1
+                                ),
+                                "concurrency": conc,
+                                "result_cache": cache_on,
+                                "rows_per_query": n_svc,
+                            }
+                        except Exception as e:  # noqa: BLE001
+                            detail[name] = {
+                                "error":
+                                f"{type(e).__name__}: {e}"[:300]
+                            }
+                        print(
+                            "PARTIAL " + json.dumps(
+                                {"query": name,
+                                 "backend": backend,
+                                 **detail[name]}
+                            ),
+                            flush=True,
+                        )
+            finally:
+                svc.close()
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["service_qps"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     geomean = (
         math.exp(sum(math.log(r) for r in ratios) / len(ratios))
         if ratios else 0.0

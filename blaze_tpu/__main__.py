@@ -5,6 +5,10 @@
                   reference callNative)
   query SQL-ish   tiny demo runner: scan a parquet file with filter/limit
   info            engine / device / native-runtime status
+  gateway         legacy one-shot task gateway (one task per connection)
+  serve           multi-query serving tier: the gateway listener with a
+                  QueryService attached (admission control, priorities,
+                  deadlines, cancellation, plan-fingerprint result cache)
 """
 
 from __future__ import annotations
@@ -82,6 +86,29 @@ def cmd_gateway(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    from blaze_tpu.runtime.gateway import serve_forever
+    from blaze_tpu.service import QueryService, ResultCache
+
+    cache = None
+    if not args.no_cache:
+        cache = ResultCache(
+            max_bytes=args.cache_bytes, ttl_s=args.cache_ttl
+        )
+    service = QueryService(
+        max_concurrency=args.max_concurrency,
+        max_queue_depth=args.max_queue_depth,
+        cache=cache,
+        enable_cache=not args.no_cache,
+        default_deadline_s=args.deadline or None,
+    )
+    try:
+        serve_forever(args.host, args.port, service=service)
+    finally:
+        service.close()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="blaze_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -98,12 +125,24 @@ def main(argv=None) -> int:
     gw = sub.add_parser("gateway")
     gw.add_argument("--host", default="127.0.0.1")
     gw.add_argument("--port", type=int, default=8484)
+    sv = sub.add_parser("serve")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8484)
+    sv.add_argument("--max-concurrency", type=int, default=2)
+    sv.add_argument("--max-queue-depth", type=int, default=64)
+    sv.add_argument("--deadline", type=float, default=0.0,
+                    help="default per-query deadline seconds (0 = none)")
+    sv.add_argument("--no-cache", action="store_true",
+                    help="disable the plan-fingerprint result cache")
+    sv.add_argument("--cache-bytes", type=int, default=256 << 20)
+    sv.add_argument("--cache-ttl", type=float, default=300.0)
     args = p.parse_args(argv)
     return {
         "info": cmd_info,
         "run-task": cmd_run_task,
         "scan": cmd_scan,
         "gateway": cmd_gateway,
+        "serve": cmd_serve,
     }[args.cmd](args)
 
 

@@ -93,10 +93,44 @@ class PhysicalOp:
 
     # ------------------------------------------------------------------
     def fingerprint(self) -> str:
-        """Stable id for jit-cache keying; subclasses append params."""
-        parts = [type(self).__name__]
-        parts += [c.fingerprint() for c in self.children]
-        return f"{'/'.join(parts)}@{id(self):x}"
+        """Content-addressed plan identity: operator name + parameter
+        digest + children, recursively. Two independently-built (or
+        independently-decoded) plans that compute the same thing get the
+        SAME fingerprint, which is what keys the serving tier's result
+        cache (service/cache.py) and jit-cache lookups.
+
+        Ops that cannot prove stable identity (in-memory scans over
+        arbitrary buffers, resource-registry readers) keep the default
+        `@id` param digest, valid only for THIS plan object; stability
+        is reported out-of-band by `fingerprint_is_stable` (a class
+        flag, not a content inspection - parameter digests may contain
+        any characters), so result reuse across submissions is refused
+        rather than silently wrong."""
+        me = f"{type(self).__name__}({self._fingerprint_params()})"
+        if not self.children:
+            return me
+        kids = ",".join(c.fingerprint() for c in self.children)
+        return f"{me}[{kids}]"
+
+    # set True by subclasses whose _fingerprint_params covers EVERY
+    # execution-relevant parameter (content identity, not object
+    # identity)
+    _FINGERPRINT_STABLE = False
+
+    def _fingerprint_params(self) -> str:
+        """Parameter digest for fingerprint(). Subclasses with full
+        parameter coverage return a deterministic content string and
+        set _FINGERPRINT_STABLE; the default is object identity."""
+        return f"@{id(self):x}"
+
+    def fingerprint_is_stable(self) -> bool:
+        """True iff the fingerprint survives re-building the plan:
+        every op in the tree declares content-complete parameter
+        coverage. Only stable fingerprints may key results shared
+        across query submissions (the serving tier's result cache)."""
+        return self._FINGERPRINT_STABLE and all(
+            c.fingerprint_is_stable() for c in self.children
+        )
 
     def timed(self, metrics: MetricNode, it: Iterator[ColumnBatch]
               ) -> Iterator[ColumnBatch]:

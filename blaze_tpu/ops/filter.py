@@ -40,6 +40,11 @@ class FilterExec(PhysicalOp):
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return repr(self.predicate)
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         for cb in self.children[0].execute(partition, ctx):

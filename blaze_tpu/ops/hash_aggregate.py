@@ -448,6 +448,13 @@ class HashAggregateExec(PhysicalOp):
     def schema(self) -> Schema:
         return self._schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        keys = ";".join(f"{n}={e!r}" for e, n in self.keys)
+        aggs = ";".join(f"{n}={a!r}" for a, n in self.aggs)
+        return f"{self.mode.name};keys[{keys}];aggs[{aggs}]"
+
     # ------------------------------------------------------------------
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:

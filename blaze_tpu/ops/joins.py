@@ -876,6 +876,12 @@ class HashJoinExec(PhysicalOp):
         self._epi_matched = None
         self._epi_parts: set = set()
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return (f"{self.join_type.name};l={self.left_keys};"
+                f"r={self.right_keys}")
+
     @property
     def schema(self) -> Schema:
         return self._schema
@@ -997,6 +1003,12 @@ class SortMergeJoinExec(PhysicalOp):
         self.right_keys = [right.schema.index_of(k) for k in right_keys]
         self.join_type = join_type
         self._schema = _joined_schema(left.schema, right.schema, join_type)
+
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return (f"{self.join_type.name};l={self.left_keys};"
+                f"r={self.right_keys}")
 
     @property
     def schema(self) -> Schema:

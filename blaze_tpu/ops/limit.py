@@ -19,6 +19,11 @@ class LimitExec(PhysicalOp):
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return str(self.limit)
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         remaining = self.limit

@@ -91,6 +91,20 @@ class ParquetScanExec(PhysicalOp):
     def partition_count(self) -> int:
         return len(self.file_groups)
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        # content identity = file ranges + projection + pruning
+        # predicate. File CONTENT changes under the same path are not
+        # captured - the serving tier's result cache covers that with
+        # TTL + explicit invalidation (docs/SERVICE.md)
+        groups = "|".join(
+            ",".join(f"{fr.path}:{fr.start}:{fr.length}" for fr in g)
+            for g in self.file_groups
+        )
+        proj = ",".join(self.projection) if self.projection else "*"
+        return f"{groups};proj={proj};prune={self.pruning_predicate!r}"
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         import pyarrow.parquet as pq

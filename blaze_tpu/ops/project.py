@@ -47,6 +47,11 @@ class ProjectExec(PhysicalOp):
     def schema(self) -> Schema:
         return self._schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return ";".join(f"{n}={e!r}" for e, n in self.exprs)
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         child = self.children[0]

@@ -21,6 +21,11 @@ class RenameColumnsExec(PhysicalOp):
     def schema(self) -> Schema:
         return self._schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return ";".join(self.names)
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         for b in self.children[0].execute(partition, ctx):

@@ -55,6 +55,11 @@ class SortExec(PhysicalOp):
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return f"{self.keys!r};fetch={self.fetch}"
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         from blaze_tpu.ops.external import collect_until

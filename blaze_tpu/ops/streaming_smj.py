@@ -111,6 +111,12 @@ class StreamingSortMergeJoinExec(PhysicalOp):
         self.join_type = join_type
         self._schema = _joined_schema(left.schema, right.schema, join_type)
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return (f"{self.join_type.name};l={self.left_keys};"
+                f"r={self.right_keys}")
+
     @property
     def schema(self) -> Schema:
         return self._schema

@@ -24,6 +24,11 @@ class UnionExec(PhysicalOp):
     def partition_count(self) -> int:
         return sum(c.partition_count for c in self.children)
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return ""
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         for child in self.children:
@@ -55,6 +60,11 @@ class CoalescePartitionsExec(PhysicalOp):
     @property
     def partition_count(self) -> int:
         return 1
+
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return ""
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:

@@ -214,6 +214,12 @@ class WindowExec(PhysicalOp):
     def schema(self) -> Schema:
         return self._schema
 
+    _FINGERPRINT_STABLE = True
+
+    def _fingerprint_params(self) -> str:
+        return (f"p={self.partition_by!r};o={self.order_by!r};"
+                f"f={self.functions!r}")
+
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         keys = [

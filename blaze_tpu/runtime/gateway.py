@@ -15,6 +15,10 @@ Framing:
             header low 62 bits = blob_len; bit 63 set = the blob is in
             the REFERENCE wire format (plan/refcompat.py decodes it -
             the reference's own plan.proto:508-513 TaskDefinition);
+            bit 61 set = the connection speaks the multi-query
+            SERVICE protocol (service/wire.py verbs: submit / poll /
+            fetch-stream / cancel over one connection) - requires a
+            QueryService attached (`python -m blaze_tpu serve`);
             bit 62 set = a resource manifest precedes the blob:
             u32-LE json_len | JSON {resource_id: [[source...] per
             partition]}, source = {"file": p, "offset": o, "length": l}
@@ -31,17 +35,24 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import socketserver
 import struct
 import threading
 
 from blaze_tpu.runtime.transport import _recv_exact
 
+log = logging.getLogger("blaze_tpu.gateway")
+
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
 _ERR = 0xFFFFFFFFFFFFFFFF
 _FLAG_REF = 1 << 63
 _FLAG_MANIFEST = 1 << 62
+# connection-mode switch: a first header with this bit set speaks the
+# multi-query service protocol (service/wire.py) instead of the legacy
+# one-shot task exchange
+_FLAG_SERVICE = 1 << 61
 MAX_TASK_BYTES = 64 << 20
 
 
@@ -73,9 +84,27 @@ class _Handler(socketserver.BaseRequestHandler):
         sock = self.request
         try:
             (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
+            if header & _FLAG_SERVICE:
+                # multi-query service connection (service/wire.py);
+                # requires a QueryService attached to the server
+                service = getattr(self.server, "service", None)
+                if service is None:
+                    msg = b"no query service attached"
+                    sock.sendall(
+                        _U64.pack(_ERR) + _U32.pack(len(msg)) + msg
+                    )
+                    return
+                from blaze_tpu.service.wire import (
+                    handle_service_connection,
+                )
+
+                handle_service_connection(sock, service)
+                return
             is_ref = bool(header & _FLAG_REF)
             has_manifest = bool(header & _FLAG_MANIFEST)
-            blob_len = header & ~(_FLAG_REF | _FLAG_MANIFEST)
+            blob_len = header & ~(
+                _FLAG_REF | _FLAG_MANIFEST | _FLAG_SERVICE
+            )
             if blob_len > MAX_TASK_BYTES:
                 raise ValueError("task too large")
             manifest_raw = None
@@ -87,6 +116,7 @@ class _Handler(socketserver.BaseRequestHandler):
             blob = _recv_exact(sock, blob_len)
         except Exception:
             return
+        batches = None
         try:
             # manifest SEMANTIC failures (bad JSON, missing keys) get
             # the documented error frame - only framing failures above
@@ -105,9 +135,26 @@ class _Handler(socketserver.BaseRequestHandler):
                 batches = execute_reference_task(blob, ctx=ctx)
             else:
                 batches = execute_task(blob, ctx=ctx)
-            for rb in batches:
+            it = iter(batches)
+            while True:
+                rb = next(it, None)  # execution errors surface here
+                if rb is None:
+                    break
                 part = encode_ipc_segment(rb)
-                sock.sendall(part)  # already u64-LE length-prefixed
+                try:
+                    sock.sendall(part)  # already u64-LE length-prefixed
+                except OSError:
+                    # client hung up mid-stream: this is a CANCELLATION,
+                    # not an execution failure (the executor's
+                    # GeneratorExit pass-through, executor.py) - close
+                    # the task generator so operators unwind cleanly
+                    # and keep the engine unpoisoned; no error frame,
+                    # no task-failure logging
+                    it.close()
+                    log.info(
+                        "client disconnected mid-stream; task cancelled"
+                    )
+                    return
             sock.sendall(_U64.pack(0))
         except Exception as e:
             msg = str(e).encode("utf-8")[:65536]
@@ -115,6 +162,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 sock.sendall(_U64.pack(_ERR) + _U32.pack(len(msg)) + msg)
             except OSError:
                 pass
+        finally:
+            if batches is not None:
+                close = getattr(batches, "close", None)
+                if close is not None:
+                    close()
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -122,11 +174,16 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class TaskGatewayServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 service=None):
         self._srv = _Server(
             (host, port), _Handler, bind_and_activate=True
         )
         self._srv.daemon_threads = True
+        # optional QueryService: enables service-protocol connections
+        # (_FLAG_SERVICE) on the same listener
+        self._srv.service = service
+        self.service = service
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True
         )
@@ -150,8 +207,8 @@ class TaskGatewayServer:
         self.stop()
 
 
-def serve_forever(host: str = "127.0.0.1",
-                  port: int = 8484) -> None:  # pragma: no cover - CLI
-    srv = TaskGatewayServer(host, port)
+def serve_forever(host: str = "127.0.0.1", port: int = 8484,
+                  service=None) -> None:  # pragma: no cover - CLI
+    srv = TaskGatewayServer(host, port, service=service)
     print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
     srv._srv.serve_forever()

@@ -5,12 +5,21 @@ reference's TPC-DS CI exercises its whole distributed path with local-mode
 Spark (SURVEY 4): partitions run as tasks on a thread pool (device
 dispatch is async so threads overlap host decode/IPC work with device
 compute), failed tasks retry like Spark's task retry (SURVEY 5.3), results
-stream back in partition order."""
+stream back in partition order.
+
+Failure semantics: the FIRST task to exhaust its retries fails the plan
+immediately - outstanding sibling tasks are cancelled (queued ones never
+start; running ones observe the cancel event at their next batch
+boundary and unwind through the executor's GeneratorExit cancellation
+pass-through, runtime/executor.py), instead of running to completion
+against a plan that already failed.
+"""
 
 from __future__ import annotations
 
 import concurrent.futures as cf
 import logging
+import threading
 from typing import List, Optional
 
 import pyarrow as pa
@@ -21,20 +30,52 @@ from blaze_tpu.runtime.executor import TaskExecutionError, execute_partition
 log = logging.getLogger("blaze_tpu.scheduler")
 
 
+class PlanCancelled(RuntimeError):
+    """A sibling task failed (or the caller cancelled the plan); this
+    partition's work was abandoned cooperatively."""
+
+
 def run_plan_parallel(
     op: PhysicalOp,
     ctx: Optional[ExecContext] = None,
     parallelism: int = 4,
     max_attempts: int = 3,
+    cancel: Optional[threading.Event] = None,
 ) -> pa.Table:
-    """Execute every partition on a thread pool and collect one table."""
+    """Execute every partition on a thread pool and collect one table.
+
+    `cancel` lets an embedder (the serving tier) abort the whole plan
+    cooperatively. Fail-fast uses a separate INTERNAL event so a task
+    failure never mutates the caller's (possibly shared) event."""
     ctx = ctx or ExecContext()
+    abort = threading.Event()  # internal: first-failure fail-fast
+
+    def cancelled() -> bool:
+        return abort.is_set() or (
+            cancel is not None and cancel.is_set()
+        )
 
     def task(p: int) -> List[pa.RecordBatch]:
         last: Optional[BaseException] = None
         for attempt in range(max_attempts):
+            if cancelled():
+                raise PlanCancelled(f"partition {p} cancelled")
+            it = execute_partition(op, p, ctx)
+            out: List[pa.RecordBatch] = []
             try:
-                return list(execute_partition(op, p, ctx))
+                for rb in it:
+                    out.append(rb)
+                    if cancelled():
+                        # the executor's cancellation pass-through:
+                        # close -> GeneratorExit unwinds the operator
+                        # tree without poisoning the engine
+                        it.close()
+                        raise PlanCancelled(
+                            f"partition {p} cancelled mid-stream"
+                        )
+                return out
+            except PlanCancelled:
+                raise
             except TaskExecutionError as e:
                 last = e
                 ctx.metrics.add("task_retries", 1)
@@ -42,18 +83,36 @@ def run_plan_parallel(
                     "task for partition %d failed (attempt %d): %s",
                     p, attempt + 1, e,
                 )
+            finally:
+                it.close()
         raise last  # type: ignore[misc]
 
     n = op.partition_count
     results: List[List[pa.RecordBatch]] = [[] for _ in range(n)]
     from blaze_tpu.runtime.dispatch import task_threads
 
+    first_error: Optional[BaseException] = None
     with cf.ThreadPoolExecutor(
         max_workers=task_threads(n, cap=max(1, parallelism))
     ) as pool:
         futs = {pool.submit(task, p): p for p in range(n)}
         for fut in cf.as_completed(futs):
-            results[futs[fut]] = fut.result()
+            try:
+                results[futs[fut]] = fut.result()
+            except PlanCancelled:
+                continue  # secondary casualty of the first failure
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                if first_error is None:
+                    first_error = e
+                    # fail fast: queued siblings never start, running
+                    # ones observe the event at the next batch
+                    abort.set()
+                    for f in futs:
+                        f.cancel()
+    if first_error is not None:
+        raise first_error
+    if cancel is not None and cancel.is_set():
+        raise PlanCancelled("plan cancelled by caller")
     batches = [rb for part in results for rb in part]
     if not batches:
         from blaze_tpu.types import to_arrow_schema

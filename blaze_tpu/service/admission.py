@@ -1,0 +1,172 @@
+"""Admission control: who runs next, and whether they fit.
+
+The reference engine never needed this - Spark's scheduler + the
+executor's task slots gate concurrency, and MemoryManagerConfig gates
+bytes (exec.rs:79-94). A standalone serving tier must grow both knobs:
+
+  * concurrency: at most `max_concurrency` queries RUNNING at once
+    (one process shares one device; extra threads buy host/device
+    overlap, not compute - runtime/dispatch.task_threads rationale);
+  * memory: a query is admitted only when its estimated device bytes
+    fit the DeviceMemoryTracker's CURRENT headroom minus what already-
+    admitted queries reserved. An over-headroom query WAITS instead of
+    OOMing the device; when the device is idle it runs alone (a query
+    larger than the whole budget must still be servable - the spill
+    ladder, not admission, handles its overflow).
+
+Ordering is strict: priority descending, FIFO within a priority class
+(submission sequence). The head of the queue blocks lower entries even
+when they would fit - bypass ("backfill") would starve big queries
+under a stream of small ones, and predictable ordering is worth more
+to a serving tier than peak packing.
+
+Backpressure is explicit: a full queue rejects at submit time
+(REJECTED_OVERLOADED) instead of building an unbounded pileup.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.service.query import Query
+
+
+def estimate_plan_device_bytes(op, partition: Optional[int] = None) -> int:
+    """Admission cost estimate: bytes the plan plausibly materializes
+    on device. Leaf-driven heuristic - parquet scans count file-range
+    bytes, memory scans count resident buffer bytes; interior nodes
+    take the sum of their children (joins/aggregates hold their inputs
+    resident in the worst case). `partition` narrows leaves to ONE
+    partition's inputs - a wire TaskDefinition executes a single
+    partition of its stage, so costing the whole scan would serialize
+    sibling tasks behind each other. Deliberately coarse: admission
+    needs a gate, not a cost model, and callers can override per
+    query."""
+    from blaze_tpu.ops.memory_scan import MemoryScanExec
+    from blaze_tpu.ops.parquet_scan import ParquetScanExec
+
+    if isinstance(op, ParquetScanExec):
+        import os
+
+        groups = op.file_groups
+        if partition is not None and partition < len(groups):
+            groups = [groups[partition]]
+        total = 0
+        for group in groups:
+            for fr in group:
+                if fr.length:
+                    total += fr.length
+                else:
+                    try:
+                        total += os.path.getsize(fr.path)
+                    except OSError:
+                        pass
+        return total
+    if isinstance(op, MemoryScanExec):
+        from blaze_tpu.runtime.memory import batch_device_bytes
+
+        parts = op.partitions
+        if partition is not None and partition < len(parts):
+            parts = [parts[partition]]
+        return sum(
+            batch_device_bytes(cb) for part in parts for cb in part
+        )
+    return sum(
+        estimate_plan_device_bytes(c, partition) for c in op.children
+    )
+
+
+class AdmissionController:
+    """Bounded priority queue + headroom gate for the QueryService."""
+
+    def __init__(
+        self,
+        device_tracker=None,
+        max_concurrency: int = 2,
+        max_queue_depth: int = 64,
+    ):
+        from blaze_tpu.runtime.memory import get_device_tracker
+
+        self._tracker = device_tracker or get_device_tracker()
+        self.max_concurrency = max(1, int(max_concurrency))
+        self.max_queue_depth = max(1, int(max_queue_depth))
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        # heap entries: (-priority, seq, query) - max-priority first,
+        # FIFO within a priority class via the submission sequence
+        self._heap: List[Tuple[int, int, Query]] = []
+        # reservations for admitted-but-not-yet-tracked device bytes
+        self._reserved: Dict[str, int] = {}
+        self.counters = {
+            "submitted": 0,
+            "admitted": 0,
+            "rejected_overloaded": 0,
+            "headroom_waits": 0,
+        }
+
+    # ------------------------------------------------------------------
+    def offer(self, q: Query) -> bool:
+        """Enqueue; False = queue full (caller marks the query
+        REJECTED_OVERLOADED - explicit backpressure)."""
+        with self._lock:
+            self.counters["submitted"] += 1
+            live = [e for e in self._heap if not e[2].done]
+            if len(live) >= self.max_queue_depth:
+                self.counters["rejected_overloaded"] += 1
+                return False
+            heapq.heappush(self._heap, (-q.priority, next(self._seq), q))
+            return True
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._heap if not e[2].done)
+
+    def running_count(self) -> int:
+        with self._lock:
+            return len(self._reserved)
+
+    # ------------------------------------------------------------------
+    def next_admissible(self) -> Optional[Query]:
+        """Pop the query that may start now, or None. Strict head-of-
+        queue policy (see module docstring); already-terminal entries
+        (cancelled/timed out while queued) are dropped on the way."""
+        with self._lock:
+            while self._heap:
+                q = self._heap[0][2]
+                if q.done:  # cancelled / timed out while queued
+                    heapq.heappop(self._heap)
+                    continue
+                if len(self._reserved) >= self.max_concurrency:
+                    return None
+                est = q.estimated_bytes or 0
+                headroom = self._tracker.headroom() - sum(
+                    self._reserved.values()
+                )
+                if self._reserved and est > headroom:
+                    # over headroom while others hold the device:
+                    # wait (queue, don't OOM). An idle device admits
+                    # anything - the spill ladder owns true overflow.
+                    self.counters["headroom_waits"] += 1
+                    return None
+                heapq.heappop(self._heap)
+                self._reserved[q.query_id] = est
+                self.counters["admitted"] += 1
+                return q
+            return None
+
+    def release(self, q: Query) -> None:
+        with self._lock:
+            self._reserved.pop(q.query_id, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                **self.counters,
+                "queued": sum(1 for e in self._heap if not e[2].done),
+                "running": len(self._reserved),
+                "reserved_bytes": sum(self._reserved.values()),
+                "headroom": self._tracker.headroom(),
+            }

@@ -1,0 +1,226 @@
+"""Query lifecycle: per-query record + state machine.
+
+The serving tier's unit of work. A Query wraps either a serialized
+TaskDefinition (the wire entry - one partition of one stage, the
+reference's callNative currency) or a driver-built plan (every
+partition), and carries the scheduling metadata the reference inherits
+from Spark's scheduler: priority, deadline, admission cost estimate.
+
+State machine (service/service.py drives it):
+
+    QUEUED -> ADMITTED -> RUNNING -> DONE
+       |          |          |-----> FAILED
+       |          |          |-----> CANCELLED
+       |          |          '-----> TIMED_OUT
+       |          |-> CANCELLED | TIMED_OUT
+       |-> CANCELLED | TIMED_OUT
+    (submit may also refuse outright: REJECTED_OVERLOADED)
+
+Transitions are validated; an illegal transition is a bug in the
+service, not a recoverable condition, so it raises.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from blaze_tpu.ops.base import ExecContext
+
+
+class QueryState(enum.Enum):
+    QUEUED = "QUEUED"
+    ADMITTED = "ADMITTED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+    TIMED_OUT = "TIMED_OUT"
+    REJECTED_OVERLOADED = "REJECTED_OVERLOADED"
+
+
+TERMINAL_STATES = frozenset(
+    {
+        QueryState.DONE,
+        QueryState.FAILED,
+        QueryState.CANCELLED,
+        QueryState.TIMED_OUT,
+        QueryState.REJECTED_OVERLOADED,
+    }
+)
+
+_ALLOWED = {
+    QueryState.QUEUED: {
+        QueryState.ADMITTED,
+        QueryState.CANCELLED,
+        QueryState.TIMED_OUT,
+        QueryState.REJECTED_OVERLOADED,
+        QueryState.FAILED,  # submit-time decode failure
+    },
+    QueryState.ADMITTED: {
+        QueryState.RUNNING,
+        QueryState.CANCELLED,
+        QueryState.TIMED_OUT,
+    },
+    QueryState.RUNNING: {
+        QueryState.DONE,
+        QueryState.FAILED,
+        QueryState.CANCELLED,
+        QueryState.TIMED_OUT,
+    },
+}
+
+
+class QueryRejected(RuntimeError):
+    """Submit-time backpressure: the admission queue is full."""
+
+
+class QueryCancelled(RuntimeError):
+    """Raised inside a query's run loop when its cancel event fires."""
+
+
+_qid_counter = itertools.count()
+
+
+def _new_query_id() -> str:
+    return f"q-{next(_qid_counter)}-{threading.get_ident():x}"
+
+
+class Query:
+    """One submitted query: payload + scheduling metadata + outcome."""
+
+    def __init__(
+        self,
+        *,
+        task_bytes: Optional[bytes] = None,
+        plan=None,
+        is_ref: bool = False,
+        resources: Optional[dict] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        estimated_bytes: Optional[int] = None,
+        use_cache: bool = True,
+        query_id: Optional[str] = None,
+    ):
+        assert (task_bytes is None) != (plan is None), \
+            "exactly one of task_bytes/plan"
+        self.query_id = query_id or _new_query_id()
+        self.task_bytes = task_bytes
+        self.plan = plan
+        self.is_ref = is_ref
+        self.resources = resources or {}
+        self.priority = int(priority)
+        self.submitted_at = time.monotonic()
+        self.deadline_at = (
+            self.submitted_at + deadline_s if deadline_s else None
+        )
+        self.estimated_bytes = estimated_bytes
+        self.use_cache = use_cache
+
+        self.state = QueryState.QUEUED
+        self.error: Optional[str] = None
+        self.result: Optional[List] = None  # pa.RecordBatch list
+        self.ctx = ExecContext(task_id=self.query_id)
+        # ONE metric tree per query: the executor adds `dispatch.*`
+        # deltas to ctx.metrics' root counters, instrument() mirrors
+        # the operator tree under the same root, so render_metrics
+        # shows both in one per-query report
+        self.metrics_root = self.ctx.metrics
+        # wall-clock phase timestamps (monotonic), service-filled:
+        # submitted / admitted / run_start / finished (+ stream_ns
+        # accumulated by the wire tier)
+        self.timings: Dict[str, float] = {"submitted": self.submitted_at}
+
+        self._lock = threading.Lock()
+        self._cancel = threading.Event()
+        self._done = threading.Event()
+        # service-filled (submit-time decode): the decoded task tuple,
+        # plan fingerprint, and whether the fingerprint is
+        # content-stable (cacheable)
+        self._decoded = None
+        self._fingerprint: Optional[str] = None
+        self._fingerprint_stable = False
+
+    # -- state machine --------------------------------------------------
+    def transition(self, new: QueryState) -> None:
+        with self._lock:
+            if new not in _ALLOWED.get(self.state, ()):  # terminal too
+                raise RuntimeError(
+                    f"illegal query transition {self.state.name} -> "
+                    f"{new.name} ({self.query_id})"
+                )
+            self.state = new
+            if new in TERMINAL_STATES:
+                self.timings.setdefault("finished", time.monotonic())
+                self._done.set()
+
+    def try_transition(self, new: QueryState) -> bool:
+        """Transition if legal from the current state; False otherwise
+        (the racy cancel-vs-finish edges use this)."""
+        with self._lock:
+            if new not in _ALLOWED.get(self.state, ()):
+                return False
+            self.state = new
+            if new in TERMINAL_STATES:
+                self.timings.setdefault("finished", time.monotonic())
+                self._done.set()
+            return True
+
+    # -- cancellation / deadline ---------------------------------------
+    def request_cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel.is_set()
+
+    def deadline_exceeded(self, now: Optional[float] = None) -> bool:
+        return (
+            self.deadline_at is not None
+            and (now if now is not None else time.monotonic())
+            >= self.deadline_at
+        )
+
+    def check_interrupt(self) -> None:
+        """Between-batch cooperative check inside the run loop."""
+        if self._cancel.is_set():
+            raise QueryCancelled(self.query_id)
+        if self.deadline_exceeded():
+            raise QueryCancelled(f"{self.query_id}: deadline")
+
+    # -- completion -----------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def status(self) -> dict:
+        """Poll payload: state + timings + per-query counters."""
+        m = self.ctx.metrics.counters
+        t = dict(self.timings)
+        out = {
+            "query_id": self.query_id,
+            "state": self.state.value,
+            "priority": self.priority,
+        }
+        if self.error:
+            out["error"] = self.error
+        if "admitted" in t:
+            out["queue_wait_s"] = round(t["admitted"] - t["submitted"], 6)
+        if "run_start" in t and "admitted" in t:
+            out["admission_s"] = round(t["run_start"] - t["admitted"], 6)
+        if "finished" in t and "run_start" in t:
+            out["execution_s"] = round(t["finished"] - t["run_start"], 6)
+        if "stream_ns" in t:
+            out["stream_s"] = round(t["stream_ns"] / 1e9, 6)
+        for k in ("output_rows", "output_batches", "cache_hits",
+                  "cache_misses"):
+            if k in m:
+                out[k] = m[k]
+        out["dispatches"] = m.get("dispatch.dispatches", 0)
+        return out

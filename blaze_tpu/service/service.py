@@ -1,0 +1,415 @@
+"""QueryService: the multi-query serving tier over the executor.
+
+What the reference inherits from Spark (driver scheduling, task slots,
+result handling - SURVEY 2.2), a standalone TPU engine must own. The
+service composes the pieces this package provides:
+
+  submit  -> Query (service/query.py state machine), bounded priority
+             admission (service/admission.py), or REJECTED_OVERLOADED
+  dispatch-> one dispatcher thread admits by priority/FIFO/headroom and
+             hands queries to a worker pool sized to max_concurrency
+  run     -> the UNCHANGED executor path (prepare_decoded_task ->
+             execute_partition), with cooperative cancel/deadline
+             checks between batches (the executor's GeneratorExit
+             cancellation contract, runtime/executor.py)
+  reuse   -> materialized results cached by (plan fingerprint,
+             partition) when the fingerprint is stable
+             (service/cache.py); a full cache hit dispatches NOTHING
+  observe -> per-query queue/admission/execution timings + the
+             dispatch.* counters + the mirrored operator metric tree,
+             one report via runtime/instrument.render_metrics
+
+Wire surface lives in service/wire.py; `python -m blaze_tpu serve`
+starts both.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from blaze_tpu.service.admission import (
+    AdmissionController,
+    estimate_plan_device_bytes,
+)
+from blaze_tpu.service.cache import ResultCache
+from blaze_tpu.service.query import (
+    Query,
+    QueryCancelled,
+    QueryState,
+)
+
+log = logging.getLogger("blaze_tpu.service")
+
+_MAX_RETAINED = 1024  # terminal queries kept for poll/report
+
+
+class QueryService:
+    def __init__(
+        self,
+        max_concurrency: int = 2,
+        max_queue_depth: int = 64,
+        cache: Optional[ResultCache] = None,
+        enable_cache: bool = True,
+        device_tracker=None,
+        default_deadline_s: Optional[float] = None,
+    ):
+        self.admission = AdmissionController(
+            device_tracker=device_tracker,
+            max_concurrency=max_concurrency,
+            max_queue_depth=max_queue_depth,
+        )
+        self.cache = (
+            cache if cache is not None
+            else (ResultCache() if enable_cache else None)
+        )
+        self.default_deadline_s = default_deadline_s
+        self._queries: Dict[str, Query] = {}
+        self._order: List[str] = []  # retention ring
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # admission order journal (query ids, in admission sequence):
+        # the load tests assert priority/FIFO semantics from this
+        self.admission_log: List[str] = []
+        self._stop = False
+        self._workers = cf.ThreadPoolExecutor(
+            max_workers=max(1, max_concurrency),
+            thread_name_prefix="blaze-query",
+        )
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="blaze-dispatch",
+        )
+        self._dispatcher.start()
+
+    # -- submission -----------------------------------------------------
+    def submit_task(
+        self,
+        task_bytes: bytes,
+        *,
+        is_ref: bool = False,
+        resources: Optional[dict] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        estimated_bytes: Optional[int] = None,
+        use_cache: bool = True,
+    ) -> Query:
+        """Wire entry: one serialized TaskDefinition (engine-native or
+        reference format), decoded eagerly so admission sees a cost
+        estimate and the cache sees a fingerprint."""
+        q = Query(
+            task_bytes=task_bytes,
+            is_ref=is_ref,
+            resources=resources,
+            priority=priority,
+            deadline_s=(
+                deadline_s if deadline_s is not None
+                else self.default_deadline_s
+            ),
+            estimated_bytes=estimated_bytes,
+            use_cache=use_cache,
+        )
+        try:
+            if is_ref:
+                from blaze_tpu.plan.refcompat import (
+                    task_from_reference_proto,
+                )
+
+                decoded = task_from_reference_proto(task_bytes)
+            else:
+                from blaze_tpu.plan.serde import task_from_proto
+
+                decoded = task_from_proto(task_bytes)
+        except Exception as e:  # noqa: BLE001 - reported via state
+            q.error = f"decode failed: {e!r}"
+            q.transition(QueryState.FAILED)
+            self._register(q)
+            return q
+        q._decoded = decoded
+        op = decoded[0]
+        if q.estimated_bytes is None:
+            # a wire task executes ONE partition of its stage - cost
+            # only that partition's leaves, or sibling tasks of a
+            # partitioned scan would serialize behind each other
+            q.estimated_bytes = estimate_plan_device_bytes(
+                op, partition=decoded[1]
+            )
+        q._fingerprint = op.fingerprint()
+        q._fingerprint_stable = op.fingerprint_is_stable()
+        return self._enqueue(q)
+
+    def submit_plan(
+        self,
+        plan,
+        *,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        estimated_bytes: Optional[int] = None,
+        use_cache: bool = True,
+    ) -> Query:
+        """Driver entry: run every partition of an in-process plan."""
+        q = Query(
+            plan=plan,
+            priority=priority,
+            deadline_s=(
+                deadline_s if deadline_s is not None
+                else self.default_deadline_s
+            ),
+            estimated_bytes=(
+                estimated_bytes if estimated_bytes is not None
+                else estimate_plan_device_bytes(plan)
+            ),
+            use_cache=use_cache,
+        )
+        q._decoded = None
+        q._fingerprint = plan.fingerprint()
+        q._fingerprint_stable = plan.fingerprint_is_stable()
+        return self._enqueue(q)
+
+    def _enqueue(self, q: Query) -> Query:
+        self._register(q)
+        if not self.admission.offer(q):
+            q.error = (
+                f"queue full ({self.admission.max_queue_depth}); "
+                "retry with backoff"
+            )
+            q.transition(QueryState.REJECTED_OVERLOADED)
+            return q
+        with self._cv:
+            self._cv.notify_all()
+        return q
+
+    def _register(self, q: Query) -> None:
+        with self._lock:
+            self._queries[q.query_id] = q
+            self._order.append(q.query_id)
+            while len(self._order) > _MAX_RETAINED:
+                old = self._order[0]
+                oq = self._queries.get(old)
+                if oq is not None and not oq.done:
+                    break  # never drop a live query
+                self._order.pop(0)
+                self._queries.pop(old, None)
+
+    # -- lifecycle API --------------------------------------------------
+    def get(self, query_id: str) -> Query:
+        with self._lock:
+            q = self._queries.get(query_id)
+        if q is None:
+            raise KeyError(f"unknown query {query_id}")
+        return q
+
+    def poll(self, query_id: str) -> dict:
+        return self.get(query_id).status()
+
+    def cancel(self, query_id: str) -> dict:
+        """Request cancellation. QUEUED queries die here; ADMITTED and
+        RUNNING ones observe the event at the next batch boundary (the
+        executor's cancellation pass-through keeps the engine clean)."""
+        q = self.get(query_id)
+        q.request_cancel()
+        if q.state is QueryState.QUEUED:
+            q.try_transition(QueryState.CANCELLED)
+        with self._cv:
+            self._cv.notify_all()
+        return q.status()
+
+    def result(self, query_id: str, timeout: Optional[float] = None):
+        """Block until terminal; return the materialized RecordBatch
+        list on DONE, raise on every other terminal state."""
+        q = self.get(query_id)
+        if not q.wait(timeout):
+            raise TimeoutError(f"query {query_id} still {q.state.value}")
+        if q.state is QueryState.DONE:
+            return q.result
+        if q.state is QueryState.CANCELLED:
+            raise QueryCancelled(query_id)
+        raise RuntimeError(
+            f"query {query_id} {q.state.value}: {q.error or ''}"
+        )
+
+    def report(self, query_id: str) -> str:
+        """Per-query observability rollup: lifecycle timings, cache and
+        dispatch counters, and the mirrored operator metric tree."""
+        from blaze_tpu.runtime.instrument import render_metrics
+
+        q = self.get(query_id)
+        st = q.status()
+        head = [
+            f"query {q.query_id}: {st['state']} "
+            f"(priority={q.priority}, est_bytes={q.estimated_bytes})"
+        ]
+        for k in ("queue_wait_s", "admission_s", "execution_s",
+                  "stream_s"):
+            if k in st:
+                head.append(f"  {k}={st[k]}")
+        body = render_metrics(q.metrics_root, indent="  ")
+        return "\n".join(head) + ("\n" + body if body else "")
+
+    def stats(self) -> dict:
+        out = {"admission": self.admission.stats()}
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+    def close(self) -> None:
+        self._stop = True
+        # shutdown cancels every live query: queued ones die here,
+        # running ones observe the event at their next batch boundary -
+        # otherwise worker shutdown would wait on them forever
+        with self._lock:
+            live = [q for q in self._queries.values() if not q.done]
+        for q in live:
+            q.request_cancel()
+            if q.state is QueryState.QUEUED:
+                q.try_transition(QueryState.CANCELLED)
+        with self._cv:
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=5)
+        self._workers.shutdown(wait=True, cancel_futures=True)
+        if self.cache is not None:
+            self.cache.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dispatcher -----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._stop:
+            with self._cv:
+                self._cv.wait(timeout=0.05)
+            if self._stop:
+                return
+            self._sweep_deadlines()
+            while True:
+                q = self.admission.next_admissible()
+                if q is None:
+                    break
+                if not q.try_transition(QueryState.ADMITTED):
+                    # cancelled / timed out between queue and admit
+                    self.admission.release(q)
+                    continue
+                q.timings["admitted"] = time.monotonic()
+                with self._lock:
+                    self.admission_log.append(q.query_id)
+                self._workers.submit(self._run_query, q)
+
+    def _sweep_deadlines(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            queued = [
+                q for q in self._queries.values()
+                if q.state is QueryState.QUEUED
+            ]
+        for q in queued:
+            if q.deadline_exceeded(now):
+                if q.try_transition(QueryState.TIMED_OUT):
+                    q.error = "deadline exceeded while queued"
+
+    # -- execution ------------------------------------------------------
+    def _run_query(self, q: Query) -> None:
+        try:
+            if q.cancel_requested:
+                if q.try_transition(QueryState.CANCELLED):
+                    return
+            if q.deadline_exceeded():
+                if q.try_transition(QueryState.TIMED_OUT):
+                    q.error = "deadline exceeded before start"
+                    return
+            if not q.try_transition(QueryState.RUNNING):
+                return
+            q.timings["run_start"] = time.monotonic()
+            try:
+                q.result = self._execute(q)
+            except QueryCancelled:
+                if q.cancel_requested:
+                    q.try_transition(QueryState.CANCELLED)
+                else:
+                    q.error = "deadline exceeded while running"
+                    q.try_transition(QueryState.TIMED_OUT)
+                return
+            except Exception as e:  # noqa: BLE001 - reported via state
+                q.error = f"{type(e).__name__}: {e}"
+                q.try_transition(QueryState.FAILED)
+                log.warning("query %s failed: %s", q.query_id, q.error)
+                return
+            q.try_transition(QueryState.DONE)
+        finally:
+            self.admission.release(q)
+            with self._cv:
+                self._cv.notify_all()
+
+    def _execute(self, q: Query) -> List:
+        """Run (or reuse) every partition of the query's plan."""
+        from blaze_tpu.runtime.executor import prepare_decoded_task
+        from blaze_tpu.runtime.instrument import instrument
+
+        # wire-manifest resources first (the gateway's resource
+        # registry contract); decoded-task resources setdefault under
+        # them in prepare_decoded_task
+        q.ctx.resources.update(q.resources)
+
+        cache = (
+            self.cache
+            if (self.cache is not None and q.use_cache
+                and q._fingerprint_stable)
+            else None
+        )
+        if q.plan is not None:
+            op = q.plan
+            partitions = list(range(op.partition_count))
+            exec_op = op  # driver plans run as-built (run_plan parity)
+        else:
+            op = None
+            partitions = [q._decoded[1]]
+            exec_op = None  # prepared lazily: a full cache hit must
+            # not pay fusion/mesh lowering (and must dispatch nothing)
+
+        out: List = []
+        for p in partitions:
+            q.check_interrupt()
+            key = (q._fingerprint, p)
+            if cache is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    q.ctx.metrics.add("cache_hits", 1)
+                    for rb in hit:
+                        q.ctx.metrics.add("output_rows", rb.num_rows)
+                    out.extend(hit)
+                    continue
+                q.ctx.metrics.add("cache_misses", 1)
+            if exec_op is None:
+                prepared, _ = prepare_decoded_task(q._decoded, q.ctx)
+                if q.ctx.config.collect_metrics:
+                    prepared = instrument(prepared, q.metrics_root)
+                exec_op = prepared
+            part_batches = self._drain(q, exec_op, p)
+            if cache is not None:
+                cache.put(key, part_batches)
+            out.extend(part_batches)
+        return out
+
+    def _drain(self, q: Query, op, partition: int) -> List:
+        """Materialize one partition with cooperative interrupt checks
+        between batches; closing the generator routes through the
+        executor's cancellation pass-through (GeneratorExit), so a
+        cancelled query never poisons the engine."""
+        from blaze_tpu.runtime.executor import execute_partition
+
+        it = execute_partition(op, partition, q.ctx)
+        batches: List = []
+        try:
+            for rb in it:
+                batches.append(rb)
+                if q.cancel_requested or q.deadline_exceeded():
+                    it.close()
+                    raise QueryCancelled(q.query_id)
+        finally:
+            it.close()
+        return batches

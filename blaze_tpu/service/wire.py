@@ -1,0 +1,394 @@
+"""Service wire protocol: multi-query serving over the gateway socket.
+
+The legacy gateway connection (runtime/gateway.py) is one-shot: one
+TaskDefinition in, one batch stream out. A serving tier needs verbs -
+submit several queries over one connection, poll them, stream results,
+cancel mid-flight. The framing extends the gateway's, so one listener
+serves both: a connection whose FIRST u64 header has bit 61
+(_FLAG_SERVICE) set switches to this protocol; anything else is a
+legacy single-task connection.
+
+Service framing (all integers LE):
+
+  hello:    u64 header with _FLAG_SERVICE set (rest of the bits 0)
+  verb:     u8   SUBMIT=1 POLL=2 FETCH=3 CANCEL=4 REPORT=5 STATS=6
+  SUBMIT:   u32 meta_len | meta JSON | u64 blob_header | [u32 mlen |
+            manifest JSON] | blob
+            blob_header reuses the legacy bits: bit 63 = reference wire
+            format, bit 62 = resource manifest present, low bits = len.
+            meta: {priority, deadline_s, estimated_bytes, use_cache}
+            -> JSON frame {query_id, state, ...}
+  POLL:     u32 id_len | id   -> JSON frame (Query.status())
+  FETCH:    u32 id_len | id | u32 timeout_ms (0 = wait forever)
+            -> on DONE: segmented-IPC parts (u64 len | zstd Arrow IPC),
+               then u64 0 (the shuffle/gateway wire format, io/ipc.py)
+            -> else: u64 ERR | u32 len | "STATE: detail" utf8
+  CANCEL:   u32 id_len | id   -> JSON frame
+  REPORT:   u32 id_len | id   -> JSON frame {report: text}
+  STATS:    u32 0             -> JSON frame (service stats)
+  JSON frame: u32 len | utf8 JSON
+
+Session semantics: queries submitted on a connection belong to it;
+when the connection drops (EOF, broken pipe) every non-terminal
+session query is cancelled - a vanished client must not keep holding
+device admission slots. Poll/cancel/fetch work from ANY connection
+(query ids are global), so detached orchestration is still possible
+via a second connection.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+from typing import Iterator, List, Optional
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_ERR = 0xFFFFFFFFFFFFFFFF
+
+VERB_SUBMIT = 1
+VERB_POLL = 2
+VERB_FETCH = 3
+VERB_CANCEL = 4
+VERB_REPORT = 5
+VERB_STATS = 6
+
+MAX_META_BYTES = 1 << 20
+
+
+class ServiceError(RuntimeError):
+    """Error frame surfaced client-side; `.state` carries the query's
+    terminal state name when the server included one."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.state = msg.split(":", 1)[0] if ":" in msg else ""
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+def handle_service_connection(sock, service) -> None:
+    """Drive one service connection until EOF. Called from the gateway
+    handler after it consumed the hello header."""
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    session_qids: List[str] = []
+    try:
+        while True:
+            try:
+                verb = _recv_exact(sock, 1)[0]
+            except (ConnectionError, OSError):
+                return  # clean EOF / client gone
+            try:
+                if verb == VERB_SUBMIT:
+                    _handle_submit(sock, service, session_qids)
+                elif verb == VERB_POLL:
+                    qid = _read_str(sock)
+                    _read_u32(sock)  # reserved (always 0)
+                    _send_json(sock, service.poll(qid))
+                elif verb == VERB_FETCH:
+                    _handle_fetch(sock, service)
+                elif verb == VERB_CANCEL:
+                    qid = _read_str(sock)
+                    _read_u32(sock)
+                    _send_json(sock, service.cancel(qid))
+                elif verb == VERB_REPORT:
+                    qid = _read_str(sock)
+                    _read_u32(sock)
+                    _send_json(
+                        sock, {"report": service.report(qid)}
+                    )
+                elif verb == VERB_STATS:
+                    _read_u32(sock)
+                    _send_json(sock, service.stats())
+                else:
+                    raise ValueError(f"unknown service verb {verb}")
+            except (ConnectionError, BrokenPipeError, OSError):
+                return  # mid-verb disconnect: session cleanup below
+            except ValueError as e:
+                # protocol violation (oversized frame, unknown verb,
+                # bad manifest): the connection may hold unread payload
+                # bytes that would be misparsed as verbs - report
+                # best-effort and CLOSE instead of desyncing
+                try:
+                    _send_json(
+                        sock,
+                        {"error": f"protocol error: {e}"[:65536],
+                         "fatal": True},
+                    )
+                except OSError:
+                    pass
+                return
+            except KeyError as e:
+                # id lookups fail AFTER their frame is fully read -
+                # the connection is still in sync, report in-band
+                _send_json(sock, {"error": f"unknown query: {e}"})
+            except Exception as e:  # noqa: BLE001 - reported in-band
+                _send_json(
+                    sock,
+                    {"error": f"{type(e).__name__}: {e}"[:65536]},
+                )
+    finally:
+        # session teardown: a disconnected client's pending queries
+        # must not keep occupying the queue or the device
+        for qid in session_qids:
+            try:
+                q = service.get(qid)
+                if not q.done:
+                    service.cancel(qid)
+            except KeyError:
+                pass
+
+
+def _handle_submit(sock, service, session_qids: List[str]) -> None:
+    from blaze_tpu.runtime.gateway import (
+        MAX_TASK_BYTES,
+        _FLAG_MANIFEST,
+        _FLAG_REF,
+        _manifest_resources,
+    )
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    (meta_len,) = _U32.unpack(_recv_exact(sock, _U32.size))
+    if meta_len > MAX_META_BYTES:
+        raise ValueError("submit meta too large")
+    meta = json.loads(_recv_exact(sock, meta_len) or b"{}")
+    (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
+    is_ref = bool(header & _FLAG_REF)
+    has_manifest = bool(header & _FLAG_MANIFEST)
+    blob_len = header & ~(_FLAG_REF | _FLAG_MANIFEST)
+    if blob_len > MAX_TASK_BYTES:
+        raise ValueError("task too large")
+    resources = {}
+    if has_manifest:
+        (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
+        if mlen > MAX_TASK_BYTES:
+            raise ValueError("manifest too large")
+        resources = _manifest_resources(
+            json.loads(_recv_exact(sock, mlen))
+        )
+    blob = _recv_exact(sock, blob_len)
+    q = service.submit_task(
+        blob,
+        is_ref=is_ref,
+        resources=resources,
+        priority=int(meta.get("priority", 0)),
+        deadline_s=meta.get("deadline_s"),
+        estimated_bytes=meta.get("estimated_bytes"),
+        use_cache=bool(meta.get("use_cache", True)),
+    )
+    session_qids.append(q.query_id)
+    _send_json(sock, q.status())
+
+
+def _handle_fetch(sock, service) -> None:
+    from blaze_tpu.io.ipc import encode_ipc_segment
+    from blaze_tpu.service.query import QueryState
+
+    qid = _read_str(sock)
+    timeout_ms = _read_u32(sock)
+    try:
+        q = service.get(qid)
+    except KeyError:
+        _send_err(sock, f"UNKNOWN: no query {qid}")
+        return
+    if not q.wait(timeout_ms / 1000.0 if timeout_ms else None):
+        _send_err(sock, f"{q.state.value}: fetch timed out")
+        return
+    if q.state is not QueryState.DONE:
+        _send_err(
+            sock, f"{q.state.value}: {q.error or 'not completed'}"
+        )
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        for rb in q.result or ():
+            sock.sendall(encode_ipc_segment(rb))
+        sock.sendall(_U64.pack(0))
+    except Exception as e:
+        # once parts are on the wire the client reads u64 frames; a
+        # JSON error frame here would desync it - abort the connection
+        # (truncated stream surfaces client-side as ConnectionError)
+        raise ConnectionError(f"fetch stream aborted: {e!r}") from e
+    finally:
+        q.timings["stream_ns"] = (
+            q.timings.get("stream_ns", 0)
+            + (time.perf_counter_ns() - t0)
+        )
+
+
+def _read_u32(sock) -> int:
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    (v,) = _U32.unpack(_recv_exact(sock, _U32.size))
+    return v
+
+
+def _read_str(sock) -> str:
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    n = _read_u32(sock)
+    if n > MAX_META_BYTES:
+        raise ValueError("string frame too large")
+    return _recv_exact(sock, n).decode("utf-8")
+
+
+def _send_json(sock, obj: dict) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_U32.pack(len(data)) + data)
+
+
+def _send_err(sock, msg: str) -> None:
+    data = msg.encode("utf-8")[:65536]
+    sock.sendall(_U64.pack(_ERR) + _U32.pack(len(data)) + data)
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+class ServiceClient:
+    """Multi-query client for the service protocol. One socket, many
+    queries; every call is a synchronous verb round trip."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+
+        self._sock = socket.create_connection(
+            (host, port), timeout=timeout
+        )
+        self._sock.sendall(_U64.pack(_FLAG_SERVICE))
+
+    # -- verbs ----------------------------------------------------------
+    def submit(
+        self,
+        task_bytes: bytes,
+        *,
+        is_ref: bool = False,
+        manifest: Optional[dict] = None,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        estimated_bytes: Optional[int] = None,
+        use_cache: bool = True,
+    ) -> dict:
+        from blaze_tpu.runtime.gateway import (
+            _FLAG_MANIFEST,
+            _FLAG_REF,
+        )
+
+        meta = json.dumps(
+            {
+                "priority": priority,
+                "deadline_s": deadline_s,
+                "estimated_bytes": estimated_bytes,
+                "use_cache": use_cache,
+            }
+        ).encode("utf-8")
+        header = len(task_bytes)
+        if is_ref:
+            header |= _FLAG_REF
+        payload = b""
+        if manifest is not None:
+            header |= _FLAG_MANIFEST
+            mbytes = json.dumps(manifest).encode("utf-8")
+            payload = _U32.pack(len(mbytes)) + mbytes
+        self._sock.sendall(
+            bytes([VERB_SUBMIT])
+            + _U32.pack(len(meta)) + meta
+            + _U64.pack(header) + payload + task_bytes
+        )
+        return self._read_json()
+
+    def poll(self, query_id: str) -> dict:
+        self._send_id_verb(VERB_POLL, query_id)
+        return self._read_json()
+
+    def cancel(self, query_id: str) -> dict:
+        self._send_id_verb(VERB_CANCEL, query_id)
+        return self._read_json()
+
+    def report(self, query_id: str) -> str:
+        self._send_id_verb(VERB_REPORT, query_id)
+        return self._read_json()["report"]
+
+    def stats(self) -> dict:
+        self._sock.sendall(bytes([VERB_STATS]) + _U32.pack(0))
+        return self._read_json()
+
+    def fetch(self, query_id: str, timeout_ms: int = 0) -> list:
+        """Materialize the result stream (list of pa.RecordBatch)."""
+        return list(self.fetch_stream(query_id, timeout_ms))
+
+    def fetch_stream(self, query_id: str,
+                     timeout_ms: int = 0) -> Iterator:
+        """Stream the result parts. Closing the client (or abandoning
+        the socket) mid-stream is the wire-level cancel."""
+        import pyarrow as pa
+
+        from blaze_tpu.runtime import native
+        from blaze_tpu.runtime.transport import _recv_exact
+
+        self._send_id_verb(VERB_FETCH, query_id, timeout_ms)
+        while True:
+            (length,) = _U64.unpack(_recv_exact(self._sock, _U64.size))
+            if length == 0:
+                return
+            if length == _ERR:
+                (mlen,) = _U32.unpack(
+                    _recv_exact(self._sock, _U32.size)
+                )
+                msg = _recv_exact(self._sock, mlen).decode("utf-8")
+                raise ServiceError(msg)
+            raw = native.zstd_decompress(
+                _recv_exact(self._sock, length)
+            )
+            if not raw:
+                continue
+            with pa.ipc.open_stream(raw) as reader:
+                for rb in reader:
+                    if rb.num_rows > 0:
+                        yield rb
+
+    # -- helpers --------------------------------------------------------
+    def run(self, task_bytes: bytes, **submit_kw) -> list:
+        """submit + fetch in one call (the single-query convenience)."""
+        st = self.submit(task_bytes, **submit_kw)
+        if st["state"] not in ("QUEUED", "ADMITTED", "RUNNING", "DONE"):
+            raise ServiceError(
+                f"{st['state']}: {st.get('error', 'rejected')}"
+            )
+        return self.fetch(st["query_id"])
+
+    def _send_id_verb(self, verb: int, query_id: str,
+                      extra_u32: int = 0) -> None:
+        qid = query_id.encode("utf-8")
+        self._sock.sendall(
+            bytes([verb]) + _U32.pack(len(qid)) + qid
+            + _U32.pack(extra_u32)
+        )
+
+    def _read_json(self) -> dict:
+        from blaze_tpu.runtime.transport import _recv_exact
+
+        (n,) = _U32.unpack(_recv_exact(self._sock, _U32.size))
+        if n > MAX_META_BYTES:
+            raise ValueError("oversized JSON frame")
+        return json.loads(_recv_exact(self._sock, n).decode("utf-8"))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

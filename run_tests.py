@@ -143,21 +143,9 @@ def run(label, args, rows=None, _retry=True):
     return p.returncode == 0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int,
-                    default=int(os.environ.get("BLAZE_TPCDS_ROWS",
-                                               200_000)))
-    ap.add_argument("--fast", action="store_true")
-    ap.add_argument("--scale", action="store_true")
-    args = ap.parse_args()
-    rows = 20_000 if args.fast else args.rows
-
-    ok = True
-    t0 = time.time()
-
-    # bench smoke first: a broken bench must fail at commit time, not
-    # silently at round end (ISSUE 1 satellite; <= 60s at small rows)
+def bench_smoke() -> bool:
+    """Commit-time bench guard (ISSUE 1 satellite; <= 60s at small
+    rows): a broken bench must fail at commit time, not at round end."""
     ts = time.time()
     p = subprocess.run(
         [sys.executable, "bench.py", "--smoke"],
@@ -171,7 +159,44 @@ def main():
           f"{tail[-1][:160] if tail else '(no output)'}", flush=True)
     if not smoke_ok:
         print("\n".join(tail[-20:]))
-    ok &= smoke_ok
+    return smoke_ok
+
+
+def service_smoke() -> bool:
+    """Serving-tier smoke (ISSUE 2 satellite): the QueryService +
+    gateway-service-protocol suites, including the `python -m
+    blaze_tpu serve` cache-hit acceptance pin."""
+    return run(
+        "service smoke",
+        ["tests/test_service.py", "tests/test_service_gateway.py",
+         "tests/test_gateway.py", "tests/test_scheduler.py"],
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get("BLAZE_TPCDS_ROWS",
+                                               200_000)))
+    ap.add_argument("--fast", action="store_true")
+    ap.add_argument("--scale", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="bench + serving-tier smoke only (commit-time "
+                         "guard, no TPC-DS matrices)")
+    args = ap.parse_args()
+    rows = 20_000 if args.fast else args.rows
+
+    ok = True
+    t0 = time.time()
+
+    if args.smoke:
+        ok &= bench_smoke()
+        ok &= service_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
+    ok &= bench_smoke()
 
     ok &= run(
         "core suite",

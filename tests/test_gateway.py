@@ -41,10 +41,18 @@ def client_bin(tmp_path_factory):
     if shutil.which("g++") is None:
         pytest.skip("no g++ in environment")
     out = str(tmp_path_factory.mktemp("bin") / "blaze_client")
-    subprocess.run(
+    res = subprocess.run(
         ["g++", "-O2", "-o", out, CLIENT_SRC, "-lzstd"],
-        check=True, capture_output=True,
+        capture_output=True, text=True,
     )
+    if res.returncode != 0:
+        # zstd-less toolchain (this image lacks libzstd-dev; the
+        # engine side falls back to raw frames, runtime/native.py) is
+        # an environment limitation, not a client regression - skip.
+        # Any OTHER compile failure stays loud.
+        if "zstd" in (res.stderr or "").lower():
+            pytest.skip("g++ cannot link zstd in this environment")
+        raise AssertionError(f"client build failed:\n{res.stderr}")
     return out
 
 
@@ -127,3 +135,110 @@ def test_cpp_client_engine_error_frame(client_bin, tmp_path):
         )
     assert res.returncode == 2
     assert "engine error" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# client-disconnect semantics (ISSUE 2 satellite): a broken pipe
+# mid-stream is a CANCELLATION, not an execution failure - the task
+# generator is closed (executor GeneratorExit pass-through) and no
+# error frame / failure log is produced. Exercised at the handler level
+# with a fake socket so no g++ or real network flakiness is involved.
+# ---------------------------------------------------------------------------
+
+import logging
+import struct
+import threading
+
+
+class _FakeSock:
+    """Feeds a canned request; sendall starts raising after N calls to
+    model the client vanishing mid-stream."""
+
+    def __init__(self, request: bytes, sends_before_break: int):
+        self._buf = request
+        self._pos = 0
+        self.sent = []
+        self._ok_sends = sends_before_break
+
+    def recv(self, n: int) -> bytes:
+        chunk = self._buf[self._pos:self._pos + n]
+        self._pos += len(chunk)
+        return chunk
+
+    def sendall(self, data: bytes) -> None:
+        if len(self.sent) >= self._ok_sends:
+            raise BrokenPipeError("client went away")
+        self.sent.append(data)
+
+
+def _run_handler(sock):
+    from blaze_tpu.runtime import gateway
+
+    class _Srv:
+        service = None
+
+    gateway._Handler(sock, ("127.0.0.1", 0), _Srv())
+
+
+def _legacy_request(blob: bytes) -> bytes:
+    return struct.pack("<Q", len(blob)) + blob
+
+
+def test_disconnect_mid_stream_cancels_not_fails(monkeypatch, caplog):
+    state = {"closed": False, "yielded": 0}
+    rb = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+
+    def fake_execute_task(blob, ctx=None):
+        def gen():
+            try:
+                for _ in range(100):
+                    state["yielded"] += 1
+                    yield rb
+            finally:
+                state["closed"] = True
+        return gen()
+
+    from blaze_tpu.runtime import executor
+
+    monkeypatch.setattr(executor, "execute_task", fake_execute_task)
+    sock = _FakeSock(_legacy_request(b"task"), sends_before_break=1)
+    with caplog.at_level(logging.INFO, logger="blaze_tpu.gateway"):
+        _run_handler(sock)  # must return cleanly, no exception
+    # generator closed through the cancellation pass-through ...
+    assert state["closed"]
+    assert state["yielded"] == 2  # one sent, one hit the broken pipe
+    # ... no error frame was emitted (only the one successful part) ...
+    assert len(sock.sent) == 1
+    assert not sock.sent[0].startswith(
+        struct.pack("<Q", 0xFFFFFFFFFFFFFFFF)
+    )
+    # ... logged as a cancellation, never as a task failure (scoped to
+    # the gateway/executor loggers: unrelated subsystems may warn, e.g.
+    # the native-lib build fallback on zstd-less hosts)
+    assert any(
+        "disconnected mid-stream" in r.message for r in caplog.records
+    )
+    assert not [
+        r for r in caplog.records
+        if r.levelno >= logging.WARNING
+        and r.name in ("blaze_tpu.gateway", "blaze_tpu.executor")
+    ]
+
+
+def test_execution_error_still_reports_error_frame(monkeypatch):
+    def fake_execute_task(blob, ctx=None):
+        def gen():
+            raise ValueError("deliberate engine error")
+            yield
+        return gen()
+
+    from blaze_tpu.runtime import executor
+
+    monkeypatch.setattr(executor, "execute_task", fake_execute_task)
+    sock = _FakeSock(_legacy_request(b"task"), sends_before_break=99)
+    _run_handler(sock)
+    assert len(sock.sent) == 1
+    assert sock.sent[0].startswith(
+        struct.pack("<Q", 0xFFFFFFFFFFFFFFFF)
+    )
+    assert b"deliberate engine error" in sock.sent[0]

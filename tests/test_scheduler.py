@@ -152,3 +152,57 @@ def test_exclusive_time_and_rendering():
     assert "  FilterExec" in lines[1]
     assert "self=" in lines[0] and "time=" in lines[0]
     assert "rows=60" in lines[0]
+
+
+def test_first_failure_cancels_outstanding_siblings():
+    """ISSUE 2 satellite: the first task error propagates immediately
+    and sibling partitions are cancelled through the executor's
+    GeneratorExit pass-through instead of running to completion."""
+    import time
+
+    closed = []
+    close_lock = threading.Lock()
+
+    class FailFast(MemoryScanExec):
+        def execute(self, partition, ctx):
+            if partition == 0:
+                time.sleep(0.05)  # let siblings start streaming
+                raise IOError("partition 0 exploded")
+            try:
+                # long enough that without fail-fast the plan would
+                # take >50s; with it, siblings die at the next batch
+                for i in range(10_000):
+                    yield ColumnBatch.from_pydict({"a": [partition]})
+                    time.sleep(0.005)
+            finally:
+                with close_lock:
+                    closed.append(partition)
+
+    base = multi_scan(4)
+    op = FailFast(base.partitions, base.schema)
+    t0 = time.monotonic()
+    with pytest.raises(TaskExecutionError, match="partition 0"):
+        run_plan_parallel(op, parallelism=4, max_attempts=1)
+    assert time.monotonic() - t0 < 20
+    # every streaming sibling was closed (cancelled), not abandoned
+    assert set(closed) >= {1, 2, 3}
+
+
+def test_caller_cancel_event_aborts_plan():
+    import time
+
+    from blaze_tpu.runtime.scheduler import PlanCancelled
+
+    cancel = threading.Event()
+
+    class Endless(MemoryScanExec):
+        def execute(self, partition, ctx):
+            for i in range(10_000):
+                yield ColumnBatch.from_pydict({"a": [i]})
+                time.sleep(0.002)
+
+    base = multi_scan(2)
+    op = Endless(base.partitions, base.schema)
+    threading.Timer(0.1, cancel.set).start()
+    with pytest.raises(PlanCancelled):
+        run_plan_parallel(op, parallelism=2, cancel=cancel)

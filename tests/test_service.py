@@ -1,0 +1,458 @@
+"""Serving-tier tests: lifecycle, admission, cancellation, deadlines,
+and the plan-fingerprint result cache (ISSUE 2 tentpole)."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+)
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime import dispatch
+from blaze_tpu.runtime.memory import DeviceMemoryTracker, MemoryPool
+from blaze_tpu.service import (
+    QueryCancelled,
+    QueryService,
+    QueryState,
+    ResultCache,
+    estimate_plan_device_bytes,
+)
+
+
+def wait_for(cond, timeout=10.0, tick=0.005):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(tick)
+    return False
+
+
+class GatedScan(MemoryScanExec):
+    """Yields one-row batches until released: occupies an admission
+    slot for as long as the test wants, while giving the service a
+    batch boundary every few ms to observe cancel/deadline events."""
+
+    def __init__(self, release: threading.Event, rows=1):
+        cb = ColumnBatch.from_pydict({"a": list(range(rows))})
+        super().__init__([[cb]], cb.schema)
+        self.release = release
+        self.started = threading.Event()
+        self.closed = threading.Event()
+
+    def execute(self, partition, ctx):
+        self.started.set()
+        try:
+            while not self.release.wait(0.005):
+                yield self.partitions[0][0]
+            yield self.partitions[0][0]
+        finally:
+            self.closed.set()
+
+
+@pytest.fixture
+def parquet_task(tmp_path):
+    rng = np.random.default_rng(7)
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 20, 4000), pa.int32()),
+                "v": pa.array(rng.random(4000), pa.float64()),
+            }
+        ),
+        p,
+    )
+
+    def make(threshold=0.5):
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(p)]]),
+                Col("v") > threshold,
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode=AggMode.COMPLETE,
+        )
+        return plan, task_to_proto(plan, 0)
+
+    return make
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_stable_across_rebuilds(parquet_task):
+    p1, _ = parquet_task()
+    p2, _ = parquet_task()
+    assert p1.fingerprint() == p2.fingerprint()
+    assert p1.fingerprint_is_stable()
+
+
+def test_fingerprint_distinguishes_plans(parquet_task):
+    p1, _ = parquet_task(0.5)
+    p2, _ = parquet_task(0.6)
+    assert p1.fingerprint() != p2.fingerprint()
+    p3, _ = parquet_task(0.5)
+    assert LimitExec(p3, 5).fingerprint() != p3.fingerprint()
+
+
+def test_fingerprint_memory_scan_unstable():
+    cb = ColumnBatch.from_pydict({"a": [1, 2]})
+    op = MemoryScanExec([[cb]], cb.schema)
+    assert not op.fingerprint_is_stable()
+    # ... so the service never caches it, but the id-digest still keys
+    # jit lookups for THIS object
+    assert op.fingerprint() == op.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + admission
+# ---------------------------------------------------------------------------
+
+
+def test_submit_plan_matches_run_plan():
+    from blaze_tpu.runtime.executor import run_plan
+
+    parts = []
+    for p in range(3):
+        parts.append(
+            [ColumnBatch.from_pydict({"a": list(range(p * 10, p * 10 + 10))})]
+        )
+    op = MemoryScanExec(parts, parts[0][0].schema)
+    expected = run_plan(
+        MemoryScanExec(parts, parts[0][0].schema)
+    ).to_pydict()
+    with QueryService(max_concurrency=2) as svc:
+        q = svc.submit_plan(FilterExec(op, Col("a") % 2 == 0))
+        batches = svc.result(q.query_id, timeout=60)
+    got = pa.Table.from_batches(batches).to_pydict()
+    assert got["a"] == [a for a in expected["a"] if a % 2 == 0]
+    assert q.state is QueryState.DONE
+
+
+def test_priority_then_fifo_admission_order():
+    release = threading.Event()
+    blocker = GatedScan(release)
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        qb = svc.submit_plan(blocker, estimated_bytes=0)
+        assert wait_for(lambda: blocker.started.is_set())
+        mk = lambda: FilterExec(  # noqa: E731
+            MemoryScanExec(
+                [[ColumnBatch.from_pydict({"a": [1, 2, 3]})]],
+                ColumnBatch.from_pydict({"a": [1]}).schema,
+            ),
+            Col("a") > 0,
+        )
+        q_low1 = svc.submit_plan(mk(), priority=0, estimated_bytes=0)
+        q_high = svc.submit_plan(mk(), priority=5, estimated_bytes=0)
+        q_low2 = svc.submit_plan(mk(), priority=0, estimated_bytes=0)
+        assert q_low1.state is QueryState.QUEUED
+        release.set()
+        for q in (q_low1, q_high, q_low2):
+            svc.result(q.query_id, timeout=60)
+        assert svc.admission_log == [
+            qb.query_id,
+            q_high.query_id,   # priority first
+            q_low1.query_id,   # then FIFO within the priority class
+            q_low2.query_id,
+        ]
+
+
+def test_headroom_queueing_not_oom():
+    """ISSUE 2 acceptance: an over-headroom query QUEUES while a
+    running query holds the device, and runs after it releases."""
+    tracker = DeviceMemoryTracker(budget=1000)
+    release = threading.Event()
+    blocker = GatedScan(release)
+    with QueryService(
+        max_concurrency=4, enable_cache=False, device_tracker=tracker
+    ) as svc:
+        qb = svc.submit_plan(blocker, estimated_bytes=800)
+        assert wait_for(lambda: blocker.started.is_set())
+        big = svc.submit_plan(
+            FilterExec(
+                MemoryScanExec(
+                    [[ColumnBatch.from_pydict({"a": [1]})]],
+                    ColumnBatch.from_pydict({"a": [1]}).schema,
+                ),
+                Col("a") > 0,
+            ),
+            estimated_bytes=500,  # 800 + 500 > 1000: must wait
+        )
+        time.sleep(0.2)
+        assert big.state is QueryState.QUEUED
+        assert svc.admission.stats()["headroom_waits"] > 0
+        release.set()
+        svc.result(big.query_id, timeout=60)
+        assert big.state is QueryState.DONE
+        svc.result(qb.query_id, timeout=60)
+
+
+def test_larger_than_budget_query_runs_alone():
+    tracker = DeviceMemoryTracker(budget=1000)
+    with QueryService(
+        max_concurrency=2, enable_cache=False, device_tracker=tracker
+    ) as svc:
+        q = svc.submit_plan(
+            MemoryScanExec(
+                [[ColumnBatch.from_pydict({"a": [1, 2]})]],
+                ColumnBatch.from_pydict({"a": [1]}).schema,
+            ),
+            estimated_bytes=50_000,  # way over budget; idle device
+        )
+        svc.result(q.query_id, timeout=60)
+        assert q.state is QueryState.DONE
+
+
+def test_queue_overflow_rejected():
+    release = threading.Event()
+    blocker = GatedScan(release)
+    try:
+        with QueryService(
+            max_concurrency=1, max_queue_depth=1, enable_cache=False
+        ) as svc:
+            svc.submit_plan(blocker, estimated_bytes=0)
+            assert wait_for(lambda: blocker.started.is_set())
+            mk = lambda: MemoryScanExec(  # noqa: E731
+                [[ColumnBatch.from_pydict({"a": [1]})]],
+                ColumnBatch.from_pydict({"a": [1]}).schema,
+            )
+            q2 = svc.submit_plan(mk(), estimated_bytes=0)
+            q3 = svc.submit_plan(mk(), estimated_bytes=0)
+            assert q2.state is QueryState.QUEUED
+            assert q3.state is QueryState.REJECTED_OVERLOADED
+            assert "queue full" in q3.error
+            with pytest.raises(RuntimeError, match="REJECTED"):
+                svc.result(q3.query_id, timeout=5)
+            release.set()
+            svc.result(q2.query_id, timeout=60)
+    finally:
+        release.set()
+
+
+def test_cancel_queued_and_running():
+    release = threading.Event()
+    blocker = GatedScan(release)
+    try:
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            qr = svc.submit_plan(blocker, estimated_bytes=0)
+            assert wait_for(lambda: blocker.started.is_set())
+            queued = svc.submit_plan(
+                MemoryScanExec(
+                    [[ColumnBatch.from_pydict({"a": [1]})]],
+                    ColumnBatch.from_pydict({"a": [1]}).schema,
+                ),
+                estimated_bytes=0,
+            )
+            svc.cancel(queued.query_id)
+            assert queued.state is QueryState.CANCELLED
+            # running: the gated generator must be CLOSED (the
+            # executor's GeneratorExit pass-through), not abandoned
+            svc.cancel(qr.query_id)
+            assert wait_for(lambda: qr.state is QueryState.CANCELLED)
+            assert wait_for(lambda: blocker.closed.is_set())
+            with pytest.raises(QueryCancelled):
+                svc.result(qr.query_id, timeout=5)
+            # the engine is not poisoned: new queries still run
+            ok = svc.submit_plan(
+                MemoryScanExec(
+                    [[ColumnBatch.from_pydict({"a": [7]})]],
+                    ColumnBatch.from_pydict({"a": [1]}).schema,
+                ),
+                estimated_bytes=0,
+            )
+            svc.result(ok.query_id, timeout=60)
+            assert ok.state is QueryState.DONE
+    finally:
+        release.set()
+
+
+def test_deadline_queued_and_running():
+    release = threading.Event()
+    blocker = GatedScan(release)
+    try:
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            svc.submit_plan(blocker, estimated_bytes=0)
+            assert wait_for(lambda: blocker.started.is_set())
+            queued = svc.submit_plan(
+                MemoryScanExec(
+                    [[ColumnBatch.from_pydict({"a": [1]})]],
+                    ColumnBatch.from_pydict({"a": [1]}).schema,
+                ),
+                deadline_s=0.05,
+                estimated_bytes=0,
+            )
+            assert wait_for(
+                lambda: queued.state is QueryState.TIMED_OUT
+            )
+            assert "queued" in queued.error
+        # running deadline: the query IS the gated scan
+        release2 = threading.Event()
+        slow = GatedScan(release2)
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            q = svc.submit_plan(
+                slow, deadline_s=0.1, estimated_bytes=0
+            )
+            assert wait_for(lambda: q.state is QueryState.TIMED_OUT)
+            assert slow.closed.is_set()
+    finally:
+        release.set()
+
+
+def test_decode_failure_reports_failed():
+    with QueryService(max_concurrency=1) as svc:
+        q = svc.submit_task(b"\x00garbage")
+        assert q.state is QueryState.FAILED
+        assert "decode failed" in q.error
+
+
+def test_illegal_transition_raises():
+    from blaze_tpu.service.query import Query
+
+    q = Query(task_bytes=b"x")
+    q.transition(QueryState.ADMITTED)
+    with pytest.raises(RuntimeError, match="illegal query transition"):
+        q.transition(QueryState.DONE)  # must pass through RUNNING
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+def test_repeated_task_hits_cache_zero_dispatches(parquet_task):
+    """ISSUE 2 acceptance: a repeated identical query is served from
+    the result cache with ZERO device dispatches."""
+    _, blob = parquet_task()
+    with QueryService(max_concurrency=1) as svc:
+        q1 = svc.submit_task(blob)
+        r1 = svc.result(q1.query_id, timeout=120)
+        before = dispatch.snapshot()
+        q2 = svc.submit_task(blob)
+        r2 = svc.result(q2.query_id, timeout=120)
+        delta = {
+            k: v - before.get(k, 0)
+            for k, v in dispatch.snapshot().items()
+            if v - before.get(k, 0)
+        }
+        assert not any(
+            k.startswith(("dispatches", "h2d", "d2h", "kernel"))
+            for k in delta
+        ), f"cache hit must not touch the device: {delta}"
+        assert q2.ctx.metrics.counters.get("cache_hits") == 1
+        assert svc.cache.stats()["hits"] == 1
+    t1 = pa.Table.from_batches(r1).to_pydict()
+    t2 = pa.Table.from_batches(r2).to_pydict()
+    assert t1 == t2
+
+
+def test_cache_bypass_when_disabled(parquet_task):
+    _, blob = parquet_task()
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        q1 = svc.submit_task(blob)
+        svc.result(q1.query_id, timeout=120)
+        q2 = svc.submit_task(blob)
+        svc.result(q2.query_id, timeout=120)
+        assert q2.ctx.metrics.counters.get("cache_hits") is None
+        assert q2.ctx.metrics.counters.get(
+            "dispatch.dispatches", 0
+        ) > 0
+
+
+def test_cache_ttl_expiry():
+    pool = MemoryPool(budget=1 << 30)
+    cache = ResultCache(max_bytes=1 << 20, ttl_s=0.05, pool=pool)
+    rb = pa.record_batch({"a": pa.array([1, 2, 3], pa.int64())})
+    cache.put(("fp", 0), [rb])
+    assert cache.get(("fp", 0)) is not None
+    time.sleep(0.1)
+    assert cache.get(("fp", 0)) is None  # expired
+    st = cache.stats()
+    assert st["evictions"] == 1 and st["entries"] == 0
+    cache.close()
+
+
+def test_cache_lru_eviction():
+    pool = MemoryPool(budget=1 << 30)
+    rb = pa.record_batch(
+        {"a": pa.array(np.arange(100, dtype=np.int64))}
+    )
+    cache = ResultCache(
+        max_bytes=int(rb.nbytes * 2.5), ttl_s=60, pool=pool
+    )
+    cache.put(("a", 0), [rb])
+    cache.put(("b", 0), [rb])
+    assert cache.get(("a", 0)) is not None  # 'a' now MRU
+    cache.put(("c", 0), [rb])               # evicts LRU = 'b'
+    assert cache.get(("b", 0)) is None
+    assert cache.get(("a", 0)) is not None
+    assert cache.get(("c", 0)) is not None
+    cache.close()
+
+
+def test_cache_spill_restore_through_memory_pool(tmp_path):
+    """The cache rides the host->disk rung of the spill ladder: under
+    MemoryPool pressure entries move to segmented-IPC files and hits
+    restore them transparently."""
+    rb = pa.record_batch(
+        {"a": pa.array(np.arange(1000, dtype=np.int64))}
+    )
+    pool = MemoryPool(budget=rb.nbytes // 2)  # any put overflows
+    cache = ResultCache(
+        max_bytes=1 << 20, ttl_s=60, pool=pool,
+        spill_dir=str(tmp_path),
+    )
+    cache.put(("fp", 0), [rb])
+    assert cache.counters["spills"] >= 1
+    assert pool.spill_count >= 1
+    got = cache.get(("fp", 0))
+    assert got is not None and got[0].equals(rb)
+    assert cache.counters["restores"] >= 1
+    cache.close()
+
+
+def test_cache_invalidate():
+    pool = MemoryPool(budget=1 << 30)
+    cache = ResultCache(max_bytes=1 << 20, ttl_s=60, pool=pool)
+    rb = pa.record_batch({"a": pa.array([1], pa.int64())})
+    cache.put(("plan-x", 0), [rb])
+    cache.put(("plan-x", 1), [rb])
+    cache.put(("plan-y", 0), [rb])
+    assert cache.invalidate("plan-x") == 2
+    assert cache.get(("plan-x", 0)) is None
+    assert cache.get(("plan-y", 0)) is not None
+    assert cache.invalidate() == 1  # everything else
+    cache.close()
+
+
+def test_unstable_fingerprint_never_cached():
+    cb = ColumnBatch.from_pydict({"a": [1, 2, 3]})
+    op = MemoryScanExec([[cb]], cb.schema)
+    with QueryService(max_concurrency=1) as svc:
+        q = svc.submit_plan(op)
+        svc.result(q.query_id, timeout=60)
+        assert svc.cache.stats()["puts"] == 0
+
+
+def test_estimate_plan_device_bytes(parquet_task):
+    plan, _ = parquet_task()
+    est = estimate_plan_device_bytes(plan)
+    assert est > 0  # parquet file bytes flow up the tree
+    cb = ColumnBatch.from_pydict({"a": list(range(100))})
+    mem = MemoryScanExec([[cb]], cb.schema)
+    assert estimate_plan_device_bytes(mem) > 0
