@@ -115,6 +115,18 @@ class HostEvaluator:
             safe = pc.if_else(zero, pa.scalar(1, type=r.type), r)
             out = pc.divide(l, safe)
             return pc.if_else(zero, pa.nulls(self.length, out.type), out)
+        if e.op is Op.MOD:
+            # Spark %: truncated remainder, sign of the dividend
+            # (device parity: lax.rem in exprs/eval.py _mod);
+            # mod-by-zero -> NULL. pyarrow has no modulo kernel, so
+            # build it from trunc-division: l - trunc(l/r)*r.
+            zero = pc.equal(r, pa.scalar(0, type=r.type))
+            safe = pc.if_else(zero, pa.scalar(1, type=r.type), r)
+            quot = pc.divide(l, safe)  # integer divide truncates
+            if pa.types.is_floating(quot.type):
+                quot = pc.trunc(quot)
+            rem = pc.subtract(l, pc.multiply(quot, safe))
+            return pc.if_else(zero, pa.nulls(self.length, rem.type), rem)
         raise NotImplementedError(f"host binary {e.op}")
 
     def _scalar_fn(self, e: ir.ScalarFn) -> pa.Array:

@@ -22,6 +22,7 @@ from blaze_tpu.types import Schema, from_arrow_schema
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.exprs import ir
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.testing import chaos
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +153,13 @@ class ParquetScanExec(PhysicalOp):
 
         def decode() -> Iterator[ColumnBatch]:
             for fr in self.file_groups[partition]:
+                if chaos.ACTIVE:
+                    # chaos seam: parquet decode / object-store read
+                    # failure for this file range
+                    chaos.fire(
+                        "parquet.decode", partition=partition,
+                        path=fr.path,
+                    )
                 # all byte IO flows through the object-store seam (the
                 # reference's registered ObjectStore, exec.rs:96-103)
                 pf = pq.ParquetFile(

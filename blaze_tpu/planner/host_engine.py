@@ -241,6 +241,143 @@ def _execute_window(node: S.WindowSpec) -> pd.DataFrame:
     raise NotImplementedError(fn)
 
 
+# ---------------------------------------------------------------------------
+# graceful degradation: native partition -> host re-execution
+# ---------------------------------------------------------------------------
+
+
+def op_to_spec(op: PhysicalOp,
+               partition: Optional[int] = None) -> Optional[S.PlanSpec]:
+    """Best-effort reverse mapping PhysicalOp -> PlanSpec so a partition
+    that failed RESOURCE_EXHAUSTED on device can re-run through the
+    pandas host engine (the native->Spark degradation analog,
+    SURVEY 5.3). `partition` narrows leaf scans to ONE partition's
+    inputs; interior ops keep their (already bound) expressions - the
+    host evaluator resolves BoundCol positionally against the same
+    child schema order the device tier used.
+
+    Returns None when any node has no host equivalent (fused pipelines,
+    partial/final aggregates, exchanges); the caller then re-raises the
+    original device error instead of degrading."""
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.hash_aggregate import AggMode, HashAggregateExec
+    from blaze_tpu.ops.limit import LimitExec
+    from blaze_tpu.ops.memory_scan import MemoryScanExec
+    from blaze_tpu.ops.parquet_scan import ParquetScanExec
+    from blaze_tpu.ops.project import ProjectExec
+    from blaze_tpu.ops.sort import SortExec
+    from blaze_tpu.ops.union import CoalescePartitionsExec, UnionExec
+
+    if isinstance(op, HostFallbackExec):
+        return op.node
+    if isinstance(op, ParquetScanExec):
+        groups = op.file_groups
+        if partition is not None:
+            if partition >= len(groups):
+                # partition index does not line up with this leaf -
+                # refusing beats silently un-narrowing (which would
+                # duplicate every other partition's rows)
+                return None
+            groups = [groups[partition]]
+        # the pruning predicate is an OPTIMIZATION derived from the
+        # filter above the scan; dropping it is safe (the filter
+        # re-applies), keeping it as a data filter would not be
+        return S.ScanSpec(
+            file_groups=groups, projection=op.projection,
+        )
+    if isinstance(op, MemoryScanExec):
+        parts = op.partitions
+        if partition is not None:
+            if partition >= len(parts):
+                return None  # see the parquet-leaf guard above
+            parts = [parts[partition]]
+        frames = [
+            cb.to_arrow().to_pandas() for part in parts for cb in part
+        ]
+        if frames:
+            df = pd.concat(frames, ignore_index=True)
+        else:
+            from blaze_tpu.types import to_arrow_schema
+
+            df = pa.Table.from_batches(
+                [], to_arrow_schema(op.schema)
+            ).to_pandas()
+        return S.MemorySpec(dataframe=df)
+    if isinstance(op, CoalescePartitionsExec):
+        # coalesce = every child partition, concatenated
+        return op_to_spec(op.children[0], None)
+    if isinstance(op, UnionExec):
+        if partition is None:
+            kids = [op_to_spec(c, None) for c in op.children]
+            if any(k is None for k in kids):
+                return None
+            return S.UnionSpec(children=kids)
+        # a union partition IS one child partition (positional append,
+        # ops/union.py execute): translate the union-global index to
+        # (child, local partition) and degrade just that subtree
+        for child in op.children:
+            n = child.partition_count
+            if partition < n:
+                return op_to_spec(child, partition)
+            partition -= n
+        return None  # index out of range: refuse
+    child = (
+        op_to_spec(op.children[0], partition) if op.children else None
+    )
+    if op.children and child is None:
+        return None
+    if isinstance(op, FilterExec):
+        return S.FilterSpec(children=[child], predicate=op.predicate)
+    if isinstance(op, ProjectExec):
+        return S.ProjectSpec(children=[child], exprs=list(op.exprs))
+    if isinstance(op, SortExec):
+        return S.SortSpec(
+            children=[child],
+            keys=[(k.expr, k.ascending, k.nulls_first)
+                  for k in op.keys],
+            fetch=op.fetch,
+        )
+    if isinstance(op, LimitExec):
+        return S.LimitSpec(children=[child], limit=op.limit)
+    if isinstance(op, HashAggregateExec):
+        if op.mode is not AggMode.COMPLETE:
+            return None  # partial/final splice states positionally
+        return S.AggSpec(
+            children=[child], keys=list(op.keys),
+            aggs=list(op.aggs), mode="complete",
+        )
+    return None
+
+
+def execute_partition_host(op: PhysicalOp, partition: int,
+                           ctx: ExecContext) -> List[pa.RecordBatch]:
+    """Degradation entry: re-execute ONE partition of a native plan on
+    the host engine, returning Arrow batches cast to the plan's
+    schema. Raises NotImplementedError when the tree has no host
+    mapping - callers treat that as 'degradation unavailable' and
+    surface the original device error."""
+    spec = op_to_spec(op, partition)
+    if spec is None:
+        raise NotImplementedError(
+            f"no host mapping for {type(op).__name__} tree"
+        )
+    from blaze_tpu.types import to_arrow_schema
+
+    df = execute_host(spec)
+    ctx.metrics.add("degraded_rows", len(df))
+    target = to_arrow_schema(op.schema)
+    tbl = pa.Table.from_pandas(df, preserve_index=False)
+    if tbl.schema != target:
+        tbl = tbl.rename_columns(target.names).cast(target)
+    out = []
+    for rb in tbl.to_batches(max_chunksize=ctx.config.batch_size):
+        if rb.num_rows:
+            ctx.metrics.add("output_rows", rb.num_rows)
+            ctx.metrics.add("output_batches", 1)
+            out.append(rb)
+    return out
+
+
 class HostFallbackExec(PhysicalOp):
     """Run a PlanSpec subtree on the host engine and re-enter the native
     tier as device batches (ConvertToNative analog)."""

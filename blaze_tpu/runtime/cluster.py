@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence
 import pyarrow as pa
 
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.testing import chaos
 
 
 class MiniCluster:
@@ -39,14 +40,27 @@ class MiniCluster:
 
     def __init__(self, num_workers: int = 2,
                  spool_dir: Optional[str] = None,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 task_max_attempts: int = 2,
+                 quarantine_after: int = 2):
         self.num_workers = num_workers
         self.spool = spool_dir or tempfile.mkdtemp(prefix="blz-cluster-")
         os.makedirs(os.path.join(self.spool, "tasks"), exist_ok=True)
         os.makedirs(os.path.join(self.spool, "claimed"), exist_ok=True)
         os.makedirs(os.path.join(self.spool, "out"), exist_ok=True)
+        os.makedirs(os.path.join(self.spool, "quarantine"),
+                    exist_ok=True)
         self._procs: List[subprocess.Popen] = []
         self._env = env
+        # failure policy (blaze_tpu/errors.py): a TRANSIENT-classified
+        # task failure is re-spooled up to task_max_attempts total; a
+        # worker that reports quarantine_after FATAL_FOR_WORKER
+        # failures (INTERNAL / RESOURCE_EXHAUSTED - the worker itself
+        # is suspect) gets a quarantine marker and stops claiming
+        self.task_max_attempts = max(1, int(task_max_attempts))
+        self.quarantine_after = max(1, int(quarantine_after))
+        self._worker_failures: dict = {}
+        self.quarantined: List[str] = []
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -118,6 +132,7 @@ class MiniCluster:
         last_progress = time.time()
         tables: List[Optional[pa.Table]] = [None] * len(ids)
         pending = set(range(len(ids)))
+        attempts = [1] * len(ids)
         claimed_dir = os.path.join(self.spool, "claimed")
         while pending:
             now = time.time()
@@ -137,14 +152,59 @@ class MiniCluster:
                     f"tasks incomplete: {pending} (no worker progress "
                     f"for {now - last_progress:.0f}s)"
                 )
+            if (
+                len(self.quarantined) >= self.num_workers
+                and all(
+                    os.path.exists(
+                        os.path.join(self.spool, "tasks", ids[i])
+                    )
+                    for i in pending
+                )
+            ):
+                # every slot is quarantined and every pending task is
+                # sitting unclaimed: nothing can make progress - fail
+                # now instead of burning the full inactivity timeout
+                raise RuntimeError(
+                    f"all {self.num_workers} worker slots quarantined "
+                    f"with {len(pending)} tasks unclaimed"
+                )
             for i in list(pending):
                 done = os.path.join(self.spool, "out", ids[i] + ".done")
                 err = os.path.join(self.spool, "out", ids[i] + ".err")
                 if os.path.exists(err):
                     with open(err) as f:
-                        raise RuntimeError(
-                            f"worker task failed: {f.read()}"
+                        info = _parse_err(f.read())
+                    # quarantine accounting FIRST, so a wedged worker
+                    # stops claiming before the re-spooled task lands
+                    # back in the pool (in-run protection, not just
+                    # across runs)
+                    self._note_worker_failure(info)
+                    if (
+                        info["class"] != "PLAN_INVALID"
+                        and attempts[i] < self.task_max_attempts
+                    ):
+                        # classified retry: TRANSIENT plausibly clears
+                        # on re-run; fatal classes get one shot on a
+                        # (possibly different, post-quarantine) worker.
+                        # PLAN_INVALID never retries - the task is bad,
+                        # not the worker.
+                        attempts[i] += 1
+                        os.unlink(err)
+                        tmp = os.path.join(
+                            self.spool, "tasks", f".{ids[i]}.tmp"
                         )
+                        with open(tmp, "wb") as f:
+                            f.write(task_blobs[i])
+                        os.replace(
+                            tmp,
+                            os.path.join(self.spool, "tasks", ids[i]),
+                        )
+                        last_progress = time.time()
+                        continue
+                    raise RuntimeError(
+                        f"worker task failed [{info['class']}]: "
+                        f"{info['error']}"
+                    )
                 if os.path.exists(done):
                     with open(
                         os.path.join(self.spool, "out", ids[i] + ".ipc"),
@@ -168,12 +228,56 @@ class MiniCluster:
             return tables, metas
         return tables  # type: ignore[return-value]
 
+    def _note_worker_failure(self, info: dict) -> None:
+        """Count classified-fatal failures per worker; after
+        quarantine_after of them the worker slot is quarantined (a
+        marker file its claim loop checks) - a wedged runtime must not
+        keep eating tasks the way a Spark executor blacklisted after
+        repeated task failures would."""
+        from blaze_tpu.errors import FATAL_FOR_WORKER, ErrorClass
+
+        wid = info.get("pid")
+        if wid is None:
+            return
+        try:
+            fatal = ErrorClass(info["class"]) in FATAL_FOR_WORKER
+        except ValueError:
+            fatal = True
+        if not fatal:
+            return
+        wid = str(wid)
+        self._worker_failures[wid] = (
+            self._worker_failures.get(wid, 0) + 1
+        )
+        if (
+            self._worker_failures[wid] >= self.quarantine_after
+            and wid not in self.quarantined
+        ):
+            open(
+                os.path.join(self.spool, "quarantine", wid), "w"
+            ).close()
+            self.quarantined.append(wid)
+
     def __enter__(self):
         self.start()
         return self
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def _parse_err(text: str) -> dict:
+    """Decode a worker .err payload. Workers write JSON
+    {pid, class, error, traceback}; plain text (older workers, partial
+    writes) degrades to an INTERNAL classification."""
+    try:
+        info = json.loads(text)
+        if isinstance(info, dict) and "class" in info:
+            info.setdefault("error", "")
+            return info
+    except (ValueError, TypeError):
+        pass
+    return {"pid": None, "class": "INTERNAL", "error": text}
 
 
 # ---------------------------------------------------------------------------
@@ -201,6 +305,13 @@ class _Heartbeat:
 
     def _run(self):
         while not self._stop.wait(_HEARTBEAT_S):
+            if chaos.ACTIVE:
+                try:
+                    # chaos seam: a stalled/dead heartbeat thread - the
+                    # driver's progress-aware liveness must notice
+                    chaos.fire("cluster.heartbeat", path=self._path)
+                except Exception:  # noqa: BLE001 - injected stall
+                    return
             try:
                 os.utime(self._path)
             except OSError:
@@ -281,7 +392,16 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
     tasks_dir = os.path.join(spool, "tasks")
     claimed_dir = os.path.join(spool, "claimed")
     out_dir = os.path.join(spool, "out")
+    quarantine_marker = os.path.join(
+        spool, "quarantine", str(os.getpid())
+    )
     while not os.path.exists(os.path.join(spool, "SHUTDOWN")):
+        if os.path.exists(quarantine_marker):
+            # the driver quarantined this slot after repeated
+            # classified-fatal failures: stop claiming, keep serving
+            # already-written shuffle blocks until shutdown
+            time.sleep(0.2)
+            continue
         claimed = None
         for name in sorted(os.listdir(tasks_dir)):
             if name.startswith("."):
@@ -298,6 +418,16 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
             time.sleep(0.05)
             continue
         name, path = claimed
+        if os.path.exists(quarantine_marker):
+            # quarantined between the loop-top check and the claim
+            # (the driver writes the marker BEFORE re-spooling a
+            # failed task): return the task for a healthy worker
+            # instead of burning its retry budget here
+            try:
+                os.replace(path, os.path.join(tasks_dir, name))
+            except OSError:
+                pass
+            continue
         try:
             with open(path, "rb") as f:
                 blob = f.read()
@@ -328,11 +458,24 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
             ) as f:
                 json.dump(meta, f)
             open(os.path.join(out_dir, name + ".done"), "w").close()
-        except Exception as e:  # report back to the driver
+        except Exception as e:  # report back to the driver, classified
             import traceback
 
-            with open(os.path.join(out_dir, name + ".err"), "w") as f:
-                f.write(f"{e}\n{traceback.format_exc()}")
+            from blaze_tpu.errors import classify
+
+            payload = {
+                "pid": os.getpid(),
+                "class": classify(e).value,
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc(),
+            }
+            # atomic publish (like the task spool): the driver polls
+            # every 50ms and a torn read would misclassify a TRANSIENT
+            # failure as run-fatal INTERNAL
+            tmp = os.path.join(out_dir, f".{name}.err.tmp")
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, os.path.join(out_dir, name + ".err"))
     server.stop()
     return 0
 

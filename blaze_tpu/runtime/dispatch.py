@@ -86,7 +86,14 @@ class counting:
 
 
 def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
+    from blaze_tpu.testing import chaos
+
     def wrapped(*args, **kw):
+        if chaos.ACTIVE:
+            # chaos seam: a compiled-kernel invocation that throws
+            # (device reset, interconnect error) - off path is one
+            # module-attribute load
+            chaos.fire("kernel.dispatch", kind=kind)
         record(kind)
         return fn(*args, **kw)
 

@@ -20,8 +20,10 @@ from typing import Iterator, List, Optional
 import pyarrow as pa
 
 from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.errors import ErrorClass, classify
 from blaze_tpu.ops.base import ExecContext, MetricNode, PhysicalOp
 from blaze_tpu.ops.util import ensure_compacted
+from blaze_tpu.testing import chaos
 
 log = logging.getLogger("blaze_tpu.executor")
 
@@ -45,6 +47,12 @@ class TaskExecutionError(RuntimeError):
         self.task_id = task_id
         self.partition = partition
         self.__cause__ = cause
+
+    @property
+    def error_class(self) -> ErrorClass:
+        """Failure taxonomy class of the wrapped cause (the raise-site
+        classification the scheduler's retry policy keys on)."""
+        return classify(self)
 
 
 def prepare_decoded_task(decoded, ctx: ExecContext):
@@ -142,6 +150,14 @@ def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
     counter = dispatch.counting()
     counter.__enter__()
     try:
+        if chaos.ACTIVE:
+            # the generic per-partition fault seam (chaos harness);
+            # inside the try so an injected fault is classified and
+            # wrapped exactly like a real operator failure
+            chaos.fire(
+                "task.execute", partition=partition,
+                task_id=ctx.task_id,
+            )
         for cb in op.execute(partition, ctx):
             cb = ensure_compacted(cb)
             if cb.num_rows == 0:

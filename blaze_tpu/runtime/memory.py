@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Dict, List
 
 from blaze_tpu.config import get_config
+from blaze_tpu.testing import chaos
 
 
 class MemoryPool:
@@ -100,6 +101,11 @@ class DeviceMemoryTracker:
         return int(get_config().device_memory_budget)
 
     def track(self, op_id: int, nbytes: int) -> None:
+        if chaos.ACTIVE:
+            # chaos seam: device-memory-pressure at the HBM accounting
+            # boundary (a RESOURCE_EXHAUSTED fault here drives the
+            # host-engine degradation path)
+            chaos.fire("device.memory", op_id=op_id, nbytes=nbytes)
         with self._lock:
             self._used[op_id] = self._used.get(op_id, 0) + nbytes
             self.high_water = max(self.high_water, self.total_unlocked())

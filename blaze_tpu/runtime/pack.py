@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from blaze_tpu.runtime.dispatch import cached_kernel, record
+from blaze_tpu.testing import chaos
 
 
 def _np_dtype(a) -> np.dtype:
@@ -120,6 +121,10 @@ def put_packed(arrays: Sequence[np.ndarray]) -> List[jax.Array]:
     """Move host arrays to device in ONE transfer + ONE split dispatch."""
     if not arrays:
         return []
+    if chaos.ACTIVE:
+        # chaos seam: the host->device staging transfer fails (a
+        # network-attached device drops the RPC)
+        chaos.fire("h2d.transfer", n_arrays=len(arrays))
     pairs = _f64_pairs()
     metas = tuple((str(_np_dtype(a)), tuple(a.shape)) for a in arrays)
     parts = []

@@ -1,4 +1,5 @@
-"""Local task scheduler: concurrent partition execution with retries.
+"""Local task scheduler: concurrent partition execution with
+CLASSIFIED retries.
 
 Plays Spark's executor role for standalone/local runs, the way the
 reference's TPC-DS CI exercises its whole distributed path with local-mode
@@ -7,23 +8,39 @@ dispatch is async so threads overlap host decode/IPC work with device
 compute), failed tasks retry like Spark's task retry (SURVEY 5.3), results
 stream back in partition order.
 
-Failure semantics: the FIRST task to exhaust its retries fails the plan
-immediately - outstanding sibling tasks are cancelled (queued ones never
-start; running ones observe the cancel event at their next batch
-boundary and unwind through the executor's GeneratorExit cancellation
-pass-through, runtime/executor.py), instead of running to completion
-against a plan that already failed.
+Failure semantics (blaze_tpu/errors.py taxonomy):
+
+  TRANSIENT           retried up to max_attempts with exponential
+                      backoff + jitter (immediate re-runs hammered the
+                      same flaky resource and burned budget in bursts)
+  RESOURCE_EXHAUSTED  degraded: the partition re-executes through the
+                      pandas host engine (planner/host_engine.py) -
+                      the native->Spark fallback analog; the metric
+                      tree records `degraded_partitions`
+  PLAN_INVALID /      fail fast, zero retries - deterministic failures
+  INTERNAL            don't get cheaper the second time
+  CANCELLED           cooperative unwind, never counted as failure
+
+The FIRST task to fail fatally fails the plan immediately - outstanding
+sibling tasks are cancelled (queued ones never start; running ones
+observe the cancel event at their next batch boundary and unwind through
+the executor's GeneratorExit cancellation pass-through,
+runtime/executor.py), instead of running to completion against a plan
+that already failed.
 """
 
 from __future__ import annotations
 
 import concurrent.futures as cf
 import logging
+import random
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 import pyarrow as pa
 
+from blaze_tpu.errors import ErrorClass, classify, retry_action
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.runtime.executor import TaskExecutionError, execute_partition
 
@@ -35,18 +52,36 @@ class PlanCancelled(RuntimeError):
     partition's work was abandoned cooperatively."""
 
 
+def backoff_delay(attempt: int, base_s: float = 0.05,
+                  cap_s: float = 2.0) -> float:
+    """Exponential backoff with full jitter: uniform in
+    (0, min(cap, base * 2^attempt)]. Jitter decorrelates retries from
+    concurrent failed tasks - without it every sibling re-hits the
+    flaky resource in lockstep."""
+    hi = min(cap_s, base_s * (2 ** attempt))
+    return random.uniform(hi * 0.5, hi)
+
+
 def run_plan_parallel(
     op: PhysicalOp,
     ctx: Optional[ExecContext] = None,
     parallelism: int = 4,
     max_attempts: int = 3,
     cancel: Optional[threading.Event] = None,
+    retry_backoff_s: float = 0.05,
+    degrade_to_host: bool = True,
+    on_attempt: Optional[Callable[[dict], None]] = None,
 ) -> pa.Table:
     """Execute every partition on a thread pool and collect one table.
 
     `cancel` lets an embedder (the serving tier) abort the whole plan
     cooperatively. Fail-fast uses a separate INTERNAL event so a task
-    failure never mutates the caller's (possibly shared) event."""
+    failure never mutates the caller's (possibly shared) event.
+    `on_attempt` observes every failed attempt as a dict
+    {partition, attempt, error_class, error, action} - an embedder's
+    hook into the failure journal. (The serving tier drives partitions
+    itself for cache interleaving, so it applies the SAME policy via
+    errors.retry_action rather than calling this function.)"""
     ctx = ctx or ExecContext()
     abort = threading.Event()  # internal: first-failure fail-fast
 
@@ -55,8 +90,37 @@ def run_plan_parallel(
             cancel is not None and cancel.is_set()
         )
 
+    def note(p: int, attempt: int, ec: ErrorClass, e: BaseException,
+             action: str) -> None:
+        if on_attempt is not None:
+            on_attempt({
+                "partition": p, "attempt": attempt,
+                "error_class": ec.value, "error": str(e)[:300],
+                "action": action,
+            })
+
+    def degrade(p: int, cause: BaseException) -> List[pa.RecordBatch]:
+        """RESOURCE_EXHAUSTED: re-run the partition on the host engine
+        (graceful degradation). Raises the ORIGINAL error when the
+        tree has no host mapping."""
+        from blaze_tpu.planner.host_engine import execute_partition_host
+
+        try:
+            out = execute_partition_host(op, p, ctx)
+        except Exception as host_err:  # noqa: BLE001 - original wins
+            log.warning(
+                "host degradation of partition %d unavailable (%s); "
+                "surfacing original error", p, host_err,
+            )
+            raise cause
+        ctx.metrics.add("degraded_partitions", 1)
+        log.warning(
+            "partition %d degraded to host engine after "
+            "RESOURCE_EXHAUSTED: %s", p, cause,
+        )
+        return out
+
     def task(p: int) -> List[pa.RecordBatch]:
-        last: Optional[BaseException] = None
         for attempt in range(max_attempts):
             if cancelled():
                 raise PlanCancelled(f"partition {p} cancelled")
@@ -77,15 +141,48 @@ def run_plan_parallel(
             except PlanCancelled:
                 raise
             except TaskExecutionError as e:
-                last = e
-                ctx.metrics.add("task_retries", 1)
-                log.warning(
-                    "task for partition %d failed (attempt %d): %s",
-                    p, attempt + 1, e,
+                if out:
+                    # drop the abandoned attempt's partial output from
+                    # the counters; a retry/degrade re-counts it
+                    ctx.metrics.add(
+                        "output_rows",
+                        -sum(rb.num_rows for rb in out),
+                    )
+                    ctx.metrics.add("output_batches", -len(out))
+                ec = classify(e)
+                action = retry_action(
+                    ec, attempt, max_attempts, degrade_to_host
                 )
+                if action == "cancel":
+                    raise PlanCancelled(
+                        f"partition {p} cancelled in-task"
+                    ) from e
+                note(p, attempt, ec, e, action)
+                if action == "degrade":
+                    return degrade(p, e)
+                if action == "fail":
+                    raise
+                ctx.metrics.add("task_retries", 1)
+                ctx.metrics.add("retries.transient", 1)
+                log.warning(
+                    "task for partition %d failed transiently "
+                    "(attempt %d): %s; backing off", p, attempt + 1, e,
+                )
+                # interruptible backoff: a sibling failure wakes the
+                # abort.wait immediately; the caller's cancel event is
+                # a separate object, so poll it on a short tick - the
+                # loop-top cancelled() check then unwinds
+                wake_at = time.monotonic() + backoff_delay(
+                    attempt, retry_backoff_s
+                )
+                while not cancelled():
+                    left = wake_at - time.monotonic()
+                    if left <= 0:
+                        break
+                    abort.wait(min(0.05, left))
             finally:
                 it.close()
-        raise last  # type: ignore[misc]
+        raise AssertionError("unreachable: attempt loop fell through")
 
     n = op.partition_count
     results: List[List[pa.RecordBatch]] = [[] for _ in range(n)]

@@ -5,8 +5,11 @@ Code map (details in docs/SERVICE.md):
   query.py     - Query record + lifecycle state machine
   admission.py - bounded priority queue, headroom + concurrency gates
   cache.py     - (fingerprint, partition) result cache, TTL/LRU/spill
-  service.py   - QueryService: submit/poll/result/cancel/report
+  service.py   - QueryService: submit/poll/result/cancel/report, with
+                 classified retries / host degradation
+                 (blaze_tpu/errors.py taxonomy, docs/ROBUSTNESS.md)
   wire.py      - service verbs over the gateway socket + ServiceClient
+                 (reconnect-with-backoff, re-attach by query_id)
 """
 
 from blaze_tpu.service.admission import (

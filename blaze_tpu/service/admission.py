@@ -14,11 +14,19 @@ bytes (exec.rs:79-94). A standalone serving tier must grow both knobs:
     larger than the whole budget must still be servable - the spill
     ladder, not admission, handles its overflow).
 
-Ordering is strict: priority descending, FIFO within a priority class
-(submission sequence). The head of the queue blocks lower entries even
-when they would fit - bypass ("backfill") would starve big queries
-under a stream of small ones, and predictable ordering is worth more
-to a serving tier than peak packing.
+Ordering is strict: priority descending; WITHIN a priority class,
+earliest deadline first (EDF - the query with the least slack runs
+first, ROADMAP "deadline-aware scheduling"), with deadline-less
+queries after deadlined ones, FIFO among themselves (submission
+sequence). The head of the queue blocks lower entries even when they
+would fit - bypass ("backfill") would starve big queries under a
+stream of small ones, and predictable ordering is worth more to a
+serving tier than peak packing.
+
+Shedding: a query whose deadline has ALREADY passed at submit time
+cannot be met no matter what - the service refuses it up front
+(TIMED_OUT with a shed marker) instead of letting it occupy queue
+depth only to die in the deadline sweep.
 
 Backpressure is explicit: a full queue rejects at submit time
 (REJECTED_OVERLOADED) instead of building an unbounded pileup.
@@ -28,6 +36,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -95,15 +104,18 @@ class AdmissionController:
         self.max_queue_depth = max(1, int(max_queue_depth))
         self._lock = threading.Lock()
         self._seq = itertools.count()
-        # heap entries: (-priority, seq, query) - max-priority first,
-        # FIFO within a priority class via the submission sequence
-        self._heap: List[Tuple[int, int, Query]] = []
+        # heap entries: (-priority, deadline, seq, query) -
+        # max-priority first; within a priority class earliest
+        # deadline first (EDF; no deadline sorts last as +inf), FIFO
+        # via the submission sequence among equals
+        self._heap: List[Tuple[int, float, int, Query]] = []
         # reservations for admitted-but-not-yet-tracked device bytes
         self._reserved: Dict[str, int] = {}
         self.counters = {
             "submitted": 0,
             "admitted": 0,
             "rejected_overloaded": 0,
+            "shed_deadline": 0,
             "headroom_waits": 0,
         }
 
@@ -113,16 +125,29 @@ class AdmissionController:
         REJECTED_OVERLOADED - explicit backpressure)."""
         with self._lock:
             self.counters["submitted"] += 1
-            live = [e for e in self._heap if not e[2].done]
+            live = [e for e in self._heap if not e[-1].done]
             if len(live) >= self.max_queue_depth:
                 self.counters["rejected_overloaded"] += 1
                 return False
-            heapq.heappush(self._heap, (-q.priority, next(self._seq), q))
+            deadline = (
+                q.deadline_at if q.deadline_at is not None else math.inf
+            )
+            heapq.heappush(
+                self._heap,
+                (-q.priority, deadline, next(self._seq), q),
+            )
             return True
+
+    def note_shed(self) -> None:
+        """The service shed a query at admission (deadline already
+        unmeetable); recorded here so stats() tells the whole story."""
+        with self._lock:
+            self.counters["submitted"] += 1
+            self.counters["shed_deadline"] += 1
 
     def queue_depth(self) -> int:
         with self._lock:
-            return sum(1 for e in self._heap if not e[2].done)
+            return sum(1 for e in self._heap if not e[-1].done)
 
     def running_count(self) -> int:
         with self._lock:
@@ -135,7 +160,7 @@ class AdmissionController:
         (cancelled/timed out while queued) are dropped on the way."""
         with self._lock:
             while self._heap:
-                q = self._heap[0][2]
+                q = self._heap[0][-1]
                 if q.done:  # cancelled / timed out while queued
                     heapq.heappop(self._heap)
                     continue
@@ -165,7 +190,7 @@ class AdmissionController:
         with self._lock:
             return {
                 **self.counters,
-                "queued": sum(1 for e in self._heap if not e[2].done),
+                "queued": sum(1 for e in self._heap if not e[-1].done),
                 "running": len(self._reserved),
                 "reserved_bytes": sum(self._reserved.values()),
                 "headroom": self._tracker.headroom(),

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from blaze_tpu.testing import chaos
+
 CacheKey = Tuple[str, int]  # (plan fingerprint, partition id)
 
 
@@ -77,6 +79,7 @@ class ResultCache:
             "misses": 0,
             "evictions": 0,
             "spills": 0,
+            "spill_errors": 0,
             "restores": 0,
             "puts": 0,
         }
@@ -197,18 +200,45 @@ class ResultCache:
                     break
                 if e.batches is None:
                     continue
-                self._spill_entry(e)
+                try:
+                    self._spill_entry(e)
+                except Exception as err:  # noqa: BLE001 - degrade
+                    # a spill IO failure (disk full, transient FS
+                    # error) must not fail the serving path: the entry
+                    # simply STAYS in memory and the pool gets less
+                    # relief - graceful degradation, observable via
+                    # the counter
+                    self.counters["spill_errors"] += 1
+                    import logging
+
+                    logging.getLogger("blaze_tpu.service").warning(
+                        "result-cache spill failed (entry kept in "
+                        "memory): %s", err,
+                    )
+                    continue
                 freed += e.nbytes
             return freed
 
     def _spill_entry(self, e: _Entry) -> None:
         from blaze_tpu.io.ipc import encode_ipc_segment
 
+        if chaos.ACTIVE:
+            # chaos seam: spill-file write failure
+            chaos.fire("cache.spill", dir=self._dir)
         self._spill_seq += 1
         path = os.path.join(self._dir, f"rc-{self._spill_seq}.seg")
-        with open(path, "wb") as f:
-            for rb in e.batches:
-                f.write(encode_ipc_segment(rb))
+        try:
+            with open(path, "wb") as f:
+                for rb in e.batches:
+                    f.write(encode_ipc_segment(rb))
+        except Exception:
+            # never leave a truncated spill file behind: a later
+            # restore would decode garbage
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
         e.path = path
         e.batches = None
         self.counters["spills"] += 1

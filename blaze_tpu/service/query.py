@@ -12,7 +12,7 @@ State machine (service/service.py drives it):
        |          |          |-----> FAILED
        |          |          |-----> CANCELLED
        |          |          '-----> TIMED_OUT
-       |          |-> CANCELLED | TIMED_OUT
+       |          |-> CANCELLED | TIMED_OUT | FAILED
        |-> CANCELLED | TIMED_OUT
     (submit may also refuse outright: REJECTED_OVERLOADED)
 
@@ -64,6 +64,7 @@ _ALLOWED = {
         QueryState.RUNNING,
         QueryState.CANCELLED,
         QueryState.TIMED_OUT,
+        QueryState.FAILED,  # admission-window failure (pre-execution)
     },
     QueryState.RUNNING: {
         QueryState.DONE,
@@ -122,6 +123,15 @@ class Query:
 
         self.state = QueryState.QUEUED
         self.error: Optional[str] = None
+        # failure taxonomy (blaze_tpu/errors.py): the class of the
+        # error that terminated the query, and the per-attempt journal
+        # the REPORT/wire surface ({partition, attempt, error_class,
+        # error, action: retry|degrade|fail})
+        self.error_class: Optional[str] = None
+        self.attempts: List[Dict] = []
+        # True when any partition re-executed through the host engine
+        # after RESOURCE_EXHAUSTED (the native->Spark fallback analog)
+        self.degraded = False
         self.result: Optional[List] = None  # pa.RecordBatch list
         self.ctx = ExecContext(task_id=self.query_id)
         # ONE metric tree per query: the executor adds `dispatch.*`
@@ -136,6 +146,7 @@ class Query:
 
         self._lock = threading.Lock()
         self._cancel = threading.Event()
+        self._cancel_reason: Optional[str] = None
         self._done = threading.Event()
         # service-filled (submit-time decode): the decoded task tuple,
         # plan fingerprint, and whether the fingerprint is
@@ -170,12 +181,24 @@ class Query:
             return True
 
     # -- cancellation / deadline ---------------------------------------
-    def request_cancel(self) -> None:
-        self._cancel.set()
+    def request_cancel(self, reason: str = "user") -> None:
+        """reason: 'user' | 'shutdown' | 'deadline'. The FIRST reason
+        wins - it decides whether the terminal state is CANCELLED
+        (user/shutdown intent) or TIMED_OUT (the deadline sweep fires
+        the same event, and a user cancel that narrowly precedes the
+        deadline must still report CANCELLED)."""
+        with self._lock:
+            if not self._cancel.is_set():
+                self._cancel_reason = reason
+            self._cancel.set()
 
     @property
     def cancel_requested(self) -> bool:
         return self._cancel.is_set()
+
+    @property
+    def cancel_reason(self) -> Optional[str]:
+        return self._cancel_reason
 
     def deadline_exceeded(self, now: Optional[float] = None) -> bool:
         return (
@@ -190,6 +213,26 @@ class Query:
             raise QueryCancelled(self.query_id)
         if self.deadline_exceeded():
             raise QueryCancelled(f"{self.query_id}: deadline")
+
+    def wait_cancel(self, timeout: float) -> bool:
+        """Interruptible sleep (retry backoff): returns True when the
+        cancel event fired during the wait."""
+        return self._cancel.wait(timeout)
+
+    # -- failure journal ------------------------------------------------
+    def record_attempt(self, partition: int, attempt: int,
+                       error_class: str, error: BaseException,
+                       action: str) -> None:
+        """Journal one failed execution attempt; travels the wire in
+        status() and renders in the REPORT."""
+        with self._lock:
+            self.attempts.append({
+                "partition": partition,
+                "attempt": attempt,
+                "error_class": error_class,
+                "error": str(error)[:300],
+                "action": action,
+            })
 
     # -- completion -----------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -210,6 +253,16 @@ class Query:
         }
         if self.error:
             out["error"] = self.error
+        if self.error_class:
+            out["error_class"] = self.error_class
+        if self.degraded:
+            out["degraded"] = True
+        if self.attempts:
+            with self._lock:
+                out["attempts"] = list(self.attempts)
+            out["retries"] = sum(
+                1 for a in out["attempts"] if a["action"] == "retry"
+            )
         if "admitted" in t:
             out["queue_wait_s"] = round(t["admitted"] - t["submitted"], 6)
         if "run_start" in t and "admitted" in t:

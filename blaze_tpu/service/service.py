@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from blaze_tpu.errors import ErrorClass, classify, retry_action
 from blaze_tpu.service.admission import (
     AdmissionController,
     estimate_plan_device_bytes,
@@ -41,6 +42,7 @@ from blaze_tpu.service.query import (
     QueryCancelled,
     QueryState,
 )
+from blaze_tpu.testing import chaos
 
 log = logging.getLogger("blaze_tpu.service")
 
@@ -56,12 +58,22 @@ class QueryService:
         enable_cache: bool = True,
         device_tracker=None,
         default_deadline_s: Optional[float] = None,
+        max_task_attempts: int = 3,
+        retry_backoff_s: float = 0.05,
+        degrade_to_host: bool = True,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
             max_concurrency=max_concurrency,
             max_queue_depth=max_queue_depth,
         )
+        # failure policy (blaze_tpu/errors.py taxonomy): TRANSIENT
+        # partition failures retry up to max_task_attempts with
+        # exponential backoff; RESOURCE_EXHAUSTED degrades to the host
+        # engine; PLAN_INVALID/INTERNAL fail fast
+        self.max_task_attempts = max(1, int(max_task_attempts))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.degrade_to_host = degrade_to_host
         self.cache = (
             cache if cache is not None
             else (ResultCache() if enable_cache else None)
@@ -125,6 +137,8 @@ class QueryService:
                 decoded = task_from_proto(task_bytes)
         except Exception as e:  # noqa: BLE001 - reported via state
             q.error = f"decode failed: {e!r}"
+            # undecodable bytes are a malformed plan by definition
+            q.error_class = ErrorClass.PLAN_INVALID.value
             q.transition(QueryState.FAILED)
             self._register(q)
             return q
@@ -171,6 +185,14 @@ class QueryService:
 
     def _enqueue(self, q: Query) -> Query:
         self._register(q)
+        if q.deadline_at is not None and q.deadline_exceeded():
+            # deadline shedding: a deadline that has already passed
+            # cannot be met - refuse up front instead of queueing work
+            # that the sweep will kill anyway
+            self.admission.note_shed()
+            q.error = "deadline unmeetable at admission (shed)"
+            q.transition(QueryState.TIMED_OUT)
+            return q
         if not self.admission.offer(q):
             q.error = (
                 f"queue full ({self.admission.max_queue_depth}); "
@@ -242,10 +264,19 @@ class QueryService:
             f"query {q.query_id}: {st['state']} "
             f"(priority={q.priority}, est_bytes={q.estimated_bytes})"
         ]
+        if st.get("error_class"):
+            head.append(f"  error_class={st['error_class']}")
+        if st.get("degraded"):
+            head.append("  degraded=True (host-engine fallback)")
         for k in ("queue_wait_s", "admission_s", "execution_s",
                   "stream_s"):
             if k in st:
                 head.append(f"  {k}={st[k]}")
+        for a in st.get("attempts", ()):
+            head.append(
+                f"  attempt p{a['partition']}#{a['attempt']}: "
+                f"{a['error_class']} -> {a['action']} ({a['error']})"
+            )
         body = render_metrics(q.metrics_root, indent="  ")
         return "\n".join(head) + ("\n" + body if body else "")
 
@@ -263,7 +294,7 @@ class QueryService:
         with self._lock:
             live = [q for q in self._queries.values() if not q.done]
         for q in live:
-            q.request_cancel()
+            q.request_cancel(reason="shutdown")
             if q.state is QueryState.QUEUED:
                 q.try_transition(QueryState.CANCELLED)
         with self._cv:
@@ -303,24 +334,53 @@ class QueryService:
     def _sweep_deadlines(self) -> None:
         now = time.monotonic()
         with self._lock:
-            queued = [
-                q for q in self._queries.values()
-                if q.state is QueryState.QUEUED
+            live = [
+                q for q in self._queries.values() if not q.done
             ]
-        for q in queued:
-            if q.deadline_exceeded(now):
+        for q in live:
+            if not q.deadline_exceeded(now):
+                continue
+            if q.state is QueryState.QUEUED:
                 if q.try_transition(QueryState.TIMED_OUT):
                     q.error = "deadline exceeded while queued"
+            elif q.state in (QueryState.ADMITTED, QueryState.RUNNING):
+                # propagate the cancel event so the run loop (or a
+                # retry-backoff wait) observes it promptly; the run
+                # loop itself performs the TIMED_OUT transition AFTER
+                # closing the operator generator, preserving the
+                # invariant that a terminal state implies cleaned-up
+                # execution resources
+                q.request_cancel(reason="deadline")
 
     # -- execution ------------------------------------------------------
     def _run_query(self, q: Query) -> None:
         try:
-            if q.cancel_requested:
+            if chaos.ACTIVE:
+                # chaos seam (STALL widens the ADMITTED->RUNNING window
+                # so cancellation races become deterministic tests); a
+                # RAISED fault here goes through the same taxonomy
+                # surfacing as any pre-execution failure
+                try:
+                    chaos.fire("service.admit", query_id=q.query_id)
+                except Exception as e:  # noqa: BLE001 - classified
+                    q.error = f"{type(e).__name__}: {e}"
+                    q.error_class = classify(e).value
+                    q.try_transition(QueryState.FAILED)
+                    return
+            # an explicit user/shutdown cancel wins over a deadline
+            # that elapsed concurrently; a sweep-fired ('deadline')
+            # cancel - or a bare deadline expiry - reports TIMED_OUT
+            if q.cancel_requested and q.cancel_reason in (
+                "user", "shutdown"
+            ):
                 if q.try_transition(QueryState.CANCELLED):
                     return
             if q.deadline_exceeded():
                 if q.try_transition(QueryState.TIMED_OUT):
                     q.error = "deadline exceeded before start"
+                    return
+            if q.cancel_requested:
+                if q.try_transition(QueryState.CANCELLED):
                     return
             if not q.try_transition(QueryState.RUNNING):
                 return
@@ -328,16 +388,24 @@ class QueryService:
             try:
                 q.result = self._execute(q)
             except QueryCancelled:
-                if q.cancel_requested:
+                if q.cancel_requested and q.cancel_reason in (
+                    "user", "shutdown"
+                ):
                     q.try_transition(QueryState.CANCELLED)
-                else:
+                elif q.deadline_exceeded():
                     q.error = "deadline exceeded while running"
                     q.try_transition(QueryState.TIMED_OUT)
+                else:
+                    q.try_transition(QueryState.CANCELLED)
                 return
             except Exception as e:  # noqa: BLE001 - reported via state
                 q.error = f"{type(e).__name__}: {e}"
+                q.error_class = classify(e).value
                 q.try_transition(QueryState.FAILED)
-                log.warning("query %s failed: %s", q.query_id, q.error)
+                log.warning(
+                    "query %s failed [%s]: %s",
+                    q.query_id, q.error_class, q.error,
+                )
                 return
             q.try_transition(QueryState.DONE)
         finally:
@@ -389,11 +457,98 @@ class QueryService:
                 if q.ctx.config.collect_metrics:
                     prepared = instrument(prepared, q.metrics_root)
                 exec_op = prepared
-            part_batches = self._drain(q, exec_op, p)
-            if cache is not None:
+            part_batches, degraded = self._run_partition(
+                q, exec_op, p
+            )
+            if cache is not None and not degraded:
+                # degraded results are correct but host-produced;
+                # keeping them out of the cache preserves device-result
+                # provenance and lets a healthy re-run repopulate it
                 cache.put(key, part_batches)
             out.extend(part_batches)
         return out
+
+    def _run_partition(self, q: Query, op, partition: int):
+        """One partition with CLASSIFIED failure handling
+        (blaze_tpu/errors.py): TRANSIENT retries with exponential
+        backoff + jitter (cancel-interruptible), RESOURCE_EXHAUSTED
+        degrades through the host engine, PLAN_INVALID/INTERNAL fail
+        fast with zero retries. Returns (batches, degraded)."""
+        from blaze_tpu.runtime.scheduler import backoff_delay
+
+        for attempt in range(self.max_task_attempts):
+            q.check_interrupt()
+            try:
+                return self._drain(q, op, partition), False
+            except QueryCancelled:
+                raise
+            except Exception as e:  # noqa: BLE001 - classified below
+                ec = classify(e)
+                action = retry_action(
+                    ec, attempt, self.max_task_attempts,
+                    self.degrade_to_host,
+                )
+                if action == "cancel":
+                    raise QueryCancelled(q.query_id) from e
+                q.record_attempt(partition, attempt, ec.value, e,
+                                 action)
+                if action == "degrade":
+                    return self._degrade_partition(q, partition, e), \
+                        True
+                if action == "fail":
+                    raise
+                q.ctx.metrics.add("task_retries", 1)
+                q.ctx.metrics.add("retries.transient", 1)
+                log.warning(
+                    "query %s partition %d failed transiently "
+                    "(attempt %d), backing off: %s",
+                    q.query_id, partition, attempt + 1, e,
+                )
+                if q.wait_cancel(
+                    backoff_delay(attempt, self.retry_backoff_s)
+                ):
+                    raise QueryCancelled(q.query_id) from e
+        raise AssertionError("unreachable: attempt loop fell through")
+
+    def _degrade_partition(self, q: Query, partition: int,
+                           cause: BaseException) -> List:
+        """RESOURCE_EXHAUSTED degradation: re-execute the partition
+        through the pandas host engine against an UNFUSED plan (fused
+        pipelines have no host mapping). Wire tasks re-decode from the
+        original bytes - prepare_decoded_task fuses the decoded tree
+        IN PLACE, so q._decoded is already fused by the time a
+        partition fails. Surfaces the ORIGINAL device error when no
+        host mapping exists."""
+        from blaze_tpu.planner.host_engine import execute_partition_host
+
+        try:
+            if q.plan is not None:
+                base = q.plan  # driver plans run as-built (never fused)
+            elif q.is_ref:
+                from blaze_tpu.plan.refcompat import (
+                    task_from_reference_proto,
+                )
+
+                base = task_from_reference_proto(q.task_bytes)[0]
+            else:
+                from blaze_tpu.plan.serde import task_from_proto
+
+                base = task_from_proto(q.task_bytes)[0]
+            batches = execute_partition_host(base, partition, q.ctx)
+        except Exception as host_err:  # noqa: BLE001 - original wins
+            log.warning(
+                "query %s: host degradation of partition %d "
+                "unavailable (%s); surfacing original error",
+                q.query_id, partition, host_err,
+            )
+            raise cause
+        q.degraded = True
+        q.ctx.metrics.add("degraded_partitions", 1)
+        log.warning(
+            "query %s partition %d degraded to host engine after "
+            "RESOURCE_EXHAUSTED: %s", q.query_id, partition, cause,
+        )
+        return batches
 
     def _drain(self, q: Query, op, partition: int) -> List:
         """Materialize one partition with cooperative interrupt checks
@@ -410,6 +565,16 @@ class QueryService:
                 if q.cancel_requested or q.deadline_exceeded():
                     it.close()
                     raise QueryCancelled(q.query_id)
+        except BaseException:
+            # an abandoned attempt's partial output must not stay in
+            # the query counters - a retry (or the host degradation)
+            # re-counts the partition from scratch
+            if batches:
+                q.ctx.metrics.add(
+                    "output_rows", -sum(rb.num_rows for rb in batches)
+                )
+                q.ctx.metrics.add("output_batches", -len(batches))
+            raise
         finally:
             it.close()
         return batches

@@ -33,7 +33,11 @@ when the connection drops (EOF, broken pipe) every non-terminal
 session query is cancelled - a vanished client must not keep holding
 device admission slots. Poll/cancel/fetch work from ANY connection
 (query ids are global), so detached orchestration is still possible
-via a second connection.
+via a second connection. A submit whose meta carries "detach": true
+opts OUT of cancel-on-disconnect: the query survives connection loss
+so a reconnecting client can re-attach by query_id (the deadline
+sweep and result TTL still bound an abandoned detached query's
+lifetime).
 """
 
 from __future__ import annotations
@@ -43,6 +47,8 @@ import socket
 import struct
 import time
 from typing import Iterator, List, Optional
+
+from blaze_tpu.testing import chaos
 
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
@@ -182,7 +188,10 @@ def _handle_submit(sock, service, session_qids: List[str]) -> None:
         estimated_bytes=meta.get("estimated_bytes"),
         use_cache=bool(meta.get("use_cache", True)),
     )
-    session_qids.append(q.query_id)
+    if not meta.get("detach"):
+        # attached (default): cancel-on-disconnect session semantics;
+        # detached queries survive connection loss for re-attach
+        session_qids.append(q.query_id)
     _send_json(sock, q.status())
 
 
@@ -207,7 +216,11 @@ def _handle_fetch(sock, service) -> None:
         return
     t0 = time.perf_counter_ns()
     try:
-        for rb in q.result or ():
+        for i, rb in enumerate(q.result or ()):
+            if chaos.ACTIVE:
+                # chaos seam: connection drop mid-result-stream (the
+                # client's reconnect-and-refetch path covers it)
+                chaos.fire("gateway.stream", query_id=qid, partition=i)
             sock.sendall(encode_ipc_segment(rb))
         sock.sendall(_U64.pack(0))
     except Exception as e:
@@ -255,15 +268,74 @@ def _send_err(sock, msg: str) -> None:
 
 class ServiceClient:
     """Multi-query client for the service protocol. One socket, many
-    queries; every call is a synchronous verb round trip."""
+    queries; every call is a synchronous verb round trip.
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    Reconnect-with-backoff: on a dropped connection the client
+    transparently reconnects (bounded attempts, exponential backoff +
+    jitter) and re-attaches by query_id - polls re-issue, and a FETCH
+    interrupted mid-stream re-issues and skips the parts already
+    delivered (the server streams one materialized part per batch,
+    deterministically). What survives the drop server-side: DONE
+    results (until retention/TTL) and queries submitted with
+    `detach=True`; a default (attached) submit still in flight is
+    cancelled by the server's session teardown when it notices the
+    disconnect - submit with detach=True when the handle must outlive
+    the connection. Submits retry too: a submit whose CONNECTION died
+    before the response frame may have registered server-side, but
+    re-submitting is safe - the result cache dedupes stable plans and
+    a duplicate query is merely wasted work, never a wrong answer.
+    Set reconnect_attempts=0 to restore fail-fast behavior."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 reconnect_attempts: int = 4,
+                 reconnect_backoff_s: float = 0.05):
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._reconnect_attempts = int(reconnect_attempts)
+        self._reconnect_backoff_s = float(reconnect_backoff_s)
+        self._sock = None
+        self._connect()
+
+    def _connect(self) -> None:
         from blaze_tpu.runtime.gateway import _FLAG_SERVICE
 
         self._sock = socket.create_connection(
-            (host, port), timeout=timeout
+            self._addr, timeout=self._timeout
         )
         self._sock.sendall(_U64.pack(_FLAG_SERVICE))
+
+    def _reconnect(self) -> None:
+        import random
+
+        self.close()
+        last: Optional[Exception] = None
+        for attempt in range(self._reconnect_attempts):
+            delay = self._reconnect_backoff_s * (2 ** attempt)
+            time.sleep(random.uniform(delay * 0.5, delay))
+            try:
+                self._connect()
+                return
+            except OSError as e:
+                last = e
+        raise ServiceError(f"RECONNECT_FAILED: {last!r}")
+
+    def _roundtrip(self, payload: bytes) -> dict:
+        """Send one verb frame and read its JSON response, reconnecting
+        once on a dropped connection (every verb frame is
+        self-contained, so a resend after reconnect is in-sync)."""
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    # closed by close() or a failed reconnect: try a
+                    # fresh connection instead of AttributeError-ing
+                    self._connect()
+                self._sock.sendall(payload)
+                return self._read_json()
+            except (ConnectionError, OSError):
+                if attempt or self._reconnect_attempts <= 0:
+                    raise
+                self._reconnect()
+        raise AssertionError("unreachable")
 
     # -- verbs ----------------------------------------------------------
     def submit(
@@ -276,7 +348,12 @@ class ServiceClient:
         deadline_s: Optional[float] = None,
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
+        detach: bool = False,
     ) -> dict:
+        """`detach=True` opts the query out of the server's
+        cancel-on-disconnect session semantics, so the handle survives
+        a connection drop and this client's reconnect can re-attach
+        by query_id."""
         from blaze_tpu.runtime.gateway import (
             _FLAG_MANIFEST,
             _FLAG_REF,
@@ -288,6 +365,7 @@ class ServiceClient:
                 "deadline_s": deadline_s,
                 "estimated_bytes": estimated_bytes,
                 "use_cache": use_cache,
+                "detach": detach,
             }
         ).encode("utf-8")
         header = len(task_bytes)
@@ -298,28 +376,25 @@ class ServiceClient:
             header |= _FLAG_MANIFEST
             mbytes = json.dumps(manifest).encode("utf-8")
             payload = _U32.pack(len(mbytes)) + mbytes
-        self._sock.sendall(
+        return self._roundtrip(
             bytes([VERB_SUBMIT])
             + _U32.pack(len(meta)) + meta
             + _U64.pack(header) + payload + task_bytes
         )
-        return self._read_json()
 
     def poll(self, query_id: str) -> dict:
-        self._send_id_verb(VERB_POLL, query_id)
-        return self._read_json()
+        return self._roundtrip(self._id_verb(VERB_POLL, query_id))
 
     def cancel(self, query_id: str) -> dict:
-        self._send_id_verb(VERB_CANCEL, query_id)
-        return self._read_json()
+        return self._roundtrip(self._id_verb(VERB_CANCEL, query_id))
 
     def report(self, query_id: str) -> str:
-        self._send_id_verb(VERB_REPORT, query_id)
-        return self._read_json()["report"]
+        return self._roundtrip(
+            self._id_verb(VERB_REPORT, query_id)
+        )["report"]
 
     def stats(self) -> dict:
-        self._sock.sendall(bytes([VERB_STATS]) + _U32.pack(0))
-        return self._read_json()
+        return self._roundtrip(bytes([VERB_STATS]) + _U32.pack(0))
 
     def fetch(self, query_id: str, timeout_ms: int = 0) -> list:
         """Materialize the result stream (list of pa.RecordBatch)."""
@@ -328,13 +403,41 @@ class ServiceClient:
     def fetch_stream(self, query_id: str,
                      timeout_ms: int = 0) -> Iterator:
         """Stream the result parts. Closing the client (or abandoning
-        the socket) mid-stream is the wire-level cancel."""
+        the socket) mid-stream is the wire-level cancel. A connection
+        dropped by the SERVER mid-stream triggers reconnect +
+        re-FETCH, skipping the parts already yielded (results are
+        materialized server-side; the part sequence is stable)."""
+        parts_yielded = 0
+        refetches = 0
+        while True:
+            try:
+                yield from self._fetch_parts(
+                    query_id, timeout_ms, skip=parts_yielded
+                )
+                return
+            except ServiceError:
+                raise  # in-band terminal state, not a drop
+            except (ConnectionError, OSError):
+                if refetches >= max(0, self._reconnect_attempts):
+                    raise
+                refetches += 1
+                self._reconnect()
+                parts_yielded = self._parts_done
+
+    def _fetch_parts(self, query_id: str, timeout_ms: int,
+                     skip: int) -> Iterator:
         import pyarrow as pa
 
         from blaze_tpu.runtime import native
         from blaze_tpu.runtime.transport import _recv_exact
 
-        self._send_id_verb(VERB_FETCH, query_id, timeout_ms)
+        self._parts_done = skip
+        if self._sock is None:
+            self._connect()
+        self._sock.sendall(
+            self._id_verb(VERB_FETCH, query_id, timeout_ms)
+        )
+        part = 0
         while True:
             (length,) = _U64.unpack(_recv_exact(self._sock, _U64.size))
             if length == 0:
@@ -345,15 +448,19 @@ class ServiceClient:
                 )
                 msg = _recv_exact(self._sock, mlen).decode("utf-8")
                 raise ServiceError(msg)
-            raw = native.zstd_decompress(
-                _recv_exact(self._sock, length)
-            )
+            payload = _recv_exact(self._sock, length)
+            part += 1
+            if part <= skip:
+                continue  # already delivered; drained, not decoded
+            raw = native.zstd_decompress(payload)
             if not raw:
+                self._parts_done = part
                 continue
             with pa.ipc.open_stream(raw) as reader:
                 for rb in reader:
                     if rb.num_rows > 0:
                         yield rb
+            self._parts_done = part
 
     # -- helpers --------------------------------------------------------
     def run(self, task_bytes: bytes, **submit_kw) -> list:
@@ -365,10 +472,10 @@ class ServiceClient:
             )
         return self.fetch(st["query_id"])
 
-    def _send_id_verb(self, verb: int, query_id: str,
-                      extra_u32: int = 0) -> None:
+    @staticmethod
+    def _id_verb(verb: int, query_id: str, extra_u32: int = 0) -> bytes:
         qid = query_id.encode("utf-8")
-        self._sock.sendall(
+        return (
             bytes([verb]) + _U32.pack(len(qid)) + qid
             + _U32.pack(extra_u32)
         )
@@ -382,10 +489,13 @@ class ServiceClient:
         return json.loads(_recv_exact(self._sock, n).decode("utf-8"))
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:
             pass
+        self._sock = None
 
     def __enter__(self):
         return self

@@ -173,6 +173,20 @@ def service_smoke() -> bool:
     )
 
 
+def chaos_smoke() -> bool:
+    """Chaos-mode smoke (ISSUE 3 satellite): the fault-injection
+    suites, run with a FIXED chaos seed baked into each test's
+    FaultPlan. The battery-shape test inside asserts that one injected
+    transient fault per shape leaves results identical to the
+    fault-free run; the cluster flavor injects through BLAZE_CHAOS
+    into real worker subprocesses."""
+    return run(
+        "chaos suite",
+        ["tests/test_chaos.py", "tests/test_service_failures.py",
+         "tests/test_cluster_chaos.py"],
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int,
@@ -181,17 +195,28 @@ def main():
     ap.add_argument("--fast", action="store_true")
     ap.add_argument("--scale", action="store_true")
     ap.add_argument("--smoke", action="store_true",
-                    help="bench + serving-tier smoke only (commit-time "
-                         "guard, no TPC-DS matrices)")
+                    help="bench + serving-tier + chaos smoke only "
+                         "(commit-time guard, no TPC-DS matrices)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos suite only: fixed-seed fault injection "
+                         "across the serving stack (retry / degrade / "
+                         "reconnect / quarantine semantics)")
     args = ap.parse_args()
     rows = 20_000 if args.fast else args.rows
 
     ok = True
     t0 = time.time()
 
+    if args.chaos:
+        ok &= chaos_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (chaos) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
     if args.smoke:
         ok &= bench_smoke()
         ok &= service_smoke()
+        ok &= chaos_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1

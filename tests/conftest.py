@@ -50,6 +50,22 @@ def _compile_cache_hygiene():
 
 
 @pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """A test that installs a chaos FaultPlan and fails must not leave
+    fault injection armed for every later test (the chaos-off
+    production path is itself pinned by tests). Env-activated plans
+    (BLAZE_CHAOS, used by cluster worker subprocess tests) survive -
+    they were installed deliberately for the whole process."""
+    yield
+    import os
+
+    if not os.environ.get("BLAZE_CHAOS"):
+        from blaze_tpu.testing import chaos
+
+        chaos.uninstall()
+
+
+@pytest.fixture(autouse=True)
 def _isolate_engine_globals():
     from blaze_tpu import config as config_mod
     from blaze_tpu.runtime import memory as memory_mod

@@ -1,0 +1,110 @@
+"""Cluster-level chaos: classified worker failures across real process
+boundaries (ISSUE 3 tentpole, cluster tier).
+
+Workers inherit the fault plan through BLAZE_CHAOS (the env-activated
+path of testing/chaos.py), so the injected failure happens in a real
+worker subprocess and travels back to the driver as a classified .err
+payload - exercising exactly the production failure wire."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import AggMode, FilterExec, HashAggregateExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.cluster import MiniCluster, _parse_err
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("BLZ_SKIP_CLUSTER") == "1",
+    reason="cluster tests disabled",
+)
+
+CLUSTER_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+
+def _task(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    rng = np.random.default_rng(9)
+    pq.write_table(
+        pa.table({"k": rng.integers(0, 10, 2000),
+                  "v": rng.integers(0, 100, 2000)}),
+        p,
+    )
+    plan = HashAggregateExec(
+        FilterExec(ParquetScanExec([[FileRange(p)]]), Col("v") < 90),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    return task_to_proto(plan, 0)
+
+
+def test_parse_err_payloads():
+    info = _parse_err(json.dumps(
+        {"pid": 123, "class": "TRANSIENT", "error": "boom"}
+    ))
+    assert (info["pid"], info["class"]) == (123, "TRANSIENT")
+    legacy = _parse_err("Traceback ... ValueError: x")
+    assert legacy["class"] == "INTERNAL" and legacy["pid"] is None
+
+
+def test_worker_transient_failure_respooled(tmp_path):
+    """A TRANSIENT-classified worker failure is re-spooled by the
+    driver and completes on the retry (the chaos plan in the worker
+    fires exactly once)."""
+    env = dict(CLUSTER_ENV)
+    env["BLAZE_CHAOS"] = json.dumps({
+        "seed": 7,
+        "faults": [{"site": "task.execute", "klass": "TRANSIENT",
+                    "times": 1}],
+    })
+    with MiniCluster(num_workers=1, env=env,
+                     task_max_attempts=2) as cluster:
+        (table,) = cluster.run_tasks([_task(tmp_path)], timeout=180)
+    assert table.num_rows == 10  # 10 groups survived the retry
+    assert not cluster.quarantined  # transient != worker-fatal
+
+
+def test_worker_fatal_failures_quarantine_slot(tmp_path):
+    """After N classified-fatal failures from one worker the driver
+    quarantines the slot WITHIN the run (fatal tasks get re-spooled
+    once, so the count accrues before the run fails): a marker appears
+    and the worker stops claiming tasks."""
+    env = dict(CLUSTER_ENV)
+    env["BLAZE_CHAOS"] = json.dumps({
+        "seed": 7,
+        "faults": [{"site": "task.execute",
+                    "klass": "RESOURCE_EXHAUSTED", "times": 0}],
+    })
+    with MiniCluster(num_workers=1, env=env, task_max_attempts=2,
+                     quarantine_after=2) as cluster:
+        blob = _task(tmp_path)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            cluster.run_tasks([blob], timeout=180)
+        assert len(cluster.quarantined) == 1
+        wid = cluster.quarantined[0]
+        assert os.path.exists(
+            os.path.join(cluster.spool, "quarantine", wid)
+        )
+
+
+def test_plan_invalid_worker_failure_never_respooled(tmp_path):
+    """PLAN_INVALID is the task's fault, not the worker's: it fails
+    the run on the FIRST report, with no re-spool and no quarantine."""
+    env = dict(CLUSTER_ENV)
+    env["BLAZE_CHAOS"] = json.dumps({
+        "seed": 7,
+        "faults": [{"site": "task.execute",
+                    "klass": "PLAN_INVALID", "times": 0}],
+    })
+    with MiniCluster(num_workers=1, env=env,
+                     task_max_attempts=3) as cluster:
+        with pytest.raises(RuntimeError, match="PLAN_INVALID"):
+            cluster.run_tasks([_task(tmp_path)], timeout=180)
+        assert not cluster.quarantined

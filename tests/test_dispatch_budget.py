@@ -277,6 +277,37 @@ def test_second_identical_plan_builds_zero_kernels(tables):
     assert c.counts.get("kernel_hits", 0) > 0, c.counts
 
 
+def test_chaos_hooks_add_zero_dispatches(tables):
+    """ISSUE 3 acceptance: chaos-off runs pay nothing - and even an
+    ARMED-but-empty fault plan (every hook actually entered) keeps the
+    exact per-shape dispatch budget. The hooks are pure control flow:
+    they cannot dispatch, transfer, or build kernels."""
+    from blaze_tpu.testing import chaos
+
+    assert not chaos.ACTIVE  # chaos is strictly opt-in
+
+    def mk():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([[tables["fact"]]],
+                               tables["fact"].schema),
+                [(Col("price"), "p")],
+            ),
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("p")), "s")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    baseline = _counts(lambda: run_plan(mk()))
+    with chaos.active([], seed=7):  # armed, zero faults: hooks fire
+        armed = _counts(lambda: run_plan(mk()))
+    assert not chaos.ACTIVE
+    for k in ("dispatches", "h2d_batches", "d2h_fetches",
+              "d2h_syncs", "kernel_builds"):
+        assert armed.get(k, 0) == baseline.get(k, 0), (k, armed)
+    _check(armed, dispatches=1, h2d=0, d2h=1)
+
+
 def test_executor_exposes_dispatch_metrics(tables):
     from blaze_tpu.ops.base import ExecContext
     from blaze_tpu.runtime.instrument import render_metrics

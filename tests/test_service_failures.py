@@ -1,0 +1,366 @@
+"""Cancellation/failure races, deadline-aware admission, and client
+reconnect (ISSUE 3 satellites).
+
+Race coverage (made deterministic via chaos hooks):
+  * cancel landing in the ADMITTED->RUNNING window
+  * double-cancel idempotence
+  * retry-then-cancel interleaving (cancel interrupts the backoff)
+  * client disconnect during FETCH of a cached result
+  * server-side drop mid-stream -> ServiceClient reconnect + re-attach
+
+Plus the deadline satellites: EDF ordering within a priority class,
+shedding of unmeetable deadlines at admission, and the
+_sweep_deadlines fix (cancel-event propagation to running work).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.config import EngineConfig, set_config
+from blaze_tpu.exprs import Col
+from blaze_tpu.ops import FilterExec, MemoryScanExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import (
+    QueryCancelled,
+    QueryService,
+    QueryState,
+    ServiceClient,
+)
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_service import GatedScan, wait_for
+
+
+def small_plan(rows=6):
+    cb = ColumnBatch.from_pydict({"a": list(range(rows))})
+    return FilterExec(
+        MemoryScanExec([[cb]], cb.schema), Col("a") >= 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# cancellation races
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_during_admitted_to_running_window():
+    """The chaos STALL in _run_query holds the query between ADMITTED
+    and RUNNING; a cancel landing there must win - the query ends
+    CANCELLED and the operator tree never starts executing."""
+    release = threading.Event()
+    scan = GatedScan(release)
+    try:
+        with chaos.active(
+            [Fault("service.admit", klass="STALL", stall_s=0.4,
+                   times=1)],
+            seed=7,
+        ):
+            with QueryService(
+                max_concurrency=1, enable_cache=False
+            ) as svc:
+                q = svc.submit_plan(scan, estimated_bytes=0)
+                assert wait_for(
+                    lambda: q.state is QueryState.ADMITTED
+                )
+                svc.cancel(q.query_id)
+                assert wait_for(
+                    lambda: q.state is QueryState.CANCELLED
+                )
+                assert not scan.started.is_set()
+    finally:
+        release.set()
+
+
+def test_double_cancel_idempotent():
+    release = threading.Event()
+    scan = GatedScan(release)
+    try:
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            q = svc.submit_plan(scan, estimated_bytes=0)
+            assert wait_for(lambda: scan.started.is_set())
+            st1 = svc.cancel(q.query_id)
+            st2 = svc.cancel(q.query_id)  # second cancel: no-op
+            assert wait_for(lambda: q.state is QueryState.CANCELLED)
+            st3 = svc.cancel(q.query_id)  # cancel AFTER terminal: no-op
+            assert st3["state"] == "CANCELLED"
+            assert "error" not in st3 or "illegal" not in st3["error"]
+            with pytest.raises(QueryCancelled):
+                svc.result(q.query_id, timeout=5)
+            del st1, st2
+    finally:
+        release.set()
+
+
+def test_retry_then_cancel_interleaving():
+    """Cancel arriving while a TRANSIENT retry backs off must end the
+    query promptly (the backoff wait is cancel-interruptible), not
+    after the remaining retry budget drains."""
+    with chaos.active(
+        [Fault("task.execute", klass="TRANSIENT", times=0)],
+        seed=7,
+    ):
+        with QueryService(
+            max_concurrency=1, enable_cache=False,
+            max_task_attempts=50, retry_backoff_s=0.4,
+        ) as svc:
+            q = svc.submit_plan(small_plan())
+            # wait until at least one failed attempt is journaled
+            assert wait_for(lambda: len(q.attempts) >= 1)
+            t0 = time.monotonic()
+            svc.cancel(q.query_id)
+            assert wait_for(lambda: q.state is QueryState.CANCELLED)
+            assert time.monotonic() - t0 < 5.0
+            # nowhere near the 50-attempt budget
+            assert len(q.attempts) < 10
+
+
+def test_cancel_vs_completion_race_clean():
+    """Cancel racing natural completion must land in exactly one
+    terminal state, never raise, never wedge the service."""
+    for _ in range(20):
+        with QueryService(max_concurrency=2, enable_cache=False) as svc:
+            q = svc.submit_plan(small_plan())
+            svc.cancel(q.query_id)
+            assert wait_for(lambda: q.done)
+            assert q.state in (
+                QueryState.DONE, QueryState.CANCELLED
+            )
+
+
+# ---------------------------------------------------------------------------
+# deadline satellites
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_propagates_cancel_to_running_query():
+    """ISSUE 3 satellite bugfix: the deadline sweep marking a RUNNING
+    query TIMED_OUT must ALSO fire its cancel event. Deterministic pin:
+    the deadline expires while the query sits in a LONG retry backoff
+    (which only the cancel event can interrupt) - without the
+    propagation the query would not terminate until the multi-second
+    backoff drained."""
+    with chaos.active(
+        [Fault("task.execute", klass="TRANSIENT", times=0)],
+        seed=7,
+    ):
+        with QueryService(
+            max_concurrency=1, enable_cache=False,
+            max_task_attempts=10, retry_backoff_s=8.0,
+        ) as svc:
+            q = svc.submit_plan(small_plan(), deadline_s=0.2)
+            t0 = time.monotonic()
+            assert wait_for(
+                lambda: q.state is QueryState.TIMED_OUT, timeout=10
+            )
+            # the sweep fired the event (backoff_delay(0, 8.0) >= 4s;
+            # terminating well under that proves the interrupt)
+            assert q.cancel_requested
+            assert time.monotonic() - t0 < 3.0
+
+
+def test_user_cancel_wins_over_concurrent_deadline():
+    """A user cancel that precedes QueryCancelled propagation must
+    report CANCELLED even when the deadline elapses in the same
+    window (the sweep fires the same event for deadline expiry, so
+    the terminal state keys on the cancel REASON, not timing)."""
+    release = threading.Event()
+    scan = GatedScan(release)
+    try:
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            q = svc.submit_plan(
+                scan, deadline_s=0.25, estimated_bytes=0
+            )
+            assert wait_for(lambda: scan.started.is_set())
+            svc.cancel(q.query_id)  # user intent, pre-deadline
+            time.sleep(0.3)  # deadline passes while unwinding
+            assert wait_for(lambda: q.done)
+            assert q.state is QueryState.CANCELLED
+    finally:
+        release.set()
+
+
+def test_edf_ordering_within_priority_class():
+    """Deadline-aware admission (ROADMAP first half): within one
+    priority class the queued query with the nearest deadline admits
+    first; deadline-less queries go last, FIFO among themselves."""
+    release = threading.Event()
+    blocker = GatedScan(release)
+    try:
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            qb = svc.submit_plan(blocker, estimated_bytes=0)
+            assert wait_for(lambda: blocker.started.is_set())
+            q_loose = svc.submit_plan(
+                small_plan(), deadline_s=30.0, estimated_bytes=0
+            )
+            q_tight = svc.submit_plan(
+                small_plan(), deadline_s=5.0, estimated_bytes=0
+            )
+            q_none1 = svc.submit_plan(small_plan(), estimated_bytes=0)
+            q_none2 = svc.submit_plan(small_plan(), estimated_bytes=0)
+            q_hi = svc.submit_plan(
+                small_plan(), priority=5, deadline_s=60.0,
+                estimated_bytes=0,
+            )
+            release.set()
+            for q in (q_loose, q_tight, q_none1, q_none2, q_hi):
+                svc.result(q.query_id, timeout=60)
+            assert svc.admission_log == [
+                qb.query_id,
+                q_hi.query_id,     # priority class first, even with
+                                   # the loosest deadline
+                q_tight.query_id,  # then EDF within class 0
+                q_loose.query_id,
+                q_none1.query_id,  # deadline-less last, FIFO
+                q_none2.query_id,
+            ]
+    finally:
+        release.set()
+
+
+def test_unmeetable_deadline_shed_at_admission():
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        q = svc.submit_plan(
+            small_plan(), deadline_s=-0.5, estimated_bytes=0
+        )
+        assert q.state is QueryState.TIMED_OUT
+        assert "shed" in q.error
+        st = svc.admission.stats()
+        assert st["shed_deadline"] == 1
+        assert st["queued"] == 0  # never occupied queue depth
+        with pytest.raises(RuntimeError, match="TIMED_OUT"):
+            svc.result(q.query_id, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# wire: disconnects and reconnect-with-backoff
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def parquet_blob(tmp_path):
+    # small batches -> multi-part FETCH streams (mid-stream coverage)
+    set_config(EngineConfig(batch_size=512))
+    rng = np.random.default_rng(13)
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 50, 4000), pa.int32()),
+            "v": pa.array(rng.random(4000), pa.float64()),
+        }),
+        p,
+    )
+    plan = FilterExec(
+        ParquetScanExec([[FileRange(p)]]), Col("v") >= 0.0
+    )
+    yield task_to_proto(plan, 0)
+    set_config(EngineConfig())
+
+
+def test_client_disconnect_during_fetch_of_cached_result(
+    parquet_blob,
+):
+    """A client vanishing mid-FETCH of a cached result must not poison
+    the service, the cache entry, or the listener."""
+    with QueryService(max_concurrency=2) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c1:
+                full = c1.run(parquet_blob)
+            assert len(full) > 1  # multi-part stream
+            assert svc.cache.stats()["puts"] == 1
+            # second submission hits the cache; disconnect mid-stream
+            c2 = ServiceClient(*srv.address)
+            st = c2.submit(parquet_blob)
+            it = c2.fetch_stream(st["query_id"])
+            first = next(it)
+            assert first.num_rows > 0
+            c2.close()  # wire-level abandon, stream unfinished
+            time.sleep(0.1)
+            # service + cache healthy: a third client gets everything
+            with ServiceClient(*srv.address) as c3:
+                again = c3.run(parquet_blob)
+            assert svc.cache.stats()["hits"] >= 1
+    t_full = pa.Table.from_batches(full).to_pydict()
+    t_again = pa.Table.from_batches(again).to_pydict()
+    assert t_full == t_again
+
+
+def test_server_drop_midstream_reconnect_refetch(parquet_blob):
+    """ISSUE 3 satellite: a server-side connection drop mid-FETCH is
+    healed by ServiceClient's reconnect-with-backoff - it re-attaches
+    by query_id, re-issues FETCH, skips already-delivered parts, and
+    the assembled result has no gaps and no duplicates."""
+    with QueryService(max_concurrency=2, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                baseline = c.run(parquet_blob)
+            assert len(baseline) > 2
+            with chaos.active(
+                [Fault("gateway.stream", klass="DROP",
+                       partition=2, times=1)],
+                seed=7,
+            ) as plan:
+                with ServiceClient(*srv.address) as c2:
+                    st = c2.submit(parquet_blob)
+                    got = list(c2.fetch_stream(st["query_id"]))
+                assert plan.fired("gateway.stream") == 1
+    tb = pa.Table.from_batches(baseline).to_pydict()
+    tg = pa.Table.from_batches(got).to_pydict()
+    assert tb == tg
+
+
+def test_poll_survives_connection_drop(parquet_blob):
+    """Reconnect re-attaches in-flight query HANDLES: a poll after the
+    socket died transparently reconnects (query ids are global; the
+    detach flag keeps the server's session teardown off the query)."""
+    with QueryService(max_concurrency=2, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                st = c.submit(parquet_blob, detach=True)
+                qid = st["query_id"]
+                # simulate a dropped connection under the client
+                c._sock.close()
+                final = None
+                for _ in range(100):
+                    final = c.poll(qid)
+                    if final["state"] not in (
+                        "QUEUED", "ADMITTED", "RUNNING"
+                    ):
+                        break
+                    time.sleep(0.05)
+                assert final["state"] == "DONE"
+                got = c.fetch(qid)
+    assert sum(rb.num_rows for rb in got) == 4000
+
+
+def test_error_class_and_attempts_on_the_wire(parquet_blob):
+    """The wire protocol carries the failure taxonomy: error_class and
+    the attempt journal ride the status JSON."""
+    with chaos.active(
+        [Fault("task.execute", klass="PLAN_INVALID", times=0)],
+        seed=7,
+    ):
+        with QueryService(max_concurrency=1, enable_cache=False) as svc:
+            with TaskGatewayServer(service=svc) as srv:
+                with ServiceClient(*srv.address) as c:
+                    st = c.submit(parquet_blob)
+                    qid = st["query_id"]
+                    final = None
+                    for _ in range(100):
+                        final = c.poll(qid)
+                        if final["state"] == "FAILED":
+                            break
+                        time.sleep(0.05)
+                    assert final["state"] == "FAILED"
+                    assert final["error_class"] == "PLAN_INVALID"
+                    assert final["attempts"][0]["action"] == "fail"
+                    report = c.report(qid)
+    assert "error_class=PLAN_INVALID" in report
+    assert "PLAN_INVALID -> fail" in report
