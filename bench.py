@@ -961,6 +961,51 @@ def child(n_rows):
     except Exception:  # noqa: BLE001
         e2e_counts = {}
 
+    # ---- observability overhead (ISSUE 4 satellite): the same
+    # battery shape measured obs-off and obs-ON (tracing enabled,
+    # recorder installed, every seam recording spans), so the perf
+    # trajectory records what the tracing layer costs. `median` is
+    # the obs-on number; overhead_pct is the on/off delta. ----
+    try:
+        from blaze_tpu.obs import trace as obs_trace
+
+        g = queries["grouped_agg"]["engine"]
+        off_med, off_spread, k_obs, _ = timed(g)
+
+        def traced():
+            rec = obs_trace.begin_trace("bench-obs")
+            with obs_trace.span("battery", rec=rec):
+                out = g()
+            rec.finish(state="DONE")
+            return out
+
+        obs_trace.enable()
+        try:
+            on_med, on_spread, _, _ = timed(traced)
+        finally:
+            obs_trace.disable()
+        detail["obs_overhead"] = {
+            "median": round(on_med, 4),
+            "median_off": round(off_med, 4),
+            "spread": round(max(off_spread, on_spread), 3),
+            "k": k_obs,
+            "overhead_pct": (
+                round((on_med / off_med - 1.0) * 100.0, 2)
+                if off_med else 0.0
+            ),
+        }
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "obs_overhead", "backend": backend,
+                 **detail["obs_overhead"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["obs_overhead"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     # ---- serving tier: queries/sec through the gateway service at
     # concurrency 1/4/16, with and without the plan-fingerprint result
     # cache (ISSUE 2 satellite). Same {median, spread, k} form as the

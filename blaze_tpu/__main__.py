@@ -8,7 +8,13 @@
   gateway         legacy one-shot task gateway (one task per connection)
   serve           multi-query serving tier: the gateway listener with a
                   QueryService attached (admission control, priorities,
-                  deadlines, cancellation, plan-fingerprint result cache)
+                  deadlines, cancellation, plan-fingerprint result cache,
+                  query-lifecycle tracing)
+  trace QUERY_ID  export one query's span tree from a running server as
+                  Chrome-trace-event JSON (load in ui.perfetto.dev or
+                  chrome://tracing)
+  metrics         print the server's Prometheus text exposition
+                  (dispatch.*, admission, cache, query counters)
 """
 
 from __future__ import annotations
@@ -101,11 +107,60 @@ def cmd_serve(args) -> int:
         cache=cache,
         enable_cache=not args.no_cache,
         default_deadline_s=args.deadline or None,
+        enable_trace=not args.no_trace,
+        slow_query_s=args.slow_query_s,
     )
     try:
         serve_forever(args.host, args.port, service=service)
     finally:
         service.close()
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Fetch one query's trace over the REPORT verb and write the
+    Perfetto-loadable Chrome-trace-event JSON."""
+    from blaze_tpu.obs.trace import validate_chrome
+    from blaze_tpu.service.wire import ServiceClient
+
+    with ServiceClient(args.host, args.port) as c:
+        data = c.report_full(args.query_id)
+    if data.get("error"):
+        # in-band server error (unknown query id, protocol problem):
+        # surface the real cause, not a tracing diagnosis
+        print(data["error"], file=sys.stderr)
+        return 1
+    doc = data.get("trace")
+    if not doc:
+        print(
+            f"no trace recorded for {args.query_id} "
+            "(server tracing disabled, or query evicted)",
+            file=sys.stderr,
+        )
+        return 1
+    problems = validate_chrome(doc)
+    if args.out == "-":
+        json.dump(doc, sys.stdout)
+        print()
+    else:
+        out = args.out or f"{args.query_id}.trace.json"
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        print(
+            f"{out}: {len(doc['traceEvents'])} events"
+            + (f" ({len(problems)} schema problems)" if problems
+               else " (valid)")
+            + " - load in ui.perfetto.dev or chrome://tracing",
+            file=sys.stderr,
+        )
+    return 0 if not problems else 2
+
+
+def cmd_metrics(args) -> int:
+    from blaze_tpu.service.wire import ServiceClient
+
+    with ServiceClient(args.host, args.port) as c:
+        sys.stdout.write(c.metrics())
     return 0
 
 
@@ -136,6 +191,22 @@ def main(argv=None) -> int:
                     help="disable the plan-fingerprint result cache")
     sv.add_argument("--cache-bytes", type=int, default=256 << 20)
     sv.add_argument("--cache-ttl", type=float, default=300.0)
+    sv.add_argument("--no-trace", action="store_true",
+                    help="disable query-lifecycle tracing (obs/)")
+    sv.add_argument("--slow-query-s", type=float, default=None,
+                    help="structured slow-query log threshold "
+                         "(default 5s or BLAZE_SLOW_QUERY_S; "
+                         "<= 0 disables)")
+    tr = sub.add_parser("trace")
+    tr.add_argument("query_id")
+    tr.add_argument("--host", default="127.0.0.1")
+    tr.add_argument("--port", type=int, default=8484)
+    tr.add_argument("-o", "--out", default=None,
+                    help="output path ('-' = stdout; default "
+                         "<query_id>.trace.json)")
+    mt = sub.add_parser("metrics")
+    mt.add_argument("--host", default="127.0.0.1")
+    mt.add_argument("--port", type=int, default=8484)
     args = p.parse_args(argv)
     return {
         "info": cmd_info,
@@ -143,6 +214,8 @@ def main(argv=None) -> int:
         "scan": cmd_scan,
         "gateway": cmd_gateway,
         "serve": cmd_serve,
+        "trace": cmd_trace,
+        "metrics": cmd_metrics,
     }[args.cmd](args)
 
 

@@ -52,6 +52,9 @@ class ExecContext:
     # resource registry: shuffle readers/writers, broadcast values, etc.
     # (the reference's JniBridge.resourcesMap, JniBridge.java:31)
     resources: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # per-query TraceRecorder (obs/trace.py) when tracing is on; the
+    # executor/scheduler seams check `trace.ACTIVE` before touching it
+    tracer: Optional[object] = None
 
 
 class PhysicalOp:

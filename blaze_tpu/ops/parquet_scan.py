@@ -152,48 +152,67 @@ class ParquetScanExec(PhysicalOp):
             present = None
 
         def decode() -> Iterator[ColumnBatch]:
-            for fr in self.file_groups[partition]:
-                if chaos.ACTIVE:
-                    # chaos seam: parquet decode / object-store read
-                    # failure for this file range
-                    chaos.fire(
-                        "parquet.decode", partition=partition,
-                        path=fr.path,
-                    )
-                # all byte IO flows through the object-store seam (the
-                # reference's registered ObjectStore, exec.rs:96-103)
-                pf = pq.ParquetFile(
-                    store_for(fr.path).open_input(fr.path)
-                )
-                groups = self._select_row_groups(pf, fr, filters)
-                if not groups:
-                    continue
-                for rb in pf.iter_batches(
-                    batch_size=cfg.batch_size, row_groups=groups,
-                    columns=read_names, use_threads=True,
-                ):
-                    ctx.metrics.add("input_rows", rb.num_rows)
-                    ctx.metrics.add("input_batches", 1)
-                    if filters and cfg.host_filter_pushdown:
-                        before = rb.num_rows
-                        rb = _apply_host_filters(rb, filters)
-                        ctx.metrics.add(
-                            "pushdown_filtered_rows", before - rb.num_rows
-                        )
-                    if rb.num_rows == 0:
-                        continue
-                    if present is None:
-                        yield ColumnBatch.from_arrow(rb)
-                    else:
-                        import pyarrow as pa
+            from blaze_tpu.obs import trace as obs_trace
 
-                        sub = pa.record_batch(
-                            [rb.column(c) for c in keep_names],
-                            names=keep_names,
+            for fr in self.file_groups[partition]:
+                # obs seam: one span per file-range decode (open,
+                # row-group selection, and the batch iteration - the
+                # inclusive decode wall time for this range)
+                # rec= explicitly: decode() is drained by a prefetch
+                # worker thread, which has no thread-current recorder
+                span_cm = (
+                    obs_trace.span(
+                        "parquet_decode", rec=ctx.tracer,
+                        partition=partition, path=fr.path,
+                    )
+                    if obs_trace.ACTIVE else obs_trace.NULL
+                )
+                with span_cm:
+                    if chaos.ACTIVE:
+                        # chaos seam: parquet decode / object-store
+                        # read failure for this file range (inside
+                        # the span, so the injected fault lands as a
+                        # chaos.fault event on THIS span)
+                        chaos.fire(
+                            "parquet.decode", partition=partition,
+                            path=fr.path,
                         )
-                        yield ColumnBatch.from_arrow_pruned(
-                            sub, self._schema, present
-                        )
+                    # all byte IO flows through the object-store seam
+                    # (the reference's registered ObjectStore,
+                    # exec.rs:96-103)
+                    pf = pq.ParquetFile(
+                        store_for(fr.path).open_input(fr.path)
+                    )
+                    groups = self._select_row_groups(pf, fr, filters)
+                    if not groups:
+                        continue
+                    for rb in pf.iter_batches(
+                        batch_size=cfg.batch_size, row_groups=groups,
+                        columns=read_names, use_threads=True,
+                    ):
+                        ctx.metrics.add("input_rows", rb.num_rows)
+                        ctx.metrics.add("input_batches", 1)
+                        if filters and cfg.host_filter_pushdown:
+                            before = rb.num_rows
+                            rb = _apply_host_filters(rb, filters)
+                            ctx.metrics.add(
+                                "pushdown_filtered_rows",
+                                before - rb.num_rows,
+                            )
+                        if rb.num_rows == 0:
+                            continue
+                        if present is None:
+                            yield ColumnBatch.from_arrow(rb)
+                        else:
+                            import pyarrow as pa
+
+                            sub = pa.record_batch(
+                                [rb.column(c) for c in keep_names],
+                                names=keep_names,
+                            )
+                            yield ColumnBatch.from_arrow_pruned(
+                                sub, self._schema, present
+                            )
 
         # overlap parquet decode + H2D with downstream device compute
         # (SURVEY 7 streaming model: double-buffered host pipeline)

@@ -27,6 +27,7 @@ from typing import List, Optional, Sequence
 
 import pyarrow as pa
 
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.testing import chaos
 
@@ -103,12 +104,18 @@ class MiniCluster:
     # ------------------------------------------------------------------
     def run_tasks(self, task_blobs: Sequence[bytes],
                   timeout: float = 300.0,
-                  return_metas: bool = False):
+                  return_metas: bool = False,
+                  tracer=None):
         """Submit serialized TaskDefinitions; wait for per-task results
         (tables decoded from segmented IPC). With return_metas, also
         return each task's worker-reported metadata (block-server
         address + shuffle output ranges) - per call, so concurrent map
         stages on one cluster can't clobber each other.
+
+        `tracer` (an obs.trace.TraceRecorder; defaults to the calling
+        thread's current recorder) receives each worker's serialized
+        span subtree - one stitched cross-process trace per run. Spawn
+        workers with BLAZE_TRACE=1 in `env` so they record at all.
 
         Liveness is PROGRESS-AWARE, not a fixed wall-clock deadline (the
         round-5 flake: a fixed deadline killed live tasks whose workers
@@ -120,6 +127,8 @@ class MiniCluster:
         or wedged rather than merely slow."""
         from blaze_tpu.io.ipc import decode_ipc_parts
 
+        if tracer is None and obs_trace.ACTIVE:
+            tracer = obs_trace.current_recorder()
         metas: List[Optional[dict]] = [None] * len(task_blobs)
         ids = []
         for blob in task_blobs:
@@ -174,6 +183,10 @@ class MiniCluster:
                 if os.path.exists(err):
                     with open(err) as f:
                         info = _parse_err(f.read())
+                    if tracer is not None and info.get("spans"):
+                        # failed attempts keep their spans too - a
+                        # retried task renders as two worker subtrees
+                        tracer.attach_subtree(info["spans"])
                     # quarantine accounting FIRST, so a wedged worker
                     # stops claiming before the re-spooled task lands
                     # back in the pool (in-run protection, not just
@@ -221,6 +234,8 @@ class MiniCluster:
                     if os.path.exists(meta):
                         with open(meta) as f:
                             metas[i] = json.load(f)
+                        if tracer is not None and metas[i].get("spans"):
+                            tracer.attach_subtree(metas[i]["spans"])
                     pending.discard(i)
                     last_progress = time.time()
             time.sleep(0.05)
@@ -257,6 +272,11 @@ class MiniCluster:
                 os.path.join(self.spool, "quarantine", wid), "w"
             ).close()
             self.quarantined.append(wid)
+            # process-wide observability: quarantines surface in the
+            # METRICS exposition and the service STATS payload
+            from blaze_tpu.obs.metrics import REGISTRY
+
+            REGISTRY.inc("blaze_worker_quarantines_total")
 
     def __enter__(self):
         self.start()
@@ -428,14 +448,26 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
             except OSError:
                 pass
             continue
+        # obs: with tracing on (BLAZE_TRACE inherited from the driver
+        # env), the worker records its own span subtree and ships it
+        # in the result/.err payload - the driver grafts it so one
+        # query renders as a single cross-process trace
+        tracer = (
+            obs_trace.begin_trace(name, root_name="worker_task")
+            if obs_trace.ACTIVE else None
+        )
         try:
             with open(path, "rb") as f:
                 blob = f.read()
             blob, outputs = _rewrite_worker_local(blob, data_dir)
             parts = bytearray()
             with _Heartbeat(path):
-                for rb in execute_task(blob):
-                    parts += encode_ipc_segment(rb)
+                with (obs_trace.span("execute", rec=tracer, task=name)
+                      if tracer is not None else obs_trace.NULL):
+                    for rb in execute_task(blob):
+                        parts += encode_ipc_segment(rb)
+            if tracer is not None:
+                tracer.finish(state="DONE")
             with open(os.path.join(out_dir, name + ".ipc"), "wb") as f:
                 f.write(bytes(parts))
             meta = {
@@ -453,6 +485,8 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
                     if os.path.exists(index)
                 ],
             }
+            if tracer is not None:
+                meta["spans"] = tracer.to_dicts()
             with open(
                 os.path.join(out_dir, name + ".meta.json"), "w"
             ) as f:
@@ -469,6 +503,10 @@ def worker_main(spool: str, data_dir: Optional[str] = None) -> int:
                 "error": f"{type(e).__name__}: {e}",
                 "traceback": traceback.format_exc(),
             }
+            if tracer is not None:
+                tracer.finish(state="FAILED",
+                              error_class=classify(e).value)
+                payload["spans"] = tracer.to_dicts()
             # atomic publish (like the task spool): the driver polls
             # every 50ms and a torn read would misclassify a TRANSIENT
             # failure as run-fatal INTERNAL

@@ -86,6 +86,7 @@ class counting:
 
 
 def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
+    from blaze_tpu.obs import trace as obs_trace
     from blaze_tpu.testing import chaos
 
     def wrapped(*args, **kw):
@@ -95,6 +96,13 @@ def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
             # module-attribute load
             chaos.fire("kernel.dispatch", kind=kind)
         record(kind)
+        if obs_trace.ACTIVE:
+            # obs seam: one span per kernel dispatch (the unit of the
+            # perf model); no-op when no recorder is in scope. XLA
+            # dispatch is async, so this measures launch, not device
+            # occupancy - the span COUNT is the signal.
+            with obs_trace.span("kernel_dispatch", kind=kind):
+                return fn(*args, **kw)
         return fn(*args, **kw)
 
     return wrapped

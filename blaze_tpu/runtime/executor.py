@@ -21,6 +21,7 @@ import pyarrow as pa
 
 from blaze_tpu.batch import ColumnBatch
 from blaze_tpu.errors import ErrorClass, classify
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.ops.base import ExecContext, MetricNode, PhysicalOp
 from blaze_tpu.ops.util import ensure_compacted
 from blaze_tpu.testing import chaos
@@ -149,23 +150,34 @@ def execute_partition(op: PhysicalOp, partition: int, ctx: ExecContext
 
     counter = dispatch.counting()
     counter.__enter__()
+    # obs seam: one span per partition drain (child spans - parquet
+    # decode, H2D, kernel dispatch - attach under it via the
+    # thread-current stack; the off path is one attribute check)
+    span_cm = (
+        obs_trace.span(
+            "execute_partition", rec=ctx.tracer,
+            partition=partition, task=ctx.task_id,
+        )
+        if obs_trace.ACTIVE else obs_trace.NULL
+    )
     try:
-        if chaos.ACTIVE:
-            # the generic per-partition fault seam (chaos harness);
-            # inside the try so an injected fault is classified and
-            # wrapped exactly like a real operator failure
-            chaos.fire(
-                "task.execute", partition=partition,
-                task_id=ctx.task_id,
-            )
-        for cb in op.execute(partition, ctx):
-            cb = ensure_compacted(cb)
-            if cb.num_rows == 0:
-                continue
-            rb = cb.to_arrow()
-            ctx.metrics.add("output_rows", rb.num_rows)
-            ctx.metrics.add("output_batches", 1)
-            yield rb
+        with span_cm:
+            if chaos.ACTIVE:
+                # the generic per-partition fault seam (chaos harness);
+                # inside the try so an injected fault is classified and
+                # wrapped exactly like a real operator failure
+                chaos.fire(
+                    "task.execute", partition=partition,
+                    task_id=ctx.task_id,
+                )
+            for cb in op.execute(partition, ctx):
+                cb = ensure_compacted(cb)
+                if cb.num_rows == 0:
+                    continue
+                rb = cb.to_arrow()
+                ctx.metrics.add("output_rows", rb.num_rows)
+                ctx.metrics.add("output_batches", 1)
+                yield rb
     except (KeyboardInterrupt, GeneratorExit):
         # task cancellation must not poison the engine (the reference
         # swallows JVM-interrupts the same way, exec.rs:330-343)

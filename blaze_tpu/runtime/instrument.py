@@ -81,6 +81,30 @@ def exclusive_elapsed(node: MetricNode) -> int:
     return max(0, own - kids)
 
 
+def operator_summary(root: MetricNode, limit: int = 6) -> list:
+    """The metric tree flattened to its hottest operators (by
+    EXCLUSIVE time): the machine-readable rollup the structured
+    slow-query log (obs/slowlog.py) embeds, where the full
+    render_metrics tree would bloat a one-line log record."""
+    rows = []
+
+    def walk(node: MetricNode) -> None:
+        self_ms = exclusive_elapsed(node) / 1e6
+        if node.counters:
+            rows.append({
+                "op": node.name,
+                "self_ms": round(self_ms, 3),
+                "rows": node.counters.get("output_rows", 0),
+            })
+        for ch in node.children:
+            walk(ch)
+
+    for ch in root.children:
+        walk(ch)
+    rows.sort(key=lambda r: -r["self_ms"])
+    return rows[:max(0, limit)]
+
+
 def render_metrics(root: MetricNode, indent: str = "") -> str:
     """Spark-UI-style rendering of the mirrored metric tree: one line
     per operator with rows/batches and inclusive + EXCLUSIVE time

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from blaze_tpu.runtime.dispatch import cached_kernel, record
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.testing import chaos
 
 
@@ -125,6 +126,16 @@ def put_packed(arrays: Sequence[np.ndarray]) -> List[jax.Array]:
         # chaos seam: the host->device staging transfer fails (a
         # network-attached device drops the RPC)
         chaos.fire("h2d.transfer", n_arrays=len(arrays))
+    if obs_trace.ACTIVE:
+        # obs seam: the H2D staging transfer as one span (pack +
+        # device_put + unpack-kernel launch); no-op without a
+        # thread-current recorder
+        with obs_trace.span("h2d", n_arrays=len(arrays)):
+            return _put_packed(arrays)
+    return _put_packed(arrays)
+
+
+def _put_packed(arrays: Sequence[np.ndarray]) -> List[jax.Array]:
     pairs = _f64_pairs()
     metas = tuple((str(_np_dtype(a)), tuple(a.shape)) for a in arrays)
     parts = []

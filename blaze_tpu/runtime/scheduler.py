@@ -41,6 +41,7 @@ from typing import Callable, List, Optional
 import pyarrow as pa
 
 from blaze_tpu.errors import ErrorClass, classify, retry_action
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.runtime.executor import TaskExecutionError, execute_partition
 
@@ -106,7 +107,10 @@ def run_plan_parallel(
         from blaze_tpu.planner.host_engine import execute_partition_host
 
         try:
-            out = execute_partition_host(op, p, ctx)
+            with (obs_trace.span("host_degrade", rec=ctx.tracer,
+                                 partition=p)
+                  if obs_trace.ACTIVE else obs_trace.NULL):
+                out = execute_partition_host(op, p, ctx)
         except Exception as host_err:  # noqa: BLE001 - original wins
             log.warning(
                 "host degradation of partition %d unavailable (%s); "
@@ -124,20 +128,31 @@ def run_plan_parallel(
         for attempt in range(max_attempts):
             if cancelled():
                 raise PlanCancelled(f"partition {p} cancelled")
+            # obs seam: ONE span per attempt (retries each get their
+            # own, auto-tagged with error_class on failure); the
+            # executor's per-partition span nests under it via the
+            # thread-current stack
+            span_cm = (
+                obs_trace.span("attempt", rec=ctx.tracer,
+                               partition=p, attempt=attempt)
+                if obs_trace.ACTIVE else obs_trace.NULL
+            )
             it = execute_partition(op, p, ctx)
             out: List[pa.RecordBatch] = []
             try:
-                for rb in it:
-                    out.append(rb)
-                    if cancelled():
-                        # the executor's cancellation pass-through:
-                        # close -> GeneratorExit unwinds the operator
-                        # tree without poisoning the engine
-                        it.close()
-                        raise PlanCancelled(
-                            f"partition {p} cancelled mid-stream"
-                        )
-                return out
+                with span_cm:
+                    for rb in it:
+                        out.append(rb)
+                        if cancelled():
+                            # the executor's cancellation
+                            # pass-through: close -> GeneratorExit
+                            # unwinds the operator tree without
+                            # poisoning the engine
+                            it.close()
+                            raise PlanCancelled(
+                                f"partition {p} cancelled mid-stream"
+                            )
+                    return out
             except PlanCancelled:
                 raise
             except TaskExecutionError as e:

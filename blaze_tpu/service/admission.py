@@ -26,7 +26,12 @@ serving tier than peak packing.
 Shedding: a query whose deadline has ALREADY passed at submit time
 cannot be met no matter what - the service refuses it up front
 (TIMED_OUT with a shed marker) instead of letting it occupy queue
-depth only to die in the deadline sweep.
+depth only to die in the deadline sweep. The service additionally
+sheds at ADMISSION time on PREDICTED unmeetability: when the
+runtime-history store (obs/history.py) has >= 3 samples for the
+query's fingerprint and now + p50 estimate already overshoots the
+deadline, running it would only burn device time to miss anyway
+(`shed_predicted` counter; service/service.py drives the check).
 
 Backpressure is explicit: a full queue rejects at submit time
 (REJECTED_OVERLOADED) instead of building an unbounded pileup.
@@ -116,6 +121,7 @@ class AdmissionController:
             "admitted": 0,
             "rejected_overloaded": 0,
             "shed_deadline": 0,
+            "shed_predicted": 0,
             "headroom_waits": 0,
         }
 
@@ -144,6 +150,18 @@ class AdmissionController:
         with self._lock:
             self.counters["submitted"] += 1
             self.counters["shed_deadline"] += 1
+
+    def note_shed_predicted(self) -> None:
+        """Predicted-unmeetability shed (ROADMAP deadline item, second
+        half): queue-wait already spent + the fingerprint's p50 runtime
+        estimate exceed the query's remaining slack. Distinct counter -
+        prediction sheds are tunable (history quality), hard-deadline
+        sheds are not. The query was already counted `submitted` at
+        enqueue; `admitted` is counted only when the ADMITTED
+        transition lands (note_admitted), so a shed never touches it
+        and completion-rate math (done/admitted) stays honest."""
+        with self._lock:
+            self.counters["shed_predicted"] += 1
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -178,9 +196,17 @@ class AdmissionController:
                     return None
                 heapq.heappop(self._heap)
                 self._reserved[q.query_id] = est
-                self.counters["admitted"] += 1
                 return q
             return None
+
+    def note_admitted(self) -> None:
+        """Counted by the SERVICE once the ADMITTED transition lands -
+        not at the next_admissible pop - so predicted-unmeetability
+        sheds and admit-races never touch it and the counter stays
+        monotonic (it is exported with Prometheus TYPE counter; a
+        decrement would read as a counter reset and corrupt rate())."""
+        with self._lock:
+            self.counters["admitted"] += 1
 
     def release(self, q: Query) -> None:
         with self._lock:

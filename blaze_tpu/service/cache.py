@@ -107,6 +107,15 @@ class ResultCache:
             self.counters["hits"] += 1
             return batches
 
+    def contains(self, key: CacheKey) -> bool:
+        """Non-mutating presence probe (no hit/miss counters, no LRU
+        touch, no spill restore): admission-time checks (predicted-
+        unmeetability shedding) must not distort cache telemetry or
+        recency just by asking."""
+        with self._lock:
+            e = self._entries.get(key)
+            return e is not None and time.monotonic() < e.expires_at
+
     def put(self, key: CacheKey, batches: List) -> bool:
         """Store one partition's materialized batches. Returns False
         when the entry is larger than the whole cache (never stored)."""

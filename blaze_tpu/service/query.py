@@ -133,6 +133,13 @@ class Query:
         # after RESOURCE_EXHAUSTED (the native->Spark fallback analog)
         self.degraded = False
         self.result: Optional[List] = None  # pa.RecordBatch list
+        # observability (blaze_tpu/obs): the per-query TraceRecorder
+        # (service-filled when tracing is on; root span opens at
+        # submit, closes at the terminal transition) and a terminal
+        # callback the service uses for runtime-history recording,
+        # metrics, and the slow-query log
+        self.tracer = None
+        self.on_terminal = None
         self.ctx = ExecContext(task_id=self.query_id)
         # ONE metric tree per query: the executor adds `dispatch.*`
         # deltas to ctx.metrics' root counters, instrument() mirrors
@@ -164,9 +171,13 @@ class Query:
                     f"{new.name} ({self.query_id})"
                 )
             self.state = new
+            fire = False
             if new in TERMINAL_STATES:
                 self.timings.setdefault("finished", time.monotonic())
+                fire = not self._done.is_set()
                 self._done.set()
+        if fire:
+            self._fire_terminal(new)
 
     def try_transition(self, new: QueryState) -> bool:
         """Transition if legal from the current state; False otherwise
@@ -175,10 +186,38 @@ class Query:
             if new not in _ALLOWED.get(self.state, ()):
                 return False
             self.state = new
+            fire = False
             if new in TERMINAL_STATES:
                 self.timings.setdefault("finished", time.monotonic())
+                fire = not self._done.is_set()
                 self._done.set()
-            return True
+        if fire:
+            self._fire_terminal(new)
+        return True
+
+    def _fire_terminal(self, new: QueryState) -> None:
+        """Exactly-once terminal hook, OUTSIDE the state lock (the
+        service's observability callback touches its own locks): close
+        the trace root span, then notify the service."""
+        if self.tracer is not None:
+            try:
+                self.tracer.finish(
+                    state=new.value, error_class=self.error_class,
+                    degraded=self.degraded or None,
+                )
+            except Exception:  # noqa: BLE001 - obs must not raise
+                pass
+        cb = self.on_terminal
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 - obs must not raise
+                import logging
+
+                logging.getLogger("blaze_tpu.service").exception(
+                    "terminal observability hook failed for %s",
+                    self.query_id,
+                )
 
     # -- cancellation / deadline ---------------------------------------
     def request_cancel(self, reason: str = "user") -> None:
@@ -188,9 +227,16 @@ class Query:
         the same event, and a user cancel that narrowly precedes the
         deadline must still report CANCELLED)."""
         with self._lock:
-            if not self._cancel.is_set():
+            first = not self._cancel.is_set()
+            if first:
                 self._cancel_reason = reason
             self._cancel.set()
+        if first and self.tracer is not None:
+            # cancellation lands in the trace as a root-span event
+            try:
+                self.tracer.event("cancel_requested", reason=reason)
+            except Exception:  # noqa: BLE001 - obs must not raise
+                pass
 
     @property
     def cancel_requested(self) -> bool:
@@ -276,4 +322,8 @@ class Query:
             if k in m:
                 out[k] = m[k]
         out["dispatches"] = m.get("dispatch.dispatches", 0)
+        if self._fingerprint is not None and self._fingerprint_stable:
+            # stable content fingerprint: the affinity key replica
+            # routing and the runtime-history store share
+            out["fingerprint"] = self._fingerprint
         return out

@@ -26,12 +26,18 @@ starts both.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import itertools
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional
 
 from blaze_tpu.errors import ErrorClass, classify, retry_action
+from blaze_tpu.obs import slowlog
+from blaze_tpu.obs import trace as obs_trace
+from blaze_tpu.obs.history import RuntimeHistory
+from blaze_tpu.obs.metrics import REGISTRY
 from blaze_tpu.service.admission import (
     AdmissionController,
     estimate_plan_device_bytes,
@@ -48,6 +54,10 @@ log = logging.getLogger("blaze_tpu.service")
 
 _MAX_RETAINED = 1024  # terminal queries kept for poll/report
 
+# monotonically assigned `service` label values for the process-wide
+# metrics registry (see QueryService._collect_metrics)
+_service_instance_ids = itertools.count()
+
 
 class QueryService:
     def __init__(
@@ -61,6 +71,9 @@ class QueryService:
         max_task_attempts: int = 3,
         retry_backoff_s: float = 0.05,
         degrade_to_host: bool = True,
+        enable_trace: bool = True,
+        slow_query_s: Optional[float] = None,
+        history: Optional[RuntimeHistory] = None,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -79,6 +92,40 @@ class QueryService:
             else (ResultCache() if enable_cache else None)
         )
         self.default_deadline_s = default_deadline_s
+        # observability (blaze_tpu/obs): refcounted tracing for the
+        # service lifetime, per-fingerprint runtime history (the
+        # deadline-prediction input), slow-query log threshold, and
+        # a per-instance collector on the process metrics registry
+        self._trace_enabled = bool(enable_trace)
+        if self._trace_enabled:
+            obs_trace.enable()
+        self.history = history if history is not None else RuntimeHistory()
+        # threshold precedence: explicit arg > BLAZE_SLOW_QUERY_S env
+        # (validated - a typo must not kill serve at startup) > 5s
+        if slow_query_s is None:
+            env = os.environ.get("BLAZE_SLOW_QUERY_S")
+            try:
+                slow_query_s = float(env) if env else 5.0
+            except ValueError:
+                log.warning(
+                    "ignoring malformed BLAZE_SLOW_QUERY_S=%r", env
+                )
+                slow_query_s = 5.0
+        self.slow_query_s = float(slow_query_s)
+        self.obs_counters = {
+            "degraded_queries": 0,
+            "retried_queries": 0,
+            "slow_queries": 0,
+        }
+        # instance label: the registry is process-wide and several
+        # services may be alive at once - unlabeled samples would
+        # collide into duplicate series and fail the whole scrape
+        self._instance = str(next(_service_instance_ids))
+        self._collector_key = f"service:{self._instance}"
+        REGISTRY.register_collector(
+            self._collector_key, self._collect_metrics
+        )
+        self._closed = False
         self._queries: Dict[str, Query] = {}
         self._order: List[str] = []  # retention ring
         self._lock = threading.Lock()
@@ -124,6 +171,7 @@ class QueryService:
             estimated_bytes=estimated_bytes,
             use_cache=use_cache,
         )
+        self._attach_obs(q)
         try:
             if is_ref:
                 from blaze_tpu.plan.refcompat import (
@@ -178,10 +226,20 @@ class QueryService:
             ),
             use_cache=use_cache,
         )
+        self._attach_obs(q)
         q._decoded = None
         q._fingerprint = plan.fingerprint()
         q._fingerprint_stable = plan.fingerprint_is_stable()
         return self._enqueue(q)
+
+    def _attach_obs(self, q: Query) -> None:
+        """Arm per-query observability BEFORE any transition can fire:
+        the span tree (root opens at submit) and the terminal hook
+        (runtime history / metrics / slow-query log)."""
+        if obs_trace.ACTIVE:
+            q.tracer = obs_trace.begin_trace(q.query_id)
+            q.ctx.tracer = q.tracer
+        q.on_terminal = self._on_query_terminal
 
     def _enqueue(self, q: Query) -> Query:
         self._register(q)
@@ -281,12 +339,129 @@ class QueryService:
         return "\n".join(head) + ("\n" + body if body else "")
 
     def stats(self) -> dict:
-        out = {"admission": self.admission.stats()}
+        """Structured service snapshot (the STATS verb payload): the
+        machine-readable form replica routing consumes - admission
+        headroom + queue depth, cache hit/miss/evictions, degradation
+        and quarantine counts, and the runtime-history summary."""
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            live = 0
+            for q in self._queries.values():
+                by_state[q.state.value] = (
+                    by_state.get(q.state.value, 0) + 1
+                )
+                if not q.done:
+                    live += 1
+        out = {
+            "admission": self.admission.stats(),
+            "queries": {
+                "live": live,
+                "by_state": by_state,
+                **self.obs_counters,
+            },
+            "runtime_history": self.history.summary(),
+            "quarantine": {
+                # cluster drivers in this process record quarantines
+                # on the shared registry (runtime/cluster.py)
+                "workers_total": int(
+                    REGISTRY.get("blaze_worker_quarantines_total")
+                ),
+            },
+            "service": {
+                "max_concurrency": self.admission.max_concurrency,
+                "max_queue_depth": self.admission.max_queue_depth,
+                "slow_query_s": self.slow_query_s,
+                "trace_enabled": self._trace_enabled,
+            },
+        }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out
 
+    def trace(self, query_id: str) -> Optional[dict]:
+        """Chrome-trace-event JSON for one query (Perfetto-loadable),
+        or None when tracing was off for it. Served through the
+        REPORT verb and `python -m blaze_tpu trace <query_id>`."""
+        q = self.get(query_id)
+        rec = q.tracer or obs_trace.get_trace(query_id)
+        return obs_trace.chrome_trace(rec) if rec is not None else None
+
+    # -- observability hooks -------------------------------------------
+    def _on_query_terminal(self, q: Query) -> None:
+        """Exactly-once per query (Query._fire_terminal): fold the
+        outcome into the process metrics registry, the per-fingerprint
+        runtime history, and (over threshold) the slow-query log."""
+        t = q.timings
+        wall = t.get("finished", time.monotonic()) - t["submitted"]
+        REGISTRY.inc("blaze_queries_total", state=q.state.value)
+        REGISTRY.observe("blaze_query_wall_seconds", wall)
+        retried = any(a.get("action") == "retry" for a in q.attempts)
+        slow = 0 < self.slow_query_s < wall
+        with self._lock:  # concurrent worker threads reach terminal
+            if retried:
+                self.obs_counters["retried_queries"] += 1
+            if q.degraded:
+                self.obs_counters["degraded_queries"] += 1
+            if slow:
+                self.obs_counters["slow_queries"] += 1
+        if q.degraded:
+            REGISTRY.inc("blaze_degraded_queries_total")
+        if (
+            q.state is QueryState.DONE
+            and q._fingerprint is not None
+            and q._fingerprint_stable
+            and not q.degraded
+            and "run_start" in t and "finished" in t
+        ):
+            # clean device executions only: degraded runs measure the
+            # host fallback, not the plan
+            self.history.record(
+                q._fingerprint, t["finished"] - t["run_start"]
+            )
+        if slow:
+            REGISTRY.inc("blaze_slow_queries_total")
+            slowlog.emit(q, self.slow_query_s)
+
+    def _collect_metrics(self):
+        """Scrape-time samples for the process registry (METRICS verb):
+        live admission/cache/history state as gauges, cumulative event
+        counts as counters."""
+        samples = []
+        sid = {"service": self._instance}  # series-disambiguating
+        a = self.admission.stats()
+        for k in ("submitted", "admitted", "rejected_overloaded",
+                  "shed_deadline", "shed_predicted",
+                  "headroom_waits"):
+            samples.append(("blaze_admission_events_total",
+                            {"event": k, **sid}, a.get(k, 0),
+                            "counter"))
+        for k in ("queued", "running", "reserved_bytes", "headroom"):
+            samples.append((f"blaze_admission_{k}", dict(sid),
+                            a.get(k, 0), "gauge"))
+        if self.cache is not None:
+            c = self.cache.stats()
+            for k in ("hits", "misses", "evictions", "puts", "spills",
+                      "restores", "spill_errors"):
+                samples.append(("blaze_result_cache_events_total",
+                                {"event": k, **sid}, c.get(k, 0),
+                                "counter"))
+            for k in ("entries", "bytes", "spilled_entries"):
+                samples.append((f"blaze_result_cache_{k}", dict(sid),
+                                c.get(k, 0), "gauge"))
+        h = self.history.summary(top=0)
+        samples.append(("blaze_runtime_history_fingerprints",
+                        dict(sid), h["fingerprints"], "gauge"))
+        samples.append(("blaze_runtime_history_samples_total",
+                        dict(sid), h["total_samples"], "counter"))
+        return samples
+
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        REGISTRY.unregister_collector(self._collector_key)
+        if self._trace_enabled:
+            obs_trace.disable()
         self._stop = True
         # shutdown cancels every live query: queued ones die here,
         # running ones observe the event at their next batch boundary -
@@ -322,14 +497,80 @@ class QueryService:
                 q = self.admission.next_admissible()
                 if q is None:
                     break
+                # predicted-unmeetability shedding: queue-wait is
+                # already spent; if the fingerprint's p50 runtime
+                # (>= 3 samples, obs/history.py) cannot fit the
+                # remaining slack, running the query only burns
+                # device time to miss the deadline anyway
+                reason = self._predicted_unmeetable(q)
+                if reason is not None:
+                    self.admission.release(q)
+                    self.admission.note_shed_predicted()
+                    if q.tracer is not None:
+                        q.tracer.event("shed_predicted",
+                                       reason=reason)
+                    prev = q.error
+                    q.error = reason
+                    if not q.try_transition(QueryState.TIMED_OUT):
+                        q.error = prev  # lost the race (cancelled)
+                    continue
                 if not q.try_transition(QueryState.ADMITTED):
                     # cancelled / timed out between queue and admit
                     self.admission.release(q)
                     continue
+                self.admission.note_admitted()
                 q.timings["admitted"] = time.monotonic()
+                if q.tracer is not None:
+                    q.tracer.record_span(
+                        "queue_wait", q.timings["submitted"],
+                        q.timings["admitted"],
+                    )
                 with self._lock:
                     self.admission_log.append(q.query_id)
                 self._workers.submit(self._run_query, q)
+
+    def _predicted_unmeetable(self, q: Query) -> Optional[str]:
+        """Shed message when the runtime-history p50 estimate says the
+        deadline cannot be met from here, else None. Conservative by
+        construction: needs a deadline, a stable fingerprint, and >= 3
+        recorded samples - one cold-compile outlier never sheds."""
+        if q.deadline_at is None or q._fingerprint is None:
+            return None
+        if not q._fingerprint_stable:
+            return None
+        est = self.history.p50(q._fingerprint, min_samples=3)
+        if est is None:
+            return None
+        if time.monotonic() + est < q.deadline_at:
+            return None
+        # a fully-cached query serves in milliseconds regardless of
+        # its recorded runtime - shedding it on the estimate would
+        # refuse work the cache answers inside any deadline (and,
+        # since sheds never execute, would pin the slow estimate
+        # forever)
+        if (
+            self.cache is not None and q.use_cache
+            and self._cache_covers(q)
+        ):
+            return None
+        return (
+            f"predicted unmeetable at admission (shed): p50 runtime "
+            f"{est:.3f}s exceeds remaining slack"
+        )
+
+    def _cache_covers(self, q: Query) -> bool:
+        """True when every partition the query would run is present
+        (and fresh) in the result cache."""
+        if q.plan is not None:
+            partitions = range(q.plan.partition_count)
+        elif q._decoded is not None:
+            partitions = [q._decoded[1]]
+        else:
+            return False
+        return all(
+            self.cache.contains((q._fingerprint, p))
+            for p in partitions
+        )
 
     def _sweep_deadlines(self) -> None:
         now = time.monotonic()
@@ -341,8 +582,13 @@ class QueryService:
             if not q.deadline_exceeded(now):
                 continue
             if q.state is QueryState.QUEUED:
-                if q.try_transition(QueryState.TIMED_OUT):
-                    q.error = "deadline exceeded while queued"
+                # error BEFORE the transition: the exactly-once
+                # terminal hook (trace root tags, slow-query log)
+                # snapshots the query as the transition fires
+                prev = q.error
+                q.error = "deadline exceeded while queued"
+                if not q.try_transition(QueryState.TIMED_OUT):
+                    q.error = prev  # lost the race to another state
             elif q.state in (QueryState.ADMITTED, QueryState.RUNNING):
                 # propagate the cancel event so the run loop (or a
                 # retry-backoff wait) observes it promptly; the run
@@ -361,7 +607,11 @@ class QueryService:
                 # RAISED fault here goes through the same taxonomy
                 # surfacing as any pre-execution failure
                 try:
-                    chaos.fire("service.admit", query_id=q.query_id)
+                    with (obs_trace.span("service_admit",
+                                         rec=q.tracer)
+                          if obs_trace.ACTIVE else obs_trace.NULL):
+                        chaos.fire("service.admit",
+                                   query_id=q.query_id)
                 except Exception as e:  # noqa: BLE001 - classified
                     q.error = f"{type(e).__name__}: {e}"
                     q.error_class = classify(e).value
@@ -376,15 +626,22 @@ class QueryService:
                 if q.try_transition(QueryState.CANCELLED):
                     return
             if q.deadline_exceeded():
+                prev = q.error
+                q.error = "deadline exceeded before start"
                 if q.try_transition(QueryState.TIMED_OUT):
-                    q.error = "deadline exceeded before start"
                     return
+                q.error = prev
             if q.cancel_requested:
                 if q.try_transition(QueryState.CANCELLED):
                     return
             if not q.try_transition(QueryState.RUNNING):
                 return
             q.timings["run_start"] = time.monotonic()
+            if q.tracer is not None and "admitted" in q.timings:
+                q.tracer.record_span(
+                    "admission", q.timings["admitted"],
+                    q.timings["run_start"],
+                )
             try:
                 q.result = self._execute(q)
             except QueryCancelled:
@@ -444,7 +701,14 @@ class QueryService:
             q.check_interrupt()
             key = (q._fingerprint, p)
             if cache is not None:
-                hit = cache.get(key)
+                probe_cm = (
+                    obs_trace.span("cache_probe", rec=q.tracer,
+                                   partition=p)
+                    if obs_trace.ACTIVE else obs_trace.NULL
+                )
+                with probe_cm as sp:
+                    hit = cache.get(key)
+                    sp.tag(hit=hit is not None)
                 if hit is not None:
                     q.ctx.metrics.add("cache_hits", 1)
                     for rb in hit:
@@ -478,8 +742,18 @@ class QueryService:
 
         for attempt in range(self.max_task_attempts):
             q.check_interrupt()
+            # obs seam: one span per attempt; a failing attempt is
+            # auto-tagged with its error_class by the span exit, so a
+            # retried query renders as N attempt spans with N-1 tagged
+            # failures
+            span_cm = (
+                obs_trace.span("attempt", rec=q.tracer,
+                               partition=partition, attempt=attempt)
+                if obs_trace.ACTIVE else obs_trace.NULL
+            )
             try:
-                return self._drain(q, op, partition), False
+                with span_cm:
+                    return self._drain(q, op, partition), False
             except QueryCancelled:
                 raise
             except Exception as e:  # noqa: BLE001 - classified below
@@ -534,7 +808,11 @@ class QueryService:
                 from blaze_tpu.plan.serde import task_from_proto
 
                 base = task_from_proto(q.task_bytes)[0]
-            batches = execute_partition_host(base, partition, q.ctx)
+            with (obs_trace.span("host_degrade", rec=q.tracer,
+                                 partition=partition)
+                  if obs_trace.ACTIVE else obs_trace.NULL):
+                batches = execute_partition_host(base, partition,
+                                                 q.ctx)
         except Exception as host_err:  # noqa: BLE001 - original wins
             log.warning(
                 "query %s: host degradation of partition %d "

@@ -24,8 +24,18 @@ Service framing (all integers LE):
                then u64 0 (the shuffle/gateway wire format, io/ipc.py)
             -> else: u64 ERR | u32 len | "STATE: detail" utf8
   CANCEL:   u32 id_len | id   -> JSON frame
-  REPORT:   u32 id_len | id   -> JSON frame {report: text}
-  STATS:    u32 0             -> JSON frame (service stats)
+  REPORT:   u32 id_len | id | u32 flags -> JSON frame {report: text,
+            trace?: Chrome-trace-event JSON} - `trace` included only
+            when flags bit 0 is set AND tracing was on for the query
+            (obs/trace.py); it is the Perfetto-loadable document
+            `python -m blaze_tpu trace` writes out
+  STATS:    u32 0             -> JSON frame (service stats: admission
+            headroom/queue depth, cache counters, degradation +
+            quarantine counts, runtime-history summary)
+  METRICS:  u32 0             -> JSON frame {metrics: text} -
+            Prometheus text exposition from the process registry
+            (obs/metrics.py), folding dispatch.*, admission, cache,
+            and query-lifecycle counters
   JSON frame: u32 len | utf8 JSON
 
 Session semantics: queries submitted on a connection belong to it;
@@ -48,6 +58,7 @@ import struct
 import time
 from typing import Iterator, List, Optional
 
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.testing import chaos
 
 _U64 = struct.Struct("<Q")
@@ -60,8 +71,12 @@ VERB_FETCH = 3
 VERB_CANCEL = 4
 VERB_REPORT = 5
 VERB_STATS = 6
+VERB_METRICS = 7
 
 MAX_META_BYTES = 1 << 20
+# response JSON frames may carry a whole trace document (REPORT);
+# request-side frames keep the tighter MAX_META_BYTES bound
+MAX_JSON_BYTES = 8 << 20
 
 
 class ServiceError(RuntimeError):
@@ -105,13 +120,29 @@ def handle_service_connection(sock, service) -> None:
                     _send_json(sock, service.cancel(qid))
                 elif verb == VERB_REPORT:
                     qid = _read_str(sock)
-                    _read_u32(sock)
-                    _send_json(
-                        sock, {"report": service.report(qid)}
-                    )
+                    flags = _read_u32(sock)
+                    resp = {"report": service.report(qid)}
+                    # trace is OPT-IN (flags bit 0): serializing a
+                    # multi-MB span tree on every text-report poll
+                    # would tax exactly the hot path observability
+                    # must not
+                    trace_of = getattr(service, "trace", None)
+                    if flags & 1 and trace_of is not None:
+                        doc = trace_of(qid)
+                        if doc is not None:
+                            resp["trace"] = doc
+                    _send_json(sock, resp)
                 elif verb == VERB_STATS:
                     _read_u32(sock)
                     _send_json(sock, service.stats())
+                elif verb == VERB_METRICS:
+                    _read_u32(sock)
+                    from blaze_tpu.obs.metrics import REGISTRY
+
+                    _send_json(
+                        sock,
+                        {"metrics": REGISTRY.render_prometheus()},
+                    )
                 else:
                     raise ValueError(f"unknown service verb {verb}")
             except (ConnectionError, BrokenPipeError, OSError):
@@ -215,6 +246,9 @@ def _handle_fetch(sock, service) -> None:
         )
         return
     t0 = time.perf_counter_ns()
+    stream_start = time.monotonic()
+    sent = 0
+    complete = False
     try:
         for i, rb in enumerate(q.result or ()):
             if chaos.ACTIVE:
@@ -222,7 +256,9 @@ def _handle_fetch(sock, service) -> None:
                 # client's reconnect-and-refetch path covers it)
                 chaos.fire("gateway.stream", query_id=qid, partition=i)
             sock.sendall(encode_ipc_segment(rb))
+            sent += 1
         sock.sendall(_U64.pack(0))
+        complete = True
     except Exception as e:
         # once parts are on the wire the client reads u64 frames; a
         # JSON error frame here would desync it - abort the connection
@@ -233,6 +269,19 @@ def _handle_fetch(sock, service) -> None:
             q.timings.get("stream_ns", 0)
             + (time.perf_counter_ns() - t0)
         )
+        if obs_trace.ACTIVE and getattr(q, "tracer", None) is not None:
+            # result streaming happens AFTER the root span closed
+            # (terminal state), so it records as a sibling span on
+            # the lifecycle track; `parts` counts what was ACTUALLY
+            # sent - an aborted stream (and the client's re-FETCH,
+            # which records its own span) must not claim full delivery
+            tags = {"parts": sent, "total": len(q.result or ())}
+            if not complete:
+                tags["aborted"] = True
+            q.tracer.record_span(
+                "result_stream", stream_start, time.monotonic(),
+                **tags,
+            )
 
 
 def _read_u32(sock) -> int:
@@ -393,8 +442,27 @@ class ServiceClient:
             self._id_verb(VERB_REPORT, query_id)
         )["report"]
 
+    def report_full(self, query_id: str,
+                    include_trace: bool = True) -> dict:
+        """The whole REPORT frame: {report: text, trace?: Chrome trace
+        JSON}. The trace document is requested via flags bit 0 (plain
+        `report()` skips it - text polling must not pay a multi-MB
+        span-tree serialization); `python -m blaze_tpu trace`
+        consumes the trace field."""
+        return self._roundtrip(
+            self._id_verb(VERB_REPORT, query_id,
+                          1 if include_trace else 0)
+        )
+
     def stats(self) -> dict:
         return self._roundtrip(bytes([VERB_STATS]) + _U32.pack(0))
+
+    def metrics(self) -> str:
+        """Prometheus text exposition from the server's process
+        metrics registry (obs/metrics.py)."""
+        return self._roundtrip(
+            bytes([VERB_METRICS]) + _U32.pack(0)
+        )["metrics"]
 
     def fetch(self, query_id: str, timeout_ms: int = 0) -> list:
         """Materialize the result stream (list of pa.RecordBatch)."""
@@ -484,7 +552,7 @@ class ServiceClient:
         from blaze_tpu.runtime.transport import _recv_exact
 
         (n,) = _U32.unpack(_recv_exact(self._sock, _U32.size))
-        if n > MAX_META_BYTES:
+        if n > MAX_JSON_BYTES:
             raise ValueError("oversized JSON frame")
         return json.loads(_recv_exact(self._sock, n).decode("utf-8"))
 

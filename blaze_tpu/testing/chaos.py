@@ -177,6 +177,17 @@ class FaultPlan:
                 break
         if chosen is None:
             return
+        # observability: a fired fault lands in the active trace as a
+        # span event carrying the plan seed, so an exported trace
+        # explains WHY an attempt failed (docs/OBSERVABILITY.md)
+        from blaze_tpu.obs import trace as obs_trace
+
+        if obs_trace.ACTIVE:
+            obs_trace.event(
+                "chaos.fault", site=site, klass=chosen.klass,
+                seed=self.seed,
+                **{k: str(v) for k, v in ctx.items()},
+            )
         if chosen.klass == "STALL":
             time.sleep(chosen.stall_s)
             return

@@ -187,6 +187,31 @@ def chaos_smoke() -> bool:
     )
 
 
+def obs_smoke() -> bool:
+    """Observability smoke (ISSUE 4 satellite): trace-export schema
+    validity (chaos-retried multi-partition query -> Perfetto JSON),
+    METRICS/STATS wire surface, runtime-history + predicted shedding,
+    the slow-query log, and the obs-off wall-overhead guard (<2% on a
+    battery shape) - plus the dispatch-budget pins that obs hooks add
+    zero dispatches."""
+    return run(
+        "obs suite",
+        ["tests/test_obs.py", "tests/test_dispatch_budget.py"],
+    )
+
+
+def trace_smoke() -> bool:
+    """Trace-export smoke (ISSUE 4 satellite, `--trace`): ONE
+    multi-partition query with a chaos-injected transient retry,
+    exported and validated against the minimal Chrome-trace-event
+    schema (matched B/E pairs, monotonic ts, attempt spans tagged with
+    error_class) plus the export/stitching unit tests."""
+    return run(
+        "trace smoke",
+        ["tests/test_obs.py", "-k", "trace or chrome or stitch"],
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int,
@@ -201,11 +226,22 @@ def main():
                     help="chaos suite only: fixed-seed fault injection "
                          "across the serving stack (retry / degrade / "
                          "reconnect / quarantine semantics)")
+    ap.add_argument("--trace", action="store_true",
+                    help="trace-export smoke only: chaos-retried "
+                         "multi-partition query -> Perfetto JSON, "
+                         "validated against the Chrome-trace-event "
+                         "schema")
     args = ap.parse_args()
     rows = 20_000 if args.fast else args.rows
 
     ok = True
     t0 = time.time()
+
+    if args.trace:
+        ok &= trace_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (trace) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
 
     if args.chaos:
         ok &= chaos_smoke()
@@ -217,6 +253,7 @@ def main():
         ok &= bench_smoke()
         ok &= service_smoke()
         ok &= chaos_smoke()
+        ok &= obs_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1

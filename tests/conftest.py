@@ -66,6 +66,25 @@ def _chaos_hygiene():
 
 
 @pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """Same contract for tracing (obs/trace.py): a test that enables
+    tracing (directly or via an unclosed QueryService) and fails must
+    not leave the tracing-on path armed - the tracing-off dispatch
+    budgets are pinned by tests. BLAZE_TRACE-activated runs (cluster
+    worker subprocess tests) keep their import-time state. The global
+    metrics registry resets too: a failed test's stale collector (an
+    unclosed service) must not feed samples - and pin the service
+    alive - for every later exposition, and per-test counter baselines
+    keep Prometheus-text assertions deterministic."""
+    yield
+    from blaze_tpu.obs import trace
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    trace._reset_for_tests()
+    REGISTRY._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
 def _isolate_engine_globals():
     from blaze_tpu import config as config_mod
     from blaze_tpu.runtime import memory as memory_mod

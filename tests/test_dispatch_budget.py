@@ -308,6 +308,55 @@ def test_chaos_hooks_add_zero_dispatches(tables):
     _check(armed, dispatches=1, h2d=0, d2h=1)
 
 
+def test_obs_hooks_add_zero_dispatches(tables):
+    """ISSUE 4 acceptance: the tracing seams are pure host-side
+    control flow. Obs-OFF keeps the exact per-shape dispatch budget
+    (the off path is one module-attribute check per seam), and even
+    obs-ON - recorder installed, every seam recording spans - adds
+    zero dispatches, transfers, and kernel builds: spans observe the
+    engine, they cannot drive it."""
+    from blaze_tpu.obs import trace
+
+    assert not trace.ACTIVE  # tracing is strictly opt-in
+
+    def mk():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([[tables["fact"]]],
+                               tables["fact"].schema),
+                [(Col("price"), "p")],
+            ),
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("p")), "s")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    baseline = _counts(lambda: run_plan(mk()))
+
+    def traced():
+        rec = trace.begin_trace("budget-probe")
+        with trace.span("battery", rec=rec):
+            run_plan(mk())
+        rec.finish(state="DONE")
+
+    trace.enable()
+    try:
+        traced()  # warm the traced path
+        with dispatch.counting() as c:
+            traced()
+        armed = c.counts
+    finally:
+        trace.disable()
+    assert not trace.ACTIVE
+    for k in ("dispatches", "h2d_batches", "d2h_fetches",
+              "d2h_syncs", "kernel_builds"):
+        assert armed.get(k, 0) == baseline.get(k, 0), (k, armed)
+    _check(armed, dispatches=1, h2d=0, d2h=1)
+    # obs-off after the traced run: budget byte-identical to baseline
+    after = _counts(lambda: run_plan(mk()))
+    assert after == baseline, (after, baseline)
+
+
 def test_executor_exposes_dispatch_metrics(tables):
     from blaze_tpu.ops.base import ExecContext
     from blaze_tpu.runtime.instrument import render_metrics
