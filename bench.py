@@ -1100,6 +1100,141 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- replica router: a repeated-query mix through TWO replicas,
+    # affinity vs random placement (ISSUE 5 satellite). Every round
+    # submits `rt_conc` repeats of `rt_distinct` fresh plans (fresh
+    # literals per round, so each round is cache-cold fleet-wide).
+    # Affinity placement sends every repeat of a plan to the replica
+    # that ran it first - one execution per plan FLEET-wide, the rest
+    # ResultCache hits; random placement splits repeats across both
+    # replicas - one execution per plan PER REPLICA. The delta is pure
+    # placement quality: same wire, same replicas, same plans. ----
+    try:
+        import threading as _rt_threading
+
+        from blaze_tpu.router import Router, RouterServer
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _RtGateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _RtService,
+            ServiceClient as _RtClient,
+        )
+
+        rt_path = "/tmp/blaze_bench_router.parquet"
+        n_rt = min(n_rows, 1 << 16)
+        pq.write_table(
+            pa.table({"item": item_sk[:n_rt], "qty": qty[:n_rt],
+                      "price": price[:n_rt]}),
+            rt_path, compression="zstd",
+        )
+        rt_distinct = 4   # distinct plans per round
+        rt_conc = 4       # client threads = repeats of each plan
+        rt_round_no = {"n": 0}
+
+        def rt_blobs():
+            """rt_distinct plans with round-unique filter literals:
+            distinct content fingerprints every round, so each round
+            measures a COLD fleet and the affinity-vs-random execution
+            count difference, not steady-state cache hits."""
+            rt_round_no["n"] += 1
+            base = 20.0 + 0.001 * rt_round_no["n"]
+            return [
+                task_to_proto(
+                    HashAggregateExec(
+                        ProjectExec(
+                            FilterExec(
+                                ParquetScanExec(
+                                    [[FileRange(rt_path)]]
+                                ),
+                                (Col("price") > base + 10.0 * j)
+                                & (Col("qty") < 8),
+                            ),
+                            [(Col("price")
+                              * Col("qty").cast(DataType.float32()),
+                              "rev")],
+                        ),
+                        keys=[],
+                        aggs=[(AggExpr(AggFn.SUM, Col("rev")), "t"),
+                              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+                        mode=AggMode.COMPLETE,
+                    ),
+                    0,
+                )
+                for j in range(rt_distinct)
+            ]
+
+        def rt_round(host, port):
+            blobs_i = rt_blobs()
+            errs = []
+
+            def client():
+                try:
+                    with _RtClient(host, port) as cl:
+                        for b in blobs_i:
+                            cl.run(b)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ts = [_rt_threading.Thread(target=client)
+                  for _ in range(rt_conc)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise RuntimeError(errs[0])
+
+        for rt_mode in ("affinity", "random"):
+            name = f"router_qps_r2_{rt_mode}"
+            svcs = [_RtService(max_concurrency=8) for _ in range(2)]
+            srvs = [_RtGateway(service=s).start() for s in svcs]
+            router = Router(
+                ["%s:%d" % s.address for s in srvs],
+                placement=rt_mode,
+                poll_interval_s=0.2,
+                start=True,
+            )
+            rs = RouterServer(router).start()
+            try:
+                router.registry.poll_now()
+                med, spread, k, _ = timed(
+                    lambda: rt_round(*rs.address), iters=3,
+                )
+                detail[name] = {
+                    "median": round(med, 4),
+                    "spread": round(spread, 3),
+                    "k": k,
+                    "qps": round(rt_distinct * rt_conc / med, 1),
+                    "replicas": 2,
+                    "distinct_plans": rt_distinct,
+                    "repeats_per_plan": rt_conc,
+                    "placement": rt_mode,
+                    "rows_per_query": n_rt,
+                }
+            except Exception as e:  # noqa: BLE001
+                detail[name] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]
+                }
+            finally:
+                rs.stop()
+                router.close()
+                for s in srvs:
+                    s.stop()
+                for s in svcs:
+                    s.close()
+            print(
+                "PARTIAL " + json.dumps(
+                    {"query": name, "backend": backend,
+                     **detail[name]}
+                ),
+                flush=True,
+            )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["router_qps"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     geomean = (
         math.exp(sum(math.log(r) for r in ratios) / len(ratios))
         if ratios else 0.0

@@ -15,6 +15,10 @@
                   chrome://tracing)
   metrics         print the server's Prometheus text exposition
                   (dispatch.*, admission, cache, query counters)
+  route           replica router: front N `serve` instances behind one
+                  service endpoint (fingerprint-affinity placement,
+                  headroom-aware load balancing, class-aware failover;
+                  blaze_tpu/router/, docs/ROUTER.md)
 """
 
 from __future__ import annotations
@@ -164,6 +168,27 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    from blaze_tpu.router.proxy import route_forever
+
+    if not args.replica:
+        print("route: at least one --replica HOST:PORT required",
+              file=sys.stderr)
+        return 2
+    route_forever(
+        args.host,
+        args.port,
+        args.replica,
+        placement=args.placement,
+        poll_interval_s=args.poll_interval,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        quarantine_s=args.quarantine,
+        breaker_threshold=args.breaker_threshold,
+        max_resubmits=args.max_resubmits,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="blaze_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -207,6 +232,28 @@ def main(argv=None) -> int:
     mt = sub.add_parser("metrics")
     mt.add_argument("--host", default="127.0.0.1")
     mt.add_argument("--port", type=int, default=8484)
+    rr = sub.add_parser("route")
+    rr.add_argument("--host", default="127.0.0.1")
+    rr.add_argument("--port", type=int, default=8485)
+    rr.add_argument("--replica", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="a serve instance to front (repeatable)")
+    rr.add_argument("--placement", default="affinity",
+                    choices=("affinity", "random"),
+                    help="placement policy (random = baseline for "
+                         "the bench comparison)")
+    rr.add_argument("--poll-interval", type=float, default=0.5,
+                    help="STATS heartbeat poll period seconds")
+    rr.add_argument("--heartbeat-timeout", type=float, default=3.0,
+                    help="no successful poll for this long = dead")
+    rr.add_argument("--quarantine", type=float, default=15.0,
+                    help="quarantine cool-off seconds")
+    rr.add_argument("--breaker-threshold", type=int, default=3,
+                    help="consecutive fatal-class failures that open "
+                         "a replica's circuit breaker")
+    rr.add_argument("--max-resubmits", type=int, default=2,
+                    help="TRANSIENT same-replica re-submissions per "
+                         "query")
     args = p.parse_args(argv)
     return {
         "info": cmd_info,
@@ -216,6 +263,7 @@ def main(argv=None) -> int:
         "serve": cmd_serve,
         "trace": cmd_trace,
         "metrics": cmd_metrics,
+        "route": cmd_route,
     }[args.cmd](args)
 
 

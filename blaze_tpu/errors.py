@@ -48,6 +48,11 @@ RETRYABLE = frozenset({ErrorClass.TRANSIENT})
 FATAL_FOR_WORKER = frozenset(
     {ErrorClass.INTERNAL, ErrorClass.RESOURCE_EXHAUSTED}
 )
+#: same signal one tier up: a service replica repeatedly failing with
+#: these classes is sick - the router's circuit breaker counts them
+#: (PLAN_INVALID deliberately absent: the PLAN is bad, not the replica,
+#: and re-routing a malformed plan would poison every breaker in turn)
+FATAL_FOR_REPLICA = FATAL_FOR_WORKER
 
 
 class BlazeError(RuntimeError):
@@ -70,6 +75,13 @@ class PlanInvalidError(BlazeError):
 
 class CancelledError(BlazeError):
     error_class = ErrorClass.CANCELLED
+
+
+class ReplicaUnavailableError(TransientError):
+    """Router-tier: no routable replica (all dead/quarantined, or the
+    fleet is empty). TRANSIENT by design - capacity comes back when a
+    replica revives or rejoins, so the client's correct reaction is
+    retry-with-backoff, not abandon."""
 
 
 # exception type names that mean "cooperative cancellation" - matched by

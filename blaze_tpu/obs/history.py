@@ -109,7 +109,13 @@ class RuntimeHistory:
             "fingerprints": len(fps),
             "total_samples": total,
             "top": [
+                # `fingerprint` stays display-truncated; `fp` carries
+                # the FULL key so the replica router can join a
+                # replica's reported p50s against the fingerprints it
+                # learned from submit responses (prefix joins would
+                # collide at fleet scale)
                 {"fingerprint": f[:16],
+                 "fp": f,
                  "samples": self._totals.get(f, 0),
                  **(self.estimate(f) or {})}
                 for f in hottest

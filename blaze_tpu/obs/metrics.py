@@ -225,4 +225,49 @@ class MetricsRegistry:
             self._collectors.clear()
 
 
+def merge_expositions(
+    base: str,
+    per_source: Dict[str, str],
+    label: str = "replica",
+) -> str:
+    """Fold several Prometheus text expositions into one document by
+    stamping every sample from `per_source[source_id]` with
+    `{label="source_id"}` - the replica router's METRICS verb uses
+    this to serve the FLEET view (its own registry plus each replica's
+    scrape) without series collisions. `# TYPE` lines are deduplicated
+    first-wins; malformed lines are dropped rather than corrupting the
+    whole scrape."""
+    lines: List[str] = []
+    seen_types = set()
+    for ln in base.splitlines():
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) >= 3:
+                seen_types.add(parts[2])
+        lines.append(ln)
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$"
+    )
+    for source_id, text in sorted(per_source.items()):
+        stamp = f'{_sanitize(label)}="{source_id}"'
+        for ln in (text or "").splitlines():
+            if not ln or ln.startswith("#"):
+                if ln.startswith("# TYPE "):
+                    parts = ln.split()
+                    if len(parts) >= 3 and parts[2] not in seen_types:
+                        seen_types.add(parts[2])
+                        lines.append(ln)
+                continue
+            m = sample_re.match(ln)
+            if m is None:
+                continue  # malformed sample: drop, don't corrupt
+            name, labels, value = m.groups()
+            if labels:
+                labels = labels[:-1] + "," + stamp + "}"
+            else:
+                labels = "{" + stamp + "}"
+            lines.append(f"{name}{labels} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 REGISTRY = MetricsRegistry()

@@ -1,0 +1,53 @@
+"""Replica router: N `serve` instances behind one service endpoint.
+
+The Spark-driver-analog layer above per-replica engines (SURVEY 2.2;
+Flare's scheduler-fronting-heterogeneous-executors shape, PAPERS.md):
+a `ServiceClient` talks to the router exactly as it talks to a single
+`python -m blaze_tpu serve` instance, and the router owns
+
+  membership  - registry.py: STATS-poll heartbeats under the
+                cluster-runner Liveness window; per-replica health,
+                quarantine, Prometheus gauges
+  placement   - placement.py: plan-fingerprint affinity (repeats hit
+                the replica whose ResultCache holds the result - zero
+                dispatches), then headroom-fits-estimated-cost, then a
+                bounded-staleness least-loaded fallback
+  failover    - failover.py: the PR 3 error taxonomy consumed one tier
+                up (TRANSIENT re-submits same-replica with backoff,
+                fatal classes strike a per-replica circuit breaker,
+                heartbeat death re-routes in-flight queries)
+  proxy       - proxy.py: verb forwarding with query-id rewriting and
+                raw segmented-IPC FETCH passthrough (zero decode at
+                the router), fleet-aggregating STATS/METRICS
+
+Code map details in docs/ROUTER.md; `python -m blaze_tpu route` is the
+CLI entry.
+"""
+
+from blaze_tpu.router.failover import CircuitBreaker, failover_action
+from blaze_tpu.router.placement import (
+    AffinityMap,
+    affinity_key,
+    choose_replica,
+)
+from blaze_tpu.router.proxy import (
+    RoutedQuery,
+    Router,
+    RouterServer,
+    handle_router_connection,
+)
+from blaze_tpu.router.registry import Replica, ReplicaRegistry
+
+__all__ = [
+    "AffinityMap",
+    "CircuitBreaker",
+    "Replica",
+    "ReplicaRegistry",
+    "RoutedQuery",
+    "Router",
+    "RouterServer",
+    "affinity_key",
+    "choose_replica",
+    "failover_action",
+    "handle_router_connection",
+]

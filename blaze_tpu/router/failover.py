@@ -1,0 +1,95 @@
+"""Class-aware failover: the PR 3 taxonomy consumed one tier up.
+
+The service already reacts to failure classes INSIDE a replica
+(TRANSIENT retries, RESOURCE_EXHAUSTED degrades, PLAN_INVALID fails
+fast - errors.retry_action). The router decides what a class means for
+the FLEET:
+
+  TRANSIENT           the replica's own retry budget is spent but the
+                      fault is still plausibly environmental:
+                      re-submit to the SAME replica (bounded, with
+                      backoff) - its cache/affinity state is there and
+                      the taxonomy says re-running can work.
+  PLAN_INVALID        surface as-is, count NOTHING against the
+                      replica: the plan is bad; re-routing it would
+                      trip every breaker in the fleet in turn.
+  CANCELLED           surface as-is (cooperative unwind is not a
+                      failure).
+  INTERNAL /          surface the failure AND count it against the
+  RESOURCE_EXHAUSTED  replica's circuit breaker (errors.
+                      FATAL_FOR_REPLICA): enough consecutive ones
+                      quarantine the replica, and quarantine (like
+                      heartbeat death) re-routes its other in-flight
+                      queries to healthy replicas.
+
+Transport-level failures (connection refused/reset while talking to a
+replica) count as breaker strikes too - a replica that cannot be
+spoken to is suspect exactly like one that fails queries.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from blaze_tpu.errors import ErrorClass, FATAL_FOR_REPLICA
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.router.registry import ReplicaRegistry
+
+
+def failover_action(error_class: Optional[str]) -> str:
+    """'resubmit' | 'surface' | 'breaker' for a terminal FAILED status
+    observed through the router."""
+    if error_class == ErrorClass.TRANSIENT.value:
+        return "resubmit"
+    try:
+        ec = ErrorClass(error_class) if error_class else None
+    except ValueError:
+        ec = None
+    if ec in FATAL_FOR_REPLICA or ec is None:
+        # unclassified failures are INTERNAL by taxonomy convention
+        return "breaker"
+    return "surface"
+
+
+class CircuitBreaker:
+    """Per-replica consecutive fatal-class strike counter. Tripping
+    quarantines the replica through the registry (cool-off +
+    half-open there); any success resets the count. Counters ride the
+    process metrics registry so the breaker state is scrapeable."""
+
+    def __init__(self, registry: ReplicaRegistry,
+                 threshold: int = 3):
+        self.registry = registry
+        self.threshold = max(1, int(threshold))
+        self._strikes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def note_ok(self, replica_id: str) -> None:
+        with self._lock:
+            self._strikes.pop(replica_id, None)
+
+    def note_fatal(self, replica_id: str,
+                   kind: str = "query") -> bool:
+        """Record one fatal-class strike; True when this strike opened
+        the breaker (the caller then re-routes the replica's in-flight
+        queries)."""
+        with self._lock:
+            n = self._strikes.get(replica_id, 0) + 1
+            self._strikes[replica_id] = n
+            tripped = n >= self.threshold
+            if tripped:
+                self._strikes[replica_id] = 0  # re-arm for half-open
+        REGISTRY.inc("blaze_router_breaker_strikes_total",
+                     replica=replica_id, kind=kind)
+        if tripped:
+            REGISTRY.inc("blaze_router_breaker_open_total",
+                         replica=replica_id)
+            self.registry.quarantine(
+                replica_id, reason="circuit-open"
+            )
+        return tripped
+
+    def strikes(self, replica_id: str) -> int:
+        with self._lock:
+            return self._strikes.get(replica_id, 0)

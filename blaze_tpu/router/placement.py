@@ -1,0 +1,209 @@
+"""Placement policy: which replica gets the next query.
+
+Decision ladder (first rung that applies wins; every decision is
+counted so STATS explains the mix):
+
+  1. AFFINITY - a stable-fingerprint plan the router has seen before
+     goes back to the replica that last completed it: that replica's
+     ResultCache most plausibly holds the materialized result, and a
+     cache hit costs zero kernel dispatches (the serving tier's
+     acceptance pin). The router learns fingerprints from submit
+     responses (Query.status carries `fingerprint` for stable plans),
+     so no plan decoding happens at the routing tier - the affinity
+     key for a not-yet-learned blob is its raw-byte digest.
+  2. HEADROOM-FITS-ESTIMATED-COST - among replicas with a fresh STATS
+     snapshot (bounded staleness), keep those whose reported admission
+     headroom fits the query's estimated device bytes, then pick the
+     one with the smallest estimated queue-drain: load (queued +
+     running + router-tracked in-flight) weighted by the replica's
+     runtime-history p50 for this fingerprint when it has one (a
+     replica that historically runs this plan fast drains sooner than
+     raw queue depth suggests).
+  3. LEAST-LOADED fallback - when every snapshot is stale (a poll gap,
+     startup), place by the router's own in-flight counts: still
+     load-aware, never blocked on a poll.
+
+Ties on rungs 2 and 3 break by RENDEZVOUS HASH of (affinity key,
+replica), not by a fixed replica order: under equal load, DISTINCT
+plans spread uniformly across the fleet instead of piling onto the
+lexicographically-first replica, while repeats of the SAME plan keep
+landing on one replica - so concurrent first submissions of a plan
+converge on a single cache/coalescing point even before the affinity
+map has learned it from a response.
+
+Quarantined and heartbeat-dead replicas are invisible to every rung.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.router.registry import Replica, ReplicaRegistry
+
+
+def rendezvous_rank(key: str, replica_id: str) -> int:
+    """Highest-random-weight rank for tie-breaking: deterministic per
+    (key, replica) pair, uniform across replicas per key."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(key.encode("utf-8"))
+    h.update(b"|")
+    h.update(replica_id.encode("utf-8"))
+    return int.from_bytes(h.digest(), "big")
+
+
+def affinity_key(task_bytes: bytes, is_ref: bool) -> str:
+    """Routing key for a raw SUBMIT blob: identical submissions digest
+    identically, so repeats route together even before the true plan
+    fingerprint is learned from the first response."""
+    h = hashlib.blake2b(task_bytes, digest_size=16)
+    h.update(b"ref" if is_ref else b"native")
+    return h.hexdigest()
+
+
+class AffinityMap:
+    """Bounded LRU: affinity key -> (replica_id, learned fingerprint).
+
+    Two joinable identities per entry: the blob digest (known before
+    the first submit) and the content-addressed plan fingerprint
+    (learned from the first submit's response, also keyed here so two
+    byte-different encodings of the same plan converge)."""
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._map: "collections.OrderedDict[str, Tuple[str, Optional[str]]]" = (
+            collections.OrderedDict()
+        )
+
+    def lookup(self, key: str) -> Tuple[Optional[str], Optional[str]]:
+        """-> (replica_id, fingerprint) or (None, None)."""
+        with self._lock:
+            v = self._map.get(key)
+            if v is None:
+                return None, None
+            self._map.move_to_end(key)
+            return v
+
+    def record(self, key: str, replica_id: str,
+               fingerprint: Optional[str] = None) -> None:
+        with self._lock:
+            for k in (key, fingerprint):
+                if not k:
+                    continue
+                self._map[k] = (replica_id, fingerprint)
+                self._map.move_to_end(k)
+            while len(self._map) > self.max_entries:
+                self._map.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+class PlacementDecision:
+    __slots__ = ("replica", "reason")
+
+    def __init__(self, replica: Replica, reason: str):
+        self.replica = replica
+        self.reason = reason
+
+
+def choose_replica(
+    registry: ReplicaRegistry,
+    affinity: AffinityMap,
+    key: str,
+    *,
+    estimated_bytes: Optional[int] = None,
+    fingerprint: Optional[str] = None,
+    stats_stale_s: float = 10.0,
+    exclude: Optional[set] = None,
+    use_affinity: bool = True,
+) -> Optional[PlacementDecision]:
+    """Pick a routable replica for one query, or None when the fleet
+    has no routable member. `exclude` drops replicas the caller
+    already failed against in this placement attempt."""
+    exclude = exclude or set()
+    candidates = [
+        r for r in registry.routable()
+        if r.replica_id not in exclude
+    ]
+    if not candidates:
+        return None
+
+    # rung 1: fingerprint affinity. The blob digest is tried first;
+    # a caller-known fingerprint (failover/resubmit re-placement, or
+    # a byte-different encoding of a learned plan) joins through the
+    # fingerprint-keyed entries the AffinityMap also records.
+    if use_affinity:
+        target, learned_fp = affinity.lookup(key)
+        if fingerprint is None:
+            fingerprint = learned_fp
+        if target is None and fingerprint:
+            target, _ = affinity.lookup(fingerprint)
+        if target is not None:
+            for r in candidates:
+                if r.replica_id == target:
+                    return PlacementDecision(r, "affinity")
+
+    # rung 2: fresh-snapshot headroom + estimated queue-drain
+    fresh = [
+        r for r in candidates if r.stats_age_s() <= stats_stale_s
+    ]
+    if fresh:
+        est = int(estimated_bytes or 0)
+        fits = [
+            r for r in fresh
+            if (r.effective_headroom() is None
+                or est <= r.effective_headroom()
+                or r.load() == 0)  # an idle device admits anything
+        ] or fresh  # nobody fits: queue on the least-drained anyway
+
+        def drain_estimate(r: Replica) -> float:
+            p50 = (
+                r.fingerprint_p50(fingerprint)
+                if fingerprint else None
+            )
+            # per-query cost unknown -> unit cost; known -> weight the
+            # queue by how long THIS plan historically takes there
+            return r.load() * (p50 if p50 is not None else 1.0) \
+                + (p50 or 0.0)
+
+        best = min(
+            fits,
+            key=lambda r: (drain_estimate(r),
+                           -(r.effective_headroom() or 0),
+                           -rendezvous_rank(key, r.replica_id)),
+        )
+        return PlacementDecision(best, "headroom")
+
+    # rung 3: bounded-staleness fallback - router-local load only
+    best = min(
+        candidates,
+        key=lambda r: (r.in_flight,
+                       -rendezvous_rank(key, r.replica_id)),
+    )
+    return PlacementDecision(best, "least_loaded")
+
+
+def random_replica(
+    registry: ReplicaRegistry,
+    seq: int,
+    exclude: Optional[set] = None,
+) -> Optional[PlacementDecision]:
+    """Round-robin-ish baseline placement (bench `random` mode): the
+    counter-driven pick is deterministic per submission sequence, which
+    keeps the bench comparison reproducible."""
+    exclude = exclude or set()
+    candidates = sorted(
+        (r for r in registry.routable()
+         if r.replica_id not in exclude),
+        key=lambda r: r.replica_id,
+    )
+    if not candidates:
+        return None
+    return PlacementDecision(
+        candidates[seq % len(candidates)], "random"
+    )

@@ -1,0 +1,1223 @@
+"""The router proper: proxy verbs, routed-query table, failover moves.
+
+`RouterServer` speaks the exact service wire protocol (service/wire.py
+framing), so a `ServiceClient` pointed at the router behaves as if it
+were talking to a single `python -m blaze_tpu serve` instance. Each
+client SUBMIT is forwarded to a placed replica (router/placement.py)
+with `detach=True` - the ROUTER owns session semantics: downstream
+handles must survive the router's own connection churn and re-route
+across replicas, which is precisely what the detach + re-attach
+machinery (PR 3) provides. Cancel-on-disconnect is re-implemented at
+the router tier: a vanished client's non-detached queries are
+cancelled on their replicas.
+
+Query ids are rewritten: the client holds a router-scoped id, the
+routing table maps it to (replica, replica-local id) and re-points it
+on failover - so a re-routed query keeps its handle. FETCH is a raw
+byte passthrough of the segmented-IPC parts (never decoded at the
+router: the zero-copy path of the wire format survives the extra hop),
+with part counting so a mid-stream failover resumes on the new replica
+skipping what the client already received.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import logging
+import os
+import random
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from blaze_tpu.errors import ReplicaUnavailableError
+from blaze_tpu.obs.metrics import REGISTRY, merge_expositions
+from blaze_tpu.router.failover import CircuitBreaker, failover_action
+from blaze_tpu.router.placement import (
+    AffinityMap,
+    PlacementDecision,
+    affinity_key,
+    choose_replica,
+    random_replica,
+)
+from blaze_tpu.router.registry import Replica, ReplicaRegistry
+from blaze_tpu.service.wire import (
+    _ERR,
+    _U32,
+    _U64,
+    VERB_CANCEL,
+    VERB_FETCH,
+    VERB_METRICS,
+    VERB_POLL,
+    VERB_REPORT,
+    VERB_STATS,
+    VERB_SUBMIT,
+    ServiceError,
+    _read_str,
+    _read_u32,
+    _send_err,
+    _send_json,
+)
+
+log = logging.getLogger("blaze_tpu.router")
+
+_MAX_RETAINED = 1024
+_HARD_RETAINED = 4 * _MAX_RETAINED  # even live queries evict past this
+_SPLICE_ERR = (
+    "FAILED: re-executed result diverged from parts already delivered "
+    "(failover across a non-deterministic or degraded re-run); "
+    "resubmit the query"
+)
+_rqid_counter = itertools.count()
+
+
+class RoutedQuery:
+    """One query routed through this router: the client-facing handle
+    plus everything needed to re-route it (the original payload)."""
+
+    __slots__ = (
+        "external_id", "key", "task_bytes", "is_ref", "manifest_bytes",
+        "meta", "replica_id", "internal_id", "fingerprint",
+        "generation", "resubmits", "failovers", "finished",
+        "cancelled", "last_state", "lock", "delivered_hashes",
+        "splice_broken",
+    )
+
+    def __init__(self, key: str, task_bytes: bytes, is_ref: bool,
+                 manifest_bytes: Optional[bytes], meta: dict):
+        self.external_id = f"rq-{next(_rqid_counter)}-{os.getpid():x}"
+        self.key = key
+        self.task_bytes = task_bytes
+        self.is_ref = is_ref
+        self.manifest_bytes = manifest_bytes
+        self.meta = meta
+        self.replica_id: Optional[str] = None
+        self.internal_id: Optional[str] = None
+        self.fingerprint: Optional[str] = None
+        self.generation = 0   # bumped on every re-route
+        self.resubmits = 0    # TRANSIENT same-replica re-submissions
+        self.failovers = 0    # cross-replica re-routes
+        self.finished = False
+        # client cancel: a pending failover must not resurrect this
+        self.cancelled = False
+        self.last_state: Optional[str] = None
+        self.lock = threading.Lock()
+        # canonical part-content record for FETCH: digest of every
+        # part ever delivered to a client, so a re-fetch after
+        # failover can PROVE the re-executed result is part-for-part
+        # identical to what the client already holds (clients resume
+        # by count; a silent splice of two different executions would
+        # corrupt their table)
+        self.delivered_hashes: List[bytes] = []
+        self.splice_broken = False
+
+
+class Router:
+    """Routing table + policy glue over ReplicaRegistry / AffinityMap /
+    CircuitBreaker. Thread-safe; one instance fronts many connections."""
+
+    def __init__(
+        self,
+        replicas,
+        *,
+        placement: str = "affinity",
+        poll_interval_s: float = 0.5,
+        heartbeat_timeout_s: float = 3.0,
+        quarantine_s: float = 15.0,
+        breaker_threshold: int = 3,
+        max_resubmits: int = 2,
+        resubmit_backoff_s: float = 0.05,
+        stats_stale_s: float = 10.0,
+        downstream_timeout_s: float = 120.0,
+        fetch_block_s: float = 0.5,
+        start: bool = True,
+    ):
+        if placement not in ("affinity", "random"):
+            raise ValueError(f"unknown placement mode {placement!r}")
+        self.placement_mode = placement
+        self.max_resubmits = int(max_resubmits)
+        self.resubmit_backoff_s = float(resubmit_backoff_s)
+        self.stats_stale_s = float(stats_stale_s)
+        self.downstream_timeout_s = float(downstream_timeout_s)
+        self.fetch_block_s = float(fetch_block_s)
+        self.registry = ReplicaRegistry(
+            replicas,
+            poll_interval_s=poll_interval_s,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            quarantine_s=quarantine_s,
+            on_dead=self._on_replica_dead_async,
+        )
+        self.affinity = AffinityMap()
+        self.breaker = CircuitBreaker(
+            self.registry, threshold=breaker_threshold
+        )
+        self._queries: Dict[str, RoutedQuery] = {}
+        self._order: List[str] = []
+        self._lock = threading.Lock()
+        self._rr_seq = itertools.count()  # random-mode sequence
+        self.counters: Dict[str, int] = {
+            "submitted": 0,
+            "placed_affinity": 0,
+            "placed_headroom": 0,
+            "placed_least_loaded": 0,
+            "placed_random": 0,
+            "resubmits_transient": 0,
+            "failovers": 0,
+            "overflow_spills": 0,
+            "no_replica": 0,
+        }
+        self._clients: Dict[str, object] = {}
+        self._client_locks: Dict[str, threading.Lock] = {
+            rid: threading.Lock() for rid in self.registry.replicas
+        }
+        self._collector_key = f"router:{id(self):x}"
+        REGISTRY.register_collector(
+            self._collector_key, self._collect_metrics
+        )
+        self._closed = False
+        if start:
+            self.registry.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        REGISTRY.unregister_collector(self._collector_key)
+        self.registry.close()
+        for rid, c in list(self._clients.items()):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        self._clients.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- downstream client pool -----------------------------------------
+    def _call(self, replica: Replica, fn):
+        """Run one verb round trip on the pooled per-replica client
+        (serialized per replica; ServiceClient's reconnect-with-backoff
+        heals transient drops underneath). A failing client is dropped
+        from the pool so the next call starts clean."""
+        from blaze_tpu.service.wire import ServiceClient
+
+        rid = replica.replica_id
+        lock = self._client_locks.setdefault(rid, threading.Lock())
+        with lock:
+            c = self._clients.get(rid)
+            if c is None:
+                c = ServiceClient(
+                    replica.host, replica.port,
+                    timeout=self.downstream_timeout_s,
+                    reconnect_attempts=1,
+                )
+                self._clients[rid] = c
+            try:
+                return fn(c)
+            except Exception:
+                self._clients.pop(rid, None)
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+
+    # -- bookkeeping -----------------------------------------------------
+    def _register(self, rq: RoutedQuery) -> None:
+        evicted = []
+        with self._lock:
+            self._queries[rq.external_id] = rq
+            self._order.append(rq.external_id)
+            while len(self._order) > _MAX_RETAINED:
+                # evict the oldest FINISHED entry wherever it sits: a
+                # long-lived live query at the head must not pin
+                # thousands of terminal entries (each holding its full
+                # task_bytes) behind it
+                idx = next(
+                    (i for i, qid in enumerate(self._order)
+                     if (oq := self._queries.get(qid)) is None
+                     or oq.finished),
+                    None,
+                )
+                if idx is None:
+                    # everything retained is LIVE: abandon the oldest
+                    # only past the hard cap
+                    if len(self._order) <= _HARD_RETAINED:
+                        break
+                    idx = 0
+                old = self._order.pop(idx)
+                orq = self._queries.pop(old, None)
+                if orq is not None and not orq.finished:
+                    evicted.append(orq)
+        for orq in evicted:
+            # an abandoned handle (detached, never drained) must not
+            # hold its replica's in-flight slot forever
+            self._finish(orq, "ABANDONED")
+            # and its downstream run was submitted detach=True, so
+            # with the handle gone nothing can ever stop OR fetch it -
+            # cancel it like the failover path cancels superseded
+            # executions, or it runs to completion holding the
+            # replica's admission slot and device reservation
+            r = self.registry.get(orq.replica_id or "")
+            if r is not None and orq.internal_id:
+                self._cancel_superseded(r, orq.internal_id)
+            log.warning("evicted live routed query %s (retention "
+                        "hard cap %d)", orq.external_id,
+                        _HARD_RETAINED)
+
+    def get(self, external_id: str) -> RoutedQuery:
+        with self._lock:
+            rq = self._queries.get(external_id)
+        if rq is None:
+            raise KeyError(f"unknown query {external_id}")
+        return rq
+
+    def _finish(self, rq: RoutedQuery, state: Optional[str]) -> bool:
+        """Idempotent terminal bookkeeping: in-flight gauge + breaker
+        reset on success. Returns True only for the caller that WON
+        the finalization (test-and-set under the handle lock), so
+        concurrent observers of one failure - two pollers, or a poll
+        racing the FETCH error path - agree on exactly one winner and
+        the same event is never double-counted downstream."""
+        with rq.lock:
+            rq.last_state = state
+            if rq.finished:
+                return False
+            rq.finished = True
+        r = self.registry.get(rq.replica_id or "")
+        if r is not None:
+            r.note_unrouted()
+        if state == "DONE" and rq.replica_id:
+            self.breaker.note_ok(rq.replica_id)
+        return True
+
+    def _rewrite(self, status: dict, rq: RoutedQuery) -> dict:
+        out = dict(status)
+        out["query_id"] = rq.external_id
+        out["replica"] = rq.replica_id
+        if rq.resubmits or rq.failovers:
+            out["router_resubmits"] = rq.resubmits
+            out["router_failovers"] = rq.failovers
+        if out.get("state") in (
+            "DONE", "FAILED", "CANCELLED", "TIMED_OUT",
+            "REJECTED_OVERLOADED",
+        ):
+            self._finish(rq, out.get("state"))
+        return out
+
+    # -- submit ----------------------------------------------------------
+    def submit(self, meta: dict, task_bytes: bytes, *,
+               is_ref: bool = False,
+               manifest_bytes: Optional[bytes] = None) -> dict:
+        with self._lock:
+            self.counters["submitted"] += 1
+        key = affinity_key(task_bytes, is_ref)
+        rq = RoutedQuery(key, task_bytes, is_ref, manifest_bytes,
+                         dict(meta))
+        try:
+            resp = self._place_and_submit(rq, exclude=set())
+        except ReplicaUnavailableError as e:
+            with self._lock:
+                self.counters["no_replica"] += 1
+            rq.finished = True
+            rq.last_state = "REJECTED_OVERLOADED"
+            self._register(rq)
+            return {
+                "query_id": rq.external_id,
+                "state": "REJECTED_OVERLOADED",
+                "error": str(e),
+                "error_class": "TRANSIENT",
+            }
+        if "query_id" not in resp:
+            # in-band protocol error: the replica answered but could
+            # not create the query, so there is no downstream handle to
+            # track. Surface it exactly as a single serve instance
+            # would - registering rq here would leave a never-finished
+            # entry pinning its task_bytes in the routing table forever
+            return resp
+        self._register(rq)
+        return self._rewrite(resp, rq)
+
+    def _place_and_submit(self, rq: RoutedQuery, exclude: set,
+                          same_replica: Optional[str] = None) -> dict:
+        """Place rq and forward its SUBMIT; walks the fleet on
+        transport failures (each one a breaker strike) and on
+        replica-level REJECTED_OVERLOADED backpressure (a placement
+        miss, not a strike: affinity is only a hint, and a saturated
+        affinity target must spill to idle fleet capacity instead of
+        bouncing the client forever). Raises ReplicaUnavailableError
+        when nobody routable is left or everybody rejected."""
+        attempts = len(self.registry.replicas) + 1
+        rejected_err: Optional[str] = None
+        for _ in range(attempts):
+            decision = None
+            if same_replica is not None:
+                r = self.registry.get(same_replica)
+                if r is not None and r.routable():
+                    decision = PlacementDecision(r, "same")
+                same_replica = None  # only the first try is pinned
+            if decision is None:
+                if self.placement_mode == "random":
+                    decision = random_replica(
+                        self.registry, next(self._rr_seq),
+                        exclude=exclude,
+                    )
+                else:
+                    decision = choose_replica(
+                        self.registry, self.affinity, rq.key,
+                        estimated_bytes=rq.meta.get("estimated_bytes"),
+                        fingerprint=rq.fingerprint,
+                        stats_stale_s=self.stats_stale_s,
+                        exclude=exclude,
+                    )
+            if decision is None:
+                break
+            replica = decision.replica
+            meta = dict(rq.meta)
+            meta["detach"] = True  # the router owns session semantics
+            try:
+                resp = self._call(
+                    replica,
+                    lambda c: c.submit_raw(
+                        rq.task_bytes, meta=meta, is_ref=rq.is_ref,
+                        manifest_bytes=rq.manifest_bytes,
+                    ),
+                )
+            except (ConnectionError, OSError, ServiceError) as e:
+                log.warning("submit to %s failed (%r); trying next",
+                            replica.replica_id, e)
+                self.breaker.note_fatal(
+                    replica.replica_id, kind="transport"
+                )
+                exclude.add(replica.replica_id)
+                continue
+            if "query_id" not in resp:
+                # in-band replica error (protocol-level): surface
+                return resp
+            if resp.get("state") == "REJECTED_OVERLOADED":
+                log.info(
+                    "replica %s rejected %s (overloaded); spilling",
+                    replica.replica_id, rq.external_id,
+                )
+                with self._lock:
+                    self.counters["overflow_spills"] += 1
+                rejected_err = resp.get("error") or "queue full"
+                exclude.add(replica.replica_id)
+                continue
+            with rq.lock:
+                rq.replica_id = replica.replica_id
+                rq.internal_id = resp["query_id"]
+                rq.generation += 1
+                if resp.get("fingerprint"):
+                    rq.fingerprint = resp["fingerprint"]
+            replica.note_routed()
+            reason = f"placed_{decision.reason}" \
+                if decision.reason != "same" else None
+            with self._lock:
+                if reason in self.counters:
+                    self.counters[reason] += 1
+            if self.placement_mode == "affinity" and rq.fingerprint:
+                # stable-fingerprint plans stick: repeats land on the
+                # replica whose ResultCache will hold the result
+                self.affinity.record(
+                    rq.key, replica.replica_id, rq.fingerprint
+                )
+            return resp
+        if rejected_err is not None:
+            raise ReplicaUnavailableError(
+                "every routable replica rejected overloaded "
+                f"(last: {rejected_err})"
+            )
+        raise ReplicaUnavailableError(
+            "no routable replica "
+            f"(fleet={len(self.registry.replicas)}, "
+            f"excluded={len(exclude)})"
+        )
+
+    # -- failover moves --------------------------------------------------
+    def _resubmit(self, rq: RoutedQuery, observed_gen: int, *,
+                  same_replica: bool, exclude: set,
+                  counter: str) -> bool:
+        """Re-submit rq (same replica for TRANSIENT, elsewhere for
+        failover). Generation-guarded: if another path already
+        re-routed it, this is a no-op success."""
+        with rq.lock:
+            if rq.cancelled or rq.generation != observed_gen:
+                # cancelled: the client let this query go - a pending
+                # failover must not resurrect it on a healthy replica
+                return True  # already moved / deliberately dropped
+            # claim the move under the lock: a concurrent observer of
+            # the same failure (death sweep vs. poll-path transport
+            # error) now sees a newer generation and no-ops instead of
+            # double-submitting the query downstream
+            rq.generation += 1
+            pin = rq.replica_id if same_replica else None
+            old = rq.replica_id
+            old_internal = rq.internal_id
+            # a finished query's slot was already released by _finish
+            # (e.g. DONE, then the replica restarted and lost the
+            # result, and a re-FETCH is re-running it): releasing it
+            # again below would under-count that replica's in_flight
+            # and bias load-rung placement toward it for good
+            old_released = rq.finished
+        try:
+            resp = self._place_and_submit(
+                rq, exclude=set(exclude), same_replica=pin
+            )
+        except ReplicaUnavailableError:
+            return False
+        if "query_id" not in resp:
+            # in-band protocol error from the chosen replica: nothing
+            # was placed and rq still points at its OLD execution, so
+            # falling through would release that slot and cancel the
+            # query's only live downstream run as "superseded"
+            return False
+        if old and not old_released:
+            # the original placement's in-flight slot is superseded by
+            # the one _place_and_submit just counted - release it even
+            # when the re-submission landed on the SAME replica
+            r = self.registry.get(old)
+            if r is not None:
+                r.note_unrouted()
+                if not same_replica and old_internal and r.alive:
+                    # cross-replica failover away from a LIVE replica
+                    # (breaker trip / lost handle): the superseded
+                    # downstream execution was submitted detach=True,
+                    # so nothing else will ever stop it - without this
+                    # cancel it runs to completion holding the sick
+                    # replica's admission slot and device reservation,
+                    # and the query executes twice fleet-wide
+                    self._cancel_superseded(r, old_internal)
+        with rq.lock:
+            if rq.cancelled:
+                # the client cancelled while the move was in flight:
+                # the fresh placement is already superseded - kill it
+                # instead of resurrecting a handle the client let go
+                new_rid, new_internal = rq.replica_id, rq.internal_id
+                rq.finished = True
+            else:
+                new_rid = None
+                rq.finished = False  # a moved query is live again
+                rq.last_state = None
+        if new_rid is not None:
+            nr = self.registry.get(new_rid)
+            if nr is not None:
+                nr.note_unrouted()
+                if new_internal:
+                    self._cancel_superseded(nr, new_internal)
+            return True
+        with self._lock:
+            self.counters[counter] += 1
+        if counter == "failovers":
+            rq.failovers += 1
+        else:
+            rq.resubmits += 1
+        return True
+
+    def _cancel_superseded(self, replica: Replica,
+                           internal_id: str) -> None:
+        """Fire-and-forget downstream cancel of an execution a
+        failover just re-routed elsewhere. A dedicated short-timeout
+        connection on a daemon thread - never the pooled verb client
+        (a quarantined-but-alive replica must not stall healthy
+        traffic behind its verb lock) and never the failover path's
+        own latency budget."""
+        from blaze_tpu.service.wire import ServiceClient
+
+        def _go():
+            try:
+                with ServiceClient(replica.host, replica.port,
+                                   timeout=5.0,
+                                   reconnect_attempts=0) as c:
+                    c.cancel(internal_id)
+            except Exception:  # noqa: BLE001 - the replica may be
+                pass           # mid-death; best-effort by design
+
+        threading.Thread(
+            target=_go, daemon=True,
+            name=f"blaze-router-cancel-{replica.replica_id}",
+        ).start()
+
+    def _on_replica_dead_async(self, replica: Replica) -> None:
+        """Registry death callback: the re-route sweep performs
+        downstream submits (seconds per query against a slow fleet)
+        and the registry has a single POLL thread - detach the sweep
+        so heartbeat polling never stalls behind failover work (a
+        second concurrent death must still be detected while the
+        first one's queries move). The breaker-trip path calls
+        _on_replica_dead directly: there the cost lands on the
+        client-serving thread that observed the fatal failure."""
+        threading.Thread(
+            target=self._on_replica_dead, args=(replica,),
+            daemon=True,
+            name=f"blaze-router-failover-{replica.replica_id}",
+        ).start()
+
+    def _on_replica_dead(self, replica: Replica) -> None:
+        """Re-route the dead replica's in-flight routed queries to
+        healthy replicas. DONE queries are left alone - a later FETCH
+        fails over on demand (their results died with the replica's
+        cache)."""
+        with self._lock:
+            moved = [
+                rq for rq in self._queries.values()
+                if rq.replica_id == replica.replica_id
+                and not rq.finished
+            ]
+        for rq in moved:
+            ok = self._resubmit(
+                rq, rq.generation, same_replica=False,
+                exclude={replica.replica_id}, counter="failovers",
+            )
+            log.warning(
+                "replica %s dead: query %s %s",
+                replica.replica_id, rq.external_id,
+                "re-routed to %s" % rq.replica_id if ok
+                else "stranded (no routable replica)",
+            )
+
+    def _observe_failed(self, rq: RoutedQuery, status: dict) -> dict:
+        """Class-aware reaction to a FAILED status seen through the
+        proxy: TRANSIENT re-submits to the same replica (bounded, with
+        backoff); fatal classes strike the circuit breaker (tripping
+        quarantines the replica and re-routes its other queries);
+        PLAN_INVALID/CANCELLED surface untouched."""
+        action = failover_action(status.get("error_class"))
+        rid = rq.replica_id
+        if action == "resubmit" and rq.resubmits < self.max_resubmits:
+            delay = self.resubmit_backoff_s * (2 ** rq.resubmits)
+            time.sleep(random.uniform(delay * 0.5, delay))
+            if self._resubmit(rq, rq.generation, same_replica=True,
+                              exclude=set(),
+                              counter="resubmits_transient"):
+                st = self._downstream_status(rq)
+                if st.get("state") == "FAILED" and not rq.finished:
+                    # the re-run failed within one status round trip:
+                    # react to ITS class too, or a remaining resubmit
+                    # budget would be silently abandoned (bounded:
+                    # each round consumed one resubmit above)
+                    return self._observe_failed(rq, st)
+                return st
+        elif action == "breaker" and rid is not None:
+            # this query surfaces as-is: finalize it BEFORE the trip so
+            # the quarantine's in-flight sweep re-routes only the
+            # replica's OTHER queries, not the one whose fatal failure
+            # is being reported. Only the finalization WINNER strikes:
+            # concurrent observers of the same failure (two pollers, a
+            # poll racing the FETCH error path) must count ONE event
+            if self._finish(rq, status.get("state")):
+                tripped = self.breaker.note_fatal(rid, kind="query")
+                if tripped:
+                    dead = self.registry.get(rid)
+                    if dead is not None:
+                        self._on_replica_dead(dead)
+        return status
+
+    # -- proxy verbs -----------------------------------------------------
+    def _downstream_status(self, rq: RoutedQuery,
+                           depth: int = 0) -> dict:
+        if depth > len(self.registry.replicas) + 2:
+            raise ReplicaUnavailableError(
+                f"status of {rq.external_id} unobtainable: the fleet "
+                "keeps failing under it"
+            )
+        if rq.internal_id is None:
+            # never placed (REJECTED_OVERLOADED at submit): the
+            # routing table still owns the handle - report its
+            # terminal state instead of pretending it is unknown
+            return {
+                "query_id": rq.external_id,
+                "state": rq.last_state or "REJECTED_OVERLOADED",
+                "error": "never placed: no routable replica",
+                "error_class": "TRANSIENT",
+            }
+        gen = rq.generation
+        replica = self.registry.get(rq.replica_id or "")
+        if replica is None:
+            raise KeyError(f"unknown replica for {rq.external_id}")
+        try:
+            st = self._call(
+                replica, lambda c: c.poll(rq.internal_id)
+            )
+        except (ConnectionError, OSError, ServiceError):
+            self.breaker.note_fatal(
+                replica.replica_id, kind="transport"
+            )
+            if rq.finished and rq.last_state:
+                # the query already reached a terminal state through
+                # this router: report it from the routing table - a
+                # status check must never resurrect a dead handle
+                return self._last_known_status(rq)
+            if not self._resubmit(rq, gen, same_replica=False,
+                                  exclude={replica.replica_id},
+                                  counter="failovers"):
+                raise ReplicaUnavailableError(
+                    f"replica {replica.replica_id} unreachable and "
+                    "no routable replica to re-route to"
+                )
+            return self._downstream_status(rq, depth + 1)
+        if "error" in st and "query_id" not in st:
+            # replica lost the handle (restarted)
+            if rq.finished and rq.last_state:
+                return self._last_known_status(rq)  # never re-run
+            # live query: re-route = fresh run
+            if self._resubmit(rq, gen, same_replica=False,
+                              exclude=set(), counter="failovers"):
+                return self._downstream_status(rq, depth + 1)
+        return st
+
+    def _last_known_status(self, rq: RoutedQuery) -> dict:
+        return {
+            "query_id": rq.external_id,
+            "state": rq.last_state,
+            "note": "replica no longer holds the handle; state is "
+                    "the router's last observation",
+        }
+
+    def poll(self, external_id: str) -> dict:
+        rq = self.get(external_id)
+        st = self._downstream_status(rq)
+        if st.get("state") == "FAILED" and not rq.finished:
+            st = self._observe_failed(rq, st)
+        return self._rewrite(st, rq)
+
+    def cancel(self, external_id: str) -> dict:
+        rq = self.get(external_id)
+        # finalize FIRST (stops the failover machinery and releases
+        # the replica's in-flight slot) - the downstream cancel below
+        # is best-effort cleanup of a handle we already let go of.
+        # The flag + generation bump under the lock make any in-flight
+        # _resubmit no-op (or kill its fresh placement): a cancelled
+        # query must never be resurrected by failover
+        with rq.lock:
+            rq.cancelled = True
+            rq.generation += 1
+            replica_id, internal_id = rq.replica_id, rq.internal_id
+        self._finish(rq, rq.last_state)
+        replica = self.registry.get(replica_id or "")
+        try:
+            if replica is None:
+                raise ConnectionError("no replica")
+            st = self._call(
+                replica, lambda c: c.cancel(internal_id)
+            )
+        except (ConnectionError, OSError, ServiceError):
+            # replica gone: nothing to cancel - the handle just ends
+            st = {"state": "CANCELLED",
+                  "error": "replica unreachable; handle abandoned"}
+        return self._rewrite(st, rq)
+
+    def report(self, external_id: str, flags: int = 0) -> dict:
+        rq = self.get(external_id)
+        if rq.internal_id is None:
+            # never placed (REJECTED_OVERLOADED at submit): answer
+            # from the routing table like poll() does - the router
+            # issued this handle, so it must not report it unknown
+            return {
+                "query_id": rq.external_id,
+                "replica": None,
+                "state": rq.last_state or "REJECTED_OVERLOADED",
+                "report": "never placed: no routable replica",
+            }
+        replica = self.registry.get(rq.replica_id or "")
+        if replica is None:
+            raise KeyError(f"unknown replica for {external_id}")
+        try:
+            if flags & 1:
+                resp = self._call(
+                    replica, lambda c: c.report_full(rq.internal_id)
+                )
+                if "error" in resp and "report" not in resp:
+                    resp = None  # replica lost the handle (restarted)
+            else:
+                resp = {"report": self._call(
+                    replica, lambda c: c.report(rq.internal_id)
+                )}
+        except (ConnectionError, OSError, ServiceError, KeyError):
+            # unreachable replica, or one that restarted and lost the
+            # handle (ServiceClient.report KeyErrors on its error
+            # reply): fall back to the routing table below
+            resp = None
+        if resp is None:
+            # the router issued this handle, so it must not surface a
+            # replica-side lookup miss as an opaque "unknown query"
+            # error - report what the routing table knows, the same
+            # way poll() answers for finalized queries
+            return {
+                "query_id": rq.external_id,
+                "replica": rq.replica_id,
+                "state": rq.last_state,
+                "report": "replica no longer holds the handle; "
+                          "state is the router's last observation",
+            }
+        resp["query_id"] = rq.external_id
+        resp["replica"] = rq.replica_id
+        return resp
+
+    def stats(self) -> dict:
+        """The fleet view: router decision/health counters, per-replica
+        health snapshots, and replica STATS aggregates."""
+        fleet = {
+            "replicas": len(self.registry.replicas),
+            "alive": 0,
+            "queued": 0,
+            "running": 0,
+            "headroom_bytes": 0,
+            "cache": {"hits": 0, "misses": 0, "coalesced": 0},
+            "queries_by_state": {},
+        }
+        for r in self.registry.replicas.values():
+            if r.alive:
+                fleet["alive"] += 1
+            if r.stats is None:
+                continue
+            a = r.stats.get("admission", {})
+            fleet["queued"] += int(a.get("queued", 0))
+            fleet["running"] += int(a.get("running", 0))
+            fleet["headroom_bytes"] += max(
+                0, r.effective_headroom() or 0
+            )
+            c = r.stats.get("cache", {})
+            for k in fleet["cache"]:
+                fleet["cache"][k] += int(c.get(k, 0))
+            for s, n in (
+                r.stats.get("queries", {}).get("by_state", {}).items()
+            ):
+                fleet["queries_by_state"][s] = (
+                    fleet["queries_by_state"].get(s, 0) + int(n)
+                )
+        with self._lock:
+            counters = dict(self.counters)
+            retained = len(self._queries)
+        return {
+            "router": {
+                "placement": self.placement_mode,
+                **counters,
+                "queries_retained": retained,
+                "affinity_entries": len(self.affinity),
+            },
+            "replicas": self.registry.snapshot(),
+            "fleet": fleet,
+        }
+
+    def metrics(self) -> str:
+        """Fleet Prometheus exposition: the router process's own
+        registry (router counters, per-replica gauges) plus every
+        reachable replica's scrape stamped with a `replica` label.
+        Replicas are scraped CONCURRENTLY on dedicated short-timeout
+        connections - never the pooled verb clients (a wedged replica
+        must not stall SUBMIT/POLL behind a 120s _call lock), and
+        never serially (a fleet scrape must cost max(replica), not
+        sum(replica), or slow replicas push it past the collector's
+        own timeout)."""
+        from blaze_tpu.service.wire import ServiceClient
+
+        per_replica: Dict[str, str] = {}
+
+        def scrape(rid, r):
+            try:
+                with ServiceClient(r.host, r.port, timeout=5.0,
+                                   reconnect_attempts=0) as c:
+                    per_replica[rid] = c.metrics()
+            except Exception:  # noqa: BLE001 - best-effort scrape
+                pass
+
+        threads = [
+            threading.Thread(target=scrape, args=(rid, r),
+                             daemon=True,
+                             name=f"blaze-router-scrape-{rid}")
+            for rid, r in self.registry.replicas.items()
+            if r.alive
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return merge_expositions(
+            REGISTRY.render_prometheus(), per_replica
+        )
+
+    def _collect_metrics(self):
+        with self._lock:
+            counters = dict(self.counters)
+        return [
+            ("blaze_router_events_total", {"event": k}, v, "counter")
+            for k, v in counters.items()
+        ]
+
+    # -- FETCH passthrough -----------------------------------------------
+    def stream_parts(self, external_id: str,
+                     timeout_ms: int = 0) -> Iterator[bytes]:
+        """Yield the raw segmented-IPC part payloads for one query,
+        surviving replica death mid-stream: the query is re-routed
+        (fresh execution on a healthy replica - results are
+        deterministic per part, the ServiceClient re-FETCH contract)
+        and parts the client already received are skipped."""
+        rq = self.get(external_id)
+        if rq.splice_broken:
+            raise ServiceError(_SPLICE_ERR)
+        sent = 0
+        cycles = 0
+        max_cycles = 3 + self.max_resubmits \
+            + len(self.registry.replicas)
+        while True:
+            gen = rq.generation
+            replica = self.registry.get(rq.replica_id or "")
+            if replica is None:
+                raise ServiceError(
+                    f"UNKNOWN: no replica for {external_id}"
+                )
+            try:
+                for i, payload in enumerate(self._raw_fetch(
+                    replica, rq.internal_id, timeout_ms
+                )):
+                    # verify against (or extend) the canonical part
+                    # record: parts the client already received - from
+                    # this stream or a previous aborted one - must be
+                    # byte-identical in a re-executed result, or the
+                    # client's count-based resume would splice two
+                    # different results into one corrupt table
+                    h = hashlib.blake2b(
+                        payload, digest_size=16
+                    ).digest()
+                    with rq.lock:
+                        if i < len(rq.delivered_hashes):
+                            if rq.delivered_hashes[i] != h:
+                                rq.splice_broken = True
+                        else:
+                            rq.delivered_hashes.append(h)
+                    if rq.splice_broken:
+                        raise ServiceError(_SPLICE_ERR)
+                    if i < sent:
+                        continue  # already delivered on this stream
+                    sent += 1
+                    yield payload
+                self._finish(rq, "DONE")
+                return
+            except ServiceError as e:
+                if rq.splice_broken:
+                    self._finish(rq, "FAILED")
+                    raise
+                cycles += 1
+                if cycles > max_cycles:
+                    raise
+                if e.state == "FAILED":
+                    st = self._downstream_status(rq)
+                    if st.get("state") == "FAILED" and not rq.finished:
+                        # same guard as poll(): a re-FETCH of an
+                        # already-finalized failure must not land a
+                        # second breaker strike for the same event
+                        st = self._observe_failed(rq, st)
+                    if st.get("state") == "FAILED" or rq.finished:
+                        self._finish(rq, st.get("state"))
+                        raise
+                    continue  # re-routed or retrying: fetch again
+                if e.state == "UNKNOWN":
+                    if self._resubmit(rq, gen, same_replica=False,
+                                      exclude=set(),
+                                      counter="failovers"):
+                        continue
+                raise
+            except (ConnectionError, OSError) as e:
+                cycles += 1
+                if cycles > max_cycles:
+                    raise
+                if rq.generation != gen:
+                    continue  # death callback already moved it
+                self.breaker.note_fatal(
+                    replica.replica_id, kind="transport"
+                )
+                if replica.routable():
+                    continue  # transient drop: re-FETCH same replica
+                if not self._resubmit(rq, gen, same_replica=False,
+                                      exclude={replica.replica_id},
+                                      counter="failovers"):
+                    raise ReplicaUnavailableError(
+                        f"replica {replica.replica_id} lost "
+                        f"mid-FETCH of {external_id}: {e!r}"
+                    ) from e
+
+    def _raw_fetch(self, replica: Replica, internal_id: str,
+                   timeout_ms: int) -> Iterator[bytes]:
+        """One downstream FETCH as raw part payloads (never decoded),
+        every part yielded in order (the caller skips/verifies).
+        Blocks in short slices so replica death during a long wait is
+        noticed between frames instead of hanging the client."""
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+        from blaze_tpu.service.wire import ServiceClient
+
+        # connect on its own budget: fetch_block_s slices RECV waits
+        # (a socket.timeout there is a poll tick, not a failure), but
+        # bounding the CONNECT at 0.5s would turn accept-backlog
+        # latency on a busy-but-healthy replica into transport-class
+        # breaker strikes - and a few of those quarantine the replica
+        # and duplicate every one of its in-flight queries
+        sock = socket.create_connection(
+            (replica.host, replica.port),
+            timeout=min(self.downstream_timeout_s, 10.0),
+        )
+        sock.settimeout(self.fetch_block_s)
+        try:
+            sock.sendall(_U64.pack(_FLAG_SERVICE))
+            sock.sendall(ServiceClient._id_verb(
+                VERB_FETCH, internal_id, timeout_ms
+            ))
+            while True:
+                header = self._recv_checked(sock, _U64.size, replica)
+                (length,) = _U64.unpack(header)
+                if length == 0:
+                    return
+                if length == _ERR:
+                    (mlen,) = _U32.unpack(
+                        self._recv_checked(sock, _U32.size, replica)
+                    )
+                    msg = self._recv_checked(
+                        sock, mlen, replica
+                    ).decode("utf-8")
+                    raise ServiceError(msg)
+                payload = self._recv_checked(sock, length, replica)
+                yield payload
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _recv_checked(self, sock, n: int,
+                      replica: Replica) -> bytes:
+        """recv_exact in fetch_block_s slices, aborting promptly when
+        the replica goes unroutable mid-wait (a FETCH blocked on a
+        dead replica must fail over, not hang)."""
+        buf = bytearray()
+        stalled = 0
+        # a mid-frame stall means bytes stopped flowing mid-payload;
+        # bound it separately from the legitimate between-frame wait
+        max_midframe = max(4, int(60.0 / self.fetch_block_s))
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(n - len(buf))
+            except socket.timeout:
+                if not replica.routable():
+                    raise ConnectionError(
+                        f"replica {replica.replica_id} unroutable "
+                        "mid-FETCH"
+                    ) from None
+                if buf:
+                    stalled += 1
+                    if stalled > max_midframe:
+                        raise ConnectionError(
+                            "mid-frame stall from "
+                            f"{replica.replica_id}"
+                        ) from None
+                continue
+            if not chunk:
+                raise ConnectionError("EOF from replica mid-FETCH")
+            stalled = 0
+            buf += chunk
+        return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# wire tier: the router as a service-protocol server
+# ---------------------------------------------------------------------------
+
+
+def handle_router_connection(sock, router: Router) -> None:
+    """Drive one client connection against the router - the same verb
+    loop as service/wire.handle_service_connection, with the router's
+    routing table behind every verb. Non-detached queries submitted on
+    this connection are cancelled (on their replicas) when the client
+    vanishes."""
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    session_qids: List[str] = []
+    try:
+        while True:
+            try:
+                verb = _recv_exact(sock, 1)[0]
+            except (ConnectionError, OSError):
+                return
+            try:
+                if verb == VERB_SUBMIT:
+                    _handle_router_submit(sock, router, session_qids)
+                elif verb == VERB_POLL:
+                    qid = _read_str(sock)
+                    _read_u32(sock)
+                    _send_json(sock, router.poll(qid))
+                elif verb == VERB_FETCH:
+                    _handle_router_fetch(sock, router)
+                elif verb == VERB_CANCEL:
+                    qid = _read_str(sock)
+                    _read_u32(sock)
+                    _send_json(sock, router.cancel(qid))
+                elif verb == VERB_REPORT:
+                    qid = _read_str(sock)
+                    flags = _read_u32(sock)
+                    _send_json(sock, router.report(qid, flags))
+                elif verb == VERB_STATS:
+                    _read_u32(sock)
+                    _send_json(sock, router.stats())
+                elif verb == VERB_METRICS:
+                    _read_u32(sock)
+                    _send_json(sock, {"metrics": router.metrics()})
+                else:
+                    raise ValueError(f"unknown service verb {verb}")
+            except (ConnectionError, BrokenPipeError, OSError):
+                return
+            except ValueError as e:
+                try:
+                    _send_json(
+                        sock,
+                        {"error": f"protocol error: {e}"[:65536],
+                         "fatal": True},
+                    )
+                except OSError:
+                    pass
+                return
+            except KeyError as e:
+                _send_json(sock, {"error": f"unknown query: {e}"})
+            except Exception as e:  # noqa: BLE001 - reported in-band
+                _send_json(
+                    sock,
+                    {"error": f"{type(e).__name__}: {e}"[:65536]},
+                )
+    finally:
+        for qid in session_qids:
+            try:
+                rq = router.get(qid)
+                if not rq.finished:
+                    router.cancel(qid)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
+def _handle_router_submit(sock, router: Router,
+                          session_qids: List[str]) -> None:
+    from blaze_tpu.service.wire import decode_submit_frame
+
+    meta, blob, is_ref, manifest_bytes = decode_submit_frame(sock)
+    resp = router.submit(
+        meta, blob, is_ref=is_ref, manifest_bytes=manifest_bytes
+    )
+    if not meta.get("detach") and "query_id" in resp:
+        session_qids.append(resp["query_id"])
+    _send_json(sock, resp)
+
+
+def _handle_router_fetch(sock, router: Router) -> None:
+    qid = _read_str(sock)
+    timeout_ms = _read_u32(sock)
+    sent = 0
+    try:
+        for payload in router.stream_parts(qid, timeout_ms):
+            sock.sendall(_U64.pack(len(payload)) + payload)
+            sent += 1
+        sock.sendall(_U64.pack(0))
+    except KeyError:
+        if sent:
+            raise ConnectionError("fetch aborted after parts sent")
+        _send_err(sock, f"UNKNOWN: no query {qid}")
+    except (ServiceError, ReplicaUnavailableError) as e:
+        if sent:
+            # parts are on the wire: a JSON/ERR frame would desync the
+            # client - abort the connection (its reconnect re-FETCHes)
+            raise ConnectionError(
+                f"fetch stream aborted: {e!r}"
+            ) from e
+        msg = str(e)
+        if isinstance(e, ReplicaUnavailableError):
+            # ERR frames carry "STATE: detail" (ServiceError.state
+            # splits on the first colon) - raw text here would parse
+            # to a garbage state like "replica 127.0.0.1". Stamp the
+            # router's fleet-unavailable convention (same as the
+            # submit path: retry with backoff once capacity returns)
+            msg = f"REJECTED_OVERLOADED: {msg}"
+        _send_err(sock, msg)
+
+
+class _RouterHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+        from blaze_tpu.runtime.transport import _recv_exact
+
+        sock = self.request
+        try:
+            (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
+        except Exception:  # noqa: BLE001 - never spoke
+            return
+        if not header & _FLAG_SERVICE:
+            msg = b"router speaks the service protocol only"
+            try:
+                sock.sendall(
+                    _U64.pack(_ERR) + _U32.pack(len(msg)) + msg
+                )
+            except OSError:
+                pass
+            return
+        handle_router_connection(sock, self.server.router)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RouterServer:
+    """TCP front for a Router: ServiceClient-compatible listener."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self._srv = _Server((host, port), _RouterHandler)
+        self._srv.router = router
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="blaze-router-accept",
+        )
+
+    @property
+    def address(self):
+        return self._srv.server_address
+
+    def start(self) -> "RouterServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def route_forever(host: str, port: int, replicas,
+                  **router_kw) -> None:  # pragma: no cover - CLI
+    router = Router(replicas, **router_kw)
+    try:
+        router.registry.poll_now()  # startup probe: log who answers
+        alive = [
+            r.replica_id
+            for r in router.registry.replicas.values() if r.alive
+        ]
+        srv = RouterServer(router, host, port)
+        print(
+            f"blaze_tpu router listening on {srv.address} -> "
+            f"{len(alive)}/{len(router.registry.replicas)} replicas "
+            f"alive {alive}",
+            flush=True,
+        )
+        srv._srv.serve_forever()
+    finally:
+        router.close()

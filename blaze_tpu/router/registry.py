@@ -1,0 +1,371 @@
+"""Replica membership: who is alive, and what shape they are in.
+
+The router's view of the fleet is built from exactly the surfaces the
+replicas already expose - no new RPC:
+
+  * liveness: the cluster-runner contract (runtime/cluster.py
+    `Liveness`) applied to STATS polls. A successful poll is the
+    heartbeat; a replica is DEAD only when no poll has succeeded
+    within the window - progress-aware, so a slow replica (cold
+    compile, long scan) is never declared dead while it still answers.
+  * shape: the structured STATS payload (ISSUE 4) - admission headroom
+    and queue depth, per-fingerprint runtime-history p50s, cache
+    counters. Placement (router/placement.py) reads the last snapshot
+    with a bounded-staleness rule instead of polling inline on the
+    submit path.
+  * quarantine: the failover tier (router/failover.py circuit breaker,
+    or heartbeat death) marks a replica unroutable for a cool-off
+    window; after it the replica is half-open - the next successful
+    STATS poll readmits it.
+
+Per-replica state is exported through the process metrics registry as
+`blaze_router_replica_*{replica=...}` gauges, so the fleet view rides
+the existing Prometheus exposition.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.runtime.cluster import Liveness
+
+log = logging.getLogger("blaze_tpu.router")
+
+
+class Replica:
+    """One serve instance: address + last-known shape + health."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = int(port)
+        self.replica_id = f"{host}:{port}"
+        self.liveness = Liveness(clock=time.monotonic)
+        self.alive = False          # becomes True on the first OK poll
+        self.ever_alive = False
+        self.stats: Optional[dict] = None
+        self.stats_at: float = 0.0  # monotonic time of last snapshot
+        self.quarantined_until: float = 0.0
+        self.quarantine_reason: Optional[str] = None
+        self.poll_failures = 0      # consecutive
+        self.in_flight = 0          # router-tracked live routed queries
+        self._client = None         # poll-loop ServiceClient
+        self._lock = threading.Lock()
+        # serializes whole poll round trips (the background loop vs. a
+        # manual poll_now startup probe): ServiceClient is NOT
+        # thread-safe - two threads recv-ing one socket steal each
+        # other's frames. Never taken by the verb hot paths.
+        self._poll_lock = threading.Lock()
+
+    def note_routed(self) -> None:
+        """Count one routed query (locked: submit handlers race)."""
+        with self._lock:
+            self.in_flight += 1
+
+    def note_unrouted(self) -> None:
+        with self._lock:
+            self.in_flight = max(0, self.in_flight - 1)
+
+    # -- derived views ---------------------------------------------------
+    def quarantined(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None else time.monotonic()) \
+            < self.quarantined_until
+
+    def routable(self, now: Optional[float] = None) -> bool:
+        return self.alive and not self.quarantined(now)
+
+    def stats_age_s(self, now: Optional[float] = None) -> float:
+        if self.stats is None:
+            return float("inf")
+        return (now if now is not None else time.monotonic()) \
+            - self.stats_at
+
+    def effective_headroom(self) -> Optional[int]:
+        """Device bytes this replica could admit right now: reported
+        tracker headroom minus what admitted queries already reserved.
+        None when no STATS snapshot exists yet."""
+        if self.stats is None:
+            return None
+        a = self.stats.get("admission", {})
+        return int(a.get("headroom", 0)) - int(
+            a.get("reserved_bytes", 0)
+        )
+
+    def load(self) -> int:
+        """Queue pressure: replica-reported queued+running plus the
+        router's own in-flight count (covers submits the next STATS
+        poll has not seen yet)."""
+        q = r = 0
+        if self.stats is not None:
+            a = self.stats.get("admission", {})
+            q, r = int(a.get("queued", 0)), int(a.get("running", 0))
+        return q + r + max(0, self.in_flight - q - r)
+
+    def fingerprint_p50(self, fingerprint: str) -> Optional[float]:
+        """This replica's reported runtime-history p50 for a full
+        fingerprint (joined on the `fp` field STATS carries)."""
+        if self.stats is None or not fingerprint:
+            return None
+        for e in self.stats.get("runtime_history", {}).get("top", ()):
+            if e.get("fp") == fingerprint and "p50" in e:
+                return float(e["p50"])
+        return None
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = now if now is not None else time.monotonic()
+        out = {
+            "alive": self.alive,
+            "quarantined": self.quarantined(now),
+            "in_flight": self.in_flight,
+            "poll_failures": self.poll_failures,
+            "stats_age_s": (
+                round(self.stats_age_s(now), 3)
+                if self.stats is not None else None
+            ),
+        }
+        if self.quarantine_reason and self.quarantined(now):
+            out["quarantine_reason"] = self.quarantine_reason
+        if self.stats is not None:
+            a = self.stats.get("admission", {})
+            out["queued"] = a.get("queued", 0)
+            out["running"] = a.get("running", 0)
+            out["headroom"] = self.effective_headroom()
+        return out
+
+
+def parse_replica(spec) -> Tuple[str, int]:
+    """'host:port' | (host, port) -> (host, port)."""
+    if isinstance(spec, (tuple, list)):
+        return str(spec[0]), int(spec[1])
+    host, _, port = str(spec).rpartition(":")
+    if not host:
+        raise ValueError(f"replica spec {spec!r} is not host:port")
+    return host, int(port)
+
+
+class ReplicaRegistry:
+    """Membership + health, fed by a background STATS-poll loop.
+
+    `poll_now()` runs one synchronous poll round (tests and the CLI's
+    startup probe use it); the background thread does the same thing
+    every `poll_interval_s`. Death and revival fire the registered
+    callbacks exactly once per transition - the router uses on_dead to
+    re-route a dead replica's in-flight queries."""
+
+    def __init__(
+        self,
+        replicas: Sequence,
+        poll_interval_s: float = 1.0,
+        heartbeat_timeout_s: float = 5.0,
+        quarantine_s: float = 15.0,
+        connect_timeout_s: float = 5.0,
+        on_dead: Optional[Callable[[Replica], None]] = None,
+        on_revive: Optional[Callable[[Replica], None]] = None,
+    ):
+        self.replicas: Dict[str, Replica] = {}
+        for spec in replicas:
+            host, port = parse_replica(spec)
+            r = Replica(host, port)
+            self.replicas[r.replica_id] = r
+        self.poll_interval_s = float(poll_interval_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.quarantine_s = float(quarantine_s)
+        # a poll slower than the liveness window is useless - and with
+        # the default connect timeout ABOVE the default heartbeat
+        # window, the advertised death-detection latency would be
+        # unachievable against a black-holing host
+        self.connect_timeout_s = min(
+            float(connect_timeout_s),
+            max(0.5, float(heartbeat_timeout_s)),
+        )
+        self.on_dead = on_dead
+        self.on_revive = on_revive
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._collector_key = f"router-registry:{id(self):x}"
+        REGISTRY.register_collector(
+            self._collector_key, self._collect_metrics
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReplicaRegistry":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="blaze-router-poll",
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        REGISTRY.unregister_collector(self._collector_key)
+        for r in self.replicas.values():
+            c, r._client = r._client, None
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+
+    # -- polling ---------------------------------------------------------
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_now()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("replica poll round failed")
+
+    def poll_now(self) -> None:
+        """One synchronous STATS round across the fleet. Replicas are
+        polled CONCURRENTLY: a black-holing host costs the round one
+        connect timeout, not one per wedged replica - with sequential
+        polls, two wedged hosts would age every healthy snapshot past
+        the staleness bound and delay death detection fleet-wide."""
+        reps = list(self.replicas.values())
+        if len(reps) <= 1:
+            for r in reps:
+                self._poll_one(r)
+            return
+        threads = [
+            threading.Thread(
+                target=self._poll_one, args=(r,), daemon=True,
+                name=f"blaze-router-poll-{r.replica_id}",
+            )
+            for r in reps
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _poll_one(self, r: Replica) -> None:
+        with r._poll_lock:
+            self._poll_one_locked(r)
+
+    def _poll_one_locked(self, r: Replica) -> None:
+        from blaze_tpu.service.wire import ServiceClient
+
+        try:
+            # the connect + STATS round trip runs OUTSIDE r._lock:
+            # note_routed/note_unrouted take that lock on the submit
+            # and query-finish hot paths, and a wedged replica must
+            # cost this poll its timeout - not stall client-visible
+            # verbs behind a blocked lock for connect_timeout_s
+            # (rounds themselves are serialized by r._poll_lock)
+            with r._lock:
+                c = r._client
+            if c is None:
+                c = ServiceClient(
+                    r.host, r.port,
+                    timeout=self.connect_timeout_s,
+                    reconnect_attempts=0,  # the loop IS the retry
+                )
+                with r._lock:
+                    r._client = c
+            stats = c.stats()
+        except Exception as e:  # noqa: BLE001 - poll failure = signal
+            with r._lock:
+                c, r._client = r._client, None
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            r.poll_failures += 1
+            REGISTRY.inc("blaze_router_polls_total", outcome="error")
+            if r.alive and r.liveness.expired(
+                self.heartbeat_timeout_s
+            ):
+                self._mark_dead(r, repr(e))
+            return
+        r.poll_failures = 0
+        r.stats = stats
+        r.stats_at = time.monotonic()
+        r.liveness.note_progress()
+        REGISTRY.inc("blaze_router_polls_total", outcome="ok")
+        if not r.alive:
+            r.alive = True
+            r.ever_alive = True
+            if r.quarantine_reason == "heartbeat-dead":
+                # revival closes a death quarantine; breaker-opened
+                # quarantines keep their cool-off (the replica answers
+                # STATS but still fails queries)
+                r.quarantined_until = 0.0
+                r.quarantine_reason = None
+            log.info("replica %s alive", r.replica_id)
+            if self.on_revive is not None:
+                try:
+                    self.on_revive(r)
+                except Exception:  # noqa: BLE001 - callback safety
+                    log.exception("on_revive callback failed")
+
+    def _mark_dead(self, r: Replica, cause: str) -> None:
+        r.alive = False
+        self.quarantine(r.replica_id, reason="heartbeat-dead")
+        log.warning("replica %s heartbeat-dead (%s): quarantined, "
+                    "re-routing its in-flight queries",
+                    r.replica_id, cause)
+        REGISTRY.inc("blaze_router_replica_deaths_total",
+                     replica=r.replica_id)
+        if self.on_dead is not None:
+            try:
+                self.on_dead(r)
+            except Exception:  # noqa: BLE001 - callback safety
+                log.exception("on_dead callback failed")
+
+    # -- health verdicts -------------------------------------------------
+    def quarantine(self, replica_id: str,
+                   reason: str = "circuit-open") -> None:
+        r = self.replicas.get(replica_id)
+        if r is None:
+            return
+        r.quarantined_until = time.monotonic() + self.quarantine_s
+        r.quarantine_reason = reason
+        REGISTRY.inc("blaze_router_quarantines_total",
+                     replica=replica_id, reason=reason)
+
+    def routable(self) -> List[Replica]:
+        now = time.monotonic()
+        return [
+            r for r in self.replicas.values() if r.routable(now)
+        ]
+
+    def get(self, replica_id: str) -> Optional[Replica]:
+        return self.replicas.get(replica_id)
+
+    # -- exposition ------------------------------------------------------
+    def _collect_metrics(self):
+        samples = []
+        now = time.monotonic()
+        for rid, r in self.replicas.items():
+            lab = {"replica": rid}
+            samples.append(("blaze_router_replica_alive", lab,
+                            1 if r.alive else 0, "gauge"))
+            samples.append(("blaze_router_replica_quarantined", lab,
+                            1 if r.quarantined(now) else 0, "gauge"))
+            samples.append(("blaze_router_replica_in_flight", lab,
+                            r.in_flight, "gauge"))
+            if r.stats is not None:
+                a = r.stats.get("admission", {})
+                samples.append(
+                    ("blaze_router_replica_queue_depth", lab,
+                     a.get("queued", 0), "gauge"))
+                samples.append(
+                    ("blaze_router_replica_headroom_bytes", lab,
+                     r.effective_headroom() or 0, "gauge"))
+        return samples
+
+    def snapshot(self) -> Dict[str, dict]:
+        now = time.monotonic()
+        return {
+            rid: r.snapshot(now)
+            for rid, r in self.replicas.items()
+        }

@@ -123,6 +123,7 @@ class AdmissionController:
             "shed_deadline": 0,
             "shed_predicted": 0,
             "headroom_waits": 0,
+            "degraded_released": 0,
         }
 
     # ------------------------------------------------------------------
@@ -211,6 +212,30 @@ class AdmissionController:
     def release(self, q: Query) -> None:
         with self._lock:
             self._reserved.pop(q.query_id, None)
+
+    def release_bytes(self, q: Query, share_of: int = 1) -> None:
+        """Degradation-aware admission (ROADMAP): a partition that fell
+        back to the HOST engine holds device bytes for nothing - free
+        its share so queued device work admits against the released
+        headroom. Degradation is per-PARTITION: with `share_of` = the
+        query's partition count, each degraded partition releases only
+        ceil(est / share_of) - sound because the estimator SUMS leaf
+        inputs across partitions - while the query's OTHER partitions
+        still execute on the device against the rest of the
+        reservation (wire tasks are single-partition, so the whole
+        reservation frees at once). `degraded_released` counts release
+        events: one per degraded partition that freed bytes. Idempotent
+        at zero; the final release() still clears the slot."""
+        with self._lock:
+            cur = self._reserved.get(q.query_id)
+            if not cur:
+                return
+            est = q.estimated_bytes or cur
+            share = cur if share_of <= 1 else min(
+                cur, -(-est // share_of)  # ceil: n shares fully drain
+            )
+            self._reserved[q.query_id] = cur - share
+            self.counters["degraded_released"] += 1
 
     def stats(self) -> dict:
         with self._lock:

@@ -82,6 +82,10 @@ class ResultCache:
             "spill_errors": 0,
             "restores": 0,
             "puts": 0,
+            # request coalescing (service/service.py, ROADMAP scan-
+            # sharing first step): identical in-flight plans that
+            # WAITED on the leader instead of re-executing
+            "coalesced": 0,
         }
         self._pool.register(id(self), self._spill_some)
 
@@ -106,6 +110,15 @@ class ResultCache:
             )
             self.counters["hits"] += 1
             return batches
+
+    def note_coalesced(self) -> None:
+        """Recorded by the coalescing layer in front of get(): a
+        second identical in-flight submission waited on the first
+        instead of re-executing. Lives on the cache's counter surface
+        because coalescing IS a cache-population optimization - the
+        follower's eventual get() is a hit the leader paid for."""
+        with self._lock:
+            self.counters["coalesced"] += 1
 
     def contains(self, key: CacheKey) -> bool:
         """Non-mutating presence probe (no hit/miss counters, no LRU

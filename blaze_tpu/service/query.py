@@ -318,7 +318,7 @@ class Query:
         if "stream_ns" in t:
             out["stream_s"] = round(t["stream_ns"] / 1e9, 6)
         for k in ("output_rows", "output_batches", "cache_hits",
-                  "cache_misses"):
+                  "cache_misses", "coalesced"):
             if k in m:
                 out[k] = m[k]
         out["dispatches"] = m.get("dispatch.dispatches", 0)

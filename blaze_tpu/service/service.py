@@ -128,6 +128,13 @@ class QueryService:
         self._closed = False
         self._queries: Dict[str, Query] = {}
         self._order: List[str] = []  # retention ring
+        # request coalescing (ROADMAP scan-sharing first step): one
+        # event per (fingerprint, partition) currently EXECUTING, so a
+        # second identical stable-fingerprint submission waits on the
+        # leader and serves from the cache it populates instead of
+        # re-executing the same plan concurrently
+        self._inflight: Dict = {}
+        self._inflight_lock = threading.Lock()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # admission order journal (query ids, in admission sequence):
@@ -441,7 +448,7 @@ class QueryService:
         if self.cache is not None:
             c = self.cache.stats()
             for k in ("hits", "misses", "evictions", "puts", "spills",
-                      "restores", "spill_errors"):
+                      "restores", "spill_errors", "coalesced"):
                 samples.append(("blaze_result_cache_events_total",
                                 {"event": k, **sid}, c.get(k, 0),
                                 "counter"))
@@ -696,11 +703,24 @@ class QueryService:
             exec_op = None  # prepared lazily: a full cache hit must
             # not pay fusion/mesh lowering (and must dispatch nothing)
 
+        def run_one(p):
+            nonlocal exec_op
+            if exec_op is None:
+                prepared, _ = prepare_decoded_task(q._decoded, q.ctx)
+                if q.ctx.config.collect_metrics:
+                    prepared = instrument(prepared, q.metrics_root)
+                exec_op = prepared
+            return self._run_partition(q, exec_op, p)
+
         out: List = []
         for p in partitions:
             q.check_interrupt()
             key = (q._fingerprint, p)
-            if cache is not None:
+            if cache is None:
+                out.extend(run_one(p)[0])
+                continue
+            followed = False
+            while True:
                 probe_cm = (
                     obs_trace.span("cache_probe", rec=q.tracer,
                                    partition=p)
@@ -708,28 +728,51 @@ class QueryService:
                 )
                 with probe_cm as sp:
                     hit = cache.get(key)
-                    sp.tag(hit=hit is not None)
+                    sp.tag(hit=hit is not None,
+                           coalesced=followed or None)
                 if hit is not None:
                     q.ctx.metrics.add("cache_hits", 1)
+                    if followed:
+                        # the leader populated the entry while we
+                        # waited: this execution was COALESCED away
+                        cache.note_coalesced()
+                        q.ctx.metrics.add("coalesced", 1)
                     for rb in hit:
                         q.ctx.metrics.add("output_rows", rb.num_rows)
                     out.extend(hit)
-                    continue
+                    break
+                # miss: claim leadership of this (fingerprint,
+                # partition) or wait on whoever holds it
+                with self._inflight_lock:
+                    ev = self._inflight.get(key)
+                    claimed = ev is None
+                    if claimed:
+                        ev = threading.Event()
+                        self._inflight[key] = ev
+                if not claimed:
+                    followed = True
+                    # interruptible wait: a cancel/deadline during the
+                    # coalesce wait must still kill THIS query promptly
+                    while not ev.wait(0.02):
+                        q.check_interrupt()
+                    continue  # leader finished (or failed): re-probe
                 q.ctx.metrics.add("cache_misses", 1)
-            if exec_op is None:
-                prepared, _ = prepare_decoded_task(q._decoded, q.ctx)
-                if q.ctx.config.collect_metrics:
-                    prepared = instrument(prepared, q.metrics_root)
-                exec_op = prepared
-            part_batches, degraded = self._run_partition(
-                q, exec_op, p
-            )
-            if cache is not None and not degraded:
-                # degraded results are correct but host-produced;
-                # keeping them out of the cache preserves device-result
-                # provenance and lets a healthy re-run repopulate it
-                cache.put(key, part_batches)
-            out.extend(part_batches)
+                try:
+                    part_batches, degraded = run_one(p)
+                    if not degraded:
+                        # degraded results are correct but host-
+                        # produced; keeping them out of the cache
+                        # preserves device-result provenance and lets
+                        # a healthy re-run repopulate it
+                        cache.put(key, part_batches)
+                finally:
+                    # release followers even on failure - each re-
+                    # probes, misses, and applies its OWN retry policy
+                    with self._inflight_lock:
+                        self._inflight.pop(key, None)
+                    ev.set()
+                out.extend(part_batches)
+                break
         return out
 
     def _run_partition(self, q: Query, op, partition: int):
@@ -822,6 +865,18 @@ class QueryService:
             raise cause
         q.degraded = True
         q.ctx.metrics.add("degraded_partitions", 1)
+        # degradation-aware admission (ROADMAP): THIS partition now
+        # runs on the HOST engine - its share of the device-byte
+        # reservation gates nothing real anymore, so release it (and
+        # wake the dispatcher) to let headroom-waiting device work
+        # admit while the host fallback grinds on. Only the share: a
+        # multi-partition driver plan's remaining partitions still
+        # execute on the device against the rest of the reservation
+        nparts = (q.plan.partition_count
+                  if q.plan is not None else 1)
+        self.admission.release_bytes(q, share_of=max(1, nparts))
+        with self._cv:
+            self._cv.notify_all()
         log.warning(
             "query %s partition %d degraded to host engine after "
             "RESOURCE_EXHAUSTED: %s", q.query_id, partition, cause,

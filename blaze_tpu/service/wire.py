@@ -183,33 +183,12 @@ def handle_service_connection(sock, service) -> None:
 
 
 def _handle_submit(sock, service, session_qids: List[str]) -> None:
-    from blaze_tpu.runtime.gateway import (
-        MAX_TASK_BYTES,
-        _FLAG_MANIFEST,
-        _FLAG_REF,
-        _manifest_resources,
-    )
-    from blaze_tpu.runtime.transport import _recv_exact
+    from blaze_tpu.runtime.gateway import _manifest_resources
 
-    (meta_len,) = _U32.unpack(_recv_exact(sock, _U32.size))
-    if meta_len > MAX_META_BYTES:
-        raise ValueError("submit meta too large")
-    meta = json.loads(_recv_exact(sock, meta_len) or b"{}")
-    (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
-    is_ref = bool(header & _FLAG_REF)
-    has_manifest = bool(header & _FLAG_MANIFEST)
-    blob_len = header & ~(_FLAG_REF | _FLAG_MANIFEST)
-    if blob_len > MAX_TASK_BYTES:
-        raise ValueError("task too large")
+    meta, blob, is_ref, manifest_bytes = decode_submit_frame(sock)
     resources = {}
-    if has_manifest:
-        (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
-        if mlen > MAX_TASK_BYTES:
-            raise ValueError("manifest too large")
-        resources = _manifest_resources(
-            json.loads(_recv_exact(sock, mlen))
-        )
-    blob = _recv_exact(sock, blob_len)
+    if manifest_bytes is not None:
+        resources = _manifest_resources(json.loads(manifest_bytes))
     q = service.submit_task(
         blob,
         is_ref=is_ref,
@@ -311,6 +290,74 @@ def _send_err(sock, msg: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# frame encoding (shared by ServiceClient and the replica router, which
+# forwards a client's SUBMIT downstream byte-compatibly)
+# ---------------------------------------------------------------------------
+
+
+def decode_submit_frame(sock):
+    """Read one SUBMIT verb frame off `sock` (verb byte already
+    consumed) -> (meta, task_bytes, is_ref, manifest_bytes). The
+    single decode used by BOTH the service handler and the replica
+    router's proxy, so the frame format (flag bits, bounds) cannot
+    drift between tiers; `manifest_bytes` stays un-parsed for
+    forwarding."""
+    from blaze_tpu.runtime.gateway import (
+        MAX_TASK_BYTES,
+        _FLAG_MANIFEST,
+        _FLAG_REF,
+    )
+    from blaze_tpu.runtime.transport import _recv_exact
+
+    (meta_len,) = _U32.unpack(_recv_exact(sock, _U32.size))
+    if meta_len > MAX_META_BYTES:
+        raise ValueError("submit meta too large")
+    meta = json.loads(_recv_exact(sock, meta_len) or b"{}")
+    (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
+    is_ref = bool(header & _FLAG_REF)
+    has_manifest = bool(header & _FLAG_MANIFEST)
+    blob_len = header & ~(_FLAG_REF | _FLAG_MANIFEST)
+    if blob_len > MAX_TASK_BYTES:
+        raise ValueError("task too large")
+    manifest_bytes = None
+    if has_manifest:
+        (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
+        if mlen > MAX_TASK_BYTES:
+            raise ValueError("manifest too large")
+        manifest_bytes = _recv_exact(sock, mlen)
+    return meta, _recv_exact(sock, blob_len), is_ref, manifest_bytes
+
+
+def encode_submit_frame(
+    meta: dict,
+    task_bytes: bytes,
+    *,
+    is_ref: bool = False,
+    manifest_bytes: Optional[bytes] = None,
+) -> bytes:
+    """One SUBMIT verb frame. `meta` is forwarded verbatim (unknown
+    keys travel untouched - the router relies on this to stay out of
+    the meta schema's way); `manifest_bytes` is the already-encoded
+    manifest JSON, so a proxy never re-serializes what it did not
+    parse."""
+    from blaze_tpu.runtime.gateway import _FLAG_MANIFEST, _FLAG_REF
+
+    meta_b = json.dumps(meta).encode("utf-8")
+    header = len(task_bytes)
+    if is_ref:
+        header |= _FLAG_REF
+    payload = b""
+    if manifest_bytes is not None:
+        header |= _FLAG_MANIFEST
+        payload = _U32.pack(len(manifest_bytes)) + manifest_bytes
+    return (
+        bytes([VERB_SUBMIT])
+        + _U32.pack(len(meta_b)) + meta_b
+        + _U64.pack(header) + payload + task_bytes
+    )
+
+
+# ---------------------------------------------------------------------------
 # client side
 # ---------------------------------------------------------------------------
 
@@ -403,32 +450,38 @@ class ServiceClient:
         cancel-on-disconnect session semantics, so the handle survives
         a connection drop and this client's reconnect can re-attach
         by query_id."""
-        from blaze_tpu.runtime.gateway import (
-            _FLAG_MANIFEST,
-            _FLAG_REF,
-        )
-
-        meta = json.dumps(
-            {
+        return self.submit_raw(
+            task_bytes,
+            meta={
                 "priority": priority,
                 "deadline_s": deadline_s,
                 "estimated_bytes": estimated_bytes,
                 "use_cache": use_cache,
                 "detach": detach,
-            }
-        ).encode("utf-8")
-        header = len(task_bytes)
-        if is_ref:
-            header |= _FLAG_REF
-        payload = b""
-        if manifest is not None:
-            header |= _FLAG_MANIFEST
-            mbytes = json.dumps(manifest).encode("utf-8")
-            payload = _U32.pack(len(mbytes)) + mbytes
+            },
+            is_ref=is_ref,
+            manifest_bytes=(
+                json.dumps(manifest).encode("utf-8")
+                if manifest is not None else None
+            ),
+        )
+
+    def submit_raw(
+        self,
+        task_bytes: bytes,
+        *,
+        meta: dict,
+        is_ref: bool = False,
+        manifest_bytes: Optional[bytes] = None,
+    ) -> dict:
+        """Submit with a caller-built meta dict, forwarded verbatim.
+        The router tier uses this to proxy a client's SUBMIT without
+        re-interpreting (or dropping) meta keys it does not know."""
         return self._roundtrip(
-            bytes([VERB_SUBMIT])
-            + _U32.pack(len(meta)) + meta
-            + _U64.pack(header) + payload + task_bytes
+            encode_submit_frame(
+                meta, task_bytes, is_ref=is_ref,
+                manifest_bytes=manifest_bytes,
+            )
         )
 
     def poll(self, query_id: str) -> dict:

@@ -232,9 +232,11 @@ def fire(site: str, **ctx: Any) -> None:
 @contextmanager
 def active(faults: List[Fault], seed: int = 0):
     """Install a FaultPlan for the duration of a `with` block,
-    restoring whatever was installed before (nesting-safe)."""
+    restoring whatever was installed before (nesting-safe). The
+    caller's seed is shifted by the BLAZE_CHAOS_SEED_OFFSET sweep
+    hook (see seed_offset)."""
     prev = _PLAN
-    plan = FaultPlan(faults, seed=seed)
+    plan = FaultPlan(faults, seed=seed + seed_offset())
     install(plan)
     try:
         yield plan
@@ -243,6 +245,22 @@ def active(faults: List[Fault], seed: int = 0):
             uninstall()
         else:
             install(prev)
+
+
+def seed_offset() -> int:
+    """Seed-sweep hook (`run_tests.py --chaos --seeds N`): a nonzero
+    BLAZE_CHAOS_SEED_OFFSET shifts the seed of every FaultPlan
+    installed through `active()`, so the same chaos suite hunts race
+    regressions under N different probabilistic firing sequences
+    instead of the one fixed seed baked into each test. A UNIFORM
+    shift preserves the suite's seed invariants (same seed -> same
+    sequence, different seeds -> different sequences). Explicit
+    BLAZE_CHAOS env plans are deliberately exempt: their seed is part
+    of a cross-process contract the installing test asserts on."""
+    try:
+        return int(os.environ.get("BLAZE_CHAOS_SEED_OFFSET", "0"))
+    except ValueError:
+        return 0
 
 
 def plan_from_json(text: str) -> FaultPlan:

@@ -1,0 +1,1118 @@
+"""Replica-router tests (ISSUE 5): fingerprint-affinity placement,
+headroom-aware load balancing, class-aware failover, and the proxy
+verb surface.
+
+Coverage map (ISSUE 5 satellite 4 + acceptance):
+  * unit tier: failover_action taxonomy mapping, circuit breaker
+    trip/half-open, AffinityMap LRU + fingerprint join, placement
+    ladder rungs, merge_expositions label stamping
+  * in-process fleet (two QueryService+gateway replicas behind one
+    Router): wire equivalence, affinity repeat -> warm replica with 0
+    dispatches, headroom spill-over, TRANSIENT same-replica re-submit,
+    fatal-class breaker quarantine with classified surfacing, replica
+    death before FETCH re-routing a detached query, session
+    cancel-on-disconnect at the router tier, fleet STATS/METRICS
+  * end-to-end acceptance: two `python -m blaze_tpu serve`
+    subprocesses behind the `route` CLI; repeated query affinity-hits
+    the warm replica (0 dispatches), SIGKILLing the replica running a
+    query mid-execution re-routes it and the client still gets the
+    full result.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.errors import ReplicaUnavailableError, classify, ErrorClass
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.obs.metrics import merge_expositions
+from blaze_tpu.ops import (
+    AggMode,
+    FilterExec,
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.cluster import Liveness
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.runtime.memory import DeviceMemoryTracker
+from blaze_tpu.router import Router, RouterServer
+from blaze_tpu.router.failover import CircuitBreaker, failover_action
+from blaze_tpu.router.placement import (
+    AffinityMap,
+    affinity_key,
+    choose_replica,
+    random_replica,
+)
+from blaze_tpu.router.registry import Replica, ReplicaRegistry
+from blaze_tpu.service import QueryService, ServiceClient, QueryState
+from blaze_tpu.service.wire import ServiceError
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_service import GatedScan, wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(23)
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 25, 5000), pa.int32()),
+                "v": pa.array(rng.random(5000), pa.float64()),
+            }
+        ),
+        p,
+    )
+
+    def blob(threshold=0.5):
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(p)]]),
+                Col("v") > threshold,
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[
+                (AggExpr(AggFn.SUM, Col("v")), "s"),
+                (AggExpr(AggFn.COUNT_STAR, None), "n"),
+            ],
+            mode=AggMode.COMPLETE,
+        )
+        return task_to_proto(plan, 0)
+
+    return blob
+
+
+class Fleet:
+    """Two in-process replicas (QueryService + gateway) behind one
+    Router. Registry polling is MANUAL (start=False) so every test
+    controls exactly when the router's fleet view refreshes."""
+
+    def __init__(self, svc_kw=None, router_kw=None, trackers=None):
+        self.svcs = []
+        self.srvs = []
+        self.specs = []
+        for i in range(2):
+            kw = {"max_concurrency": 2, **(svc_kw or {})}
+            if trackers is not None:
+                kw["device_tracker"] = trackers[i]
+            svc = QueryService(**kw)
+            srv = TaskGatewayServer(service=svc).start()
+            self.svcs.append(svc)
+            self.srvs.append(srv)
+            self.specs.append("%s:%d" % srv.address)
+        self.router = Router(
+            self.specs,
+            poll_interval_s=0.1,
+            heartbeat_timeout_s=0.6,
+            resubmit_backoff_s=0.01,
+            start=False,
+            **(router_kw or {}),
+        )
+        self.router.registry.poll_now()
+        self.by_id = {
+            self.specs[i]: (self.svcs[i], self.srvs[i])
+            for i in range(2)
+        }
+
+    def other(self, replica_id: str) -> str:
+        return next(s for s in self.specs if s != replica_id)
+
+    def kill_gateway(self, replica_id: str) -> None:
+        """Stop accepting new connections on one replica's gateway and
+        drop the router's pooled connections to it - the in-process
+        stand-in for a replica host dying."""
+        self.by_id[replica_id][1].stop()
+        r = self.router.registry.get(replica_id)
+        c, r._client = r._client, None
+        if c is not None:
+            c.close()
+        pooled = self.router._clients.pop(replica_id, None)
+        if pooled is not None:
+            pooled.close()
+
+    def close(self):
+        self.router.close()
+        for srv in self.srvs:
+            try:
+                srv.stop()
+            except OSError:
+                pass
+        for svc in self.svcs:
+            svc.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def wait_done(router, qid, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = router.poll(qid)
+        if st["state"] in (
+            "DONE", "FAILED", "CANCELLED", "TIMED_OUT",
+            "REJECTED_OVERLOADED",
+        ):
+            return st
+        time.sleep(0.01)
+    raise AssertionError(f"query {qid} did not finish: {st}")
+
+
+# ---------------------------------------------------------------------------
+# unit tier
+# ---------------------------------------------------------------------------
+
+
+def test_failover_action_taxonomy():
+    assert failover_action("TRANSIENT") == "resubmit"
+    assert failover_action("INTERNAL") == "breaker"
+    assert failover_action("RESOURCE_EXHAUSTED") == "breaker"
+    assert failover_action(None) == "breaker"  # unclassified = INTERNAL
+    assert failover_action("garbage") == "breaker"
+    assert failover_action("PLAN_INVALID") == "surface"
+    assert failover_action("CANCELLED") == "surface"
+
+
+def test_replica_unavailable_is_transient():
+    """Fleet exhaustion is a capacity condition, not a client bug: the
+    correct client reaction is retry-with-backoff."""
+    assert classify(ReplicaUnavailableError("x")) is ErrorClass.TRANSIENT
+
+
+def test_circuit_breaker_trips_quarantines_and_half_opens():
+    reg = ReplicaRegistry(["h:1", "h:2"], quarantine_s=0.2)
+    try:
+        r = reg.get("h:1")
+        r.alive = True
+        br = CircuitBreaker(reg, threshold=2)
+        assert not br.note_fatal("h:1")
+        assert br.strikes("h:1") == 1
+        br.note_ok("h:1")  # success resets the count
+        assert br.strikes("h:1") == 0
+        assert not br.note_fatal("h:1")
+        assert br.note_fatal("h:1")  # second consecutive: trips
+        assert r.quarantined()
+        assert not r.routable()
+        assert wait_for(lambda: not r.quarantined(), timeout=2)
+        assert r.routable()  # half-open after the cool-off
+    finally:
+        reg.close()
+
+
+def test_affinity_map_lru_and_fingerprint_join():
+    m = AffinityMap(max_entries=4)
+    m.record("blob-key", "r1", fingerprint="fp-abc")
+    # both identities resolve to the same placement
+    assert m.lookup("blob-key") == ("r1", "fp-abc")
+    assert m.lookup("fp-abc") == ("r1", "fp-abc")
+    for i in range(4):
+        m.record(f"k{i}", "r2")
+    assert len(m) == 4  # bounded
+    assert m.lookup("blob-key") == (None, None)  # evicted LRU-first
+
+
+def test_liveness_window_progress_resets():
+    now = {"t": 100.0}
+    lv = Liveness(clock=lambda: now["t"])
+    now["t"] = 103.0
+    assert lv.expired(2.0)
+    lv.note_progress()
+    assert not lv.expired(2.0)
+    # stale progress reports never move the window backwards
+    lv.note_progress(at=50.0)
+    assert lv.idle_s() == 0.0
+
+
+def _stub_registry(stats_by_id):
+    reg = ReplicaRegistry(list(stats_by_id), quarantine_s=30.0)
+    for rid, stats in stats_by_id.items():
+        r = reg.get(rid)
+        r.alive = True
+        if stats is not None:
+            r.stats = stats
+            r.stats_at = time.monotonic()
+    return reg
+
+
+def test_placement_ladder_affinity_then_headroom_then_load():
+    reg = _stub_registry({
+        "h:1": {"admission": {"headroom": 100, "reserved_bytes": 90,
+                              "queued": 3, "running": 2}},
+        "h:2": {"admission": {"headroom": 1000, "reserved_bytes": 0,
+                              "queued": 0, "running": 0}},
+    })
+    try:
+        aff = AffinityMap()
+        # rung 2: fresh stats, h:1 over-committed -> h:2
+        d = choose_replica(reg, aff, "k1", estimated_bytes=500)
+        assert (d.replica.replica_id, d.reason) == ("h:2", "headroom")
+        # rung 1: a recorded affinity wins over load
+        aff.record("k1", "h:1", fingerprint="fp1")
+        d = choose_replica(reg, aff, "k1", estimated_bytes=500)
+        assert (d.replica.replica_id, d.reason) == ("h:1", "affinity")
+        # a byte-different encoding (new blob key) of a learned plan
+        # joins through the fingerprint-keyed AffinityMap entry
+        d = choose_replica(reg, aff, "other-encoding",
+                           fingerprint="fp1", estimated_bytes=500)
+        assert (d.replica.replica_id, d.reason) == ("h:1", "affinity")
+        # quarantined affinity target falls through to the next rung
+        reg.quarantine("h:1")
+        d = choose_replica(reg, aff, "k1", estimated_bytes=500)
+        assert (d.replica.replica_id, d.reason) == ("h:2", "headroom")
+        # rung 3: stale snapshots everywhere -> router-local load
+        for rid in ("h:1", "h:2"):
+            reg.get(rid).stats_at -= 1000.0
+        reg.get("h:2").in_flight = 5
+        d = choose_replica(reg, aff, "k-new", stats_stale_s=10.0)
+        assert (d.replica.replica_id, d.reason) == (
+            "h:2", "least_loaded",
+        )  # h:1 still quarantined; h:2 is all that's routable
+        assert choose_replica(
+            reg, aff, "k-new", exclude={"h:2"}
+        ) is None
+    finally:
+        reg.close()
+
+
+def test_placement_p50_weights_queue_drain():
+    """A replica that historically runs this plan fast drains its
+    queue sooner than raw depth suggests."""
+    reg = _stub_registry({
+        "h:1": {"admission": {"headroom": 1000, "reserved_bytes": 0,
+                              "queued": 2, "running": 0},
+                "runtime_history": {"top": [
+                    {"fingerprint": "fp-slow-w"[:16], "fp": "fp-w",
+                     "p50": 0.01}]}},
+        "h:2": {"admission": {"headroom": 1000, "reserved_bytes": 0,
+                              "queued": 1, "running": 0},
+                "runtime_history": {"top": [
+                    {"fingerprint": "fp-w"[:16], "fp": "fp-w",
+                     "p50": 5.0}]}},
+    })
+    try:
+        # depth alone would pick h:2 (1 < 2); the p50 weighting knows
+        # h:2 runs this plan 500x slower
+        d = choose_replica(
+            reg, AffinityMap(), "k", fingerprint="fp-w",
+            use_affinity=False,
+        )
+        assert (d.replica.replica_id, d.reason) == ("h:1", "headroom")
+    finally:
+        reg.close()
+
+
+def test_tied_load_rendezvous_spreads_distinct_keys():
+    """Under EQUAL load the headroom rung must not pile every distinct
+    plan onto the lexicographically-first replica: ties break by
+    rendezvous hash, so distinct keys spread across the fleet while
+    the SAME key deterministically picks the same replica (concurrent
+    first submissions converge on one cache/coalescing point before
+    the affinity map has learned the plan)."""
+    same = {"admission": {"headroom": 1000, "reserved_bytes": 0,
+                          "queued": 0, "running": 0}}
+    reg = _stub_registry({f"h:{i}": dict(same) for i in range(4)})
+    try:
+        aff = AffinityMap()
+        picks = {
+            k: choose_replica(reg, aff, k, use_affinity=False)
+            .replica.replica_id
+            for k in (f"key-{i}" for i in range(16))
+        }
+        assert len(set(picks.values())) > 1  # spread, not piled
+        for k, first in picks.items():  # deterministic per key
+            again = choose_replica(
+                reg, aff, k, use_affinity=False
+            ).replica.replica_id
+            assert again == first
+        # rung 3 (stale snapshots) spreads the same way
+        for i in range(4):
+            reg.get(f"h:{i}").stats_at -= 1000.0
+        stale_picks = {
+            choose_replica(reg, aff, f"key-{i}",
+                           use_affinity=False).replica.replica_id
+            for i in range(16)
+        }
+        assert len(stale_picks) > 1
+    finally:
+        reg.close()
+
+
+def test_random_placement_round_robin_and_exclude():
+    reg = _stub_registry({"h:1": None, "h:2": None})
+    try:
+        picks = [
+            random_replica(reg, i).replica.replica_id
+            for i in range(4)
+        ]
+        assert picks == ["h:1", "h:2", "h:1", "h:2"]
+        d = random_replica(reg, 0, exclude={"h:1"})
+        assert d.replica.replica_id == "h:2"
+    finally:
+        reg.close()
+
+
+def test_merge_expositions_stamps_and_dedups():
+    base = (
+        "# TYPE blaze_router_events_total counter\n"
+        "blaze_router_events_total{event=\"submitted\"} 3\n"
+    )
+    merged = merge_expositions(base, {
+        "127.0.0.1:9001": (
+            "# TYPE blaze_router_events_total counter\n"
+            "# TYPE blaze_q_total counter\n"
+            "blaze_q_total 7\n"
+            "blaze_q_labeled{state=\"done\"} 2\n"
+            "this line is : not ; a sample\n"
+        ),
+    })
+    assert 'blaze_q_total{replica="127.0.0.1:9001"} 7' in merged
+    assert ('blaze_q_labeled{state="done",replica="127.0.0.1:9001"} 2'
+            in merged)
+    assert "not ; a sample" not in merged  # malformed dropped
+    assert merged.count("# TYPE blaze_router_events_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet
+# ---------------------------------------------------------------------------
+
+
+def test_router_wire_roundtrip_matches_inprocess(dataset):
+    from blaze_tpu.runtime.executor import execute_task
+
+    blob = dataset()
+    exp = pa.Table.from_batches(list(execute_task(blob)))
+    with Fleet() as fl:
+        with RouterServer(fl.router) as rs:
+            with ServiceClient(*rs.address) as c:
+                got = pa.Table.from_batches(c.run(blob))
+    g = got.to_pandas().sort_values("k").reset_index(drop=True)
+    e = exp.to_pandas().sort_values("k").reset_index(drop=True)
+    assert g.k.tolist() == e.k.tolist()
+    assert np.allclose(g.s.values, e.s.values)
+
+
+def test_affinity_repeat_lands_on_warm_replica_zero_dispatches(dataset):
+    """ISSUE 5 acceptance (placement half): the second identical query
+    is routed by fingerprint affinity to the replica whose ResultCache
+    holds the result and completes with 0 kernel dispatches."""
+    blob = dataset()
+    with Fleet() as fl:
+        r = fl.router
+        st1 = r.submit({"use_cache": True}, blob)
+        p1 = wait_done(r, st1["query_id"])
+        assert p1["state"] == "DONE" and p1["dispatches"] > 0
+        st2 = r.submit({"use_cache": True}, blob)
+        p2 = wait_done(r, st2["query_id"])
+        assert p2["state"] == "DONE"
+        assert p2["replica"] == p1["replica"]  # warm replica
+        assert p2["dispatches"] == 0
+        assert p2["cache_hits"] == 1
+        assert r.counters["placed_affinity"] == 1
+        # the fleet STATS view explains the decision mix (bounded
+        # staleness: refresh the snapshot before reading aggregates)
+        r.registry.poll_now()
+        stats = r.stats()
+        assert stats["router"]["placed_affinity"] == 1
+        assert stats["fleet"]["alive"] == 2
+        assert stats["fleet"]["cache"]["hits"] == 1
+
+
+def test_headroom_spillover_to_less_loaded_replica(dataset):
+    """A query whose estimated bytes exceed the busy replica's
+    remaining admission headroom spills to the idle one."""
+    trackers = [DeviceMemoryTracker(budget=1000),
+                DeviceMemoryTracker(budget=1000)]
+    release = threading.Event()
+    blocker = GatedScan(release)
+    try:
+        with Fleet(svc_kw={"max_concurrency": 4},
+                   trackers=trackers) as fl:
+            busy_id = fl.specs[0]
+            busy_svc = fl.svcs[0]
+            busy_svc.submit_plan(blocker, estimated_bytes=800)
+            assert wait_for(lambda: blocker.started.is_set())
+            fl.router.registry.poll_now()  # learn the 800-byte hold
+            st = fl.router.submit(
+                {"use_cache": True, "estimated_bytes": 500},
+                dataset(),
+            )
+            p = wait_done(fl.router, st["query_id"])
+            assert p["state"] == "DONE"
+            assert p["replica"] == fl.other(busy_id)
+            assert fl.router.counters["placed_headroom"] == 1
+    finally:
+        release.set()
+
+
+def test_overloaded_affinity_target_spills_to_idle_replica(dataset):
+    """A saturated affinity target must not turn fleet capacity into
+    client-visible rejections: replica-level REJECTED_OVERLOADED is a
+    placement miss, so the router spills the query to the next
+    routable replica and only surfaces a rejection when EVERYBODY
+    refused (affinity is a hint, never a correctness dependency)."""
+    release = threading.Event()
+    try:
+        with Fleet(svc_kw={"max_concurrency": 1,
+                           "max_queue_depth": 1}) as fl:
+            blob = dataset()
+            st = fl.router.submit({"use_cache": True}, blob)
+            p = wait_done(fl.router, st["query_id"])
+            warm = p["replica"]
+            # saturate the warm replica: one running + a full queue
+            warm_svc = fl.by_id[warm][0]
+            blocker = GatedScan(release)
+            warm_svc.submit_plan(blocker)
+            assert wait_for(lambda: blocker.started.is_set())
+            warm_svc.submit_plan(GatedScan(release))
+            # affinity still points at the warm replica; its admission
+            # now rejects, and the router spills instead of bouncing
+            st2 = fl.router.submit({"use_cache": True}, blob)
+            assert st2["state"] != "REJECTED_OVERLOADED", st2
+            p2 = wait_done(fl.router, st2["query_id"])
+            assert p2["state"] == "DONE"
+            assert p2["replica"] == fl.other(warm)
+            assert fl.router.counters["overflow_spills"] == 1
+            # saturate the OTHER replica too: now the whole fleet
+            # refuses, and the rejection surfaces classified
+            other_svc = fl.by_id[fl.other(warm)][0]
+            blocker2 = GatedScan(release)
+            other_svc.submit_plan(blocker2)
+            assert wait_for(lambda: blocker2.started.is_set())
+            other_svc.submit_plan(GatedScan(release))
+            st3 = fl.router.submit({"use_cache": False}, dataset(0.9))
+            assert st3["state"] == "REJECTED_OVERLOADED"
+            assert st3["error_class"] == "TRANSIENT"
+            assert "rejected overloaded" in st3["error"]
+            assert fl.router.counters["overflow_spills"] == 3
+    finally:
+        release.set()
+
+
+def test_failover_cancels_superseded_execution_on_live_replica(
+    dataset,
+):
+    """Failover away from a replica that is still ALIVE (breaker trip,
+    not heartbeat death) must best-effort cancel the superseded
+    downstream execution: it was submitted detach=True, so without the
+    cancel it would run to completion - the query executing twice
+    fleet-wide while holding the sick replica's admission slot."""
+    blob = dataset()
+    with chaos.active(
+        # one stall keeps the first execution RUNNING while the test
+        # trips the breaker and the router re-routes elsewhere; the
+        # cancel is only OBSERVED once the (uninterruptible) stall
+        # sleep ends, so keep it short enough for the wait below
+        [Fault("task.execute", klass="STALL", stall_s=4.0, times=1)],
+        seed=7,
+    ):
+        with Fleet(router_kw={"breaker_threshold": 1,
+                              "quarantine_s": 30.0}) as fl:
+            st = fl.router.submit({"use_cache": True,
+                                   "detach": True}, blob)
+            qid = st["query_id"]
+            rq = fl.router.get(qid)
+            first, first_internal = rq.replica_id, rq.internal_id
+            first_svc = fl.by_id[first][0]
+            assert wait_for(
+                lambda: first_svc.get(first_internal).state
+                is QueryState.RUNNING
+            )
+            # fatal-class strike trips the breaker (threshold 1):
+            # quarantine + re-route of the replica's in-flight queries
+            assert fl.router.breaker.note_fatal(first, kind="query")
+            fl.router._on_replica_dead(fl.router.registry.get(first))
+            assert rq.replica_id == fl.other(first)
+            p = wait_done(fl.router, qid)
+            assert p["state"] == "DONE"
+            # the superseded execution on the LIVE first replica was
+            # cancelled - not left to grind through the 30s stall
+            assert wait_for(
+                lambda: first_svc.get(first_internal).state
+                is QueryState.CANCELLED,
+                timeout=10,
+            )
+
+
+def test_transient_failure_resubmits_same_replica(dataset):
+    """TRANSIENT terminal failures re-submit to the SAME replica
+    (bounded, with backoff): its cache/affinity state is there and the
+    taxonomy says re-running can work."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="TRANSIENT", times=1)], seed=7,
+    ):
+        # max_task_attempts=1: the replica does NOT retry internally,
+        # so the failure class surfaces to the router tier
+        with Fleet(svc_kw={"max_task_attempts": 1}) as fl:
+            st = fl.router.submit({"use_cache": True}, blob)
+            first_replica = fl.router.get(st["query_id"]).replica_id
+            p = wait_done(fl.router, st["query_id"])
+            assert p["state"] == "DONE"
+            assert p["replica"] == first_replica
+            assert p["router_resubmits"] == 1
+            assert fl.router.counters["resubmits_transient"] == 1
+            assert fl.router.counters["failovers"] == 0
+            # the superseded first placement's in-flight slot was
+            # released on re-submission (same replica), and the
+            # terminal _finish released the second: no leak
+            assert fl.router.registry.get(
+                first_replica
+            ).in_flight == 0
+
+
+def test_fatal_class_trips_breaker_surfaces_classified(dataset):
+    """Fatal-class failures surface AS-IS (classified, no opaque
+    FAILED) and count against the replica's circuit breaker; an
+    all-dead fleet degrades to REJECTED_OVERLOADED + TRANSIENT."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="RESOURCE_EXHAUSTED", times=0)],
+        seed=7,
+    ):
+        with Fleet(
+            svc_kw={"max_task_attempts": 1, "degrade_to_host": False},
+            router_kw={"breaker_threshold": 1, "quarantine_s": 30.0},
+        ) as fl:
+            st1 = fl.router.submit({"use_cache": False}, blob)
+            p1 = wait_done(fl.router, st1["query_id"])
+            assert p1["state"] == "FAILED"
+            assert p1["error_class"] == "RESOURCE_EXHAUSTED"
+            assert fl.router.registry.get(p1["replica"]).quarantined()
+            st2 = fl.router.submit({"use_cache": False}, blob)
+            p2 = wait_done(fl.router, st2["query_id"])
+            assert p2["state"] == "FAILED"
+            assert p2["replica"] == fl.other(p1["replica"])
+            # both replicas quarantined: fleet is out of capacity
+            st3 = fl.router.submit({"use_cache": False}, blob)
+            assert st3["state"] == "REJECTED_OVERLOADED"
+            assert st3["error_class"] == "TRANSIENT"
+            assert fl.router.counters["no_replica"] == 1
+            # the rejected handle stays pollable: its terminal state
+            # comes back, not an unknown-replica error
+            p3 = fl.router.poll(st3["query_id"])
+            assert p3["state"] == "REJECTED_OVERLOADED"
+            assert p3["error_class"] == "TRANSIENT"
+
+
+def test_refetch_of_finalized_failure_lands_no_extra_strikes(dataset):
+    """A client retrieving an already-surfaced failure (poll, then
+    FETCH retries) must not land additional breaker strikes for the
+    same single event - one query failing + fetch retries must never
+    quarantine a healthy replica."""
+    from blaze_tpu.service.wire import ServiceError
+
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="RESOURCE_EXHAUSTED", times=1)],
+        seed=7,
+    ):
+        with Fleet(
+            svc_kw={"max_task_attempts": 1, "degrade_to_host": False},
+            router_kw={"breaker_threshold": 3, "quarantine_s": 30.0},
+        ) as fl:
+            st = fl.router.submit({"use_cache": False}, blob)
+            p = wait_done(fl.router, st["query_id"])
+            assert p["state"] == "FAILED"  # strike 1, finalized
+            for _ in range(3):  # would trip threshold=3 if counted
+                with pytest.raises(ServiceError):
+                    list(fl.router.stream_parts(st["query_id"]))
+            assert not fl.router.registry.get(
+                p["replica"]
+            ).quarantined()
+
+
+def test_retention_evicts_finished_before_live(monkeypatch):
+    """Routed-query retention: a long-lived live query at the head of
+    the ring must not pin terminal entries (each holding its full
+    task_bytes) behind it - finished entries evict first, wherever
+    they sit; only past the hard cap is a live head abandoned."""
+    from blaze_tpu.router import proxy as proxy_mod
+
+    monkeypatch.setattr(proxy_mod, "_MAX_RETAINED", 4)
+    monkeypatch.setattr(proxy_mod, "_HARD_RETAINED", 8)
+    r = Router([], start=False)
+    r.registry.replicas["h:1"] = Replica("h", 1)
+    cancelled = []
+    monkeypatch.setattr(
+        r, "_cancel_superseded",
+        lambda rep, iid: cancelled.append((rep.replica_id, iid)),
+    )
+    try:
+        def mk(finished):
+            rq = proxy_mod.RoutedQuery("k", b"t", False, None, {})
+            rq.finished = finished
+            rq.replica_id = "h:1"
+            rq.internal_id = "iq-" + rq.external_id
+            r._register(rq)
+            return rq
+
+        live = mk(False)
+        done = [mk(True) for _ in range(5)]
+        # the live head survives; the OLDEST finished entries go
+        assert live.external_id in r._queries
+        assert len(r._order) == 4
+        assert done[0].external_id not in r._queries
+        assert done[1].external_id not in r._queries
+        assert all(d.external_id in r._queries for d in done[2:])
+        # all-live fleet: retention holds up to the hard cap, then
+        # abandons the oldest live handle (classified, slot released)
+        extra = [mk(False) for _ in range(7)]
+        assert len(r._order) == 8
+        assert live.external_id in r._queries
+        mk(False)
+        assert live.external_id not in r._queries
+        assert live.finished and live.last_state == "ABANDONED"
+        assert all(e.external_id in r._queries for e in extra)
+        # abandoning a live handle also cancels its detach=True
+        # downstream run - with the handle gone nothing else can ever
+        # stop or fetch it, so leaking it would pin the replica's
+        # admission slot and device reservation to completion
+        assert cancelled == [("h:1", live.internal_id)]
+    finally:
+        r.close()
+
+
+def test_fetch_fleet_unavailable_err_carries_state_token(
+        dataset, monkeypatch):
+    """FETCH ERR frames follow the 'STATE: detail' convention even for
+    router-tier fleet-unavailable errors: ServiceError.state must
+    parse to a state token (the submit path's REJECTED_OVERLOADED
+    convention), not the first half of an IP address."""
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"detach": True}, blob)
+        qid = st["query_id"]
+
+        def unavailable(*a, **kw):
+            raise ReplicaUnavailableError(
+                f"replica 127.0.0.1:1 lost mid-FETCH of {qid}"
+            )
+
+        monkeypatch.setattr(fl.router, "stream_parts", unavailable)
+        with RouterServer(fl.router) as rs:
+            with ServiceClient(*rs.address) as c:
+                with pytest.raises(ServiceError) as ei:
+                    c.fetch(qid)
+        assert ei.value.state == "REJECTED_OVERLOADED"
+
+
+def test_resubmit_of_finished_query_does_not_double_release(
+        monkeypatch):
+    """A DONE query's in-flight slot was already released by _finish;
+    when its replica restarts and loses the result, the re-FETCH
+    UNKNOWN path _resubmits it - that move must not release the old
+    slot AGAIN, or the replica's in_flight under-counts by one (per
+    such re-fetch) and load-rung placement over-targets it for the
+    router's whole life."""
+    from blaze_tpu.router import proxy as proxy_mod
+
+    r = Router([], start=False)
+    try:
+        a, b = Replica("h", 1), Replica("h", 2)
+        r.registry.replicas[a.replica_id] = a
+        r.registry.replicas[b.replica_id] = b
+        a.note_routed()  # one OTHER live query holds a slot on A
+        rq = proxy_mod.RoutedQuery("k", b"t", False, None, {})
+        rq.replica_id = a.replica_id
+        rq.internal_id = "iq-1"
+        rq.finished = True  # DONE: slot released at _finish
+        rq.last_state = "DONE"
+
+        def fake_place(rq2, exclude, same_replica=None):
+            rq2.replica_id = b.replica_id
+            rq2.internal_id = "iq-2"
+            rq2.generation += 1
+            b.note_routed()
+            return {"query_id": "iq-2"}
+
+        monkeypatch.setattr(r, "_place_and_submit", fake_place)
+        assert r._resubmit(rq, rq.generation, same_replica=False,
+                           exclude={a.replica_id},
+                           counter="failovers")
+        assert a.in_flight == 1  # the other query's slot survives
+        assert b.in_flight == 1  # the re-run counts exactly once
+        assert not rq.finished   # moved query is live again
+    finally:
+        r.close()
+
+
+def test_report_of_lost_handle_answers_from_routing_table(
+        dataset, monkeypatch):
+    """REPORT of a finished query whose replica restarted (downstream
+    handle gone - ServiceClient.report KeyErrors on the replica's
+    error reply) must answer the router's last observation like
+    poll() does, not surface an opaque replica-side lookup miss."""
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True, "detach": True},
+                              blob)
+        qid = st["query_id"]
+        p = wait_done(fl.router, qid)
+        assert p["state"] == "DONE"
+
+        def lost(self, iid):
+            raise KeyError("report")
+
+        monkeypatch.setattr(ServiceClient, "report", lost)
+        out = fl.router.report(qid)
+        assert out["query_id"] == qid
+        assert out["state"] == "DONE"
+        assert "no longer holds" in out["report"]
+
+
+def test_replica_death_reroutes_detached_fetch(dataset):
+    """ISSUE 5 satellite: a detached query whose replica dies before
+    FETCH is re-routed (fresh execution - its results died with the
+    replica's cache) and the client still gets the full result."""
+    from blaze_tpu.runtime.executor import execute_task
+
+    blob = dataset()
+    exp = pa.Table.from_batches(list(execute_task(blob)))
+    with Fleet(router_kw={"breaker_threshold": 1,
+                          "quarantine_s": 30.0}) as fl:
+        with RouterServer(fl.router) as rs:
+            with ServiceClient(*rs.address) as c:
+                st = c.submit(blob, detach=True)
+                qid = st["query_id"]
+                p = wait_done(fl.router, qid)
+                assert p["state"] == "DONE"
+                fl.kill_gateway(p["replica"])
+                batches = c.fetch(qid)
+                p2 = c.poll(qid)
+        assert p2["replica"] == fl.other(p["replica"])
+        assert p2["router_failovers"] >= 1
+        assert fl.router.counters["failovers"] >= 1
+    got = pa.Table.from_batches(batches)
+    g = got.to_pandas().sort_values("k").reset_index(drop=True)
+    e = exp.to_pandas().sort_values("k").reset_index(drop=True)
+    assert g.k.tolist() == e.k.tolist()
+    assert np.allclose(g.s.values, e.s.values)
+
+
+def test_fetch_splice_protection_detects_divergent_rerun(dataset):
+    """A re-fetch serves parts verified against the digests of what
+    the client already received: a re-executed result that diverged
+    (non-deterministic or degraded re-run after failover) must fail
+    classified, never be silently spliced into the client's
+    count-based resume."""
+    from blaze_tpu.service.wire import ServiceError
+
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        qid = st["query_id"]
+        wait_done(fl.router, qid)
+        parts = list(fl.router.stream_parts(qid))
+        assert parts
+        rq = fl.router.get(qid)
+        assert len(rq.delivered_hashes) == len(parts)
+        # an identical re-fetch re-verifies clean
+        assert list(fl.router.stream_parts(qid)) == parts
+        # simulate a divergent re-execution: the canonical record no
+        # longer matches what the replica streams
+        rq.delivered_hashes[0] = b"\x00" * 16
+        with pytest.raises(ServiceError) as ei:
+            list(fl.router.stream_parts(qid))
+        assert ei.value.state == "FAILED"
+        assert rq.splice_broken
+        # the poisoned handle fails fast forever after
+        with pytest.raises(ServiceError):
+            list(fl.router.stream_parts(qid))
+
+
+def test_heartbeat_death_reroutes_inflight_query(dataset):
+    """Registry heartbeat death (no successful STATS poll within the
+    liveness window) quarantines the replica and re-routes its
+    in-flight queries without the client doing anything."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=8.0, times=1)],
+        seed=7,
+    ):
+        with Fleet(router_kw={"quarantine_s": 30.0}) as fl:
+            st = fl.router.submit({"use_cache": True,
+                                   "detach": True}, blob)
+            qid = st["query_id"]
+            rq = fl.router.get(qid)
+            first = rq.replica_id
+            fl.kill_gateway(first)
+
+            def dead():
+                fl.router.registry.poll_now()
+                return not fl.router.registry.get(first).alive
+
+            assert wait_for(dead, timeout=10)
+            # on_dead re-routed the stalled query to the survivor
+            # (where the consumed stall budget no longer fires); the
+            # sweep runs detached from the poll thread, so wait
+            assert wait_for(
+                lambda: rq.replica_id == fl.other(first), timeout=10
+            )
+            p = wait_done(fl.router, qid)
+            assert p["state"] == "DONE"
+            assert p["router_failovers"] >= 1
+            assert fl.router.registry.get(first).quarantine_reason \
+                == "heartbeat-dead"
+
+
+def test_cancel_blocks_pending_failover_resurrection(dataset):
+    """A client cancel must stick: a failover _resubmit that observed
+    the query's generation BEFORE the cancel no-ops instead of
+    re-executing the cancelled query detached on a healthy replica."""
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True, "detach": True},
+                              blob)
+        qid = st["query_id"]
+        rq = fl.router.get(qid)
+        observed_gen = rq.generation
+        fl.router.cancel(qid)
+        assert rq.cancelled and rq.finished
+        # the failover sweep wakes up with its stale observation:
+        # the claim must be refused
+        assert fl.router._resubmit(
+            rq, observed_gen, same_replica=False, exclude=set(),
+            counter="failovers",
+        )
+        assert rq.finished  # not resurrected
+        assert fl.router.counters["failovers"] == 0
+        # downstream cancellation is cooperative (batch boundaries):
+        # wait for the terminal state instead of racing it
+        assert wait_for(
+            lambda: fl.router.poll(qid)["state"]
+            in ("CANCELLED", "DONE")
+        )
+
+
+def test_inband_submit_error_passes_through_unregistered(
+        dataset, monkeypatch):
+    """A replica that answers SUBMIT with a protocol-level error (no
+    query_id - e.g. a draining shutdown) surfaces exactly as a single
+    serve instance would: the router must not mint a handle for a
+    query that never existed downstream (the entry would sit
+    never-finished in the routing table, pinning its task blob past
+    every finished-first eviction scan)."""
+    blob = dataset()
+    with Fleet() as fl:
+        monkeypatch.setattr(
+            ServiceClient, "submit_raw",
+            lambda self, *a, **kw: {"error": "service draining"},
+        )
+        resp = fl.router.submit({"use_cache": True}, blob)
+        assert resp == {"error": "service draining"}
+        assert not fl.router._queries
+
+
+def test_inband_error_during_failover_keeps_original_placement(
+        dataset, monkeypatch):
+    """_resubmit must treat an in-band submit error (no query_id) as a
+    failed move: nothing was placed, so releasing the old in-flight
+    slot or cancelling the old execution as superseded would kill the
+    query's only live downstream run - it would then surface CANCELLED
+    although the client never cancelled."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=1.0, times=1)],
+        seed=7,
+    ):
+        with Fleet() as fl:
+            st = fl.router.submit({"use_cache": True, "detach": True},
+                                  blob)
+            qid = st["query_id"]
+            rq = fl.router.get(qid)
+            first = rq.replica_id
+            monkeypatch.setattr(
+                ServiceClient, "submit_raw",
+                lambda self, *a, **kw: {"error": "service draining"},
+            )
+            assert not fl.router._resubmit(
+                rq, rq.generation, same_replica=False,
+                exclude={first}, counter="failovers",
+            )
+            monkeypatch.undo()
+            assert rq.replica_id == first
+            assert not rq.finished
+            assert fl.router.counters["failovers"] == 0
+            # the old slot was not released for a move that never
+            # happened - a leak here biases load() for the router's
+            # whole life
+            assert fl.router.registry.get(first).in_flight == 1
+            # and the original execution was NOT cancelled as
+            # superseded: the query drains to DONE where it started
+            p = wait_done(fl.router, qid)
+            assert p["state"] == "DONE"
+            assert p["replica"] == first
+
+
+def test_router_session_disconnect_cancels_downstream(dataset):
+    """Cancel-on-disconnect re-implemented at the router tier: a
+    vanished client's non-detached queries are cancelled on their
+    replicas."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=8.0, times=1)],
+        seed=7,
+    ):
+        with Fleet() as fl:
+            with RouterServer(fl.router) as rs:
+                c = ServiceClient(*rs.address)
+                st = c.submit(blob)  # attached (detach=False)
+                qid = st["query_id"]
+                rq = fl.router.get(qid)
+                assert wait_for(lambda: rq.internal_id is not None)
+                svc = fl.by_id[rq.replica_id][0]
+                internal = svc.get(rq.internal_id)
+                c.close()  # vanish mid-execution
+                assert wait_for(
+                    lambda: internal.state is QueryState.CANCELLED,
+                    timeout=15,
+                )
+                assert rq.finished
+                # cancel released the replica's in-flight slot: a
+                # leak here would bias load() against this replica
+                # for the rest of the router's life
+                assert fl.router.registry.get(
+                    rq.replica_id
+                ).in_flight == 0
+
+
+def test_router_stats_and_metrics_fleet_view(dataset):
+    blob = dataset()
+    with Fleet() as fl:
+        with RouterServer(fl.router) as rs:
+            with ServiceClient(*rs.address) as c:
+                c.run(blob)
+                stats = c.stats()
+                assert stats["router"]["submitted"] == 1
+                assert stats["fleet"]["alive"] == 2
+                assert set(stats["replicas"]) == set(fl.specs)
+                text = c.metrics()
+    assert "blaze_router_events_total" in text
+    # replica-stamped series from the downstream scrapes
+    assert re.search(r'replica="127\.0\.0\.1:\d+"', text)
+    assert "blaze_router_replica_alive" in text
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: serve x2 behind the route CLI
+# ---------------------------------------------------------------------------
+
+
+def _spawn(args, env_extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "blaze_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO,
+    )
+    deadline = time.monotonic() + 120
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on" in line:
+            break
+        assert proc.poll() is None, f"{args[0]} exited early"
+    m = re.search(r"'([\d.]+)', (\d+)", line)
+    assert m, f"no address in: {line!r}"
+    return proc, m.group(1), int(m.group(2))
+
+
+def _reap(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_e2e_route_cli_affinity_and_chaos_kill_failover(dataset):
+    """ISSUE 5 acceptance, end to end: two `serve` replicas behind
+    `python -m blaze_tpu route`. A repeated identical query is routed
+    by fingerprint affinity to the warm replica and completes with 0
+    kernel dispatches; SIGKILLing the replica mid-query re-routes it
+    and the client still gets the full result (no opaque FAILED)."""
+    # every real execution stalls 2s (STALL never raises, so results
+    # stay correct): wide-open window to kill a replica mid-query
+    chaos_env = json.dumps({
+        "seed": 5,
+        "faults": [{"site": "task.execute", "klass": "STALL",
+                    "stall_s": 2.0, "times": 0}],
+    })
+    procs = []
+    try:
+        replicas = {}
+        for _ in range(2):
+            proc, host, port = _spawn(
+                ["serve", "--port", "0", "--max-concurrency", "2"],
+                env_extra={"BLAZE_CHAOS": chaos_env},
+            )
+            procs.append(proc)
+            replicas[f"{host}:{port}"] = proc
+        rproc, rhost, rport = _spawn(
+            ["route", "--port", "0",
+             *(x for rid in replicas for x in ("--replica", rid)),
+             "--poll-interval", "0.1", "--heartbeat-timeout", "0.8",
+             "--breaker-threshold", "1", "--quarantine", "60"],
+        )
+        procs.append(rproc)
+        with ServiceClient(rhost, rport, timeout=300.0) as c:
+            # --- affinity leg -----------------------------------------
+            blob = dataset()
+            st1 = c.submit(blob)
+            r1 = c.fetch(st1["query_id"])
+            p1 = c.poll(st1["query_id"])
+            assert p1["state"] == "DONE" and p1["dispatches"] > 0
+            st2 = c.submit(blob)
+            r2 = c.fetch(st2["query_id"])
+            p2 = c.poll(st2["query_id"])
+            assert p2["state"] == "DONE"
+            assert p2["replica"] == p1["replica"]
+            assert p2["dispatches"] == 0, p2
+            assert p2["cache_hits"] == 1
+            assert pa.Table.from_batches(r1).to_pydict() == \
+                pa.Table.from_batches(r2).to_pydict()
+            # --- chaos-kill leg ---------------------------------------
+            blob2 = dataset(0.3)  # distinct fingerprint
+            st3 = c.submit(blob2, detach=True)
+            qid3 = st3["query_id"]
+            assert wait_for(
+                lambda: c.poll(qid3).get("state") == "RUNNING",
+                timeout=60,
+            )
+            victim = c.poll(qid3)["replica"]
+            replicas[victim].kill()  # SIGKILL mid-execution
+            batches = c.fetch(qid3)  # re-routed + re-run downstream
+            p3 = c.poll(qid3)
+            assert p3["state"] == "DONE"
+            assert p3["replica"] != victim
+            assert p3["router_failovers"] >= 1
+            got = pa.Table.from_batches(batches)
+            assert got.num_rows > 0
+            # fleet view records exactly one dead replica
+            stats = c.stats()
+            assert stats["fleet"]["alive"] == 1
+            assert stats["router"]["failovers"] >= 1
+    finally:
+        for proc in procs:
+            _reap(proc)

@@ -456,3 +456,39 @@ def test_estimate_plan_device_bytes(parquet_task):
     cb = ColumnBatch.from_pydict({"a": list(range(100))})
     mem = MemoryScanExec([[cb]], cb.schema)
     assert estimate_plan_device_bytes(mem) > 0
+
+
+def test_coalescing_second_identical_inflight_submit_waits(parquet_task):
+    """ISSUE 5 satellite (ROADMAP scan-sharing first step): a second
+    identical stable-fingerprint SUBMIT while the first is in flight
+    WAITS on the leader and serves from the cache it populates - it
+    never re-executes - and the `coalesced` counter records it."""
+    from blaze_tpu.testing import chaos
+    from blaze_tpu.testing.chaos import Fault
+
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=2.0, times=1)],
+        seed=7,
+    ) as plan:
+        with QueryService(max_concurrency=2) as svc:
+            p1, _ = parquet_task()
+            p2, _ = parquet_task()  # identical content fingerprint
+            q1 = svc.submit_plan(p1)
+            # the stall fires INSIDE partition execution, i.e. after
+            # q1 claimed (fingerprint, partition) leadership - q2 is
+            # deterministically the follower
+            assert wait_for(lambda: plan.fired("task.execute") >= 1)
+            q2 = svc.submit_plan(p2)
+            r1 = svc.result(q1.query_id, timeout=60)
+            r2 = svc.result(q2.query_id, timeout=60)
+            s1, s2 = q1.status(), q2.status()
+            assert s1.get("coalesced", 0) == 0
+            assert s2["coalesced"] == 1
+            assert s2["dispatches"] == 0  # never executed
+            assert s2["cache_hits"] == 1
+            assert svc.cache.stats()["coalesced"] == 1
+            # only the leader ever reached the execution seam
+            assert plan.fired("task.execute") == 1
+    t1 = pa.Table.from_batches(r1).to_pydict()
+    t2 = pa.Table.from_batches(r2).to_pydict()
+    assert t1 == t2

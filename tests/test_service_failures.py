@@ -364,3 +364,90 @@ def test_error_class_and_attempts_on_the_wire(parquet_blob):
                     report = c.report(qid)
     assert "error_class=PLAN_INVALID" in report
     assert "PLAN_INVALID -> fail" in report
+
+
+class PartitionGatedScan(MemoryScanExec):
+    """MemoryScanExec whose chosen partitions block on an Event until
+    the test releases them: event-gated ordering, no wall-clock
+    races. `gates[p] = (started, release)`."""
+
+    def __init__(self, parts, schema, gates):
+        super().__init__(parts, schema)
+        self.gates = gates
+
+    def execute(self, partition, ctx):
+        g = self.gates.get(partition)
+        if g is not None:
+            g[0].set()
+            assert g[1].wait(30), f"partition {partition} gate leaked"
+        yield from super().execute(partition, ctx)
+
+
+def test_degraded_query_releases_bytes_unblocks_waiter():
+    """ISSUE 5 satellite (degradation-aware admission): a partition
+    that degrades to the HOST engine releases its SHARE of the
+    device-byte reservation (ceil(800/3) = 267 here - the other
+    partitions still run on the device against the rest), so a
+    headroom-waiting query admits while the degraded one is still
+    running - without the release, 800 + 400 > 1000 would hold the
+    waiter until the degraded query finished; with it,
+    533 + 400 <= 1000 admits. Every ordering point is event-gated
+    (p0 and p2 block on explicit gates), never wall-clock."""
+    from blaze_tpu.runtime.memory import DeviceMemoryTracker
+
+    def gated(n_parts, gates, rows=40):
+        parts, schema = [], None
+        for p in range(n_parts):
+            cb = ColumnBatch.from_pydict(
+                {"a": list(range(p * rows, (p + 1) * rows))}
+            )
+            schema = cb.schema
+            parts.append([cb])
+        return PartitionGatedScan(parts, schema, gates)
+
+    g0 = (threading.Event(), threading.Event())
+    g2 = (threading.Event(), threading.Event())
+    tracker = DeviceMemoryTracker(budget=1000)
+    try:
+        with chaos.active(
+            # p1: degrade -> release_bytes frees its 267-byte share
+            [Fault("task.execute", klass="RESOURCE_EXHAUSTED",
+                   partition=1, times=1)],
+            seed=7,
+        ):
+            with QueryService(
+                max_concurrency=4, enable_cache=False,
+                device_tracker=tracker,
+            ) as svc:
+                qa = svc.submit_plan(
+                    gated(3, {0: g0, 2: g2}), estimated_bytes=800
+                )
+                # p0 holds the full reservation until released
+                assert wait_for(lambda: g0[0].is_set())
+                qb = svc.submit_plan(small_plan(),
+                                     estimated_bytes=400)
+                # over headroom while qa holds 800: qb WAITS
+                assert wait_for(
+                    lambda: svc.admission.stats()["headroom_waits"]
+                    >= 1
+                )
+                assert qb.state is QueryState.QUEUED
+                g0[1].set()
+                # qa's p1 degrades -> its share (267) frees -> qb
+                # admits and finishes while qa sits gated at p2 ON
+                # THE DEVICE against the remaining 533-byte
+                # reservation
+                svc.result(qb.query_id, timeout=30)
+                assert wait_for(lambda: g2[0].is_set())
+                assert qa.state is QueryState.RUNNING
+                assert (
+                    svc.admission.stats()["degraded_released"] == 1
+                )
+                assert svc.admission.stats()["reserved_bytes"] == 533
+                g2[1].set()
+                svc.result(qa.query_id, timeout=60)
+                assert qa.degraded
+                assert qa.state is QueryState.DONE
+    finally:
+        g0[1].set()
+        g2[1].set()
