@@ -1006,6 +1006,46 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- per-phase rollup (ISSUE 6): the phase probe's per-phase
+    # p50s recorded in the artifact, so `python -m blaze_tpu regress
+    # --bench OLD NEW` can diff two rounds PHASE BY PHASE - queue-wait
+    # creep and decode regressions are invisible to the e2e medians
+    # every other shape tracks. `median` is the probe's e2e p50 (the
+    # {median, spread, k} contract the smoke asserts); `snapshot` is
+    # the full per-class rollup regress consumes. ----
+    try:
+        from blaze_tpu.obs import phases as obs_phases
+
+        ph_rounds = 5
+        snap = obs_phases.run_probe(
+            rounds=ph_rounds, rows=min(n_rows, 1 << 18)
+        )
+        e2e_ph = snap.get("_all", {}).get("e2e", {})
+        p50 = float(e2e_ph.get("p50", 0.0))
+        p95 = float(e2e_ph.get("p95", 0.0))
+        detail["phases"] = {
+            "median": round(p50, 4),
+            "spread": round((p95 / p50 - 1.0) if p50 else 0.0, 3),
+            "k": ph_rounds,
+            "per_phase_p50": {
+                ph: v.get("p50")
+                for ph, v in snap.get("_all", {}).items()
+            },
+            "snapshot": snap,
+        }
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "phases", "backend": backend,
+                 **{k: v for k, v in detail["phases"].items()
+                    if k != "snapshot"}}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["phases"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     # ---- serving tier: queries/sec through the gateway service at
     # concurrency 1/4/16, with and without the plan-fingerprint result
     # cache (ISSUE 2 satellite). Same {median, spread, k} form as the

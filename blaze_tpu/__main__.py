@@ -19,6 +19,14 @@
                   service endpoint (fingerprint-affinity placement,
                   headroom-aware load balancing, class-aware failover;
                   blaze_tpu/router/, docs/ROUTER.md)
+  regress         per-phase regression check (obs/phases.py): run the
+                  fixed probe workload and diff its per-phase p50s
+                  against a checked-in baseline (--against), emit a
+                  fresh baseline (--emit-baseline), or diff the phase
+                  rollups of two BENCH_r*.json rounds (--bench A B).
+                  Exits nonzero on per-phase p50 creep beyond the
+                  noise band - a decode regression hiding under a
+                  flat e2e median fails here, not in production
 """
 
 from __future__ import annotations
@@ -185,7 +193,88 @@ def cmd_route(args) -> int:
         quarantine_s=args.quarantine,
         breaker_threshold=args.breaker_threshold,
         max_resubmits=args.max_resubmits,
+        enable_trace=not args.no_trace,
     )
+    return 0
+
+
+def cmd_regress(args) -> int:
+    """Per-phase regression detection (obs/phases.py): probe-vs-
+    baseline or bench-round-vs-bench-round. Exit codes: 0 clean,
+    1 regression(s) detected, 2 usage/input problem."""
+    from blaze_tpu.obs import phases
+
+    if args.bench:
+        try:
+            base = phases.phases_from_bench(args.bench[0])
+            live = phases.phases_from_bench(args.bench[1])
+        except (OSError, json.JSONDecodeError) as e:
+            # input problems exit 2, never 1: automation must be able
+            # to tell "phase regression" from "bad artifact path"
+            print(f"regress: cannot read bench artifact: {e}",
+                  file=sys.stderr)
+            return 2
+        missing = [p for p, s in zip(args.bench, (base, live))
+                   if s is None]
+        if missing:
+            print(f"no phase rollup recorded in {missing} "
+                  "(round predates phase recording?)",
+                  file=sys.stderr)
+            return 2
+        source = f"{args.bench[1]} vs {args.bench[0]}"
+        if args.emit_baseline:
+            # refresh the baseline from the NEWER round's rollup
+            phases.save_baseline(
+                args.emit_baseline, live,
+                meta={"source": args.bench[1]},
+            )
+            print(f"wrote {args.emit_baseline}", file=sys.stderr)
+    else:
+        live = phases.run_probe(rounds=args.rounds, rows=args.rows)
+        source = f"probe({args.rounds}x{args.rows} rows)"
+        if args.emit_baseline:
+            phases.save_baseline(
+                args.emit_baseline, live,
+                meta={"rounds": args.rounds, "rows": args.rows},
+            )
+            print(f"wrote {args.emit_baseline}", file=sys.stderr)
+            if not args.against:
+                return 0
+        if not args.against:
+            print(json.dumps(live, indent=1, sort_keys=True))
+            return 0
+        try:
+            base = phases.load_baseline(args.against)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"regress: cannot read baseline "
+                  f"{args.against}: {e}", file=sys.stderr)
+            return 2
+        source += f" vs {args.against}"
+    regressions = phases.compare(
+        live, base,
+        rel_band=args.noise,
+        abs_floor_s=args.abs_floor,
+        min_samples=args.min_samples,
+    )
+    print(json.dumps({
+        "source": source,
+        "noise_band": {"rel": args.noise,
+                       "abs_floor_s": args.abs_floor},
+        "regressions": regressions,
+        "live": live if args.verbose else
+        {k: v for k, v in live.items() if k == "_all"},
+    }, indent=1, sort_keys=True))
+    if regressions:
+        worst = regressions[0]
+        print(
+            f"REGRESSION: {len(regressions)} phase(s) crept - worst "
+            f"{worst['class']}/{worst['phase']} p50 "
+            f"{worst['base_p50']}s -> {worst['live_p50']}s "
+            f"({worst['ratio']}x, limit {worst['limit']}s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("per-phase p50s within the noise band", file=sys.stderr)
     return 0
 
 
@@ -254,6 +343,34 @@ def main(argv=None) -> int:
     rr.add_argument("--max-resubmits", type=int, default=2,
                     help="TRANSIENT same-replica re-submissions per "
                          "query")
+    rr.add_argument("--no-trace", action="store_true",
+                    help="disable router-hop tracing (obs/)")
+    rg = sub.add_parser("regress")
+    rg.add_argument("--against", default=None, metavar="BASELINE",
+                    help="phase baseline JSON to diff the probe "
+                         "against (PHASE_BASELINE.json)")
+    rg.add_argument("--emit-baseline", default=None, metavar="PATH",
+                    help="write the probe's rollup as a fresh "
+                         "baseline")
+    rg.add_argument("--bench", nargs=2, default=None,
+                    metavar=("OLD", "NEW"),
+                    help="diff the phase rollups of two BENCH_r*.json "
+                         "artifacts instead of probing")
+    rg.add_argument("--rounds", type=int, default=6,
+                    help="probe repetitions (post-warmup)")
+    rg.add_argument("--rows", type=int, default=1 << 18,
+                    help="probe dataset rows")
+    rg.add_argument("--noise", type=float, default=0.75,
+                    help="relative noise band: regress when live p50 "
+                         "> base p50 * (1 + noise) + abs-floor")
+    rg.add_argument("--abs-floor", type=float, default=0.05,
+                    help="absolute noise floor seconds")
+    rg.add_argument("--min-samples", type=int, default=3,
+                    help="ignore (class, phase) cells with fewer "
+                         "samples on either side")
+    rg.add_argument("-v", "--verbose", action="store_true",
+                    help="include every class in the report, not "
+                         "just _all")
     args = p.parse_args(argv)
     return {
         "info": cmd_info,
@@ -264,6 +381,7 @@ def main(argv=None) -> int:
         "trace": cmd_trace,
         "metrics": cmd_metrics,
         "route": cmd_route,
+        "regress": cmd_regress,
     }[args.cmd](args)
 
 

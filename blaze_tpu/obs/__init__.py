@@ -13,6 +13,10 @@ Three complementary surfaces over one serving process:
   history  per-fingerprint execution-time records (obs/history.py) -
            the estimate feeding predicted-unmeetability shedding and
            (ROADMAP) replica routing;
+  phases   per-phase duration rollup keyed by fingerprint class
+           (obs/phases.py) - the diffable form behind `python -m
+           blaze_tpu regress`, which catches queue-wait creep and
+           decode regressions invisible to e2e medians;
   slowlog  one structured JSON log line per over-threshold query
            (obs/slowlog.py).
 
@@ -24,6 +28,7 @@ has the span taxonomy and export formats.
 
 from blaze_tpu.obs.history import RuntimeHistory
 from blaze_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from blaze_tpu.obs.phases import ROLLUP, PhaseRollup
 from blaze_tpu.obs.trace import (
     TraceRecorder,
     begin_trace,
@@ -34,7 +39,9 @@ from blaze_tpu.obs.trace import (
 
 __all__ = [
     "REGISTRY",
+    "ROLLUP",
     "MetricsRegistry",
+    "PhaseRollup",
     "RuntimeHistory",
     "TraceRecorder",
     "begin_trace",

@@ -34,6 +34,8 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 from blaze_tpu.errors import ReplicaUnavailableError
+from blaze_tpu.obs import phases as obs_phases
+from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.metrics import REGISTRY, merge_expositions
 from blaze_tpu.router.failover import CircuitBreaker, failover_action
 from blaze_tpu.router.placement import (
@@ -48,18 +50,9 @@ from blaze_tpu.service.wire import (
     _ERR,
     _U32,
     _U64,
-    VERB_CANCEL,
     VERB_FETCH,
-    VERB_METRICS,
-    VERB_POLL,
-    VERB_REPORT,
-    VERB_STATS,
-    VERB_SUBMIT,
     ServiceError,
-    _read_str,
-    _read_u32,
     _send_err,
-    _send_json,
 )
 
 log = logging.getLogger("blaze_tpu.router")
@@ -83,7 +76,7 @@ class RoutedQuery:
         "meta", "replica_id", "internal_id", "fingerprint",
         "generation", "resubmits", "failovers", "finished",
         "cancelled", "last_state", "lock", "delivered_hashes",
-        "splice_broken",
+        "splice_broken", "tracer", "hop_span", "grafted",
     )
 
     def __init__(self, key: str, task_bytes: bytes, is_ref: bool,
@@ -105,6 +98,16 @@ class RoutedQuery:
         self.cancelled = False
         self.last_state: Optional[str] = None
         self.lock = threading.Lock()
+        # router-hop tracing (obs/trace.py): the ROUTER's own span
+        # tree for this query - placement ladder outcome, each
+        # submit/failover attempt, proxy streaming. hop_span is the
+        # current generation's successful router_attempt span: the
+        # graft point for the replica's span subtree on REPORT.
+        # `grafted` guards re-grafting the same downstream execution
+        # when REPORT is called twice.
+        self.tracer = None
+        self.hop_span = None
+        self.grafted: set = set()
         # canonical part-content record for FETCH: digest of every
         # part ever delivered to a client, so a re-fetch after
         # failover can PROVE the re-executed result is part-for-part
@@ -133,6 +136,7 @@ class Router:
         stats_stale_s: float = 10.0,
         downstream_timeout_s: float = 120.0,
         fetch_block_s: float = 0.5,
+        enable_trace: bool = True,
         start: bool = True,
     ):
         if placement not in ("affinity", "random"):
@@ -177,6 +181,11 @@ class Router:
         REGISTRY.register_collector(
             self._collector_key, self._collect_metrics
         )
+        # router-hop tracing: refcounted for the router's lifetime
+        # (same contract as QueryService); `route --no-trace` opts out
+        self._trace_enabled = bool(enable_trace)
+        if self._trace_enabled:
+            obs_trace.enable()
         self._closed = False
         if start:
             self.registry.start()
@@ -187,6 +196,8 @@ class Router:
             return
         self._closed = True
         REGISTRY.unregister_collector(self._collector_key)
+        if self._trace_enabled:
+            obs_trace.disable()
         self.registry.close()
         for rid, c in list(self._clients.items()):
             try:
@@ -297,6 +308,28 @@ class Router:
             r.note_unrouted()
         if state == "DONE" and rq.replica_id:
             self.breaker.note_ok(rq.replica_id)
+        if rq.tracer is not None:
+            # the finalization winner closes the router root span and
+            # folds this query's router overhead (placement ladder +
+            # submit hops, NOT downstream execution) into the
+            # per-phase rollup the regress CLI diffs
+            try:
+                rq.tracer.finish(
+                    state=state, replica=rq.replica_id,
+                    failovers=rq.failovers or None,
+                    resubmits=rq.resubmits or None,
+                )
+                overhead = obs_phases.fold_span_dicts(
+                    rq.tracer.to_dicts()
+                ).get("router")
+                if overhead is not None:
+                    obs_phases.ROLLUP.observe(
+                        "router", overhead,
+                        klass=obs_phases.class_key(rq.fingerprint),
+                    )
+            except Exception:  # noqa: BLE001 - obs must not raise
+                log.exception("router trace finish failed for %s",
+                              rq.external_id)
         return True
 
     def _rewrite(self, status: dict, rq: RoutedQuery) -> dict:
@@ -322,13 +355,23 @@ class Router:
         key = affinity_key(task_bytes, is_ref)
         rq = RoutedQuery(key, task_bytes, is_ref, manifest_bytes,
                          dict(meta))
+        if obs_trace.ACTIVE:
+            # the router's OWN span tree for this query: the tier the
+            # replica's trace cannot see (placement, failover, proxy
+            # streaming). REPORT grafts the replica subtree under the
+            # current hop span so `trace <qid>` through the router
+            # renders client->router->replica->worker as ONE document
+            rq.tracer = obs_trace.begin_trace(
+                rq.external_id, root_name="router_query"
+            )
+            rq.tracer.root.tag(key=key[:16],
+                               placement=self.placement_mode)
         try:
             resp = self._place_and_submit(rq, exclude=set())
         except ReplicaUnavailableError as e:
             with self._lock:
                 self.counters["no_replica"] += 1
-            rq.finished = True
-            rq.last_state = "REJECTED_OVERLOADED"
+            self._finish(rq, "REJECTED_OVERLOADED")
             self._register(rq)
             return {
                 "query_id": rq.external_id,
@@ -357,90 +400,139 @@ class Router:
         when nobody routable is left or everybody rejected."""
         attempts = len(self.registry.replicas) + 1
         rejected_err: Optional[str] = None
-        for _ in range(attempts):
-            decision = None
-            if same_replica is not None:
-                r = self.registry.get(same_replica)
-                if r is not None and r.routable():
-                    decision = PlacementDecision(r, "same")
-                same_replica = None  # only the first try is pinned
-            if decision is None:
-                if self.placement_mode == "random":
-                    decision = random_replica(
-                        self.registry, next(self._rr_seq),
-                        exclude=exclude,
-                    )
-                else:
-                    decision = choose_replica(
-                        self.registry, self.affinity, rq.key,
-                        estimated_bytes=rq.meta.get("estimated_bytes"),
-                        fingerprint=rq.fingerprint,
-                        stats_stale_s=self.stats_stale_s,
-                        exclude=exclude,
-                    )
-            if decision is None:
-                break
-            replica = decision.replica
-            meta = dict(rq.meta)
-            meta["detach"] = True  # the router owns session semantics
-            try:
-                resp = self._call(
-                    replica,
-                    lambda c: c.submit_raw(
-                        rq.task_bytes, meta=meta, is_ref=rq.is_ref,
-                        manifest_bytes=rq.manifest_bytes,
-                    ),
-                )
-            except (ConnectionError, OSError, ServiceError) as e:
-                log.warning("submit to %s failed (%r); trying next",
-                            replica.replica_id, e)
-                self.breaker.note_fatal(
-                    replica.replica_id, kind="transport"
-                )
-                exclude.add(replica.replica_id)
-                continue
-            if "query_id" not in resp:
-                # in-band replica error (protocol-level): surface
-                return resp
-            if resp.get("state") == "REJECTED_OVERLOADED":
-                log.info(
-                    "replica %s rejected %s (overloaded); spilling",
-                    replica.replica_id, rq.external_id,
-                )
-                with self._lock:
-                    self.counters["overflow_spills"] += 1
-                rejected_err = resp.get("error") or "queue full"
-                exclude.add(replica.replica_id)
-                continue
-            with rq.lock:
-                rq.replica_id = replica.replica_id
-                rq.internal_id = resp["query_id"]
-                rq.generation += 1
-                if resp.get("fingerprint"):
-                    rq.fingerprint = resp["fingerprint"]
-            replica.note_routed()
-            reason = f"placed_{decision.reason}" \
-                if decision.reason != "same" else None
-            with self._lock:
-                if reason in self.counters:
-                    self.counters[reason] += 1
-            if self.placement_mode == "affinity" and rq.fingerprint:
-                # stable-fingerprint plans stick: repeats land on the
-                # replica whose ResultCache will hold the result
-                self.affinity.record(
-                    rq.key, replica.replica_id, rq.fingerprint
-                )
-            return resp
-        if rejected_err is not None:
-            raise ReplicaUnavailableError(
-                "every routable replica rejected overloaded "
-                f"(last: {rejected_err})"
-            )
-        raise ReplicaUnavailableError(
-            "no routable replica "
-            f"(fleet={len(self.registry.replicas)}, "
-            f"excluded={len(exclude)})"
+        rec = rq.tracer
+        # one router_place span per placement pass (submit or
+        # failover move): the ladder walk, every per-replica
+        # router_attempt span nested under it, the chosen rung tagged
+        # on exit. The span exit auto-tags error_class when the walk
+        # raises ReplicaUnavailableError.
+        place_cm = (
+            obs_trace.span("router_place", rec=rec,
+                           mode=self.placement_mode,
+                           excluded=len(exclude))
+            if rec is not None and obs_trace.ACTIVE
+            else obs_trace.NULL
         )
+        with place_cm as place_sp:
+            for _ in range(attempts):
+                decision = None
+                if same_replica is not None:
+                    r = self.registry.get(same_replica)
+                    if r is not None and r.routable():
+                        decision = PlacementDecision(r, "same")
+                    same_replica = None  # only the first try is pinned
+                if decision is None:
+                    if self.placement_mode == "random":
+                        decision = random_replica(
+                            self.registry, next(self._rr_seq),
+                            exclude=exclude,
+                        )
+                    else:
+                        decision = choose_replica(
+                            self.registry, self.affinity, rq.key,
+                            estimated_bytes=rq.meta.get(
+                                "estimated_bytes"
+                            ),
+                            fingerprint=rq.fingerprint,
+                            stats_stale_s=self.stats_stale_s,
+                            exclude=exclude,
+                        )
+                if decision is None:
+                    break
+                replica = decision.replica
+                meta = dict(rq.meta)
+                meta["detach"] = True  # router owns session semantics
+                hop_cm = (
+                    obs_trace.span(
+                        "router_attempt", rec=rec,
+                        replica=replica.replica_id,
+                        rung=decision.reason,
+                        affinity_hit=decision.reason == "affinity",
+                    )
+                    if rec is not None and obs_trace.ACTIVE
+                    else obs_trace.NULL
+                )
+                with hop_cm as hop:
+                    try:
+                        resp = self._call(
+                            replica,
+                            lambda c: c.submit_raw(
+                                rq.task_bytes, meta=meta,
+                                is_ref=rq.is_ref,
+                                manifest_bytes=rq.manifest_bytes,
+                            ),
+                        )
+                    except (ConnectionError, OSError,
+                            ServiceError) as e:
+                        log.warning(
+                            "submit to %s failed (%r); trying next",
+                            replica.replica_id, e,
+                        )
+                        hop.tag(transport_error=type(e).__name__,
+                                error_class="TRANSIENT")
+                        self.breaker.note_fatal(
+                            replica.replica_id, kind="transport"
+                        )
+                        exclude.add(replica.replica_id)
+                        continue
+                    if "query_id" not in resp:
+                        # in-band replica error (protocol): surface
+                        hop.tag(inband_error=True)
+                        return resp
+                    if resp.get("state") == "REJECTED_OVERLOADED":
+                        log.info(
+                            "replica %s rejected %s (overloaded); "
+                            "spilling",
+                            replica.replica_id, rq.external_id,
+                        )
+                        hop.tag(overflow_spill=True)
+                        place_sp.event(
+                            "overflow_spill",
+                            replica=replica.replica_id,
+                        )
+                        with self._lock:
+                            self.counters["overflow_spills"] += 1
+                        rejected_err = resp.get("error") \
+                            or "queue full"
+                        exclude.add(replica.replica_id)
+                        continue
+                    hop.tag(internal_id=resp["query_id"])
+                    with rq.lock:
+                        rq.replica_id = replica.replica_id
+                        rq.internal_id = resp["query_id"]
+                        rq.generation += 1
+                        if resp.get("fingerprint"):
+                            rq.fingerprint = resp["fingerprint"]
+                        if isinstance(hop, obs_trace.Span):
+                            # the graft point for this generation's
+                            # replica subtree (REPORT)
+                            rq.hop_span = hop
+                replica.note_routed()
+                place_sp.tag(rung=decision.reason,
+                             replica=replica.replica_id)
+                reason = f"placed_{decision.reason}" \
+                    if decision.reason != "same" else None
+                with self._lock:
+                    if reason in self.counters:
+                        self.counters[reason] += 1
+                if self.placement_mode == "affinity" \
+                        and rq.fingerprint:
+                    # stable-fingerprint plans stick: repeats land on
+                    # the replica whose ResultCache holds the result
+                    self.affinity.record(
+                        rq.key, replica.replica_id, rq.fingerprint
+                    )
+                return resp
+            if rejected_err is not None:
+                raise ReplicaUnavailableError(
+                    "every routable replica rejected overloaded "
+                    f"(last: {rejected_err})"
+                )
+            raise ReplicaUnavailableError(
+                "no routable replica "
+                f"(fleet={len(self.registry.replicas)}, "
+                f"excluded={len(exclude)})"
+            )
 
     # -- failover moves --------------------------------------------------
     def _resubmit(self, rq: RoutedQuery, observed_gen: int, *,
@@ -520,6 +612,11 @@ class Router:
             rq.failovers += 1
         else:
             rq.resubmits += 1
+        if rq.tracer is not None:
+            # the move lands as a root-span event (the per-attempt
+            # router_attempt spans carry the detail)
+            rq.tracer.event("router_move", kind=counter,
+                            replica=rq.replica_id)
         return True
 
     def _cancel_superseded(self, replica: Replica,
@@ -721,25 +818,59 @@ class Router:
             # never placed (REJECTED_OVERLOADED at submit): answer
             # from the routing table like poll() does - the router
             # issued this handle, so it must not report it unknown
-            return {
+            out = {
                 "query_id": rq.external_id,
                 "replica": None,
                 "state": rq.last_state or "REJECTED_OVERLOADED",
                 "report": "never placed: no routable replica",
             }
-        replica = self.registry.get(rq.replica_id or "")
+            if flags & 1 and rq.tracer is not None:
+                out["trace"] = obs_trace.chrome_trace(rq.tracer)
+            if flags & 2 and rq.tracer is not None:
+                out["trace_spans"] = rq.tracer.to_dicts()
+            return out
+        rec = rq.tracer
+        # the router honors BOTH report flag bits, exactly like a
+        # single serve instance (the shared verb loop's protocol
+        # symmetry): bit 0 = rendered Chrome doc, bit 1 = raw span
+        # dicts - so a router can itself sit behind another router's
+        # cross-hop graft
+        want_doc = bool(flags & 1)
+        want_spans = bool(flags & 2)
+        # snapshot the generation under the lock BEFORE the RPC: a
+        # failover racing this REPORT swaps replica_id/internal_id/
+        # hop_span, and grafting the OLD generation's spans under the
+        # NEW hop span (or marking the new id grafted with the old
+        # subtree) would permanently wedge the trace
+        with rq.lock:
+            internal_id = rq.internal_id
+            anchor = rq.hop_span
+            replica_id = rq.replica_id
+        replica = self.registry.get(replica_id or "")
         if replica is None:
             raise KeyError(f"unknown replica for {external_id}")
         try:
-            if flags & 1:
+            if want_doc or want_spans:
+                # cross-hop trace: when the router recorded its own
+                # span tree, ask the replica for RAW span dicts
+                # (flags bit 1) and graft them under the current hop
+                # span - ONE Perfetto document spanning client ->
+                # router -> replica -> worker. Routers without a
+                # recorder (route --no-trace) pass the replica's
+                # rendered document / raw spans through untouched.
                 resp = self._call(
-                    replica, lambda c: c.report_full(rq.internal_id)
+                    replica,
+                    lambda c: c.report_full(
+                        internal_id,
+                        include_trace=want_doc and rec is None,
+                        include_spans=want_spans or rec is not None,
+                    ),
                 )
                 if "error" in resp and "report" not in resp:
                     resp = None  # replica lost the handle (restarted)
             else:
                 resp = {"report": self._call(
-                    replica, lambda c: c.report(rq.internal_id)
+                    replica, lambda c: c.report(internal_id)
                 )}
         except (ConnectionError, OSError, ServiceError, KeyError):
             # unreachable replica, or one that restarted and lost the
@@ -751,13 +882,41 @@ class Router:
             # replica-side lookup miss as an opaque "unknown query"
             # error - report what the routing table knows, the same
             # way poll() answers for finalized queries
-            return {
+            out = {
                 "query_id": rq.external_id,
                 "replica": rq.replica_id,
                 "state": rq.last_state,
                 "report": "replica no longer holds the handle; "
                           "state is the router's last observation",
             }
+            if want_doc and rec is not None:
+                # the router-side spans survive the replica's death
+                out["trace"] = obs_trace.chrome_trace(rec)
+            if want_spans and rec is not None:
+                out["trace_spans"] = rec.to_dicts()
+            return out
+        if (want_doc or want_spans) and rec is not None:
+            spans = resp.pop("trace_spans", None)
+            if spans:
+                with rq.lock:
+                    # keyed + anchored on the PRE-RPC snapshot: the
+                    # fetched spans belong to THAT generation, and a
+                    # failover that moved the query mid-RPC must not
+                    # see its fresh internal_id marked grafted
+                    fresh = internal_id not in rq.grafted
+                    if fresh:
+                        rq.grafted.add(internal_id)
+                if fresh:
+                    # id-remapped graft (obs/trace.attach_subtree):
+                    # the replica's root re-parents under the hop
+                    # span that submitted this generation
+                    rec.attach_subtree(spans, parent=anchor)
+            if want_doc:
+                resp["trace"] = obs_trace.chrome_trace(rec)
+            if want_spans:
+                # the GRAFTED tree: an upstream router re-grafts the
+                # whole client->router->replica subtree in one piece
+                resp["trace_spans"] = rec.to_dicts()
         resp["query_id"] = rq.external_id
         resp["replica"] = rq.replica_id
         return resp
@@ -806,6 +965,9 @@ class Router:
             },
             "replicas": self.registry.snapshot(),
             "fleet": fleet,
+            # this process's per-phase rollup (the `router` phase for
+            # proxied queries; regress can diff a live router too)
+            "phases": obs_phases.ROLLUP.snapshot(max_classes=6),
         }
 
     def metrics(self) -> str:
@@ -827,16 +989,34 @@ class Router:
                 with ServiceClient(r.host, r.port, timeout=5.0,
                                    reconnect_attempts=0) as c:
                     per_replica[rid] = c.metrics()
-            except Exception:  # noqa: BLE001 - best-effort scrape
-                pass
+            except Exception:  # noqa: BLE001 - counted, not raised
+                # a quarantined (or just-wedged) replica silently
+                # vanishing from the merged exposition looks exactly
+                # like it was never configured - count the failure
+                # with the replica label so dashboards see the GAP,
+                # not just the absence
+                REGISTRY.inc("blaze_router_scrape_failed",
+                             replica=rid)
 
-        threads = [
-            threading.Thread(target=scrape, args=(rid, r),
-                             daemon=True,
-                             name=f"blaze-router-scrape-{rid}")
-            for rid, r in self.registry.replicas.items()
-            if r.alive
-        ]
+        # heartbeat-DEAD replicas are counted failed WITHOUT a
+        # connect attempt: the pollers already know nothing answers,
+        # and a black-holed host would otherwise add its full connect
+        # timeout to every fleet scrape. Quarantined-but-alive
+        # replicas (breaker-open) still answer METRICS and are
+        # scraped normally.
+        threads = []
+        for rid, r in self.registry.replicas.items():
+            if not r.ever_alive:
+                continue
+            if not r.alive:
+                REGISTRY.inc("blaze_router_scrape_failed",
+                             replica=rid)
+                continue
+            threads.append(
+                threading.Thread(target=scrape, args=(rid, r),
+                                 daemon=True,
+                                 name=f"blaze-router-scrape-{rid}")
+            )
         for t in threads:
             t.start()
         for t in threads:
@@ -868,82 +1048,105 @@ class Router:
         cycles = 0
         max_cycles = 3 + self.max_resubmits \
             + len(self.registry.replicas)
-        while True:
-            gen = rq.generation
-            replica = self.registry.get(rq.replica_id or "")
-            if replica is None:
-                raise ServiceError(
-                    f"UNKNOWN: no replica for {external_id}"
-                )
-            try:
-                for i, payload in enumerate(self._raw_fetch(
-                    replica, rq.internal_id, timeout_ms
-                )):
-                    # verify against (or extend) the canonical part
-                    # record: parts the client already received - from
-                    # this stream or a previous aborted one - must be
-                    # byte-identical in a re-executed result, or the
-                    # client's count-based resume would splice two
-                    # different results into one corrupt table
-                    h = hashlib.blake2b(
-                        payload, digest_size=16
-                    ).digest()
-                    with rq.lock:
-                        if i < len(rq.delivered_hashes):
-                            if rq.delivered_hashes[i] != h:
-                                rq.splice_broken = True
-                        else:
-                            rq.delivered_hashes.append(h)
+        stream_t0 = time.monotonic()
+        completed = False
+        try:
+            while True:
+                gen = rq.generation
+                replica = self.registry.get(rq.replica_id or "")
+                if replica is None:
+                    raise ServiceError(
+                        f"UNKNOWN: no replica for {external_id}"
+                    )
+                try:
+                    for i, payload in enumerate(self._raw_fetch(
+                        replica, rq.internal_id, timeout_ms
+                    )):
+                        # verify against (or extend) the canonical part
+                        # record: parts the client already received - from
+                        # this stream or a previous aborted one - must be
+                        # byte-identical in a re-executed result, or the
+                        # client's count-based resume would splice two
+                        # different results into one corrupt table
+                        h = hashlib.blake2b(
+                            payload, digest_size=16
+                        ).digest()
+                        with rq.lock:
+                            if i < len(rq.delivered_hashes):
+                                if rq.delivered_hashes[i] != h:
+                                    rq.splice_broken = True
+                            else:
+                                rq.delivered_hashes.append(h)
+                        if rq.splice_broken:
+                            raise ServiceError(_SPLICE_ERR)
+                        if i < sent:
+                            continue  # already delivered on this stream
+                        sent += 1
+                        yield payload
+                    completed = True
+                    self._finish(rq, "DONE")
+                    return
+                except ServiceError as e:
                     if rq.splice_broken:
-                        raise ServiceError(_SPLICE_ERR)
-                    if i < sent:
-                        continue  # already delivered on this stream
-                    sent += 1
-                    yield payload
-                self._finish(rq, "DONE")
-                return
-            except ServiceError as e:
-                if rq.splice_broken:
-                    self._finish(rq, "FAILED")
-                    raise
-                cycles += 1
-                if cycles > max_cycles:
-                    raise
-                if e.state == "FAILED":
-                    st = self._downstream_status(rq)
-                    if st.get("state") == "FAILED" and not rq.finished:
-                        # same guard as poll(): a re-FETCH of an
-                        # already-finalized failure must not land a
-                        # second breaker strike for the same event
-                        st = self._observe_failed(rq, st)
-                    if st.get("state") == "FAILED" or rq.finished:
-                        self._finish(rq, st.get("state"))
+                        self._finish(rq, "FAILED")
                         raise
-                    continue  # re-routed or retrying: fetch again
-                if e.state == "UNKNOWN":
-                    if self._resubmit(rq, gen, same_replica=False,
-                                      exclude=set(),
-                                      counter="failovers"):
-                        continue
-                raise
-            except (ConnectionError, OSError) as e:
-                cycles += 1
-                if cycles > max_cycles:
+                    cycles += 1
+                    if cycles > max_cycles:
+                        raise
+                    if e.state == "FAILED":
+                        st = self._downstream_status(rq)
+                        if st.get("state") == "FAILED" and not rq.finished:
+                            # same guard as poll(): a re-FETCH of an
+                            # already-finalized failure must not land a
+                            # second breaker strike for the same event
+                            st = self._observe_failed(rq, st)
+                        if st.get("state") == "FAILED" or rq.finished:
+                            self._finish(rq, st.get("state"))
+                            raise
+                        continue  # re-routed or retrying: fetch again
+                    if e.state == "UNKNOWN":
+                        if self._resubmit(rq, gen, same_replica=False,
+                                          exclude=set(),
+                                          counter="failovers"):
+                            continue
                     raise
-                if rq.generation != gen:
-                    continue  # death callback already moved it
-                self.breaker.note_fatal(
-                    replica.replica_id, kind="transport"
-                )
-                if replica.routable():
-                    continue  # transient drop: re-FETCH same replica
-                if not self._resubmit(rq, gen, same_replica=False,
-                                      exclude={replica.replica_id},
-                                      counter="failovers"):
-                    raise ReplicaUnavailableError(
-                        f"replica {replica.replica_id} lost "
-                        f"mid-FETCH of {external_id}: {e!r}"
-                    ) from e
+                except (ConnectionError, OSError) as e:
+                    cycles += 1
+                    if cycles > max_cycles:
+                        raise
+                    if rq.generation != gen:
+                        continue  # death callback already moved it
+                    self.breaker.note_fatal(
+                        replica.replica_id, kind="transport"
+                    )
+                    if replica.routable():
+                        continue  # transient drop: re-FETCH same replica
+                    if not self._resubmit(rq, gen, same_replica=False,
+                                          exclude={replica.replica_id},
+                                          counter="failovers"):
+                        raise ReplicaUnavailableError(
+                            f"replica {replica.replica_id} lost "
+                            f"mid-FETCH of {external_id}: {e!r}"
+                        ) from e
+        finally:
+            if rq.tracer is not None:
+                # retroactive proxy-streaming span (a live span would
+                # straddle generator suspensions): parts actually
+                # forwarded + resume cycles; aborted streams (client
+                # gone, fleet lost) are tagged - the re-FETCH records
+                # its own span
+                tags = {"parts": sent}
+                if cycles:
+                    tags["resumes"] = cycles
+                if not completed:
+                    tags["aborted"] = True
+                try:
+                    rq.tracer.record_span(
+                        "router_stream", stream_t0,
+                        time.monotonic(), **tags,
+                    )
+                except Exception:  # noqa: BLE001 - obs must not raise
+                    pass
 
     def _raw_fetch(self, replica: Replica, internal_id: str,
                    timeout_ms: int) -> Iterator[bytes]:
@@ -1030,117 +1233,88 @@ class Router:
 # ---------------------------------------------------------------------------
 
 
-def handle_router_connection(sock, router: Router) -> None:
-    """Drive one client connection against the router - the same verb
-    loop as service/wire.handle_service_connection, with the router's
-    routing table behind every verb. Non-detached queries submitted on
-    this connection are cancelled (on their replicas) when the client
-    vanishes."""
-    from blaze_tpu.runtime.transport import _recv_exact
+class RouterVerbBackend:
+    """The Router behind the shared verb loop
+    (service/wire.serve_verb_connection): the same protocol skeleton
+    as a single serve instance with the routing table behind every
+    verb. Non-detached queries submitted on a connection are cancelled
+    (on their replicas) when the client vanishes."""
 
-    session_qids: List[str] = []
-    try:
-        while True:
-            try:
-                verb = _recv_exact(sock, 1)[0]
-            except (ConnectionError, OSError):
-                return
-            try:
-                if verb == VERB_SUBMIT:
-                    _handle_router_submit(sock, router, session_qids)
-                elif verb == VERB_POLL:
-                    qid = _read_str(sock)
-                    _read_u32(sock)
-                    _send_json(sock, router.poll(qid))
-                elif verb == VERB_FETCH:
-                    _handle_router_fetch(sock, router)
-                elif verb == VERB_CANCEL:
-                    qid = _read_str(sock)
-                    _read_u32(sock)
-                    _send_json(sock, router.cancel(qid))
-                elif verb == VERB_REPORT:
-                    qid = _read_str(sock)
-                    flags = _read_u32(sock)
-                    _send_json(sock, router.report(qid, flags))
-                elif verb == VERB_STATS:
-                    _read_u32(sock)
-                    _send_json(sock, router.stats())
-                elif verb == VERB_METRICS:
-                    _read_u32(sock)
-                    _send_json(sock, {"metrics": router.metrics()})
-                else:
-                    raise ValueError(f"unknown service verb {verb}")
-            except (ConnectionError, BrokenPipeError, OSError):
-                return
-            except ValueError as e:
-                try:
-                    _send_json(
-                        sock,
-                        {"error": f"protocol error: {e}"[:65536],
-                         "fatal": True},
-                    )
-                except OSError:
-                    pass
-                return
-            except KeyError as e:
-                _send_json(sock, {"error": f"unknown query: {e}"})
-            except Exception as e:  # noqa: BLE001 - reported in-band
-                _send_json(
-                    sock,
-                    {"error": f"{type(e).__name__}: {e}"[:65536]},
+    def __init__(self, router: Router):
+        self.router = router
+
+    def submit(self, meta: dict, task_bytes: bytes, is_ref: bool,
+               manifest_bytes: Optional[bytes]) -> dict:
+        return self.router.submit(
+            meta, task_bytes, is_ref=is_ref,
+            manifest_bytes=manifest_bytes,
+        )
+
+    def poll(self, qid: str) -> dict:
+        return self.router.poll(qid)
+
+    def cancel(self, qid: str) -> dict:
+        return self.router.cancel(qid)
+
+    def report_frame(self, qid: str, flags: int) -> dict:
+        return self.router.report(qid, flags)
+
+    def stats(self) -> dict:
+        return self.router.stats()
+
+    def metrics_frame(self) -> dict:
+        return {"metrics": self.router.metrics()}
+
+    def abandon(self, qid: str) -> None:
+        try:
+            rq = self.router.get(qid)
+        except KeyError:
+            return
+        if not rq.finished:
+            self.router.cancel(qid)
+
+    def fetch(self, sock, qid: str, timeout_ms: int) -> None:
+        router = self.router
+        sent = 0
+        try:
+            for payload in router.stream_parts(qid, timeout_ms):
+                sock.sendall(_U64.pack(len(payload)) + payload)
+                sent += 1
+            sock.sendall(_U64.pack(0))
+        except KeyError:
+            if sent:
+                raise ConnectionError(
+                    "fetch aborted after parts sent"
                 )
-    finally:
-        for qid in session_qids:
-            try:
-                rq = router.get(qid)
-                if not rq.finished:
-                    router.cancel(qid)
-            except Exception:  # noqa: BLE001 - teardown best-effort
-                pass
+            _send_err(sock, f"UNKNOWN: no query {qid}")
+        except (ServiceError, ReplicaUnavailableError) as e:
+            if sent:
+                # parts are on the wire: a JSON/ERR frame would
+                # desync the client - abort the connection (its
+                # reconnect re-FETCHes)
+                raise ConnectionError(
+                    f"fetch stream aborted: {e!r}"
+                ) from e
+            msg = str(e)
+            if isinstance(e, ReplicaUnavailableError):
+                # ERR frames carry "STATE: detail"
+                # (ServiceError.state splits on the first colon) -
+                # raw text here would parse to a garbage state like
+                # "replica 127.0.0.1". Stamp the router's
+                # fleet-unavailable convention (same as the submit
+                # path: retry with backoff once capacity returns)
+                msg = f"REJECTED_OVERLOADED: {msg}"
+            _send_err(sock, msg)
 
 
-def _handle_router_submit(sock, router: Router,
-                          session_qids: List[str]) -> None:
-    from blaze_tpu.service.wire import decode_submit_frame
+def handle_router_connection(sock, router: Router) -> None:
+    """Drive one client connection against the router through the
+    SHARED table-driven verb loop (service/wire.py) - one skeleton for
+    both protocol speakers, so framing and error handling cannot drift
+    between tiers."""
+    from blaze_tpu.service.wire import serve_verb_connection
 
-    meta, blob, is_ref, manifest_bytes = decode_submit_frame(sock)
-    resp = router.submit(
-        meta, blob, is_ref=is_ref, manifest_bytes=manifest_bytes
-    )
-    if not meta.get("detach") and "query_id" in resp:
-        session_qids.append(resp["query_id"])
-    _send_json(sock, resp)
-
-
-def _handle_router_fetch(sock, router: Router) -> None:
-    qid = _read_str(sock)
-    timeout_ms = _read_u32(sock)
-    sent = 0
-    try:
-        for payload in router.stream_parts(qid, timeout_ms):
-            sock.sendall(_U64.pack(len(payload)) + payload)
-            sent += 1
-        sock.sendall(_U64.pack(0))
-    except KeyError:
-        if sent:
-            raise ConnectionError("fetch aborted after parts sent")
-        _send_err(sock, f"UNKNOWN: no query {qid}")
-    except (ServiceError, ReplicaUnavailableError) as e:
-        if sent:
-            # parts are on the wire: a JSON/ERR frame would desync the
-            # client - abort the connection (its reconnect re-FETCHes)
-            raise ConnectionError(
-                f"fetch stream aborted: {e!r}"
-            ) from e
-        msg = str(e)
-        if isinstance(e, ReplicaUnavailableError):
-            # ERR frames carry "STATE: detail" (ServiceError.state
-            # splits on the first colon) - raw text here would parse
-            # to a garbage state like "replica 127.0.0.1". Stamp the
-            # router's fleet-unavailable convention (same as the
-            # submit path: retry with backoff once capacity returns)
-            msg = f"REJECTED_OVERLOADED: {msg}"
-        _send_err(sock, msg)
+    serve_verb_connection(sock, RouterVerbBackend(router))
 
 
 class _RouterHandler(socketserver.BaseRequestHandler):

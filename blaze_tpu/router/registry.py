@@ -147,13 +147,15 @@ def parse_replica(spec) -> Tuple[str, int]:
 
 
 class ReplicaRegistry:
-    """Membership + health, fed by a background STATS-poll loop.
+    """Membership + health, fed by PERSISTENT per-replica pollers.
 
-    `poll_now()` runs one synchronous poll round (tests and the CLI's
-    startup probe use it); the background thread does the same thing
-    every `poll_interval_s`. Death and revival fire the registered
-    callbacks exactly once per transition - the router uses on_dead to
-    re-route a dead replica's in-flight queries."""
+    `start()` spawns one long-lived poller thread per replica, each
+    polling STATS every `poll_interval_s` (per-poll latency lands in
+    the `blaze_router_poll_round_seconds{replica=...}` histogram);
+    `poll_now()` runs one synchronous round for tests and the CLI's
+    startup probe. Death and revival fire the registered callbacks
+    exactly once per transition - the router uses on_dead to re-route
+    a dead replica's in-flight queries."""
 
     def __init__(
         self,
@@ -185,7 +187,7 @@ class ReplicaRegistry:
         self.on_revive = on_revive
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self._collector_key = f"router-registry:{id(self):x}"
         REGISTRY.register_collector(
             self._collector_key, self._collect_metrics
@@ -193,19 +195,30 @@ class ReplicaRegistry:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaRegistry":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._poll_loop, daemon=True,
-                name="blaze-router-poll",
-            )
-            self._thread.start()
+        """Spawn one PERSISTENT poller thread per replica. The old
+        shape - a coordinator spawning a fresh thread per replica per
+        0.5s round - cost a thread create/join cycle per replica per
+        round forever, and is the wrong substrate for dynamic
+        membership (ROADMAP item 4): with per-replica pollers, a
+        joining replica is one new thread and a leaving one is one
+        stopped thread, no round choreography."""
+        if not self._threads:
+            self._threads = [
+                threading.Thread(
+                    target=self._poller_loop, args=(r,), daemon=True,
+                    name=f"blaze-router-poll-{r.replica_id}",
+                )
+                for r in self.replicas.values()
+            ]
+            for t in self._threads:
+                t.start()
         return self
 
     def close(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
         REGISTRY.unregister_collector(self._collector_key)
         for r in self.replicas.values():
             c, r._client = r._client, None
@@ -216,19 +229,30 @@ class ReplicaRegistry:
                     pass
 
     # -- polling ---------------------------------------------------------
-    def _poll_loop(self) -> None:
+    def _poller_loop(self, r: Replica) -> None:
+        """One replica's long-lived poller: independent cadences mean
+        a black-holing host delays only ITS OWN snapshot - healthy
+        replicas keep their freshness and death-detection latency no
+        matter how many peers are wedged."""
         while not self._stop.wait(self.poll_interval_s):
+            t0 = time.monotonic()
             try:
-                self.poll_now()
+                self._poll_one(r)
             except Exception:  # noqa: BLE001 - the loop must survive
-                log.exception("replica poll round failed")
+                log.exception("poll of %s failed", r.replica_id)
+            REGISTRY.observe(
+                "blaze_router_poll_round_seconds",
+                time.monotonic() - t0, replica=r.replica_id,
+            )
 
     def poll_now(self) -> None:
-        """One synchronous STATS round across the fleet. Replicas are
-        polled CONCURRENTLY: a black-holing host costs the round one
-        connect timeout, not one per wedged replica - with sequential
-        polls, two wedged hosts would age every healthy snapshot past
-        the staleness bound and delay death detection fleet-wide."""
+        """One synchronous STATS round across the fleet - the manual
+        probe (tests, the CLI's startup check). The recurring path is
+        the per-replica poller threads (`start()`); rounds against one
+        replica serialize on its `_poll_lock`, so a manual round
+        during background polling never crosses frames. Replicas are
+        polled concurrently: a black-holing host costs the round one
+        connect timeout, not one per wedged replica."""
         reps = list(self.replicas.values())
         if len(reps) <= 1:
             for r in reps:
@@ -237,7 +261,7 @@ class ReplicaRegistry:
         threads = [
             threading.Thread(
                 target=self._poll_one, args=(r,), daemon=True,
-                name=f"blaze-router-poll-{r.replica_id}",
+                name=f"blaze-router-probe-{r.replica_id}",
             )
             for r in reps
         ]

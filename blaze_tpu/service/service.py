@@ -34,6 +34,7 @@ import time
 from typing import Dict, List, Optional
 
 from blaze_tpu.errors import ErrorClass, classify, retry_action
+from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import slowlog
 from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.history import RuntimeHistory
@@ -74,6 +75,7 @@ class QueryService:
         enable_trace: bool = True,
         slow_query_s: Optional[float] = None,
         history: Optional[RuntimeHistory] = None,
+        fold_phases: bool = True,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -112,6 +114,11 @@ class QueryService:
                 )
                 slow_query_s = 5.0
         self.slow_query_s = float(slow_query_s)
+        # fold_phases=False keeps this instance out of the process
+        # rollup: the regress probe runs a synthetic workload inside
+        # what may be a LIVE serving process, and its samples must
+        # not skew the production STATS `phases` payload
+        self._fold_phases = bool(fold_phases)
         self.obs_counters = {
             "degraded_queries": 0,
             "retried_queries": 0,
@@ -367,6 +374,8 @@ class QueryService:
                 **self.obs_counters,
             },
             "runtime_history": self.history.summary(),
+            # per-phase rollup (bounded classes; regress CLI diffs it)
+            "phases": obs_phases.ROLLUP.snapshot(max_classes=6),
             "quarantine": {
                 # cluster drivers in this process record quarantines
                 # on the shared registry (runtime/cluster.py)
@@ -392,6 +401,17 @@ class QueryService:
         q = self.get(query_id)
         rec = q.tracer or obs_trace.get_trace(query_id)
         return obs_trace.chrome_trace(rec) if rec is not None else None
+
+    def trace_spans(self, query_id: str) -> Optional[list]:
+        """One query's RAW span dicts (TraceRecorder.to_dicts), or
+        None when tracing was off for it. The replica router's REPORT
+        path requests these (flags bit 1) instead of the rendered
+        Chrome document so it can graft the subtree into its OWN
+        recorder via attach_subtree - re-parsing an exported trace
+        back into spans would lose ids and parent links."""
+        q = self.get(query_id)
+        rec = q.tracer or obs_trace.get_trace(query_id)
+        return rec.to_dicts() if rec is not None else None
 
     # -- observability hooks -------------------------------------------
     def _on_query_terminal(self, q: Query) -> None:
@@ -428,6 +448,15 @@ class QueryService:
         if slow:
             REGISTRY.inc("blaze_slow_queries_total")
             slowlog.emit(q, self.slow_query_s)
+        # per-phase rollup (obs/phases.py): fold the finished query's
+        # lifecycle timings + span tree into the duration rings the
+        # regress CLI diffs - terminal-hook time, never the hot path
+        if self._fold_phases:
+            try:
+                obs_phases.ROLLUP.fold_query(q)
+            except Exception:  # noqa: BLE001 - obs must not raise
+                log.exception("phase rollup fold failed for %s",
+                              q.query_id)
 
     def _collect_metrics(self):
         """Scrape-time samples for the process registry (METRICS verb):

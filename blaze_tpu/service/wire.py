@@ -25,10 +25,14 @@ Service framing (all integers LE):
             -> else: u64 ERR | u32 len | "STATE: detail" utf8
   CANCEL:   u32 id_len | id   -> JSON frame
   REPORT:   u32 id_len | id | u32 flags -> JSON frame {report: text,
-            trace?: Chrome-trace-event JSON} - `trace` included only
-            when flags bit 0 is set AND tracing was on for the query
-            (obs/trace.py); it is the Perfetto-loadable document
-            `python -m blaze_tpu trace` writes out
+            trace?: Chrome-trace-event JSON, trace_spans?: [span
+            dicts]} - `trace` included only when flags bit 0 is set
+            AND tracing was on for the query (obs/trace.py); it is
+            the Perfetto-loadable document `python -m blaze_tpu
+            trace` writes out. flags bit 1 requests the RAW span
+            dicts (TraceRecorder.to_dicts) instead: the replica
+            router grafts those into its own recorder
+            (attach_subtree) to render ONE cross-hop trace
   STATS:    u32 0             -> JSON frame (service stats: admission
             headroom/queue depth, cache counters, degradation +
             quarantine counts, runtime-history summary)
@@ -89,13 +93,43 @@ class ServiceError(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# server side
+# server side: ONE table-driven verb loop for both tiers
 # ---------------------------------------------------------------------------
+#
+# The replica router re-implemented this loop's whole skeleton (verb
+# decode, framing, the error-handling ladder, session teardown) with
+# only the object behind the verbs changed. Factoring the skeleton
+# around a small backend surface keeps the two protocol speakers
+# byte-identical by construction - the same reason decode_submit_frame
+# is shared. A backend provides:
+#
+#   submit(meta, task_bytes, is_ref, manifest_bytes) -> status dict
+#   poll(qid) / cancel(qid) -> status dict
+#   report_frame(qid, flags) -> REPORT response dict
+#   stats() / metrics_frame() -> response dict
+#   fetch(sock, qid, timeout_ms)   owns its own framing (part stream)
+#   abandon(qid)                   session teardown for one query
 
 
-def handle_service_connection(sock, service) -> None:
-    """Drive one service connection until EOF. Called from the gateway
-    handler after it consumed the hello header."""
+# POLL/CANCEL/REPORT share one frame shape: u32 id_len | id | u32
+_ID_VERBS = {
+    VERB_POLL: lambda b, qid, flags: b.poll(qid),
+    VERB_CANCEL: lambda b, qid, flags: b.cancel(qid),
+    VERB_REPORT: lambda b, qid, flags: b.report_frame(qid, flags),
+}
+# STATS/METRICS share the bare u32-reserved frame
+_NOARG_VERBS = {
+    VERB_STATS: lambda b: b.stats(),
+    VERB_METRICS: lambda b: b.metrics_frame(),
+}
+
+
+def serve_verb_connection(sock, backend) -> None:
+    """Drive one service-protocol connection until EOF against any
+    verb backend (the QueryService adapter below, or the router's).
+    Owns the shared skeleton: verb dispatch, the error-handling ladder
+    (protocol violations close, id misses report in-band), and
+    cancel-on-disconnect session teardown."""
     from blaze_tpu.runtime.transport import _recv_exact
 
     session_qids: List[str] = []
@@ -107,42 +141,32 @@ def handle_service_connection(sock, service) -> None:
                 return  # clean EOF / client gone
             try:
                 if verb == VERB_SUBMIT:
-                    _handle_submit(sock, service, session_qids)
-                elif verb == VERB_POLL:
-                    qid = _read_str(sock)
-                    _read_u32(sock)  # reserved (always 0)
-                    _send_json(sock, service.poll(qid))
+                    meta, blob, is_ref, manifest_bytes = (
+                        decode_submit_frame(sock)
+                    )
+                    resp = backend.submit(
+                        meta, blob, is_ref, manifest_bytes
+                    )
+                    if not meta.get("detach") \
+                            and "query_id" in resp:
+                        # attached (default): cancel-on-disconnect
+                        # session semantics; detached queries survive
+                        # connection loss for re-attach
+                        session_qids.append(resp["query_id"])
+                    _send_json(sock, resp)
                 elif verb == VERB_FETCH:
-                    _handle_fetch(sock, service)
-                elif verb == VERB_CANCEL:
                     qid = _read_str(sock)
-                    _read_u32(sock)
-                    _send_json(sock, service.cancel(qid))
-                elif verb == VERB_REPORT:
+                    timeout_ms = _read_u32(sock)
+                    backend.fetch(sock, qid, timeout_ms)
+                elif verb in _ID_VERBS:
                     qid = _read_str(sock)
                     flags = _read_u32(sock)
-                    resp = {"report": service.report(qid)}
-                    # trace is OPT-IN (flags bit 0): serializing a
-                    # multi-MB span tree on every text-report poll
-                    # would tax exactly the hot path observability
-                    # must not
-                    trace_of = getattr(service, "trace", None)
-                    if flags & 1 and trace_of is not None:
-                        doc = trace_of(qid)
-                        if doc is not None:
-                            resp["trace"] = doc
-                    _send_json(sock, resp)
-                elif verb == VERB_STATS:
-                    _read_u32(sock)
-                    _send_json(sock, service.stats())
-                elif verb == VERB_METRICS:
-                    _read_u32(sock)
-                    from blaze_tpu.obs.metrics import REGISTRY
-
                     _send_json(
-                        sock,
-                        {"metrics": REGISTRY.render_prometheus()},
+                        sock, _ID_VERBS[verb](backend, qid, flags)
                     )
+                elif verb in _NOARG_VERBS:
+                    _read_u32(sock)
+                    _send_json(sock, _NOARG_VERBS[verb](backend))
                 else:
                     raise ValueError(f"unknown service verb {verb}")
             except (ConnectionError, BrokenPipeError, OSError):
@@ -175,92 +199,160 @@ def handle_service_connection(sock, service) -> None:
         # must not keep occupying the queue or the device
         for qid in session_qids:
             try:
-                q = service.get(qid)
-                if not q.done:
-                    service.cancel(qid)
-            except KeyError:
+                backend.abandon(qid)
+            except Exception:  # noqa: BLE001 - teardown best-effort
                 pass
 
 
-def _handle_submit(sock, service, session_qids: List[str]) -> None:
-    from blaze_tpu.runtime.gateway import _manifest_resources
+class ServiceVerbBackend:
+    """The QueryService behind the shared verb loop."""
 
-    meta, blob, is_ref, manifest_bytes = decode_submit_frame(sock)
-    resources = {}
-    if manifest_bytes is not None:
-        resources = _manifest_resources(json.loads(manifest_bytes))
-    q = service.submit_task(
-        blob,
-        is_ref=is_ref,
-        resources=resources,
-        priority=int(meta.get("priority", 0)),
-        deadline_s=meta.get("deadline_s"),
-        estimated_bytes=meta.get("estimated_bytes"),
-        use_cache=bool(meta.get("use_cache", True)),
-    )
-    if not meta.get("detach"):
-        # attached (default): cancel-on-disconnect session semantics;
-        # detached queries survive connection loss for re-attach
-        session_qids.append(q.query_id)
-    _send_json(sock, q.status())
+    def __init__(self, service):
+        self.service = service
 
+    def submit(self, meta: dict, task_bytes: bytes, is_ref: bool,
+               manifest_bytes: Optional[bytes]) -> dict:
+        from blaze_tpu.runtime.gateway import _manifest_resources
 
-def _handle_fetch(sock, service) -> None:
-    from blaze_tpu.io.ipc import encode_ipc_segment
-    from blaze_tpu.service.query import QueryState
-
-    qid = _read_str(sock)
-    timeout_ms = _read_u32(sock)
-    try:
-        q = service.get(qid)
-    except KeyError:
-        _send_err(sock, f"UNKNOWN: no query {qid}")
-        return
-    if not q.wait(timeout_ms / 1000.0 if timeout_ms else None):
-        _send_err(sock, f"{q.state.value}: fetch timed out")
-        return
-    if q.state is not QueryState.DONE:
-        _send_err(
-            sock, f"{q.state.value}: {q.error or 'not completed'}"
-        )
-        return
-    t0 = time.perf_counter_ns()
-    stream_start = time.monotonic()
-    sent = 0
-    complete = False
-    try:
-        for i, rb in enumerate(q.result or ()):
-            if chaos.ACTIVE:
-                # chaos seam: connection drop mid-result-stream (the
-                # client's reconnect-and-refetch path covers it)
-                chaos.fire("gateway.stream", query_id=qid, partition=i)
-            sock.sendall(encode_ipc_segment(rb))
-            sent += 1
-        sock.sendall(_U64.pack(0))
-        complete = True
-    except Exception as e:
-        # once parts are on the wire the client reads u64 frames; a
-        # JSON error frame here would desync it - abort the connection
-        # (truncated stream surfaces client-side as ConnectionError)
-        raise ConnectionError(f"fetch stream aborted: {e!r}") from e
-    finally:
-        q.timings["stream_ns"] = (
-            q.timings.get("stream_ns", 0)
-            + (time.perf_counter_ns() - t0)
-        )
-        if obs_trace.ACTIVE and getattr(q, "tracer", None) is not None:
-            # result streaming happens AFTER the root span closed
-            # (terminal state), so it records as a sibling span on
-            # the lifecycle track; `parts` counts what was ACTUALLY
-            # sent - an aborted stream (and the client's re-FETCH,
-            # which records its own span) must not claim full delivery
-            tags = {"parts": sent, "total": len(q.result or ())}
-            if not complete:
-                tags["aborted"] = True
-            q.tracer.record_span(
-                "result_stream", stream_start, time.monotonic(),
-                **tags,
+        resources = {}
+        if manifest_bytes is not None:
+            resources = _manifest_resources(
+                json.loads(manifest_bytes)
             )
+        q = self.service.submit_task(
+            task_bytes,
+            is_ref=is_ref,
+            resources=resources,
+            priority=int(meta.get("priority", 0)),
+            deadline_s=meta.get("deadline_s"),
+            estimated_bytes=meta.get("estimated_bytes"),
+            use_cache=bool(meta.get("use_cache", True)),
+        )
+        return q.status()
+
+    def poll(self, qid: str) -> dict:
+        return self.service.poll(qid)
+
+    def cancel(self, qid: str) -> dict:
+        return self.service.cancel(qid)
+
+    def report_frame(self, qid: str, flags: int) -> dict:
+        resp = {"report": self.service.report(qid)}
+        # trace is OPT-IN (flags bit 0 = rendered Chrome doc, bit 1 =
+        # raw span dicts for the router's cross-hop graft):
+        # serializing a multi-MB span tree on every text-report poll
+        # would tax exactly the hot path observability must not
+        trace_of = getattr(self.service, "trace", None)
+        if flags & 1 and trace_of is not None:
+            doc = trace_of(qid)
+            if doc is not None:
+                resp["trace"] = doc
+        spans_of = getattr(self.service, "trace_spans", None)
+        if flags & 2 and spans_of is not None:
+            spans = spans_of(qid)
+            if spans is not None:
+                resp["trace_spans"] = spans
+        return resp
+
+    def stats(self) -> dict:
+        return self.service.stats()
+
+    def metrics_frame(self) -> dict:
+        from blaze_tpu.obs.metrics import REGISTRY
+
+        return {"metrics": REGISTRY.render_prometheus()}
+
+    def abandon(self, qid: str) -> None:
+        try:
+            q = self.service.get(qid)
+        except KeyError:
+            return
+        if not q.done:
+            self.service.cancel(qid)
+
+    def fetch(self, sock, qid: str, timeout_ms: int) -> None:
+        from blaze_tpu.io.ipc import encode_ipc_segment
+        from blaze_tpu.service.query import QueryState
+
+        service = self.service
+        try:
+            q = service.get(qid)
+        except KeyError:
+            _send_err(sock, f"UNKNOWN: no query {qid}")
+            return
+        if not q.wait(timeout_ms / 1000.0 if timeout_ms else None):
+            _send_err(sock, f"{q.state.value}: fetch timed out")
+            return
+        if q.state is not QueryState.DONE:
+            _send_err(
+                sock, f"{q.state.value}: {q.error or 'not completed'}"
+            )
+            return
+        t0 = time.perf_counter_ns()
+        stream_start = time.monotonic()
+        sent = 0
+        complete = False
+        try:
+            for i, rb in enumerate(q.result or ()):
+                if chaos.ACTIVE:
+                    # chaos seam: connection drop mid-result-stream
+                    # (the client's reconnect-and-refetch path covers
+                    # it)
+                    chaos.fire("gateway.stream", query_id=qid,
+                               partition=i)
+                sock.sendall(encode_ipc_segment(rb))
+                sent += 1
+            sock.sendall(_U64.pack(0))
+            complete = True
+        except Exception as e:
+            # once parts are on the wire the client reads u64 frames;
+            # a JSON error frame here would desync it - abort the
+            # connection (truncated stream surfaces client-side as
+            # ConnectionError)
+            raise ConnectionError(
+                f"fetch stream aborted: {e!r}"
+            ) from e
+        finally:
+            stream_s = (time.perf_counter_ns() - t0) / 1e9
+            q.timings["stream_ns"] = (
+                q.timings.get("stream_ns", 0)
+                + (time.perf_counter_ns() - t0)
+            )
+            if complete and getattr(service, "_fold_phases", True):
+                # stream phase rolls up at FETCH end (it happens
+                # after the terminal-hook fold); aborted streams are
+                # re-fetched and would double-count. Gated by the
+                # same fold_phases switch as the terminal hook (the
+                # regress probe must not skew the live rollup)
+                from blaze_tpu.obs import phases as obs_phases
+
+                obs_phases.ROLLUP.observe(
+                    "stream", stream_s,
+                    klass=obs_phases.class_key(
+                        q._fingerprint, q._fingerprint_stable
+                    ),
+                )
+            if obs_trace.ACTIVE \
+                    and getattr(q, "tracer", None) is not None:
+                # result streaming happens AFTER the root span closed
+                # (terminal state), so it records as a sibling span on
+                # the lifecycle track; `parts` counts what was
+                # ACTUALLY sent - an aborted stream (and the client's
+                # re-FETCH, which records its own span) must not claim
+                # full delivery
+                tags = {"parts": sent, "total": len(q.result or ())}
+                if not complete:
+                    tags["aborted"] = True
+                q.tracer.record_span(
+                    "result_stream", stream_start, time.monotonic(),
+                    **tags,
+                )
+
+
+def handle_service_connection(sock, service) -> None:
+    """Drive one service connection until EOF. Called from the gateway
+    handler after it consumed the hello header."""
+    serve_verb_connection(sock, ServiceVerbBackend(service))
 
 
 def _read_u32(sock) -> int:
@@ -496,15 +588,19 @@ class ServiceClient:
         )["report"]
 
     def report_full(self, query_id: str,
-                    include_trace: bool = True) -> dict:
+                    include_trace: bool = True,
+                    include_spans: bool = False) -> dict:
         """The whole REPORT frame: {report: text, trace?: Chrome trace
-        JSON}. The trace document is requested via flags bit 0 (plain
-        `report()` skips it - text polling must not pay a multi-MB
-        span-tree serialization); `python -m blaze_tpu trace`
-        consumes the trace field."""
+        JSON, trace_spans?: raw span dicts}. The trace document is
+        requested via flags bit 0 (plain `report()` skips it - text
+        polling must not pay a multi-MB span-tree serialization);
+        `python -m blaze_tpu trace` consumes the trace field. Flags
+        bit 1 requests the RAW span dicts instead - the replica
+        router's cross-hop graft input (attach_subtree)."""
+        flags = (1 if include_trace else 0) \
+            | (2 if include_spans else 0)
         return self._roundtrip(
-            self._id_verb(VERB_REPORT, query_id,
-                          1 if include_trace else 0)
+            self._id_verb(VERB_REPORT, query_id, flags)
         )
 
     def stats(self) -> dict:

@@ -208,8 +208,41 @@ def obs_smoke() -> bool:
     zero dispatches."""
     return run(
         "obs suite",
-        ["tests/test_obs.py", "tests/test_dispatch_budget.py"],
+        ["tests/test_obs.py", "tests/test_phases.py",
+         "tests/test_dispatch_budget.py"],
     )
+
+
+def regress_smoke() -> bool:
+    """Per-phase regression guard (ISSUE 6): run the fixed phase
+    probe and diff its per-phase p50s against the checked-in
+    PHASE_BASELINE.json. The noise band is deliberately generous
+    (hosts and CI load differ; the baseline pins ORDER-of-magnitude
+    phase cost, not exact timing) - a real decode or queue-wait
+    regression is a multiple, not a percent. Skips quietly when no
+    baseline is checked in (fresh clone before the first bench
+    round)."""
+    baseline = os.path.join(REPO, "PHASE_BASELINE.json")
+    if not os.path.exists(baseline):
+        print("[SKIP] regress smoke (no PHASE_BASELINE.json)",
+              flush=True)
+        return True
+    ts = time.time()
+    p = subprocess.run(
+        [sys.executable, "-m", "blaze_tpu", "regress",
+         "--against", baseline,
+         "--noise", "3.0", "--abs-floor", "0.25"],
+        cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=600,
+    )
+    ok = p.returncode == 0
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    print(f"[{'OK ' if ok else 'FAIL'}] regress smoke "
+          f"({time.time() - ts:.0f}s) :: "
+          f"{tail[-1][:160] if tail else '(no output)'}", flush=True)
+    if not ok:
+        print("\n".join((p.stdout or "").splitlines()[-30:]))
+    return ok
 
 
 def trace_smoke() -> bool:
@@ -276,6 +309,7 @@ def main():
         ok &= chaos_smoke()
         ok &= chaos_smoke(seed_offset=1)
         ok &= obs_smoke()
+        ok &= regress_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1

@@ -16,6 +16,14 @@ resetting them would recompile everything per test.
 
 import pytest
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 sweep (-m 'not slow')",
+    )
+
+
 # VERDICT r2 Weak #1: ~115 in-process XLA compilations segfault jaxlib's
 # backend_compile_and_load (reproduced 3/3 on the TPC-DS matrix). The
 # mitigation is compile-cache hygiene: periodically drop every cached
@@ -79,9 +87,11 @@ def _obs_hygiene():
     yield
     from blaze_tpu.obs import trace
     from blaze_tpu.obs.metrics import REGISTRY
+    from blaze_tpu.obs.phases import ROLLUP
 
     trace._reset_for_tests()
     REGISTRY._reset_for_tests()
+    ROLLUP._reset_for_tests()
 
 
 @pytest.fixture(autouse=True)

@@ -552,6 +552,47 @@ def test_wire_metrics_verb_and_trace_report(two_part_plan, tmp_path):
             assert rc == 1
 
 
+def test_wire_report_raw_span_dicts_flag(two_part_plan):
+    """REPORT flags bit 1 (ISSUE 6): the RAW span dicts ride the wire
+    for the router's cross-hop graft - id/parent links intact, NOT
+    the rendered Chrome document (and not unless asked)."""
+    from blaze_tpu.plan.serde import task_to_proto
+    from blaze_tpu.runtime.gateway import TaskGatewayServer
+    from blaze_tpu.service import ServiceClient
+
+    blob = task_to_proto(two_part_plan(), 0)
+    with QueryService(max_concurrency=2) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            host, port = srv.address
+            with ServiceClient(host, port) as c:
+                st = c.submit(blob)
+                qid = st["query_id"]
+                c.fetch(qid)
+                plain = c.report_full(qid, include_trace=False)
+                assert "trace_spans" not in plain
+                resp = c.report_full(qid, include_trace=False,
+                                     include_spans=True)
+                spans = resp["trace_spans"]
+                assert "trace" not in resp
+                assert isinstance(spans, list) and spans
+                ids = {s["span_id"] for s in spans}
+                # a self-consistent subtree: every parent link
+                # resolves inside the payload (root's parent is 0)
+                assert all(
+                    s["parent_id"] in ids or s["parent_id"] == 0
+                    for s in spans
+                )
+                names = {s["name"] for s in spans}
+                assert {"query", "queue_wait", "attempt"} <= names
+                # and it grafts cleanly into another recorder
+                rec = trace.TraceRecorder("re-graft")
+                assert rec.attach_subtree(spans) == len(spans)
+                rec.finish(state="DONE")
+                assert trace.validate_chrome(
+                    trace.chrome_trace(rec)
+                ) == []
+
+
 # ---------------------------------------------------------------------------
 # cross-process stitching (cluster workers)
 # ---------------------------------------------------------------------------

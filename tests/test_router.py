@@ -1011,6 +1011,164 @@ def test_router_stats_and_metrics_fleet_view(dataset):
     assert "blaze_router_replica_alive" in text
 
 
+def test_metrics_scrape_failure_counts_instead_of_silent_drop(
+    dataset,
+):
+    """A replica that stops answering METRICS (quarantined, wedged,
+    mid-death) must not silently vanish from the merged exposition -
+    the scrape failure lands as a `blaze_router_scrape_failed`
+    counter with the replica label, and the healthy replica's series
+    still arrive stamped."""
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        wait_done(fl.router, st["query_id"])
+        dead = fl.router.get(st["query_id"]).replica_id
+        fl.kill_gateway(dead)
+        text = fl.router.metrics()
+        assert REGISTRY.get("blaze_router_scrape_failed",
+                            replica=dead) >= 1
+        # the failure is VISIBLE on the scrape surface itself
+        assert "blaze_router_scrape_failed" in text
+        # and the healthy replica still reports, stamped
+        alive = fl.other(dead)
+        assert f'replica="{alive}"' in text
+
+
+def test_registry_persistent_pollers_feed_stats_and_histogram():
+    """ISSUE 6 satellite: the background poll path is one LONG-LIVED
+    thread per replica (no thread-per-replica-per-round churn), each
+    cycle observed into the blaze_router_poll_round_seconds
+    histogram; close() joins them all."""
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    with Fleet() as fl:
+        reg = fl.router.registry
+        assert not reg._threads  # Fleet starts with start=False
+        reg.start()
+        try:
+            threads = list(reg._threads)
+            assert len(threads) == 2
+            assert all(t.is_alive() for t in threads)
+            # starting twice must not double the pollers
+            reg.start()
+            assert reg._threads == threads
+            # the pollers refresh snapshots without poll_now
+            assert wait_for(
+                lambda: all(
+                    r.stats is not None and r.stats_age_s() < 2.0
+                    for r in reg.replicas.values()
+                ),
+                timeout=10.0,
+            )
+            assert wait_for(
+                lambda: all(
+                    REGISTRY.histogram_summary(
+                        "blaze_router_poll_round_seconds",
+                        replica=rid,
+                    ) is not None
+                    for rid in reg.replicas
+                ),
+                timeout=10.0,
+            )
+        finally:
+            reg.close()
+        assert not reg._threads
+        assert all(not t.is_alive() for t in threads)
+
+
+def test_cross_hop_trace_stitches_one_perfetto_doc(dataset):
+    """ISSUE 6 acceptance: `trace <qid>` through the router yields
+    ONE schema-valid Perfetto document - router placement + TWO
+    router_attempt spans (a chaos-injected TRANSIENT forced one
+    resubmit) with the replica's span subtree (queue_wait / attempt /
+    execute_partition) grafted UNDER the live attempt span."""
+    from blaze_tpu.obs.trace import validate_chrome
+
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="TRANSIENT", times=1)], seed=7,
+    ):
+        with Fleet(svc_kw={"max_task_attempts": 1}) as fl:
+            with RouterServer(fl.router) as rs:
+                with ServiceClient(*rs.address) as c:
+                    st = c.submit(blob, use_cache=False)
+                    qid = st["query_id"]
+                    assert c.fetch(qid)  # drives the failover + DONE
+                    resp = c.report_full(qid)
+            assert resp.get("router_resubmits", 0) == 1 or (
+                fl.router.get(qid).resubmits == 1
+            )
+            doc = resp["trace"]
+            assert validate_chrome(doc) == [], validate_chrome(doc)
+            names = [e.get("name") for e in doc["traceEvents"]
+                     if e.get("ph") == "B"]
+            # router tier: root + placement + one attempt per
+            # submission (initial + TRANSIENT resubmit)
+            assert "router_query" in names
+            assert names.count("router_place") == 2
+            assert names.count("router_attempt") == 2
+            assert "router_stream" in names
+            # replica tier, grafted: the replica's own root and its
+            # execution subtree render in the SAME document
+            assert "query" in names
+            assert "queue_wait" in names
+            assert "attempt" in names
+            assert "execute_partition" in names
+            # structural pin: the grafted replica root hangs off the
+            # CURRENT router_attempt span (the one that submitted the
+            # surviving execution)
+            rq = fl.router.get(qid)
+            by_id = {s.span_id: s for s in rq.tracer.spans}
+            replica_roots = [
+                s for s in rq.tracer.spans
+                if s.name == "query" and s.span_id != rq.tracer.root.span_id
+            ]
+            assert len(replica_roots) == 1
+            anchor = by_id[replica_roots[0].parent_id]
+            assert anchor.name == "router_attempt"
+            assert anchor is rq.hop_span
+            # a second trace request must NOT re-graft the subtree
+            n_spans = len(rq.tracer.spans)
+            resp2 = fl.router.report(qid, flags=1)
+            assert len(rq.tracer.spans) == n_spans
+            assert validate_chrome(resp2["trace"]) == []
+            # protocol symmetry (shared verb loop): the router honors
+            # REPORT flags bit 1 exactly like a serve instance - the
+            # GRAFTED raw span dicts, so a second router tier could
+            # re-graft the whole client->router->replica subtree
+            resp3 = fl.router.report(qid, flags=2)
+            assert "trace" not in resp3
+            span_names = {s["name"] for s in resp3["trace_spans"]}
+            assert {"router_query", "router_attempt",
+                    "queue_wait"} <= span_names
+            assert len(rq.tracer.spans) == n_spans  # still no re-graft
+
+
+def test_router_trace_survives_replica_loss_of_handle(dataset):
+    """REPORT of a query whose replica lost the handle still returns
+    the router-side trace: the hop spans outlive the replica."""
+    from blaze_tpu.obs.trace import validate_chrome
+
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        qid = st["query_id"]
+        wait_done(fl.router, qid)
+        rq = fl.router.get(qid)
+        # simulate a replica restart that lost the handle
+        svc = fl.by_id[rq.replica_id][0]
+        with svc._lock:
+            svc._queries.pop(rq.internal_id, None)
+        resp = fl.router.report(qid, flags=1)
+        assert resp["state"] == "DONE"
+        assert validate_chrome(resp["trace"]) == []
+        names = {s.name for s in rq.tracer.spans}
+        assert "router_place" in names
+
+
 # ---------------------------------------------------------------------------
 # end-to-end acceptance: serve x2 behind the route CLI
 # ---------------------------------------------------------------------------
