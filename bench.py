@@ -519,6 +519,106 @@ def timed(fn, iters=None, warmup=1):
     return median, spread, k, out
 
 
+def mesh_child(n_dev: int, n_rows: int) -> int:
+    """One mesh_groupby_d{n} measurement (ISSUE 7): the SAME global
+    grouped aggregate - a FINAL/exchange/PARTIAL sandwich over an
+    8-partition in-memory table - run at the forced host device count
+    the parent set via XLA_FLAGS. With 1 device the mesh pass is a
+    no-op and the sandwich runs the file-shuffle exchange tier; with 8
+    the planner lowers it to one pjit program exchanging partial
+    states over the virtual ICI all_to_all. Results are asserted equal
+    to a pandas oracle before timing; the steady state re-executes the
+    warm plan (mesh: program compiled once, fresh execution per round
+    - the battery's warm-kernel convention). Prints one JSON line."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import pandas as pd
+    import pyarrow as pa
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec, MemoryScanExec
+    from blaze_tpu.planner.distribute import (
+        insert_exchanges,
+        lower_plan_to_mesh,
+    )
+    from blaze_tpu.runtime.executor import run_plan
+
+    assert len(jax.devices()) == n_dev, (
+        f"expected {n_dev} forced host devices, saw "
+        f"{len(jax.devices())}"
+    )
+    n_parts = 8
+    per = max(1, n_rows // n_parts)
+    rng = np.random.default_rng(17)
+    parts, schema, frames = [], None, []
+    for _ in range(n_parts):
+        k = rng.integers(0, 4096, per).astype(np.int64)
+        v = rng.integers(0, 1000, per).astype(np.int64)
+        frames.append(pd.DataFrame({"k": k, "v": v}))
+        cb = ColumnBatch.from_arrow(
+            pa.record_batch({"k": k, "v": v})
+        )
+        schema = cb.schema
+        parts.append([cb])
+    shuffle_dir = tempfile.mkdtemp(prefix="blaze_mesh_bench_")
+
+    def sandwich():
+        return insert_exchanges(
+            HashAggregateExec(
+                MemoryScanExec(parts, schema),
+                keys=[(Col("k"), "k")],
+                aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                      (AggExpr(AggFn.COUNT_STAR, None), "n")],
+                mode=AggMode.COMPLETE,
+            ),
+            n_parts, shuffle_dir=shuffle_dir,
+        )
+
+    lowered = lower_plan_to_mesh(sandwich(), mode="on")
+    mesh_lowered = type(lowered).__name__ == "MeshGroupByExec"
+
+    def run_once():
+        if mesh_lowered:
+            lowered._result = None  # fresh execution, warm program
+            return run_plan(lowered)
+        return run_plan(sandwich())
+
+    got = (
+        run_once().to_pandas().sort_values("k")
+        .reset_index(drop=True)
+    )
+    want = (
+        pd.concat(frames).groupby("k")
+        .agg(s=("v", "sum"), n=("v", "size"))
+        .reset_index().sort_values("k").reset_index(drop=True)
+    )
+    assert np.array_equal(got["k"], want["k"]), "mesh bench keys drift"
+    assert np.array_equal(got["s"], want["s"]), "mesh bench sums drift"
+    assert np.array_equal(got["n"], want["n"]), "mesh bench counts drift"
+    med, spread, k_iters, _ = timed(run_once)
+    print(json.dumps({
+        "median": round(med, 4),
+        "spread": round(spread, 3),
+        "k": k_iters,
+        "n_devices": n_dev,
+        "rows": per * n_parts,
+        "groups": int(len(got)),
+        "mesh_lowered": mesh_lowered,
+    }), flush=True)
+    return 0
+
+
 def child(n_rows):
     import numpy as np
 
@@ -1046,6 +1146,61 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- mesh execution tier (ISSUE 7): the SAME global grouped
+    # aggregate timed at 1 forced host device (single-device path -
+    # the FINAL/exchange/PARTIAL file-shuffle sandwich) and at 8 (the
+    # planner lowers the sandwich onto the mesh: one pjit program,
+    # partial states exchanged over the virtual ICI all_to_all).
+    # Each runs in its OWN subprocess because the device count
+    # freezes at first backend init. Results are asserted equal
+    # before timing, battery-style. ----
+    for n_dev in (1, 8):
+        name = f"mesh_groupby_d{n_dev}"
+        try:
+            mesh_rows = min(n_rows, 1 << 20)
+            env = _repo_env(platform="cpu")
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count"
+                      f"={n_dev}"
+                ).strip()
+            env.setdefault("BLAZE_BENCH_ITERS",
+                           os.environ.get("BLAZE_BENCH_ITERS", "3"))
+            # per-shape bound well inside smoke()'s 420s outer budget:
+            # a hung compile lands as THIS shape's error, it must not
+            # starve the rest of the battery (or the smoke parent)
+            p = subprocess.run(
+                [sys.executable, "-u", os.path.abspath(__file__),
+                 "--mesh-child", str(n_dev), str(mesh_rows)],
+                capture_output=True, text=True, timeout=150, env=env,
+            )
+            parsed = None
+            for line in reversed(p.stdout.splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        parsed = json.loads(line)
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            if p.returncode != 0 or parsed is None:
+                tail = (p.stderr or "").strip().splitlines()
+                raise RuntimeError(
+                    f"mesh child rc={p.returncode} "
+                    f"({tail[-1][:160] if tail else 'no stderr'})"
+                )
+            detail[name] = parsed
+        except Exception as e:  # noqa: BLE001 - battery survives
+            detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": name, "backend": backend, **detail[name]}
+            ),
+            flush=True,
+        )
+
     # ---- serving tier: queries/sec through the gateway service at
     # concurrency 1/4/16, with and without the plan-fingerprint result
     # cache (ISSUE 2 satellite). Same {median, spread, k} form as the
@@ -1338,11 +1493,24 @@ def smoke():
     env = _repo_env(platform="cpu")
     env["BLAZE_BENCH_ITERS"] = env.get("BLAZE_BENCH_ITERS", "3")
     t0 = time.monotonic()
-    out = subprocess.run(
-        [sys.executable, "-u", os.path.abspath(__file__), "--child",
-         str(rows)],
-        capture_output=True, text=True, timeout=300, env=env,
-    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--child", str(rows)],
+            # the battery + the two mesh_groupby_d{1,8} subprocesses
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # a wedged child must fail the smoke as a PROBLEM with
+        # whatever partial output streamed, not as a traceback
+        print(json.dumps({
+            "smoke": "FAIL",
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "rows": rows,
+            "problems": [f"child timed out after {e.timeout:.0f}s"],
+            "result": None,
+        }), flush=True)
+        return 1
     result = None
     for line in reversed(out.stdout.splitlines()):
         line = line.strip()
@@ -1391,6 +1559,8 @@ def smoke():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-child":
+        sys.exit(mesh_child(int(sys.argv[2]), int(sys.argv[3])))
     elif len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         sys.exit(smoke())
     else:

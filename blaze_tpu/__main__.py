@@ -19,6 +19,10 @@
                   service endpoint (fingerprint-affinity placement,
                   headroom-aware load balancing, class-aware failover;
                   blaze_tpu/router/, docs/ROUTER.md)
+  mesh-dryrun     versioned multichip artifact generator: run the full
+                  distributed query step on an n-device virtual CPU
+                  mesh and emit the MULTICHIP_r*.json shape
+                  ({n_devices, rc, ok, skipped, tail})
   regress         per-phase regression check (obs/phases.py): run the
                   fixed probe workload and diff its per-phase p50s
                   against a checked-in baseline (--against), emit a
@@ -121,6 +125,7 @@ def cmd_serve(args) -> int:
         default_deadline_s=args.deadline or None,
         enable_trace=not args.no_trace,
         slow_query_s=args.slow_query_s,
+        mesh_mode=("on" if args.mesh else args.mesh_mode),
     )
     try:
         serve_forever(args.host, args.port, service=service)
@@ -194,8 +199,83 @@ def cmd_route(args) -> int:
         breaker_threshold=args.breaker_threshold,
         max_resubmits=args.max_resubmits,
         enable_trace=not args.no_trace,
+        conn_pool_size=args.conn_pool,
     )
     return 0
+
+
+def cmd_mesh_dryrun(args) -> int:
+    """Versioned generator for the MULTICHIP_r*.json artifact shape:
+    compile + run the full distributed query step (group-by all_to_all
+    exchange, broadcast join, slack repartition + skew retry, decoded-
+    TaskDefinition differential) on an n-device virtual CPU mesh in a
+    FRESH subprocess (the platform choice freezes at first backend
+    init), and emit {n_devices, rc, ok, skipped, tail} JSON. Skips
+    cleanly (skipped=true, rc 0) when jax lacks shard_map or the
+    repo-root driver entry is not importable."""
+    import os
+    import subprocess
+
+    n = args.devices
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    doc = {"n_devices": n, "rc": 0, "ok": False, "skipped": False,
+           "tail": ""}
+
+    def emit() -> int:
+        text = json.dumps(doc, indent=2)
+        if args.out and args.out != "-":
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {args.out}", file=sys.stderr)
+        else:
+            print(text)
+        return 0 if (doc["ok"] or doc["skipped"]) else 1
+
+    try:
+        from jax import shard_map  # noqa: F401
+    except ImportError:
+        try:
+            from jax.experimental.shard_map import (  # noqa: F401
+                shard_map,
+            )
+        except ImportError:
+            doc.update(skipped=True,
+                       tail="jax lacks shard_map; mesh tier skipped\n")
+            return emit()
+    if not os.path.exists(os.path.join(root, "__graft_entry__.py")):
+        doc.update(skipped=True,
+                   tail="__graft_entry__.py not found at repo root\n")
+        return emit()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__; "
+             f"__graft_entry__.dryrun_multichip({n})"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        tail_lines = (
+            (p.stdout or "") + (p.stderr or "")
+        ).splitlines()[-20:]
+        doc.update(
+            rc=p.returncode, ok=p.returncode == 0,
+            tail="\n".join(tail_lines) + "\n",
+        )
+    except subprocess.TimeoutExpired:
+        doc.update(rc=124, ok=False,
+                   tail=f"mesh dryrun timed out after "
+                        f"{args.timeout:.0f}s\n")
+    return emit()
 
 
 def cmd_regress(args) -> int:
@@ -311,6 +391,13 @@ def main(argv=None) -> int:
                     help="structured slow-query log threshold "
                          "(default 5s or BLAZE_SLOW_QUERY_S; "
                          "<= 0 disables)")
+    sv.add_argument("--mesh", action="store_true",
+                    help="force the mesh execution tier for every "
+                         "eligible query (mesh_mode=on; docs/MESH.md)")
+    sv.add_argument("--mesh-mode", default=None,
+                    choices=("auto", "on", "off"),
+                    help="mesh execution mode (default: defer to "
+                         "BLAZE_MESH_LOWERING / auto)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -345,6 +432,18 @@ def main(argv=None) -> int:
                          "query")
     rr.add_argument("--no-trace", action="store_true",
                     help="disable router-hop tracing (obs/)")
+    rr.add_argument("--conn-pool", type=int, default=4,
+                    help="verb connections pooled per replica (one "
+                         "slow RPC can't serialize sibling verbs)")
+    md = sub.add_parser("mesh-dryrun")
+    md.add_argument("--devices", type=int, default=8,
+                    help="virtual device count for the forced host "
+                         "mesh")
+    md.add_argument("-o", "--out", default=None,
+                    help="output path for the MULTICHIP-shaped JSON "
+                         "('-'/default = stdout)")
+    md.add_argument("--timeout", type=float, default=600.0,
+                    help="dryrun subprocess wall-clock bound seconds")
     rg = sub.add_parser("regress")
     rg.add_argument("--against", default=None, metavar="BASELINE",
                     help="phase baseline JSON to diff the probe "
@@ -381,6 +480,7 @@ def main(argv=None) -> int:
         "trace": cmd_trace,
         "metrics": cmd_metrics,
         "route": cmd_route,
+        "mesh-dryrun": cmd_mesh_dryrun,
         "regress": cmd_regress,
     }[args.cmd](args)
 

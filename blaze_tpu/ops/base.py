@@ -55,6 +55,11 @@ class ExecContext:
     # per-query TraceRecorder (obs/trace.py) when tracing is on; the
     # executor/scheduler seams check `trace.ACTIVE` before touching it
     tracer: Optional[object] = None
+    # mesh execution mode for this task ("auto"|"on"|"off"); None
+    # defers to the BLAZE_MESH_LOWERING env
+    # (planner/distribute.resolve_mesh_mode) - the serving tier's
+    # mesh_mode knob threads through here
+    mesh_mode: Optional[str] = None
 
 
 class PhysicalOp:

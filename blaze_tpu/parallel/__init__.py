@@ -29,6 +29,11 @@ from blaze_tpu.parallel.exchange import (
     RemoteClusterShuffleExchangeExec,
     ShuffleExchangeExec,
 )
+from blaze_tpu.parallel.mesh_exec import (
+    MeshBroadcastJoinExec,
+    MeshPipelineExec,
+)
+from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
 
 __all__ = [
     "get_mesh",
@@ -38,4 +43,7 @@ __all__ = [
     "RemoteClusterShuffleExchangeExec",
     "BroadcastExchangeExec",
     "CoalescedShuffleReader",
+    "MeshGroupByExec",
+    "MeshPipelineExec",
+    "MeshBroadcastJoinExec",
 ]

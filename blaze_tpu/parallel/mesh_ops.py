@@ -11,12 +11,13 @@ fallback for string keys / more partitions than devices / multi-host.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Iterator, List, Sequence, Tuple
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from blaze_tpu.types import DataType, Field, Schema, TypeId
 from blaze_tpu.batch import Column, ColumnBatch
@@ -24,9 +25,16 @@ from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.ir import AggExpr, AggFn
 from blaze_tpu.exprs.typing import infer_dtype
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
-from blaze_tpu.ops.util import concat_batches
 from blaze_tpu.parallel.mesh import get_mesh
+from blaze_tpu.parallel.mesh_exec import (
+    degrade_or_raise,
+    mesh_chaos,
+    record_exchange,
+    record_mesh_run,
+    stack_partitions,
+)
 from blaze_tpu.parallel.sharded import DistAgg, DistributedGroupBy
+from blaze_tpu.runtime import dispatch
 
 
 class MeshGroupByExec(PhysicalOp):
@@ -89,6 +97,9 @@ class MeshGroupByExec(PhysicalOp):
             filter_pred=filter_pred,
         )
         self._result = None
+        # single-flight: concurrent partition pulls (the parallel
+        # scheduler) must compile/launch the mesh program once
+        self._lock = threading.Lock()
 
     @property
     def schema(self) -> Schema:
@@ -99,92 +110,76 @@ class MeshGroupByExec(PhysicalOp):
         return int(self.mesh.shape["data"])
 
     def _run(self, ctx: ExecContext):
-        if self._result is not None:
-            return self._result
-        child = self.children[0]
-        n_dev = self.partition_count
-        assert child.partition_count <= n_dev, (
-            "more partitions than devices; use the exchange tier"
-        )
-        per_part = []
-        for p in range(child.partition_count):
-            b = concat_batches(
-                list(child.execute(p, ctx)), schema=child.schema
+        with self._lock:
+            if self._result is not None:
+                return self._result
+            child = self.children[0]
+            n_dev = self.partition_count
+            # HBM-resident staging: partitions land sharded over the
+            # mesh and stay device-side through the whole program -
+            # host spill happens only at the mesh boundary (the
+            # grouped-result fetch below)
+            stacked, num_rows, cap, total, _ = stack_partitions(
+                child, ctx, self.mesh
             )
-            # fail fast BEFORE materializing the remaining partitions:
-            # a nullable input detected here falls back to the
-            # original plan, and everything collected so far is sunk
-            # cost
-            for c in b.columns:
-                if c.validity is not None:
-                    raise NotImplementedError(
-                        "mesh group-by handles non-nullable columns; "
-                        "nullable inputs use the exchange tier"
+            multi = jax.process_count() > 1
+            mesh_chaos("mesh.groupby", n_dev, ctx)
+            t0 = time.monotonic()
+            dispatch.record("dispatches")
+            dispatch.record("mesh_dispatches")
+            key_out, agg_out, counts = self._gb(stacked, num_rows)
+            if multi:
+                # every rank needs every device's output slice
+                # (execute() may be asked for any partition):
+                # allgather the small grouped results
+                from blaze_tpu.parallel.mesh import allgather_rows
+
+                key_out = [allgather_rows(k, n_dev) for k in key_out]
+                agg_out = [allgather_rows(a, n_dev) for a in agg_out]
+                counts = allgather_rows(counts, n_dev, trailing=False)
+            else:
+                key_out, agg_out, counts = dispatch.device_get(
+                    jax.block_until_ready(
+                        (key_out, agg_out, counts)
                     )
-            per_part.append(b)
-        # pad to a common capacity and stack [n_dev, cap] per column
-        cap = max(max((b.capacity for b in per_part), default=1), 1)
-        ncols = len(child.schema)
-        from blaze_tpu.parallel.mesh import data_sharding
-
-        sharding = data_sharding(self.mesh)
-        multi = jax.process_count() > 1
-
-        def to_mesh(global_np):
-            # single-controller: a plain device array suffices. Multi-
-            # process SPMD: every rank holds the full logical value (the
-            # task decodes rank-symmetrically), so build the global
-            # array from each rank's addressable shards - a plain
-            # jnp.asarray would be process-local and the pjit would
-            # reject it
-            if not multi:
-                return jnp.asarray(global_np)
-            return jax.make_array_from_callback(
-                global_np.shape, sharding,
-                lambda idx: global_np[idx],
+                )
+            t1 = time.monotonic()
+            counts = np.asarray(counts)
+            # the partial-state repartition inside the program is the
+            # exchange: every live input row's partial group crosses
+            # ICI at most once (conservatively counted as the input
+            # rows - the partial states are bounded by them)
+            nbytes = total * sum(
+                np.dtype(f.dtype.physical_dtype()).itemsize
+                for f in self.schema.fields
             )
-
-        stacked = []
-        for ci in range(ncols):
-            phys = child.schema.fields[ci].dtype.physical_dtype()
-            rows = []
-            for b in per_part:
-                v = np.asarray(b.columns[ci].values)
-                if len(v) < cap:
-                    v = np.pad(v, (0, cap - len(v)))
-                rows.append(v)
-            for _ in range(n_dev - len(per_part)):
-                rows.append(np.zeros(cap, dtype=phys))
-            stacked.append(to_mesh(np.stack(rows)))
-        num_rows = to_mesh(
-            np.array(
-                [b.num_rows for b in per_part]
-                + [0] * (n_dev - len(per_part)),
-                dtype=np.int32,
+            record_exchange(ctx, "all_to_all", total, nbytes)
+            nr_host = np.asarray(num_rows)
+            record_mesh_run(
+                ctx, "mesh.groupby", n_dev, t0, t1,
+                [{"rows_in": int(nr_host[d]),
+                  "groups_out": int(counts[d])}
+                 for d in range(n_dev)],
             )
-        )
-        key_out, agg_out, counts = self._gb(stacked, num_rows)
-        if multi:
-            # every rank needs every device's output slice (execute()
-            # may be asked for any partition): allgather the small
-            # grouped results
-            from blaze_tpu.parallel.mesh import allgather_rows
-
-            key_out = [allgather_rows(k, n_dev) for k in key_out]
-            agg_out = [allgather_rows(a, n_dev) for a in agg_out]
-            counts = allgather_rows(counts, n_dev, trailing=False)
-        self._result = (key_out, agg_out, np.asarray(counts))
-        ctx.metrics.add("mesh_groupby_groups", int(self._result[2].sum()))
-        return self._result
+            self._result = (
+                [np.asarray(k) for k in key_out],
+                [np.asarray(a) for a in agg_out],
+                counts,
+            )
+            ctx.metrics.add(
+                "mesh_groupby_groups", int(self._result[2].sum())
+            )
+            return self._result
 
     def execute(self, partition: int, ctx: ExecContext
                 ) -> Iterator[ColumnBatch]:
         if self.fallback is not None and not self._use_fallback:
             try:
                 self._run(ctx)
-            except NotImplementedError:
-                self._use_fallback = True
-                self._result = None
+            except Exception as e:  # noqa: BLE001 - failure ladder:
+                # TRANSIENT propagates (task retry re-runs the mesh),
+                # everything else degrades to the single-device plan
+                degrade_or_raise(self, ctx, e)
         if self._use_fallback:
             if partition < self.fallback.partition_count:
                 yield from self.fallback.execute(partition, ctx)

@@ -160,6 +160,243 @@ def _rewrite(op: PhysicalOp, n: int, shuffle_dir,
     return new
 
 
+# ---------------------------------------------------------------------------
+# Mesh execution tier: the cost-guarded planner pass
+# ---------------------------------------------------------------------------
+
+
+def estimate_rows(op: PhysicalOp) -> int:
+    """Leaf-driven row estimate for the mesh cost guard: memory scans
+    count resident rows, parquet scans approximate rows from file-range
+    bytes (~16 B/row, the battery tables' order of magnitude), interior
+    nodes sum their leaves. Deliberately coarse - the guard needs an
+    order of magnitude, not a cost model (same contract as
+    admission.estimate_plan_device_bytes)."""
+    from blaze_tpu.ops.memory_scan import MemoryScanExec
+    from blaze_tpu.ops.parquet_scan import ParquetScanExec
+
+    if isinstance(op, MemoryScanExec):
+        return sum(
+            cb.num_rows for part in op.partitions for cb in part
+        )
+    if isinstance(op, ParquetScanExec):
+        import os
+
+        total = 0
+        for group in op.file_groups:
+            for fr in group:
+                if fr.length:
+                    total += fr.length
+                else:
+                    try:
+                        total += os.path.getsize(fr.path)
+                    except OSError:
+                        pass
+        return total // 16
+    if not op.children:
+        return 0
+    return sum(estimate_rows(c) for c in op.children)
+
+
+def resolve_mesh_mode(ctx=None) -> str:
+    """Mesh execution mode: explicit per-context override (the serving
+    tier's `mesh_mode` knob / `serve --mesh`) beats the
+    BLAZE_MESH_LOWERING env, default "auto".
+
+      off   never lower onto the mesh
+      auto  lower when the mesh exists AND the cost guard passes
+            (single-controller only - in a multi-process group ranks
+            decode DIFFERENT tasks and a one-sided collective would
+            deadlock the group)
+      on    force lowering (bypasses the row-count guard; asserts the
+            caller decodes rank-symmetric tasks in a multi-process
+            group)
+    """
+    import os
+
+    mode = getattr(ctx, "mesh_mode", None) if ctx is not None else None
+    mode = mode or os.environ.get("BLAZE_MESH_LOWERING", "auto")
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"mesh mode must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+def _mesh_min_rows(mode: str) -> int:
+    """Cost guard: plans below this row estimate stay single-device
+    (staging + program launch would dominate). `on` forces."""
+    import os
+
+    if mode == "on":
+        return 0
+    try:
+        return int(os.environ.get("BLAZE_MESH_MIN_ROWS", 4096))
+    except ValueError:
+        return 4096
+
+
+def _pick_mesh(n_parts: int, mesh=None):
+    """Partition-axis selection from plan shape: a k-partition child
+    lands one partition per device on a k-wide 'data' axis (k capped
+    by the device pool and BLAZE_MESH_DEVICES); a single-partition
+    child takes the FULL mesh - its groups still spread across every
+    device at the exchange. Returns None when no multi-device mesh is
+    possible."""
+    import os
+
+    from blaze_tpu.parallel.mesh import device_count, get_mesh
+
+    if mesh is not None:
+        return mesh
+    n_dev = device_count()
+    try:
+        cap = int(os.environ.get("BLAZE_MESH_DEVICES", n_dev))
+    except ValueError:
+        cap = n_dev
+    n_dev = max(1, min(n_dev, cap))
+    if n_dev <= 1:
+        return None
+    width = n_dev if n_parts <= 1 else min(n_dev, max(2, n_parts))
+    return get_mesh((width,))
+
+
+def lower_plan_to_mesh(op: PhysicalOp, mode: Optional[str] = None,
+                       mesh=None, ctx=None) -> PhysicalOp:
+    """The mesh execution tier's planner pass (ROADMAP item 2): lower
+    the ROOT of a plan onto the device mesh when its shape shards and
+    the cost guard passes, else return the plan untouched (single-
+    device execution). Three recognized shapes:
+
+      grouped aggregate (COMPLETE, or the FINAL/exchange/PARTIAL
+        sandwich)            -> MeshGroupByExec (ICI all_to_all)
+      inner broadcast hash join with a small unique-key build side
+                             -> MeshBroadcastJoinExec (ICI all_gather)
+      filter/project chain over a multi-partition source
+                             -> MeshPipelineExec (partition-parallel)
+
+    Root-only by design: a mid-tree rewrite would hand Sort/Limit/
+    Window parents n_dev partitions where the plan promised fewer,
+    silently turning global semantics per-partition. Every lowered op
+    carries the ORIGINAL node as its runtime fallback (tryConvert
+    semantics, both halves)."""
+    mode = mode if mode is not None else resolve_mesh_mode(ctx)
+    if mode == "off":
+        return op
+    if mode == "auto":
+        # single-controller only: in a multi-process group, ranks
+        # execute DIFFERENT plans and a one-sided collective would
+        # deadlock the group. "on" asserts rank-symmetric callers
+        # (the launcher's SPMD workload). Guarded HERE so every entry
+        # (service driver plans, run_plan_parallel, decoded tasks)
+        # shares it, not just prepare_decoded_task.
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                return op
+        except Exception:  # noqa: BLE001 - uninitialized distributed
+            pass
+    from blaze_tpu.parallel.mesh import device_count
+
+    if mesh is None and device_count() <= 1:
+        return op
+    from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+
+    min_rows = _mesh_min_rows(mode)
+    new = _try_mesh_groupby(op, mesh, MeshGroupByExec,
+                            min_rows=min_rows, global_only=True)
+    if new is not op:
+        return new
+    new = _try_mesh_broadcast_join(op, mesh, min_rows)
+    if new is not op:
+        return new
+    return _try_mesh_pipeline(op, mesh, min_rows)
+
+
+def _try_mesh_broadcast_join(node: PhysicalOp, mesh,
+                             min_rows: int) -> PhysicalOp:
+    """HashJoinExec (CollectLeft broadcast join) -> mesh broadcast
+    join: INNER, one integer key pair, build side small enough to
+    replicate into every device's HBM, multi-partition probe."""
+    import os
+
+    from blaze_tpu.ops.joins import JoinType
+
+    if not isinstance(node, HashJoinExec):
+        return node
+    if node.join_type is not JoinType.INNER:
+        return node
+    if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+        return node
+    build, probe = node.children
+    if getattr(build, "is_broadcast", False):
+        # already wrapped for the file tier; unwrap the real relation
+        build = build.children[0] if build.children else build
+    for side, keys in ((build, node.left_keys),
+                       (probe, node.right_keys)):
+        dt = side.schema.fields[keys[0]].dtype
+        if not dt.is_integer:
+            return node
+    if probe.partition_count < 2:
+        return node
+    try:
+        bcast_max = int(
+            os.environ.get("BLAZE_MESH_BCAST_MAX_ROWS", 1 << 17)
+        )
+    except ValueError:
+        bcast_max = 1 << 17
+    if estimate_rows(build) > bcast_max:
+        return node
+    if estimate_rows(probe) < min_rows:
+        return node
+    m = _pick_mesh(probe.partition_count, mesh)
+    if m is None or probe.partition_count > int(m.shape["data"]):
+        return node
+    try:
+        from blaze_tpu.parallel.mesh_exec import MeshBroadcastJoinExec
+
+        return MeshBroadcastJoinExec(
+            build, probe,
+            build_key=node.left_keys[0],
+            probe_key=node.right_keys[0],
+            mesh=m, fallback=node,
+        )
+    except (NotImplementedError, AssertionError):
+        return node
+
+
+def _try_mesh_pipeline(node: PhysicalOp, mesh,
+                       min_rows: int) -> PhysicalOp:
+    """A root filter/project chain over a multi-partition source
+    executes all partitions in one shard_map program."""
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.project import ProjectExec
+
+    chain = []
+    cur = node
+    while isinstance(cur, (FilterExec, ProjectExec)):
+        chain.append(cur)
+        cur = cur.children[0]
+    if not chain:
+        return node
+    source = cur
+    if source.partition_count < 2:
+        return node
+    if estimate_rows(source) < min_rows:
+        return node
+    m = _pick_mesh(source.partition_count, mesh)
+    if m is None or source.partition_count > int(m.shape["data"]):
+        return node
+    try:
+        from blaze_tpu.parallel.mesh_exec import MeshPipelineExec
+
+        return MeshPipelineExec(node, chain, source, mesh=m,
+                                fallback=node)
+    except (NotImplementedError, AssertionError):
+        return node
+
+
 def lower_to_mesh(op: PhysicalOp, mesh=None,
                   root_only: bool = False) -> PhysicalOp:
     """Lower aggregate shapes onto the ICI tier: a grouped aggregate
@@ -202,28 +439,42 @@ def lower_to_mesh(op: PhysicalOp, mesh=None,
     return rewrite(op)
 
 
-def _try_mesh_groupby(node: PhysicalOp, mesh, MeshGroupByExec
-                      ) -> PhysicalOp:
+def _try_mesh_groupby(node: PhysicalOp, mesh, MeshGroupByExec,
+                      min_rows: int = 0,
+                      global_only: bool = False) -> PhysicalOp:
     from blaze_tpu.exprs.ir import AggFn
 
     shapes = _match_agg_shape(node)
     if shapes is None:
         return node
     child, keys, aggs = shapes
+    if (global_only and node.mode is AggMode.COMPLETE
+            and child.partition_count > 1):
+        # a bare COMPLETE aggregate over a multi-partition child has
+        # PER-PARTITION grouping semantics engine-side (the global
+        # form is the FINAL/exchange/PARTIAL sandwich); the mesh op
+        # computes the global aggregate, so lowering here would
+        # silently change results. The production pass refuses; the
+        # dryrun/test entry (lower_to_mesh) keeps the old behavior
+        # where callers assert global intent.
+        return node
     supported = {AggFn.SUM, AggFn.COUNT, AggFn.COUNT_STAR,
                  AggFn.MIN, AggFn.MAX, AggFn.AVG}
     if any(a.fn not in supported for a, _ in aggs):
         return node
-    # cheap partition gates BEFORE constructing the (pjit-program-
-    # building) mesh op: a sandwich with more reducers than devices is
-    # the common insert_exchanges default and must not pay plan-time
-    # construction just to be discarded
-    from blaze_tpu.parallel.mesh import device_count
-
-    n_dev = (
-        int(mesh.shape["data"]) if mesh is not None
-        else device_count()
+    if min_rows and estimate_rows(child) < min_rows:
+        return node  # cost guard: staging would dominate
+    # partition-axis selection + cheap partition gates BEFORE
+    # constructing the (pjit-program-building) mesh op: a sandwich
+    # with more reducers than devices is the common insert_exchanges
+    # default and must not pay plan-time construction just to be
+    # discarded
+    mesh = _pick_mesh(
+        max(child.partition_count, node.partition_count), mesh
     )
+    if mesh is None:
+        return node
+    n_dev = int(mesh.shape["data"])
     if child.partition_count > n_dev or node.partition_count > n_dev:
         return node
     try:

@@ -137,6 +137,7 @@ class Router:
         downstream_timeout_s: float = 120.0,
         fetch_block_s: float = 0.5,
         enable_trace: bool = True,
+        conn_pool_size: int = 4,
         start: bool = True,
     ):
         if placement not in ("affinity", "random"):
@@ -173,9 +174,17 @@ class Router:
             "overflow_spills": 0,
             "no_replica": 0,
         }
-        self._clients: Dict[str, object] = {}
-        self._client_locks: Dict[str, threading.Lock] = {
-            rid: threading.Lock() for rid in self.registry.replicas
+        # per-replica verb-client POOL (ROADMAP item 4's last enabling
+        # refactor): up to conn_pool_size concurrent connections per
+        # replica, so one slow RPC cannot serialize sibling verbs
+        # behind a single socket. `_clients[rid]` holds IDLE clients;
+        # `_client_counts[rid]` counts created (idle + checked-out)
+        self._pool_size = max(1, int(conn_pool_size))
+        self._clients: Dict[str, list] = {}
+        self._client_counts: Dict[str, int] = {}
+        self._client_cv: Dict[str, threading.Condition] = {
+            rid: threading.Condition()
+            for rid in self.registry.replicas
         }
         self._collector_key = f"router:{id(self):x}"
         REGISTRY.register_collector(
@@ -199,12 +208,14 @@ class Router:
         if self._trace_enabled:
             obs_trace.disable()
         self.registry.close()
-        for rid, c in list(self._clients.items()):
-            try:
-                c.close()
-            except Exception:  # noqa: BLE001 - teardown
-                pass
+        for rid, idle in list(self._clients.items()):
+            for c in idle:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
         self._clients.clear()
+        self._client_counts.clear()
 
     def __enter__(self):
         return self
@@ -214,32 +225,72 @@ class Router:
 
     # -- downstream client pool -----------------------------------------
     def _call(self, replica: Replica, fn):
-        """Run one verb round trip on the pooled per-replica client
-        (serialized per replica; ServiceClient's reconnect-with-backoff
-        heals transient drops underneath). A failing client is dropped
-        from the pool so the next call starts clean."""
+        """Run one verb round trip on a client checked out of the
+        per-replica connection pool (ServiceClient's reconnect-with-
+        backoff heals transient drops underneath). Up to
+        `conn_pool_size` verbs run concurrently against one replica;
+        a caller that finds every connection busy lands one
+        `blaze_router_conn_pool_waits{replica}` count and blocks until
+        a sibling checks its client back in. A failing client is
+        closed and dropped so the next checkout starts clean."""
         from blaze_tpu.service.wire import ServiceClient
 
         rid = replica.replica_id
-        lock = self._client_locks.setdefault(rid, threading.Lock())
-        with lock:
-            c = self._clients.get(rid)
-            if c is None:
+        cv = self._client_cv.setdefault(rid, threading.Condition())
+        c = None
+        counted_wait = False
+        with cv:
+            while True:
+                idle = self._clients.setdefault(rid, [])
+                if idle:
+                    c = idle.pop()
+                    break
+                if self._client_counts.get(rid, 0) < self._pool_size:
+                    self._client_counts[rid] = (
+                        self._client_counts.get(rid, 0) + 1
+                    )
+                    break  # connect OUTSIDE the pool lock
+                if not counted_wait:
+                    # one count per wait EPISODE, not per wakeup
+                    counted_wait = True
+                    REGISTRY.inc("blaze_router_conn_pool_waits",
+                                 replica=rid)
+                cv.wait(timeout=0.1)
+
+        def _discard(client) -> None:
+            with cv:
+                self._client_counts[rid] = max(
+                    0, self._client_counts.get(rid, 1) - 1
+                )
+                cv.notify()
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        if c is None:
+            try:
                 c = ServiceClient(
                     replica.host, replica.port,
                     timeout=self.downstream_timeout_s,
                     reconnect_attempts=1,
                 )
-                self._clients[rid] = c
-            try:
-                return fn(c)
-            except Exception:
-                self._clients.pop(rid, None)
-                try:
-                    c.close()
-                except Exception:  # noqa: BLE001
-                    pass
+            except BaseException:
+                _discard(None)  # release the reserved slot
                 raise
+        try:
+            out = fn(c)
+        except BaseException:
+            # BaseException too (thread-delivered interrupt/exit mid-
+            # verb): the slot and the client must never leak - after
+            # conn_pool_size leaks every _call would wait forever
+            _discard(c)
+            raise
+        with cv:
+            self._clients.setdefault(rid, []).append(c)
+            cv.notify()
+        return out
 
     # -- bookkeeping -----------------------------------------------------
     def _register(self, rq: RoutedQuery) -> None:

@@ -63,39 +63,41 @@ def prepare_decoded_task(decoded, ctx: ExecContext):
     one-dispatch pipeline programs; reference: the decoded plan IS the
     executed plan, exec.rs:137-165), attach scan hints, and install the
     task's resources into the context."""
-    import os
-
     from blaze_tpu.ops.fused import fuse_pipelines
     from blaze_tpu.planner.colprune import install as install_scan_hints
 
     op, partition, task_id, resources = decoded
     # Mesh lowering first (it matches raw aggregate shapes the fusion
-    # rewrite would consume): with >1 visible device, eligible grouped
-    # aggregates become one pjit program over the ICI mesh
-    # (planner/distribute.lower_to_mesh). ONLY single-partition plans
-    # qualify at this boundary: a TaskDefinition carries ONE partition
-    # of its stage, and the SPMD group-by aggregates the WHOLE child -
-    # lowering a multi-partition task would double-count its siblings'
-    # data. The lowered tree is coalesced so the task's one partition
-    # carries every group (the mesh op's output is per-device
-    # group-disjoint). BLAZE_MESH_LOWERING=off restores the
-    # file-fabric path; single-device is a no-op.
-    # Mode: "auto" lowers only in a single-controller process (in a
-    # multi-process group, ranks decode DIFFERENT tasks - the
+    # rewrite would consume): with >1 visible device, eligible root
+    # shapes become one pjit program over the ICI mesh - the
+    # cost-guarded pass in planner/distribute.lower_plan_to_mesh.
+    # ONLY single-partition plans qualify at this boundary: a
+    # TaskDefinition carries ONE partition of its stage, and the SPMD
+    # operators consume the WHOLE child - lowering a multi-partition
+    # task would double-count its siblings' data. The lowered tree is
+    # coalesced so the task's one partition carries every group (the
+    # mesh ops' output is per-device disjoint). Mode resolution:
+    # ctx.mesh_mode (the serving tier's knob) > BLAZE_MESH_LOWERING
+    # env > "auto". "auto" lowers only in a single-controller process
+    # (in a multi-process group, ranks decode DIFFERENT tasks - the
     # task-per-partition cluster model - and a one-sided collective
-    # would deadlock the group); "on" asserts the caller decodes
-    # rank-symmetric tasks (the launcher's SPMD workload); "off"
-    # disables. Root-only: a mid-tree rewrite would change the
+    # would deadlock the group); "on" forces (asserts the caller
+    # decodes rank-symmetric tasks - the launcher's SPMD workload);
+    # "off" disables. Root-only: a mid-tree rewrite would change the
     # partitioning under Sort/Limit/Window parents.
-    mode = os.environ.get("BLAZE_MESH_LOWERING", "auto")
+    from blaze_tpu.planner.distribute import (
+        lower_plan_to_mesh,
+        resolve_mesh_mode,
+    )
+
+    mode = resolve_mesh_mode(ctx)
     lower_ok = mode == "on" or (
         mode == "auto" and _process_count() == 1
     )
     if lower_ok and op.partition_count == 1:
         from blaze_tpu.ops.union import CoalescePartitionsExec
-        from blaze_tpu.planner.distribute import lower_to_mesh
 
-        lowered = lower_to_mesh(op, root_only=True)
+        lowered = lower_plan_to_mesh(op, mode=mode)
         op = (
             CoalescePartitionsExec(lowered)
             if lowered.partition_count != 1

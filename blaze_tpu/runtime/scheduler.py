@@ -72,6 +72,7 @@ def run_plan_parallel(
     retry_backoff_s: float = 0.05,
     degrade_to_host: bool = True,
     on_attempt: Optional[Callable[[dict], None]] = None,
+    mesh: Optional[str] = None,
 ) -> pa.Table:
     """Execute every partition on a thread pool and collect one table.
 
@@ -82,8 +83,20 @@ def run_plan_parallel(
     {partition, attempt, error_class, error, action} - an embedder's
     hook into the failure journal. (The serving tier drives partitions
     itself for cache interleaving, so it applies the SAME policy via
-    errors.retry_action rather than calling this function.)"""
+    errors.retry_action rather than calling this function.)
+
+    `mesh` selects the mesh execution tier for this plan ("auto" cost-
+    guarded, "on" forced, "off"/None single-device - driver plans stay
+    single-device by default): the root is lowered through
+    planner/distribute.lower_plan_to_mesh, partitions then map one-per-
+    device, and a mesh failure degrades back to the single-device plan
+    (docs/MESH.md)."""
     ctx = ctx or ExecContext()
+    if mesh is not None and mesh != "off":
+        from blaze_tpu.planner.distribute import lower_plan_to_mesh
+
+        ctx.mesh_mode = mesh
+        op = lower_plan_to_mesh(op, mode=mesh)
     abort = threading.Event()  # internal: first-failure fail-fast
 
     def cancelled() -> bool:

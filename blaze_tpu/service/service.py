@@ -76,6 +76,7 @@ class QueryService:
         slow_query_s: Optional[float] = None,
         history: Optional[RuntimeHistory] = None,
         fold_phases: bool = True,
+        mesh_mode: Optional[str] = None,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -89,6 +90,17 @@ class QueryService:
         self.max_task_attempts = max(1, int(max_task_attempts))
         self.retry_backoff_s = float(retry_backoff_s)
         self.degrade_to_host = degrade_to_host
+        # mesh execution tier (planner/distribute, docs/MESH.md):
+        # "auto" = cost-guarded lowering, "on" = forced (`serve
+        # --mesh`), "off" = single-device; None defers to the
+        # BLAZE_MESH_LOWERING env per task. Threaded into every
+        # query's ExecContext so prepare_decoded_task resolves it
+        # without env mutation
+        if mesh_mode not in (None, "auto", "on", "off"):
+            raise ValueError(
+                f"mesh_mode must be auto|on|off, got {mesh_mode!r}"
+            )
+        self.mesh_mode = mesh_mode
         self.cache = (
             cache if cache is not None
             else (ResultCache() if enable_cache else None)
@@ -253,6 +265,8 @@ class QueryService:
         if obs_trace.ACTIVE:
             q.tracer = obs_trace.begin_trace(q.query_id)
             q.ctx.tracer = q.tracer
+        if self.mesh_mode is not None:
+            q.ctx.mesh_mode = self.mesh_mode
         q.on_terminal = self._on_query_terminal
 
     def _enqueue(self, q: Query) -> Query:
@@ -388,6 +402,7 @@ class QueryService:
                 "max_queue_depth": self.admission.max_queue_depth,
                 "slow_query_s": self.slow_query_s,
                 "trace_enabled": self._trace_enabled,
+                "mesh_mode": self.mesh_mode or "env",
             },
         }
         if self.cache is not None:
@@ -724,6 +739,18 @@ class QueryService:
         )
         if q.plan is not None:
             op = q.plan
+            if self.mesh_mode in ("auto", "on"):
+                # mesh tier for driver plans: root-only cost-guarded
+                # lowering. Partition geometry may change (one
+                # partition per device), which is consistent per
+                # service instance - cache keys stay (fingerprint,
+                # partition) over the LOWERED geometry, and the mode
+                # is fixed for the process lifetime
+                from blaze_tpu.planner.distribute import (
+                    lower_plan_to_mesh,
+                )
+
+                op = lower_plan_to_mesh(op, mode=self.mesh_mode)
             partitions = list(range(op.partition_count))
             exec_op = op  # driver plans run as-built (run_plan parity)
         else:

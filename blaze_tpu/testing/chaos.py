@@ -38,6 +38,10 @@ Injection sites (each named in docs/ROBUSTNESS.md):
   service.admit     QueryService._run_query before the RUNNING
                     transition (STALL widens the ADMITTED->RUNNING
                     race window for cancellation tests)
+  mesh.exchange     before every mesh-tier program launch
+                    (parallel/mesh_exec.py): TRANSIENT propagates to
+                    the task-retry tier, any other class degrades the
+                    op to its single-device fallback plan
 
 Activation: programmatic `install()`/`active()` (tests), or the
 BLAZE_CHAOS environment variable carrying the plan as JSON - worker

@@ -152,7 +152,7 @@ def bench_smoke() -> bool:
     p = subprocess.run(
         [sys.executable, "bench.py", "--smoke"],
         cwd=REPO, env=_env(), capture_output=True, text=True,
-        timeout=360,
+        timeout=600,
     )
     smoke_ok = p.returncode == 0
     tail = p.stdout.strip().splitlines()
@@ -211,6 +211,104 @@ def obs_smoke() -> bool:
         ["tests/test_obs.py", "tests/test_phases.py",
          "tests/test_dispatch_budget.py"],
     )
+
+
+def mesh_smoke() -> bool:
+    """Mesh execution tier suite (ISSUE 7): the mesh-vs-single-device
+    differential battery, chaos `mesh.exchange` coverage, and the
+    QueryService mesh-mode acceptance pin. Forces an 8-device virtual
+    host mesh via XLA_FLAGS ITSELF (the repo conftest does the same
+    for plain pytest runs, but this suite must not depend on it) and
+    skips cleanly when the installed jax lacks shard_map."""
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "try:\n"
+         "    from jax import shard_map\n"
+         "except ImportError:\n"
+         "    from jax.experimental.shard_map import shard_map\n"],
+        capture_output=True, text=True, env=_env(),
+    )
+    if probe.returncode != 0:
+        print("[SKIP] mesh suite (jax lacks shard_map)", flush=True)
+        return True
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return run(
+        "mesh suite",
+        ["tests/test_mesh_exec.py", "tests/test_parallel.py"],
+        extra_env={"XLA_FLAGS": flags},
+    )
+
+
+def _bench_phase_rounds():
+    """BENCH_r*.json artifacts (round order) that carry a per-phase
+    rollup snapshot - the inline mirror of obs/phases.phases_from_bench
+    (kept import-light: this runs before any jax-touching child)."""
+    import glob
+    import json
+
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict) and "tail" in doc \
+                and "queries" not in doc:
+            parsed = doc.get("parsed")
+            if not isinstance(parsed, dict):
+                parsed = None
+                for line in reversed(
+                    str(doc.get("tail", "")).splitlines()
+                ):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            parsed = json.loads(line)
+                            break
+                        except json.JSONDecodeError:
+                            continue
+            doc = parsed or {}
+        snap = ((doc.get("queries") or {}).get("phases") or {}) \
+            .get("snapshot")
+        if snap:
+            out.append(path)
+    return out
+
+
+def bench_regress_smoke() -> bool:
+    """Nightly-shape regression hook (ROADMAP PR 6 follow-up): diff
+    the per-phase rollups of the two most recent BENCH_r*.json rounds
+    (`regress --bench OLD NEW`), so cross-round phase creep fails at
+    commit time. Skips quietly while fewer than 2 artifacts carry
+    `phases` snapshots."""
+    rounds = _bench_phase_rounds()
+    if len(rounds) < 2:
+        print(f"[SKIP] bench regress ({len(rounds)} artifact(s) with "
+              "phase rollups; need 2)", flush=True)
+        return True
+    old, new = rounds[-2], rounds[-1]
+    ts = time.time()
+    p = subprocess.run(
+        [sys.executable, "-m", "blaze_tpu", "regress",
+         "--bench", old, new,
+         "--noise", "3.0", "--abs-floor", "0.25"],
+        cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=300,
+    )
+    ok = p.returncode == 0
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    print(f"[{'OK ' if ok else 'FAIL'}] bench regress "
+          f"{os.path.basename(old)} -> {os.path.basename(new)} "
+          f"({time.time() - ts:.0f}s) :: "
+          f"{tail[-1][:160] if tail else '(no output)'}", flush=True)
+    if not ok:
+        print("\n".join((p.stdout or "").splitlines()[-30:]))
+    return ok
 
 
 def regress_smoke() -> bool:
@@ -280,11 +378,21 @@ def main():
                          "multi-partition query -> Perfetto JSON, "
                          "validated against the Chrome-trace-event "
                          "schema")
+    ap.add_argument("--mesh", action="store_true",
+                    help="mesh execution tier suite only: forces an "
+                         "8-device virtual host mesh itself; skips "
+                         "cleanly if jax lacks shard_map")
     args = ap.parse_args()
     rows = 20_000 if args.fast else args.rows
 
     ok = True
     t0 = time.time()
+
+    if args.mesh:
+        ok &= mesh_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (mesh) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
 
     if args.trace:
         ok &= trace_smoke()
@@ -309,7 +417,9 @@ def main():
         ok &= chaos_smoke()
         ok &= chaos_smoke(seed_offset=1)
         ok &= obs_smoke()
+        ok &= mesh_smoke()
         ok &= regress_smoke()
+        ok &= bench_regress_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1

@@ -357,6 +357,67 @@ def test_obs_hooks_add_zero_dispatches(tables):
     assert after == baseline, (after, baseline)
 
 
+def test_mesh_groupby_budget():
+    """ISSUE 7: dispatch budgets extend to MESH plans. A global
+    grouped aggregate over an 8-partition source, lowered onto the
+    forced 8-device host mesh, is ONE program launch: 1 dispatch
+    (tagged mesh_dispatches), one H2D per staged column stack (+1 row
+    counts), one batched result fetch - and the whole exchange stays
+    HBM-resident (nothing else touches the host). An armed-but-empty
+    chaos plan (the mesh.exchange seam entered) changes nothing."""
+    import tempfile
+
+    import jax
+
+    from blaze_tpu.planner.distribute import (
+        insert_exchanges,
+        lower_plan_to_mesh,
+    )
+    from blaze_tpu.testing import chaos
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (forced-host) mesh")
+    rng = np.random.default_rng(7)
+    parts, schema = [], None
+    for _ in range(8):
+        cb = ColumnBatch.from_arrow(pa.record_batch({
+            "k": rng.integers(0, 64, 4096).astype(np.int64),
+            "v": rng.integers(0, 1000, 4096).astype(np.int64),
+        }))
+        schema = cb.schema
+        parts.append([cb])
+
+    low = lower_plan_to_mesh(
+        insert_exchanges(
+            HashAggregateExec(
+                MemoryScanExec(parts, schema),
+                keys=[(Col("k"), "k")],
+                aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                      (AggExpr(AggFn.COUNT_STAR, None), "n")],
+                mode=AggMode.COMPLETE,
+            ),
+            8, shuffle_dir=tempfile.mkdtemp(),
+        ),
+        mode="on",
+    )
+    assert type(low).__name__ == "MeshGroupByExec"
+
+    def run():
+        low._result = None  # fresh execution, warm program
+        return run_plan(low)
+
+    counts = _counts(run)
+    assert counts.get("mesh_dispatches", 0) == 1, counts
+    assert counts.get("dispatches", 0) <= 1, counts
+    assert counts.get("h2d_batches", 0) <= 3, counts
+    assert counts.get("d2h_fetches", 0) \
+        + counts.get("d2h_syncs", 0) <= 1, counts
+    assert counts.get("kernel_builds", 0) == 0, counts
+    with chaos.active([], seed=7):  # armed, zero faults: seam entered
+        armed = _counts(run)
+    assert armed == counts, (armed, counts)
+
+
 def test_executor_exposes_dispatch_metrics(tables):
     from blaze_tpu.ops.base import ExecContext
     from blaze_tpu.runtime.instrument import render_metrics

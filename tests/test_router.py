@@ -141,9 +141,9 @@ class Fleet:
         c, r._client = r._client, None
         if c is not None:
             c.close()
-        pooled = self.router._clients.pop(replica_id, None)
-        if pooled is not None:
+        for pooled in self.router._clients.pop(replica_id, []):
             pooled.close()
+        self.router._client_counts.pop(replica_id, None)
 
     def close(self):
         self.router.close()
@@ -1274,3 +1274,133 @@ def test_e2e_route_cli_affinity_and_chaos_kill_failover(dataset):
     finally:
         for proc in procs:
             _reap(proc)
+
+
+# ---------------------------------------------------------------------------
+# per-replica connection pool (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+class _StubVerbClient:
+    """Stands in for ServiceClient in pool-bookkeeping tests: no
+    socket, just identity + closed flag."""
+
+    def __init__(self, host, port, **kw):
+        self.host = host
+        self.port = port
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def _stub_wire(monkeypatch):
+    import blaze_tpu.service.wire as wire
+
+    made = []
+
+    def factory(host, port, **kw):
+        c = _StubVerbClient(host, port, **kw)
+        made.append(c)
+        return c
+
+    monkeypatch.setattr(wire, "ServiceClient", factory)
+    return made
+
+
+def test_conn_pool_parallel_verbs_do_not_serialize(monkeypatch):
+    """ROADMAP item 4's last enabling refactor: with a pool of N
+    connections per replica, a slow RPC on one connection no longer
+    blocks a sibling verb - the sibling checks out a SECOND client
+    and completes while the first is still in flight."""
+    made = _stub_wire(monkeypatch)
+    r = Router(["127.0.0.1:19999"], start=False, conn_pool_size=2)
+    try:
+        rep = next(iter(r.registry.replicas.values()))
+        hold = threading.Event()
+        entered = threading.Event()
+        slow_out = []
+
+        def slow(c):
+            entered.set()
+            assert hold.wait(10)
+            return ("slow", c)
+
+        t = threading.Thread(
+            target=lambda: slow_out.append(r._call(rep, slow))
+        )
+        t.start()
+        assert entered.wait(10)
+        # sibling verb while the slow RPC holds its connection
+        fast = r._call(rep, lambda c: ("fast", c))
+        assert fast[0] == "fast"
+        hold.set()
+        t.join(10)
+        assert slow_out and slow_out[0][0] == "slow"
+        assert fast[1] is not slow_out[0][1]  # distinct connections
+        assert len(made) == 2
+    finally:
+        r.close()
+
+
+def test_conn_pool_exhaustion_counts_waits_and_reuses(monkeypatch):
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    _stub_wire(monkeypatch)
+    r = Router(["127.0.0.1:19999"], start=False, conn_pool_size=1)
+    try:
+        rep = next(iter(r.registry.replicas.values()))
+        rid = rep.replica_id
+        before = REGISTRY.get("blaze_router_conn_pool_waits",
+                              replica=rid)
+        hold = threading.Event()
+        entered = threading.Event()
+
+        def slow(c):
+            entered.set()
+            assert hold.wait(10)
+            return c
+
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(r._call(rep, slow))
+        )
+        t.start()
+        assert entered.wait(10)
+        t2 = threading.Thread(
+            target=lambda: out.append(r._call(rep, lambda c: c))
+        )
+        t2.start()
+        # the waiter lands exactly one wait count for the episode
+        assert wait_for(
+            lambda: REGISTRY.get("blaze_router_conn_pool_waits",
+                                 replica=rid) == before + 1,
+            timeout=5,
+        )
+        hold.set()
+        t.join(10)
+        t2.join(10)
+        assert len(out) == 2
+        assert out[0] is out[1]  # pool of 1: same client reused
+    finally:
+        r.close()
+
+
+def test_conn_pool_drops_failing_client(monkeypatch):
+    made = _stub_wire(monkeypatch)
+    r = Router(["127.0.0.1:19999"], start=False, conn_pool_size=2)
+    try:
+        rep = next(iter(r.registry.replicas.values()))
+
+        def boom(c):
+            raise ConnectionError("peer reset")
+
+        with pytest.raises(ConnectionError):
+            r._call(rep, boom)
+        assert made[0].closed  # failing client dropped + closed
+        # next call starts clean on a FRESH connection
+        c2 = r._call(rep, lambda c: c)
+        assert c2 is not made[0] and not c2.closed
+        assert r._client_counts[rep.replica_id] == 1
+    finally:
+        r.close()
