@@ -1388,6 +1388,10 @@ def child(n_rows):
                 ["%s:%d" % s.address for s in srvs],
                 placement=rt_mode,
                 poll_interval_s=0.2,
+                # no hot-result replication: it would warm the second
+                # replica mid-round and blur the affinity-vs-random
+                # comparison this shape exists to measure
+                replicate_hot_k=0,
                 start=True,
             )
             rs = RouterServer(router).start()

@@ -17,8 +17,9 @@
                   (dispatch.*, admission, cache, query counters)
   route           replica router: front N `serve` instances behind one
                   service endpoint (fingerprint-affinity placement,
-                  headroom-aware load balancing, class-aware failover;
-                  blaze_tpu/router/, docs/ROUTER.md)
+                  headroom-aware load balancing, class-aware failover,
+                  elastic JOIN/LEAVE membership + hot-result
+                  replication; blaze_tpu/router/, docs/ROUTER.md)
   mesh-dryrun     versioned multichip artifact generator: run the full
                   distributed query step on an n-device virtual CPU
                   mesh and emit the MULTICHIP_r*.json shape
@@ -109,7 +110,11 @@ def cmd_gateway(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    from blaze_tpu.runtime.gateway import serve_forever
+    import signal
+    import threading
+    import time
+
+    from blaze_tpu.runtime.gateway import TaskGatewayServer
     from blaze_tpu.service import QueryService, ResultCache
 
     cache = None
@@ -127,9 +132,61 @@ def cmd_serve(args) -> int:
         slow_query_s=args.slow_query_s,
         mesh_mode=("on" if args.mesh else args.mesh_mode),
     )
+    # serve_blocking (NOT start()): the main thread is the only
+    # accept loop - see TaskGatewayServer.serve_blocking
+    srv = TaskGatewayServer(args.host, args.port, service=service)
+    print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
+    announcer = None
+    if args.router:
+        # elastic membership (docs/ROUTER.md): JOIN the router now and
+        # re-announce periodically, so a restarted router re-learns
+        # this replica without anyone editing a --replica list
+        from blaze_tpu.router.membership import (
+            MembershipAnnouncer,
+            parse_advertise,
+        )
+
+        announcer = MembershipAnnouncer(
+            args.router,
+            parse_advertise(args.advertise, srv.address),
+        ).start()
+    draining = threading.Event()
+
+    def _drain_and_exit() -> None:
+        # the listener stays up through the drain: in-flight queries
+        # finish and their results stay FETCHable; only new SUBMITs
+        # are refused (classified DRAINING rejection)
+        print("SIGTERM: draining (refusing new submits)", flush=True)
+        service.drain(timeout_s=args.drain_grace or None)
+        # short linger: a router that saw the last query finish still
+        # needs a beat to FETCH the result before the listener dies
+        time.sleep(0.25)
+        if announcer is not None:
+            announcer.leave()
+            announcer.close()
+        print("drained; leaving", flush=True)
+        srv.shutdown()
+
+    def _on_sigterm(signum, frame) -> None:
+        if not draining.is_set():
+            draining.set()
+            threading.Thread(
+                target=_drain_and_exit, daemon=True,
+                name="blaze-serve-drain",
+            ).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
-        serve_forever(args.host, args.port, service=service)
+        srv.serve_blocking()
+    except KeyboardInterrupt:
+        pass
     finally:
+        try:
+            srv.stop()
+        except OSError:
+            pass
+        if announcer is not None:
+            announcer.close()
         service.close()
     return 0
 
@@ -184,10 +241,13 @@ def cmd_metrics(args) -> int:
 def cmd_route(args) -> int:
     from blaze_tpu.router.proxy import route_forever
 
+    # --replica is only a BOOTSTRAP hint since the JOIN/LEAVE
+    # protocol landed: an empty router waits for replicas to announce
+    # themselves (serve --router HOST:PORT)
     if not args.replica:
-        print("route: at least one --replica HOST:PORT required",
+        print("route: no --replica bootstrap hints; waiting for "
+              "replicas to JOIN (serve --router ...)",
               file=sys.stderr)
-        return 2
     route_forever(
         args.host,
         args.port,
@@ -200,6 +260,8 @@ def cmd_route(args) -> int:
         max_resubmits=args.max_resubmits,
         enable_trace=not args.no_trace,
         conn_pool_size=args.conn_pool,
+        replicate_hot_k=args.replicate_hot,
+        replicate_interval_s=args.replicate_interval,
     )
     return 0
 
@@ -398,6 +460,18 @@ def main(argv=None) -> int:
                     choices=("auto", "on", "off"),
                     help="mesh execution mode (default: defer to "
                          "BLAZE_MESH_LOWERING / auto)")
+    sv.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="router to JOIN (elastic membership: "
+                         "announced at startup and re-announced "
+                         "periodically; LEAVE is sent after a "
+                         "SIGTERM drain)")
+    sv.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                    help="address announced to the router (default: "
+                         "the listener's bound address)")
+    sv.add_argument("--drain-grace", type=float, default=30.0,
+                    help="SIGTERM drain: max seconds to wait for "
+                         "in-flight queries before leaving anyway "
+                         "(0 = wait forever)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -413,7 +487,9 @@ def main(argv=None) -> int:
     rr.add_argument("--port", type=int, default=8485)
     rr.add_argument("--replica", action="append", default=[],
                     metavar="HOST:PORT",
-                    help="a serve instance to front (repeatable)")
+                    help="a serve instance to front (repeatable; a "
+                         "BOOTSTRAP hint only - replicas join and "
+                         "leave dynamically via the MEMBER verb)")
     rr.add_argument("--placement", default="affinity",
                     choices=("affinity", "random"),
                     help="placement policy (random = baseline for "
@@ -435,6 +511,13 @@ def main(argv=None) -> int:
     rr.add_argument("--conn-pool", type=int, default=4,
                     help="verb connections pooled per replica (one "
                          "slow RPC can't serialize sibling verbs)")
+    rr.add_argument("--replicate-hot", type=int, default=4,
+                    metavar="K",
+                    help="double-place the top-K hot fingerprints on "
+                         "a second replica (0 disables hot-result "
+                         "replication)")
+    rr.add_argument("--replicate-interval", type=float, default=2.0,
+                    help="hot-replication pass period seconds")
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "

@@ -84,6 +84,16 @@ class ReplicaUnavailableError(TransientError):
     retry-with-backoff, not abandon."""
 
 
+class ReplicaDrainingError(TransientError):
+    """A replica refused new work because it is DRAINING (SIGTERM
+    rolling restart: finish in-flight, reject new, LEAVE when empty).
+    TRANSIENT by design - the replica (or its replacement) comes back
+    within one restart, so a bare client's correct reaction is the
+    same retry-with-backoff it already applies to dropped
+    connections; a router treats it as a placement miss and spills to
+    the next replica with zero breaker strikes."""
+
+
 # exception type names that mean "cooperative cancellation" - matched by
 # name to avoid importing the scheduler/service from this leaf module
 _CANCEL_NAMES = frozenset({"PlanCancelled", "QueryCancelled"})

@@ -5,9 +5,15 @@ Flare's scheduler-fronting-heterogeneous-executors shape, PAPERS.md):
 a `ServiceClient` talks to the router exactly as it talks to a single
 `python -m blaze_tpu serve` instance, and the router owns
 
-  membership  - registry.py: STATS-poll heartbeats under the
+  membership  - registry.py: DYNAMIC fleet membership (JOIN/LEAVE over
+                the MEMBER wire verb; membership.py announces from the
+                replica side, the --replica list is only a bootstrap
+                hint) with STATS-poll heartbeats under the
                 cluster-runner Liveness window; per-replica health,
-                quarantine, Prometheus gauges
+                drain state, quarantine, Prometheus gauges
+  replication - replication.py: the top-K hot fingerprints get a
+                confirmed second ResultCache copy, promoted to the
+                affinity home when the first replica departs
   placement   - placement.py: plan-fingerprint affinity (repeats hit
                 the replica whose ResultCache holds the result - zero
                 dispatches), then headroom-fits-estimated-cost, then a
@@ -25,6 +31,7 @@ CLI entry.
 """
 
 from blaze_tpu.router.failover import CircuitBreaker, failover_action
+from blaze_tpu.router.membership import MembershipAnnouncer
 from blaze_tpu.router.placement import (
     AffinityMap,
     affinity_key,
@@ -37,10 +44,13 @@ from blaze_tpu.router.proxy import (
     handle_router_connection,
 )
 from blaze_tpu.router.registry import Replica, ReplicaRegistry
+from blaze_tpu.router.replication import HotReplicator
 
 __all__ = [
     "AffinityMap",
     "CircuitBreaker",
+    "HotReplicator",
+    "MembershipAnnouncer",
     "Replica",
     "ReplicaRegistry",
     "RoutedQuery",

@@ -1,0 +1,157 @@
+"""Elastic fleet membership: the replica side of JOIN/LEAVE.
+
+The router's registry (router/registry.py) owns the authoritative
+fleet view; this module is what a `python -m blaze_tpu serve` replica
+runs to participate in it:
+
+  * JOIN - announced over the MEMBER wire verb (service/wire.py) as
+    soon as the replica's listener is up, and RE-announced every
+    `interval_s` from a background thread. Re-announcement is the
+    whole re-registration story: JOIN is idempotent at the router, so
+    a restarted router (empty registry) re-learns the fleet within one
+    announce interval with no replica-side state machine. A router
+    that is down or unreachable costs one failed connect per tick -
+    the loop IS the retry.
+  * LEAVE - sent once by the drain path (SIGTERM -> QueryService.drain
+    -> LEAVE -> exit) on a dedicated short-timeout connection, so a
+    cleanly departing replica is removed from placement immediately
+    instead of aging into a heartbeat death.
+
+The router-side counterpart (Router.membership) fires the
+`router.membership` chaos seam on every frame, so dropped JOINs and
+LEAVE races are exercised by the chaos suite like every other failure
+path (docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Tuple
+
+from blaze_tpu.router.registry import parse_replica
+
+log = logging.getLogger("blaze_tpu.router")
+
+
+class MembershipAnnouncer:
+    """Background JOIN announcer + one-shot LEAVE for a serve replica.
+
+    `advertise` is the address OTHER processes can reach this replica
+    at (defaults to the listener's bound address - override it when
+    the bind address is 0.0.0.0 or NAT-ed)."""
+
+    def __init__(
+        self,
+        router_spec,
+        advertise,
+        interval_s: float = 2.0,
+        timeout_s: float = 5.0,
+    ):
+        self.router_host, self.router_port = parse_replica(router_spec)
+        self.host, self.port = parse_replica(advertise)
+        self.replica_id = f"{self.host}:{self.port}"
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._left = False
+        # serializes MEMBER round trips: leave() must not overtake an
+        # in-flight JOIN (a slow router could otherwise process the
+        # LEAVE first, then the stalled JOIN would resurrect a
+        # membership record for a process about to exit)
+        self._member_lock = threading.Lock()
+        self.joins_acked = 0   # successful JOIN round trips
+        self.join_failures = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "MembershipAnnouncer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._announce_loop, daemon=True,
+                name=f"blaze-member-announce-{self.replica_id}",
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- protocol --------------------------------------------------------
+    def _member(self, payload: dict) -> dict:
+        """One MEMBER round trip on a fresh short-timeout connection.
+        Never reuses a socket across ticks: the announcer must observe
+        a restarted router as a clean reconnect, not a half-dead
+        session."""
+        from blaze_tpu.service.wire import ServiceClient
+
+        with self._member_lock:
+            with ServiceClient(
+                self.router_host, self.router_port,
+                timeout=self.timeout_s, reconnect_attempts=0,
+            ) as c:
+                return c.member(payload)
+
+    def announce_now(self) -> bool:
+        """One synchronous JOIN (tests and the startup path). True on
+        an acked JOIN."""
+        try:
+            resp = self._member({
+                "op": "join", "host": self.host, "port": self.port,
+            })
+        except Exception as e:  # noqa: BLE001 - the loop is the retry
+            self.join_failures += 1
+            log.debug("JOIN %s -> %s:%d failed: %r", self.replica_id,
+                      self.router_host, self.router_port, e)
+            return False
+        if resp.get("error"):
+            self.join_failures += 1
+            log.warning("JOIN %s rejected: %s", self.replica_id,
+                        resp["error"])
+            return False
+        self.joins_acked += 1
+        return True
+
+    def leave(self, reason: str = "drained") -> bool:
+        """One best-effort LEAVE. Further JOIN announcements stop
+        first, and the MEMBER round-trip lock below means any
+        already-in-flight JOIN completes (ack received) before the
+        LEAVE is even SENT - the router processes them in that order,
+        so a leave->announce race cannot resurrect membership."""
+        self._left = True
+        try:
+            resp = self._member({
+                "op": "leave", "host": self.host, "port": self.port,
+                "reason": reason,
+            })
+        except Exception as e:  # noqa: BLE001 - the heartbeat death
+            # path covers an unreachable router; leaving is advisory
+            log.warning("LEAVE %s failed (%r); router will detect "
+                        "departure by heartbeat", self.replica_id, e)
+            return False
+        return not resp.get("error")
+
+    def _announce_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._left:
+                self.announce_now()
+            if self._stop.wait(self.interval_s):
+                return
+
+
+def parse_advertise(advertise: Optional[str],
+                    bound_address: Tuple[str, int]) -> str:
+    """The address a replica announces: an explicit --advertise wins;
+    otherwise the listener's actual bound (host, port)."""
+    if advertise:
+        return advertise
+    return "%s:%d" % bound_address

@@ -98,6 +98,22 @@ class AffinityMap:
             while len(self._map) > self.max_entries:
                 self._map.popitem(last=False)
 
+    def evict_replica(self, replica_id: str) -> int:
+        """Eager departure eviction (LEAVE / heartbeat death): drop
+        every entry pointing at the departed replica NOW, instead of
+        letting each one decay into a failed placement + failover.
+        Entries for other replicas are untouched (a flapping replica
+        must not thrash the whole fleet's affinity). Returns the
+        eviction count."""
+        with self._lock:
+            dead = [
+                k for k, (rid, _fp) in self._map.items()
+                if rid == replica_id
+            ]
+            for k in dead:
+                del self._map[k]
+        return len(dead)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._map)

@@ -45,15 +45,22 @@ from blaze_tpu.router.placement import (
     choose_replica,
     random_replica,
 )
-from blaze_tpu.router.registry import Replica, ReplicaRegistry
+from blaze_tpu.router.registry import (
+    Replica,
+    ReplicaRegistry,
+    parse_replica,
+)
+from blaze_tpu.router.replication import HotReplicator
 from blaze_tpu.service.wire import (
     _ERR,
     _U32,
     _U64,
     VERB_FETCH,
     ServiceError,
+    _is_draining_rejection,
     _send_err,
 )
+from blaze_tpu.testing import chaos
 
 log = logging.getLogger("blaze_tpu.router")
 
@@ -138,6 +145,8 @@ class Router:
         fetch_block_s: float = 0.5,
         enable_trace: bool = True,
         conn_pool_size: int = 4,
+        replicate_hot_k: int = 4,
+        replicate_interval_s: float = 2.0,
         start: bool = True,
     ):
         if placement not in ("affinity", "random"):
@@ -153,9 +162,14 @@ class Router:
             poll_interval_s=poll_interval_s,
             heartbeat_timeout_s=heartbeat_timeout_s,
             quarantine_s=quarantine_s,
-            on_dead=self._on_replica_dead_async,
+            on_dead=self._on_replica_departed_async,
         )
         self.affinity = AffinityMap()
+        # replicated hot results (router/replication.py): the top-K
+        # hot fingerprints get a confirmed second copy, promoted to
+        # the affinity home when the first one departs
+        self.hot = HotReplicator(self, top_k=replicate_hot_k)
+        self.replicate_interval_s = float(replicate_interval_s)
         self.breaker = CircuitBreaker(
             self.registry, threshold=breaker_threshold
         )
@@ -172,6 +186,7 @@ class Router:
             "resubmits_transient": 0,
             "failovers": 0,
             "overflow_spills": 0,
+            "drain_spills": 0,
             "no_replica": 0,
         }
         # per-replica verb-client POOL (ROADMAP item 4's last enabling
@@ -182,6 +197,11 @@ class Router:
         self._pool_size = max(1, int(conn_pool_size))
         self._clients: Dict[str, list] = {}
         self._client_counts: Dict[str, int] = {}
+        # per-replica pool EPOCH, bumped when a replica LEAVEs: a
+        # client checked out across the leave must not be pooled (or
+        # counted) back into the next epoch - a restarted replica at
+        # the same address would inherit a socket to the dead process
+        self._client_epoch: Dict[str, int] = {}
         self._client_cv: Dict[str, threading.Condition] = {
             rid: threading.Condition()
             for rid in self.registry.replicas
@@ -196,14 +216,37 @@ class Router:
         if self._trace_enabled:
             obs_trace.enable()
         self._closed = False
+        self._hot_stop = threading.Event()
+        self._hot_thread: Optional[threading.Thread] = None
         if start:
             self.registry.start()
+            if self.hot.top_k > 0:
+                self._hot_thread = threading.Thread(
+                    target=self._hot_loop, daemon=True,
+                    name="blaze-router-hot-replicate",
+                )
+                self._hot_thread.start()
+
+    def _hot_loop(self) -> None:
+        """Background hot-result replication pass (replication.py).
+        Its own thread: a replication submit + DONE confirmation can
+        take seconds, and neither the pollers nor client verbs may
+        wait on it."""
+        while not self._hot_stop.wait(self.replicate_interval_s):
+            try:
+                self.hot.tick()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("hot replication tick failed")
 
     # -- lifecycle -------------------------------------------------------
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._hot_stop.set()
+        if self._hot_thread is not None:
+            self._hot_thread.join(timeout=5)
+            self._hot_thread = None
         REGISTRY.unregister_collector(self._collector_key)
         if self._trace_enabled:
             obs_trace.disable()
@@ -256,12 +299,17 @@ class Router:
                     REGISTRY.inc("blaze_router_conn_pool_waits",
                                  replica=rid)
                 cv.wait(timeout=0.1)
+            epoch = self._client_epoch.get(rid, 0)
 
         def _discard(client) -> None:
             with cv:
-                self._client_counts[rid] = max(
-                    0, self._client_counts.get(rid, 1) - 1
-                )
+                if self._client_epoch.get(rid, 0) == epoch:
+                    # only the epoch that counted this client may
+                    # un-count it: a post-LEAVE epoch starts from 0
+                    # and must not absorb a stale client's release
+                    self._client_counts[rid] = max(
+                        0, self._client_counts.get(rid, 1) - 1
+                    )
                 cv.notify()
             if client is not None:
                 try:
@@ -288,8 +336,21 @@ class Router:
             _discard(c)
             raise
         with cv:
-            self._clients.setdefault(rid, []).append(c)
+            if self._client_epoch.get(rid, 0) != epoch:
+                # the replica LEFT while this verb was in flight: the
+                # pool purge could not see the checked-out client, so
+                # check-in closes it instead of handing a socket to
+                # the dead process to whoever re-joins at the address
+                c, stale = None, c
+            else:
+                self._clients.setdefault(rid, []).append(c)
+                stale = None
             cv.notify()
+        if stale is not None:
+            try:
+                stale.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
         return out
 
     # -- bookkeeping -----------------------------------------------------
@@ -531,12 +592,27 @@ class Router:
                         hop.tag(inband_error=True)
                         return resp
                     if resp.get("state") == "REJECTED_OVERLOADED":
+                        draining = _is_draining_rejection(resp)
+                        if draining:
+                            # the replica announced a drain the next
+                            # STATS poll has not delivered yet: stop
+                            # placing here NOW. A placement miss like
+                            # any backpressure - spill, zero breaker
+                            # strikes (the replica is healthy, just
+                            # leaving)
+                            replica.draining = True
+                            self.registry.note_membership(
+                                "drain_reject", replica.replica_id
+                            )
+                            with self._lock:
+                                self.counters["drain_spills"] += 1
                         log.info(
-                            "replica %s rejected %s (overloaded); "
-                            "spilling",
+                            "replica %s rejected %s (%s); spilling",
                             replica.replica_id, rq.external_id,
+                            "draining" if draining else "overloaded",
                         )
-                        hop.tag(overflow_spill=True)
+                        hop.tag(overflow_spill=True,
+                                draining=draining or None)
                         place_sp.event(
                             "overflow_spill",
                             replica=replica.replica_id,
@@ -572,6 +648,14 @@ class Router:
                     # the replica whose ResultCache holds the result
                     self.affinity.record(
                         rq.key, replica.replica_id, rq.fingerprint
+                    )
+                    # hot-result replication payload capture: if this
+                    # fingerprint ranks hot, the replicator re-places
+                    # these bytes on a second replica
+                    self.hot.note_submit(
+                        rq.key, rq.fingerprint, rq.task_bytes,
+                        rq.is_ref, rq.manifest_bytes,
+                        replica.replica_id,
                     )
                 return resp
             if rejected_err is not None:
@@ -694,15 +778,125 @@ class Router:
             name=f"blaze-router-cancel-{replica.replica_id}",
         ).start()
 
-    def _on_replica_dead_async(self, replica: Replica) -> None:
+    # -- membership ------------------------------------------------------
+    def membership(self, payload: dict) -> dict:
+        """The MEMBER verb: JOIN/LEAVE from replicas (announced by
+        router/membership.MembershipAnnouncer). The `router.membership`
+        chaos seam fires on every frame, so dropped JOINs, LEAVE races
+        and flapping replicas are chaos-testable like every other
+        failure path."""
+        op = str(payload.get("op", ""))
+        try:
+            host, port = parse_replica(
+                "%s:%s" % (payload.get("host"), payload.get("port"))
+            )
+        except (ValueError, TypeError):
+            return {"error": f"membership: bad address in {payload!r}"}
+        rid = f"{host}:{port}"
+        if chaos.ACTIVE:
+            # DROP = the ack never reaches the replica (announcer
+            # retries next tick); STALL = a slow membership authority
+            chaos.fire("router.membership", op=op, replica=rid)
+        if op == "join":
+            return self._member_join(host, port)
+        if op == "leave":
+            return self._member_leave(
+                rid, str(payload.get("reason") or "leave")
+            )
+        return {"error": f"membership: unknown op {op!r}"}
+
+    def _member_join(self, host: str, port: int) -> dict:
+        r, created = self.registry.add((host, port))
+        rid = r.replica_id
+        self._client_cv.setdefault(rid, threading.Condition())
+        if created and not r.alive:
+            # one synchronous probe so the ack implies routability -
+            # a joining replica takes traffic NOW, not a poll tick
+            # from now
+            try:
+                self.registry.probe(rid)
+            except Exception:  # noqa: BLE001 - the poller retries
+                pass
+        return {
+            "ok": True,
+            "replica": rid,
+            "created": created,
+            "state": r.membership_state(),
+            "fleet": len(self.registry.replicas),
+        }
+
+    def _member_leave(self, rid: str, reason: str) -> dict:
+        r = self.registry.remove(rid, reason="leave")
+        if r is None:
+            # LEAVE of an unknown (or already-left) replica: ack -
+            # the desired end state already holds
+            return {"ok": True, "replica": rid, "known": False}
+        self._evict_and_promote(rid)
+        # drop the pooled verb clients: the address may be reused by
+        # a restarted replica that must start on fresh connections
+        cv = self._client_cv.get(rid)
+        if cv is not None:
+            with cv:
+                idle = self._clients.pop(rid, [])
+                self._client_counts.pop(rid, None)
+                # epoch bump: clients currently CHECKED OUT (invisible
+                # to this purge) close at check-in instead of being
+                # pooled for whoever re-joins at this address
+                self._client_epoch[rid] = (
+                    self._client_epoch.get(rid, 0) + 1
+                )
+                cv.notify_all()
+            for c in idle:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+        # a LEAVE racing in-flight queries (crash-leave, drain
+        # timeout): re-route them like a death would
+        with self._lock:
+            stranded = any(
+                rq.replica_id == rid and not rq.finished
+                for rq in self._queries.values()
+            )
+        if stranded:
+            threading.Thread(
+                target=self._on_replica_dead, args=(r,), daemon=True,
+                name=f"blaze-router-failover-{rid}",
+            ).start()
+        return {
+            "ok": True, "replica": rid, "known": True,
+            "reason": reason,
+        }
+
+    def _evict_and_promote(self, replica_id: str) -> None:
+        """Departure bookkeeping (LEAVE or heartbeat death): evict the
+        replica's AffinityMap entries eagerly - instead of letting
+        each decay into a failed placement + failover - then promote
+        confirmed hot-result secondaries to the affinity home so
+        repeats stay warm on the survivor."""
+        evicted = self.affinity.evict_replica(replica_id)
+        if evicted:
+            REGISTRY.inc("blaze_router_affinity_evictions_total",
+                         evicted)
+        promoted = self.hot.on_replica_gone(replica_id)
+        log.info(
+            "replica %s departed: %d affinity entries evicted, %d "
+            "hot fingerprints promoted to survivors",
+            replica_id, evicted, len(promoted),
+        )
+
+    def _on_replica_departed_async(self, replica: Replica) -> None:
         """Registry death callback: the re-route sweep performs
         downstream submits (seconds per query against a slow fleet)
-        and the registry has a single POLL thread - detach the sweep
-        so heartbeat polling never stalls behind failover work (a
-        second concurrent death must still be detected while the
-        first one's queries move). The breaker-trip path calls
-        _on_replica_dead directly: there the cost lands on the
-        client-serving thread that observed the fatal failure."""
+        and the registry poller must not stall behind failover work
+        (a second concurrent death must still be detected while the
+        first one's queries move) - detach the sweep. Affinity
+        eviction + hot promotion run inline first: they are lock-bound
+        and the next submit must already see the re-pointed fleet.
+        The breaker-trip path calls _on_replica_dead directly: a
+        quarantine is a cool-off, not a departure - affinity state
+        survives it."""
+        self._evict_and_promote(replica.replica_id)
         threading.Thread(
             target=self._on_replica_dead, args=(replica,),
             daemon=True,
@@ -978,6 +1172,8 @@ class Router:
         fleet = {
             "replicas": len(self.registry.replicas),
             "alive": 0,
+            "draining": 0,
+            "departed": len(self.registry.departed),
             "queued": 0,
             "running": 0,
             "headroom_bytes": 0,
@@ -987,6 +1183,8 @@ class Router:
         for r in self.registry.replicas.values():
             if r.alive:
                 fleet["alive"] += 1
+            if r.draining:
+                fleet["draining"] += 1
             if r.stats is None:
                 continue
             a = r.stats.get("admission", {})
@@ -1016,6 +1214,10 @@ class Router:
             },
             "replicas": self.registry.snapshot(),
             "fleet": fleet,
+            # hot-result replication state (replication.py): which
+            # fingerprints hold a confirmed second copy - the churn
+            # tests and dashboards wait on this
+            "hot": self.hot.snapshot(),
             # this process's per-phase rollup (the `router` phase for
             # proxied queries; regress can diff a live router too)
             "phases": obs_phases.ROLLUP.snapshot(max_classes=6),
@@ -1288,8 +1490,10 @@ class RouterVerbBackend:
     """The Router behind the shared verb loop
     (service/wire.serve_verb_connection): the same protocol skeleton
     as a single serve instance with the routing table behind every
-    verb. Non-detached queries submitted on a connection are cancelled
-    (on their replicas) when the client vanishes."""
+    verb - plus MEMBER, where the router is the fleet's membership
+    authority (a bare serve instance answers it with an in-band
+    error). Non-detached queries submitted on a connection are
+    cancelled (on their replicas) when the client vanishes."""
 
     def __init__(self, router: Router):
         self.router = router
@@ -1315,6 +1519,9 @@ class RouterVerbBackend:
 
     def metrics_frame(self) -> dict:
         return {"metrics": self.router.metrics()}
+
+    def member_frame(self, payload: dict) -> dict:
+        return self.router.membership(payload)
 
     def abandon(self, qid: str) -> None:
         try:

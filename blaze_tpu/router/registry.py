@@ -25,6 +25,7 @@ the existing Prometheus exposition.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -37,7 +38,16 @@ log = logging.getLogger("blaze_tpu.router")
 
 
 class Replica:
-    """One serve instance: address + last-known shape + health."""
+    """One serve instance: address + last-known shape + health.
+
+    Membership lifecycle (docs/ROUTER.md):
+
+        joining -> alive <-> quarantined
+                     |  \\-> draining -> gone (LEAVE)
+                     '-> gone (LEAVE / removal)
+
+    `membership_state()` derives the label STATS and the
+    `blaze_router_replica_membership` gauge expose."""
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -52,6 +62,14 @@ class Replica:
         self.quarantine_reason: Optional[str] = None
         self.poll_failures = 0      # consecutive
         self.in_flight = 0          # router-tracked live routed queries
+        # DRAINING (rolling restart): announced through the replica's
+        # STATS `service.draining` flag, or observed directly from a
+        # DRAINING submit rejection - either way NEW placements stop
+        # while in-flight POLL/FETCH keep working
+        self.draining = False
+        # set when the replica LEFT (or was removed): the record may
+        # linger in the registry's departed ring for STATS visibility
+        self.departed = False
         self._client = None         # poll-loop ServiceClient
         self._lock = threading.Lock()
         # serializes whole poll round trips (the background loop vs. a
@@ -59,6 +77,9 @@ class Replica:
         # thread-safe - two threads recv-ing one socket steal each
         # other's frames. Never taken by the verb hot paths.
         self._poll_lock = threading.Lock()
+        # per-replica poller shutdown: dynamic membership stops ONE
+        # replica's poller on LEAVE without a registry-wide barrier
+        self._stop = threading.Event()
 
     def note_routed(self) -> None:
         """Count one routed query (locked: submit handlers race)."""
@@ -75,7 +96,30 @@ class Replica:
             < self.quarantined_until
 
     def routable(self, now: Optional[float] = None) -> bool:
-        return self.alive and not self.quarantined(now)
+        # draining replicas keep answering POLL/FETCH for in-flight
+        # queries but take no NEW placements
+        return (
+            self.alive and not self.quarantined(now)
+            and not self.draining and not self.departed
+        )
+
+    def membership_state(self, now: Optional[float] = None) -> str:
+        """joining | alive | draining | quarantined | gone - the
+        membership label on STATS snapshots and the
+        blaze_router_replica_membership gauge."""
+        if self.departed:
+            return "gone"
+        if self.draining and self.alive:
+            return "draining"
+        if self.quarantined(now) or (self.ever_alive
+                                     and not self.alive):
+            # breaker-open, OR heartbeat-dead (still dead past the
+            # quarantine window = still effectively quarantined; the
+            # next successful poll revives it)
+            return "quarantined"
+        if self.alive:
+            return "alive"
+        return "joining"
 
     def stats_age_s(self, now: Optional[float] = None) -> float:
         if self.stats is None:
@@ -118,6 +162,7 @@ class Replica:
         now = now if now is not None else time.monotonic()
         out = {
             "alive": self.alive,
+            "state": self.membership_state(now),
             "quarantined": self.quarantined(now),
             "in_flight": self.in_flight,
             "poll_failures": self.poll_failures,
@@ -155,7 +200,16 @@ class ReplicaRegistry:
     `poll_now()` runs one synchronous round for tests and the CLI's
     startup probe. Death and revival fire the registered callbacks
     exactly once per transition - the router uses on_dead to re-route
-    a dead replica's in-flight queries."""
+    a dead replica's in-flight queries.
+
+    Membership is DYNAMIC (ROADMAP item 4): `add()` registers a
+    JOINing replica (spinning up its poller when the registry is
+    started) and `remove()` retires a LEAVing one (its poller stops at
+    the next tick, its record moves to the bounded `departed` ring for
+    STATS visibility). The constructor's replica list is only the
+    BOOTSTRAP hint - the fleet the router actually routes to is
+    whatever joined minus whatever left. Every membership transition
+    lands on the `blaze_router_membership_events{kind=...}` counter."""
 
     def __init__(
         self,
@@ -187,7 +241,18 @@ class ReplicaRegistry:
         self.on_revive = on_revive
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._threads: Dict[str, threading.Thread] = {}
+        # pollers of removed replicas, kept until close() joins them
+        # (they exit at their next tick; the flapping tests assert
+        # none leak)
+        self._retired: List[threading.Thread] = []
+        # LEFT replicas, bounded ring: rid -> (Replica, departed_at) -
+        # STATS keeps showing them as state=gone so churn is visible,
+        # not inferable only from scrape gaps
+        self.departed: "collections.OrderedDict[str, Tuple[Replica, float]]" = (
+            collections.OrderedDict()
+        )
         self._collector_key = f"router-registry:{id(self):x}"
         REGISTRY.register_collector(
             self._collector_key, self._collect_metrics
@@ -202,25 +267,37 @@ class ReplicaRegistry:
         membership (ROADMAP item 4): with per-replica pollers, a
         joining replica is one new thread and a leaving one is one
         stopped thread, no round choreography."""
-        if not self._threads:
-            self._threads = [
-                threading.Thread(
-                    target=self._poller_loop, args=(r,), daemon=True,
-                    name=f"blaze-router-poll-{r.replica_id}",
-                )
-                for r in self.replicas.values()
-            ]
-            for t in self._threads:
-                t.start()
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        for r in list(self.replicas.values()):
+            self._spawn_poller(r)
         return self
+
+    def _spawn_poller(self, r: Replica) -> None:
+        with self._lock:
+            if self._stop.is_set() or r.replica_id in self._threads:
+                return
+            t = threading.Thread(
+                target=self._poller_loop, args=(r,), daemon=True,
+                name=f"blaze-router-poll-{r.replica_id}",
+            )
+            self._threads[r.replica_id] = t
+        t.start()
 
     def close(self) -> None:
         self._stop.set()
-        for t in self._threads:
+        with self._lock:
+            threads = list(self._threads.values()) + self._retired
+            self._threads = {}
+            self._retired = []
+        for r in list(self.replicas.values()):
+            r._stop.set()
+        for t in threads:
             t.join(timeout=5)
-        self._threads = []
         REGISTRY.unregister_collector(self._collector_key)
-        for r in self.replicas.values():
+        for r in list(self.replicas.values()):
             c, r._client = r._client, None
             if c is not None:
                 try:
@@ -228,13 +305,90 @@ class ReplicaRegistry:
                 except Exception:  # noqa: BLE001 - teardown
                     pass
 
+    # -- dynamic membership ----------------------------------------------
+    def note_membership(self, kind: str, replica_id: str) -> None:
+        """One membership transition onto the fleet-view counter. kind:
+        join | rejoin | leave | drain | drain_reject | dead | revive."""
+        REGISTRY.inc("blaze_router_membership_events", kind=kind)
+        log.info("membership %s: %s", kind, replica_id)
+
+    def add(self, spec) -> Tuple[Replica, bool]:
+        """JOIN: register a replica (idempotent - the announcer
+        re-JOINs periodically so a restarted router re-learns the
+        fleet). Returns (replica, created); a poller spins up when the
+        registry is started and membership counters fire only on real
+        transitions, never on idempotent re-announcements."""
+        host, port = parse_replica(spec)
+        rid = f"{host}:{port}"
+        created = False
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None:
+                r = Replica(host, port)
+                created = True
+                # atomic dict swap: readers iterate a stable snapshot
+                # (routable()/snapshot()/metrics run lock-free)
+                m = dict(self.replicas)
+                m[rid] = r
+                self.replicas = m
+                rejoined = self.departed.pop(rid, None) is not None
+            started = self._started
+        if created:
+            self.note_membership("rejoin" if rejoined else "join",
+                                 rid)
+            if started:
+                self._spawn_poller(r)
+        return r, created
+
+    def remove(self, replica_id: str,
+               reason: str = "leave") -> Optional[Replica]:
+        """LEAVE (or forced removal): retire the replica - stop its
+        poller at the next tick, close its poll client, and move the
+        record to the bounded departed ring (state=gone on STATS)."""
+        with self._lock:
+            r = self.replicas.get(replica_id)
+            if r is None:
+                return None
+            m = dict(self.replicas)
+            m.pop(replica_id, None)
+            self.replicas = m
+            r.departed = True
+            r.alive = False
+            r._stop.set()
+            t = self._threads.pop(replica_id, None)
+            if t is not None:
+                self._retired.append(t)
+            self.departed[replica_id] = (r, time.monotonic())
+            while len(self.departed) > 64:
+                self.departed.popitem(last=False)
+            c, r._client = r._client, None
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        self.note_membership(reason, replica_id)
+        return r
+
+    def probe(self, replica_id: str) -> bool:
+        """One synchronous poll of a single replica (the JOIN ack
+        path: a joining replica becomes routable without waiting a
+        poller tick). True when the poll succeeded."""
+        r = self.replicas.get(replica_id)
+        if r is None:
+            return False
+        self._poll_one(r)
+        return r.alive
+
     # -- polling ---------------------------------------------------------
     def _poller_loop(self, r: Replica) -> None:
         """One replica's long-lived poller: independent cadences mean
         a black-holing host delays only ITS OWN snapshot - healthy
         replicas keep their freshness and death-detection latency no
-        matter how many peers are wedged."""
-        while not self._stop.wait(self.poll_interval_s):
+        matter how many peers are wedged. Polls FIRST, then sleeps: a
+        JOINing replica is routable within one round trip, not one
+        interval."""
+        while not (self._stop.is_set() or r._stop.is_set()):
             t0 = time.monotonic()
             try:
                 self._poll_one(r)
@@ -244,6 +398,8 @@ class ReplicaRegistry:
                 "blaze_router_poll_round_seconds",
                 time.monotonic() - t0, replica=r.replica_id,
             )
+            if r._stop.wait(self.poll_interval_s):
+                break
 
     def poll_now(self) -> None:
         """One synchronous STATS round across the fleet - the manual
@@ -277,6 +433,11 @@ class ReplicaRegistry:
     def _poll_one_locked(self, r: Replica) -> None:
         from blaze_tpu.service.wire import ServiceClient
 
+        if r.departed:
+            # a straggler round racing remove() must not resurrect a
+            # replica that LEFT (its record lives on in the departed
+            # ring only for STATS visibility)
+            return
         try:
             # the connect + STATS round trip runs OUTSIDE r._lock:
             # note_routed/note_unrouted take that lock on the submit
@@ -315,7 +476,17 @@ class ReplicaRegistry:
         r.stats_at = time.monotonic()
         r.liveness.note_progress()
         REGISTRY.inc("blaze_router_polls_total", outcome="ok")
+        # membership: the replica's own DRAINING announcement (rolling
+        # restart). Flipping it stops NEW placements one poll after
+        # SIGTERM landed; clearing happens if the drain was aborted.
+        was_draining = r.draining
+        r.draining = bool(
+            (stats.get("service") or {}).get("draining")
+        )
+        if r.draining and not was_draining:
+            self.note_membership("drain", r.replica_id)
         if not r.alive:
+            first_contact = not r.ever_alive
             r.alive = True
             r.ever_alive = True
             if r.quarantine_reason == "heartbeat-dead":
@@ -325,6 +496,9 @@ class ReplicaRegistry:
                 r.quarantined_until = 0.0
                 r.quarantine_reason = None
             log.info("replica %s alive", r.replica_id)
+            self.note_membership(
+                "alive" if first_contact else "revive", r.replica_id
+            )
             if self.on_revive is not None:
                 try:
                     self.on_revive(r)
@@ -339,6 +513,7 @@ class ReplicaRegistry:
                     r.replica_id, cause)
         REGISTRY.inc("blaze_router_replica_deaths_total",
                      replica=r.replica_id)
+        self.note_membership("dead", r.replica_id)
         if self.on_dead is not None:
             try:
                 self.on_dead(r)
@@ -377,6 +552,12 @@ class ReplicaRegistry:
                             1 if r.quarantined(now) else 0, "gauge"))
             samples.append(("blaze_router_replica_in_flight", lab,
                             r.in_flight, "gauge"))
+            # the membership `state` label: churn renders on the
+            # scrape surface, not just as scrape gaps
+            samples.append((
+                "blaze_router_replica_membership",
+                {**lab, "state": r.membership_state(now)}, 1, "gauge",
+            ))
             if r.stats is not None:
                 a = r.stats.get("admission", {})
                 samples.append(
@@ -385,11 +566,28 @@ class ReplicaRegistry:
                 samples.append(
                     ("blaze_router_replica_headroom_bytes", lab,
                      r.effective_headroom() or 0, "gauge"))
+        with self._lock:
+            gone = list(self.departed)
+        for rid in gone:
+            samples.append((
+                "blaze_router_replica_membership",
+                {"replica": rid, "state": "gone"}, 1, "gauge",
+            ))
         return samples
 
     def snapshot(self) -> Dict[str, dict]:
         now = time.monotonic()
-        return {
+        out = {
             rid: r.snapshot(now)
             for rid, r in self.replicas.items()
         }
+        with self._lock:
+            gone = [(rid, at) for rid, (_r, at)
+                    in self.departed.items()]
+        for rid, at in gone:
+            out.setdefault(rid, {
+                "state": "gone",
+                "alive": False,
+                "departed_age_s": round(now - at, 3),
+            })
+        return out

@@ -1,0 +1,278 @@
+"""Replicated hot results: a replica death must not cold-start the
+working set.
+
+Affinity placement (router/placement.py) concentrates repeats of a
+plan on ONE replica so its ResultCache answers them with zero kernel
+dispatches - which also concentrates the blast radius: kill that
+replica and every repeat of its hot plans re-executes cold elsewhere.
+This module closes the gap by DOUBLE-PLACING the hottest fingerprints:
+
+  rank     the per-fingerprint sample counts + p50s the registry
+           already polls off every replica's STATS (`runtime_history.
+           top`, obs/history.py) are summed fleet-wide; the top-K by
+           (samples x p50) - the re-execution cost a death would
+           charge - are "hot".
+  warm     for each hot fingerprint whose payload the router has seen
+           (it keeps the raw SUBMIT blob per routed query), submit the
+           SAME task bytes to a SECOND replica (use_cache=True,
+           detach=True, straight down the pooled verb client - never
+           through the routing table) and confirm it reached DONE:
+           the secondary's ResultCache now holds the same
+           (fingerprint, partition) entries.
+  promote  on the home replica's departure (LEAVE or heartbeat death,
+           after the eager AffinityMap eviction) the confirmed
+           secondary is recorded as the NEW affinity home, so the next
+           repeat is a warm cache hit on the survivor - 0 dispatches -
+           instead of a cold re-execution.
+
+Everything is bounded: at most `max_entries` tracked payloads (LRU),
+`top_k` fingerprints replicated, one replication in flight at a time
+(the tick runs on the router's background thread). Replication is an
+OPTIMIZATION layered on the existing failover story - losing both
+copies still just re-executes; correctness never depends on it.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.router.placement import choose_replica
+
+log = logging.getLogger("blaze_tpu.router")
+
+
+class _HotEntry:
+    """One stable-fingerprint plan the router can re-place: the raw
+    submit payload plus where its result lives."""
+
+    __slots__ = ("key", "task_bytes", "is_ref", "manifest_bytes",
+                 "home", "secondary")
+
+    def __init__(self, key: str, task_bytes: bytes, is_ref: bool,
+                 manifest_bytes: Optional[bytes], home: str):
+        self.key = key
+        self.task_bytes = task_bytes
+        self.is_ref = is_ref
+        self.manifest_bytes = manifest_bytes
+        self.home = home
+        self.secondary: Optional[str] = None  # CONFIRMED copy holder
+
+
+class HotReplicator:
+    """Top-K hot-fingerprint double-placement for a Router."""
+
+    def __init__(self, router, top_k: int = 4, max_entries: int = 128,
+                 min_samples: int = 2, confirm_timeout_s: float = 30.0):
+        self.router = router
+        self.top_k = int(top_k)
+        self.max_entries = int(max_entries)
+        self.min_samples = int(min_samples)
+        self.confirm_timeout_s = float(confirm_timeout_s)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _HotEntry]" = (
+            collections.OrderedDict()
+        )
+        self.counters = {
+            "replicated": 0,    # confirmed secondary placements
+            "promoted": 0,      # secondary -> affinity home on death
+            "failures": 0,      # replication submits that went wrong
+        }
+
+    # -- payload capture -------------------------------------------------
+    def note_submit(self, key: str, fingerprint: Optional[str],
+                    task_bytes: bytes, is_ref: bool,
+                    manifest_bytes: Optional[bytes],
+                    replica_id: str) -> None:
+        """Called by the router after every successful placement of a
+        stable-fingerprint plan: remember the payload + home so a hot
+        fingerprint can be re-placed without any client involvement."""
+        if not fingerprint:
+            return
+        with self._lock:
+            ent = self._entries.get(fingerprint)
+            if ent is None:
+                ent = _HotEntry(key, task_bytes, is_ref,
+                                manifest_bytes, replica_id)
+                self._entries[fingerprint] = ent
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)
+            else:
+                ent.key = key
+                if ent.home != replica_id:
+                    # the fleet moved this plan (spill, failover): if
+                    # it landed on the confirmed secondary, the OLD
+                    # home becomes the surviving copy - keep it
+                    old_home = ent.home
+                    ent.home = replica_id
+                    ent.secondary = (
+                        old_home if replica_id == ent.secondary
+                        else None
+                    )
+            self._entries.move_to_end(fingerprint)
+
+    # -- ranking ---------------------------------------------------------
+    def rank_hot(self) -> List[str]:
+        """Fleet-wide hotness from the per-replica STATS snapshots the
+        registry already polls: sum each fingerprint's lifetime sample
+        count per replica, weight by its p50 (frequency x unit cost =
+        what a cold re-execution of the working set would charge)."""
+        scores: Dict[str, float] = {}
+        samples: Dict[str, int] = {}
+        for r in list(self.router.registry.replicas.values()):
+            if not r.alive or r.stats is None:
+                continue
+            top = (r.stats.get("runtime_history") or {}).get("top", ())
+            for e in top:
+                fp = e.get("fp")
+                if not fp:
+                    continue
+                n = int(e.get("samples", e.get("n", 0)) or 0)
+                p50 = float(e.get("p50", 0.0) or 0.0)
+                samples[fp] = samples.get(fp, 0) + n
+                scores[fp] = scores.get(fp, 0.0) \
+                    + n * max(p50, 1e-6)
+        hot = [
+            fp for fp in sorted(scores, key=lambda f: -scores[f])
+            if samples.get(fp, 0) >= self.min_samples
+        ]
+        return hot[:max(0, self.top_k)]
+
+    # -- replication -----------------------------------------------------
+    def tick(self) -> int:
+        """One replication pass: give every un-replicated hot
+        fingerprint a confirmed second copy. Returns how many
+        replications were confirmed this pass."""
+        if self.top_k <= 0:
+            return 0
+        done = 0
+        for fp in self.rank_hot():
+            with self._lock:
+                ent = self._entries.get(fp)
+            if ent is None:
+                continue  # hot, but the payload predates this router
+            registry = self.router.registry
+            home = registry.get(ent.home)
+            if home is None or not home.alive:
+                continue  # departure path owns promotion, not tick
+            if ent.secondary:
+                sec = registry.get(ent.secondary)
+                if sec is not None and sec.routable():
+                    continue  # already double-placed and healthy
+            if self._replicate(fp, ent):
+                done += 1
+        return done
+
+    def _replicate(self, fp: str, ent: _HotEntry) -> bool:
+        """Place one copy of `ent` on a replica other than its home
+        and confirm DONE (the secondary's cache now holds the result).
+        Never touches the routing table: replication traffic has no
+        client handle to track or fail over."""
+        decision = choose_replica(
+            self.router.registry, self.router.affinity, ent.key,
+            fingerprint=fp, exclude={ent.home}, use_affinity=False,
+        )
+        if decision is None:
+            return False  # nobody to replicate to (fleet of one)
+        target = decision.replica
+        meta = {"use_cache": True, "detach": True}
+        try:
+            resp = self.router._call(
+                target,
+                lambda c: c.submit_raw(
+                    ent.task_bytes, meta=meta, is_ref=ent.is_ref,
+                    manifest_bytes=ent.manifest_bytes,
+                ),
+            )
+            qid = resp.get("query_id")
+            if qid is None or resp.get("state") in (
+                "REJECTED_OVERLOADED", "FAILED",
+            ):
+                return False  # busy/draining target: next tick retries
+            deadline = time.monotonic() + self.confirm_timeout_s
+            while time.monotonic() < deadline:
+                st = self.router._call(
+                    target, lambda c: c.poll(qid)
+                )
+                state = st.get("state")
+                if state == "DONE":
+                    break
+                if state in ("FAILED", "CANCELLED", "TIMED_OUT",
+                             "REJECTED_OVERLOADED", None):
+                    return False
+                time.sleep(0.05)
+            else:
+                return False
+        except Exception as e:  # noqa: BLE001 - replication is an
+            # optimization: a failing target is the failover tier's
+            # problem, never the tick loop's
+            with self._lock:
+                self.counters["failures"] += 1
+            log.warning("hot replication of %s to %s failed: %r",
+                        fp[:16], target.replica_id, e)
+            return False
+        with self._lock:
+            # re-read: a concurrent note_submit may have moved home
+            cur = self._entries.get(fp)
+            if cur is None or cur.home == target.replica_id:
+                return False
+            cur.secondary = target.replica_id
+            self.counters["replicated"] += 1
+        REGISTRY.inc("blaze_router_hot_replications_total")
+        log.info("hot fingerprint %s replicated %s -> %s",
+                 fp[:16], ent.home, target.replica_id)
+        return True
+
+    # -- departure -------------------------------------------------------
+    def on_replica_gone(self, replica_id: str) -> List[Tuple[str, str]]:
+        """Departure hook (run AFTER AffinityMap.evict_replica): every
+        hot fingerprint homed on the departed replica with a confirmed
+        surviving secondary is re-pointed there - the next repeat hits
+        the survivor's warm cache instead of cold-starting. Returns
+        [(fingerprint, new_home)]."""
+        promoted: List[Tuple[str, str, str]] = []
+        with self._lock:
+            for fp, ent in self._entries.items():
+                if ent.secondary == replica_id:
+                    ent.secondary = None
+                if ent.home != replica_id:
+                    continue
+                sec = ent.secondary
+                if not sec:
+                    continue
+                sr = self.router.registry.get(sec)
+                if sr is None or not sr.alive:
+                    continue
+                ent.home, ent.secondary = sec, None
+                promoted.append((fp, ent.key, sec))
+                self.counters["promoted"] += 1
+        out = []
+        for fp, key, new_home in promoted:
+            self.router.affinity.record(key, new_home, fp)
+            REGISTRY.inc("blaze_router_hot_promotions_total")
+            log.info("hot fingerprint %s promoted to survivor %s "
+                     "after %s departed", fp[:16], new_home,
+                     replica_id)
+            out.append((fp, new_home))
+        return out
+
+    # -- exposition ------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                **self.counters,
+                "tracked": len(self._entries),
+                "top_k": self.top_k,
+                # FULL fingerprints, same lesson as obs/history's `fp`
+                # field: content fingerprints share long op-name
+                # prefixes, so a truncated list is a colliding
+                # constant, not an identifier
+                "replicated_fps": sorted(
+                    fp for fp, e in self._entries.items()
+                    if e.secondary
+                ),
+            }

@@ -196,6 +196,19 @@ class TaskGatewayServer:
         self._thread.start()
         return self
 
+    def serve_blocking(self) -> None:
+        """Run the accept loop on the CALLING thread (the CLI shape).
+        Mutually exclusive with start(): two accept loops on one
+        listener race on every connection, and the loser blocks in
+        accept() forever. Returns after shutdown()."""
+        self._srv.serve_forever()
+
+    def shutdown(self) -> None:
+        """Stop the accept loop (serve_blocking returns / the start()
+        thread exits) without closing the listener; safe from any
+        thread - the drain path calls it once the service is empty."""
+        self._srv.shutdown()
+
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()

@@ -145,6 +145,10 @@ class QueryService:
             self._collector_key, self._collect_metrics
         )
         self._closed = False
+        # DRAINING (rolling-restart shutdown, docs/ROUTER.md): new
+        # SUBMITs are refused with a classified TRANSIENT rejection
+        # while in-flight queries run to completion; drain() flips it
+        self.draining = False
         self._queries: Dict[str, Query] = {}
         self._order: List[str] = []  # retention ring
         # request coalescing (ROADMAP scan-sharing first step): one
@@ -198,6 +202,8 @@ class QueryService:
             use_cache=use_cache,
         )
         self._attach_obs(q)
+        if self.draining:
+            return self._reject_draining(q)
         try:
             if is_ref:
                 from blaze_tpu.plan.refcompat import (
@@ -253,10 +259,58 @@ class QueryService:
             use_cache=use_cache,
         )
         self._attach_obs(q)
+        if self.draining:
+            return self._reject_draining(q)
         q._decoded = None
         q._fingerprint = plan.fingerprint()
         q._fingerprint_stable = plan.fingerprint_is_stable()
         return self._enqueue(q)
+
+    def _reject_draining(self, q: Query) -> Query:
+        """DRAINING rejection: classified TRANSIENT so a bare client
+        retries with backoff (the replica or its rolling-restart
+        replacement comes back) and a fronting router treats it as a
+        placement miss (spill to the next replica, zero breaker
+        strikes). The 'DRAINING:' error prefix is the wire marker both
+        consumers key on."""
+        q.error = (
+            "DRAINING: replica is draining (rolling restart); "
+            "resubmit elsewhere or retry with backoff"
+        )
+        q.error_class = ErrorClass.TRANSIENT.value
+        q.transition(QueryState.REJECTED_OVERLOADED)
+        self._register(q)
+        return q
+
+    def drain(self, timeout_s: Optional[float] = None,
+              poll_s: float = 0.05) -> bool:
+        """Enter DRAINING and block until every live query reached a
+        terminal state (True) or `timeout_s` elapsed (False, still
+        draining - the caller decides whether to hard-stop). New
+        SUBMITs are refused from the moment this is called; POLL /
+        FETCH / CANCEL keep working so clients can collect results
+        already in flight."""
+        self.draining = True
+        REGISTRY.inc("blaze_service_drains_total")
+        log.info("service draining: refusing new submits, waiting "
+                 "for in-flight queries")
+        deadline = (
+            time.monotonic() + timeout_s
+            if timeout_s is not None else None
+        )
+        while True:
+            with self._lock:
+                live = sum(
+                    1 for q in self._queries.values() if not q.done
+                )
+            if not live:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                log.warning(
+                    "drain timed out with %d live queries", live
+                )
+                return False
+            time.sleep(poll_s)
 
     def _attach_obs(self, q: Query) -> None:
         """Arm per-query observability BEFORE any transition can fire:
@@ -403,6 +457,10 @@ class QueryService:
                 "slow_query_s": self.slow_query_s,
                 "trace_enabled": self._trace_enabled,
                 "mesh_mode": self.mesh_mode or "env",
+                # membership signal: the router's registry poller
+                # reads this to mark the replica DRAINING (unroutable
+                # for NEW placements) before any submit bounces
+                "draining": self.draining,
             },
         }
         if self.cache is not None:

@@ -12,6 +12,7 @@ Service framing (all integers LE):
 
   hello:    u64 header with _FLAG_SERVICE set (rest of the bits 0)
   verb:     u8   SUBMIT=1 POLL=2 FETCH=3 CANCEL=4 REPORT=5 STATS=6
+                 METRICS=7 MEMBER=8
   SUBMIT:   u32 meta_len | meta JSON | u64 blob_header | [u32 mlen |
             manifest JSON] | blob
             blob_header reuses the legacy bits: bit 63 = reference wire
@@ -40,6 +41,14 @@ Service framing (all integers LE):
             Prometheus text exposition from the process registry
             (obs/metrics.py), folding dispatch.*, admission, cache,
             and query-lifecycle counters
+  MEMBER:   u32 len | JSON    -> JSON frame - fleet membership
+            (router/membership.py): {"op": "join"|"leave", "host",
+            "port", ...}. A freshly started serve replica JOINs the
+            router it fronts for (re-announced periodically, so a
+            restarted router re-learns the fleet); a drained replica
+            LEAVEs when empty. Only the router tier is a membership
+            authority - a serve instance answers with an in-band
+            error.
   JSON frame: u32 len | utf8 JSON
 
 Session semantics: queries submitted on a connection belong to it;
@@ -76,6 +85,7 @@ VERB_CANCEL = 4
 VERB_REPORT = 5
 VERB_STATS = 6
 VERB_METRICS = 7
+VERB_MEMBER = 8
 
 MAX_META_BYTES = 1 << 20
 # response JSON frames may carry a whole trace document (REPORT);
@@ -90,6 +100,15 @@ class ServiceError(RuntimeError):
     def __init__(self, msg: str):
         super().__init__(msg)
         self.state = msg.split(":", 1)[0] if ":" in msg else ""
+
+
+def _is_draining_rejection(resp: dict) -> bool:
+    """True for the serving tier's DRAINING refusal (the 'DRAINING:'
+    error prefix is the wire marker; service._reject_draining)."""
+    return (
+        resp.get("state") == "REJECTED_OVERLOADED"
+        and str(resp.get("error", "")).startswith("DRAINING")
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +126,7 @@ class ServiceError(RuntimeError):
 #   poll(qid) / cancel(qid) -> status dict
 #   report_frame(qid, flags) -> REPORT response dict
 #   stats() / metrics_frame() -> response dict
+#   member_frame(payload) -> membership response dict (router tier)
 #   fetch(sock, qid, timeout_ms)   owns its own framing (part stream)
 #   abandon(qid)                   session teardown for one query
 
@@ -164,6 +184,9 @@ def serve_verb_connection(sock, backend) -> None:
                     _send_json(
                         sock, _ID_VERBS[verb](backend, qid, flags)
                     )
+                elif verb == VERB_MEMBER:
+                    payload = json.loads(_read_str(sock) or "{}")
+                    _send_json(sock, backend.member_frame(payload))
                 elif verb in _NOARG_VERBS:
                     _read_u32(sock)
                     _send_json(sock, _NOARG_VERBS[verb](backend))
@@ -261,6 +284,11 @@ class ServiceVerbBackend:
         from blaze_tpu.obs.metrics import REGISTRY
 
         return {"metrics": REGISTRY.render_prometheus()}
+
+    def member_frame(self, payload: dict) -> dict:
+        # a single serve instance is not a membership authority - the
+        # router tier (router/proxy.RouterVerbBackend) owns the fleet
+        return {"error": "membership: this endpoint is not a router"}
 
     def abandon(self, qid: str) -> None:
         try:
@@ -541,21 +569,42 @@ class ServiceClient:
         """`detach=True` opts the query out of the server's
         cancel-on-disconnect session semantics, so the handle survives
         a connection drop and this client's reconnect can re-attach
-        by query_id."""
-        return self.submit_raw(
-            task_bytes,
-            meta={
-                "priority": priority,
-                "deadline_s": deadline_s,
-                "estimated_bytes": estimated_bytes,
-                "use_cache": use_cache,
-                "detach": detach,
-            },
-            is_ref=is_ref,
-            manifest_bytes=(
-                json.dumps(manifest).encode("utf-8")
-                if manifest is not None else None
-            ),
+        by query_id.
+
+        A DRAINING rejection (the replica is mid-rolling-restart) is
+        retried with the same bounded backoff as a dropped connection
+        - the replica, or its restarted replacement behind the same
+        address, comes back - and surfaces as a classified TRANSIENT
+        `ReplicaDrainingError` only once the budget is spent
+        (`reconnect_attempts=0` restores fail-fast)."""
+        import random
+
+        meta = {
+            "priority": priority,
+            "deadline_s": deadline_s,
+            "estimated_bytes": estimated_bytes,
+            "use_cache": use_cache,
+            "detach": detach,
+        }
+        manifest_bytes = (
+            json.dumps(manifest).encode("utf-8")
+            if manifest is not None else None
+        )
+        for attempt in range(max(1, self._reconnect_attempts + 1)):
+            resp = self.submit_raw(
+                task_bytes, meta=meta, is_ref=is_ref,
+                manifest_bytes=manifest_bytes,
+            )
+            if not _is_draining_rejection(resp):
+                return resp
+            if attempt >= self._reconnect_attempts:
+                break
+            delay = self._reconnect_backoff_s * (2 ** attempt)
+            time.sleep(random.uniform(delay * 0.5, delay))
+        from blaze_tpu.errors import ReplicaDrainingError
+
+        raise ReplicaDrainingError(
+            resp.get("error", "DRAINING: replica is draining")
         )
 
     def submit_raw(
@@ -612,6 +661,16 @@ class ServiceClient:
         return self._roundtrip(
             bytes([VERB_METRICS]) + _U32.pack(0)
         )["metrics"]
+
+    def member(self, payload: dict) -> dict:
+        """One membership round trip (MEMBER verb): {"op": "join" |
+        "leave", "host", "port", ...} against a router endpoint. The
+        announcer (router/membership.py) drives this; a non-router
+        endpoint answers with an in-band error."""
+        data = json.dumps(payload).encode("utf-8")
+        return self._roundtrip(
+            bytes([VERB_MEMBER]) + _U32.pack(len(data)) + data
+        )
 
     def fetch(self, query_id: str, timeout_ms: int = 0) -> list:
         """Materialize the result stream (list of pa.RecordBatch)."""

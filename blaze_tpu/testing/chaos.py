@@ -42,6 +42,11 @@ Injection sites (each named in docs/ROBUSTNESS.md):
                     (parallel/mesh_exec.py): TRANSIENT propagates to
                     the task-retry tier, any other class degrades the
                     op to its single-device fallback plan
+  router.membership every MEMBER frame the router handles
+                    (router/proxy.py): DROP = a JOIN/LEAVE whose ack
+                    never reaches the replica (the announcer's next
+                    tick retries), STALL = a slow membership
+                    authority widening join/leave race windows
 
 Activation: programmatic `install()`/`active()` (tests), or the
 BLAZE_CHAOS environment variable carrying the plan as JSON - worker

@@ -1,0 +1,315 @@
+"""Fleet churn tests (ISSUE 9 acceptance): rolling restarts must be
+client-invisible.
+
+Two tiers:
+  * in-process (tier-1): a 2-replica fleet behind one Router; each
+    replica is drained (finish in-flight, DRAINING-reject new work),
+    LEAVEs, and a replacement JOINs - all while a repeated-query mix
+    runs through the router. Zero client-visible failures.
+  * subprocess e2e (slow; `run_tests.py --churn`): three `serve`
+    processes that JOIN a bootstrap-empty `route` CLI, SIGTERM-drained
+    and respawned in turn under a live query mix - zero failures,
+    drained replicas rejoin via JOIN - then the affinity home of a hot
+    fingerprint is SIGKILLed and its repeat is served WARM
+    (0 dispatches) from the survivor holding the replicated result.
+"""
+
+import os
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService, ServiceClient
+from tests.test_router import Fleet, _reap, _spawn, wait_done
+from tests.test_service import wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TERMINAL_BAD = ("FAILED", "CANCELLED", "TIMED_OUT",
+                "REJECTED_OVERLOADED")
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(9)
+    p = str(tmp_path / "churn.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 25, 5000), pa.int32()),
+                "v": pa.array(rng.random(5000), pa.float64()),
+            }
+        ),
+        p,
+    )
+
+    def blob(threshold=0.5):
+        from blaze_tpu.exprs import AggExpr, AggFn, Col
+        from blaze_tpu.ops import (
+            AggMode,
+            FilterExec,
+            HashAggregateExec,
+        )
+        from blaze_tpu.ops.parquet_scan import (
+            FileRange,
+            ParquetScanExec,
+        )
+        from blaze_tpu.plan.serde import task_to_proto
+
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(p)]]),
+                Col("v") > threshold,
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode=AggMode.COMPLETE,
+        )
+        return task_to_proto(plan, 0)
+
+    return blob
+
+
+def test_inprocess_rolling_drain_is_client_invisible(dataset):
+    """Drain each replica in turn (drain -> LEAVE -> a replacement
+    JOINs) while a repeated-query mix runs through the router: every
+    query completes DONE - drains spill, departures re-point affinity,
+    nothing surfaces to the client."""
+    blobs = [dataset(), dataset(0.3)]
+    extra = []  # replacement (svc, srv) pairs to tear down
+    with Fleet() as fl:
+        fl.router.registry.start()
+        failures = []
+        completed = [0]
+        stop = threading.Event()
+
+        def mix():
+            while not stop.is_set():
+                for b in blobs:
+                    try:
+                        st = fl.router.submit({"use_cache": True}, b)
+                        if st.get("state") in TERMINAL_BAD:
+                            failures.append(("submit", st))
+                            continue
+                        p = wait_done(fl.router, st["query_id"])
+                        if p["state"] != "DONE":
+                            failures.append(("poll", p))
+                        else:
+                            completed[0] += 1
+                    except Exception as e:  # noqa: BLE001 - the point
+                        failures.append(("raise", repr(e)))
+                time.sleep(0.01)
+
+        t = threading.Thread(target=mix, daemon=True)
+        t.start()
+        try:
+            assert wait_for(lambda: completed[0] >= 4, timeout=60)
+            for spec in list(fl.specs):
+                svc = fl.by_id[spec][0]
+                # SIGTERM analog: drain (in-flight finishes, new work
+                # DRAINING-rejected), then LEAVE when empty
+                assert svc.drain(timeout_s=60)
+                host, _, port = spec.rpartition(":")
+                fl.router.membership({
+                    "op": "leave", "host": host, "port": int(port),
+                })
+                # the replacement JOINs (fresh process analog)
+                nsvc = QueryService(max_concurrency=2)
+                nsrv = TaskGatewayServer(service=nsvc).start()
+                extra.append((nsvc, nsrv))
+                fl.router.membership({
+                    "op": "join", "host": nsrv.address[0],
+                    "port": nsrv.address[1],
+                })
+                fl.by_id["%s:%d" % nsrv.address] = (nsvc, nsrv)
+                base = completed[0]
+                assert wait_for(
+                    lambda: completed[0] >= base + 2, timeout=60
+                )
+            assert failures == [], failures[:5]
+            assert completed[0] >= 8
+            # both drained replicas are gone, both replacements alive
+            stats = fl.router.stats()
+            assert stats["fleet"]["departed"] == 2
+            assert stats["fleet"]["alive"] >= 2
+        finally:
+            stop.set()
+            t.join(timeout=30)
+            for svc, srv in extra:
+                try:
+                    srv.stop()
+                except OSError:
+                    pass
+                svc.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e acceptance
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stats(client: ServiceClient) -> dict:
+    try:
+        return client.stats()
+    except Exception:  # noqa: BLE001 - transient poll during churn
+        return {}
+
+
+@pytest.mark.slow
+def test_e2e_rolling_restart_and_hot_kill_acceptance(dataset):
+    """ISSUE 9 acceptance, end to end: SIGTERM-drain each of 3 serve
+    replicas in turn while a repeated-query mix runs through the
+    route CLI - zero client-visible failures, drained replicas rejoin
+    via JOIN - then SIGKILL the affinity home of a hot fingerprint
+    and assert its repeat serves warm (0 dispatches) from the
+    survivor holding the replicated result."""
+    rproc, rhost, rport = _spawn(
+        ["route", "--port", "0",
+         "--poll-interval", "0.1", "--heartbeat-timeout", "0.8",
+         "--quarantine", "60", "--breaker-threshold", "2",
+         "--replicate-interval", "0.3"],
+    )
+    procs = [rproc]
+    serves = {}
+
+    def spawn_serve(port):
+        proc, _, _ = _spawn(
+            ["serve", "--port", str(port),
+             "--max-concurrency", "2",
+             "--router", f"{rhost}:{rport}",
+             "--drain-grace", "60"],
+        )
+        procs.append(proc)
+        serves[port] = proc
+        return proc
+
+    try:
+        ports = [_free_port() for _ in range(3)]
+        for p in ports:
+            spawn_serve(p)
+        with ServiceClient(rhost, rport, timeout=300.0) as c:
+            assert wait_for(
+                lambda: _stats(c).get("fleet", {}).get("alive") == 3,
+                timeout=120,
+            )
+            blobs = [dataset(), dataset(0.3)]
+            failures = []
+            completed = [0]
+            stop = threading.Event()
+
+            def mix():
+                with ServiceClient(rhost, rport,
+                                   timeout=300.0) as mc:
+                    while not stop.is_set():
+                        for b in blobs:
+                            try:
+                                st = mc.submit(b)
+                                if st.get("state") in TERMINAL_BAD:
+                                    failures.append(("submit", st))
+                                    continue
+                                batches = mc.fetch(st["query_id"])
+                                if not batches:
+                                    failures.append(("empty", st))
+                                else:
+                                    completed[0] += 1
+                            except Exception as e:  # noqa: BLE001
+                                failures.append(("raise", repr(e)))
+                        time.sleep(0.02)
+
+            t = threading.Thread(target=mix, daemon=True)
+            t.start()
+            # warm-up: every blob executed at least twice fleet-wide
+            assert wait_for(lambda: completed[0] >= 4, timeout=120)
+            # --- rolling restart leg ------------------------------
+            for port in ports:
+                old = serves[port]
+                old.terminate()  # SIGTERM -> drain -> LEAVE -> exit
+                old.wait(timeout=120)
+                assert wait_for(
+                    lambda: _stats(c).get("fleet", {})
+                    .get("alive") == 2,
+                    timeout=60,
+                )
+                spawn_serve(port)  # rejoins via JOIN
+                assert wait_for(
+                    lambda: _stats(c).get("fleet", {})
+                    .get("alive") == 3,
+                    timeout=120,
+                )
+                base = completed[0]
+                assert wait_for(
+                    lambda: completed[0] >= base + 2, timeout=120
+                )
+            stop.set()
+            t.join(timeout=60)
+            assert failures == [], failures[:5]
+            stats = _stats(c)
+            assert stats["fleet"]["alive"] == 3
+            # drained replicas LEFT cleanly and rejoined via JOIN:
+            # each restart is one `leave` + one `rejoin` on the
+            # membership counter (a rejoining replica is popped back
+            # OUT of the departed ring, so the counter is the record)
+            metrics = c.metrics()
+            m = re.search(
+                r'blaze_router_membership_events\{kind="leave"\} '
+                r"(\d+)", metrics)
+            assert m and int(m.group(1)) >= 3, m
+            m = re.search(
+                r'blaze_router_membership_events\{kind="rejoin"\} '
+                r"(\d+)", metrics)
+            assert m and int(m.group(1)) >= 3, m
+            # --- hot-kill leg -------------------------------------
+            # make blob1 unambiguously hot and learn its fingerprint
+            st = c.submit(blobs[0])
+            assert c.fetch(st["query_id"])
+            p = c.poll(st["query_id"])
+            fp, victim = p.get("fingerprint"), p["replica"]
+            assert fp
+            # FULL fingerprint match: content fingerprints share long
+            # op-name prefixes, so a truncated check would be
+            # satisfied by the OTHER blob's replication
+            assert wait_for(
+                lambda: fp in _stats(c).get("hot", {})
+                .get("replicated_fps", []),
+                timeout=60,
+            )
+            promoted_before = _stats(c)["hot"]["promoted"]
+            victim_port = int(victim.rsplit(":", 1)[1])
+            serves[victim_port].kill()  # SIGKILL the affinity home
+            assert wait_for(
+                lambda: _stats(c).get("fleet", {})
+                .get("alive") == 2,
+                timeout=60,
+            )
+            assert wait_for(
+                lambda: _stats(c).get("hot", {}).get("promoted", 0)
+                > promoted_before,
+                timeout=30,
+            )
+            # THE acceptance pin: the FIRST repeat after the kill is
+            # served warm from the survivor's replicated result
+            st2 = c.submit(blobs[0])
+            assert c.fetch(st2["query_id"])
+            p2 = c.poll(st2["query_id"])
+            assert p2["state"] == "DONE"
+            assert p2["replica"] != victim
+            assert p2["dispatches"] == 0, p2
+            assert p2["cache_hits"] == 1
+    finally:
+        for proc in procs:
+            _reap(proc)

@@ -1,0 +1,562 @@
+"""Elastic fleet membership tests (ISSUE 9): JOIN/LEAVE protocol,
+graceful drain, hot-result replication, and membership churn chaos.
+
+Coverage map:
+  * wire tier: the MEMBER verb end to end (join ack, leave ack, a
+    bare serve instance refusing membership authority), announcer
+    retry across a chaos-dropped JOIN (`router.membership` seam)
+  * registry tier: dynamic add/remove spinning pollers up/down, the
+    membership state ladder (joining/alive/draining/quarantined/gone)
+    on STATS and the `blaze_router_replica_membership` gauge, the
+    `blaze_router_membership_events{kind}` counter
+  * drain: QueryService.drain finishes in-flight work while refusing
+    new SUBMITs with the classified DRAINING rejection; the router
+    treats that rejection as a placement miss (spill, zero breaker
+    strikes); a bare ServiceClient retries it with backoff and
+    surfaces TRANSIENT (`ReplicaDrainingError`)
+  * departure: LEAVE (and heartbeat death) eagerly evicts the
+    departed replica's AffinityMap entries; flapping join/leave
+    neither thrashes other replicas' affinity nor leaks poller
+    threads
+  * replication: the hot ranking from polled runtime-history data,
+    tick() double-placing the top-K, and promotion of the confirmed
+    secondary to affinity home on death - the repeat serves warm
+    (0 dispatches) from the survivor.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.errors import ErrorClass, ReplicaDrainingError, classify
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.ops import AggMode, FilterExec, HashAggregateExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.router import (
+    MembershipAnnouncer,
+    Router,
+    RouterServer,
+)
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService, ServiceClient
+from blaze_tpu.service.wire import _is_draining_rejection
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_router import Fleet, wait_done
+from tests.test_service import GatedScan, wait_for
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(41)
+    p = str(tmp_path / "m.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 25, 5000), pa.int32()),
+                "v": pa.array(rng.random(5000), pa.float64()),
+            }
+        ),
+        p,
+    )
+
+    def blob(threshold=0.5):
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(p)]]),
+                Col("v") > threshold,
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode=AggMode.COMPLETE,
+        )
+        return task_to_proto(plan, 0)
+
+    return blob
+
+
+def _join(router, spec):
+    host, _, port = spec.rpartition(":")
+    return router.membership(
+        {"op": "join", "host": host, "port": int(port)}
+    )
+
+
+def _leave(router, spec, reason="leave"):
+    host, _, port = spec.rpartition(":")
+    return router.membership(
+        {"op": "leave", "host": host, "port": int(port),
+         "reason": reason}
+    )
+
+
+# ---------------------------------------------------------------------------
+# JOIN/LEAVE protocol
+# ---------------------------------------------------------------------------
+
+
+def test_join_from_empty_bootstrap_and_leave(dataset):
+    """The --replica list is only a bootstrap hint: a router started
+    EMPTY serves traffic as soon as replicas JOIN, and a LEAVE retires
+    one (state=gone on STATS) without a restart."""
+    router = Router([], poll_interval_s=0.1,
+                    heartbeat_timeout_s=0.8, start=False)
+    svcs, srvs, specs = [], [], []
+    try:
+        for _ in range(2):
+            svc = QueryService(max_concurrency=2)
+            srv = TaskGatewayServer(service=svc).start()
+            svcs.append(svc)
+            srvs.append(srv)
+            specs.append("%s:%d" % srv.address)
+            resp = _join(router, specs[-1])
+            assert resp["ok"] and resp["created"]
+            # the JOIN ack already implies routability (sync probe)
+            assert resp["state"] == "alive"
+        assert len(router.registry.routable()) == 2
+        st = router.submit({"use_cache": True}, dataset())
+        p = wait_done(router, st["query_id"])
+        assert p["state"] == "DONE"
+        # idempotent re-JOIN (the announcer re-announces forever)
+        resp = _join(router, specs[0])
+        assert resp["ok"] and not resp["created"]
+        assert len(router.registry.replicas) == 2
+        # LEAVE retires the replica and the fleet keeps serving
+        gone = p["replica"]
+        resp = _leave(router, gone, reason="drained")
+        assert resp["ok"] and resp["known"]
+        assert len(router.registry.routable()) == 1
+        snap = router.registry.snapshot()
+        assert snap[gone]["state"] == "gone"
+        st2 = router.submit({"use_cache": True}, dataset())
+        p2 = wait_done(router, st2["query_id"])
+        assert p2["state"] == "DONE" and p2["replica"] != gone
+        # LEAVE of an unknown replica acks (desired state holds)
+        assert router.membership(
+            {"op": "leave", "host": "h", "port": 1}
+        )["ok"]
+        assert "error" in router.membership(
+            {"op": "flap", "host": "h", "port": 1}
+        )
+    finally:
+        router.close()
+        for srv in srvs:
+            srv.stop()
+        for svc in svcs:
+            svc.close()
+
+
+def test_member_verb_over_wire_and_announcer(dataset):
+    """The MEMBER verb end to end: an announcer JOINs through the
+    router's listener; a bare serve instance refuses membership
+    authority in-band."""
+    with Fleet() as fl:
+        with RouterServer(fl.router) as rs:
+            svc = QueryService(max_concurrency=1)
+            srv = TaskGatewayServer(service=svc).start()
+            try:
+                spec = "%s:%d" % srv.address
+                ann = MembershipAnnouncer(
+                    "%s:%d" % rs.address, spec, interval_s=30.0,
+                )
+                assert ann.announce_now()
+                assert ann.joins_acked == 1
+                assert spec in fl.router.registry.replicas
+                assert ann.leave()
+                assert spec not in fl.router.registry.replicas
+                ann.close()
+                # a serve instance is NOT a membership authority
+                with ServiceClient(*srv.address) as c:
+                    resp = c.member({"op": "join", "host": "x",
+                                     "port": 1})
+                assert "error" in resp
+            finally:
+                srv.stop()
+                svc.close()
+
+
+def test_registry_dynamic_pollers_spin_up_and_down():
+    """add() on a STARTED registry spawns exactly one poller for the
+    joiner; remove() stops it at the next tick (no thread leak)."""
+    with Fleet() as fl:
+        reg = fl.router.registry
+        reg.start()
+        assert set(reg._threads) == set(fl.specs)
+        svc = QueryService(max_concurrency=1)
+        srv = TaskGatewayServer(service=svc).start()
+        try:
+            spec = "%s:%d" % srv.address
+            r, created = reg.add(spec)
+            assert created
+            assert spec in reg._threads
+            t = reg._threads[spec]
+            # the poller's first round makes it alive without poll_now
+            assert wait_for(lambda: r.alive, timeout=10)
+            reg.remove(spec, reason="leave")
+            assert spec not in reg._threads
+            assert wait_for(lambda: not t.is_alive(), timeout=10)
+            assert spec in reg.departed
+        finally:
+            srv.stop()
+            svc.close()
+
+
+def test_membership_chaos_dropped_join_retries(dataset):
+    """`router.membership` chaos seam: a DROPped JOIN never acks - the
+    announcer's next tick retries and succeeds (the loop IS the
+    retry); the fleet converges despite the fault."""
+    with Fleet() as fl:
+        with RouterServer(fl.router) as rs:
+            svc = QueryService(max_concurrency=1)
+            srv = TaskGatewayServer(service=svc).start()
+            try:
+                spec = "%s:%d" % srv.address
+                ann = MembershipAnnouncer(
+                    "%s:%d" % rs.address, spec, interval_s=30.0,
+                )
+                with chaos.active(
+                    [Fault("router.membership", klass="DROP",
+                           times=1)],
+                    seed=11,
+                ) as plan:
+                    assert not ann.announce_now()  # dropped
+                    assert plan.fired("router.membership") == 1
+                    assert spec not in fl.router.registry.replicas
+                    assert ann.announce_now()  # the retry lands
+                assert spec in fl.router.registry.replicas
+                assert ann.join_failures == 1
+                ann.close()
+            finally:
+                srv.stop()
+                svc.close()
+
+
+def test_flapping_replica_no_affinity_thrash_no_poller_leak(dataset):
+    """Satellite: repeated quick join/leave of ONE replica neither
+    thrashes the OTHER replicas' affinity placement nor leaks poller
+    threads."""
+    with Fleet() as fl:
+        reg = fl.router.registry
+        reg.start()
+        # pin an affinity home on a stable replica first
+        st = fl.router.submit({"use_cache": True}, dataset())
+        p = wait_done(fl.router, st["query_id"])
+        home = p["replica"]
+        key = fl.router.get(st["query_id"]).key
+        svc = QueryService(max_concurrency=1)
+        srv = TaskGatewayServer(service=svc).start()
+        try:
+            spec = "%s:%d" % srv.address
+            flapped = []
+            for _ in range(6):
+                _join(fl.router, spec)
+                flapped.append(reg._threads.get(spec))
+                _leave(fl.router, spec)
+            # the stable replica's affinity never moved
+            assert fl.router.affinity.lookup(key)[0] == home
+            st2 = fl.router.submit({"use_cache": True}, dataset())
+            p2 = wait_done(fl.router, st2["query_id"])
+            assert p2["replica"] == home
+            assert p2["dispatches"] == 0  # still the warm cache
+            # every flap cycle's poller exits; at most the live
+            # entry's thread remains
+            assert spec not in reg._threads
+            assert wait_for(
+                lambda: all(
+                    t is None or not t.is_alive() for t in flapped
+                ),
+                timeout=10,
+            )
+            assert len(reg._retired) <= 64
+        finally:
+            srv.stop()
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+
+def test_drain_finishes_inflight_and_rejects_new(dataset):
+    """QueryService.drain: in-flight queries run to completion while
+    new SUBMITs get the classified DRAINING rejection (TRANSIENT, so
+    clients retry instead of failing)."""
+    release = threading.Event()
+    svc = QueryService(max_concurrency=2)
+    try:
+        blocker = GatedScan(release)
+        q = svc.submit_plan(blocker)
+        assert wait_for(lambda: blocker.started.is_set())
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(svc.drain(timeout_s=30))
+        )
+        t.start()
+        assert wait_for(lambda: svc.draining)
+        rej = svc.submit_plan(GatedScan(release))
+        assert rej.state.value == "REJECTED_OVERLOADED"
+        assert rej.error.startswith("DRAINING")
+        assert rej.error_class == "TRANSIENT"
+        assert _is_draining_rejection(rej.status())
+        assert t.is_alive()  # still waiting on the in-flight query
+        release.set()
+        t.join(timeout=30)
+        assert out == [True]
+        assert q.state.value == "DONE"
+        # the STATS surface carries the drain flag for the registry
+        assert svc.stats()["service"]["draining"] is True
+    finally:
+        release.set()
+        svc.close()
+
+
+def test_drain_timeout_reports_false():
+    release = threading.Event()
+    svc = QueryService(max_concurrency=1)
+    try:
+        blocker = GatedScan(release)
+        svc.submit_plan(blocker)
+        assert wait_for(lambda: blocker.started.is_set())
+        assert svc.drain(timeout_s=0.2) is False
+    finally:
+        release.set()
+        svc.close()
+
+
+def test_router_spills_draining_rejection_no_strikes(dataset):
+    """The router treats a DRAINING rejection as a placement miss: the
+    query spills to the next replica with ZERO breaker strikes, the
+    replica is marked draining immediately (before the next STATS
+    poll), and the drain lands on the membership counter."""
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        p = wait_done(fl.router, st["query_id"])
+        home = p["replica"]
+        before = REGISTRY.get("blaze_router_membership_events",
+                              kind="drain_reject")
+        # drain announced but NOT yet polled: affinity still points at
+        # the draining replica, so the submit bounces off it
+        fl.by_id[home][0].draining = True
+        st2 = fl.router.submit({"use_cache": True}, blob)
+        p2 = wait_done(fl.router, st2["query_id"])
+        assert p2["state"] == "DONE"
+        assert p2["replica"] == fl.other(home)
+        assert fl.router.counters["drain_spills"] == 1
+        assert REGISTRY.get("blaze_router_membership_events",
+                            kind="drain_reject") == before + 1
+        # zero breaker strikes: draining is not sickness
+        assert fl.router.breaker.strikes(home) == 0
+        assert not fl.router.registry.get(home).quarantined()
+        # the direct observation marked it draining -> unroutable for
+        # NEW placements, and STATS shows the state
+        assert not fl.router.registry.get(home).routable()
+        fl.router.registry.poll_now()
+        assert fl.router.registry.snapshot()[home]["state"] \
+            == "draining"
+        assert fl.router.stats()["fleet"]["draining"] == 1
+
+
+def test_bare_client_submit_retries_draining_then_classifies(dataset):
+    """Satellite: a bare ServiceClient (no router) maps the DRAINING
+    rejection to a TRANSIENT classified error after retrying with the
+    existing backoff - a rolling restart never surfaces as an opaque
+    failure."""
+    blob = dataset()
+    svc = QueryService(max_concurrency=1)
+    srv = TaskGatewayServer(service=svc).start()
+    try:
+        svc.draining = True
+        # fail-fast client: classified error immediately
+        with ServiceClient(*srv.address,
+                           reconnect_attempts=0) as c:
+            with pytest.raises(ReplicaDrainingError) as ei:
+                c.submit(blob)
+        assert classify(ei.value) is ErrorClass.TRANSIENT
+        # retrying client: the replica comes back mid-backoff and the
+        # SAME submit call succeeds
+        def _undrain():
+            time.sleep(0.15)
+            svc.draining = False
+
+        threading.Thread(target=_undrain, daemon=True).start()
+        with ServiceClient(*srv.address) as c:
+            st = c.submit(blob)
+            assert st["state"] in ("QUEUED", "ADMITTED", "RUNNING",
+                                   "DONE")
+    finally:
+        srv.stop()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# departure bookkeeping + hot-result replication
+# ---------------------------------------------------------------------------
+
+
+def test_leave_evicts_affinity_eagerly(dataset):
+    """Departure (LEAVE) evicts the leaver's AffinityMap entries NOW -
+    the next repeat places fresh instead of decaying into a failed
+    placement + failover."""
+    blob = dataset()
+    with Fleet() as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        p = wait_done(fl.router, st["query_id"])
+        rq = fl.router.get(st["query_id"])
+        home = p["replica"]
+        assert fl.router.affinity.lookup(rq.key)[0] == home
+        before = len(fl.router.affinity)
+        _leave(fl.router, home)
+        assert fl.router.affinity.lookup(rq.key) == (None, None)
+        assert len(fl.router.affinity) < before
+        assert REGISTRY.get("blaze_router_affinity_evictions_total") \
+            >= 2  # blob key + learned fingerprint
+
+
+def test_hot_replication_ranks_places_and_promotes(dataset):
+    """Tentpole arm 3: repeats make a fingerprint hot (runtime-history
+    samples the registry polls); tick() double-places it on the second
+    replica (confirmed DONE = warm ResultCache copy); killing the home
+    promotes the secondary to affinity home and the next repeat
+    serves WARM - 0 dispatches - from the survivor."""
+    blob = dataset()
+    with Fleet(router_kw={"quarantine_s": 30.0}) as fl:
+        r = fl.router
+        qid = None
+        for _ in range(3):  # accumulate history samples
+            st = r.submit({"use_cache": True}, blob)
+            p = wait_done(r, st["query_id"])
+            assert p["state"] == "DONE"
+            qid = st["query_id"]
+        home = p["replica"]
+        other = fl.other(home)
+        fp = r.get(qid).fingerprint
+        r.registry.poll_now()  # deliver the history snapshots
+        assert fp in r.hot.rank_hot()
+        assert r.hot.tick() == 1
+        snap = r.hot.snapshot()
+        assert snap["replicated"] == 1
+        assert fp in snap["replicated_fps"]
+        # the copy is REAL: the secondary's cache holds the result
+        other_svc = fl.by_id[other][0]
+        assert other_svc.cache.stats()["entries"] >= 1
+        # a second tick is a no-op (already replicated + healthy)
+        assert r.hot.tick() == 0
+        # kill the home replica; heartbeat death -> eviction +
+        # promotion of the confirmed secondary
+        fl.kill_gateway(home)
+
+        def dead():
+            r.registry.poll_now()
+            return not r.registry.get(home).alive
+
+        assert wait_for(dead, timeout=10)
+        assert wait_for(
+            lambda: r.affinity.lookup(
+                r.get(qid).key
+            )[0] == other,
+            timeout=10,
+        )
+        assert r.hot.snapshot()["promoted"] == 1
+        # the acceptance pin: the repeat is served warm from the
+        # survivor holding the replicated result - zero dispatches
+        st2 = r.submit({"use_cache": True}, blob)
+        p2 = wait_done(r, st2["query_id"])
+        assert p2["state"] == "DONE"
+        assert p2["replica"] == other
+        assert p2["dispatches"] == 0, p2
+        assert p2["cache_hits"] == 1
+
+
+def test_hot_replicator_skips_unknown_payload_and_fleet_of_one():
+    """rank_hot can name fingerprints the router never placed (payload
+    predates it) and a fleet of one has nowhere to replicate - both
+    are clean no-ops."""
+    with Fleet() as fl:
+        # no submissions: nothing tracked, nothing hot
+        assert fl.router.hot.tick() == 0
+        assert fl.router.hot.rank_hot() == []
+        assert fl.router.hot.on_replica_gone(fl.specs[0]) == []
+
+
+def test_conn_pool_checkin_across_leave_closes_stale_client(
+        monkeypatch):
+    """A verb client checked OUT while its replica LEAVEs is invisible
+    to the leave-time pool purge - the epoch bump makes its check-in
+    close it instead of pooling a socket to the dead process for
+    whoever re-joins at the same address (and its release must not
+    corrupt the next epoch's connection count)."""
+    from tests.test_router import _stub_wire
+
+    made = _stub_wire(monkeypatch)
+    r = Router(["127.0.0.1:19999"], start=False, conn_pool_size=2)
+    try:
+        rep = next(iter(r.registry.replicas.values()))
+        rid = rep.replica_id
+        hold = threading.Event()
+        entered = threading.Event()
+        out = []
+
+        def slow(c):
+            entered.set()
+            assert hold.wait(10)
+            return c
+
+        t = threading.Thread(
+            target=lambda: out.append(r._call(rep, slow))
+        )
+        t.start()
+        assert entered.wait(10)
+        # the replica LEAVEs while the verb is in flight
+        assert r._member_leave(rid, "leave")["ok"]
+        hold.set()
+        t.join(10)
+        assert out and out[0].closed  # closed at check-in, not pooled
+        assert r._clients.get(rid, []) == []
+        # the next epoch starts clean: fresh client, count from zero
+        c2 = r._call(rep, lambda c: c)
+        assert c2 is not out[0] and not c2.closed
+        assert r._client_counts[rid] == 1
+        assert len(made) == 2
+    finally:
+        r.close()
+
+
+def test_membership_events_counter_and_state_gauge(dataset):
+    """Satellite: churn is visible on the scrape surface - the
+    membership `state` label per replica and the
+    blaze_router_membership_events{kind} counter."""
+    with Fleet() as fl:
+        svc = QueryService(max_concurrency=1)
+        srv = TaskGatewayServer(service=svc).start()
+        try:
+            spec = "%s:%d" % srv.address
+            joins = REGISTRY.get("blaze_router_membership_events",
+                                 kind="join")
+            _join(fl.router, spec)
+            assert REGISTRY.get("blaze_router_membership_events",
+                                kind="join") == joins + 1
+            _leave(fl.router, spec)
+            assert REGISTRY.get("blaze_router_membership_events",
+                                kind="leave") >= 1
+            text = REGISTRY.render_prometheus()
+            assert "blaze_router_membership_events" in text
+            assert 'blaze_router_replica_membership{' in text
+            assert f'replica="{spec}",state="gone"' in text
+            assert 'state="alive"' in text
+            # STATS carries the same states
+            snap = fl.router.stats()["replicas"]
+            assert snap[spec]["state"] == "gone"
+            assert all(
+                snap[s]["state"] == "alive" for s in fl.specs
+            )
+        finally:
+            srv.stop()
+            svc.close()

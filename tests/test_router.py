@@ -1049,12 +1049,12 @@ def test_registry_persistent_pollers_feed_stats_and_histogram():
         assert not reg._threads  # Fleet starts with start=False
         reg.start()
         try:
-            threads = list(reg._threads)
+            threads = list(reg._threads.values())
             assert len(threads) == 2
             assert all(t.is_alive() for t in threads)
             # starting twice must not double the pollers
             reg.start()
-            assert reg._threads == threads
+            assert list(reg._threads.values()) == threads
             # the pollers refresh snapshots without poll_now
             assert wait_for(
                 lambda: all(
