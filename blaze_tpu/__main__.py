@@ -131,6 +131,7 @@ def cmd_serve(args) -> int:
         enable_trace=not args.no_trace,
         slow_query_s=args.slow_query_s,
         mesh_mode=("on" if args.mesh else args.mesh_mode),
+        orphan_ttl_s=args.orphan_ttl,
     )
     # serve_blocking (NOT start()): the main thread is the only
     # accept loop - see TaskGatewayServer.serve_blocking
@@ -262,6 +263,8 @@ def cmd_route(args) -> int:
         conn_pool_size=args.conn_pool,
         replicate_hot_k=args.replicate_hot,
         replicate_interval_s=args.replicate_interval,
+        journal_path=args.journal,
+        recover_timeout_s=args.recover_timeout,
     )
     return 0
 
@@ -468,6 +471,11 @@ def main(argv=None) -> int:
     sv.add_argument("--advertise", default=None, metavar="HOST:PORT",
                     help="address announced to the router (default: "
                          "the listener's bound address)")
+    sv.add_argument("--orphan-ttl", type=float, default=900.0,
+                    help="reap terminal, never-fetched queries with "
+                         "no POLL activity for this many seconds - "
+                         "a permanently-dead router cannot pin "
+                         "replica retention forever (0 disables)")
     sv.add_argument("--drain-grace", type=float, default=30.0,
                     help="SIGTERM drain: max seconds to wait for "
                          "in-flight queries before leaving anyway "
@@ -518,6 +526,18 @@ def main(argv=None) -> int:
                          "replication)")
     rr.add_argument("--replicate-interval", type=float, default=2.0,
                     help="hot-replication pass period seconds")
+    rr.add_argument("--journal", default=None, metavar="PATH",
+                    help="durable routing journal: record every "
+                         "routed query's lifecycle so a restarted "
+                         "router (same --journal) replays its table "
+                         "and reconciles in-flight queries against "
+                         "the re-JOINing fleet instead of forgetting "
+                         "them (docs/ROUTER.md 'Router recovery')")
+    rr.add_argument("--recover-timeout", type=float, default=30.0,
+                    help="recovery window seconds: journaled "
+                         "placements whose replica has not re-JOINed "
+                         "by then are re-placed on the live fleet "
+                         "(or stranded when none is routable)")
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "

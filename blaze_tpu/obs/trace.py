@@ -219,6 +219,31 @@ class TraceRecorder:
         with self._lock:
             return [s.to_dict() for s in self.spans]
 
+    def phase_totals(self, phase_of: Dict[str, Optional[str]]
+                     ) -> Dict[str, float]:
+        """Sum span durations into phase totals (seconds) keyed by
+        `phase_of[span.name]` - the allocation-free form of
+        `phases.fold_span_dicts(rec.to_dicts())`. The terminal hook
+        folds EVERY finished query through this; to_dicts() would
+        materialize a dict (with tag and event copies) per span - for
+        a retried multi-partition query that is thousands of
+        allocations per query on the serving path, for a result this
+        fold immediately throws away. One pass over the live Span
+        objects, one small output dict."""
+        totals: Dict[str, float] = {}
+        with self._lock:
+            for s in self.spans:
+                phase = phase_of.get(s.name)
+                if not phase:
+                    continue
+                end = s.end_ns
+                if end is None or end < s.start_ns:
+                    continue
+                totals[phase] = (
+                    totals.get(phase, 0.0) + (end - s.start_ns) / 1e9
+                )
+        return totals
+
     def attach_subtree(self, span_dicts: List[Dict[str, Any]],
                        parent: Optional[Span] = None) -> int:
         """Graft a serialized subtree (a cluster worker's spans) under

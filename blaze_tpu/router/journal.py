@@ -1,0 +1,399 @@
+"""Durable routing journal: the router's crash-safety substrate.
+
+PR 8 made every replica disposable, which left the router as the
+fleet's last single point of failure: a restarted router re-learns
+MEMBERSHIP within one announcer tick, but every in-flight routed
+query was forgotten - even though its downstream run is detach=True
+and keeps executing on the replica. The source paper's Spark lineage
+makes the driver recoverable by re-spooling work from retained state;
+this module is the retained state.
+
+The journal is an append-only record of each routed query's
+lifecycle, written from the router's verb paths and replayed by a
+restarting router (router/proxy.py `Router._recover_*`):
+
+  S  SUBMIT    admission: client query_id + meta + the raw task bytes
+               (enough to re-place the query from scratch)
+  P  PLACE     a placement landing: replica_id + replica-local
+               internal_id (+ learned fingerprint) - written every
+               time `_place_and_submit` succeeds, so failover moves
+               journal as newer P records for the same id
+  F  FINISH    terminal state: a truncation marker - replay drops the
+               entry, and compaction reclaims its bytes
+
+Durability model: appends go straight to the OS (unbuffered
+`os.write` on a raw fd), fsync is BATCHED from a flusher thread every
+`fsync_interval_s`. A router SIGKILL therefore loses nothing (the
+page cache survives process death on one host); only a host power
+loss can drop the tail since the last fsync - and replay treats any
+torn or unparseable tail as the crash point, truncating to the last
+whole record instead of refusing to start. Each record is framed
+`u32 len | u32 crc32 | payload` so a half-written final record is
+detected by length or checksum, never misparsed.
+
+Compaction: replay-time (a restart rewrites only the live entries)
+and opportunistic from the flusher once the file accumulates more
+dead records than live ones - the journal's steady-state size is
+O(in-flight queries), not O(queries ever routed).
+
+Chaos seam `router.journal` (testing/chaos.py): op="append" (a DROP
+fault tears the record mid-write - the crash-at-the-worst-moment
+test), op="fsync" (STALL = a slow disk under the flusher), and
+op="reconcile_poll" fired by the recovery pass in proxy.py (DROP = a
+reconcile POLL that never reaches the replica; the pass retries on
+its next tick).
+
+Depth/replay health is exported as `blaze_router_journal_*` metrics
+through the process registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+from base64 import b64decode, b64encode
+from typing import Dict, Optional, Tuple
+
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.testing import chaos
+
+log = logging.getLogger("blaze_tpu.router")
+
+_HDR = struct.Struct("<II")  # payload length, crc32(payload)
+# a length field above this is framing corruption, not a real record
+_MAX_RECORD = 256 << 20
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One live routed query reconstructed by replay."""
+
+    external_id: str
+    key: str
+    meta: dict
+    task_bytes: bytes
+    is_ref: bool
+    manifest_bytes: Optional[bytes]
+    replica_id: Optional[str] = None
+    internal_id: Optional[str] = None
+    fingerprint: Optional[str] = None
+    generation: int = 0
+
+    @property
+    def placed(self) -> bool:
+        return self.internal_id is not None
+
+
+class RouterJournal:
+    """Append-only, fsync-batched lifecycle journal with torn-tail
+    tolerant replay. Thread-safe: verb handlers append concurrently;
+    the flusher thread owns fsync and opportunistic compaction."""
+
+    def __init__(self, path: str, fsync_interval_s: float = 0.05,
+                 compact_min_records: int = 1024):
+        self.path = path
+        self.fsync_interval_s = float(fsync_interval_s)
+        self.compact_min_records = int(compact_min_records)
+        self._lock = threading.Lock()
+        self._dirty = False
+        self._closed = False
+        # replay BEFORE opening for append: a torn tail is truncated
+        # so the next append extends a well-framed file
+        self.replayed, truncated = self.replay_file(path)
+        if truncated is not None:
+            REGISTRY.inc("blaze_router_journal_truncations_total")
+            log.warning(
+                "journal %s: torn tail truncated at byte %d "
+                "(%d live entries survive)",
+                path, truncated, len(self.replayed),
+            )
+            with open(path, "r+b") as f:
+                f.truncate(truncated)
+        REGISTRY.inc("blaze_router_journal_replayed_total",
+                     len(self.replayed))
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT
+                           | os.O_APPEND, 0o644)
+        # live-entry tracking for the depth gauge + compaction
+        # trigger; replayed entries count as live until finished
+        self._live = set(self.replayed)
+        self._records = 0   # appended since open/compaction
+        self._dead = 0      # F-marked among them
+        self._collector_key = f"router-journal:{id(self):x}"
+        REGISTRY.register_collector(
+            self._collector_key, self._collect_metrics
+        )
+        # startup compaction: a restart inherits every dead record of
+        # the previous life - rewrite only what replay kept alive
+        if self.replayed or os.path.getsize(path) > 0:
+            self._compact_locked()
+        self._stop_wait = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, daemon=True,
+            name="blaze-router-journal-flush",
+        )
+        self._flusher.start()
+
+    # -- replay ----------------------------------------------------------
+    @staticmethod
+    def replay_file(path: str
+                    ) -> Tuple[Dict[str, JournalEntry],
+                               Optional[int]]:
+        """Replay `path` into {external_id: JournalEntry} of LIVE
+        queries (F records drop their entry). Returns (entries,
+        torn_offset) where torn_offset is the byte offset of the
+        first unreadable record (None = clean tail). Idempotent by
+        construction: replaying the same bytes always yields the
+        same entries."""
+        entries: Dict[str, JournalEntry] = {}
+        if not os.path.exists(path):
+            return entries, None
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        torn: Optional[int] = None
+        n = len(data)
+        while off < n:
+            if off + _HDR.size > n:
+                torn = off
+                break
+            length, crc = _HDR.unpack_from(data, off)
+            if length > _MAX_RECORD or off + _HDR.size + length > n:
+                torn = off
+                break
+            payload = data[off + _HDR.size: off + _HDR.size + length]
+            if zlib.crc32(payload) != crc:
+                # checksum mismatch = the crash point; framing after
+                # it cannot be trusted either
+                torn = off
+                break
+            off += _HDR.size + length
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                torn = off - _HDR.size - length
+                break
+            kind = rec.get("k")
+            qid = rec.get("id")
+            if not qid:
+                continue
+            if kind == "S":
+                entries[qid] = JournalEntry(
+                    external_id=qid,
+                    key=str(rec.get("key", "")),
+                    meta=dict(rec.get("meta") or {}),
+                    task_bytes=b64decode(rec.get("blob", "")),
+                    is_ref=bool(rec.get("ref")),
+                    manifest_bytes=(
+                        b64decode(rec["man"])
+                        if rec.get("man") is not None else None
+                    ),
+                )
+            elif kind == "P":
+                e = entries.get(qid)
+                if e is not None:
+                    e.replica_id = rec.get("r")
+                    e.internal_id = rec.get("iid")
+                    e.fingerprint = rec.get("fp") or e.fingerprint
+                    e.generation = int(rec.get("gen", 0))
+            elif kind == "F":
+                entries.pop(qid, None)
+        return entries, torn
+
+    # -- record encoding (THE dict shapes; replay_file is the decoder,
+    # and compaction re-emits through these same builders so the field
+    # sets cannot drift between the append and rewrite paths) --------
+    @staticmethod
+    def _submit_record(external_id: str, key: str, meta: dict,
+                       task_bytes: bytes, is_ref: bool,
+                       manifest_bytes: Optional[bytes]) -> dict:
+        return {
+            "id": external_id,
+            "key": key,
+            "meta": meta,
+            "blob": b64encode(task_bytes).decode("ascii"),
+            "ref": bool(is_ref),
+            "man": (b64encode(manifest_bytes).decode("ascii")
+                    if manifest_bytes is not None else None),
+        }
+
+    @staticmethod
+    def _place_record(external_id: str, replica_id: str,
+                      internal_id: str, fingerprint: Optional[str],
+                      generation: int) -> dict:
+        return {
+            "id": external_id,
+            "r": replica_id,
+            "iid": internal_id,
+            "fp": fingerprint,
+            "gen": int(generation),
+        }
+
+    @staticmethod
+    def _encode_frame(kind: str, rec: dict) -> bytes:
+        rec["k"] = kind
+        payload = json.dumps(rec, separators=(",", ":")).encode()
+        return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+    # -- append paths ----------------------------------------------------
+    def record_submit(self, external_id: str, key: str, meta: dict,
+                      task_bytes: bytes, is_ref: bool,
+                      manifest_bytes: Optional[bytes]) -> None:
+        self._append("S", self._submit_record(
+            external_id, key, meta, task_bytes, is_ref,
+            manifest_bytes,
+        ), live=external_id)
+
+    def record_place(self, external_id: str, replica_id: str,
+                     internal_id: str,
+                     fingerprint: Optional[str],
+                     generation: int) -> None:
+        self._append("P", self._place_record(
+            external_id, replica_id, internal_id, fingerprint,
+            generation,
+        ))
+
+    def record_finish(self, external_id: str, state: str) -> None:
+        self._append("F", {"id": external_id, "st": state},
+                     dead=external_id)
+
+    def _append(self, kind: str, rec: dict,
+                live: Optional[str] = None,
+                dead: Optional[str] = None) -> None:
+        frame = self._encode_frame(kind, rec)
+        with self._lock:
+            if self._closed:
+                return
+            torn = False
+            if chaos.ACTIVE:
+                # DROP = the process dies mid-write: only part of the
+                # frame reaches the file (the torn-tail replay path);
+                # STALL = slow disk under the appender
+                try:
+                    chaos.fire("router.journal", op="append",
+                               kind=kind, id=rec.get("id"))
+                except ConnectionError:
+                    torn = True
+            if torn:
+                os.write(self._fd, frame[: max(1, len(frame) // 2)])
+            else:
+                os.write(self._fd, frame)
+            self._dirty = True
+            self._records += 1
+            if live is not None:
+                self._live.add(live)
+            if dead is not None:
+                self._live.discard(dead)
+                self._dead += 1
+        REGISTRY.inc("blaze_router_journal_records_total", kind=kind)
+
+    # -- fsync batching / compaction -------------------------------------
+    def sync(self) -> None:
+        """Force one fsync (tests and close; the steady-state path is
+        the batched flusher)."""
+        with self._lock:
+            self._fsync_locked()
+
+    def _fsync_locked(self) -> None:
+        if self._closed or not self._dirty:
+            return
+        if chaos.ACTIVE:
+            chaos.fire("router.journal", op="fsync")
+        os.fsync(self._fd)
+        self._dirty = False
+        REGISTRY.inc("blaze_router_journal_fsyncs_total")
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            try:
+                with self._lock:
+                    self._fsync_locked()
+                    # opportunistic compaction: more dead weight than
+                    # live entries and enough volume to matter
+                    if (self._records >= self.compact_min_records
+                            and self._dead > max(1, len(self._live))):
+                        self._compact_locked()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("journal flush failed")
+            if self._stop_wait.wait(self.fsync_interval_s):
+                return
+
+    def _compact_locked(self) -> None:
+        """Rewrite only the LIVE entries (their S + last P) into a tmp
+        file, fsync, and atomically swap it in. Caller holds _lock."""
+        live, _ = self.replay_file(self.path)
+        # include records buffered since the last fsync: replay reads
+        # the file, and O_APPEND writes land immediately, so this is
+        # simply "the current file state" - flush first regardless
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for e in live.values():
+                f.write(self._encode_frame("S", self._submit_record(
+                    e.external_id, e.key, e.meta, e.task_bytes,
+                    e.is_ref, e.manifest_bytes,
+                )))
+                if e.placed:
+                    f.write(self._encode_frame(
+                        "P", self._place_record(
+                            e.external_id, e.replica_id,
+                            e.internal_id, e.fingerprint,
+                            e.generation,
+                        ),
+                    ))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        old_fd = self._fd
+        self._fd = os.open(self.path, os.O_WRONLY | os.O_CREAT
+                           | os.O_APPEND, 0o644)
+        try:
+            os.close(old_fd)
+        except OSError:
+            pass
+        self._live = set(live)
+        self._records = len(live)
+        self._dead = 0
+        self._dirty = False
+        REGISTRY.inc("blaze_router_journal_compactions_total")
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._fsync_locked()
+            except OSError:
+                pass
+            self._closed = True
+        self._stop_wait.set()
+        if self._flusher.is_alive():
+            self._flusher.join(timeout=5)
+        REGISTRY.unregister_collector(self._collector_key)
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- exposition ------------------------------------------------------
+    def _collect_metrics(self):
+        with self._lock:
+            live = len(self._live)
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        return [
+            ("blaze_router_journal_live_entries", {}, live, "gauge"),
+            ("blaze_router_journal_bytes", {}, size, "gauge"),
+        ]

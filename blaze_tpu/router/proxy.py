@@ -27,6 +27,7 @@ import itertools
 import logging
 import os
 import random
+import re
 import socket
 import socketserver
 import threading
@@ -38,6 +39,7 @@ from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.metrics import REGISTRY, merge_expositions
 from blaze_tpu.router.failover import CircuitBreaker, failover_action
+from blaze_tpu.router.journal import RouterJournal
 from blaze_tpu.router.placement import (
     AffinityMap,
     PlacementDecision,
@@ -84,11 +86,21 @@ class RoutedQuery:
         "generation", "resubmits", "failovers", "finished",
         "cancelled", "last_state", "lock", "delivered_hashes",
         "splice_broken", "tracer", "hop_span", "grafted",
+        "recovered", "reconciled",
     )
 
     def __init__(self, key: str, task_bytes: bytes, is_ref: bool,
-                 manifest_bytes: Optional[bytes], meta: dict):
-        self.external_id = f"rq-{next(_rqid_counter)}-{os.getpid():x}"
+                 manifest_bytes: Optional[bytes], meta: dict,
+                 external_id: Optional[str] = None):
+        # journal replay reconstructs handles under their ORIGINAL id
+        # (the client re-attaches by query_id); fresh submissions mint
+        # a new one. The pid suffix alone does NOT make restarts
+        # collision-free (container pid 1, pid recycling) - journal
+        # restore fast-forwards _rqid_counter past every recovered id
+        self.external_id = (
+            external_id
+            or f"rq-{next(_rqid_counter)}-{os.getpid():x}"
+        )
         self.key = key
         self.task_bytes = task_bytes
         self.is_ref = is_ref
@@ -123,6 +135,13 @@ class RoutedQuery:
         # corrupt their table)
         self.delivered_hashes: List[bytes] = []
         self.splice_broken = False
+        # crash recovery (router/journal.py): `recovered` marks a
+        # handle rebuilt by journal replay; `reconciled` flips once
+        # the recovery pass re-adopted (or re-placed) it against the
+        # live fleet - until then, client verbs report a RUNNING
+        # placeholder instead of finalizing on stale state
+        self.recovered = False
+        self.reconciled = False
 
 
 class Router:
@@ -147,6 +166,8 @@ class Router:
         conn_pool_size: int = 4,
         replicate_hot_k: int = 4,
         replicate_interval_s: float = 2.0,
+        journal_path: Optional[str] = None,
+        recover_timeout_s: float = 30.0,
         start: bool = True,
     ):
         if placement not in ("affinity", "random"):
@@ -157,12 +178,18 @@ class Router:
         self.stats_stale_s = float(stats_stale_s)
         self.downstream_timeout_s = float(downstream_timeout_s)
         self.fetch_block_s = float(fetch_block_s)
+        self.recover_timeout_s = float(recover_timeout_s)
         self.registry = ReplicaRegistry(
             replicas,
             poll_interval_s=poll_interval_s,
             heartbeat_timeout_s=heartbeat_timeout_s,
             quarantine_s=quarantine_s,
             on_dead=self._on_replica_departed_async,
+            # crash recovery: a replica coming alive (first contact
+            # after restart, or a revival) may hold journaled
+            # placements waiting to be re-adopted - kick the
+            # reconcile pass instead of waiting out its tick
+            on_revive=self._on_replica_alive,
         )
         self.affinity = AffinityMap()
         # replicated hot results (router/replication.py): the top-K
@@ -216,6 +243,19 @@ class Router:
         if self._trace_enabled:
             obs_trace.enable()
         self._closed = False
+        # crash recovery (router/journal.py + docs/ROUTER.md): replay
+        # the durable routing journal into the routing table, then
+        # reconcile each recovered handle against the live fleet as
+        # announcers re-JOIN. `route --journal PATH` opts in.
+        self.journal: Optional[RouterJournal] = None
+        self._recover_pending: List[str] = []
+        self._recover_kick = threading.Event()
+        self._recover_deadline = 0.0
+        self._recover_trace = None
+        self._recover_thread: Optional[threading.Thread] = None
+        if journal_path:
+            self.journal = RouterJournal(journal_path)
+            self._restore_from_journal(self.journal.replayed)
         self._hot_stop = threading.Event()
         self._hot_thread: Optional[threading.Thread] = None
         if start:
@@ -226,6 +266,8 @@ class Router:
                     name="blaze-router-hot-replicate",
                 )
                 self._hot_thread.start()
+            if self._recover_pending:
+                self._start_recovery()
 
     def _hot_loop(self) -> None:
         """Background hot-result replication pass (replication.py).
@@ -244,9 +286,15 @@ class Router:
             return
         self._closed = True
         self._hot_stop.set()
+        self._recover_kick.set()
         if self._hot_thread is not None:
             self._hot_thread.join(timeout=5)
             self._hot_thread = None
+        if self._recover_thread is not None:
+            self._recover_thread.join(timeout=5)
+            self._recover_thread = None
+        if self.journal is not None:
+            self.journal.close()
         REGISTRY.unregister_collector(self._collector_key)
         if self._trace_enabled:
             obs_trace.disable()
@@ -265,6 +313,314 @@ class Router:
 
     def __exit__(self, *exc):
         self.close()
+
+    # -- crash recovery (router/journal.py) ------------------------------
+    def _journal_submit(self, rq: RoutedQuery) -> None:
+        if self.journal is None:
+            return
+        try:
+            self.journal.record_submit(
+                rq.external_id, rq.key, rq.meta, rq.task_bytes,
+                rq.is_ref, rq.manifest_bytes,
+            )
+        except Exception:  # noqa: BLE001 - journal loss degrades
+            log.exception("journal submit record failed for %s",
+                          rq.external_id)  # recovery, never serving
+
+    def _journal_place(self, rq: RoutedQuery) -> None:
+        if self.journal is None:
+            return
+        try:
+            with rq.lock:
+                rid, iid = rq.replica_id, rq.internal_id
+                fp, gen = rq.fingerprint, rq.generation
+            if rid and iid:
+                self.journal.record_place(rq.external_id, rid, iid,
+                                          fp, gen)
+        except Exception:  # noqa: BLE001 - journal loss degrades
+            log.exception("journal place record failed for %s",
+                          rq.external_id)
+
+    def _journal_finish(self, rq: RoutedQuery,
+                        state: Optional[str]) -> None:
+        if self.journal is None:
+            return
+        try:
+            self.journal.record_finish(rq.external_id,
+                                       str(state or "FINISHED"))
+        except Exception:  # noqa: BLE001 - journal loss degrades
+            log.exception("journal finish record failed for %s",
+                          rq.external_id)
+
+    def _restore_from_journal(self, entries) -> None:
+        """Rebuild the routing table from replayed journal entries:
+        each live entry becomes a RoutedQuery under its ORIGINAL
+        external id (clients re-attach by query_id), queued for the
+        reconcile pass. Runs in the constructor, before any verb."""
+        max_ctr = -1
+        for e in entries.values():
+            m = re.match(r"rq-(\d+)-", e.external_id)
+            if m:
+                max_ctr = max(max_ctr, int(m.group(1)))
+            rq = RoutedQuery(e.key, e.task_bytes, e.is_ref,
+                             e.manifest_bytes, dict(e.meta),
+                             external_id=e.external_id)
+            rq.recovered = True
+            rq.replica_id = e.replica_id
+            rq.internal_id = e.internal_id
+            rq.fingerprint = e.fingerprint
+            rq.generation = max(1, e.generation) if e.placed else 0
+            if obs_trace.ACTIVE:
+                rq.tracer = obs_trace.begin_trace(
+                    rq.external_id, root_name="router_query"
+                )
+                rq.tracer.root.tag(recovered=True, key=e.key[:16])
+            with self._lock:
+                self._queries[rq.external_id] = rq
+                self._order.append(rq.external_id)
+            self._recover_pending.append(rq.external_id)
+        if max_ctr >= 0:
+            # fast-forward the id counter PAST every recovered id: a
+            # restarted router commonly reuses its pid (container
+            # pid 1, pid recycling), and a reset counter would then
+            # mint a fresh rq-{n}-{pid} that silently overwrites a
+            # recovered handle in _register. Never rewinds: the new
+            # count starts at max(current, recovered)+1
+            global _rqid_counter
+            cur = next(_rqid_counter)
+            _rqid_counter = itertools.count(max(cur, max_ctr + 1))
+        if self._recover_pending:
+            log.info("journal replay: %d routed queries to "
+                     "reconcile", len(self._recover_pending))
+
+    def _start_recovery(self) -> None:
+        self._recover_deadline = (
+            time.monotonic() + self.recover_timeout_s
+        )
+        if obs_trace.ACTIVE and self._recover_trace is None:
+            # the recovery pass gets its own span tree - one
+            # `recover_query` span per reconciled handle, outcome
+            # tagged - so a restart is observable like everything else
+            self._recover_trace = obs_trace.begin_trace(
+                f"router-recover-{os.getpid():x}",
+                root_name="router_recover",
+            )
+            self._recover_trace.root.tag(
+                pending=len(self._recover_pending)
+            )
+        self._recover_thread = threading.Thread(
+            target=self._recover_loop, daemon=True,
+            name="blaze-router-recover",
+        )
+        self._recover_thread.start()
+
+    def _on_replica_alive(self, replica: Replica) -> None:
+        """Registry revive callback: a replica JOINing (or coming
+        back) may hold journaled placements - reconcile NOW instead
+        of on the pass's next tick."""
+        self._recover_kick.set()
+
+    def _recover_loop(self) -> None:
+        while not self._closed:
+            try:
+                outstanding = self._recover_tick()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("recovery tick failed")
+                outstanding = len(self._recover_pending)
+            if not outstanding:
+                break
+            self._recover_kick.wait(timeout=0.25)
+            self._recover_kick.clear()
+        if self._recover_trace is not None:
+            try:
+                self._recover_trace.finish(
+                    outstanding=len(self._recover_pending) or None
+                )
+            except Exception:  # noqa: BLE001 - obs must not raise
+                pass
+
+    def _recover_tick(self) -> int:
+        """One reconcile pass over the recovered handles; returns how
+        many remain unresolved. Exposed for deterministic tests
+        (`start=False` routers drive it manually)."""
+        force = time.monotonic() >= self._recover_deadline \
+            if self._recover_deadline else False
+        for qid in list(self._recover_pending):
+            rq = self._queries.get(qid)
+            if rq is None:
+                self._recover_pending.remove(qid)
+                continue
+            t0 = time.monotonic()
+            outcome = self._reconcile_one(rq, force=force)
+            if outcome is None:
+                continue  # not resolvable yet (replica still absent)
+            self._recover_pending.remove(qid)
+            REGISTRY.inc("blaze_router_recovered_total",
+                         outcome=outcome)
+            log.info("recovered query %s: %s (replica %s)",
+                     qid, outcome, rq.replica_id)
+            if self._recover_trace is not None:
+                try:
+                    self._recover_trace.record_span(
+                        "recover_query", t0, time.monotonic(),
+                        query=qid, outcome=outcome,
+                        replica=rq.replica_id,
+                    )
+                except Exception:  # noqa: BLE001 - obs must not raise
+                    pass
+        return len(self._recover_pending)
+
+    def _reconcile_one(self, rq: RoutedQuery,
+                       force: bool = False) -> Optional[str]:
+        """Reconcile one recovered handle against the live fleet.
+        Returns the outcome label, or None when it cannot be resolved
+        yet (journaled replica not back, no routable capacity) and
+        the pass should retry. Outcomes (the
+        `blaze_router_recovered_total{outcome}` label values):
+
+          adopted_running   journaled placement still executing
+          adopted_done      journaled placement already DONE - the
+                            result is FETCHable as if nothing happened
+          adopted_terminal  journaled placement reached another
+                            terminal state; it surfaces classified
+          replaced          replica lost the handle (or never came
+                            back): re-placed from the journaled
+                            SUBMIT bytes through the failover path
+          requeued          never placed before the crash: re-entered
+                            placement from the journaled bytes
+          stranded          unrecoverable (no routable fleet within
+                            the recovery window)
+        """
+        with rq.lock:
+            if rq.reconciled:
+                return None
+            if rq.cancelled or rq.finished:
+                rq.reconciled = True
+                return "adopted_terminal"
+            placed = rq.internal_id is not None
+            rid = rq.replica_id
+            gen = rq.generation
+        if not placed:
+            # never placed before the crash: re-enter placement like
+            # a fresh submit (the journal already holds its S record)
+            if not self.registry.routable():
+                return self._maybe_strand(rq, force)
+            try:
+                resp = self._place_and_submit(rq, exclude=set())
+            except ReplicaUnavailableError:
+                return self._maybe_strand(rq, force)
+            if "query_id" not in resp:
+                # in-band replica error (e.g. undecodable plan): the
+                # handle ends classified instead of retrying forever
+                with rq.lock:
+                    rq.reconciled = True
+                self._finish(rq, "FAILED")
+                return "stranded"
+            with rq.lock:
+                rq.reconciled = True
+            return "requeued"
+        replica = self.registry.get(rid or "")
+        if replica is None or not replica.alive:
+            if not force:
+                return None  # the announcer may still re-JOIN it
+            return self._replace_or_strand(rq, gen, rid, force)
+        if chaos.ACTIVE:
+            # DROP = a reconcile POLL that never reaches the replica
+            # (the pass retries); STALL = a slow replica under
+            # recovery load
+            try:
+                chaos.fire("router.journal", op="reconcile_poll",
+                           replica=rid, query=rq.external_id)
+            except ConnectionError:
+                return None
+        try:
+            st = self._call(
+                replica, lambda c: c.poll(rq.internal_id)
+            )
+        except (ConnectionError, OSError, ServiceError):
+            # mid-restart replica: retry until the window closes
+            return self._replace_or_strand(rq, gen, rid, force) \
+                if force else None
+        if "query_id" not in st:
+            # the replica answered but lost the handle (it restarted
+            # too): re-run from the journaled bytes - the normal
+            # failover move, cancel-superseded semantics intact.
+            # NO exclusion: the replica is alive and routable, and in
+            # a single-replica fleet excluding it would strand a
+            # perfectly recoverable query (the lost-handle path in
+            # _downstream_status re-places with exclude=set() for the
+            # same reason)
+            return self._replace_or_strand(rq, gen, None, True)
+        state = st.get("state")
+        with rq.lock:
+            if rq.reconciled:
+                return None
+            rq.reconciled = True
+            finished = rq.finished
+        if not finished:
+            # the handle is live again: balance the in-flight gauge
+            # the way a fresh placement would
+            replica.note_routed()
+        if self.placement_mode == "affinity" and rq.fingerprint:
+            # re-learn affinity: repeats keep landing on the replica
+            # whose ResultCache holds this plan's result
+            self.affinity.record(rq.key, replica.replica_id,
+                                 rq.fingerprint)
+        if state == "DONE":
+            return "adopted_done"
+        if state in ("FAILED", "CANCELLED", "TIMED_OUT",
+                     "REJECTED_OVERLOADED"):
+            return "adopted_terminal"
+        return "adopted_running"
+
+    def _replace_or_strand(self, rq: RoutedQuery, gen: int,
+                           old_rid: Optional[str],
+                           force: bool) -> Optional[str]:
+        """Re-place a recovered handle away from its journaled
+        replica (lost handle / replica never returned)."""
+        exclude = {old_rid} if old_rid else set()
+        routable = [
+            r for r in self.registry.routable()
+            if r.replica_id not in exclude
+        ]
+        if not routable:
+            return self._maybe_strand(rq, force)
+        if self._resubmit(rq, gen, same_replica=False,
+                          exclude=exclude, counter="failovers"):
+            with rq.lock:
+                rq.reconciled = True
+            return "replaced"
+        return self._maybe_strand(rq, force)
+
+    def _maybe_strand(self, rq: RoutedQuery,
+                      force: bool) -> Optional[str]:
+        if not force:
+            return None
+        with rq.lock:
+            rq.reconciled = True
+        self._finish(rq, "REJECTED_OVERLOADED")
+        log.warning("recovered query %s stranded: no routable "
+                    "replica within the recovery window",
+                    rq.external_id)
+        return "stranded"
+
+    def _await_reconcile(self, rq: RoutedQuery,
+                         poll_s: float = 0.05) -> None:
+        """Block a client FETCH of a recovered handle until the
+        reconcile pass resolved it (bounded by the recovery window):
+        fetching against a stale placement would bounce UNKNOWN off a
+        replica that restarted, when one more announcer tick away the
+        journaled result is servable."""
+        if not rq.recovered or rq.reconciled:
+            return
+        deadline = max(
+            self._recover_deadline,
+            time.monotonic() + 1.0,
+        ) + 5.0
+        while time.monotonic() < deadline:
+            if rq.reconciled or rq.finished:
+                return
+            time.sleep(poll_s)
 
     # -- downstream client pool -----------------------------------------
     def _call(self, replica: Replica, fn):
@@ -415,6 +771,9 @@ class Router:
             if rq.finished:
                 return False
             rq.finished = True
+        # terminal = journal truncation marker: replay drops the
+        # entry, compaction reclaims its bytes
+        self._journal_finish(rq, state)
         r = self.registry.get(rq.replica_id or "")
         if r is not None:
             r.note_unrouted()
@@ -431,8 +790,8 @@ class Router:
                     failovers=rq.failovers or None,
                     resubmits=rq.resubmits or None,
                 )
-                overhead = obs_phases.fold_span_dicts(
-                    rq.tracer.to_dicts()
+                overhead = rq.tracer.phase_totals(
+                    obs_phases.SPAN_PHASE
                 ).get("router")
                 if overhead is not None:
                     obs_phases.ROLLUP.observe(
@@ -478,6 +837,10 @@ class Router:
             )
             rq.tracer.root.tag(key=key[:16],
                                placement=self.placement_mode)
+        # journal the SUBMIT bytes BEFORE placement: a crash between
+        # admission and placement leaves a never-placed entry that
+        # recovery re-enters into placement
+        self._journal_submit(rq)
         try:
             resp = self._place_and_submit(rq, exclude=set())
         except ReplicaUnavailableError as e:
@@ -496,7 +859,12 @@ class Router:
             # not create the query, so there is no downstream handle to
             # track. Surface it exactly as a single serve instance
             # would - registering rq here would leave a never-finished
-            # entry pinning its task_bytes in the routing table forever
+            # entry pinning its task_bytes in the routing table forever.
+            # The journal needs the truncation marker though: without
+            # it the S record stays live, compaction preserves it, and
+            # the next restart would resurrect the known-bad plan as a
+            # never-placed query and re-submit it to the fleet
+            self._journal_finish(rq, resp.get("state") or "FAILED")
             return resp
         self._register(rq)
         return self._rewrite(resp, rq)
@@ -635,6 +1003,10 @@ class Router:
                             # replica subtree (REPORT)
                             rq.hop_span = hop
                 replica.note_routed()
+                # placement record: recovery re-adopts by POLLing
+                # this (replica_id, internal_id); failover moves land
+                # as newer P records for the same handle
+                self._journal_place(rq)
                 place_sp.tag(rung=decision.reason,
                              replica=replica.replica_id)
                 reason = f"placed_{decision.reason}" \
@@ -817,6 +1189,10 @@ class Router:
                 self.registry.probe(rid)
             except Exception:  # noqa: BLE001 - the poller retries
                 pass
+        if self._recover_pending:
+            # recovery reconciles as announcers re-JOIN: this may be
+            # the replica a journaled placement is waiting for
+            self._recover_kick.set()
         return {
             "ok": True,
             "replica": rid,
@@ -971,6 +1347,19 @@ class Router:
                 f"status of {rq.external_id} unobtainable: the fleet "
                 "keeps failing under it"
             )
+        if rq.recovered and not rq.reconciled and not rq.finished:
+            # a recovered handle awaiting reconcile: its detached
+            # downstream run is (presumably) still executing - report
+            # RUNNING instead of finalizing on replayed state, and
+            # never error on a replica the announcers have not
+            # re-delivered yet
+            return {
+                "query_id": rq.external_id,
+                "state": "RUNNING",
+                "note": "recovering: awaiting replica re-JOIN for "
+                        "reconciliation",
+                "replica": rq.replica_id,
+            }
         if rq.internal_id is None:
             # never placed (REJECTED_OVERLOADED at submit): the
             # routing table still owns the handle - report its
@@ -984,6 +1373,10 @@ class Router:
         gen = rq.generation
         replica = self.registry.get(rq.replica_id or "")
         if replica is None:
+            if rq.finished and rq.last_state:
+                # e.g. a stranded recovery: the replica never came
+                # back, but the router still owns the handle
+                return self._last_known_status(rq)
             raise KeyError(f"unknown replica for {rq.external_id}")
         try:
             st = self._call(
@@ -1211,6 +1604,10 @@ class Router:
                 **counters,
                 "queries_retained": retained,
                 "affinity_entries": len(self.affinity),
+                # crash-safety state: journaling on/off + how many
+                # recovered handles still await reconciliation
+                "journal": self.journal is not None,
+                "recover_pending": len(self._recover_pending),
             },
             "replicas": self.registry.snapshot(),
             "fleet": fleet,
@@ -1297,6 +1694,10 @@ class Router:
         rq = self.get(external_id)
         if rq.splice_broken:
             raise ServiceError(_SPLICE_ERR)
+        # a FETCH racing the reconcile pass waits for it (bounded):
+        # fetching a stale placement would bounce off a replica one
+        # announcer tick away from serving the journaled result
+        self._await_reconcile(rq)
         sent = 0
         cycles = 0
         max_cycles = 3 + self.max_resubmits \

@@ -150,6 +150,17 @@ class Query:
         # submitted / admitted / run_start / finished (+ stream_ns
         # accumulated by the wire tier)
         self.timings: Dict[str, float] = {"submitted": self.submitted_at}
+        # orphan detection (service._sweep_orphans): last client
+        # touch (poll/report/fetch) and whether the result was ever
+        # streamed - a terminal query nobody polls or fetches past
+        # the orphan TTL is reaped (its router died; retention must
+        # not pin its result forever)
+        self.last_activity = self.submitted_at
+        self.fetched = False
+        # live FETCH streams against this query: the sweep must never
+        # reap under an in-progress collection, no matter how slowly
+        # the parts pace out relative to the TTL
+        self.fetchers = 0
 
         self._lock = threading.Lock()
         self._cancel = threading.Event()
@@ -218,6 +229,23 @@ class Query:
                     "terminal observability hook failed for %s",
                     self.query_id,
                 )
+
+    def note_activity(self) -> None:
+        """A client touched this query (POLL/REPORT/FETCH): defer the
+        orphan sweep. Unlocked monotonic-float store - races only
+        jitter the TTL by one touch."""
+        self.last_activity = time.monotonic()
+
+    def begin_fetch(self) -> None:
+        """Locked, unlike note_activity: fetchers is a counter, and a
+        lost increment under two concurrent FETCHes would let the
+        orphan sweep reap this query mid-collection."""
+        with self._lock:
+            self.fetchers += 1
+
+    def end_fetch(self) -> None:
+        with self._lock:
+            self.fetchers -= 1
 
     # -- cancellation / deadline ---------------------------------------
     def request_cancel(self, reason: str = "user") -> None:

@@ -77,6 +77,7 @@ class QueryService:
         history: Optional[RuntimeHistory] = None,
         fold_phases: bool = True,
         mesh_mode: Optional[str] = None,
+        orphan_ttl_s: Optional[float] = 900.0,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -135,7 +136,20 @@ class QueryService:
             "degraded_queries": 0,
             "retried_queries": 0,
             "slow_queries": 0,
+            "orphans_reaped": 0,
         }
+        # orphan reaping (docs/SERVICE.md): a detach=True query whose
+        # ROUTER died holds its result in retention forever - nothing
+        # will ever POLL or FETCH it, and _MAX_RETAINED eviction only
+        # helps under fresh traffic. The sweep reaps terminal,
+        # never-fetched queries with no client activity for
+        # orphan_ttl_s (None/<=0 disables); a reaped query's FETCH
+        # answers the classified UNKNOWN not-found, never a hang
+        self.orphan_ttl_s = (
+            float(orphan_ttl_s)
+            if orphan_ttl_s and orphan_ttl_s > 0 else None
+        )
+        self._next_orphan_sweep = 0.0
         # instance label: the registry is process-wide and several
         # services may be alive at once - unlabeled samples would
         # collide into duplicate series and fail the whole scrape
@@ -365,7 +379,9 @@ class QueryService:
         return q
 
     def poll(self, query_id: str) -> dict:
-        return self.get(query_id).status()
+        q = self.get(query_id)
+        q.note_activity()  # a polled query has an attentive owner
+        return q.status()
 
     def cancel(self, query_id: str) -> dict:
         """Request cancellation. QUEUED queries die here; ADMITTED and
@@ -399,6 +415,7 @@ class QueryService:
         from blaze_tpu.runtime.instrument import render_metrics
 
         q = self.get(query_id)
+        q.note_activity()
         st = q.status()
         head = [
             f"query {q.query_id}: {st['state']} "
@@ -461,6 +478,10 @@ class QueryService:
                 # reads this to mark the replica DRAINING (unroutable
                 # for NEW placements) before any submit bounces
                 "draining": self.draining,
+                # orphan sweep (serve --orphan-ttl): retention held
+                # by a dead router's abandoned detached queries is
+                # reclaimed after this long (null = disabled)
+                "orphan_ttl_s": self.orphan_ttl_s,
             },
         }
         if self.cache is not None:
@@ -557,6 +578,10 @@ class QueryService:
             for k in ("entries", "bytes", "spilled_entries"):
                 samples.append((f"blaze_result_cache_{k}", dict(sid),
                                 c.get(k, 0), "gauge"))
+        with self._lock:
+            orphans = self.obs_counters["orphans_reaped"]
+        samples.append(("blaze_service_orphans_reaped_total",
+                        dict(sid), orphans, "counter"))
         h = self.history.summary(top=0)
         samples.append(("blaze_runtime_history_fingerprints",
                         dict(sid), h["fingerprints"], "gauge"))
@@ -602,6 +627,7 @@ class QueryService:
             if self._stop:
                 return
             self._sweep_deadlines()
+            self._sweep_orphans()
             while True:
                 q = self.admission.next_admissible()
                 if q is None:
@@ -706,6 +732,41 @@ class QueryService:
                 # invariant that a terminal state implies cleaned-up
                 # execution resources
                 q.request_cancel(reason="deadline")
+
+    def _sweep_orphans(self) -> None:
+        """Reap terminal queries no router will ever collect: never
+        fetched, no POLL/REPORT activity for orphan_ttl_s. Closes the
+        replica-side leak of a permanently-dead router - the detached
+        downstream runs it abandoned must not pin retention (and their
+        materialized results) forever. Throttled to ~4 sweeps per TTL
+        so the dispatcher loop stays cheap."""
+        ttl = self.orphan_ttl_s
+        if ttl is None:
+            return
+        now = time.monotonic()
+        if now < self._next_orphan_sweep:
+            return
+        self._next_orphan_sweep = now + max(0.05, ttl / 4.0)
+        reaped = []
+        with self._lock:
+            for qid, q in self._queries.items():
+                if not q.done or q.fetched or q.fetchers > 0:
+                    continue
+                idle_since = max(q.last_activity,
+                                 q.timings.get("finished", 0.0))
+                if now - idle_since > ttl:
+                    reaped.append(qid)
+            for qid in reaped:
+                self._queries.pop(qid, None)
+            if reaped:
+                gone = set(reaped)
+                self._order = [
+                    qid for qid in self._order if qid not in gone
+                ]
+                self.obs_counters["orphans_reaped"] += len(reaped)
+        for qid in reaped:
+            log.info("reaped orphaned query %s (terminal, never "
+                     "fetched, idle > %.1fs)", qid, ttl)
 
     # -- execution ------------------------------------------------------
     def _run_query(self, q: Query) -> None:

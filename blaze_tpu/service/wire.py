@@ -299,15 +299,32 @@ class ServiceVerbBackend:
             self.service.cancel(qid)
 
     def fetch(self, sock, qid: str, timeout_ms: int) -> None:
+        try:
+            q = self.service.get(qid)
+        except KeyError:
+            # includes queries the orphan sweep reaped: a dead
+            # router's abandoned handle answers classified not-found,
+            # never a hang
+            _send_err(sock, f"UNKNOWN: no query {qid}")
+            return
+        q.note_activity()  # a FETCH defers the orphan sweep
+        # in-progress-fetch guard: the orphan sweep must not reap a
+        # query mid-collection (a slow first part or a long DONE-wait
+        # could otherwise out-idle a short TTL); released in the
+        # finally below
+        q.begin_fetch()
+        try:
+            self._fetch_stream(sock, q, timeout_ms)
+        finally:
+            q.end_fetch()
+            q.note_activity()
+
+    def _fetch_stream(self, sock, q, timeout_ms: int) -> None:
         from blaze_tpu.io.ipc import encode_ipc_segment
         from blaze_tpu.service.query import QueryState
 
         service = self.service
-        try:
-            q = service.get(qid)
-        except KeyError:
-            _send_err(sock, f"UNKNOWN: no query {qid}")
-            return
+        qid = q.query_id
         if not q.wait(timeout_ms / 1000.0 if timeout_ms else None):
             _send_err(sock, f"{q.state.value}: fetch timed out")
             return
@@ -330,8 +347,14 @@ class ServiceVerbBackend:
                                partition=i)
                 sock.sendall(encode_ipc_segment(rb))
                 sent += 1
+                # per-part activity: a stream slower than the orphan
+                # TTL is still a COLLECTING client, not a dead router
+                q.note_activity()
             sock.sendall(_U64.pack(0))
             complete = True
+            # a fully-streamed result was COLLECTED: it is no orphan
+            # candidate no matter how long it then sits in retention
+            q.fetched = True
         except Exception as e:
             # once parts are on the wire the client reads u64 frames;
             # a JSON error frame here would desync it - abort the

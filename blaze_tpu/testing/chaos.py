@@ -47,6 +47,14 @@ Injection sites (each named in docs/ROBUSTNESS.md):
                     never reaches the replica (the announcer's next
                     tick retries), STALL = a slow membership
                     authority widening join/leave race windows
+  router.journal    the durable routing journal (router/journal.py)
+                    and the recovery pass (router/proxy.py), keyed by
+                    the `op` context value: op=append DROP tears the
+                    record mid-write (the crash-at-the-worst-moment
+                    replay test), op=fsync STALL = slow disk under
+                    the batched flusher, op=reconcile_poll DROP = a
+                    recovery POLL that never reaches the journaled
+                    replica (the pass retries next tick)
 
 Activation: programmatic `install()`/`active()` (tests), or the
 BLAZE_CHAOS environment variable carrying the plan as JSON - worker

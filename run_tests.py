@@ -192,6 +192,7 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
         ["tests/test_chaos.py", "tests/test_service_failures.py",
          "tests/test_cluster_chaos.py", "tests/test_router.py",
          "tests/test_membership.py", "tests/test_churn.py",
+         "tests/test_journal.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}
@@ -201,15 +202,19 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
 
 
 def churn_smoke() -> bool:
-    """Rolling-restart smoke (ISSUE 9 satellite): the fleet-churn
-    suites - JOIN/LEAVE membership, graceful drain, hot-result
-    replication/promotion - plus the subprocess acceptance e2e
-    (SIGTERM-drain 3 replicas in turn under a live query mix with
-    zero client-visible failures, then SIGKILL a hot fingerprint's
-    affinity home and serve its repeat warm from the survivor)."""
+    """Rolling-restart smoke (ISSUE 9 satellite + ISSUE 11
+    router-restart rounds): the fleet-churn suites - JOIN/LEAVE
+    membership, graceful drain, hot-result replication/promotion, the
+    ROUTER restart rounds (drain-restart and kill-restart from the
+    routing journal under a live query mix, zero client-visible
+    failures) - plus the subprocess acceptance e2es (SIGTERM-drain 3
+    replicas in turn, SIGKILL a hot fingerprint's affinity home, and
+    SIGKILL the router mid-query + restart it on the same port/journal
+    with zero re-executions)."""
     return run(
         "churn suite",
-        ["tests/test_membership.py", "tests/test_churn.py"],
+        ["tests/test_membership.py", "tests/test_churn.py",
+         "tests/test_journal.py"],
     )
 
 

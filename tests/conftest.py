@@ -95,6 +95,28 @@ def _obs_hygiene():
 
 
 @pytest.fixture(autouse=True)
+def _journal_hygiene():
+    """Router-journal hygiene (_obs_hygiene-style, ISSUE 11): journal
+    files in tests belong under pytest's tmp_path. A test that
+    mistakenly points `Router(journal_path=...)` at a repo-relative
+    path - or a failed test whose journal survived - must not leave
+    durable routing state behind for a later test (or a later PR's
+    git status) to trip over: a stale journal replays as phantom
+    recovered queries."""
+    import glob
+    import os
+
+    before = set(glob.glob("*.journal")) | set(glob.glob("*.rjournal"))
+    yield
+    for path in (set(glob.glob("*.journal"))
+                 | set(glob.glob("*.rjournal"))) - before:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+@pytest.fixture(autouse=True)
 def _isolate_engine_globals():
     from blaze_tpu import config as config_mod
     from blaze_tpu.runtime import memory as memory_mod

@@ -14,6 +14,7 @@ Two tiers:
     (0 dispatches) from the survivor holding the replicated result.
 """
 
+import json
 import os
 import re
 import socket
@@ -25,6 +26,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
 
+from blaze_tpu.router import Router, RouterServer
 from blaze_tpu.runtime.gateway import TaskGatewayServer
 from blaze_tpu.service import QueryService, ServiceClient
 from tests.test_router import Fleet, _reap, _spawn, wait_done
@@ -148,6 +150,104 @@ def test_inprocess_rolling_drain_is_client_invisible(dataset):
                 except OSError:
                     pass
                 svc.close()
+
+
+def test_inprocess_router_restart_rounds_under_live_mix(
+    dataset, tmp_path
+):
+    """ISSUE 11 churn rounds: restart the ROUTER itself - once
+    drain-style (clean close, journal fsynced) and once kill-style
+    (the old router simply abandoned mid-everything) - while a
+    repeated-query mix runs through the wire tier on a fixed port.
+    The journal + ServiceClient's reconnect-with-backoff make both
+    restarts client-invisible: zero failures in the mix."""
+    blobs = [dataset(), dataset(0.3)]
+    jp = str(tmp_path / "router.journal")
+    with Fleet() as fl:
+
+        def mk_router():
+            return Router(
+                fl.specs,
+                poll_interval_s=0.1,
+                heartbeat_timeout_s=1.0,
+                resubmit_backoff_s=0.01,
+                journal_path=jp,
+                recover_timeout_s=15.0,
+            )
+
+        r = mk_router()
+        srv = RouterServer(r).start()
+        host, port = srv.address
+        failures = []
+        completed = [0]
+        stop = threading.Event()
+
+        def mix():
+            with ServiceClient(host, port, timeout=60.0,
+                               reconnect_attempts=8) as c:
+                while not stop.is_set():
+                    for b in blobs:
+                        try:
+                            st = c.submit(b)
+                            if st.get("state") in TERMINAL_BAD:
+                                failures.append(("submit", st))
+                                continue
+                            deadline = time.monotonic() + 60
+                            while True:
+                                p = c.poll(st["query_id"])
+                                if p.get("state") == "DONE":
+                                    completed[0] += 1
+                                    break
+                                if p.get("state") in TERMINAL_BAD \
+                                        or "error" in p:
+                                    failures.append(("poll", p))
+                                    break
+                                if time.monotonic() > deadline:
+                                    failures.append(("stuck", p))
+                                    break
+                                time.sleep(0.02)
+                        except Exception as e:  # noqa: BLE001
+                            failures.append(("raise", repr(e)))
+                    time.sleep(0.01)
+
+        t = threading.Thread(target=mix, daemon=True)
+        t.start()
+        abandoned = []
+        try:
+            assert wait_for(lambda: completed[0] >= 2, timeout=60)
+            # round 1: drain-style restart - close() fsyncs the
+            # journal and stops every thread before the successor
+            # binds the same port
+            srv.stop()
+            r.close()
+            r = mk_router()
+            srv = RouterServer(r, host, port).start()
+            base = completed[0]
+            assert wait_for(
+                lambda: completed[0] >= base + 2, timeout=60
+            )
+            # round 2: kill-style restart - the old router is
+            # ABANDONED (no close, no drain, no final fsync), exactly
+            # what SIGKILL leaves behind
+            srv.stop()
+            abandoned.append(r)
+            r = mk_router()
+            srv = RouterServer(r, host, port).start()
+            base = completed[0]
+            assert wait_for(
+                lambda: completed[0] >= base + 2, timeout=60
+            )
+            assert failures == [], failures[:5]
+        finally:
+            stop.set()
+            t.join(timeout=30)
+            try:
+                srv.stop()
+            except OSError:
+                pass
+            r.close()
+            for old in abandoned:
+                old.close()
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +410,115 @@ def test_e2e_rolling_restart_and_hot_kill_acceptance(dataset):
             assert p2["replica"] != victim
             assert p2["dispatches"] == 0, p2
             assert p2["cache_hits"] == 1
+    finally:
+        for proc in procs:
+            _reap(proc)
+
+
+@pytest.mark.slow
+def test_e2e_router_sigkill_restart_recovers_with_zero_reexecutions(
+    dataset, tmp_path
+):
+    """ISSUE 11 acceptance, end to end: SIGKILL the `route` CLI
+    mid-query (the replica's detached run keeps executing), restart
+    it on the SAME port with the SAME --journal, and the unchanged
+    ServiceClient - reconnect-with-backoff + re-attach by query_id -
+    FETCHes the full result. Zero re-executions: the replica's
+    admission `submitted` counter is flat across the router's death,
+    and the reconcile outcome is visible on
+    `blaze_router_recovered_total{outcome}`."""
+    jp = str(tmp_path / "router.journal")
+    rport = _free_port()
+    sport = _free_port()
+
+    def spawn_router():
+        proc, rhost_, rport_ = _spawn(
+            ["route", "--port", str(rport),
+             "--poll-interval", "0.1",
+             "--heartbeat-timeout", "0.8",
+             "--quarantine", "60",
+             "--journal", jp,
+             "--recover-timeout", "60"],
+        )
+        assert rport_ == rport
+        return proc, rhost_
+
+    rproc, rhost = spawn_router()
+    procs = [rproc]
+    # the replica STALLs its FIRST execution for 8s: the window the
+    # router is killed and restarted inside
+    sproc, shost, _ = _spawn(
+        ["serve", "--port", str(sport),
+         "--max-concurrency", "2",
+         "--router", f"{rhost}:{rport}"],
+        env_extra={"BLAZE_CHAOS": json.dumps({
+            "seed": 1,
+            "faults": [{"site": "task.execute", "klass": "STALL",
+                        "stall_s": 8.0, "times": 1}],
+        })},
+    )
+    procs.append(sproc)
+    try:
+        blob = dataset()
+        with ServiceClient(rhost, rport, timeout=120.0,
+                           reconnect_attempts=8) as c, \
+                ServiceClient(shost, sport, timeout=60.0) as rc:
+            assert wait_for(
+                lambda: _stats(c).get("fleet", {}).get("alive") == 1,
+                timeout=120,
+            )
+            st = c.submit(blob)
+            qid = st["query_id"]
+            assert st.get("state") not in TERMINAL_BAD
+            # mid-query: placed downstream and RUNNING (stalled)
+            assert wait_for(
+                lambda: c.poll(qid).get("state") == "RUNNING",
+                timeout=60,
+            )
+            submitted_before = (
+                rc.stats()["admission"]["submitted"]
+            )
+            assert submitted_before >= 1
+            rproc.kill()  # SIGKILL: no drain, no fsync, no goodbye
+            rproc.wait(timeout=30)
+            rproc2, _ = spawn_router()
+            procs.append(rproc2)
+            # the UNCHANGED client rides through: reconnect, re-attach
+            # by query_id, poll to DONE (the replica re-JOINs within
+            # one announcer tick; reconcile re-adopts the run)
+            deadline = time.monotonic() + 120
+            state = None
+            while time.monotonic() < deadline:
+                p = c.poll(qid)
+                state = p.get("state")
+                assert state not in TERMINAL_BAD, p
+                assert "error" not in p, p
+                if state == "DONE":
+                    break
+                time.sleep(0.1)
+            assert state == "DONE"
+            batches = c.fetch(qid)
+            rows = sum(rb.num_rows for rb in batches)
+            assert rows > 0
+            # THE pin: zero re-executions - the replica saw exactly
+            # one submit for this query across the router's death
+            assert rc.stats()["admission"]["submitted"] \
+                == submitted_before
+            # reconcile outcome on the metrics surface
+            metrics = c.metrics()
+            m = re.search(
+                r'blaze_router_recovered_total\{outcome='
+                r'"(adopted_running|adopted_done)"\} (\d+)',
+                metrics,
+            )
+            assert m and int(m.group(2)) >= 1, metrics[:2000]
+            # integrity: a post-restart repeat (served from the
+            # replica's result cache) returns the same result
+            st2 = c.submit(blob)
+            rows2 = sum(
+                rb.num_rows for rb in c.fetch(st2["query_id"])
+            )
+            assert rows2 == rows
     finally:
         for proc in procs:
             _reap(proc)

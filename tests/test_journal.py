@@ -1,0 +1,539 @@
+"""Durable routing journal + router restart reconciliation (ISSUE 11).
+
+Two tiers:
+  * unit tier: journal framing roundtrip, replay idempotence,
+    torn-tail truncation (manual garbage AND the chaos DROP fault
+    that tears a record mid-write), terminal truncation markers,
+    compaction, fsync STALL chaos.
+  * reconcile matrix (in-process, two QueryService replicas behind a
+    journaled Router): a "restarted" router - a second Router built
+    from the same journal - re-adopts a still-RUNNING placement, a
+    DONE placement (FETCHable with zero re-executions), re-places
+    when the journaled replica is gone, re-enters placement for a
+    never-placed entry, strands cleanly with no fleet, reports a
+    RUNNING placeholder while reconciliation is pending, and retries
+    a chaos-DROPped reconcile POLL. Outcomes are pinned on
+    `blaze_router_recovered_total{outcome}`.
+
+The subprocess acceptance e2e (SIGKILL the route CLI mid-query,
+restart on the same port + journal, client FETCHes the full result
+with zero re-executions) lives in tests/test_churn.py.
+"""
+
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.router import Router
+from blaze_tpu.router.journal import RouterJournal
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_router import Fleet, wait_done
+from tests.test_service import wait_for
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(31)
+    p = str(tmp_path / "j.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "k": pa.array(rng.integers(0, 25, 5000), pa.int32()),
+                "v": pa.array(rng.random(5000), pa.float64()),
+            }
+        ),
+        p,
+    )
+
+    def blob(threshold=0.5):
+        from blaze_tpu.exprs import AggExpr, AggFn, Col
+        from blaze_tpu.ops import (
+            AggMode,
+            FilterExec,
+            HashAggregateExec,
+        )
+        from blaze_tpu.ops.parquet_scan import (
+            FileRange,
+            ParquetScanExec,
+        )
+        from blaze_tpu.plan.serde import task_to_proto
+
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(p)]]),
+                Col("v") > threshold,
+            ),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+            mode=AggMode.COMPLETE,
+        )
+        return task_to_proto(plan, 0)
+
+    return blob
+
+
+@pytest.fixture
+def journal_path(tmp_path):
+    return str(tmp_path / "router.journal")
+
+
+def _restart(fleet_specs, journal_path, **kw):
+    """A 'restarted' router: a fresh Router over the same journal.
+    Manual lifecycle (start=False) so each test drives polling and
+    the reconcile tick deterministically."""
+    r = Router(
+        fleet_specs,
+        poll_interval_s=0.1,
+        heartbeat_timeout_s=1.0,
+        resubmit_backoff_s=0.01,
+        start=False,
+        journal_path=journal_path,
+        **kw,
+    )
+    r.registry.poll_now()
+    return r
+
+
+def _recovered(outcome):
+    return REGISTRY.get("blaze_router_recovered_total",
+                        outcome=outcome)
+
+
+# ---------------------------------------------------------------------------
+# unit tier: the journal file itself
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_terminal_truncation(journal_path):
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-a", "key-a", {"priority": 1},
+                        b"\x00task-a", False, None)
+        j.record_place("rq-a", "h:1", "q-1", "fp-a", 1)
+        j.record_submit("rq-b", "key-b", {}, b"task-b", True, b"{}")
+        j.record_finish("rq-b", "DONE")
+        j.sync()
+        entries, torn = RouterJournal.replay_file(journal_path)
+    assert torn is None
+    # the F record is a truncation marker: rq-b replays to nothing
+    assert set(entries) == {"rq-a"}
+    e = entries["rq-a"]
+    assert e.task_bytes == b"\x00task-a"
+    assert (e.replica_id, e.internal_id) == ("h:1", "q-1")
+    assert e.fingerprint == "fp-a" and e.meta == {"priority": 1}
+    assert not e.is_ref and e.manifest_bytes is None
+
+
+def test_journal_replay_is_idempotent(journal_path):
+    with RouterJournal(journal_path) as j:
+        for i in range(8):
+            j.record_submit(f"rq-{i}", f"k{i}", {}, b"x" * i, False,
+                            None)
+            if i % 2:
+                j.record_finish(f"rq-{i}", "DONE")
+        j.sync()
+    one, _ = RouterJournal.replay_file(journal_path)
+    two, _ = RouterJournal.replay_file(journal_path)
+    assert {k: vars(v) for k, v in one.items()} \
+        == {k: vars(v) for k, v in two.items()}
+    assert set(one) == {"rq-0", "rq-2", "rq-4", "rq-6"}
+
+
+def test_journal_torn_tail_truncated_on_reopen(journal_path):
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-keep", "k", {}, b"payload", False, None)
+        j.record_place("rq-keep", "h:9", "q-9", None, 1)
+        j.sync()
+    # a crash mid-write: a frame header promising more bytes than
+    # the file holds
+    with open(journal_path, "ab") as f:
+        f.write(b"\xff\x00\x00\x00CRASHED-MID-WRITE")
+    entries, torn = RouterJournal.replay_file(journal_path)
+    assert torn is not None
+    assert set(entries) == {"rq-keep"}
+    assert entries["rq-keep"].internal_id == "q-9"
+    # reopening truncates the torn tail; the file replays clean after
+    with RouterJournal(journal_path) as j2:
+        assert set(j2.replayed) == {"rq-keep"}
+    entries2, torn2 = RouterJournal.replay_file(journal_path)
+    assert torn2 is None and set(entries2) == {"rq-keep"}
+
+
+def test_journal_chaos_drop_tears_the_record(journal_path):
+    """The `router.journal` op=append DROP fault models the process
+    dying mid-write: only part of the frame lands. Replay keeps
+    everything before the torn record and drops the tail."""
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-ok", "k", {}, b"whole", False, None)
+        with chaos.active(
+            [Fault("router.journal", klass="DROP", match="append",
+                   times=1)],
+            seed=3,
+        ) as plan:
+            j.record_submit("rq-torn", "k2", {}, b"half", False,
+                            None)
+            assert plan.fired("router.journal") == 1
+        j.sync()
+    entries, torn = RouterJournal.replay_file(journal_path)
+    assert torn is not None
+    assert set(entries) == {"rq-ok"}
+
+
+def test_journal_chaos_stall_on_fsync_only_slows(journal_path):
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-s", "k", {}, b"x", False, None)
+        with chaos.active(
+            [Fault("router.journal", klass="STALL", match="fsync",
+                   stall_s=0.05, times=1)],
+            seed=4,
+        ) as plan:
+            t0 = time.monotonic()
+            j.sync()
+            assert time.monotonic() - t0 >= 0.04
+            assert plan.fired("router.journal") == 1
+    entries, torn = RouterJournal.replay_file(journal_path)
+    assert torn is None and set(entries) == {"rq-s"}
+
+
+def test_journal_compaction_reclaims_dead_records(journal_path):
+    j = RouterJournal(journal_path)
+    try:
+        for i in range(50):
+            j.record_submit(f"rq-{i}", f"k{i}", {}, b"y" * 64,
+                            False, None)
+            if i != 7:
+                j.record_finish(f"rq-{i}", "DONE")
+        j.sync()
+        before = os.path.getsize(journal_path)
+        with j._lock:
+            j._compact_locked()
+        after = os.path.getsize(journal_path)
+        assert after < before
+        entries, torn = RouterJournal.replay_file(journal_path)
+        assert torn is None and set(entries) == {"rq-7"}
+    finally:
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# reconcile matrix: restart a journaled router against a live fleet
+# ---------------------------------------------------------------------------
+
+
+def _fleet_submitted(fl):
+    return sum(
+        svc.admission.stats()["submitted"] for svc in fl.svcs
+    )
+
+
+def test_restart_adopts_running_query_zero_reexecutions(
+    dataset, journal_path
+):
+    """SIGKILL-mid-query, in process: the downstream run is
+    detach=True and keeps executing through the router's death; the
+    restarted router re-adopts it by POLLing the journaled
+    internal_id - no re-placement, no second execution."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=3.0, times=1)],
+        seed=11,
+    ):
+        with Fleet(router_kw={"journal_path": journal_path}) as fl:
+            st = fl.router.submit({"use_cache": True}, blob)
+            qid = st["query_id"]
+            rq = fl.router.get(qid)
+            assert rq.internal_id is not None  # placed + journaled
+            submitted_before = _fleet_submitted(fl)
+            # "SIGKILL": the old router is simply abandoned - no
+            # drain, no close, no final fsync (os.write already put
+            # the records in the file, exactly like a real kill)
+            r2 = _restart(fl.specs, journal_path)
+            try:
+                assert r2._recover_pending == [qid]
+                # a client poll during reconciliation reports the
+                # placeholder, never finalizes on replayed state
+                assert r2.poll(qid)["state"] == "RUNNING"
+                r2._recover_deadline = time.monotonic() + 10
+                assert wait_for(
+                    lambda: r2._recover_tick() == 0, timeout=10
+                )
+                assert _recovered("adopted_running") == 1
+                p = wait_done(r2, qid)
+                assert p["state"] == "DONE"
+                parts = list(r2.stream_parts(qid))
+                assert parts
+                # THE pin: zero re-executions - the fleet saw exactly
+                # the submits it had before the router died
+                assert _fleet_submitted(fl) == submitted_before
+            finally:
+                r2.close()
+
+
+def test_restart_adopts_done_query_still_fetchable(
+    dataset, journal_path
+):
+    blob = dataset(0.3)
+    with Fleet(router_kw={"journal_path": journal_path}) as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        qid = st["query_id"]
+
+        def downstream_done():
+            return any(
+                svc.stats()["queries"]["by_state"].get("DONE", 0)
+                for svc in fl.svcs
+            )
+
+        assert wait_for(downstream_done, timeout=30)
+        submitted_before = _fleet_submitted(fl)
+        r2 = _restart(fl.specs, journal_path)
+        try:
+            r2._recover_deadline = time.monotonic() + 10
+            assert wait_for(
+                lambda: r2._recover_tick() == 0, timeout=10
+            )
+            assert _recovered("adopted_done") == 1
+            # FETCHable as if nothing happened, without re-running
+            parts = list(r2.stream_parts(qid))
+            assert parts
+            assert r2.poll(qid)["state"] == "DONE"
+            assert _fleet_submitted(fl) == submitted_before
+        finally:
+            r2.close()
+
+
+def test_restart_replaces_query_when_replica_gone(
+    dataset, journal_path
+):
+    """The journaled replica never re-JOINs: past the recovery
+    window the query is re-placed from the journaled SUBMIT bytes
+    through the normal failover path, on the survivor."""
+    blob = dataset()
+    with Fleet(router_kw={"journal_path": journal_path}) as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        qid = st["query_id"]
+        rq = fl.router.get(qid)
+        victim = rq.replica_id
+        survivor = fl.other(victim)
+        # wait downstream-side only: a router-side poll would
+        # finalize the handle and journal its F truncation marker -
+        # the scenario under test is a LIVE journaled query whose
+        # replica dies with the router
+        vsvc = fl.by_id[victim][0]
+        assert wait_for(
+            lambda: vsvc.stats()["queries"]["by_state"]
+            .get("DONE", 0) > 0,
+            timeout=30,
+        )
+        fl.kill_gateway(victim)
+        # the restarted router only ever learns about the survivor
+        r2 = _restart([survivor], journal_path)
+        try:
+            # within the window: unresolved (the victim might still
+            # re-JOIN), reported as the RUNNING placeholder
+            r2._recover_deadline = time.monotonic() + 60
+            assert r2._recover_tick() == 1
+            assert r2.poll(qid)["state"] == "RUNNING"
+            # window closed: re-place on the survivor
+            r2._recover_deadline = time.monotonic() - 1
+            assert wait_for(
+                lambda: r2._recover_tick() == 0, timeout=10
+            )
+            assert _recovered("replaced") == 1
+            assert rq.external_id not in r2._recover_pending
+            rq2 = r2.get(qid)
+            assert rq2.replica_id == survivor
+            p = wait_done(r2, qid)
+            assert p["state"] == "DONE"
+            assert list(r2.stream_parts(qid))
+        finally:
+            r2.close()
+
+
+def test_restart_requeues_never_placed_entry(dataset, journal_path):
+    """A crash between admission and placement leaves an S record
+    with no P: recovery re-enters placement from the journaled
+    bytes."""
+    blob = dataset()
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-unplaced-x", "key-x", {"use_cache": True},
+                        blob, False, None)
+        j.sync()
+    with Fleet() as fl:
+        r2 = _restart(fl.specs, journal_path)
+        try:
+            assert r2._recover_pending == ["rq-unplaced-x"]
+            r2._recover_deadline = time.monotonic() + 10
+            assert wait_for(
+                lambda: r2._recover_tick() == 0, timeout=10
+            )
+            assert _recovered("requeued") == 1
+            p = wait_done(r2, "rq-unplaced-x")
+            assert p["state"] == "DONE"
+            assert list(r2.stream_parts("rq-unplaced-x"))
+        finally:
+            r2.close()
+
+
+def test_lost_handle_on_alive_replica_replaces_without_exclusion(
+    dataset, journal_path
+):
+    """Review regression: router AND replica both restarted (host
+    power-cycle). The replica re-JOINs alive but empty - the
+    reconcile POLL finds the journaled internal_id unknown. The
+    re-placement must NOT exclude the (alive, routable) replica, or a
+    single-replica fleet would strand a perfectly recoverable query
+    as REJECTED_OVERLOADED instead of re-running it."""
+    blob = dataset()
+    with Fleet() as fl:
+        only = fl.specs[0]  # a single-replica fleet
+        with RouterJournal(journal_path) as j:
+            j.record_submit("rq-lost-handle", "key-lh",
+                            {"use_cache": True}, blob, False, None)
+            j.record_place("rq-lost-handle", only,
+                           "q-from-previous-life", None, 1)
+            j.sync()
+        r2 = _restart([only], journal_path)
+        try:
+            r2._recover_deadline = time.monotonic() + 10
+            assert wait_for(
+                lambda: r2._recover_tick() == 0, timeout=10
+            )
+            assert _recovered("replaced") == 1
+            assert _recovered("stranded") == 0
+            rq = r2.get("rq-lost-handle")
+            assert rq.replica_id == only  # re-ran on the survivor
+            p = wait_done(r2, "rq-lost-handle")
+            assert p["state"] == "DONE"
+            assert list(r2.stream_parts("rq-lost-handle"))
+        finally:
+            r2.close()
+
+
+def test_restart_strands_cleanly_without_fleet(journal_path):
+    """No replica ever re-JOINs: past the window the recovered
+    handle finalizes classified (REJECTED_OVERLOADED - capacity may
+    come back) instead of hanging clients forever."""
+    with RouterJournal(journal_path) as j:
+        j.record_submit("rq-lost", "key-l", {}, b"bytes", False,
+                        None)
+        j.record_place("rq-lost", "127.0.0.1:1", "q-dead", None, 1)
+        j.sync()
+    r2 = Router([], start=False, journal_path=journal_path)
+    try:
+        r2._recover_deadline = time.monotonic() - 1
+        assert r2._recover_tick() == 0
+        assert _recovered("stranded") == 1
+        p = r2.poll("rq-lost")
+        assert p["state"] == "REJECTED_OVERLOADED"
+    finally:
+        r2.close()
+
+
+def test_inband_submit_error_truncates_journal_entry(
+    dataset, journal_path
+):
+    """Review regression: a submit the replica rejects in-band (no
+    downstream query_id - here an undecodable manifest) must F-mark
+    its journaled S record. Without the truncation marker the dead
+    entry stays live forever and the next restart resurrects the
+    known-bad plan as a phantom never-placed query."""
+    blob = dataset()
+    with Fleet(router_kw={"journal_path": journal_path}) as fl:
+        resp = fl.router.submit({"use_cache": True}, blob,
+                                manifest_bytes=b"NOT-JSON{")
+        assert "query_id" not in resp and "error" in resp
+    entries, torn = RouterJournal.replay_file(journal_path)
+    assert torn is None
+    assert entries == {}
+
+
+def test_restart_counter_fast_forwards_past_recovered_ids(
+    journal_path,
+):
+    """Review regression: a restarted router commonly reuses its pid
+    (container pid 1, pid recycling), and a reset _rqid_counter would
+    mint a fresh rq-{n}-{pid} that collides with a recovered handle -
+    _register would silently overwrite it and the re-attaching client
+    would poll the wrong query. Journal restore fast-forwards the
+    counter past every recovered id."""
+    from blaze_tpu.router import proxy as proxy_mod
+
+    pid = f"{os.getpid():x}"
+    recovered_id = f"rq-41000-{pid}"
+    with RouterJournal(journal_path) as j:
+        j.record_submit(recovered_id, "key-ff", {}, b"x", False,
+                        None)
+        j.record_place(recovered_id, "127.0.0.1:1", "q-z", None, 1)
+        j.sync()
+    r2 = Router([], start=False, journal_path=journal_path)
+    try:
+        assert recovered_id in r2._queries
+        fresh = proxy_mod.RoutedQuery("k", b"y", False, None, {})
+        assert int(fresh.external_id.split("-")[1]) > 41000
+        assert fresh.external_id != recovered_id
+    finally:
+        r2.close()
+
+
+def test_reconcile_poll_drop_retries_next_tick(
+    dataset, journal_path
+):
+    """A chaos-DROPped reconcile POLL (op=reconcile_poll) leaves the
+    handle pending; the next tick re-polls and adopts."""
+    blob = dataset()
+    with Fleet(router_kw={"journal_path": journal_path}) as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        qid = st["query_id"]
+        wait_done(fl.router, qid)
+        # wait_done finalized the query through the OLD router, which
+        # journaled its F record - craft the restart from a journal
+        # state where the query is still live: rewrite S+P only
+        with RouterJournal(str(journal_path) + ".live") as j:
+            rq = fl.router.get(qid)
+            j.record_submit(qid, rq.key, rq.meta, rq.task_bytes,
+                            rq.is_ref, rq.manifest_bytes)
+            j.record_place(qid, rq.replica_id, rq.internal_id,
+                           rq.fingerprint, rq.generation)
+            j.sync()
+        with chaos.active(
+            [Fault("router.journal", klass="DROP",
+                   match="reconcile_poll", times=1)],
+            seed=5,
+        ) as plan:
+            r2 = _restart(fl.specs, str(journal_path) + ".live")
+            try:
+                r2._recover_deadline = time.monotonic() + 10
+                assert r2._recover_tick() == 1  # POLL dropped
+                assert plan.fired("router.journal") == 1
+                assert wait_for(
+                    lambda: r2._recover_tick() == 0, timeout=10
+                )
+                assert _recovered("adopted_done") == 1
+                assert list(r2.stream_parts(qid))
+            finally:
+                r2.close()
+
+
+def test_journal_metrics_exposed(dataset, journal_path):
+    blob = dataset()
+    with Fleet(router_kw={"journal_path": journal_path}) as fl:
+        st = fl.router.submit({"use_cache": True}, blob)
+        wait_done(fl.router, st["query_id"])
+        assert REGISTRY.get("blaze_router_journal_records_total",
+                            kind="S") >= 1
+        assert REGISTRY.get("blaze_router_journal_records_total",
+                            kind="P") >= 1
+        assert REGISTRY.get("blaze_router_journal_records_total",
+                            kind="F") >= 1
+        text = REGISTRY.render_prometheus()
+        assert "blaze_router_journal_live_entries" in text
+        assert "blaze_router_journal_bytes" in text
+        # the routing-tier stats surface carries the journal state
+        s = fl.router.stats()["router"]
+        assert s["journal"] is True
+        assert s["recover_pending"] == 0

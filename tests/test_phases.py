@@ -18,6 +18,7 @@ from blaze_tpu.exprs import AggExpr, AggFn, Col
 from blaze_tpu.obs import phases
 from blaze_tpu.obs.phases import (
     ALL_CLASS,
+    SPAN_PHASE,
     PhaseRollup,
     class_key,
     compare,
@@ -368,3 +369,56 @@ def test_stream_phase_folds_on_wire_fetch(agg_blob):
                     break
                 time.sleep(0.01)
     assert "stream" in phases.ROLLUP.snapshot()[ALL_CLASS]
+
+
+def test_compare_router_stream_phases_get_widened_default_bands():
+    """ISSUE 11 satellite: the hop phases (router/stream) measure
+    millisecond p50s that wobble by integer factors under CI load -
+    compare() widens their bands by default (max of the caller band
+    and the built-in widener), so a 3ms->8ms jitter passes while a
+    real execute regression of the same ratio still fails."""
+    base = {"_all": {"router": _cell(0.003), "stream": _cell(0.004),
+                     "execute": _cell(1.0)}}
+    live = {"_all": {"router": _cell(0.008), "stream": _cell(0.010),
+                     "execute": _cell(2.7)}}
+    regs = compare(live, base, rel_band=0.5, abs_floor_s=0.01)
+    # execute (2.7x) regresses; router/stream ride the widened band
+    assert [r["phase"] for r in regs] == ["execute"]
+    # a genuine hop blowup still fails: beyond 3x + the 50ms floor
+    live2 = {"_all": {"router": _cell(0.25)}}
+    regs2 = compare(live2, {"_all": {"router": _cell(0.003)}},
+                    rel_band=0.5, abs_floor_s=0.01)
+    assert [r["phase"] for r in regs2] == ["router"]
+    # an EXPLICIT per-phase band wins outright over the widener
+    regs3 = compare(
+        {"_all": {"router": _cell(0.008)}},
+        {"_all": {"router": _cell(0.003)}},
+        rel_band=0.5, abs_floor_s=0.01,
+        bands={"router": (0.1, 0.001)},
+    )
+    assert [r["phase"] for r in regs3] == ["router"]
+
+
+def test_phase_totals_matches_fold_span_dicts():
+    """The allocation-free terminal-hook fold
+    (TraceRecorder.phase_totals) must agree exactly with the
+    dict-materializing fold it replaced - same span-name map, same
+    totals - or the rollup baselines would shift under a pure
+    optimization."""
+    from blaze_tpu.obs import trace
+
+    rec = trace.TraceRecorder("fold-parity")
+    t0 = time.monotonic()
+    rec.record_span("queue_wait", t0, t0 + 0.010)
+    rec.record_span("parquet_decode", t0, t0 + 0.020)
+    rec.record_span("parquet_decode", t0 + 0.020, t0 + 0.050)
+    rec.record_span("kernel_dispatch", t0, t0 + 0.001)
+    rec.record_span("attempt", t0, t0 + 0.5)  # structural: unmapped
+    unfinished = rec.begin("h2d")  # open span: excluded by both
+    assert unfinished is not None
+    rec.finish(state="DONE")
+    fast = rec.phase_totals(SPAN_PHASE)
+    slow = fold_span_dicts(rec.to_dicts())
+    assert fast == slow
+    assert fast["decode"] == pytest.approx(0.050, abs=1e-6)
+    assert "h2d" not in fast and "attempt" not in fast

@@ -13,6 +13,7 @@ shedding of unmeetable deadlines at admission, and the
 _sweep_deadlines fix (cancel-event propagation to running work).
 """
 
+import sys
 import threading
 import time
 
@@ -451,3 +452,122 @@ def test_degraded_query_releases_bytes_unblocks_waiter():
     finally:
         g0[1].set()
         g2[1].set()
+
+
+# ---------------------------------------------------------------------------
+# orphan reaping (ISSUE 11 satellite): detached queries a dead router
+# abandoned must not pin replica retention forever
+# ---------------------------------------------------------------------------
+
+
+def test_orphan_sweep_reaps_terminal_never_fetched_queries():
+    """A terminal query nobody ever fetched or polled past the
+    orphan TTL - the replica-side footprint of a router that died and
+    never came back - is reaped: removed from retention, counted on
+    `orphans_reaped`."""
+    with QueryService(max_concurrency=1, orphan_ttl_s=0.3) as svc:
+        q = svc.submit_plan(small_plan())
+        assert q.wait(30) and q.state is QueryState.DONE
+        qid = q.query_id
+        # nobody polls, nobody fetches: the dead-router scenario
+
+        def reaped():
+            try:
+                svc.get(qid)
+                return False
+            except KeyError:
+                return True
+
+        assert wait_for(reaped, timeout=10)
+        assert svc.stats()["queries"]["orphans_reaped"] == 1
+        assert svc.stats()["service"]["orphan_ttl_s"] == 0.3
+
+
+def test_poll_activity_defers_orphan_sweep():
+    """An attentive owner (a live router POLLs on the client's
+    behalf) keeps the query out of the sweep indefinitely; reaping
+    begins only once the polls stop."""
+    with QueryService(max_concurrency=1, orphan_ttl_s=0.4) as svc:
+        q = svc.submit_plan(small_plan())
+        assert q.wait(30) and q.state is QueryState.DONE
+        qid = q.query_id
+        deadline = time.monotonic() + 1.2
+        while time.monotonic() < deadline:
+            assert svc.poll(qid)["state"] == "DONE"  # still owned
+            time.sleep(0.05)
+
+        def reaped():
+            try:
+                svc.get(qid)
+                return False
+            except KeyError:
+                return True
+
+        assert wait_for(reaped, timeout=10)  # polls stopped -> reaped
+        assert svc.stats()["queries"]["orphans_reaped"] == 1
+
+
+def test_fetch_of_reaped_query_is_classified_not_found(parquet_blob):
+    """Regression (ISSUE 11 satellite): a FETCH of a reaped query
+    answers the classified UNKNOWN not-found error frame, never a
+    hang - the late-returning router (or a confused client) gets a
+    clean terminal answer."""
+    svc = QueryService(max_concurrency=1, orphan_ttl_s=0.3)
+    srv = TaskGatewayServer(service=svc).start()
+    try:
+        with ServiceClient(*srv.address) as c:
+            st = c.submit(parquet_blob, detach=True)
+            qid = st["query_id"]
+            assert wait_for(
+                lambda: c.poll(qid)["state"] == "DONE", timeout=30
+            )
+
+            def reaped():
+                try:
+                    svc.get(qid)
+                    return False
+                except KeyError:
+                    return True
+
+            assert wait_for(reaped, timeout=10)
+            t0 = time.monotonic()
+            with pytest.raises(Exception) as ei:
+                c.fetch(qid)
+            assert time.monotonic() - t0 < 5.0  # answered, not hung
+            assert "UNKNOWN" in str(ei.value)
+            # a fetched-before-TTL sibling is NOT reaped: collection
+            # is what the sweep exists to preserve
+            st2 = c.submit(parquet_blob, detach=True)
+            assert c.fetch(st2["query_id"])
+            time.sleep(0.8)
+            assert svc.poll(st2["query_id"])["state"] == "DONE"
+    finally:
+        srv.stop()
+        svc.close()
+
+
+def test_fetch_guard_counter_survives_concurrent_fetches():
+    """Review regression: `fetchers` is the in-progress-fetch guard
+    the orphan sweep consults before reaping; its updates are
+    read-modify-writes and MUST be locked - two concurrent FETCHes
+    interleaving an unlocked `+= 1` can lose an increment, letting
+    the sweep reap a query mid-collection."""
+    from blaze_tpu.service.query import Query
+
+    q = Query(task_bytes=b"x")
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)  # make lost updates likely if racy
+    try:
+        def hammer():
+            for _ in range(20_000):
+                q.begin_fetch()
+                q.end_fetch()
+
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert q.fetchers == 0
