@@ -1036,6 +1036,17 @@ def child(n_rows):
             "spread": round(max(eng_spread, cpu_spread), 3),
             "k": k,
         }
+        # per-shape dispatch counts (ISSUE 13 satellite): the warm
+        # dispatch/H2D/fetch profile recorded next to the timing, so a
+        # fusion regression is a visible count diff between rounds,
+        # not timing archaeology (counts are exact on a warmed query;
+        # tests/test_dispatch_budget.py pins the same numbers)
+        try:
+            with dispatch.counting() as c:
+                q["engine"]()
+            detail[name]["dispatch_counts"] = dict(c.counts)
+        except Exception:  # noqa: BLE001 - counts are advisory here
+            pass
         # a shape whose run-to-run noise exceeds its margin over 1x
         # cannot support a "beats/loses to CPU" claim - flag it in the
         # artifact instead of leaving the discrepancy to archaeology
@@ -1559,6 +1570,23 @@ def smoke():
             problems.append(
                 f"e2e dispatch budget blown: {counts} (want <= 8)"
             )
+        # per-shape counts (ISSUE 13): every battery shape records its
+        # warm dispatch profile; the relational-core shapes must hold
+        # the fused 1-dispatch budget the tests pin
+        for name in ("e2e_scan_agg", "join_agg", "grouped_agg",
+                     "window", "expr_chain"):
+            d = (result.get("queries") or {}).get(name) or {}
+            if "error" in d:
+                continue
+            dc = d.get("dispatch_counts")
+            if not dc:
+                problems.append(f"{name}: missing dispatch_counts")
+            elif name in ("join_agg", "grouped_agg") \
+                    and dc.get("dispatches", 99) > 1:
+                problems.append(
+                    f"{name}: fused dispatch budget blown: {dc} "
+                    "(want 1 warm dispatch)"
+                )
         obs = (result.get("queries") or {}).get("obs_overhead") or {}
         if obs and "error" not in obs:
             # obs-overhead pin (ISSUE 11 satellite, re-pinned from

@@ -49,6 +49,8 @@ PHASES = (
     "decode",       # parquet file-range decode (prefetch threads)
     "h2d",          # packed host->device staging
     "dispatch",     # compiled-kernel launches
+    "join",         # fused join-probe kernel launches
+    "group",        # fused grouped-aggregate kernel launches
     "execute",      # RUNNING -> terminal (the whole execution)
     "stream",       # FETCH result streaming
     "router",       # router overhead (placement + submit hops)
@@ -64,6 +66,8 @@ SPAN_PHASE = {
     "parquet_decode": "decode",
     "h2d": "h2d",
     "kernel_dispatch": "dispatch",
+    "join_dispatch": "join",
+    "group_dispatch": "group",
     "execute_partition": "execute",
     "result_stream": "stream",
     "router_place": "router",
@@ -267,6 +271,11 @@ DEFAULT_MIN_SAMPLES = 3
 PHASE_BANDS: Dict[str, tuple] = {
     "router": (2.0, 0.05),
     "stream": (2.0, 0.05),
+    # fused join-probe / grouped-carry dispatch phases: one kernel
+    # launch per batch, so small-row probes measure low-millisecond
+    # p50s with the same scheduler-load wobble as the hop phases
+    "join": (2.0, 0.05),
+    "group": (2.0, 0.05),
 }
 
 

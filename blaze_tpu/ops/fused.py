@@ -26,6 +26,7 @@ per-op path handles their per-batch host lowering).
 
 from __future__ import annotations
 
+import itertools
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -237,35 +238,45 @@ class FusedAggregateExec(PhysicalOp):
                     leaf, partition, ctx, plan
                 )
                 return
+        plan = self._grouped_carry_plan()
+        if plan is not None:
+            yield from self._execute_grouped_carry(
+                (self._batch_spec(cb)
+                 for cb in leaf.execute(partition, ctx)),
+                plan,
+            )
+            return
         first = True
         for cb in leaf.execute(partition, ctx):
-            pv = packed_view(cb)
-            if pv is not None:
-                key_suffix = ("fusedagg_packed", pv.key)
-                build = (
-                    lambda fl, gc, pv=pv: self._build_kernel_packed(
-                        pv, force_lexsort=fl, group_cap=gc
-                    )
-                )
-                args = (pv.buf, cb.selection,
-                        None if cb.num_rows == cb.capacity
-                        else cb.num_rows)
-            else:
-                layout = cb.layout()
-                key_suffix = ("fusedagg", layout)
-                build = (
-                    lambda fl, gc, layout=layout: self._build_kernel(
-                        layout, force_lexsort=fl, group_cap=gc
-                    )
-                )
-                args = (cb.device_buffers(), cb.selection,
-                        None if cb.num_rows == cb.capacity
-                        else cb.num_rows)
-            out, first = self._run_agg(
-                key_suffix, build, args, cb.capacity, first,
-            )
+            out, first = self._run_agg(*self._batch_spec(cb), first)
             if out is not None:
                 yield out
+
+    def _batch_spec(self, cb: ColumnBatch):
+        """(key_suffix, build_fn, args, capacity) for one input batch:
+        the packed wire-buffer kernel variant when the batch still
+        carries its H2D buffer, else the plain-layout variant."""
+        pv = packed_view(cb)
+        if pv is not None:
+            return (
+                ("fusedagg_packed", pv.key),
+                lambda fl, gc, pv=pv: self._build_kernel_packed(
+                    pv, force_lexsort=fl, group_cap=gc
+                ),
+                (pv.buf, cb.selection,
+                 None if cb.num_rows == cb.capacity else cb.num_rows),
+                cb.capacity,
+            )
+        layout = cb.layout()
+        return (
+            ("fusedagg", layout),
+            lambda fl, gc, layout=layout: self._build_kernel(
+                layout, force_lexsort=fl, group_cap=gc
+            ),
+            (cb.device_buffers(), cb.selection,
+             None if cb.num_rows == cb.capacity else cb.num_rows),
+            cb.capacity,
+        )
 
     def _execute_keyless_carry(self, leaf, partition: int,
                                ctx: ExecContext, plan):
@@ -323,9 +334,293 @@ class FusedAggregateExec(PhysicalOp):
             return  # empty stream: HostFinalAggExec emits the global row
         yield _fetch_packed_states(carry, packed, self._schema)
 
+    # ------------------------------------------------------------------
+    # keyed streaming device carry (the grouped twin of the keyless form)
+    def _grouped_carry_plan(self):
+        """Merge plan for the KEYED streaming device carry, or None when
+        the shape must keep the batch-at-a-time path.
+
+        Eligible: host-finalized (COMPLETE rewrite) keyed aggregates
+        whose partial states merge by pure add/min/max (FIRST/LAST are
+        order-sensitive) running on the SCATTER grouping core - the
+        scatter core's exact-equality probing has no hash-collision
+        sentinel, so the only per-batch retry condition left is group
+        overflow, which the carry driver demotes on instead of
+        re-laddering inside the composed kernel."""
+        if not (self.fetch_host and self.agg.keys):
+            return None
+        if not self.agg._scatter_core_hint(
+            self.agg.children[0].schema,
+            [e for e, _ in self.agg.keys],
+        ):
+            return None
+        n_keys = len(self.agg.keys)
+        plan = _keyless_merge_plan(
+            self.agg.aggs, self._schema.fields[n_keys:]
+        )
+        if plan is None:
+            return None
+        # the merge kernel's MIN/MAX lanes have no bool encoding (the
+        # batch kernel widens bool to int8, which would break the
+        # carry's dtype fixed point)
+        for op, f in zip(plan, self._schema.fields[n_keys:]):
+            if op in ("min", "max") and f.dtype.id is TypeId.BOOL:
+                return None
+        return tuple(plan)
+
+    def _execute_grouped_carry(self, specs, plan,
+                               span: str = "group_dispatch"):
+        """Stream a KEYED aggregate through a persistent device carry:
+        ONE dispatch per input batch, the grouped state re-merged
+        in-kernel into a fixed set of carry slots instead of being
+        re-fetched (or re-merged by a separate device FINAL pass) per
+        batch, and ONE plain end-of-stream fetch of the in-kernel-packed
+        (count, states) buffer.
+
+        Single-batch partitions (the hot path) skip even the scalar
+        sync: the group count rides inside the packed buffer. Multi-
+        batch streams pay one scalar sync per batch - the group-overflow
+        guard: when the merged group count outgrows the carry slots the
+        driver DEMOTES, yielding the accumulated carry as one device-
+        resident partial batch and running the rest of the stream
+        through the standard per-batch ladder (HostFinalAggExec's device
+        FINAL merges, external/grace behavior unchanged)."""
+        from blaze_tpu.config import get_config
+        from blaze_tpu.runtime.dispatch import host_int
+
+        agg_cap = get_config().agg_group_capacity
+        base = (
+            "fusedagg_gcarry", self.pipeline.structure_key(),
+            tuple((e, n) for e, n in self.agg.keys),
+            tuple((a.fn, a.child) for a, _ in self.agg.aggs),
+            plan,
+        )
+        it = iter(specs)
+        spec = next(it, None)
+        carry = None        # (n_groups device scalar, [(v, m)...])
+        carry_n = 0         # host copy of the carry's group count
+        slots = None        # carry slot capacity (first batch's out_cap)
+        packed = None
+        demote = None
+        while spec is not None:
+            key_suffix, build_fn, args, cap = spec
+            s_b = min(cap, agg_cap)
+            nxt = next(it, None)
+            if carry is None:
+                slots = s_b
+                fn = cached_kernel(
+                    base + (key_suffix, s_b, False),
+                    lambda b=build_fn, s=s_b, c=cap:
+                        self._build_grouped_carry_kernel(
+                            b, plan, s, c, None, None
+                        ),
+                    scatter_class=True, span=span,
+                )
+                (n_dev, outs), packed = fn(args)
+                if nxt is None:
+                    # single-batch hot path: one dispatch + one fetch,
+                    # group count inside the packed buffer (no sync)
+                    n, out = self._fetch_carry(outs, packed, n_dev)
+                    if n > s_b:
+                        # overflow: rare re-dispatch under the ladder
+                        out, _ = self._run_agg(
+                            key_suffix, build_fn, args, cap, True,
+                            span=span,
+                        )
+                    if out is not None:
+                        yield out
+                    return
+            else:
+                struct = tuple(
+                    (str(np.dtype(v.dtype)), m is not None)
+                    for v, m in carry[1]
+                )
+                fn = cached_kernel(
+                    base + (key_suffix, slots, s_b, struct, True),
+                    lambda b=build_fn, s=s_b, c=cap, st=struct:
+                        self._build_grouped_carry_kernel(
+                            b, plan, s, c, slots, st
+                        ),
+                    scatter_class=True, span=span,
+                )
+                (n_dev, outs), packed = fn(args, carry)
+            # batch-level overflow already rides in n (the kernel
+            # substitutes slots+1), so one slot check covers both
+            n = host_int(n_dev)
+            if n < 0 or n > slots:
+                demote = spec
+                if nxt is not None:
+                    # the lookahead batch is already off the iterator -
+                    # put it back for the demotion loop
+                    it = itertools.chain([nxt], it)
+                break
+            carry = (n_dev, outs)
+            carry_n = n
+            spec = nxt
+        if demote is None:
+            if carry is not None and carry_n > 0:
+                _n, out = self._fetch_carry(carry[1], packed, carry[0])
+                if out is not None:
+                    yield out
+            return
+        # ---- demotion: carry -> one device partial batch; the
+        # offending batch and the rest of the stream take the standard
+        # per-batch ladder (device FINAL merges downstream) ----
+        first = True
+        if carry is not None and carry_n > 0:
+            cols = [
+                Column(f.dtype, v, m, None)
+                for f, (v, m) in zip(self._schema.fields, carry[1])
+            ]
+            yield ColumnBatch(self._schema, cols, carry_n)
+            first = False
+        out, first = self._run_agg(*demote, first, span=span)
+        if out is not None:
+            yield out
+        for spec in it:
+            out, first = self._run_agg(*spec, first, span=span)
+            if out is not None:
+                yield out
+
+    def _fetch_carry(self, outs, packed, n_dev):
+        """ONE plain fetch of an in-kernel-packed (group count, states)
+        buffer -> (n, ColumnBatch | None). No pack dispatch, no scalar
+        sync: the count travels inside the buffer. Returns (n, None)
+        for an empty result or a count that overflowed the state slots
+        (the caller re-runs the ladder)."""
+        from blaze_tpu.runtime.dispatch import record
+        from blaze_tpu.runtime.pack import unpack_host
+
+        specs = [(str(np.dtype(n_dev.dtype)), tuple(n_dev.shape))]
+        for v, m in outs:
+            specs.append((str(np.dtype(v.dtype)), tuple(v.shape)))
+            if m is not None:
+                specs.append((str(np.dtype(m.dtype)), tuple(m.shape)))
+        record("d2h_fetches")
+        host = iter(unpack_host(np.asarray(packed), specs))
+        n = int(next(host))
+        if n <= 0 or n > len(outs[0][0]):
+            return n, None
+        cols = []
+        for (v, m), f in zip(outs, self._schema.fields):
+            hv = next(host)
+            hm = next(host) if m is not None else None
+            cols.append(Column(f.dtype, hv, hm, None))
+        return n, ColumnBatch(self._schema, cols, n)
+
+    def _build_grouped_carry_kernel(self, build_inner, plan, s_b, cap_b,
+                                    s_carry, carry_struct):
+        """Compose one fused-aggregate batch kernel with the keyed
+        device carry: batch partial -> (with a carry) concatenate the
+        carry rows with the batch's grouped state and regroup them back
+        into the carry slots via a state-preserving PARTIAL merge
+        aggregate -> pack (count, states) in-kernel. Returns
+        ((n, states), packed_u8); n carries the overflow sentinel
+        (slots + 1) when either the batch or the merged result outgrew
+        its static slot count."""
+        from blaze_tpu.runtime.pack import pack_in_kernel
+
+        inner = build_inner(False, s_b if s_b < cap_b else None)
+        merge_inner = None
+        if s_carry is not None:
+            merge_inner = self._build_carry_merge_kernel(
+                plan, s_carry, s_b, carry_struct
+            )
+
+        def kernel(args, carry=None):
+            outs, n_b = inner(*args)
+            over_b = n_b > jnp.int32(s_b)
+            if carry is None:
+                m_outs = outs
+                n_out = jnp.where(
+                    over_b, jnp.int32(s_b + 1), n_b
+                ).astype(jnp.int32)
+            else:
+                n_c, c_cols = carry
+                live = jnp.concatenate([
+                    jnp.arange(s_carry, dtype=jnp.int32) < n_c,
+                    jnp.arange(s_b, dtype=jnp.int32)
+                    < jnp.minimum(n_b, jnp.int32(s_b)),
+                ])
+                merged = []
+                for (cv, cm), (bv, bm) in zip(c_cols, outs):
+                    merged.append(jnp.concatenate([cv, bv]))
+                    if cm is not None:
+                        merged.append(jnp.concatenate([cm, bm]))
+                mo, n_m = merge_inner(tuple(merged), live, None)
+                # restore the canonical state-mask structure: the merge
+                # lanes always emit a validity, the inner states may not
+                m_outs = [
+                    (v, m if om is not None else None)
+                    for (v, m), (_ov, om) in zip(mo, outs)
+                ]
+                n_out = jnp.where(
+                    over_b, jnp.int32(s_carry + 1), n_m
+                ).astype(jnp.int32)
+            flat = [n_out.reshape(())]
+            for v, m in m_outs:
+                flat.append(v)
+                if m is not None:
+                    flat.append(m)
+            return (n_out, m_outs), pack_in_kernel(flat)
+
+        return kernel
+
+    def _build_carry_merge_kernel(self, plan, s_carry, s_b, struct):
+        """State-preserving grouped merge: a PARTIAL aggregate over the
+        (carry + batch) state rows whose lanes are SUM for additive
+        state columns and MIN/MAX for extrema - unlike a FINAL kernel it
+        emits mergeable partial state again, keeping the carry a fixed
+        point. Groups resolve through the same scatter core as the
+        batch kernel; output capacity is the carry slot count."""
+        from blaze_tpu.ops.hash_aggregate import (
+            AggMode,
+            HashAggregateExec,
+            _SchemaStub,
+        )
+
+        pschema = self._schema
+        n_keys = len(self.agg.keys)
+        fn_map = {
+            "add": AggFn.SUM, "min": AggFn.MIN, "max": AggFn.MAX
+        }
+        merge_agg = HashAggregateExec(
+            _SchemaStub(pschema),
+            keys=[
+                (ir.BoundCol(i, pschema.fields[i].dtype),
+                 pschema.fields[i].name)
+                for i in range(n_keys)
+            ],
+            aggs=[
+                (AggExpr(
+                    fn_map[op],
+                    ir.BoundCol(
+                        n_keys + j, pschema.fields[n_keys + j].dtype
+                    ),
+                ), f"m{j}")
+                for j, op in enumerate(plan)
+            ],
+            mode=AggMode.PARTIAL,
+        )
+        cap = s_carry + s_b
+        layout = (cap, tuple(
+            (f.dtype.id.value, f.dtype.precision, f.dtype.scale, has_m)
+            for f, (_dt, has_m) in zip(pschema.fields, struct)
+        ))
+        return merge_agg._build_kernel(
+            pschema, cap,
+            [e for e, _ in merge_agg.keys],
+            {j: a.child for j, (a, _) in enumerate(merge_agg.aggs)},
+            False, layout, group_cap=s_carry,
+        )
+
     def _execute_join_fused(self, join, partition: int,
                             ctx: ExecContext):
-        from blaze_tpu.ops.joins import _JoinCore, _flatten_cols
+        from blaze_tpu.ops.joins import (
+            _JoinCore,
+            _eq_layout,
+            _flatten_cols,
+        )
 
         build = join._collect_build(ctx)
         # the build INDEX is as probe-invariant as the build relation
@@ -338,59 +633,147 @@ class FusedAggregateExec(PhysicalOp):
                 core = _JoinCore(build, join.left_keys)
                 join._fused_core = core
         first = True
-        for pb in join.children[1].execute(partition, ctx):
-            tstate, pb = core.table_state(pb, join.right_keys)
-            if tstate is None:
-                # duplicate build keys / sort core: fall back to the
-                # materialized pair emission + the standard fused kernel
-                state = core.probe(pb, join.right_keys)
-                pb = state[1]
-                out_cols, valid, pair_cap, _mp = core.emit_pairs(
-                    state, list(build.columns), list(pb.columns),
-                    build_first=True,
-                )
-                cb = ColumnBatch(join.schema, out_cols, pair_cap, valid)
-                out, first = self._run_agg(
-                    ("fusedagg", cb.layout()),
-                    lambda fl, gc, layout=cb.layout():
-                        self._build_kernel(
-                            layout, force_lexsort=fl, group_cap=gc
-                        ),
-                    (cb.device_buffers(), cb.selection,
-                     None if cb.num_rows == cb.capacity
-                     else cb.num_rows),
-                    cb.layout()[0],
-                    first,
-                )
-            else:
-                _pb, unified_b, unified_p, tab, mode = tstate
-                p_layout = pb.layout()
-                b_layout = build.layout()
-                from blaze_tpu.ops.joins import _eq_layout
+        fused_probe = getattr(join, "_fused_probe", None)
+        folded = None
+        if fused_probe is not None:
+            # planner-recorded probe chain (_fuse_join_under_agg): try
+            # the fully folded form - raw probe leaf batch -> stages ->
+            # key extraction -> table walk -> build gather -> aggregate
+            # as ONE kernel. Ineligible shapes (dictionary keys, the
+            # sorted core) fall through to the materialized loop below,
+            # where children[1] - the same pipeline object - still runs
+            # the whole probe chain as one dispatch per batch.
+            folded = core.table_state_static(
+                join.right_keys, fused_probe[1].schema
+            )
+        if folded is not None:
+            mode, tab = folded
+            pleaf, ppipe = fused_probe
+            b_layout = build.layout()
+            build_key_cols = [build.columns[i] for i in join.left_keys]
+            b_eq_layout = _eq_layout(build_key_cols)
+            b_eq_bufs = _flatten_cols(build_key_cols)
+            pkey_idx = tuple(join.right_keys)
 
-                b_eq_layout = _eq_layout(unified_b)
-                p_eq_layout = _eq_layout(unified_p)
-                out, first = self._run_agg(
-                    ("fusedagg_join", mode, p_layout, b_layout,
-                     b_eq_layout, p_eq_layout),
-                    lambda fl, gc: self._build_join_kernel(
-                        mode, p_layout, b_layout, b_eq_layout,
-                        p_eq_layout, force_lexsort=fl, group_cap=gc,
-                    ),
-                    (build.device_buffers(), pb.device_buffers(),
-                     _flatten_cols(unified_b),
-                     _flatten_cols(unified_p),
-                     tab,
-                     None if pb.num_rows == p_layout[0]
-                     else pb.num_rows),
-                    p_layout[0],
-                    first,
+            def probe_spec(raw):
+                pv = packed_view(raw)
+                if pv is not None:
+                    # still-packed wire batch: the H2D buffer split
+                    # traces into the folded kernel too (scan unpack ->
+                    # stages -> probe -> aggregate, one program; packed
+                    # columns nothing references never materialize)
+                    key = ("fusedagg_join_probe_packed", mode, pv.key,
+                           ppipe.structure_key(), b_layout,
+                           b_eq_layout, pkey_idx)
+                    build_fn = (
+                        lambda fl, gc, pv=pv:
+                            self._build_join_probe_kernel_packed(
+                                pv, mode, b_layout, b_eq_layout,
+                                pkey_idx, ppipe, force_lexsort=fl,
+                                group_cap=gc,
+                            )
+                    )
+                    p_bufs = pv.buf
+                    pcap = pv.layout[0]
+                else:
+                    p_layout = raw.layout()
+                    key = ("fusedagg_join_probe", mode, p_layout,
+                           ppipe.structure_key(), b_layout,
+                           b_eq_layout, pkey_idx)
+                    build_fn = (
+                        lambda fl, gc, p_layout=p_layout:
+                            self._build_join_probe_kernel(
+                                mode, p_layout, b_layout, b_eq_layout,
+                                pkey_idx, ppipe, force_lexsort=fl,
+                                group_cap=gc,
+                            )
+                    )
+                    p_bufs = raw.device_buffers()
+                    pcap = p_layout[0]
+                return (
+                    key, build_fn,
+                    (build.device_buffers(), p_bufs, b_eq_bufs, tab,
+                     raw.selection,
+                     None if raw.num_rows == pcap else raw.num_rows),
+                    pcap,
                 )
+
+            specs = (
+                probe_spec(raw)
+                for raw in pleaf.execute(partition, ctx)
+            )
+            plan = self._grouped_carry_plan()
+            if plan is not None:
+                yield from self._execute_grouped_carry(
+                    specs, plan, span="join_dispatch"
+                )
+                return
+            for spec in specs:
+                out, first = self._run_agg(
+                    *spec, first, span="join_dispatch"
+                )
+                if out is not None:
+                    yield out
+            return
+        for pb in join.children[1].execute(partition, ctx):
+            out, first = self._join_batch(core, join, build, pb, first)
             if out is not None:
                 yield out
 
+    def _join_batch(self, core, join, build, pb, first):
+        """Fused-join step over one MATERIALIZED probe batch: table-core
+        state + the lookup-inclusive fused kernel, or the sorted-core
+        pair-emission fallback. Returns (ColumnBatch | None, first)."""
+        from blaze_tpu.ops.joins import _eq_layout, _flatten_cols
+
+        tstate, pb = core.table_state(pb, join.right_keys)
+        if tstate is None:
+            # duplicate build keys / sort core: fall back to the
+            # materialized pair emission + the standard fused kernel
+            state = core.probe(pb, join.right_keys)
+            pb = state[1]
+            out_cols, valid, pair_cap, _mp = core.emit_pairs(
+                state, list(build.columns), list(pb.columns),
+                build_first=True,
+            )
+            cb = ColumnBatch(join.schema, out_cols, pair_cap, valid)
+            return self._run_agg(
+                ("fusedagg", cb.layout()),
+                lambda fl, gc, layout=cb.layout():
+                    self._build_kernel(
+                        layout, force_lexsort=fl, group_cap=gc
+                    ),
+                (cb.device_buffers(), cb.selection,
+                 None if cb.num_rows == cb.capacity
+                 else cb.num_rows),
+                cb.layout()[0],
+                first,
+            )
+        _pb, unified_b, unified_p, tab, mode = tstate
+        p_layout = pb.layout()
+        b_layout = build.layout()
+        b_eq_layout = _eq_layout(unified_b)
+        p_eq_layout = _eq_layout(unified_p)
+        return self._run_agg(
+            ("fusedagg_join", mode, p_layout, b_layout,
+             b_eq_layout, p_eq_layout),
+            lambda fl, gc: self._build_join_kernel(
+                mode, p_layout, b_layout, b_eq_layout,
+                p_eq_layout, force_lexsort=fl, group_cap=gc,
+            ),
+            (build.device_buffers(), pb.device_buffers(),
+             _flatten_cols(unified_b),
+             _flatten_cols(unified_p),
+             tab,
+             None if pb.num_rows == p_layout[0]
+             else pb.num_rows),
+            p_layout[0],
+            first,
+            span="join_dispatch",
+        )
+
     def _run_agg(self, key_suffix, build_kernel, args, cap: int,
-                 first: bool):
+                 first: bool, span: str = "group_dispatch"):
         """Shared per-batch aggregate dispatch: run under the retry
         ladder, fetch per the host-finalize policy, wrap the output.
         Returns (ColumnBatch | None, first)."""
@@ -408,6 +791,14 @@ class FusedAggregateExec(PhysicalOp):
             tuple((e, n) for e, n in self.agg.keys),
             tuple((a.fn, a.child) for a, _ in self.agg.aggs),
             _group_core_choice(),
+        )
+        # the fused kernel's dominant cost is the grouping core's
+        # scatters (plus, on the join path, the in-kernel table gather)
+        # - route scatter-core variants to the scatter-friendly CPU
+        # runtime (runtime/dispatch.py)
+        scatter = self.agg._scatter_core_hint(
+            self.agg.children[0].schema,
+            [e for e, _ in self.agg.keys],
         )
 
         def fetch(outs, n_groups):
@@ -449,6 +840,7 @@ class FusedAggregateExec(PhysicalOp):
             gcap = None
         host_outs, n = run_grouped_kernel(
             base_key, build_kernel, args, fetch, gcap,
+            scatter_class=scatter, span=span,
         )
         if self.fetch_host and first and n > 0:
             first = False
@@ -506,6 +898,98 @@ class FusedAggregateExec(PhysicalOp):
                     joined.append(jnp.take(next(it), g, axis=0))
             joined.extend(p_bufs)
             return inner(tuple(joined), matched, num_rows)
+
+        return kernel
+
+    def _build_join_probe_kernel(self, mode, p_layout, b_layout,
+                                 b_eq_layout, probe_keys, probe_pipe,
+                                 force_lexsort: bool = False,
+                                 group_cap=None):
+        """Deepest fusion tier: the probe side's OWN stage chain folds
+        in ahead of the table walk, so scan -> filter -> project ->
+        probe -> build gather -> aggregate stages run as ONE program
+        over the RAW probe leaf batch - the probe relation never
+        materializes at all. Probe join keys come out of the in-kernel
+        stage evaluation; filtered-out rows drop via the stage
+        selection before the lookup, and NULL keys never match via the
+        evaluated masks."""
+        from blaze_tpu.ops.joins import _table_lookup, _unflatten_eq
+
+        pipe_kernel = probe_pipe._build_kernel(p_layout)
+        mid_schema = probe_pipe.schema
+        pcap = p_layout[0]
+        bcap = b_layout[0]
+        b_cols_desc = b_layout[1]
+        joined_layout = (
+            pcap,
+            tuple(b_cols_desc) + tuple(
+                (f.dtype.id.value, f.dtype.precision, f.dtype.scale,
+                 True)
+                for f in mid_schema
+            ),
+        )
+        inner = self._build_kernel(
+            joined_layout, force_lexsort=force_lexsort,
+            group_cap=group_cap,
+        )
+        expect = tuple(
+            np.dtype(mid_schema.fields[i].dtype.physical_dtype())
+            for i in probe_keys
+        )
+
+        def kernel(b_bufs, p_bufs, b_eq, tab, selection, num_rows):
+            mid_bufs, sel = pipe_kernel(p_bufs, selection)
+            live = (
+                jnp.ones(pcap, dtype=jnp.bool_) if num_rows is None
+                else jnp.arange(pcap, dtype=jnp.int32) < num_rows
+            )
+            if sel is not None:
+                live = live & sel
+            pkeys = [
+                (mid_bufs[2 * i], mid_bufs[2 * i + 1])
+                for i in probe_keys
+            ]
+            # table_state_static decided the mode from the fields'
+            # physical dtypes; hold the evaluator to that contract
+            assert tuple(k.dtype for k, _ in pkeys) == expect, (
+                [k.dtype for k, _ in pkeys], expect)
+            for _, m in pkeys:
+                live = live & m  # NULL join keys never match
+            match_idx, matched = _table_lookup(
+                mode, tab, pkeys, _unflatten_eq(b_eq_layout, b_eq),
+                live, bcap,
+            )
+            g = jnp.clip(match_idx, 0, bcap - 1)
+            joined = []
+            it = iter(b_bufs)
+            for _tid, _prec, _scale, has_mask in b_cols_desc:
+                joined.append(jnp.take(next(it), g, axis=0))
+                if has_mask:
+                    joined.append(jnp.take(next(it), g, axis=0))
+            joined.extend(mid_bufs)
+            return inner(tuple(joined), matched, num_rows)
+
+        return kernel
+
+    def _build_join_probe_kernel_packed(self, pv, mode, b_layout,
+                                        b_eq_layout, probe_keys,
+                                        probe_pipe,
+                                        force_lexsort: bool = False,
+                                        group_cap=None):
+        """Packed-probe-input variant of the folded join: H2D wire
+        buffer split + probe stages + table walk + build gather +
+        aggregate, ONE traced program."""
+        unflatten = pv.build_unflatten()
+        inner = self._build_join_probe_kernel(
+            mode, pv.layout, b_layout, b_eq_layout, probe_keys,
+            probe_pipe, force_lexsort=force_lexsort,
+            group_cap=group_cap,
+        )
+
+        def kernel(b_bufs, buf, b_eq, tab, selection, num_rows):
+            return inner(
+                b_bufs, unflatten(buf), b_eq, tab, selection, num_rows
+            )
 
         return kernel
 

@@ -105,7 +105,9 @@ def _decimal_chunks(cv):
     return [c0, c1, c2, c3]
 
 
-def run_grouped_kernel(base_key, build, args, fetch_n, gcap):
+def run_grouped_kernel(base_key, build, args, fetch_n, gcap,
+                       scatter_class: bool = False,
+                       span: str = "group_dispatch"):
     """Dispatch a grouped-aggregate kernel under the sentinel-retry
     ladder shared by HashAggregateExec and FusedAggregateExec:
 
@@ -119,7 +121,13 @@ def run_grouped_kernel(base_key, build, args, fetch_n, gcap):
       a retry.
 
     `build(force_lexsort, group_cap)` returns the python kernel to jit;
-    `fetch_n(outs, n_groups) -> (outs', n)` owns the host sync policy."""
+    `fetch_n(outs, n_groups) -> (outs', n)` owns the host sync policy.
+
+    `scatter_class` rides through to cached_kernel for the variants
+    that actually run the scatter core (the force_lexsort retry is
+    sort-dominated and always compiles under the default runtime);
+    `span` names the obs span so phases.py can band group/join
+    dispatches separately."""
     import os
 
     force_lex = False
@@ -141,6 +149,8 @@ def run_grouped_kernel(base_key, build, args, fetch_n, gcap):
         fn = cached_kernel(
             base_key + (force_lex, gc),
             lambda fl=force_lex, g=gc: build(fl, g),
+            scatter_class=scatter_class and not force_lex,
+            span=span,
         )
         outs, n_groups = fn(*args)
         host_outs, n = fetch_n(outs, n_groups)
@@ -698,6 +708,9 @@ class HashAggregateExec(PhysicalOp):
             (lambda o, ng: (o, 1)) if not self.keys
             else (lambda o, ng: (o, host_int(ng))),
             gcap,
+            scatter_class=self._scatter_core_hint(
+                aug.schema, key_exprs_l
+            ),
         )
         cols: List[Column] = []
         # recover dictionaries for string key passthroughs
@@ -760,6 +773,21 @@ class HashAggregateExec(PhysicalOp):
         return ColumnBatch(self._schema, cols, n)
 
     # ------------------------------------------------------------------
+    def _scatter_core_hint(self, in_schema, key_exprs) -> bool:
+        """Mirror of _build_kernel's use_scatter gate, evaluated at
+        dispatch time: True when the kernel variant about to build will
+        run the scatter grouping core, so cached_kernel can route it to
+        the scatter-friendly CPU runtime (dispatch._scatter_jit_kwargs).
+        A wrong guess only costs runtime choice, never correctness."""
+        return (
+            bool(key_exprs)
+            and _group_core_choice() == "scatter"
+            and self._narrow_key_dtypes(
+                in_schema, key_exprs, allow_floats=True
+            )
+            is not None
+        )
+
     def _narrow_key_dtypes(self, in_schema, key_exprs,
                            allow_floats: bool = False):
         """Hash dtypes for the narrow-key grouping fast path, or None

@@ -263,7 +263,8 @@ class _JoinCore:
                     return kernel
 
                 span_fn = cached_kernel(
-                    ("join_keyspan", eq_layout, cap), build_span
+                    ("join_keyspan", eq_layout, cap), build_span,
+                    span="join_dispatch",
                 )
                 kmin, kmax = (
                     int(x) for x in np.asarray(
@@ -300,6 +301,7 @@ class _JoinCore:
                     dfn = cached_kernel(
                         ("join_table_direct", eq_layout, cap, tsize_d),
                         build_direct,
+                        scatter_class=True, span="join_dispatch",
                     )
                     base = jnp.asarray(kmin, jnp.int64)
                     tab, dup = dfn(
@@ -345,7 +347,8 @@ class _JoinCore:
                 return kernel
 
             fn = cached_kernel(
-                ("join_table", eq_layout, cap, tsize, kr), build_table
+                ("join_table", eq_layout, cap, tsize, kr), build_table,
+                scatter_class=True, span="join_dispatch",
             )
             tab, dup = fn(
                 _flatten_cols(build_cols),
@@ -382,7 +385,9 @@ class _JoinCore:
 
             return kernel
 
-        fn = cached_kernel(("join_index", dtypes, cap), build)
+        fn = cached_kernel(
+            ("join_index", dtypes, cap), build, span="join_dispatch"
+        )
         h_sorted, order = fn(
             tuple(v for v, _, _ in bufs), tuple(m for _, m, _ in bufs),
             self.build.num_rows,
@@ -451,6 +456,54 @@ class _JoinCore:
             probe_cb,
         )
 
+    def table_state_static(self, probe_keys: List[int],
+                           probe_schema: Schema):
+        """Table-core state WITHOUT a materialized probe batch, for the
+        probe-chain-folded fused join: the probe keys are evaluated
+        INSIDE the consumer's kernel, so eligibility must be decided
+        from static probe dtypes alone. Dictionary-encoded keys on
+        either side are out (unification needs host key values); the
+        kr/direct width checks mirror _check_probe_dtypes using the
+        probe fields' physical dtypes (the engine-wide invariant that
+        evaluated buffers carry their field's physical dtype - the
+        folded kernel asserts it at trace time). Returns (mode, tab) or
+        None (sorted core / ineligible shape); None means the caller
+        should materialize the probe batch and use table_state()."""
+        build_cols = [self.build.columns[i] for i in self.build_keys]
+        if any(c.dtype.is_dictionary_encoded for c in build_cols):
+            return None
+        p_fields = [probe_schema.fields[i] for i in probe_keys]
+        if any(
+            f.dtype.is_dictionary_encoded
+            or f.dtype.is_string_like
+            or f.dtype.is_wide_decimal
+            for f in p_fields
+        ):
+            return None
+        p_dtypes = [
+            np.dtype(f.dtype.physical_dtype()) for f in p_fields
+        ]
+        with self._index_lock:
+            self._ensure_index(build_cols)
+            # width demotions, statically (mirror _check_probe_dtypes)
+            if self._index[0] == "table_direct" and not all(
+                np.issubdtype(dt, np.integer) for dt in p_dtypes
+            ):
+                self._force_generic = True
+                self._index = None
+                self._ensure_index(build_cols)
+            elif self._index[0] == "table_kr" and not all(
+                b.values.dtype == dt
+                for b, dt in zip(build_cols, p_dtypes)
+            ):
+                self._force_generic = True
+                self._index = None
+                self._ensure_index(build_cols)
+            index = self._index
+        if index[0] not in ("table", "table_kr", "table_direct"):
+            return None
+        return index[0], index[1]
+
     def probe(self, probe_cb: ColumnBatch, probe_keys: List[int]):
         """Hash the probe keys and size the pair expansion (one host
         sync). Returns the state tuple for emit_pairs(); emission - and
@@ -503,6 +556,7 @@ class _JoinCore:
                 ("join_lookup", mode, b_eq_layout, p_eq_layout, bcap,
                  pcap),
                 build_lookup,
+                span="join_dispatch",
             )
             match_idx, matched = fn(
                 _flatten_cols(unified_b),
@@ -550,7 +604,10 @@ class _JoinCore:
 
             return kernel
 
-        fn = cached_kernel(("join_counts", pdtypes, pcap), build_counts)
+        fn = cached_kernel(
+            ("join_counts", pdtypes, pcap), build_counts,
+            span="join_dispatch",
+        )
         counts, lo, total_dev = fn(
             tuple(v for v, _, _ in pbufs),
             tuple(m for _, m, _ in pbufs),
@@ -663,6 +720,7 @@ class _JoinCore:
             ("join_emit", k_layout, b_layout, p_layout, bcap, pcap,
              pair_cap, n_b, n_p),
             build_emit,
+            scatter_class=True, span="join_dispatch",
         )
         bkey_bufs = tuple(b2.values for b2 in unified_b)
         pkey_bufs = tuple(
@@ -729,6 +787,7 @@ class _JoinCore:
             ("join_emit_table", b_layout, bcap, pcap,
              len(out_build_cols)),
             build_emit,
+            scatter_class=True, span="join_dispatch",
         )
         bout, valid, mb = fn(
             match_idx, matched, _flatten_cols(out_build_cols),

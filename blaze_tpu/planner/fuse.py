@@ -113,6 +113,62 @@ def _window_agg_fusable(win) -> bool:
     return win._sort_fusable(keys)
 
 
+def _fuse_join_under_agg(join) -> None:
+    """Relational-core fusion for an INNER hash join that feeds a fused
+    aggregate. Both join-side chains collapse:
+
+    - the BUILD side's scan->filter->project chain becomes one
+      FusedPipelineExec (even a single stage - the collected build
+      relation then lands in the device hash table with one stage
+      dispatch per batch plus the cached insert, no intermediate
+      materialization between stages);
+    - the PROBE side's chain is recorded on the join as
+      ``_fused_probe = (leaf, pipeline)`` so
+      FusedAggregateExec._execute_join_fused can fold the stages INTO
+      the lookup+aggregate kernel (scan -> filter -> project -> probe
+      -> aggregate as ONE program over the raw leaf batch). The probe
+      child is ALSO replaced by the same pipeline object, so shapes the
+      folded form rejects at runtime (dictionary-encoded keys, the
+      sorted join core, packed wire batches) fall back to one
+      stage-chain dispatch per batch instead of one per stage.
+
+    The join node itself is left in place - outer-join types, the
+    unfused HashJoinExec.execute path and mesh fallback plans read none
+    of the attachments and keep their existing ladder."""
+    from blaze_tpu.ops.fused import FusedPipelineExec
+
+    bchain, bleaf = _collect_chain(join.children[0])
+    if bchain:
+        join.children[0] = FusedPipelineExec(
+            fuse_pipelines(bleaf), list(reversed(bchain))
+        )
+    else:
+        join.children[0] = fuse_pipelines(join.children[0])
+    pchain, pleaf = _collect_chain(join.children[1])
+    if pchain:
+        pleaf = fuse_pipelines(pleaf)
+        pipe = FusedPipelineExec(pleaf, list(reversed(pchain)))
+        join.children[1] = pipe
+        join._fused_probe = (pleaf, pipe)
+    else:
+        join.children[1] = fuse_pipelines(join.children[1])
+
+
+def _fuse_agg_leaf(leaf: PhysicalOp) -> PhysicalOp:
+    """Recurse below a fused aggregate's chain leaf. An INNER hash join
+    gets its input chains fused around the join (see
+    _fuse_join_under_agg); anything else takes the generic pass."""
+    from blaze_tpu.ops.joins import HashJoinExec, JoinType
+
+    if (
+        isinstance(leaf, HashJoinExec)
+        and leaf.join_type is JoinType.INNER
+    ):
+        _fuse_join_under_agg(leaf)
+        return leaf
+    return fuse_pipelines(leaf)
+
+
 def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
     """Top-down rewrite collapsing maximal fusable chains (>= 2 stages),
     folding PARTIAL aggregates into the chain below them, rewriting
@@ -137,7 +193,7 @@ def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
         if op.mode is AggMode.PARTIAL:
             if chain:
                 pipeline = FusedPipelineExec(
-                    fuse_pipelines(leaf), list(reversed(chain))
+                    _fuse_agg_leaf(leaf), list(reversed(chain))
                 )
                 return FusedAggregateExec(pipeline, op)
             # no chain to fold - leave the plain streaming partial
@@ -162,7 +218,7 @@ def fuse_pipelines(op: PhysicalOp) -> PhysicalOp:
                     )
                 leaf = win
             pipeline = FusedPipelineExec(
-                fuse_pipelines(leaf), list(reversed(chain))
+                _fuse_agg_leaf(leaf), list(reversed(chain))
             )
             partial = HashAggregateExec(
                 pipeline,

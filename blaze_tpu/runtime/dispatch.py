@@ -45,6 +45,46 @@ _KERNEL_CACHE_CAP = int(
     _os.environ.get("BLAZE_KERNEL_CACHE_CAP", 256)
 ) or (1 << 30)
 
+# ---------------------------------------------------------------------------
+# Per-kernel XLA:CPU runtime selection.
+#
+# jaxlib's default CPU runtime (the "thunk" runtime) serializes scatter
+# updates through a slow per-element path: an 8M-row segment_sum costs
+# ~457ms vs ~33ms under the legacy runtime (measured on this host,
+# jaxlib 0.4.36) - a 14x gap that dominates every scatter-core grouped
+# aggregate and hash-table insert. The legacy runtime, in turn, sorts
+# ~6x SLOWER, so the selection must be per-kernel: scatter-dominated
+# kernels (grouped aggregation, join table inserts, the fused
+# join+aggregate program) opt in via `cached_kernel(...,
+# scatter_class=True)`; sort-dominated kernels (window, lexsort
+# grouping, the sorted join core) keep the default runtime.
+#
+# CPU-only: on TPU (and any non-CPU backend) the hint is a no-op. The
+# option is probed once with a throwaway compile so an incompatible
+# jaxlib silently falls back to the default runtime.
+# BLAZE_CPU_RUNTIME_SPLIT=0 disables the split entirely.
+_SCATTER_JIT_KWARGS: Dict[str, Any] = None
+
+
+def _scatter_jit_kwargs() -> Dict[str, Any]:
+    global _SCATTER_JIT_KWARGS
+    if _SCATTER_JIT_KWARGS is not None:
+        return _SCATTER_JIT_KWARGS
+    kwargs: Dict[str, Any] = {}
+    if _os.environ.get("BLAZE_CPU_RUNTIME_SPLIT", "1") != "0":
+        try:
+            if jax.default_backend() == "cpu":
+                opts = {"xla_cpu_use_thunk_runtime": False}
+                # probe compile: rejects on jaxlibs without the flag
+                jax.jit(
+                    lambda x: x + 1, compiler_options=opts
+                )(0)
+                kwargs = {"compiler_options": opts}
+        except Exception:
+            kwargs = {}
+    _SCATTER_JIT_KWARGS = kwargs
+    return kwargs
+
 
 def record(kind: str, n: int = 1) -> None:
     with _lock:
@@ -85,7 +125,8 @@ class counting:
         return False
 
 
-def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
+def _wrap_dispatch(fn: Callable, kind: str,
+                   span: str = "kernel_dispatch") -> Callable:
     from blaze_tpu.obs import trace as obs_trace
     from blaze_tpu.testing import chaos
 
@@ -100,8 +141,10 @@ def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
             # obs seam: one span per kernel dispatch (the unit of the
             # perf model); no-op when no recorder is in scope. XLA
             # dispatch is async, so this measures launch, not device
-            # occupancy - the span COUNT is the signal.
-            with obs_trace.span("kernel_dispatch", kind=kind):
+            # occupancy - the span COUNT is the signal. `span` gives
+            # relational-core kernels (join/group) their own phase
+            # attribution in obs/phases.py.
+            with obs_trace.span(span, kind=kind):
                 return fn(*args, **kw)
         return fn(*args, **kw)
 
@@ -109,12 +152,19 @@ def _wrap_dispatch(fn: Callable, kind: str) -> Callable:
 
 
 def cached_kernel(key: Tuple, build: Callable[[], Callable],
+                  scatter_class: bool = False,
+                  span: str = "kernel_dispatch",
                   **jit_kwargs) -> Callable:
     """Process-wide compiled-kernel lookup.
 
     `build()` returns the python function to jit; it runs only on cache
     miss. Each invocation of the returned callable records one
-    "dispatches" count (steady state: one XLA execution per call)."""
+    "dispatches" count (steady state: one XLA execution per call).
+
+    `scatter_class=True` marks a scatter-dominated kernel: on the CPU
+    backend it compiles under the legacy (non-thunk) XLA:CPU runtime
+    (see _scatter_jit_kwargs). `span` names the obs trace span so
+    phases.py can band join/group dispatches separately."""
     with _lock:
         fn = _KERNELS.get(key)
         if fn is not None:
@@ -123,6 +173,8 @@ def cached_kernel(key: Tuple, build: Callable[[], Callable],
             # query stream should be all hits - tests pin this
             _counts["kernel_hits"] = _counts.get("kernel_hits", 0) + 1
     if fn is None:
+        if scatter_class:
+            jit_kwargs = {**_scatter_jit_kwargs(), **jit_kwargs}
         with _lock:
             fn = _KERNELS.get(key)
             if fn is None:
@@ -132,7 +184,8 @@ def cached_kernel(key: Tuple, build: Callable[[], Callable],
                     _counts.get("kernel_builds", 0) + 1
                 )
                 fn = _wrap_dispatch(
-                    jax.jit(build(), **jit_kwargs), "dispatches"
+                    jax.jit(build(), **jit_kwargs), "dispatches",
+                    span=span,
                 )
                 _KERNELS[key] = fn
                 while len(_KERNELS) > _KERNEL_CACHE_CAP:

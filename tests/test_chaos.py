@@ -584,3 +584,116 @@ def test_battery_shapes_identical_under_transient_chaos(tmp_path):
         bl = baseline.sort_by(baseline.column_names[0]).to_pydict()
         ch = chaotic.sort_by(chaotic.column_names[0]).to_pydict()
         assert bl == ch, f"shape {name} diverged under chaos"
+
+
+# ---------------------------------------------------------------------------
+# --chaos smoke: fused relational kernels == unfused ladder, byte-equal
+# ---------------------------------------------------------------------------
+
+
+def _relational_shapes():
+    """join_agg / grouped_agg plan builders (ISSUE 13): the two shapes
+    whose fused kernels (probe fold + grouped streaming carry) replace
+    the multi-dispatch ladder. Multi-chunk input so the keyed carry's
+    merge path runs, not just the single-batch hot path."""
+    from blaze_tpu.exprs.ir import Literal
+    from blaze_tpu.ops.joins import HashJoinExec, JoinType
+    from blaze_tpu.types import DataType
+
+    rng = np.random.default_rng(13)
+    n, chunks = 1 << 12, 3
+    fact_parts = []
+    for _ in range(chunks):
+        fact_parts.append(ColumnBatch.from_arrow(pa.record_batch({
+            "item": rng.integers(0, 256, n).astype(np.int32),
+            "qty": rng.integers(1, 10, n).astype(np.int32),
+            "price": (rng.random(n) * 100).astype(np.float32),
+        })))
+    items = ColumnBatch.from_arrow(pa.record_batch({
+        "i_item": np.arange(256, dtype=np.int32),
+        "i_brand": rng.integers(0, 32, 256).astype(np.int32),
+    }))
+    fschema = fact_parts[0].schema
+
+    def join_agg():
+        return HashAggregateExec(
+            ProjectExec(
+                HashJoinExec(
+                    MemoryScanExec([[items]], items.schema),
+                    ProjectExec(
+                        FilterExec(
+                            MemoryScanExec([fact_parts], fschema),
+                            Col("qty") > Literal(2, DataType.int32()),
+                        ),
+                        [(Col("item"), "item"),
+                         (Col("price"), "price")],
+                    ),
+                    [Col("i_item")], [Col("item")], JoinType.INNER,
+                ),
+                [(Col("i_brand"), "brand"), (Col("price"), "price")],
+            ),
+            keys=[(Col("brand"), "brand")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "rev"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "cnt")],
+            mode=AggMode.COMPLETE,
+        )
+
+    def grouped_agg():
+        return HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([fact_parts], fschema),
+                [(Col("item") % Literal(64, DataType.int32()), "g"),
+                 (Col("price"), "price"), (Col("qty"), "qty")],
+            ),
+            keys=[(Col("g"), "g")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "s"),
+                  (AggExpr(AggFn.MIN, Col("price")), "lo"),
+                  (AggExpr(AggFn.MAX, Col("qty")), "hi"),
+                  (AggExpr(AggFn.AVG, Col("qty")), "aq")],
+            mode=AggMode.COMPLETE,
+        )
+
+    return {"join_agg": join_agg, "grouped_agg": grouped_agg}
+
+
+def _canon_bytes(t: pa.Table):
+    """Canonical order + one chunk -> serialized IPC bytes, the
+    byte-equality form of the differential."""
+    t = t.sort_by([(t.column_names[0], "ascending")]).combine_chunks()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return t, sink.getvalue().to_pybytes()
+
+
+def test_fused_relational_byte_equal_and_chaos_parity():
+    """run_tests.py --chaos --seeds N member (ISSUE 13): for each
+    relational-core shape, the FUSED plan's Arrow output is BYTE-equal
+    (canonical order, serialized IPC) to the unfused operator ladder -
+    and stays byte-equal when a transient kernel.dispatch fault fires
+    through the new fused kernels' shared chaos seam and the retry
+    machinery re-runs the partition."""
+    from blaze_tpu.ops.fused import fuse_pipelines
+    from blaze_tpu.runtime.executor import run_plan
+
+    for name, mk in _relational_shapes().items():
+        ref, ref_bytes = _canon_bytes(run_plan(mk()))
+        fused, fused_bytes = _canon_bytes(run_plan(fuse_pipelines(mk())))
+        assert fused.schema.equals(ref.schema), name
+        assert fused_bytes == ref_bytes, \
+            f"shape {name}: fused output diverged from unfused ladder"
+
+        with chaos.active(
+            [Fault("kernel.dispatch", klass="TRANSIENT", times=1)],
+            seed=11,
+        ) as plan:
+            ctx = ExecContext()
+            chaotic = run_plan_parallel(
+                fuse_pipelines(mk()), ctx=ctx, parallelism=1,
+                retry_backoff_s=0.005,
+            )
+            assert plan.fired("kernel.dispatch") == 1, name
+            assert ctx.metrics.counters["task_retries"] == 1, name
+        _, chaos_bytes = _canon_bytes(chaotic)
+        assert chaos_bytes == ref_bytes, \
+            f"shape {name} diverged under chaos retry"

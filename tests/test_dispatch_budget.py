@@ -81,6 +81,17 @@ def _check(counts, dispatches, h2d=0, d2h=1):
     assert counts.get("kernel_builds", 0) == 0, counts
 
 
+def _check_exact(counts, dispatches, h2d=0, fetches=1, syncs=0):
+    """EXACT budget (ISSUE 13): the relational-core shapes pin their
+    precise warm counts, so a fusion regression that merely adds a
+    dispatch - still under some slack upper bound - fails loudly."""
+    assert counts.get("dispatches", 0) == dispatches, counts
+    assert counts.get("h2d_batches", 0) == h2d, counts
+    assert counts.get("d2h_fetches", 0) == fetches, counts
+    assert counts.get("d2h_syncs", 0) == syncs, counts
+    assert counts.get("kernel_builds", 0) == 0, counts
+
+
 def test_e2e_scan_agg_budget(tmp_path, tables):
     path = str(tmp_path / "t.parquet")
     rng = np.random.default_rng(7)
@@ -129,10 +140,10 @@ def test_join_agg_budget(tables):
         mode=AggMode.COMPLETE,
     ))
     counts = _counts(lambda: run_plan(plan))
-    # probe+lookup+stages+aggregate fuse into one program; the grouped
-    # fetch pays one packed D2H (pack dispatch + fetch) and the group
-    # count rides it
-    _check(counts, dispatches=3, h2d=0, d2h=2)
+    # probe stages + lookup + gather + grouped aggregate + in-kernel
+    # state pack fuse into ONE program; the group count rides the
+    # single packed fetch (no separate pack dispatch, no count sync)
+    _check_exact(counts, dispatches=1, h2d=0, fetches=1, syncs=0)
 
 
 def test_grouped_agg_budget(tables):
@@ -149,7 +160,10 @@ def test_grouped_agg_budget(tables):
         mode=AggMode.COMPLETE,
     ))
     counts = _counts(lambda: run_plan(plan))
-    _check(counts, dispatches=3, h2d=0, d2h=2)
+    # stages + scatter grouping + segmented reduce + in-kernel state
+    # pack are ONE program; single-batch skips the overflow sync (the
+    # group count is validated off the fetched buffer instead)
+    _check_exact(counts, dispatches=1, h2d=0, fetches=1, syncs=0)
 
 
 def test_window_budget(tables):
@@ -253,6 +267,192 @@ def test_multi_chunk_carry_stream_budget_and_oracle(tmp_path):
     finally:
         set_config(EngineConfig(batch_size=N,
                                 shape_buckets=(4096, N)))
+
+
+def test_keyed_multi_chunk_carry_budget_and_oracle(tmp_path):
+    """The KEYED streaming carry (ISSUE 13): a grouped aggregate over a
+    multi-chunk scan runs one fused dispatch per chunk - inner partial
+    + carry merge in the same program - with one overflow-guard sync
+    per chunk and ONE final packed fetch (no per-batch state fetch, no
+    host FINAL-merge dispatches); the merged groups are exactly the
+    single-pass numpy answer."""
+    set_config(EngineConfig(batch_size=1 << 14,
+                            shape_buckets=(4096, 1 << 14)))
+    try:
+        n = 1 << 16  # 4 chunks of 16k
+        rng = np.random.default_rng(11)
+        g = rng.integers(0, 64, n).astype(np.int32)
+        qty = rng.integers(1, 10, n).astype(np.int32)
+        price = (rng.random(n) * 100).astype(np.float32)
+        path = str(tmp_path / "gk.parquet")
+        pq.write_table(pa.table({"g": g, "qty": qty, "price": price}),
+                       path, compression="zstd", row_group_size=n)
+        plan = HashAggregateExec(
+            FilterExec(
+                ParquetScanExec([[FileRange(path)]]),
+                Col("price") > 25.0,
+            ),
+            keys=[(Col("g"), "g")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n"),
+                  (AggExpr(AggFn.MIN, Col("price")), "lo"),
+                  (AggExpr(AggFn.MAX, Col("price")), "hi"),
+                  (AggExpr(AggFn.AVG, Col("qty")), "aq")],
+            mode=AggMode.COMPLETE,
+        )
+        blob = task_to_proto(plan, 0)
+
+        def run():
+            # mesh off: this pins the SINGLE-DEVICE keyed carry (the
+            # forced-host test mesh would lower this grouped shape to
+            # MeshGroupByExec, whose budget test_mesh_groupby_budget
+            # pins separately)
+            from blaze_tpu.ops.base import ExecContext
+
+            ctx = ExecContext()
+            ctx.mesh_mode = "off"
+            t = pa.Table.from_batches(list(execute_task(blob, ctx)))
+            return t.sort_by([("g", "ascending")])
+
+        out = run()
+        live = price > 25.0
+        keys = np.unique(g[live])
+        assert out.column("g").to_numpy().tolist() == keys.tolist()
+        for i, k in enumerate(keys):
+            m = live & (g == k)
+            assert out.column("n")[i].as_py() == int(m.sum())
+            s = float(price[m].sum(dtype=np.float64))
+            # f32 accumulation order inside the scatter reduce: a few
+            # ulps at this magnitude
+            assert abs(out.column("s")[i].as_py() - s) <= abs(s) * 1e-5
+            assert out.column("lo")[i].as_py() == float(price[m].min())
+            assert out.column("hi")[i].as_py() == float(price[m].max())
+            assert abs(out.column("aq")[i].as_py()
+                       - float(qty[m].mean())) < 1e-9
+        counts = _counts(run)
+        # 4 chunks -> 4 fused carry dispatches, 4 packed H2D, 4 carry
+        # overflow-guard syncs, ONE final fetch
+        _check_exact(counts, dispatches=4, h2d=4, fetches=1, syncs=4)
+    finally:
+        set_config(EngineConfig(batch_size=N,
+                                shape_buckets=(4096, N)))
+
+
+def test_second_relational_plan_builds_zero_kernels(tables):
+    """ISSUE 13: the fused join/grouped kernels cache structurally -
+    a freshly constructed, structurally identical plan re-dispatches
+    from the kernel cache without one new build. The fresh JOIN plan
+    pays the cached build-side insert again (its hash table is plan-
+    object state): 3 dispatches + 1 dup-check sync on top of the warm
+    1-dispatch probe, all served from cache."""
+    def fresh_join():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                HashJoinExec(
+                    MemoryScanExec([[tables["items"]]],
+                                   tables["items"].schema),
+                    ProjectExec(
+                        MemoryScanExec([[tables["fact"]]],
+                                       tables["fact"].schema),
+                        [(Col("item"), "item"),
+                         (Col("price"), "price")],
+                    ),
+                    [Col("i_item")], [Col("item")], JoinType.INNER,
+                ),
+                [(Col("i_brand"), "brand"), (Col("price"), "price")],
+            ),
+            keys=[(Col("brand"), "brand")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "rev")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    def fresh_grouped():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([[tables["fact"]]],
+                               tables["fact"].schema),
+                [(Col("item") % Literal(4096, DataType.int32()), "g"),
+                 (Col("price"), "price"), (Col("qty"), "qty")],
+            ),
+            keys=[(Col("g"), "g")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "s"),
+                  (AggExpr(AggFn.MIN, Col("price")), "lo"),
+                  (AggExpr(AggFn.AVG, Col("qty")), "aq")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    run_plan(fresh_join())  # build + warm
+    with dispatch.counting() as c:
+        run_plan(fresh_join())
+    assert c.counts.get("kernel_builds", 0) == 0, c.counts
+    assert c.counts.get("kernel_hits", 0) > 0, c.counts
+    _check_exact(c.counts, dispatches=3, h2d=0, fetches=1, syncs=1)
+
+    run_plan(fresh_grouped())
+    with dispatch.counting() as c:
+        run_plan(fresh_grouped())
+    assert c.counts.get("kernel_builds", 0) == 0, c.counts
+    assert c.counts.get("kernel_hits", 0) > 0, c.counts
+    # grouped carry state is not plan-object-bound: the fresh plan
+    # keeps the exact 1-dispatch budget
+    _check_exact(c.counts, dispatches=1, h2d=0, fetches=1, syncs=0)
+
+
+def test_chaos_armed_keeps_relational_budgets(tables):
+    """ISSUE 13: the new fused join/group kernels dispatch through the
+    same chaos seam as every other kernel - an ARMED-but-empty fault
+    plan (hooks entered, zero faults) keeps the exact relational-core
+    budgets and adds zero dispatches/transfers/builds."""
+    from blaze_tpu.testing import chaos
+
+    assert not chaos.ACTIVE
+
+    def mk_join():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                HashJoinExec(
+                    MemoryScanExec([[tables["items"]]],
+                                   tables["items"].schema),
+                    ProjectExec(
+                        MemoryScanExec([[tables["fact"]]],
+                                       tables["fact"].schema),
+                        [(Col("item"), "item"),
+                         (Col("price"), "price")],
+                    ),
+                    [Col("i_item")], [Col("item")], JoinType.INNER,
+                ),
+                [(Col("i_brand"), "brand"), (Col("price"), "price")],
+            ),
+            keys=[(Col("brand"), "brand")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "rev")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    def mk_grouped():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([[tables["fact"]]],
+                               tables["fact"].schema),
+                [(Col("item") % Literal(4096, DataType.int32()), "g"),
+                 (Col("price"), "price"), (Col("qty"), "qty")],
+            ),
+            keys=[(Col("g"), "g")],
+            aggs=[(AggExpr(AggFn.SUM, Col("price")), "s"),
+                  (AggExpr(AggFn.MIN, Col("price")), "lo"),
+                  (AggExpr(AggFn.AVG, Col("qty")), "aq")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    for mk, disp, syncs in ((mk_join, 3, 1), (mk_grouped, 1, 0)):
+        baseline = _counts(lambda: run_plan(mk()))
+        with chaos.active([], seed=7):  # armed, zero faults
+            armed = _counts(lambda: run_plan(mk()))
+        assert not chaos.ACTIVE
+        for k in ("dispatches", "h2d_batches", "d2h_fetches",
+                  "d2h_syncs", "kernel_builds"):
+            assert armed.get(k, 0) == baseline.get(k, 0), (k, armed)
+        _check_exact(armed, dispatches=disp, h2d=0, fetches=1,
+                     syncs=syncs)
 
 
 def test_second_identical_plan_builds_zero_kernels(tables):

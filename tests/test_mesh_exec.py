@@ -597,3 +597,72 @@ def test_mesh_dryrun_cli(tmp_path):
     assert doc["n_devices"] == 2
     assert doc["ok"] is True and doc["skipped"] is False
     assert "dryrun_multichip OK" in doc["tail"]
+
+
+def test_mesh_relational_fused_kernels_byte_equal_and_pin():
+    """ISSUE 13: the mesh tier inherits the fused relational kernels
+    for free. MeshGroupByExec and MeshBroadcastJoinExec results are
+    BYTE-equal (canonical total order, serialized IPC) to the mesh-off
+    path - which now runs the fused grouped-carry / join kernels - and
+    the mesh-stage dispatch pin (ONE program launch per stage) is
+    unchanged by the fusion work."""
+    from blaze_tpu.ops.fused import fuse_pipelines
+    from blaze_tpu.runtime import dispatch
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (forced-host) mesh")
+
+    def canon(table):
+        df = table.to_pandas()
+        df = df.sort_values(list(df.columns)).reset_index(drop=True)
+        tbl = pa.Table.from_pandas(df, preserve_index=False) \
+            .combine_chunks()
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        return sink.getvalue().to_pybytes()
+
+    # grouped aggregate: mesh-off exchange sandwich (whose per-device
+    # sub-plans run the fused grouped-carry kernels) vs MeshGroupByExec
+    want = canon(run_plan(fuse_pipelines(sandwich(scan(n_parts=8),
+                                                  n=8))))
+    low = lower_plan_to_mesh(sandwich(scan(n_parts=8), n=8), mode="on")
+    assert isinstance(low, MeshGroupByExec)
+    assert canon(run_plan(low)) == want
+    low._result = None
+    run_plan(low)  # warm
+
+    def run_grouped():
+        low._result = None
+        return run_plan(low)
+
+    with dispatch.counting() as c:
+        run_grouped()
+    assert c.counts.get("mesh_dispatches", 0) == 1, c.counts
+
+    # broadcast join: mesh-off fused pipeline vs MeshBroadcastJoinExec
+    items = ColumnBatch.from_arrow(pa.record_batch({
+        "ik": np.arange(10, dtype=np.int64),
+        "iv": (np.arange(10, dtype=np.int64) * 100),
+    }))
+
+    def join(probe):
+        return HashJoinExec(
+            MemoryScanExec([[items]], items.schema), probe,
+            ["ik"], ["k"], JoinType.INNER,
+        )
+
+    jwant = canon(run_plan(fuse_pipelines(join(scan()))))
+    jlow = lower_plan_to_mesh(join(scan()), mode="on")
+    assert isinstance(jlow, MeshBroadcastJoinExec)
+    assert canon(run_plan(jlow)) == jwant
+    jlow._result = None
+    run_plan(jlow)  # warm
+
+    def run_join():
+        jlow._result = None
+        return run_plan(jlow)
+
+    with dispatch.counting() as c:
+        run_join()
+    assert c.counts.get("mesh_dispatches", 0) == 1, c.counts

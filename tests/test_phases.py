@@ -162,7 +162,9 @@ def test_terminal_hook_folds_phases_into_global_rollup(agg_blob):
             assert q.wait(60.0) and q.state.value == "DONE"
         snap = phases.ROLLUP.snapshot()
         assert snap[ALL_CLASS]["e2e"]["n"] == 3
-        for ph in ("queue_wait", "execute", "decode", "dispatch"):
+        # the keyed aggregate's kernel launches land in the fused
+        # grouped-dispatch phase, not the generic dispatch bucket
+        for ph in ("queue_wait", "execute", "decode", "group"):
             assert ph in snap[ALL_CLASS], snap[ALL_CLASS].keys()
         # the fingerprint class rode along (stable plan)
         fp_classes = [k for k in snap if k not in (ALL_CLASS,)]
