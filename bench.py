@@ -1316,6 +1316,103 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- streaming data plane (ISSUE 14): time-to-first-part vs
+    # time-to-last-part through the gateway FETCH stream. A filter-
+    # only plan over an 8-row-group parquet file keeps parts flowing
+    # as execution produces them (an aggregate would collapse the
+    # stream to one terminal part), so TTFP measures when the FIRST
+    # batch crosses the wire while the query is still RUNNING - the
+    # incremental-delivery win the materialized path cannot have
+    # (there TTFP == TTLP by construction). Cache off: a ResultCache
+    # hit feeds the ring all at once and would fake a perfect TTFP.
+    # `median` is TTLP (the e2e cost, comparable across rounds);
+    # ttfp_over_ttlp < 0.5 is the smoke's incremental-delivery bar. ----
+    try:
+        from blaze_tpu.config import get_config as _get_cfg
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _StGateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _StService,
+            ServiceClient as _StClient,
+        )
+
+        n_stream = n_rows
+        stream_parts = 8
+        stream_bs = max(4096, n_stream // stream_parts)
+        st_path = "/tmp/blaze_bench_stream.parquet"
+        pq.write_table(
+            pa.table({"item": item_sk[:n_stream], "qty": qty[:n_stream],
+                      "price": price[:n_stream]}),
+            st_path, compression="zstd", row_group_size=stream_bs,
+        )
+        st_blob = task_to_proto(
+            FilterExec(
+                ParquetScanExec([[FileRange(st_path)]]),
+                Col("price") > 1.0,
+            ),
+            0,
+        )
+        prev_cfg = _get_cfg()
+        set_config(EngineConfig(batch_size=stream_bs))
+        st_svc = _StService(max_concurrency=4)
+        try:
+            with _StGateway(service=st_svc) as st_srv:
+                st_host, st_port = st_srv.address
+
+                def stream_once():
+                    with _StClient(st_host, st_port) as cl:
+                        st = cl.submit(st_blob, use_cache=False)
+                        t0 = time.perf_counter()
+                        first = last = None
+                        nparts = rows_seen = 0
+                        for rb in cl.fetch_stream(st["query_id"]):
+                            now = time.perf_counter()
+                            if first is None:
+                                first = now - t0
+                            last = now - t0
+                            nparts += 1
+                            rows_seen += rb.num_rows
+                    return first, last, nparts, rows_seen
+
+                k_st = int(os.environ.get("BLAZE_BENCH_ITERS", 3))
+                stream_once()  # warm-up: compile at the stream bucket
+                samples = [stream_once() for _ in range(k_st)]
+                samples.sort(key=lambda s: s[1])
+                ttfp, ttlp, nparts, rows_seen = (
+                    samples[len(samples) // 2]
+                )
+                lps = [s[1] for s in samples]
+                spread = (
+                    (lps[-1] - lps[0]) / ttlp if ttlp else 0.0
+                )
+                detail["stream_first_byte_8m"] = {
+                    "median": round(ttlp, 4),
+                    "spread": round(spread, 3),
+                    "k": k_st,
+                    "first_part_s": round(ttfp, 4),
+                    "last_part_s": round(ttlp, 4),
+                    "ttfp_over_ttlp": (
+                        round(ttfp / ttlp, 3) if ttlp else 0.0
+                    ),
+                    "parts": nparts,
+                    "rows": rows_seen,
+                }
+        finally:
+            st_svc.close()
+            set_config(prev_cfg)
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "stream_first_byte_8m", "backend": backend,
+                 **detail["stream_first_byte_8m"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["stream_first_byte_8m"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     # ---- replica router: a repeated-query mix through TWO replicas,
     # affinity vs random placement (ISSUE 5 satellite). Every round
     # submits `rt_conc` repeats of `rt_distinct` fresh plans (fresh
@@ -1587,6 +1684,25 @@ def smoke():
                     f"{name}: fused dispatch budget blown: {dc} "
                     "(want 1 warm dispatch)"
                 )
+        stq = (result.get("queries") or {}).get(
+            "stream_first_byte_8m") or {}
+        if stq and "error" not in stq:
+            # incremental-delivery bar (ISSUE 14): the first part must
+            # cross the wire well before the stream finishes - under
+            # materialized delivery TTFP == TTLP by construction, so
+            # a ratio creeping toward 1.0 means streaming regressed
+            # back to buffer-then-send
+            st_ratio = float(stq.get("ttfp_over_ttlp", 1.0))
+            if st_ratio >= 0.5:
+                problems.append(
+                    f"stream TTFP/TTLP {st_ratio} >= 0.5 "
+                    f"(first part no longer beats the full stream; "
+                    f"parts={stq.get('parts')})"
+                )
+        elif stq:
+            problems.append(
+                f"stream_first_byte_8m failed: {stq.get('error')}"
+            )
         obs = (result.get("queries") or {}).get("obs_overhead") or {}
         if obs and "error" not in obs:
             # obs-overhead pin (ISSUE 11 satellite, re-pinned from

@@ -132,6 +132,8 @@ def cmd_serve(args) -> int:
         slow_query_s=args.slow_query_s,
         mesh_mode=("on" if args.mesh else args.mesh_mode),
         orphan_ttl_s=args.orphan_ttl,
+        stream_buffer_bytes=args.stream_buffer_bytes,
+        stream_stall_s=args.stream_stall_s,
     )
     # serve_blocking (NOT start()): the main thread is the only
     # accept loop - see TaskGatewayServer.serve_blocking
@@ -265,6 +267,8 @@ def cmd_route(args) -> int:
         replicate_interval_s=args.replicate_interval,
         journal_path=args.journal,
         recover_timeout_s=args.recover_timeout,
+        stream_window=args.stream_window,
+        stream_stall_s=args.stream_stall_s,
     )
     return 0
 
@@ -479,7 +483,22 @@ def main(argv=None) -> int:
     sv.add_argument("--drain-grace", type=float, default=30.0,
                     help="SIGTERM drain: max seconds to wait for "
                          "in-flight queries before leaving anyway "
-                         "(0 = wait forever)")
+                         "(0 = wait forever; open result streams "
+                         "count as in-flight)")
+    sv.add_argument("--stream-buffer-bytes", type=int,
+                    default=32 << 20,
+                    help="per-query bounded ring for incremental "
+                         "FETCH-while-RUNNING delivery: the executor "
+                         "blocks once this many produced-but-"
+                         "undelivered bytes pile up (0 = legacy "
+                         "materialize-then-stream)")
+    sv.add_argument("--stream-stall-s", type=float, default=30.0,
+                    help="slow-consumer budget: a FETCHing client "
+                         "that accepts no bytes for this long while "
+                         "the stream buffer sits at its cap gets the "
+                         "query aborted STREAM_STALLED (CANCELLED-"
+                         "class - never a breaker strike), freeing "
+                         "buffer and reservation (0 disables)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -538,6 +557,17 @@ def main(argv=None) -> int:
                          "placements whose replica has not re-JOINed "
                          "by then are re-placed on the live fleet "
                          "(or stranded when none is routable)")
+    rr.add_argument("--stream-window", type=int, default=4,
+                    help="streaming relay credit window: raw result "
+                         "parts in flight between the downstream "
+                         "reader and the client-facing writer "
+                         "(1 = strictly serial relay)")
+    rr.add_argument("--stream-stall-s", type=float, default=30.0,
+                    help="relay slow-consumer budget: a client that "
+                         "accepts no bytes for this long gets its "
+                         "relay aborted (downstream keeps the parts; "
+                         "a re-FETCH resumes; never a breaker "
+                         "strike; 0 disables)")
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "

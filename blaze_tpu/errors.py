@@ -103,8 +103,13 @@ def _classify_one(e: BaseException) -> Optional[ErrorClass]:
     if isinstance(e, BlazeError):
         return e.error_class
     name = type(e).__name__
-    if name in _CANCEL_NAMES or isinstance(
-        e, (GeneratorExit, KeyboardInterrupt)
+    # match the whole MRO, not just the leaf name: subclasses of the
+    # cancel types (e.g. StreamStalled(QueryCancelled)) are
+    # cooperative cancellations too
+    if (
+        name in _CANCEL_NAMES
+        or any(c.__name__ in _CANCEL_NAMES for c in type(e).__mro__)
+        or isinstance(e, (GeneratorExit, KeyboardInterrupt))
     ):
         return ErrorClass.CANCELLED
     if isinstance(e, MemoryError):

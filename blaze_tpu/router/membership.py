@@ -15,7 +15,15 @@ runs to participate in it:
   * LEAVE - sent once by the drain path (SIGTERM -> QueryService.drain
     -> LEAVE -> exit) on a dedicated short-timeout connection, so a
     cleanly departing replica is removed from placement immediately
-    instead of aging into a heartbeat death.
+    instead of aging into a heartbeat death. Open STREAMS are live
+    work to the drain: QueryService.drain counts a query with an
+    attached fetcher as in flight and holds the process up to the
+    grace budget while the consumer finishes pulling parts (bounded -
+    a stalled consumer is aborted by the stream stall budget, never by
+    the drain). A stream the grace window cuts off is not lost: the
+    router's routing journal + mid-stream failover re-place the query
+    and resume from the last delivered part on a surviving replica
+    (docs/ROUTER.md, "streaming relay").
 
 The router-side counterpart (Router.membership) fires the
 `router.membership` chaos seam on every frame, so dropped JOINs and

@@ -26,6 +26,7 @@ import hashlib
 import itertools
 import logging
 import os
+import queue
 import random
 import re
 import socket
@@ -162,6 +163,8 @@ class Router:
         stats_stale_s: float = 10.0,
         downstream_timeout_s: float = 120.0,
         fetch_block_s: float = 0.5,
+        stream_window: int = 4,
+        stream_stall_s: float = 30.0,
         enable_trace: bool = True,
         conn_pool_size: int = 4,
         replicate_hot_k: int = 4,
@@ -178,6 +181,14 @@ class Router:
         self.stats_stale_s = float(stats_stale_s)
         self.downstream_timeout_s = float(downstream_timeout_s)
         self.fetch_block_s = float(fetch_block_s)
+        # streaming relay flow control: at most stream_window raw
+        # parts in flight between the downstream reader and the
+        # client-facing writer (credit window - the relay never
+        # materializes a result), and a client that accepts no bytes
+        # for stream_stall_s gets its relay aborted instead of letting
+        # its backpressure pin downstream buffers fleet-wide
+        self.stream_window = max(1, int(stream_window))
+        self.stream_stall_s = float(stream_stall_s)
         self.recover_timeout_s = float(recover_timeout_s)
         self.registry = ReplicaRegistry(
             replicas,
@@ -215,6 +226,8 @@ class Router:
             "overflow_spills": 0,
             "drain_spills": 0,
             "no_replica": 0,
+            "stream_stalls": 0,
+            "stream_window_waits": 0,
         }
         # per-replica verb-client POOL (ROADMAP item 4's last enabling
         # refactor): up to conn_pool_size concurrent connections per
@@ -1608,6 +1621,12 @@ class Router:
                 # recovered handles still await reconciliation
                 "journal": self.journal is not None,
                 "recover_pending": len(self._recover_pending),
+                # streaming relay flow control (counters above carry
+                # stream_stalls / stream_window_waits)
+                "streaming": {
+                    "window": self.stream_window,
+                    "stall_s": self.stream_stall_s,
+                },
             },
             "replicas": self.registry.snapshot(),
             "fleet": fleet,
@@ -1806,11 +1825,20 @@ class Router:
                    timeout_ms: int) -> Iterator[bytes]:
         """One downstream FETCH as raw part payloads (never decoded),
         every part yielded in order (the caller skips/verifies).
-        Blocks in short slices so replica death during a long wait is
-        noticed between frames instead of hanging the client."""
-        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
-        from blaze_tpu.service.wire import ServiceClient
+        stream_window > 1 overlaps the downstream RECV with the client
+        SEND through a bounded credit window; window <= 1 keeps the
+        strictly-serial path (recv one part, relay it, recv the
+        next)."""
+        if self.stream_window <= 1:
+            yield from self._raw_fetch_direct(
+                replica, internal_id, timeout_ms
+            )
+        else:
+            yield from self._raw_fetch_windowed(
+                replica, internal_id, timeout_ms
+            )
 
+    def _fetch_connect(self, replica: Replica):
         # connect on its own budget: fetch_block_s slices RECV waits
         # (a socket.timeout there is a poll tick, not a failure), but
         # bounding the CONNECT at 0.5s would turn accept-backlog
@@ -1822,6 +1850,17 @@ class Router:
             timeout=min(self.downstream_timeout_s, 10.0),
         )
         sock.settimeout(self.fetch_block_s)
+        return sock
+
+    def _raw_fetch_direct(self, replica: Replica, internal_id: str,
+                          timeout_ms: int) -> Iterator[bytes]:
+        """Serial relay: blocks in short slices so replica death
+        during a long wait is noticed between frames instead of
+        hanging the client."""
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+        from blaze_tpu.service.wire import ServiceClient
+
+        sock = self._fetch_connect(replica)
         try:
             sock.sendall(_U64.pack(_FLAG_SERVICE))
             sock.sendall(ServiceClient._id_verb(
@@ -1847,6 +1886,98 @@ class Router:
                 sock.close()
             except OSError:
                 pass
+
+    def _raw_fetch_windowed(self, replica: Replica, internal_id: str,
+                            timeout_ms: int) -> Iterator[bytes]:
+        """Credit-window relay: a reader thread pulls raw parts off
+        the downstream socket into a bounded queue while the caller
+        (the client-facing writer) drains it - at most stream_window
+        parts in flight at the router, each the SAME bytes object that
+        came off the wire (no per-part materialization or re-framing;
+        the zero-copy bar of the passthrough survives the overlap). A
+        full window parks the READER (the downstream replica's own
+        stream buffer absorbs the backpressure and accounts it against
+        the query's reservation); `stream_window_waits` counts parts
+        that had to park. Queue items: ("part", payload) in order,
+        then exactly one ("end", None) or ("err", exc)."""
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+        from blaze_tpu.service.wire import ServiceClient
+
+        sock = self._fetch_connect(replica)
+        window: queue.Queue = queue.Queue(maxsize=self.stream_window)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            waited = False
+            while not stop.is_set():
+                try:
+                    window.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    if not waited and item[0] == "part":
+                        waited = True
+                        with self._lock:
+                            self.counters["stream_window_waits"] += 1
+            return False  # consumer gone: drop, reader exits
+
+        def _reader() -> None:
+            try:
+                sock.sendall(_U64.pack(_FLAG_SERVICE))
+                sock.sendall(ServiceClient._id_verb(
+                    VERB_FETCH, internal_id, timeout_ms
+                ))
+                while True:
+                    header = self._recv_checked(
+                        sock, _U64.size, replica
+                    )
+                    (length,) = _U64.unpack(header)
+                    if length == 0:
+                        _put(("end", None))
+                        return
+                    if length == _ERR:
+                        (mlen,) = _U32.unpack(self._recv_checked(
+                            sock, _U32.size, replica
+                        ))
+                        msg = self._recv_checked(
+                            sock, mlen, replica
+                        ).decode("utf-8")
+                        _put(("err", ServiceError(msg)))
+                        return
+                    payload = self._recv_checked(
+                        sock, length, replica
+                    )
+                    if not _put(("part", payload)):
+                        return
+            except BaseException as e:  # noqa: BLE001 - relayed
+                # the consumer re-raises it in stream_parts, where
+                # the failover ladder classifies it; swallowing here
+                # would hang the relay on a dead downstream
+                _put(("err", e))
+
+        reader = threading.Thread(
+            target=_reader, daemon=True,
+            name="blaze-router-stream-reader",
+        )
+        reader.start()
+        try:
+            while True:
+                kind, payload = window.get()
+                if kind == "part":
+                    yield payload
+                elif kind == "end":
+                    return
+                else:
+                    raise payload
+        finally:
+            # generator close (client gone, failover cycle, or clean
+            # end): release the reader - stop flag first so a parked
+            # _put exits, then the socket so a blocked recv does
+            stop.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+            reader.join(timeout=2.0)
 
     def _recv_checked(self, sock, n: int,
                       replica: Replica) -> bytes:
@@ -1935,9 +2066,28 @@ class RouterVerbBackend:
     def fetch(self, sock, qid: str, timeout_ms: int) -> None:
         router = self.router
         sent = 0
+        # slow-consumer protection at the relay: a client that stops
+        # draining for stream_stall_s holds a downstream stream (and
+        # its replica-side buffer bytes) hostage - abort THIS relay
+        # only. The downstream ring keeps the parts; a re-FETCH
+        # resumes. Never a breaker strike: the replica did nothing
+        # wrong, so the abort stays off the failover ladder entirely
+        # (ConnectionError from OUR send is not caught below).
+        stall_s = router.stream_stall_s
+        prev_timeout = sock.gettimeout()
+        if stall_s > 0:
+            sock.settimeout(stall_s)
         try:
             for payload in router.stream_parts(qid, timeout_ms):
-                sock.sendall(_U64.pack(len(payload)) + payload)
+                try:
+                    sock.sendall(_U64.pack(len(payload)) + payload)
+                except (socket.timeout, TimeoutError) as e:
+                    with router._lock:
+                        router.counters["stream_stalls"] += 1
+                    raise ConnectionError(
+                        f"relay send stalled past {stall_s}s "
+                        f"for {qid}"
+                    ) from e
                 sent += 1
             sock.sendall(_U64.pack(0))
         except KeyError:
@@ -1964,6 +2114,12 @@ class RouterVerbBackend:
                 # path: retry with backoff once capacity returns)
                 msg = f"REJECTED_OVERLOADED: {msg}"
             _send_err(sock, msg)
+        finally:
+            if stall_s > 0:
+                try:
+                    sock.settimeout(prev_timeout)
+                except OSError:
+                    pass  # connection already torn down
 
 
 def handle_router_connection(sock, router: Router) -> None:

@@ -237,6 +237,20 @@ class AdmissionController:
             self._reserved[q.query_id] = cur - share
             self.counters["degraded_released"] += 1
 
+    def adjust_reservation(self, q: Query, delta: int) -> None:
+        """Stream-buffer accounting (service/stream.py): pending
+        (produced-but-undelivered) result bytes of an actively-FETCHed
+        query count against its reservation, so a consumer slower than
+        the producer gates new admissions exactly like the device
+        bytes it mirrors. No-op once the query released its slot -
+        post-terminal retention is bounded by the ring's own byte cap,
+        not by admission."""
+        with self._lock:
+            cur = self._reserved.get(q.query_id)
+            if cur is None:
+                return
+            self._reserved[q.query_id] = max(0, cur + int(delta))
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -82,6 +82,11 @@ class ResultCache:
             "spill_errors": 0,
             "restores": 0,
             "puts": 0,
+            # streaming-integrity guard: put(complete=False) attempts
+            # refused - a cache entry must never hold a truncated
+            # prefix of a partition (a later hit would silently serve
+            # a short result)
+            "partial_puts_refused": 0,
             # request coalescing (service/service.py, ROADMAP scan-
             # sharing first step): identical in-flight plans that
             # WAITED on the leader instead of re-executing
@@ -129,9 +134,24 @@ class ResultCache:
             e = self._entries.get(key)
             return e is not None and time.monotonic() < e.expires_at
 
-    def put(self, key: CacheKey, batches: List) -> bool:
+    def put(self, key: CacheKey, batches: List,
+            complete: bool = True) -> bool:
         """Store one partition's materialized batches. Returns False
-        when the entry is larger than the whole cache (never stored)."""
+        when the entry is larger than the whole cache (never stored).
+
+        `complete` is the streaming-integrity contract: entries are
+        finalized only after the partition's LAST part was produced.
+        With incremental FETCH delivery (service/stream.py) parts
+        leave the building while execution is still running - but the
+        cache population point stays after the partition loop drains,
+        so a concurrent probe of an in-progress query MISSES (and
+        coalesces on the leader) rather than ever seeing a truncated
+        prefix. Callers that only hold a partial result must say so;
+        the put is refused and counted, never stored."""
+        if not complete:
+            with self._lock:
+                self.counters["partial_puts_refused"] += 1
+            return False
         nbytes = sum(rb.nbytes for rb in batches)
         if nbytes > self.max_bytes:
             return False

@@ -161,6 +161,11 @@ class Query:
         # reap under an in-progress collection, no matter how slowly
         # the parts pace out relative to the TTL
         self.fetchers = 0
+        # incremental result ring (service/stream.py), service-filled
+        # when streaming is enabled: the executor feeds it as batches
+        # complete and FETCH drains it while the query is RUNNING.
+        # None = pre-streaming materialize-then-stream behavior
+        self.stream = None
 
         self._lock = threading.Lock()
         self._cancel = threading.Event()
@@ -345,6 +350,11 @@ class Query:
             out["execution_s"] = round(t["finished"] - t["run_start"], 6)
         if "stream_ns" in t:
             out["stream_s"] = round(t["stream_ns"] / 1e9, 6)
+        if self.stream is not None and self.stream.consumers_seen:
+            # in-progress stream visibility (POLL while FETCHing):
+            # parts produced vs delivered + the backpressure signal
+            out["stream_parts"] = self.stream.total_parts()
+            out["stream_consumed"] = self.stream.consumed
         for k in ("output_rows", "output_batches", "cache_hits",
                   "cache_misses", "coalesced"):
             if k in m:

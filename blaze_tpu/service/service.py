@@ -78,6 +78,8 @@ class QueryService:
         fold_phases: bool = True,
         mesh_mode: Optional[str] = None,
         orphan_ttl_s: Optional[float] = 900.0,
+        stream_buffer_bytes: int = 32 << 20,
+        stream_stall_s: float = 30.0,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -137,7 +139,21 @@ class QueryService:
             "retried_queries": 0,
             "slow_queries": 0,
             "orphans_reaped": 0,
+            # streaming data plane (service/stream.py): stall aborts
+            # and producer backpressure episodes, aggregated across
+            # per-query ring buffers by _note_stream_event
+            "stream_stalls": 0,
+            "stream_backpressure_waits": 0,
         }
+        # end-to-end streaming (service/stream.py, docs/SERVICE.md):
+        # per-query bounded result rings FETCH drains while RUNNING.
+        # stream_buffer_bytes <= 0 disables streaming (legacy
+        # materialize-then-stream); stream_stall_s bounds how long a
+        # non-draining consumer may pin a full ring before the query
+        # aborts with the classified STREAM_STALLED outcome
+        self.stream_buffer_bytes = int(stream_buffer_bytes)
+        self.stream_stall_s = float(stream_stall_s)
+        self._stream_high_water = 0  # max pending bytes, any query
         # orphan reaping (docs/SERVICE.md): a detach=True query whose
         # ROUTER died holds its result in retention forever - nothing
         # will ever POLL or FETCH it, and _MAX_RETAINED eviction only
@@ -303,11 +319,21 @@ class QueryService:
         draining - the caller decides whether to hard-stop). New
         SUBMITs are refused from the moment this is called; POLL /
         FETCH / CANCEL keep working so clients can collect results
-        already in flight."""
+        already in flight.
+
+        OPEN STREAMS are live work: a query with an in-progress FETCH
+        (fetchers > 0) holds the drain even when it is already
+        terminal, so a rolling restart finishes delivering the parts a
+        client is actively reading instead of severing the stream. A
+        consumer that stops draining cannot pin the drain past the
+        grace - the stream stall budget aborts it, and a grace expiry
+        hands the stream off to the router's journal/failover resume
+        path (the client re-FETCHes the re-placed query and skips the
+        delivered prefix)."""
         self.draining = True
         REGISTRY.inc("blaze_service_drains_total")
         log.info("service draining: refusing new submits, waiting "
-                 "for in-flight queries")
+                 "for in-flight queries and open streams")
         deadline = (
             time.monotonic() + timeout_s
             if timeout_s is not None else None
@@ -315,13 +341,15 @@ class QueryService:
         while True:
             with self._lock:
                 live = sum(
-                    1 for q in self._queries.values() if not q.done
+                    1 for q in self._queries.values()
+                    if not q.done or q.fetchers > 0
                 )
             if not live:
                 return True
             if deadline is not None and time.monotonic() >= deadline:
                 log.warning(
-                    "drain timed out with %d live queries", live
+                    "drain timed out with %d live queries/streams",
+                    live,
                 )
                 return False
             time.sleep(poll_s)
@@ -335,7 +363,32 @@ class QueryService:
             q.ctx.tracer = q.tracer
         if self.mesh_mode is not None:
             q.ctx.mesh_mode = self.mesh_mode
+        if self.stream_buffer_bytes > 0:
+            from blaze_tpu.service.stream import StreamBuffer
+
+            q.stream = StreamBuffer(
+                self.stream_buffer_bytes,
+                self.stream_stall_s,
+                on_pending=(
+                    lambda delta, _q=q:
+                    self.admission.adjust_reservation(_q, delta)
+                ),
+                on_event=self._note_stream_event,
+            )
         q.on_terminal = self._on_query_terminal
+
+    def _note_stream_event(self, name: str, value: int = 1) -> None:
+        """StreamBuffer observability fan-in (per-query rings, one
+        service-level rollup): stall/backpressure counters + the
+        high-water gauge STATS and METRICS expose."""
+        with self._lock:
+            if name == "stall":
+                self.obs_counters["stream_stalls"] += 1
+            elif name == "backpressure_wait":
+                self.obs_counters["stream_backpressure_waits"] += 1
+            elif name == "high_water":
+                if value > self._stream_high_water:
+                    self._stream_high_water = value
 
     def _enqueue(self, q: Query) -> Query:
         self._register(q)
@@ -483,6 +536,15 @@ class QueryService:
                 # reclaimed after this long (null = disabled)
                 "orphan_ttl_s": self.orphan_ttl_s,
             },
+            # streaming data plane (service/stream.py): the ring cap +
+            # stall budget, and the high-water gauge the slow-consumer
+            # acceptance pin asserts against
+            "streaming": {
+                "enabled": self.stream_buffer_bytes > 0,
+                "buffer_bytes": self.stream_buffer_bytes,
+                "stall_s": self.stream_stall_s,
+                "buffer_high_water_bytes": self._stream_high_water,
+            },
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
@@ -512,6 +574,17 @@ class QueryService:
         """Exactly-once per query (Query._fire_terminal): fold the
         outcome into the process metrics registry, the per-fingerprint
         runtime history, and (over threshold) the slow-query log."""
+        if q.stream is not None:
+            # stream finalization rides the exactly-once terminal hook
+            # so EVERY terminal path (run-loop exits, queued cancels,
+            # deadline sweeps, decode failures) resolves the ring:
+            # DONE finishes it (fetchers drain the tail and get the
+            # terminator), anything else aborts it and frees retained
+            # parts - there is no result to collect
+            if q.state is QueryState.DONE:
+                q.stream.finish()
+            else:
+                q.stream.abort(q.state.value)
         t = q.timings
         wall = t.get("finished", time.monotonic()) - t["submitted"]
         REGISTRY.inc("blaze_queries_total", state=q.state.value)
@@ -580,8 +653,21 @@ class QueryService:
                                 c.get(k, 0), "gauge"))
         with self._lock:
             orphans = self.obs_counters["orphans_reaped"]
+            stalls = self.obs_counters["stream_stalls"]
+            bp_waits = self.obs_counters["stream_backpressure_waits"]
+            high_water = self._stream_high_water
         samples.append(("blaze_service_orphans_reaped_total",
                         dict(sid), orphans, "counter"))
+        samples.append(("blaze_service_stream_stalls_total",
+                        dict(sid), stalls, "counter"))
+        samples.append((
+            "blaze_service_stream_backpressure_waits_total",
+            dict(sid), bp_waits, "counter",
+        ))
+        samples.append((
+            "blaze_service_stream_buffer_high_water_bytes",
+            dict(sid), high_water, "gauge",
+        ))
         h = self.history.summary(top=0)
         samples.append(("blaze_runtime_history_fingerprints",
                         dict(sid), h["fingerprints"], "gauge"))
@@ -819,6 +905,15 @@ class QueryService:
                     "user", "shutdown"
                 ):
                     q.try_transition(QueryState.CANCELLED)
+                elif q.cancel_requested and q.cancel_reason == (
+                    "stream_stalled"
+                ):
+                    # slow-consumer abort (service/stream.py): the
+                    # ring already stamped the classified
+                    # STREAM_STALLED error; CANCELLED-class keeps it
+                    # strike-free for replica circuit breakers even
+                    # when a deadline lapsed during the stall wait
+                    q.try_transition(QueryState.CANCELLED)
                 elif q.deadline_exceeded():
                     q.error = "deadline exceeded while running"
                     q.try_transition(QueryState.TIMED_OUT)
@@ -914,6 +1009,11 @@ class QueryService:
                         q.ctx.metrics.add("coalesced", 1)
                     for rb in hit:
                         q.ctx.metrics.add("output_rows", rb.num_rows)
+                        if q.stream is not None:
+                            # cached partitions feed the ring too -
+                            # part order must equal q.result order for
+                            # the delivered-prefix resume contract
+                            q.stream.put(q, rb)
                     out.extend(hit)
                     break
                 # miss: claim leadership of this (fingerprint,
@@ -985,8 +1085,15 @@ class QueryService:
                 q.record_attempt(partition, attempt, ec.value, e,
                                  action)
                 if action == "degrade":
-                    return self._degrade_partition(q, partition, e), \
-                        True
+                    batches = self._degrade_partition(q, partition, e)
+                    if q.stream is not None:
+                        # the failed device attempt's parts were
+                        # rolled back in _drain; the host re-run feeds
+                        # the ring on success (replay-verified against
+                        # any prefix already delivered)
+                        for rb in batches:
+                            q.stream.put(q, rb)
+                    return batches, True
                 if action == "fail":
                     raise
                 q.ctx.metrics.add("task_retries", 1)
@@ -1067,16 +1174,30 @@ class QueryService:
 
         it = execute_partition(op, partition, q.ctx)
         batches: List = []
+        sb = q.stream
+        start_pos = sb.position() if sb is not None else 0
         try:
             for rb in it:
                 batches.append(rb)
+                if sb is not None:
+                    # stream-as-produced: the part is visible to an
+                    # in-progress FETCH the moment the executor yields
+                    # it; put() blocks on the ring's byte cap, so a
+                    # slow consumer backpressures THIS loop instead of
+                    # growing host memory (StreamStalled/QueryCancelled
+                    # propagate through the rollback below)
+                    sb.put(q, rb)
                 if q.cancel_requested or q.deadline_exceeded():
                     it.close()
                     raise QueryCancelled(q.query_id)
         except BaseException:
             # an abandoned attempt's partial output must not stay in
             # the query counters - a retry (or the host degradation)
-            # re-counts the partition from scratch
+            # re-counts the partition from scratch. Same for the ring:
+            # undelivered parts truncate; delivered ones stay and the
+            # retry replays against them (delivered-prefix verify)
+            if sb is not None:
+                sb.rollback(start_pos)
             if batches:
                 q.ctx.metrics.add(
                     "output_rows", -sum(rb.num_rows for rb in batches)

@@ -20,10 +20,21 @@ Service framing (all integers LE):
             meta: {priority, deadline_s, estimated_bytes, use_cache}
             -> JSON frame {query_id, state, ...}
   POLL:     u32 id_len | id   -> JSON frame (Query.status())
-  FETCH:    u32 id_len | id | u32 timeout_ms (0 = wait forever)
-            -> on DONE: segmented-IPC parts (u64 len | zstd Arrow IPC),
-               then u64 0 (the shuffle/gateway wire format, io/ipc.py)
-            -> else: u64 ERR | u32 len | "STATE: detail" utf8
+  FETCH:    u32 id_len | id | u32 timeout_ms
+            -> segmented-IPC parts (u64 len | zstd Arrow IPC) as the
+               executor PRODUCES them - delivery starts while the
+               query is still RUNNING - then u64 0 once the query is
+               DONE and the ring drained (the shuffle/gateway wire
+               format, io/ipc.py)
+            -> u64 ERR | u32 len | "STATE: detail" utf8 when the
+               query is terminal non-DONE before the first part;
+               timeout_ms (0 = wait forever) bounds the wait for the
+               FIRST part. After parts are on the wire a failure
+               aborts the connection (never an in-band frame - it
+               would desync the u64 framing); the client resumes by
+               re-FETCHing and skipping delivered parts. Producer
+               flow control + the slow-consumer stall budget:
+               service/stream.py, docs/SERVICE.md
   CANCEL:   u32 id_len | id   -> JSON frame
   REPORT:   u32 id_len | id | u32 flags -> JSON frame {report: text,
             trace?: Chrome-trace-event JSON, trace_spans?: [span
@@ -320,6 +331,152 @@ class ServiceVerbBackend:
             q.note_activity()
 
     def _fetch_stream(self, sock, q, timeout_ms: int) -> None:
+        sb = getattr(q, "stream", None)
+        if sb is not None:
+            # streaming service (the default): deliver parts as the
+            # executor produces them - FETCH no longer waits for DONE
+            self._fetch_incremental(sock, q, sb, timeout_ms)
+            return
+        self._fetch_materialized(sock, q, timeout_ms)
+
+    def _fetch_incremental(self, sock, q, sb, timeout_ms: int) -> None:
+        """Stream-as-produced FETCH (service/stream.py): drain the
+        query's ring while it is still RUNNING. `timeout_ms` bounds
+        the wait for the FIRST part (time-to-first-byte); once parts
+        flow, production is bounded by the query's own deadline/cancel
+        machinery and delivery by the stall budget. The wire format is
+        UNCHANGED (u64-framed parts, u64 0 terminator, u64 ERR escape
+        before the first part), so clients - and the router relay -
+        need no new protocol: the count-based part-skip resume simply
+        starts working mid-query."""
+        from blaze_tpu.io.ipc import encode_ipc_segment
+
+        service = self.service
+        qid = q.query_id
+        deadline = (
+            time.monotonic() + timeout_ms / 1000.0
+            if timeout_ms else None
+        )
+        sb.attach()
+        t0 = time.perf_counter_ns()
+        stream_start = time.monotonic()
+        sent = 0
+        live_parts = 0  # parts shipped while the query was RUNNING
+        complete = False
+        stall_s = getattr(service, "stream_stall_s", 0.0) or 0.0
+        prev_timeout = sock.gettimeout()
+        if stall_s > 0:
+            # send-side slow-consumer bound: a stalled reader of a
+            # DONE query's stream has no producer left to
+            # backpressure, so the socket send timeout is the stall
+            # budget on this half of the pipe
+            sock.settimeout(stall_s)
+        try:
+            i = 0
+            while True:
+                if sent == 0 and deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        _send_err(
+                            sock, f"{q.state.value}: fetch timed out"
+                        )
+                        return
+                    kind, payload = sb.next_ready(i, min(0.25, rem))
+                else:
+                    kind, payload = sb.next_ready(i, 0.25)
+                if kind == "timeout":
+                    continue
+                if kind == "part":
+                    if chaos.ACTIVE:
+                        # chaos seam: drop/stall mid-result-stream,
+                        # now covering the IN-PROGRESS window (the
+                        # part may ship while the query is RUNNING)
+                        chaos.fire("gateway.stream", query_id=qid,
+                                   partition=i)
+                    if not q.done:
+                        live_parts += 1
+                    # committed-for-delivery BEFORE the send: a part
+                    # on the wire can never be truncated by a retry
+                    # rollback (delivered-prefix consistency)
+                    sb.mark_consumed(i)
+                    try:
+                        sock.sendall(encode_ipc_segment(payload))
+                    except (socket.timeout, TimeoutError) as e:
+                        service._note_stream_event("stall")
+                        raise ConnectionError(
+                            f"fetch send stalled past {stall_s}s"
+                        ) from e
+                    sent += 1
+                    i += 1
+                    # per-part activity: a slow COLLECTING client is
+                    # not a dead router (orphan sweep)
+                    q.note_activity()
+                    continue
+                if kind == "finished":
+                    # the ring finishes at the DONE transition, so
+                    # the terminal state is already set; the
+                    # terminator closes the part stream
+                    sock.sendall(_U64.pack(0))
+                    complete = True
+                    q.fetched = True
+                    return
+                # aborted: terminal (or about to be) with no result.
+                # Parts already on the wire -> a JSON/ERR frame would
+                # desync the u64 framing: abort the connection and
+                # let the client's resume path re-FETCH the
+                # classified outcome. Zero parts -> wait out the tiny
+                # abort->terminal window so the state prefix the
+                # router keys on is the real terminal state, then
+                # answer in-band
+                if sent:
+                    raise ConnectionError(
+                        f"fetch stream aborted: {payload}"
+                    )
+                q.wait(5.0)
+                _send_err(
+                    sock,
+                    f"{q.state.value}: {q.error or 'not completed'}",
+                )
+                return
+        finally:
+            if stall_s > 0:
+                try:
+                    sock.settimeout(prev_timeout)
+                except OSError:
+                    pass
+            stream_s = (time.perf_counter_ns() - t0) / 1e9
+            q.timings["stream_ns"] = (
+                q.timings.get("stream_ns", 0)
+                + (time.perf_counter_ns() - t0)
+            )
+            if complete and getattr(service, "_fold_phases", True):
+                from blaze_tpu.obs import phases as obs_phases
+
+                obs_phases.ROLLUP.observe(
+                    "stream", stream_s,
+                    klass=obs_phases.class_key(
+                        q._fingerprint, q._fingerprint_stable
+                    ),
+                )
+            if obs_trace.ACTIVE \
+                    and getattr(q, "tracer", None) is not None:
+                # the `stream` span now covers the INCREMENTAL window:
+                # it may open while the root span is still live (parts
+                # shipping during RUNNING); live_parts says how much
+                # of the stream overlapped execution
+                tags = {"parts": sent, "total": sb.total_parts(),
+                        "live_parts": live_parts}
+                if not complete:
+                    tags["aborted"] = True
+                q.tracer.record_span(
+                    "result_stream", stream_start, time.monotonic(),
+                    **tags,
+                )
+
+    def _fetch_materialized(self, sock, q, timeout_ms: int) -> None:
+        """Legacy materialize-then-stream FETCH: only reachable when
+        the service runs with streaming disabled
+        (stream_buffer_bytes <= 0)."""
         from blaze_tpu.io.ipc import encode_ipc_segment
         from blaze_tpu.service.query import QueryState
 
@@ -748,6 +905,16 @@ class ServiceClient:
                 msg = _recv_exact(self._sock, mlen).decode("utf-8")
                 raise ServiceError(msg)
             payload = _recv_exact(self._sock, length)
+            if chaos.ACTIVE:
+                # chaos seam `stream.consume`: the CLIENT side of the
+                # pipe - STALL models a slow consumer (the server's
+                # backpressure/stall budget sees it), DROP a consumer
+                # whose connection dies mid-read (the reconnect +
+                # part-skip resume path covers it). Fired after the
+                # payload recv so `part` is the 0-based index of the
+                # part in hand
+                chaos.fire("stream.consume", query_id=query_id,
+                           partition=part)
             part += 1
             if part <= skip:
                 continue  # already delivered; drained, not decoded

@@ -32,7 +32,16 @@ Injection sites (each named in docs/ROBUSTNESS.md):
   h2d.transfer      runtime/pack.py put_packed host->device staging
   kernel.dispatch   every compiled-kernel invocation (dispatch.py)
   device.memory     DeviceMemoryTracker.track (HBM accounting)
-  gateway.stream    per result part in the service FETCH send loop
+  gateway.stream    per result part in the service FETCH send loop -
+                    with incremental delivery (service/stream.py) the
+                    window now covers IN-PROGRESS streams: a fault at
+                    partition k can land while the query is still
+                    RUNNING, not just on a finished result
+  stream.consume    per result part on the CLIENT side of a FETCH
+                    (ServiceClient._fetch_parts, after the part is in
+                    hand): STALL = a slow consumer holding producer
+                    backpressure, DROP = the client connection dying
+                    mid-read (resume/re-FETCH paths)
   cache.spill       ResultCache spill-to-disk write
   cluster.heartbeat worker heartbeat tick (STALL silences liveness)
   service.admit     QueryService._run_query before the RUNNING

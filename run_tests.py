@@ -192,12 +192,25 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
         ["tests/test_chaos.py", "tests/test_service_failures.py",
          "tests/test_cluster_chaos.py", "tests/test_router.py",
          "tests/test_membership.py", "tests/test_churn.py",
-         "tests/test_journal.py",
+         "tests/test_journal.py", "tests/test_stream.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}
             if seed_offset else None
         ),
+    )
+
+
+def stream_smoke() -> bool:
+    """Streaming data-plane suite (ISSUE 14): bounded-ring
+    backpressure + reservation accounting, slow-consumer stall aborts
+    (STREAM_STALLED, CANCELLED-class, never a breaker strike),
+    FETCH-while-RUNNING / double-FETCH / mid-stream resume semantics,
+    the router's windowed zero-copy relay (credit window, mid-stream
+    failover, relay stall budget), and drain-holds-open-streams."""
+    return run(
+        "stream suite",
+        ["tests/test_stream.py"],
     )
 
 
@@ -404,6 +417,11 @@ def main():
                     help="mesh execution tier suite only: forces an "
                          "8-device virtual host mesh itself; skips "
                          "cleanly if jax lacks shard_map")
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming suite only: bounded-ring "
+                         "backpressure, slow-consumer stall aborts, "
+                         "mid-stream resume, and the router's "
+                         "windowed zero-copy relay")
     ap.add_argument("--churn", action="store_true",
                     help="fleet-churn suite only: JOIN/LEAVE "
                          "membership, graceful drain, hot-result "
@@ -424,6 +442,12 @@ def main():
     if args.trace:
         ok &= trace_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (trace) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
+    if args.stream:
+        ok &= stream_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (stream) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1
 
@@ -449,6 +473,7 @@ def main():
         # second probabilistic firing sequence
         ok &= chaos_smoke()
         ok &= chaos_smoke(seed_offset=1)
+        ok &= stream_smoke()
         ok &= churn_smoke()
         ok &= obs_smoke()
         ok &= mesh_smoke()

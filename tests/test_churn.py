@@ -79,6 +79,67 @@ def dataset(tmp_path):
     return blob
 
 
+def scan_blob(tmp_path, rows=120_000, name="stream.parquet"):
+    """Multi-part streaming payload: a plain filter-scan over enough
+    rows that the default batch size yields many result parts - the
+    churn rounds need a stream that is genuinely OPEN for a while."""
+    from blaze_tpu.exprs import Col
+    from blaze_tpu.ops import FilterExec
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+    from blaze_tpu.plan.serde import task_to_proto
+
+    rng = np.random.default_rng(31)
+    p = str(tmp_path / name)
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 100, rows), pa.int32()),
+            "v": pa.array(rng.random(rows), pa.float64()),
+        }),
+        p,
+    )
+    plan = FilterExec(
+        ParquetScanExec([[FileRange(p)]]), Col("v") >= 0.0
+    )
+    return task_to_proto(plan, 0), rows
+
+
+def test_inprocess_drain_during_open_stream_is_client_invisible(
+    tmp_path,
+):
+    """ISSUE 14 drain integration: SIGTERM-style drain of the replica
+    that is actively streaming a multi-part result holds for the open
+    stream - the client reads every part, the table is complete, and
+    the drain then finishes cleanly. Zero client-visible failures."""
+    blob, rows = scan_blob(tmp_path)
+    with Fleet() as fl:
+        fl.router.registry.start()
+        with RouterServer(fl.router) as rs:
+            with ServiceClient(*rs.address, timeout=60.0) as c:
+                st = c.submit(blob)
+                qid = st["query_id"]
+                owner = fl.router.get(qid).replica_id
+                svc = fl.by_id[owner][0]
+                parts = []
+                drained = []
+                td = None
+                for rb in c.fetch_stream(qid):
+                    parts.append(rb)
+                    if td is None:
+                        # first part in hand: drain the replica NOW,
+                        # mid-stream
+                        td = threading.Thread(
+                            target=lambda: drained.append(
+                                svc.drain(timeout_s=60)
+                            )
+                        )
+                        td.start()
+                    time.sleep(0.02)  # keep the stream open a while
+                td.join(60)
+                assert drained == [True]
+                assert len(parts) > 1
+                assert sum(rb.num_rows for rb in parts) == rows
+
+
 def test_inprocess_rolling_drain_is_client_invisible(dataset):
     """Drain each replica in turn (drain -> LEAVE -> a replacement
     JOINs) while a repeated-query mix runs through the router: every
@@ -271,13 +332,21 @@ def _stats(client: ServiceClient) -> dict:
 
 
 @pytest.mark.slow
-def test_e2e_rolling_restart_and_hot_kill_acceptance(dataset):
+def test_e2e_rolling_restart_and_hot_kill_acceptance(
+    dataset, tmp_path
+):
     """ISSUE 9 acceptance, end to end: SIGTERM-drain each of 3 serve
     replicas in turn while a repeated-query mix runs through the
     route CLI - zero client-visible failures, drained replicas rejoin
     via JOIN - then SIGKILL the affinity home of a hot fingerprint
     and assert its repeat serves warm (0 dispatches) from the
-    survivor holding the replicated result."""
+    survivor holding the replicated result.
+
+    ISSUE 14 grows the rolling leg a mid-stream round: each SIGTERM
+    lands while a slow consumer is reading a multi-part stream
+    through the router - the drain holds for the open stream (or the
+    journal/failover resume re-places it) and the stream completes
+    byte-complete, zero client-visible failures."""
     rproc, rhost, rport = _spawn(
         ["route", "--port", "0",
          "--poll-interval", "0.1", "--heartbeat-timeout", "0.8",
@@ -335,10 +404,41 @@ def test_e2e_rolling_restart_and_hot_kill_acceptance(dataset):
             t.start()
             # warm-up: every blob executed at least twice fleet-wide
             assert wait_for(lambda: completed[0] >= 4, timeout=120)
+            sblob, srows = scan_blob(tmp_path, rows=200_000)
             # --- rolling restart leg ------------------------------
             for port in ports:
+                # mid-stream round: open a slow multi-part stream
+                # through the router, then SIGTERM while it is live
+                stream_err = []
+                stream_rows = [0]
+                stream_open = threading.Event()
+
+                def slow_stream():
+                    try:
+                        with ServiceClient(rhost, rport,
+                                           timeout=300.0,
+                                           reconnect_attempts=8
+                                           ) as sc:
+                            sst = sc.submit(sblob)
+                            for rb in sc.fetch_stream(
+                                sst["query_id"]
+                            ):
+                                stream_rows[0] += rb.num_rows
+                                stream_open.set()
+                                time.sleep(0.05)
+                    except Exception as e:  # noqa: BLE001 - the pin
+                        stream_err.append(repr(e))
+
+                ts = threading.Thread(target=slow_stream,
+                                      daemon=True)
+                ts.start()
+                assert stream_open.wait(120)
                 old = serves[port]
                 old.terminate()  # SIGTERM -> drain -> LEAVE -> exit
+                ts.join(timeout=240)
+                assert not ts.is_alive()
+                assert stream_err == [], stream_err
+                assert stream_rows[0] == srows
                 old.wait(timeout=120)
                 assert wait_for(
                     lambda: _stats(c).get("fleet", {})

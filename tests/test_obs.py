@@ -388,6 +388,15 @@ def test_queued_deadline_timeout_snapshots_error(two_part_plan,
             with QueryService(max_concurrency=1,
                               slow_query_s=1e-6) as svc:
                 blocker = svc.submit_plan(two_part_plan())
+                # the blocker must HOLD the single slot (stalled at
+                # the service.admit seam) before the deadlined query
+                # is enqueued, else the dispatcher may admit the
+                # deadlined query first and it times out "before
+                # start" instead of "while queued"
+                assert wait_for(
+                    lambda: blocker.state.value != "QUEUED",
+                    timeout=20,
+                )
                 q = svc.submit_plan(two_part_plan(), deadline_s=0.15)
                 assert wait_for(lambda: q.done, timeout=20)
                 assert q.state.value == "TIMED_OUT"

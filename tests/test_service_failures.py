@@ -297,7 +297,13 @@ def test_server_drop_midstream_reconnect_refetch(parquet_blob):
     """ISSUE 3 satellite: a server-side connection drop mid-FETCH is
     healed by ServiceClient's reconnect-with-backoff - it re-attaches
     by query_id, re-issues FETCH, skips already-delivered parts, and
-    the assembled result has no gaps and no duplicates."""
+    the assembled result has no gaps and no duplicates. detach=True:
+    with the streaming FETCH path parts ship while the query is still
+    RUNNING, so the drop now lands mid-execution - an ATTACHED query
+    would be cancelled by the server's session teardown (by design:
+    cancel-on-disconnect protects admission slots), and re-attach
+    across connection loss is exactly what detach is for (the router
+    submits downstream with detach=True for the same reason)."""
     with QueryService(max_concurrency=2, enable_cache=False) as svc:
         with TaskGatewayServer(service=svc) as srv:
             with ServiceClient(*srv.address) as c:
@@ -309,7 +315,7 @@ def test_server_drop_midstream_reconnect_refetch(parquet_blob):
                 seed=7,
             ) as plan:
                 with ServiceClient(*srv.address) as c2:
-                    st = c2.submit(parquet_blob)
+                    st = c2.submit(parquet_blob, detach=True)
                     got = list(c2.fetch_stream(st["query_id"]))
                 assert plan.fired("gateway.stream") == 1
     tb = pa.Table.from_batches(baseline).to_pydict()
@@ -571,3 +577,57 @@ def test_fetch_guard_counter_survives_concurrent_fetches():
     finally:
         sys.setswitchinterval(old)
     assert q.fetchers == 0
+
+
+def test_result_cache_refuses_partial_entries():
+    """ISSUE 14 satellite: with incremental delivery, parts leave the
+    building while execution is still running - the ResultCache must
+    finalize an entry only after the LAST part was produced. A
+    partial put is refused and counted; a probe of the key stays a
+    clean miss, never a truncated prefix."""
+    from blaze_tpu.service import ResultCache
+
+    rc = ResultCache(max_bytes=1 << 20, ttl_s=60.0)
+    try:
+        rbs = [
+            pa.record_batch([pa.array([1, 2, 3])], names=["a"]),
+            pa.record_batch([pa.array([4, 5, 6])], names=["a"]),
+        ]
+        key = ("fp-stream", 0)
+        assert rc.put(key, rbs[:1], complete=False) is False
+        assert rc.counters["partial_puts_refused"] == 1
+        assert rc.get(key) is None  # miss, not a 1-of-2 prefix
+        assert rc.put(key, rbs, complete=True) is True
+        assert len(rc.get(key)) == 2
+    finally:
+        rc.close()
+
+
+def test_cache_probe_mid_stream_misses_then_hits(parquet_blob):
+    """Integration half of the same satellite: while a query's parts
+    are mid-flight the cache has no entry for its fingerprint (a
+    concurrent identical submit coalesces on the leader instead);
+    after the stream's last part the entry appears complete."""
+    with QueryService(max_concurrency=2) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                st = c.submit(parquet_blob, detach=True)
+                qid = st["query_id"]
+                it = c.fetch_stream(qid)
+                first = next(it)  # stream opened, parts in flight
+                assert first.num_rows > 0
+                q = svc.get(qid)
+                if not q.done:
+                    # mid-stream probe: nothing cached yet for an
+                    # in-progress partition set
+                    assert svc.cache.stats()["entries"] == 0
+                rest = list(it)
+            assert wait_for(
+                lambda: svc.cache.stats()["entries"] > 0
+            )
+            with ServiceClient(*srv.address) as c2:
+                again = c2.run(parquet_blob)
+    t1 = pa.Table.from_batches([first] + rest)
+    t2 = pa.Table.from_batches(again)
+    assert t1.equals(t2)
+    assert svc.cache.counters["hits"] >= 1

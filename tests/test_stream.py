@@ -1,0 +1,551 @@
+"""End-to-end streaming tests (ISSUE 14 tentpole): incremental
+FETCH-while-RUNNING delivery through the bounded per-query ring
+(service/stream.py), producer backpressure against the byte cap,
+STREAM_STALLED slow-consumer aborts (CANCELLED-class, never a breaker
+strike), resume / double-FETCH byte consistency, drain integration,
+and the router's windowed credit relay."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.config import EngineConfig, set_config
+from blaze_tpu.errors import ErrorClass, classify
+from blaze_tpu.exprs import Col
+from blaze_tpu.ops import FilterExec, MemoryScanExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.router import Router, RouterServer
+from blaze_tpu.router.failover import failover_action
+from blaze_tpu.router.proxy import RouterVerbBackend
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService, QueryState, ServiceClient
+from blaze_tpu.service.stream import (
+    StreamBuffer,
+    StreamSpliceError,
+    StreamStalled,
+)
+from blaze_tpu.service.wire import ServiceError
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_router import Fleet, wait_done
+from tests.test_service import GatedScan, wait_for
+
+
+class GatedBatches(MemoryScanExec):
+    """Deterministic multi-batch producer: holds at the start gate,
+    then yields its fixed batches in order - the streaming tests'
+    knob for 'execution is provably in progress when X happens'."""
+
+    def __init__(self, batches, start=None):
+        super().__init__([list(batches)], batches[0].schema)
+        self.start_gate = start
+
+    def execute(self, partition, ctx):
+        if self.start_gate is not None:
+            self.start_gate.wait(10)
+        yield from self.partitions[0]
+
+
+def int_batches(n=6, rows=20_000):
+    return [
+        ColumnBatch.from_pydict(
+            {"a": np.arange(i * rows, (i + 1) * rows, dtype=np.int64)}
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture
+def parquet_blob(tmp_path):
+    """Serializable multi-part plan: small batch_size so one file
+    becomes many stream parts."""
+    set_config(EngineConfig(batch_size=512))
+    rng = np.random.default_rng(19)
+    p = str(tmp_path / "s.parquet")
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 50, 20_000), pa.int32()),
+            "v": pa.array(rng.random(20_000), pa.float64()),
+        }),
+        p,
+    )
+    plan = FilterExec(
+        ParquetScanExec([[FileRange(p)]]), Col("v") >= 0.0
+    )
+    yield task_to_proto(plan, 0)
+    set_config(EngineConfig())
+
+
+# ---------------------------------------------------------------------------
+# ring unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_ring_fills_while_running_without_consumer():
+    """No consumer attached = legacy behavior: the producer never
+    blocks and parts accumulate for a later FETCH."""
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      stream_buffer_bytes=1_000) as svc:
+        # cap (1KB) far below total batch bytes: only an attached
+        # consumer may gate the producer, never result() callers
+        q = svc.submit_plan(
+            MemoryScanExec([int_batches(4)], int_batches(1)[0].schema)
+        )
+        batches = svc.result(q.query_id, timeout=30)
+        assert sum(rb.num_rows for rb in batches) == 4 * 20_000
+        assert q.stream.finished
+        assert q.stream.total_parts() == 4
+        assert q.stream.backpressure_waits == 0
+
+
+def test_backpressure_pins_high_water_at_cap():
+    """An attached consumer slower than the producer parks the
+    producer at the byte cap: buffered bytes never exceed
+    cap-plus-one-part, and the wait is counted."""
+    batches = int_batches(6)
+    # each part materializes as ~20k int64 rows ~= 160KB of Arrow;
+    # the cap leaves room for one part, never two
+    part_bytes = 20_000 * 8
+    cap = int(part_bytes * 1.25)
+    start = threading.Event()
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      stream_buffer_bytes=cap,
+                      stream_stall_s=30.0) as svc:
+        q = svc.submit_plan(GatedBatches(batches, start=start),
+                            use_cache=False)
+        sb = q.stream
+        sb.attach()
+        start.set()
+        assert wait_for(lambda: sb.backpressure_waits > 0)
+        assert not q.done  # producer parked mid-execution
+        got = []
+        i = 0
+        while True:
+            kind, payload = sb.next_ready(i, timeout=5.0)
+            if kind == "part":
+                got.append(payload)
+                sb.mark_consumed(i)
+                i += 1
+            elif kind == "finished":
+                break
+            else:
+                raise AssertionError(f"unexpected {kind}: {payload}")
+        assert len(got) == 6
+        assert wait_for(lambda: q.state is QueryState.DONE)
+        assert sb.high_water <= cap + 2 * part_bytes
+        assert svc.obs_counters["stream_backpressure_waits"] > 0
+        st = svc.stats()["streaming"]
+        assert st["enabled"] and st["buffer_high_water_bytes"] > 0
+        # ring drained + slot released: nothing left reserved
+        assert wait_for(
+            lambda: svc.admission.stats()["reserved_bytes"] == 0
+        )
+
+
+def test_stalled_consumer_aborts_stream_stalled():
+    """A consumer that attaches and then stops draining past the
+    stall budget gets the query aborted STREAM_STALLED: CANCELLED
+    terminal, preset classified error, ring and reservation freed."""
+    batches = int_batches(6)
+    part_bytes = 20_000 * 8
+    cap = part_bytes + 1_000
+    start = threading.Event()
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      stream_buffer_bytes=cap,
+                      stream_stall_s=0.4) as svc:
+        q = svc.submit_plan(GatedBatches(batches, start=start),
+                            use_cache=False)
+        sb = q.stream
+        sb.attach()
+        start.set()
+        kind, _ = sb.next_ready(0, timeout=5.0)
+        assert kind == "part"
+        sb.mark_consumed(0)
+        # ... and never ask for another: the producer parks at the
+        # cap, waits out the 0.4s budget, and aborts
+        assert wait_for(lambda: q.done, timeout=10.0)
+        assert q.state is QueryState.CANCELLED
+        assert q.error.startswith("STREAM_STALLED")
+        assert q.error_class == ErrorClass.CANCELLED.value
+        assert sb.aborted == "STREAM_STALLED"
+        assert sb.pending_bytes == 0
+        assert svc.obs_counters["stream_stalls"] >= 1
+        assert sb.high_water <= cap + 2 * part_bytes
+        assert wait_for(
+            lambda: svc.admission.stats()["reserved_bytes"] == 0
+        )
+
+
+def test_stream_stalled_is_never_a_breaker_strike():
+    """Taxonomy pin: STREAM_STALLED is CANCELLED-class, and the
+    router failover ladder surfaces CANCELLED instead of striking the
+    replica's breaker - a slow CLIENT must never quarantine a healthy
+    replica."""
+    exc = StreamStalled("q-1")
+    assert classify(exc) is ErrorClass.CANCELLED
+    assert failover_action(ErrorClass.CANCELLED.value) == "surface"
+    # splice divergence is the client's plan problem, also no strike
+    assert classify(StreamSpliceError("x")) is ErrorClass.PLAN_INVALID
+    assert failover_action(ErrorClass.PLAN_INVALID.value) == "surface"
+
+
+def test_rollback_preserves_delivered_prefix_and_replay_verifies():
+    """A failed attempt truncates only UNDELIVERED parts; the retry
+    replays the delivered prefix and must match byte-for-byte."""
+
+    class Q:
+        cancel_requested = False
+
+        @staticmethod
+        def deadline_exceeded():
+            return False
+
+        @staticmethod
+        def request_cancel(reason=None):
+            pass
+
+    rbs = [
+        pa.record_batch([pa.array([i, i + 1])], names=["a"])
+        for i in range(4)
+    ]
+    sb = StreamBuffer(1 << 20, 30.0)
+    sb.attach()
+    sb.put(Q, rbs[0])
+    sb.put(Q, rbs[1])
+    sb.mark_consumed(0)  # part 0 delivered - the floor
+    sb.rollback(0)       # attempt failed: truncate undelivered
+    assert sb.total_parts() == 1 and sb.consumed == 1
+    sb.put(Q, rbs[0])    # replay verifies against delivered prefix
+    sb.put(Q, rbs[2])    # then extends
+    assert sb.total_parts() == 2
+    # divergence on the delivered prefix is a splice break
+    sb.rollback(0)
+    with pytest.raises(StreamSpliceError):
+        sb.put(Q, rbs[3])
+    assert sb.aborted == "SPLICE_BROKEN"
+
+
+# ---------------------------------------------------------------------------
+# wire tier: FETCH-while-RUNNING
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_delivers_first_part_while_running():
+    """The tentpole: a FETCH issued against a RUNNING query starts
+    yielding parts before execution finishes."""
+    release = threading.Event()
+    plan = GatedScan(release)
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            q = svc.submit_plan(plan, use_cache=False)
+            assert wait_for(plan.started.wait, timeout=5.0)
+            with ServiceClient(*srv.address) as c:
+                it = c.fetch_stream(q.query_id)
+                first = next(it)
+                # the part is in hand and the query is still running
+                assert first.num_rows >= 1
+                assert not q.done
+                assert q.state is QueryState.RUNNING
+                release.set()
+                rest = list(it)
+            assert wait_for(lambda: q.state is QueryState.DONE)
+            assert len(rest) + 1 == q.stream.total_parts()
+            # live_parts made it onto the stream span's tags
+            assert q.stream.consumed == q.stream.total_parts()
+
+
+def test_double_fetch_of_live_stream_byte_identical():
+    """Two concurrent FETCHes of one in-progress stream each get the
+    complete part sequence: the ring retains consumed parts (it IS
+    the resume source), so a second consumer starts from part 0."""
+    release = threading.Event()
+    plan = GatedScan(release)
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            q = svc.submit_plan(plan, use_cache=False)
+            assert wait_for(plan.started.wait, timeout=5.0)
+            got = {}
+
+            def fetch(name, first_seen):
+                with ServiceClient(*srv.address) as c:
+                    parts = []
+                    for rb in c.fetch_stream(q.query_id):
+                        parts.append(rb)
+                        if len(parts) == 1:
+                            first_seen.set()
+                    got[name] = parts
+
+            seen_a, seen_b = threading.Event(), threading.Event()
+            ta = threading.Thread(target=fetch, args=("a", seen_a))
+            tb = threading.Thread(target=fetch, args=("b", seen_b))
+            ta.start()
+            assert seen_a.wait(5.0)  # a is mid-stream...
+            tb.start()               # ...when b attaches
+            assert seen_b.wait(5.0)
+            release.set()
+            ta.join(10)
+            tb.join(10)
+            assert not ta.is_alive() and not tb.is_alive()
+    ta_tbl = pa.Table.from_batches(got["a"])
+    tb_tbl = pa.Table.from_batches(got["b"])
+    assert ta_tbl.equals(tb_tbl)
+    assert len(got["a"]) == len(got["b"])
+
+
+def test_attached_disconnect_mid_stream_cancels_and_frees(
+    parquet_blob,
+):
+    """Session semantics over an in-progress stream: the client
+    vanishing mid-FETCH of an ATTACHED query fires cancel-on-
+    disconnect - the execution stops, the ring is freed, and the
+    admission reservation returns to zero."""
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      stream_buffer_bytes=16_000,
+                      stream_stall_s=30.0) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            c = ServiceClient(*srv.address)
+            st = c.submit(parquet_blob)  # attached
+            qid = st["query_id"]
+            it = c.fetch_stream(qid)
+            next(it)  # one part in hand, producer parked at the cap
+            q = svc.get(qid)
+            assert not q.done
+            c.close()  # vanish mid-stream
+            assert wait_for(lambda: q.done, timeout=10.0)
+            assert q.state is QueryState.CANCELLED
+            assert q.stream.pending_bytes == 0
+            assert q.stream.aborted is not None
+            assert wait_for(
+                lambda: svc.admission.stats()["reserved_bytes"] == 0
+            )
+
+
+def test_orphan_reap_with_partially_delivered_stream(parquet_blob):
+    """serve --orphan-ttl: a detached query whose consumer read a
+    part prefix and vanished is still an orphan once terminal and
+    idle - the sweep reaps it and a late FETCH answers classified
+    UNKNOWN, never a hang or a truncated stream."""
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      orphan_ttl_s=0.3) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                st = c.submit(parquet_blob, detach=True)
+                qid = st["query_id"]
+                it = c.fetch_stream(qid)
+                next(it)  # partial delivery, then abandon
+                it.close()
+            q = svc.get(qid)
+            assert wait_for(lambda: q.done, timeout=10.0)
+            assert not q.fetched  # the stream never completed
+            assert wait_for(
+                lambda: svc.obs_counters["orphans_reaped"] >= 1,
+                timeout=10.0,
+            )
+            with ServiceClient(*srv.address) as c2:
+                with pytest.raises(ServiceError) as ei:
+                    c2.fetch(qid)
+            assert ei.value.state == "UNKNOWN"
+
+
+def test_drain_waits_for_open_stream(parquet_blob):
+    """Rolling-restart contract: a drain with an open in-progress
+    stream holds until the consumer finishes pulling parts, then
+    completes - the stream is never severed by the drain itself."""
+    release = threading.Event()
+    plan = GatedScan(release)
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            q = svc.submit_plan(plan, use_cache=False)
+            assert wait_for(plan.started.wait, timeout=5.0)
+            parts = []
+            mid_stream = threading.Event()
+
+            def consume():
+                with ServiceClient(*srv.address) as c:
+                    for rb in c.fetch_stream(q.query_id):
+                        parts.append(rb)
+                        mid_stream.set()
+
+            tc = threading.Thread(target=consume)
+            tc.start()
+            assert mid_stream.wait(5.0)
+            drained = []
+            td = threading.Thread(
+                target=lambda: drained.append(
+                    svc.drain(timeout_s=15.0)
+                )
+            )
+            td.start()
+            time.sleep(0.3)
+            # stream still open: the drain must be holding
+            assert td.is_alive() and not drained
+            # ... and refusing new submits while it holds
+            q2 = svc.submit_plan(GatedScan(threading.Event()))
+            assert q2.state is QueryState.REJECTED_OVERLOADED
+            assert q2.error.startswith("DRAINING")
+            release.set()
+            tc.join(10)
+            td.join(15)
+            assert drained == [True]
+            assert len(parts) == q.stream.total_parts()
+
+
+# ---------------------------------------------------------------------------
+# chaos seams
+# ---------------------------------------------------------------------------
+
+
+def test_stream_consume_drop_resumes_byte_identical(parquet_blob):
+    """stream.consume DROP: the CLIENT connection dies after part 3
+    is in hand; reconnect + re-FETCH resumes from the delivered
+    prefix and the assembled table matches a clean run exactly."""
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                baseline = pa.Table.from_batches(c.run(parquet_blob))
+            with chaos.active(
+                [Fault("stream.consume", klass="DROP",
+                       partition=3, times=1)],
+                seed=11,
+            ) as plan:
+                with ServiceClient(*srv.address) as c2:
+                    st = c2.submit(parquet_blob, detach=True)
+                    got = pa.Table.from_batches(
+                        list(c2.fetch_stream(st["query_id"]))
+                    )
+                assert plan.fired("stream.consume") == 1
+    assert got.equals(baseline)
+
+
+def test_stream_consume_stall_slows_but_completes(parquet_blob):
+    """stream.consume STALL: a slow consumer (well inside the stall
+    budget) only delays delivery - same bytes, stream completes."""
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      stream_stall_s=30.0) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                baseline = pa.Table.from_batches(c.run(parquet_blob))
+            with chaos.active(
+                [Fault("stream.consume", klass="STALL",
+                       stall_s=0.05, times=3)],
+                seed=5,
+            ) as plan:
+                with ServiceClient(*srv.address) as c2:
+                    got = pa.Table.from_batches(c2.run(parquet_blob))
+                assert plan.fired("stream.consume") == 3
+    assert got.equals(baseline)
+
+
+# ---------------------------------------------------------------------------
+# router tier: windowed credit relay
+# ---------------------------------------------------------------------------
+
+
+def router_dataset(tmp_path):
+    rng = np.random.default_rng(29)
+    p = str(tmp_path / "r.parquet")
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 40, 12_000), pa.int32()),
+            "v": pa.array(rng.random(12_000), pa.float64()),
+        }),
+        p,
+    )
+    plan = FilterExec(
+        ParquetScanExec([[FileRange(p)]]), Col("v") >= 0.0
+    )
+    return task_to_proto(plan, 0)
+
+
+def test_router_windowed_relay_byte_identical(tmp_path):
+    """The windowed relay forwards the same raw part bytes the
+    replica produced: a table fetched through the router equals one
+    fetched directly, and the streaming knobs surface in stats."""
+    set_config(EngineConfig(batch_size=512))
+    try:
+        blob = router_dataset(tmp_path)
+        with Fleet(router_kw={"stream_window": 3}) as fl:
+            with RouterServer(fl.router) as rs:
+                with ServiceClient(*rs.address) as c:
+                    got = pa.Table.from_batches(c.run(blob))
+            direct_svc, direct_srv = fl.by_id[fl.specs[0]]
+            with ServiceClient(*direct_srv.address) as c:
+                direct = pa.Table.from_batches(c.run(blob))
+            st = fl.router.stats()["router"]
+            assert st["streaming"]["window"] == 3
+            assert "stream_window_waits" in st
+        assert got.equals(direct)
+    finally:
+        set_config(EngineConfig())
+
+
+def test_router_relay_survives_replica_drop_mid_stream(tmp_path):
+    """gateway.stream DROP during the router's downstream FETCH: the
+    windowed reader surfaces the transport error, the ladder re-
+    FETCHes (replica still routable), and the client's table is
+    byte-complete with the delivered prefix verified."""
+    set_config(EngineConfig(batch_size=512))
+    try:
+        blob = router_dataset(tmp_path)
+        with Fleet(router_kw={"stream_window": 4}) as fl:
+            with RouterServer(fl.router) as rs:
+                with ServiceClient(*rs.address) as c:
+                    baseline = pa.Table.from_batches(c.run(blob))
+                with chaos.active(
+                    [Fault("gateway.stream", klass="DROP",
+                           partition=1, times=1)],
+                    seed=13,
+                ) as plan:
+                    with ServiceClient(*rs.address) as c2:
+                        st = c2.submit(blob)
+                        got = pa.Table.from_batches(
+                            c2.fetch(st["query_id"])
+                        )
+                    assert plan.fired("gateway.stream") == 1
+        assert got.equals(baseline)
+    finally:
+        set_config(EngineConfig())
+
+
+def test_router_relay_stall_budget_aborts_slow_client():
+    """RouterVerbBackend.fetch: a client that stops accepting bytes
+    past stream_stall_s gets the relay aborted with a counted stall
+    and a ConnectionError (connection teardown, no ERR frame, no
+    breaker involvement)."""
+    router = Router([], start=False, stream_stall_s=0.4)
+    try:
+        payload = b"\x00" * (1 << 20)
+        router.stream_parts = (
+            lambda qid, timeout_ms: iter([payload] * 64)
+        )
+        backend = RouterVerbBackend(router)
+        s_srv, s_cli = socket.socketpair()
+        s_srv.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDBUF, 16_384
+        )
+        errs = []
+
+        def run():
+            try:
+                backend.fetch(s_srv, "q-stall", 0)
+            except Exception as e:  # noqa: BLE001 - under test
+                errs.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=10.0)  # never read from s_cli
+        assert not t.is_alive()
+        assert errs and isinstance(errs[0], ConnectionError)
+        assert "stalled" in str(errs[0])
+        assert router.counters["stream_stalls"] == 1
+        s_srv.close()
+        s_cli.close()
+    finally:
+        router.close()
