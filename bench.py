@@ -1073,12 +1073,16 @@ def child(n_rows):
         e2e_counts = {}
 
     # ---- observability overhead (ISSUE 4 satellite): the same
-    # battery shape measured obs-off and obs-ON (tracing enabled,
-    # recorder installed, every seam recording spans), so the perf
-    # trajectory records what the tracing layer costs. `median` is
-    # the obs-on number; overhead_pct is the on/off delta. ----
+    # battery shape measured obs-off and obs-ON, so the perf
+    # trajectory records what the obs layer costs. Obs-on now means
+    # the FULL stack: tracing + the terminal-hook phase fold +
+    # lock-wait accounting + the stack sampler running at its
+    # serving default (ISSUE 15) - the <3% smoke pin prices all of
+    # it. `median` is the obs-on number; overhead_pct the delta. ----
     try:
+        from blaze_tpu.obs import contention as obs_contention
         from blaze_tpu.obs import phases as obs_phases
+        from blaze_tpu.obs import sampler as obs_sampler
         from blaze_tpu.obs import trace as obs_trace
 
         g = queries["grouped_agg"]["engine"]
@@ -1101,9 +1105,13 @@ def child(n_rows):
             return out
 
         obs_trace.enable()
+        obs_contention.enable()
+        obs_sampler.start(hz=67.0)
         try:
             on_med, on_spread, _, _ = timed(traced)
         finally:
+            obs_sampler.stop()
+            obs_contention.disable()
             obs_trace.disable()
         detail["obs_overhead"] = {
             "median": round(on_med, 4),
@@ -1266,10 +1274,18 @@ def child(n_rows):
             if errs:
                 raise RuntimeError(errs[0])
 
+        from blaze_tpu.obs import contention as svc_contention
+
         for cache_on in (True, False):
             svc = QueryService(
                 max_concurrency=16, enable_cache=cache_on
             )
+            # lock-wait accounting rides the CACHED pass (the c16
+            # collapse case, ISSUE 15): each concurrency entry
+            # carries its own window's top blocking locks, so the
+            # artifact attributes the qps curve, not just plots it
+            if cache_on:
+                svc_contention.enable()
             try:
                 with TaskGatewayServer(service=svc) as srv:
                     host, port = srv.address
@@ -1279,6 +1295,8 @@ def child(n_rows):
                             f"{'cache' if cache_on else 'nocache'}"
                         )
                         try:
+                            if cache_on:
+                                svc_contention.reset_stats()
                             med, spread, k, _ = timed(
                                 lambda: service_round(
                                     host, port, conc
@@ -1296,6 +1314,10 @@ def child(n_rows):
                                 "result_cache": cache_on,
                                 "rows_per_query": n_svc,
                             }
+                            if cache_on:
+                                detail[name]["contention"] = (
+                                    svc_contention.top_locks(3)
+                                )
                         except Exception as e:  # noqa: BLE001
                             detail[name] = {
                                 "error":
@@ -1310,6 +1332,8 @@ def child(n_rows):
                             flush=True,
                         )
             finally:
+                if cache_on:
+                    svc_contention.disable()
                 svc.close()
     except Exception as e:  # noqa: BLE001 - the battery must survive
         detail["service_qps"] = {

@@ -24,6 +24,15 @@
                   distributed query step on an n-device virtual CPU
                   mesh and emit the MULTICHIP_r*.json shape
                   ({n_devices, rc, ok, skipped, tail})
+  profile         contention profiler (obs/contention.py + sampler.py):
+                  drive the serving workload at increasing concurrency
+                  with lock-wait accounting + the stack sampler hot,
+                  and emit one JSON report attributing where the c16
+                  collapse goes (top blocking locks with wait:hold
+                  ratios, top sampled stacks per thread role, per-verb
+                  wire latencies) - in-process by default, or against
+                  a live serve/route via --host/--port and the
+                  PROFILE verb
   regress         per-phase regression check (obs/phases.py): run the
                   fixed probe workload and diff its per-phase p50s
                   against a checked-in baseline (--against), emit a
@@ -135,6 +144,14 @@ def cmd_serve(args) -> int:
         stream_buffer_bytes=args.stream_buffer_bytes,
         stream_stall_s=args.stream_stall_s,
     )
+    if args.profile_hz > 0:
+        # whole-lifetime profiling: contention accounting + stack
+        # sampler armed for the process (the PROFILE verb can also
+        # arm a running tier without this flag)
+        from blaze_tpu.obs import contention, sampler
+
+        contention.enable()
+        sampler.start(hz=args.profile_hz)
     # serve_blocking (NOT start()): the main thread is the only
     # accept loop - see TaskGatewayServer.serve_blocking
     srv = TaskGatewayServer(args.host, args.port, service=service)
@@ -244,6 +261,12 @@ def cmd_metrics(args) -> int:
 def cmd_route(args) -> int:
     from blaze_tpu.router.proxy import route_forever
 
+    if args.profile_hz > 0:
+        from blaze_tpu.obs import contention, sampler
+
+        contention.enable()
+        sampler.start(hz=args.profile_hz)
+
     # --replica is only a BOOTSTRAP hint since the JOIN/LEAVE
     # protocol landed: an empty router waits for replicas to announce
     # themselves (serve --router HOST:PORT)
@@ -345,6 +368,221 @@ def cmd_mesh_dryrun(args) -> int:
                    tail=f"mesh dryrun timed out after "
                         f"{args.timeout:.0f}s\n")
     return emit()
+
+
+def cmd_profile(args) -> int:
+    """Contention profiler: drive the serving workload at each
+    --concurrency level with lock-wait accounting + the stack sampler
+    hot, and emit ONE JSON report attributing where the time goes -
+    top blocking locks with wait:hold ratios, top sampled stacks per
+    thread role, per-verb wire latencies. This is the artifact the
+    ROADMAP item-2 wire-loop refactor is judged against."""
+    import os
+    import statistics
+    import tempfile
+    import threading
+    import time
+
+    from blaze_tpu.service.wire import ServiceClient
+
+    levels = [max(1, int(tok)) for tok in
+              str(args.concurrency).split(",") if tok.strip()]
+    if not levels:
+        print("profile: empty --concurrency list", file=sys.stderr)
+        return 2
+
+    def workload_blob(rows: int) -> bytes:
+        # the phase probe's keyless-aggregate shape (obs/phases.py):
+        # cheap kernel, so the levels measure SERVING contention,
+        # not XLA compilation
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from blaze_tpu.exprs import AggExpr, AggFn, Col
+        from blaze_tpu.ops import (
+            AggMode,
+            FilterExec,
+            HashAggregateExec,
+        )
+        from blaze_tpu.ops.parquet_scan import (
+            FileRange,
+            ParquetScanExec,
+        )
+        from blaze_tpu.plan.serde import task_to_proto
+
+        path = os.path.join(
+            tempfile.gettempdir(), f"blaze_profile_{rows}.parquet"
+        )
+        if not os.path.exists(path):
+            rng = np.random.default_rng(7)
+            pq.write_table(
+                pa.table({
+                    "k": pa.array(
+                        rng.integers(0, 64, rows), pa.int32()
+                    ),
+                    "v": pa.array(rng.random(rows), pa.float64()),
+                }),
+                path, compression="zstd",
+            )
+        plan = HashAggregateExec(
+            FilterExec(ParquetScanExec([[FileRange(path)]]),
+                       Col("v") > 0.25),
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n")],
+            mode=AggMode.COMPLETE,
+        )
+        return task_to_proto(plan, 0)
+
+    blob = workload_blob(args.rows)
+    per_client = max(1, args.per_client)
+
+    def drive(host, port, conc):
+        errs = []
+
+        def client():
+            try:
+                with ServiceClient(host, port) as cl:
+                    for _ in range(per_client):
+                        cl.run(blob)
+            except Exception as e:  # noqa: BLE001 - reported once
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=client,
+                               name=f"blaze-profile-client-{i}")
+              for i in range(conc)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise RuntimeError(errs[0])
+
+    # target: a live tier (--port: the PROFILE verb arms and samples
+    # it remotely - the workload parquet must be visible to it, i.e.
+    # same host) or an in-process stack built here (default; --router
+    # fronts the service with a real Router so the router-tier locks
+    # and relay threads show up too)
+    remote = args.port is not None
+    teardown = []  # LIFO
+    try:
+        if remote:
+            host, port = args.host, args.port
+        else:
+            from blaze_tpu.runtime.gateway import TaskGatewayServer
+            from blaze_tpu.service import QueryService
+
+            svc = QueryService(
+                max_concurrency=args.max_concurrency,
+                enable_cache=not args.no_cache,
+            )
+            teardown.append(svc.close)
+            srv = TaskGatewayServer(service=svc).start()
+            teardown.append(srv.stop)
+            host, port = srv.address
+            if args.router:
+                from blaze_tpu.router.proxy import (
+                    Router,
+                    RouterServer,
+                )
+
+                router = Router([f"{host}:{port}"],
+                                poll_interval_s=0.2)
+                teardown.append(router.close)
+                router.registry.poll_now()
+                rsrv = RouterServer(router).start()
+                teardown.append(rsrv.stop)
+                host, port = rsrv.address
+
+        def pctl(payload):
+            with ServiceClient(host, port) as c:
+                out = c.profile(payload)
+            if out.get("error"):
+                raise RuntimeError(f"PROFILE: {out['error']}")
+            return out
+
+        started = pctl({"op": "start", "hz": args.hz})
+        teardown.append(lambda: pctl({"op": "stop"}))
+        tier = started.get("tier", "service")
+        drive(host, port, 1)  # warmup: kernel compile, cache prime
+
+        report_levels = []
+        last_snap = {}
+        for i, conc in enumerate(levels):
+            pctl({"op": "reset"})
+            times = []
+            for _ in range(max(1, args.rounds)):
+                t0 = time.perf_counter()
+                drive(host, port, conc)
+                times.append(time.perf_counter() - t0)
+            # collapsed stacks only for the LAST (max-pressure)
+            # window: they dominate the report's size
+            last = i == len(levels) - 1
+            snap = pctl({"op": "snapshot", "collapsed": last,
+                         "top_locks": 3})
+            med = statistics.median(times)
+            entry = {
+                "concurrency": conc,
+                "rounds": len(times),
+                "median_s": round(med, 4),
+                "spread": round(
+                    (max(times) / med - 1.0) if med else 0.0, 3
+                ),
+                "qps": round(conc * per_client / med, 1)
+                if med else 0.0,
+                "top_locks": snap.get("top_locks", []),
+                "contention": snap.get("contention", {}),
+                "stacks": {
+                    k: snap.get("profile", {}).get(k)
+                    for k in ("samples", "distinct_stacks", "top")
+                },
+            }
+            report_levels.append(entry)
+            last_snap = snap
+            locks = entry["top_locks"]
+            print(
+                f"profile: c{conc} qps={entry['qps']} "
+                f"median={entry['median_s']}s top_lock="
+                + (f"{locks[0]['lock']} "
+                   f"(wait {locks[0]['wait_s']}s)" if locks
+                   else "none"),
+                file=sys.stderr, flush=True,
+            )
+        collapsed = last_snap.get("profile", {}).get("collapsed", "")
+        report = {
+            "format": "blaze-profile-v1",
+            "tier": tier,
+            "mode": "remote" if remote else "in-process",
+            "router": bool(args.router) or tier == "router",
+            "hz": args.hz,
+            "per_client": per_client,
+            "rows_per_query": args.rows,
+            "result_cache": not args.no_cache,
+            "levels": report_levels,
+            # headline attribution: the max-concurrency window
+            "top_locks": report_levels[-1]["top_locks"],
+            "per_verb_seconds": last_snap.get("verbs", {}),
+            "collapsed": collapsed,
+            "roles": sorted({
+                ln.split(";", 1)[0]
+                for ln in collapsed.splitlines() if ln
+            }),
+        }
+    finally:
+        for fn in reversed(teardown):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+    text = json.dumps(report, indent=1, sort_keys=True)
+    if args.out and args.out != "-":
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
 
 
 def cmd_regress(args) -> int:
@@ -499,6 +737,11 @@ def main(argv=None) -> int:
                          "query aborted STREAM_STALLED (CANCELLED-"
                          "class - never a breaker strike), freeing "
                          "buffer and reservation (0 disables)")
+    sv.add_argument("--profile-hz", type=float, default=0.0,
+                    help="arm lock-wait accounting and run the "
+                         "thread-stack sampler at this Hz for the "
+                         "process lifetime (0 = off; the PROFILE "
+                         "verb can arm a live server without it)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -568,6 +811,10 @@ def main(argv=None) -> int:
                          "relay aborted (downstream keeps the parts; "
                          "a re-FETCH resumes; never a breaker "
                          "strike; 0 disables)")
+    rr.add_argument("--profile-hz", type=float, default=0.0,
+                    help="arm lock-wait accounting and run the "
+                         "thread-stack sampler at this Hz for the "
+                         "router's lifetime (0 = off)")
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "
@@ -577,6 +824,37 @@ def main(argv=None) -> int:
                          "('-'/default = stdout)")
     md.add_argument("--timeout", type=float, default=600.0,
                     help="dryrun subprocess wall-clock bound seconds")
+    pf = sub.add_parser("profile")
+    pf.add_argument("--concurrency", default="1,4,16",
+                    help="comma list of client concurrency levels "
+                         "to drive and attribute (default 1,4,16)")
+    pf.add_argument("--router", action="store_true",
+                    help="front the in-process service with a real "
+                         "Router so router-tier locks and relay "
+                         "threads are attributed too")
+    pf.add_argument("--host", default="127.0.0.1")
+    pf.add_argument("--port", type=int, default=None,
+                    help="profile a LIVE serve/route at host:port "
+                         "via the PROFILE verb instead of building "
+                         "an in-process stack (same host: the "
+                         "workload parquet path must be visible "
+                         "to it)")
+    pf.add_argument("--hz", type=float, default=67.0,
+                    help="stack sampler frequency")
+    pf.add_argument("--rounds", type=int, default=3,
+                    help="timed workload rounds per level")
+    pf.add_argument("--per-client", type=int, default=4,
+                    help="queries each client thread runs per round")
+    pf.add_argument("--rows", type=int, default=1 << 16,
+                    help="workload dataset rows (small: the levels "
+                         "measure serving contention, not kernels)")
+    pf.add_argument("--max-concurrency", type=int, default=16,
+                    help="in-process service executor slots")
+    pf.add_argument("--no-cache", action="store_true",
+                    help="disable the result cache (default on: the "
+                         "cached path IS the c16 collapse case)")
+    pf.add_argument("-o", "--out", default=None,
+                    help="report path ('-'/default = stdout)")
     rg = sub.add_parser("regress")
     rg.add_argument("--against", default=None, metavar="BASELINE",
                     help="phase baseline JSON to diff the probe "
@@ -614,6 +892,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "route": cmd_route,
         "mesh-dryrun": cmd_mesh_dryrun,
+        "profile": cmd_profile,
         "regress": cmd_regress,
     }[args.cmd](args)
 

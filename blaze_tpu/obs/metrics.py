@@ -46,11 +46,17 @@ def _sanitize(name: str) -> str:
     return _NAME_RE.sub("_", name)
 
 
-def _label_str(labels: Dict[str, str]) -> str:
+def _label_str(labels) -> str:
+    """Accepts a dict OR an already-sorted tuple of (k, v) pairs -
+    the registry stores label sets as tuples, and rendering them
+    directly avoids re-materializing a dict per sample at scrape
+    time."""
     if not labels:
         return ""
+    items = sorted(labels.items()) if isinstance(labels, dict) \
+        else labels
     inner = ",".join(
-        f'{_sanitize(k)}="{str(v)}"' for k, v in sorted(labels.items())
+        f'{_sanitize(k)}="{str(v)}"' for k, v in items
     )
     return "{" + inner + "}"
 
@@ -125,6 +131,17 @@ class MetricsRegistry:
             h = self._hists.get(key)
             return h.summary() if h is not None else None
 
+    def histogram_summaries(
+        self, name: str
+    ) -> List[Tuple[Dict[str, str], Dict[str, Any]]]:
+        """Every labeled series of one histogram family as
+        (labels, summary) pairs - the profile report enumerates
+        blaze_verb_seconds through this without parsing expositions."""
+        with self._lock:
+            return [(dict(labels), h.summary())
+                    for (n, labels), h in self._hists.items()
+                    if n == name]
+
     # -- collectors -----------------------------------------------------
     def register_collector(
         self, key: str, fn: Callable[[], Iterable[Sample]]
@@ -170,7 +187,9 @@ class MetricsRegistry:
             counters = sorted(self._counters.items())
             hists = sorted(self._hists.items())
         for (name, labels), v in counters:
-            samples.append((name, dict(labels), v, "counter"))
+            # labels is the stored sorted tuple; _label_str renders
+            # it as-is, so no per-sample dict materialization
+            samples.append((name, labels, v, "counter"))
 
         lines: List[str] = []
         seen_types: Dict[str, str] = {}
@@ -188,13 +207,14 @@ class MetricsRegistry:
             v = int(value) if float(value).is_integer() else value
             lines.append(f"{name}{_label_str(labels)} {v}")
 
-        # stable family grouping: all samples of one metric together
-        by_name: Dict[str, List[Sample]] = {}
-        for s in samples:
-            by_name.setdefault(s[0], []).append(s)
-        for name in sorted(by_name):
-            for _, labels, value, mtype in by_name[name]:
-                emit(name, labels, value, mtype)
+        # stable family grouping: all samples of one metric together.
+        # An in-place stable sort by family name replaces the old
+        # throwaway dict-of-lists grouping - same output (insertion
+        # order preserved within a family), no intermediate
+        # allocation proportional to the sample count.
+        samples.sort(key=lambda s: s[0])
+        for name, labels, value, mtype in samples:
+            emit(name, labels, value, mtype)
 
         for (name, labels), h in hists:
             base = _sanitize(name)

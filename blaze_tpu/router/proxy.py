@@ -36,6 +36,7 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 from blaze_tpu.errors import ReplicaUnavailableError
+from blaze_tpu.obs import contention as obs_contention
 from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.metrics import REGISTRY, merge_expositions
@@ -213,7 +214,7 @@ class Router:
         )
         self._queries: Dict[str, RoutedQuery] = {}
         self._order: List[str] = []
-        self._lock = threading.Lock()
+        self._lock = obs_contention.TimedLock("router_table")
         self._rr_seq = itertools.count()  # random-mode sequence
         self.counters: Dict[str, int] = {
             "submitted": 0,
@@ -229,6 +230,12 @@ class Router:
             "stream_stalls": 0,
             "stream_window_waits": 0,
         }
+        # fleet-wide relay-window memory: bytes currently parked in
+        # the bounded per-stream relay queues of _raw_fetch_windowed,
+        # summed across concurrent streams (the
+        # blaze_router_stream_buffered_bytes gauge)
+        self._stream_buffered = 0
+        self._stream_buffered_mu = threading.Lock()
         # per-replica verb-client POOL (ROADMAP item 4's last enabling
         # refactor): up to conn_pool_size concurrent connections per
         # replica, so one slow RPC cannot serialize sibling verbs
@@ -243,7 +250,9 @@ class Router:
         # the same address would inherit a socket to the dead process
         self._client_epoch: Dict[str, int] = {}
         self._client_cv: Dict[str, threading.Condition] = {
-            rid: threading.Condition()
+            rid: threading.Condition(
+                obs_contention.TimedLock("conn_pool")
+            )
             for rid in self.registry.replicas
         }
         self._collector_key = f"router:{id(self):x}"
@@ -648,7 +657,10 @@ class Router:
         from blaze_tpu.service.wire import ServiceClient
 
         rid = replica.replica_id
-        cv = self._client_cv.setdefault(rid, threading.Condition())
+        cv = self._client_cv.setdefault(
+            rid,
+            threading.Condition(obs_contention.TimedLock("conn_pool")),
+        )
         c = None
         counted_wait = False
         with cv:
@@ -1193,7 +1205,10 @@ class Router:
     def _member_join(self, host: str, port: int) -> dict:
         r, created = self.registry.add((host, port))
         rid = r.replica_id
-        self._client_cv.setdefault(rid, threading.Condition())
+        self._client_cv.setdefault(
+            rid,
+            threading.Condition(obs_contention.TimedLock("conn_pool")),
+        )
         if created and not r.alive:
             # one synchronous probe so the ack implies routability -
             # a joining replica takes traffic NOW, not a poll tick
@@ -1637,6 +1652,9 @@ class Router:
             # this process's per-phase rollup (the `router` phase for
             # proxied queries; regress can diff a live router too)
             "phases": obs_phases.ROLLUP.snapshot(max_classes=6),
+            # lock-wait accounting (obs/contention.py): empty dict
+            # when the gate is off or nothing contended yet
+            "contention": obs_contention.snapshot(),
         }
 
     def metrics(self) -> str:
@@ -1686,21 +1704,33 @@ class Router:
                                  daemon=True,
                                  name=f"blaze-router-scrape-{rid}")
             )
+        t_scrape = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        return merge_expositions(
+        out = merge_expositions(
             REGISTRY.render_prometheus(), per_replica
         )
+        # scrape cost is itself observable; a fleet scrape prices
+        # max(replica), and this lands in the NEXT exposition
+        REGISTRY.observe("blaze_scrape_seconds",
+                         time.perf_counter() - t_scrape,
+                         tier="router")
+        return out
 
     def _collect_metrics(self):
+        # a generator: the registry consumes it at scrape time, so no
+        # per-scrape sample list is materialized here
         with self._lock:
             counters = dict(self.counters)
-        return [
-            ("blaze_router_events_total", {"event": k}, v, "counter")
-            for k, v in counters.items()
-        ]
+        for k, v in counters.items():
+            yield ("blaze_router_events_total", {"event": k}, v,
+                   "counter")
+        # fleet-wide relay-window memory across concurrent streams:
+        # the observability precursor to a fleet-wide relay-memory cap
+        yield ("blaze_router_stream_buffered_bytes", {},
+               self._stream_buffered, "gauge")
 
     # -- FETCH passthrough -----------------------------------------------
     def stream_parts(self, external_id: str,
@@ -1906,9 +1936,22 @@ class Router:
         sock = self._fetch_connect(replica)
         window: queue.Queue = queue.Queue(maxsize=self.stream_window)
         stop = threading.Event()
+        # this stream's share of the router-wide buffered-bytes
+        # gauge; the finally below subtracts the residual so an
+        # abandoned stream cannot leak gauge weight
+        pending = [0]
+
+        def _acct(delta: int) -> None:
+            with self._stream_buffered_mu:
+                pending[0] += delta
+                self._stream_buffered += delta
 
         def _put(item) -> bool:
             waited = False
+            if item[0] == "part":
+                # account BEFORE parking so the gauge covers the
+                # window-full wait, not just settled parts
+                _acct(len(item[1]))
             while not stop.is_set():
                 try:
                     window.put(item, timeout=0.1)
@@ -1918,6 +1961,8 @@ class Router:
                         waited = True
                         with self._lock:
                             self.counters["stream_window_waits"] += 1
+            if item[0] == "part":
+                _acct(-len(item[1]))
             return False  # consumer gone: drop, reader exits
 
         def _reader() -> None:
@@ -1963,6 +2008,7 @@ class Router:
             while True:
                 kind, payload = window.get()
                 if kind == "part":
+                    _acct(-len(payload))
                     yield payload
                 elif kind == "end":
                     return
@@ -1978,6 +2024,11 @@ class Router:
             except OSError:
                 pass
             reader.join(timeout=2.0)
+            # reader joined, consumer done: whatever this stream
+            # still attributes to the gauge is residual - drop it
+            with self._stream_buffered_mu:
+                self._stream_buffered -= pending[0]
+                pending[0] = 0
 
     def _recv_checked(self, sock, n: int,
                       replica: Replica) -> bytes:
@@ -2027,6 +2078,8 @@ class RouterVerbBackend:
     error). Non-detached queries submitted on a connection are
     cancelled (on their replicas) when the client vanishes."""
 
+    tier = "router"  # wire-latency / scrape-cost metric label
+
     def __init__(self, router: Router):
         self.router = router
 
@@ -2054,6 +2107,11 @@ class RouterVerbBackend:
 
     def member_frame(self, payload: dict) -> dict:
         return self.router.membership(payload)
+
+    def profile_frame(self, payload: dict) -> dict:
+        from blaze_tpu.service.wire import handle_profile_frame
+
+        return handle_profile_frame(self.tier, payload)
 
     def abandon(self, qid: str) -> None:
         try:

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from blaze_tpu.obs.contention import TimedLock
 from blaze_tpu.obs.metrics import REGISTRY
 from blaze_tpu.runtime.cluster import Liveness
 
@@ -239,7 +240,7 @@ class ReplicaRegistry:
         )
         self.on_dead = on_dead
         self.on_revive = on_revive
-        self._lock = threading.Lock()
+        self._lock = TimedLock("registry_swap")
         self._stop = threading.Event()
         self._started = False
         self._threads: Dict[str, threading.Thread] = {}
@@ -542,38 +543,33 @@ class ReplicaRegistry:
 
     # -- exposition ------------------------------------------------------
     def _collect_metrics(self):
-        samples = []
+        # a generator: the registry consumes it at scrape time, so no
+        # per-scrape sample list is materialized here
         now = time.monotonic()
         for rid, r in self.replicas.items():
             lab = {"replica": rid}
-            samples.append(("blaze_router_replica_alive", lab,
-                            1 if r.alive else 0, "gauge"))
-            samples.append(("blaze_router_replica_quarantined", lab,
-                            1 if r.quarantined(now) else 0, "gauge"))
-            samples.append(("blaze_router_replica_in_flight", lab,
-                            r.in_flight, "gauge"))
+            yield ("blaze_router_replica_alive", lab,
+                   1 if r.alive else 0, "gauge")
+            yield ("blaze_router_replica_quarantined", lab,
+                   1 if r.quarantined(now) else 0, "gauge")
+            yield ("blaze_router_replica_in_flight", lab,
+                   r.in_flight, "gauge")
             # the membership `state` label: churn renders on the
             # scrape surface, not just as scrape gaps
-            samples.append((
-                "blaze_router_replica_membership",
-                {**lab, "state": r.membership_state(now)}, 1, "gauge",
-            ))
+            yield ("blaze_router_replica_membership",
+                   {**lab, "state": r.membership_state(now)}, 1,
+                   "gauge")
             if r.stats is not None:
                 a = r.stats.get("admission", {})
-                samples.append(
-                    ("blaze_router_replica_queue_depth", lab,
-                     a.get("queued", 0), "gauge"))
-                samples.append(
-                    ("blaze_router_replica_headroom_bytes", lab,
-                     r.effective_headroom() or 0, "gauge"))
+                yield ("blaze_router_replica_queue_depth", lab,
+                       a.get("queued", 0), "gauge")
+                yield ("blaze_router_replica_headroom_bytes", lab,
+                       r.effective_headroom() or 0, "gauge")
         with self._lock:
             gone = list(self.departed)
         for rid in gone:
-            samples.append((
-                "blaze_router_replica_membership",
-                {"replica": rid, "state": "gone"}, 1, "gauge",
-            ))
-        return samples
+            yield ("blaze_router_replica_membership",
+                   {"replica": rid, "state": "gone"}, 1, "gauge")
 
     def snapshot(self) -> Dict[str, dict]:
         now = time.monotonic()

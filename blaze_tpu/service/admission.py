@@ -45,6 +45,7 @@ import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from blaze_tpu.obs.contention import TimedLock
 from blaze_tpu.service.query import Query
 
 
@@ -107,7 +108,7 @@ class AdmissionController:
         self._tracker = device_tracker or get_device_tracker()
         self.max_concurrency = max(1, int(max_concurrency))
         self.max_queue_depth = max(1, int(max_queue_depth))
-        self._lock = threading.Lock()
+        self._lock = TimedLock("admission")
         self._seq = itertools.count()
         # heap entries: (-priority, deadline, seq, query) -
         # max-priority first; within a priority class earliest

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from blaze_tpu.obs.contention import TimedRLock
 from blaze_tpu.testing import chaos
 
 CacheKey = Tuple[str, int]  # (plan fingerprint, partition id)
@@ -69,7 +70,7 @@ class ResultCache:
         )
         # RLock: put() -> pool.grow() may call back into _spill_some()
         # on the same thread under host-memory pressure
-        self._lock = threading.RLock()
+        self._lock = TimedRLock("result_cache")
         self._entries: "collections.OrderedDict[CacheKey, _Entry]" = (
             collections.OrderedDict()
         )

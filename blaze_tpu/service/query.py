@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from blaze_tpu.obs.contention import TimedLock
 from blaze_tpu.ops.base import ExecContext
 
 
@@ -167,7 +168,7 @@ class Query:
         # None = pre-streaming materialize-then-stream behavior
         self.stream = None
 
-        self._lock = threading.Lock()
+        self._lock = TimedLock("query_state")
         self._cancel = threading.Event()
         self._cancel_reason: Optional[str] = None
         self._done = threading.Event()

@@ -34,6 +34,7 @@ import time
 from typing import Dict, List, Optional
 
 from blaze_tpu.errors import ErrorClass, classify, retry_action
+from blaze_tpu.obs import contention as obs_contention
 from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import slowlog
 from blaze_tpu.obs import trace as obs_trace
@@ -188,7 +189,7 @@ class QueryService:
         # re-executing the same plan concurrently
         self._inflight: Dict = {}
         self._inflight_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._lock = obs_contention.TimedLock("service_state")
         self._cv = threading.Condition(self._lock)
         # admission order journal (query ids, in admission sequence):
         # the load tests assert priority/FIFO semantics from this
@@ -548,6 +549,9 @@ class QueryService:
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
+        # lock-wait accounting (obs/contention.py): empty dict when
+        # the gate is off or nothing contended yet
+        out["contention"] = obs_contention.snapshot()
         return out
 
     def trace(self, query_id: str) -> Optional[dict]:
@@ -628,52 +632,44 @@ class QueryService:
     def _collect_metrics(self):
         """Scrape-time samples for the process registry (METRICS verb):
         live admission/cache/history state as gauges, cumulative event
-        counts as counters."""
-        samples = []
+        counts as counters. A generator: the registry consumes it
+        directly, so no per-scrape sample list is materialized here."""
         sid = {"service": self._instance}  # series-disambiguating
         a = self.admission.stats()
         for k in ("submitted", "admitted", "rejected_overloaded",
                   "shed_deadline", "shed_predicted",
                   "headroom_waits"):
-            samples.append(("blaze_admission_events_total",
-                            {"event": k, **sid}, a.get(k, 0),
-                            "counter"))
+            yield ("blaze_admission_events_total",
+                   {"event": k, **sid}, a.get(k, 0), "counter")
         for k in ("queued", "running", "reserved_bytes", "headroom"):
-            samples.append((f"blaze_admission_{k}", dict(sid),
-                            a.get(k, 0), "gauge"))
+            yield (f"blaze_admission_{k}", sid, a.get(k, 0), "gauge")
         if self.cache is not None:
             c = self.cache.stats()
             for k in ("hits", "misses", "evictions", "puts", "spills",
                       "restores", "spill_errors", "coalesced"):
-                samples.append(("blaze_result_cache_events_total",
-                                {"event": k, **sid}, c.get(k, 0),
-                                "counter"))
+                yield ("blaze_result_cache_events_total",
+                       {"event": k, **sid}, c.get(k, 0), "counter")
             for k in ("entries", "bytes", "spilled_entries"):
-                samples.append((f"blaze_result_cache_{k}", dict(sid),
-                                c.get(k, 0), "gauge"))
+                yield (f"blaze_result_cache_{k}", sid,
+                       c.get(k, 0), "gauge")
         with self._lock:
             orphans = self.obs_counters["orphans_reaped"]
             stalls = self.obs_counters["stream_stalls"]
             bp_waits = self.obs_counters["stream_backpressure_waits"]
             high_water = self._stream_high_water
-        samples.append(("blaze_service_orphans_reaped_total",
-                        dict(sid), orphans, "counter"))
-        samples.append(("blaze_service_stream_stalls_total",
-                        dict(sid), stalls, "counter"))
-        samples.append((
-            "blaze_service_stream_backpressure_waits_total",
-            dict(sid), bp_waits, "counter",
-        ))
-        samples.append((
-            "blaze_service_stream_buffer_high_water_bytes",
-            dict(sid), high_water, "gauge",
-        ))
+        yield ("blaze_service_orphans_reaped_total",
+               sid, orphans, "counter")
+        yield ("blaze_service_stream_stalls_total",
+               sid, stalls, "counter")
+        yield ("blaze_service_stream_backpressure_waits_total",
+               sid, bp_waits, "counter")
+        yield ("blaze_service_stream_buffer_high_water_bytes",
+               sid, high_water, "gauge")
         h = self.history.summary(top=0)
-        samples.append(("blaze_runtime_history_fingerprints",
-                        dict(sid), h["fingerprints"], "gauge"))
-        samples.append(("blaze_runtime_history_samples_total",
-                        dict(sid), h["total_samples"], "counter"))
-        return samples
+        yield ("blaze_runtime_history_fingerprints",
+               sid, h["fingerprints"], "gauge")
+        yield ("blaze_runtime_history_samples_total",
+               sid, h["total_samples"], "counter")
 
     def close(self) -> None:
         if self._closed:

@@ -49,6 +49,7 @@ import time
 from typing import Callable, List, Optional
 
 from blaze_tpu.errors import ErrorClass, PlanInvalidError
+from blaze_tpu.obs.contention import TimedLock
 from blaze_tpu.service.query import QueryCancelled
 
 
@@ -84,7 +85,7 @@ class StreamBuffer:
         self.stall_s = float(stall_s)
         self._on_pending = on_pending
         self._on_event = on_event
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(TimedLock("stream_ring"))
         self.parts: List = []  # produced pa.RecordBatch refs, in order
         self._nbytes: List[int] = []
         # producer cursor: == len(parts) normally; behind it while

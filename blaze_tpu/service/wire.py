@@ -12,7 +12,7 @@ Service framing (all integers LE):
 
   hello:    u64 header with _FLAG_SERVICE set (rest of the bits 0)
   verb:     u8   SUBMIT=1 POLL=2 FETCH=3 CANCEL=4 REPORT=5 STATS=6
-                 METRICS=7 MEMBER=8
+                 METRICS=7 MEMBER=8 PROFILE=9
   SUBMIT:   u32 meta_len | meta JSON | u64 blob_header | [u32 mlen |
             manifest JSON] | blob
             blob_header reuses the legacy bits: bit 63 = reference wire
@@ -60,6 +60,15 @@ Service framing (all integers LE):
             LEAVEs when empty. Only the router tier is a membership
             authority - a serve instance answers with an in-band
             error.
+  PROFILE:  u32 len | JSON    -> JSON frame - live contention +
+            sampling profiler control (obs/contention.py,
+            obs/sampler.py): {"op": "start"|"stop"|"snapshot"|
+            "reset", "hz"?, "top"?, "collapsed"?}. `start` arms lock
+            accounting and the stack sampler on the receiving
+            process; `snapshot` answers {profile: {top, collapsed,
+            samples, ...}, contention: {lock: {waits, wait_s,
+            hold_s, ...}}} - so a live fleet is profiled without
+            restart. Both tiers answer for their own process.
   JSON frame: u32 len | utf8 JSON
 
 Session semantics: queries submitted on a connection belong to it;
@@ -79,6 +88,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import threading
 import time
 from typing import Iterator, List, Optional
 
@@ -97,6 +107,14 @@ VERB_REPORT = 5
 VERB_STATS = 6
 VERB_METRICS = 7
 VERB_MEMBER = 8
+VERB_PROFILE = 9
+
+VERB_NAMES = {
+    VERB_SUBMIT: "submit", VERB_POLL: "poll", VERB_FETCH: "fetch",
+    VERB_CANCEL: "cancel", VERB_REPORT: "report", VERB_STATS: "stats",
+    VERB_METRICS: "metrics", VERB_MEMBER: "member",
+    VERB_PROFILE: "profile",
+}
 
 MAX_META_BYTES = 1 << 20
 # response JSON frames may carry a whole trace document (REPORT);
@@ -154,15 +172,56 @@ _NOARG_VERBS = {
     VERB_METRICS: lambda b: b.metrics_frame(),
 }
 
+# live connection-count gauges per tier, exported through the metrics
+# collector surface (open/close only - never per verb)
+_CONN_LOCK = threading.Lock()
+_CONNECTIONS = {"service": 0, "router": 0}
+
+
+def _conn_samples():
+    with _CONN_LOCK:
+        counts = dict(_CONNECTIONS)
+    for tier, n in counts.items():
+        yield ("blaze_connections", {"tier": tier}, n, "gauge")
+
+
+def _observe_verb(tier: str, verb: int, t0: float, t_decoded: float,
+                  t_dispatched: float, t_done: float) -> None:
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    name = VERB_NAMES.get(verb, str(verb))
+    REGISTRY.observe("blaze_verb_seconds", t_decoded - t0,
+                     tier=tier, verb=name, segment="decode")
+    REGISTRY.observe("blaze_verb_seconds", t_dispatched - t_decoded,
+                     tier=tier, verb=name, segment="dispatch")
+    REGISTRY.observe("blaze_verb_seconds", t_done - t_dispatched,
+                     tier=tier, verb=name, segment="reply")
+
 
 def serve_verb_connection(sock, backend) -> None:
     """Drive one service-protocol connection until EOF against any
     verb backend (the QueryService adapter below, or the router's).
     Owns the shared skeleton: verb dispatch, the error-handling ladder
-    (protocol violations close, id misses report in-band), and
-    cancel-on-disconnect session teardown."""
+    (protocol violations close, id misses report in-band),
+    cancel-on-disconnect session teardown - and the per-verb wire
+    latency surface: every verb round trip records decode / dispatch /
+    reply segment histograms (blaze_verb_seconds{tier,verb,segment}),
+    the FIRST verb byte records accept-to-first-byte queueing delay,
+    and live connections gauge per tier."""
+    from blaze_tpu.obs.metrics import REGISTRY
     from blaze_tpu.runtime.transport import _recv_exact
 
+    tier = getattr(backend, "tier", "service")
+    # role tag for the sampling profiler (obs/sampler.py): the
+    # socketserver default Thread-N name would hide the wire tier
+    t = threading.current_thread()
+    if not t.name.startswith("blaze-verb"):
+        t.name = f"blaze-verb-{tier}"
+    with _CONN_LOCK:
+        _CONNECTIONS[tier] = _CONNECTIONS.get(tier, 0) + 1
+    REGISTRY.register_collector("wire_connections", _conn_samples)
+    t_accept = time.perf_counter()
+    first_verb = True
     session_qids: List[str] = []
     try:
         while True:
@@ -170,14 +229,24 @@ def serve_verb_connection(sock, backend) -> None:
                 verb = _recv_exact(sock, 1)[0]
             except (ConnectionError, OSError):
                 return  # clean EOF / client gone
+            t0 = time.perf_counter()
+            if first_verb:
+                # accept-to-first-byte: how long an accepted
+                # connection queued before its first request reached
+                # this handler (the c16 backlog measure)
+                first_verb = False
+                REGISTRY.observe("blaze_accept_first_byte_seconds",
+                                 t0 - t_accept, tier=tier)
             try:
                 if verb == VERB_SUBMIT:
                     meta, blob, is_ref, manifest_bytes = (
                         decode_submit_frame(sock)
                     )
+                    t1 = time.perf_counter()
                     resp = backend.submit(
                         meta, blob, is_ref, manifest_bytes
                     )
+                    t2 = time.perf_counter()
                     if not meta.get("detach") \
                             and "query_id" in resp:
                         # attached (default): cancel-on-disconnect
@@ -188,21 +257,40 @@ def serve_verb_connection(sock, backend) -> None:
                 elif verb == VERB_FETCH:
                     qid = _read_str(sock)
                     timeout_ms = _read_u32(sock)
+                    t1 = time.perf_counter()
+                    # fetch owns its own framing: the part stream is
+                    # the dispatch segment, reply is the terminator
                     backend.fetch(sock, qid, timeout_ms)
+                    t2 = time.perf_counter()
                 elif verb in _ID_VERBS:
                     qid = _read_str(sock)
                     flags = _read_u32(sock)
-                    _send_json(
-                        sock, _ID_VERBS[verb](backend, qid, flags)
-                    )
+                    t1 = time.perf_counter()
+                    resp = _ID_VERBS[verb](backend, qid, flags)
+                    t2 = time.perf_counter()
+                    _send_json(sock, resp)
                 elif verb == VERB_MEMBER:
                     payload = json.loads(_read_str(sock) or "{}")
-                    _send_json(sock, backend.member_frame(payload))
+                    t1 = time.perf_counter()
+                    resp = backend.member_frame(payload)
+                    t2 = time.perf_counter()
+                    _send_json(sock, resp)
+                elif verb == VERB_PROFILE:
+                    payload = json.loads(_read_str(sock) or "{}")
+                    t1 = time.perf_counter()
+                    resp = backend.profile_frame(payload)
+                    t2 = time.perf_counter()
+                    _send_json(sock, resp)
                 elif verb in _NOARG_VERBS:
                     _read_u32(sock)
-                    _send_json(sock, _NOARG_VERBS[verb](backend))
+                    t1 = time.perf_counter()
+                    resp = _NOARG_VERBS[verb](backend)
+                    t2 = time.perf_counter()
+                    _send_json(sock, resp)
                 else:
                     raise ValueError(f"unknown service verb {verb}")
+                _observe_verb(tier, verb, t0, t1, t2,
+                              time.perf_counter())
             except (ConnectionError, BrokenPipeError, OSError):
                 return  # mid-verb disconnect: session cleanup below
             except ValueError as e:
@@ -229,6 +317,8 @@ def serve_verb_connection(sock, backend) -> None:
                     {"error": f"{type(e).__name__}: {e}"[:65536]},
                 )
     finally:
+        with _CONN_LOCK:
+            _CONNECTIONS[tier] = max(0, _CONNECTIONS.get(tier, 1) - 1)
         # session teardown: a disconnected client's pending queries
         # must not keep occupying the queue or the device
         for qid in session_qids:
@@ -238,8 +328,79 @@ def serve_verb_connection(sock, backend) -> None:
                 pass
 
 
+# PROFILE verb ops, shared by both tier backends. `start` enables
+# contention accounting + the stack sampler exactly once no matter how
+# many starts arrive (the refcount must balance the eventual stop);
+# `snapshot` serves both surfaces; `reset` zeroes them between
+# measurement windows (the profile CLI's per-concurrency sections).
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_ARMED = False
+
+
+def verb_latency_summary() -> dict:
+    """Per-verb wire latency from this process's registry, folded as
+    {verb: {segment: {count, sum, mean}}} - the profile report's
+    per-verb section (decode / dispatch / reply segments)."""
+    from blaze_tpu.obs.metrics import REGISTRY
+
+    out: dict = {}
+    for labels, summ in REGISTRY.histogram_summaries(
+        "blaze_verb_seconds"
+    ):
+        verb = labels.get("verb", "?")
+        seg = labels.get("segment", "?")
+        out.setdefault(verb, {})[seg] = summ
+    return out
+
+
+def handle_profile_frame(tier: str, payload: dict) -> dict:
+    global _PROFILE_ARMED
+    from blaze_tpu.obs import contention, sampler
+
+    op = str(payload.get("op", "snapshot"))
+    if op == "start":
+        with _PROFILE_LOCK:
+            if not _PROFILE_ARMED:
+                contention.enable()
+                _PROFILE_ARMED = True
+        sampler.start(hz=float(payload.get("hz", 67.0)))
+        return {"ok": True, "tier": tier, "profiling": True}
+    if op == "stop":
+        sampler.stop()
+        with _PROFILE_LOCK:
+            if _PROFILE_ARMED:
+                contention.disable()
+                _PROFILE_ARMED = False
+        return {"ok": True, "tier": tier, "profiling": False}
+    if op == "reset":
+        contention.reset_stats()
+        s = sampler.current()
+        if s is not None:
+            s.reset()
+        return {"ok": True, "tier": tier}
+    if op == "snapshot":
+        return {
+            "ok": True,
+            "tier": tier,
+            "profile": sampler.snapshot(
+                top_n=int(payload.get("top", 20)),
+                include_collapsed=bool(
+                    payload.get("collapsed", True)
+                ),
+            ),
+            "contention": contention.snapshot(),
+            "top_locks": contention.top_locks(
+                int(payload.get("top_locks", 3))
+            ),
+            "verbs": verb_latency_summary(),
+        }
+    raise ValueError(f"unknown profile op {op!r}")
+
+
 class ServiceVerbBackend:
     """The QueryService behind the shared verb loop."""
+
+    tier = "service"
 
     def __init__(self, service):
         self.service = service
@@ -294,12 +455,21 @@ class ServiceVerbBackend:
     def metrics_frame(self) -> dict:
         from blaze_tpu.obs.metrics import REGISTRY
 
-        return {"metrics": REGISTRY.render_prometheus()}
+        t0 = time.perf_counter()
+        text = REGISTRY.render_prometheus()
+        # self-metric: scrape cost is itself observable (lands in the
+        # NEXT exposition - the standard self-scrape semantics)
+        REGISTRY.observe("blaze_scrape_seconds",
+                         time.perf_counter() - t0, tier="service")
+        return {"metrics": text}
 
     def member_frame(self, payload: dict) -> dict:
         # a single serve instance is not a membership authority - the
         # router tier (router/proxy.RouterVerbBackend) owns the fleet
         return {"error": "membership: this endpoint is not a router"}
+
+    def profile_frame(self, payload: dict) -> dict:
+        return handle_profile_frame(self.tier, payload)
 
     def abandon(self, qid: str) -> None:
         try:
@@ -850,6 +1020,16 @@ class ServiceClient:
         data = json.dumps(payload).encode("utf-8")
         return self._roundtrip(
             bytes([VERB_MEMBER]) + _U32.pack(len(data)) + data
+        )
+
+    def profile(self, payload: Optional[dict] = None) -> dict:
+        """One PROFILE round trip: {"op": "start"|"stop"|"snapshot"|
+        "reset", ...} against either tier - arm contention accounting
+        + the stack sampler on a LIVE process and pull the folded
+        report back, no restart required. Default op is snapshot."""
+        data = json.dumps(payload or {}).encode("utf-8")
+        return self._roundtrip(
+            bytes([VERB_PROFILE]) + _U32.pack(len(data)) + data
         )
 
     def fetch(self, query_id: str, timeout_ms: int = 0) -> list:

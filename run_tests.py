@@ -193,6 +193,7 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
          "tests/test_cluster_chaos.py", "tests/test_router.py",
          "tests/test_membership.py", "tests/test_churn.py",
          "tests/test_journal.py", "tests/test_stream.py",
+         "tests/test_contention.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}
@@ -390,6 +391,62 @@ def trace_smoke() -> bool:
     )
 
 
+def profile_smoke() -> bool:
+    """Profiler smoke (ISSUE 15 satellite, `--profile`): runs the
+    `python -m blaze_tpu profile` CLI at c1/c4 against an in-process
+    service and asserts the blaze-profile-v1 report schema - every
+    concurrency level carries qps + contention accounting, the
+    collapsed-stack section sampled at least one frame, and the
+    top-lock table names real locks with wait:hold ratios."""
+    import json
+    import tempfile
+
+    ts = time.time()
+    out = os.path.join(tempfile.gettempdir(),
+                       f"blaze_profile_smoke_{os.getpid()}.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "blaze_tpu", "profile",
+         "--concurrency", "1,4", "--rounds", "1", "--per-client", "2",
+         "--rows", "4096", "-o", out],
+        cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=300,
+    )
+    ok = p.returncode == 0
+    why = f"exit {p.returncode}"
+    if ok:
+        try:
+            with open(out) as f:
+                rep = json.load(f)
+            assert rep["format"] == "blaze-profile-v1", rep.get("format")
+            assert len(rep["levels"]) == 2, len(rep["levels"])
+            for lvl in rep["levels"]:
+                assert lvl["qps"] > 0, lvl
+                assert lvl["contention"], "empty contention section"
+            assert rep["top_locks"], "empty top_locks"
+            for row in rep["top_locks"]:
+                assert "lock" in row and "wait_hold_ratio" in row, row
+            stacks = rep["levels"][-1]["stacks"]
+            assert stacks["samples"] > 0, stacks
+            assert any(ln for ln in rep["collapsed"].splitlines()), \
+                "empty collapsed section"
+            why = (f"c4 {rep['levels'][-1]['qps']:.0f} qps, "
+                   f"top lock {rep['top_locks'][0]['lock']}, "
+                   f"{stacks['samples']} stack samples")
+        except (OSError, KeyError, AssertionError,
+                json.JSONDecodeError) as e:
+            ok = False
+            why = f"report invalid: {e!r}"
+    print(f"[{'OK ' if ok else 'FAIL'}] profile smoke "
+          f"({time.time() - ts:.0f}s) :: {why}", flush=True)
+    if not ok:
+        print("\n".join((p.stderr or "").splitlines()[-20:]))
+    try:
+        os.remove(out)
+    except OSError:
+        pass
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int,
@@ -422,6 +479,11 @@ def main():
                          "backpressure, slow-consumer stall aborts, "
                          "mid-stream resume, and the router's "
                          "windowed zero-copy relay")
+    ap.add_argument("--profile", action="store_true",
+                    help="profiler smoke only: the `python -m "
+                         "blaze_tpu profile` CLI at c1/c4 against an "
+                         "in-process service, report schema + "
+                         "non-empty lock and stack sections asserted")
     ap.add_argument("--churn", action="store_true",
                     help="fleet-churn suite only: JOIN/LEAVE "
                          "membership, graceful drain, hot-result "
@@ -451,6 +513,12 @@ def main():
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1
 
+    if args.profile:
+        ok &= profile_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (profile) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
     if args.churn:
         ok &= churn_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (churn) "
@@ -476,6 +544,7 @@ def main():
         ok &= stream_smoke()
         ok &= churn_smoke()
         ok &= obs_smoke()
+        ok &= profile_smoke()
         ok &= mesh_smoke()
         ok &= regress_smoke()
         ok &= bench_regress_smoke()

@@ -83,13 +83,18 @@ def _obs_hygiene():
     metrics registry resets too: a failed test's stale collector (an
     unclosed service) must not feed samples - and pin the service
     alive - for every later exposition, and per-test counter baselines
-    keep Prometheus-text assertions deterministic."""
+    keep Prometheus-text assertions deterministic. Contention
+    accounting and the stack sampler (ISSUE 15) share the contract:
+    a failed test must not leave accounting armed (the contention-off
+    dispatch budgets are pinned) or a sampler thread running."""
     yield
-    from blaze_tpu.obs import trace
+    from blaze_tpu.obs import contention, sampler, trace
     from blaze_tpu.obs.metrics import REGISTRY
     from blaze_tpu.obs.phases import ROLLUP
 
     trace._reset_for_tests()
+    contention._reset_for_tests()
+    sampler._reset_for_tests()
     REGISTRY._reset_for_tests()
     ROLLUP._reset_for_tests()
 

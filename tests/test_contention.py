@@ -1,0 +1,413 @@
+"""Contention observability (ISSUE 15): TimedLock/TimedRLock wait-hold
+accounting, the thread-stack sampling profiler, the PROFILE wire verb
+through both tiers, and the `python -m blaze_tpu profile` CLI.
+
+The off-mode contract (accounting disarmed = bare-lock pass-through)
+is pinned where the budgets live: test_dispatch_budget.py extends its
+obs-off pin with contention armed/disarmed."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.obs import contention, sampler
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.ops import AggMode, FilterExec, HashAggregateExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService, ServiceClient
+
+
+# ---------------------------------------------------------------------------
+# TimedLock / TimedRLock accounting
+# ---------------------------------------------------------------------------
+
+
+def test_timedlock_records_wait_and_hold_under_contention():
+    contention.enable()
+    try:
+        lk = contention.TimedLock("t_contended")
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                release.wait(2.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        # wait until the holder owns the lock, then contend
+        for _ in range(200):
+            if lk.locked():
+                break
+            time.sleep(0.001)
+        assert lk.locked()
+        t0 = time.perf_counter()
+        threading.Timer(0.05, release.set).start()
+        with lk:
+            waited = time.perf_counter() - t0
+        t.join()
+        snap = contention.snapshot()["t_contended"]
+        assert snap["waits"] == 2  # holder's free acquire + ours
+        assert snap["holds"] == 2
+        # our acquire really parked behind the holder
+        assert snap["wait_max_s"] >= min(0.04, waited * 0.5)
+        # the holder held for the release wait
+        assert snap["hold_max_s"] >= 0.04
+        assert snap["wait_hold_ratio"] > 0
+    finally:
+        contention.disable()
+
+
+def test_timedrlock_reentrant_is_one_boundary():
+    contention.enable()
+    try:
+        lk = contention.TimedRLock("t_rlock")
+        with lk:
+            with lk:
+                with lk:
+                    pass
+        snap = contention.snapshot()["t_rlock"]
+        assert snap["waits"] == 1
+        assert snap["holds"] == 1
+    finally:
+        contention.disable()
+
+
+def test_off_mode_records_nothing():
+    assert not contention.ACTIVE
+    lk = contention.TimedLock("t_off")
+    rl = contention.TimedRLock("t_off_r")
+    with lk:
+        pass
+    with rl:
+        with rl:
+            pass
+    snap = contention.snapshot()
+    assert snap.get("t_off", {"waits": 0})["waits"] == 0
+    assert snap.get("t_off_r", {"holds": 0})["holds"] == 0
+
+
+def test_condition_over_timedlock_accounts_cv_waits():
+    contention.enable()
+    try:
+        cv = threading.Condition(contention.TimedLock("t_cv"))
+        ready = threading.Event()
+        got = []
+
+        def waiter():
+            with cv:
+                ready.set()
+                cv.wait(2.0)
+                got.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        assert ready.wait(2.0)
+        with cv:
+            cv.notify()
+        t.join(2.0)
+        assert got == [True]
+        snap = contention.snapshot()["t_cv"]
+        # waiter acquire + notifier acquire + post-notify reacquire
+        assert snap["waits"] >= 3
+        assert snap["holds"] >= 3
+    finally:
+        contention.disable()
+
+
+def test_enable_is_refcounted():
+    assert not contention.ACTIVE
+    contention.enable()
+    contention.enable()
+    contention.disable()
+    assert contention.ACTIVE  # one enable still outstanding
+    contention.disable()
+    assert not contention.ACTIVE
+
+
+def test_lock_name_overflow_folds_bounded():
+    contention.enable()
+    try:
+        for i in range(contention._MAX_LOCKS + 8):
+            with contention.TimedLock(f"t_mint_{i}"):
+                pass
+        snap = contention.snapshot()
+        assert len(snap) <= contention._MAX_LOCKS + 1
+        assert contention._OVERFLOW in snap
+        assert snap[contention._OVERFLOW]["holds"] >= 8
+    finally:
+        contention.disable()
+
+
+def test_top_locks_orders_by_wait():
+    contention.enable()
+    try:
+        contention.stat_for("t_small").record_wait(0.001)
+        contention.stat_for("t_small").record_hold(0.001)
+        contention.stat_for("t_big").record_wait(0.5)
+        contention.stat_for("t_big").record_hold(0.01)
+        top = contention.top_locks(2)
+        assert top[0]["lock"] == "t_big"
+        assert top[0]["wait_hold_ratio"] == pytest.approx(50.0)
+    finally:
+        contention.disable()
+
+
+def test_lock_histograms_reach_metrics_exposition():
+    contention.enable()
+    try:
+        with contention.TimedLock("t_expo"):
+            pass
+        text = REGISTRY.render_prometheus()
+        assert 'blaze_lock_wait_seconds_bucket{le="+Inf",lock="t_expo"}' \
+            in text.replace("', '", "")
+        assert "blaze_lock_hold_seconds_count" in text
+        # bucket counts are cumulative: +Inf >= first bucket
+        pat = re.compile(
+            r'blaze_lock_wait_seconds_bucket\{le="([^"]+)",'
+            r'lock="t_expo"\} (\d+)'
+        )
+        counts = [int(m[1]) for m in pat.findall(text)]
+        assert counts and counts[-1] == max(counts)
+    finally:
+        contention.disable()
+
+
+# ---------------------------------------------------------------------------
+# stack sampler
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_start_stop_hygiene():
+    s = sampler.start(hz=200.0)
+    assert s.running
+    assert any(t.name == "blaze-sampler"
+               for t in threading.enumerate())
+    # same hz: no-op, same instance
+    assert sampler.start(hz=200.0) is s
+    sampler.stop()
+    assert not s.running
+    time.sleep(0.05)
+    assert not any(t.name == "blaze-sampler"
+                   for t in threading.enumerate())
+    # retune: a different hz replaces the sampler
+    s2 = sampler.start(hz=97.0)
+    assert s2 is not s and s2.hz == 97.0
+    sampler._reset_for_tests()
+    assert sampler.current() is None
+
+
+def test_sampler_bounds_distinct_stacks():
+    s = sampler.StackSampler(hz=100.0, max_stacks=2, max_depth=4)
+    for _ in range(30):
+        s.sample_once()
+    snap = s.snapshot(include_collapsed=False)
+    assert snap["samples"] == 30
+    # bounded: at most max_stacks keys plus per-role overflow bins
+    assert snap["distinct_stacks"] <= 2 + len(
+        {r for r, _ in s._stacks}
+    )
+    stacks = list(s._stacks)
+    assert all(len(st) <= 4 for _, st in stacks)
+
+
+def test_collapsed_export_is_flamegraph_valid():
+    # sample_once excludes the CALLING thread (in production, the
+    # sampler thread excludes itself) - park a worker to be sampled
+    s = sampler.StackSampler(hz=100.0)
+    stop = threading.Event()
+    w = threading.Thread(target=stop.wait, args=(5.0,),
+                         name="blaze-query-w")
+    w.start()
+    try:
+        for _ in range(5):
+            s.sample_once()
+    finally:
+        stop.set()
+        w.join()
+    text = s.collapsed()
+    assert text
+    line_re = re.compile(r"^[^ ]+(;[^ ]+)+ \d+$")
+    for line in text.splitlines():
+        assert line_re.match(line), line
+    # role filter keeps only that role's stacks
+    roles = {ln.split(";", 1)[0] for ln in text.splitlines()}
+    for role in roles:
+        sub = s.collapsed(role=role)
+        assert all(ln.startswith(role + ";")
+                   for ln in sub.splitlines())
+    top = s.top(5)
+    assert top and all(
+        set(e) == {"frame", "role", "samples", "pct"} for e in top
+    )
+
+
+def test_role_tagging():
+    assert sampler.role_of("blaze-verb-service") == "verb-loop"
+    assert sampler.role_of("blaze-dispatch") == "dispatcher"
+    assert sampler.role_of("blaze-query-3") == "executor"
+    assert sampler.role_of("blaze-router-poll-x") == "poller"
+    assert sampler.role_of("blaze-router-stream-reader") == "relay"
+    assert sampler.role_of("Thread-7") == "other"
+
+
+# ---------------------------------------------------------------------------
+# PROFILE verb + STATS/METRICS surfaces through both tiers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(5)
+    p = str(tmp_path / "c.parquet")
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 16, 4000), pa.int32()),
+            "v": pa.array(rng.random(4000), pa.float64()),
+        }),
+        p,
+    )
+    plan = HashAggregateExec(
+        FilterExec(ParquetScanExec([[FileRange(p)]]),
+                   Col("v") > 0.25),
+        keys=[],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+              (AggExpr(AggFn.COUNT_STAR, None), "n")],
+        mode=AggMode.COMPLETE,
+    )
+    return task_to_proto(plan, 0)
+
+
+def test_profile_verb_roundtrip_service_tier(dataset):
+    with QueryService(max_concurrency=2) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address) as c:
+                started = c.profile({"op": "start", "hz": 101.0})
+                assert started == {
+                    "ok": True, "tier": "service",
+                    "profiling": True,
+                }
+                assert contention.ACTIVE
+                assert sampler.current().running
+                c.run(dataset)
+                snap = c.profile({"op": "snapshot"})
+                assert snap["tier"] == "service"
+                assert snap["profile"]["hz"] == 101.0
+                assert "service_state" in snap["contention"]
+                assert isinstance(snap["top_locks"], list)
+                # per-verb wire latency rode the same roundtrips
+                assert "submit" in snap["verbs"]
+                assert set(snap["verbs"]["submit"]) == {
+                    "decode", "dispatch", "reply",
+                }
+                c.profile({"op": "reset"})
+                snap2 = c.profile({"op": "snapshot",
+                                   "collapsed": False})
+                assert snap2["profile"]["samples"] \
+                    <= snap["profile"]["samples"]
+                stopped = c.profile({"op": "stop"})
+                assert stopped["profiling"] is False
+                assert not contention.ACTIVE
+                # STATS carries the contention section on this tier
+                stats = c.stats()
+                assert "contention" in stats
+            # scrape self-metric: the second exposition carries the
+            # first scrape's cost
+            with ServiceClient(*srv.address) as c:
+                c.metrics()
+                assert "blaze_scrape_seconds" in c.metrics()
+
+
+def test_profile_verb_roundtrip_router_tier(dataset):
+    from blaze_tpu.router.proxy import Router, RouterServer
+
+    with QueryService(max_concurrency=2) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            router = Router(["%s:%d" % srv.address],
+                            start=False)
+            router.registry.poll_now()
+            try:
+                with RouterServer(router) as rsrv:
+                    with ServiceClient(*rsrv.address) as c:
+                        started = c.profile({"op": "start"})
+                        assert started["tier"] == "router"
+                        c.run(dataset)
+                        snap = c.profile({"op": "snapshot"})
+                        assert snap["tier"] == "router"
+                        assert "router_table" in snap["contention"]
+                        stats = c.stats()
+                        assert "contention" in stats
+                        c.profile({"op": "stop"})
+            finally:
+                router.close()
+    assert not contention.ACTIVE
+
+
+def test_router_stream_buffered_bytes_gauge():
+    from blaze_tpu.router.proxy import Router
+
+    r = Router([], start=False)
+    try:
+        samples = list(r._collect_metrics())
+        gauges = [s for s in samples
+                  if s[0] == "blaze_router_stream_buffered_bytes"]
+        assert gauges == [
+            ("blaze_router_stream_buffered_bytes", {}, 0, "gauge")
+        ]
+    finally:
+        r.close()
+
+
+def test_profile_verb_repeated_start_balances():
+    """N starts then one stop must fully disarm (the armed flag, not
+    a runaway refcount, owns the contention enable)."""
+    from blaze_tpu.service.wire import handle_profile_frame
+
+    for _ in range(3):
+        handle_profile_frame("service", {"op": "start", "hz": 251.0})
+    assert contention.ACTIVE
+    handle_profile_frame("service", {"op": "stop"})
+    assert not contention.ACTIVE
+    assert not sampler.current().running
+
+
+# ---------------------------------------------------------------------------
+# profile CLI end-to-end (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_cli_end_to_end(tmp_path):
+    from blaze_tpu.__main__ import main
+
+    out = str(tmp_path / "report.json")
+    rc = main([
+        "profile", "--concurrency", "1,2", "--rounds", "1",
+        "--per-client", "2", "--rows", "4096", "-o", out,
+    ])
+    assert rc == 0
+    report = json.loads(open(out).read())
+    assert report["format"] == "blaze-profile-v1"
+    assert report["tier"] == "service"
+    assert [e["concurrency"] for e in report["levels"]] == [1, 2]
+    for entry in report["levels"]:
+        assert entry["qps"] > 0
+        assert entry["contention"], "empty lock section"
+        assert entry["stacks"]["samples"] > 0
+    assert report["top_locks"], "no wait-dominated locks reported"
+    for lock in report["top_locks"]:
+        assert {"lock", "wait_s", "wait_hold_ratio"} <= set(lock)
+    assert report["per_verb_seconds"].get("submit")
+    # the acceptance bar: >= 1 collapsed stack for the verb-loop role
+    assert "verb-loop" in report["roles"]
+    assert any(ln.startswith("verb-loop;")
+               for ln in report["collapsed"].splitlines())
+    # the CLI disarms on exit
+    assert not contention.ACTIVE

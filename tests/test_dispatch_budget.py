@@ -557,6 +557,47 @@ def test_obs_hooks_add_zero_dispatches(tables):
     assert after == baseline, (after, baseline)
 
 
+def test_contention_hooks_add_zero_dispatches(tables):
+    """ISSUE 15 acceptance: lock-wait accounting + the stack sampler
+    are pure host-side observation. Armed (accounting recording,
+    sampler walking stacks at 200 Hz) the per-shape dispatch budget
+    stays exact, and disarmed the budget is byte-identical to the
+    pre-arm baseline - the off path is one module-attribute check
+    per acquire."""
+    from blaze_tpu.obs import contention, sampler
+
+    assert not contention.ACTIVE  # accounting is strictly opt-in
+
+    def mk():
+        return fuse_pipelines(HashAggregateExec(
+            ProjectExec(
+                MemoryScanExec([[tables["fact"]]],
+                               tables["fact"].schema),
+                [(Col("price"), "p")],
+            ),
+            keys=[],
+            aggs=[(AggExpr(AggFn.SUM, Col("p")), "s")],
+            mode=AggMode.COMPLETE,
+        ))
+
+    baseline = _counts(lambda: run_plan(mk()))
+    contention.enable()
+    sampler.start(hz=200.0)
+    try:
+        armed = _counts(lambda: run_plan(mk()))
+    finally:
+        sampler.stop()
+        contention.disable()
+    assert not contention.ACTIVE
+    for k in ("dispatches", "h2d_batches", "d2h_fetches",
+              "d2h_syncs", "kernel_builds"):
+        assert armed.get(k, 0) == baseline.get(k, 0), (k, armed)
+    _check(armed, dispatches=1, h2d=0, d2h=1)
+    # contention-off after the armed run: byte-identical to baseline
+    after = _counts(lambda: run_plan(mk()))
+    assert after == baseline, (after, baseline)
+
+
 def test_mesh_groupby_budget():
     """ISSUE 7: dispatch budgets extend to MESH plans. A global
     grouped aggregate over an 8-partition source, lowered onto the
