@@ -1289,7 +1289,11 @@ def child(n_rows):
             try:
                 with TaskGatewayServer(service=svc) as srv:
                     host, port = srv.address
-                    for conc in (1, 4, 16):
+                    # c64 rides the async wire plane (event-loop verb
+                    # serving): 64 blocked reader threads would thrash
+                    # the threaded tier - the monotone-in-concurrency
+                    # smoke pin guards exactly that collapse
+                    for conc in (1, 4, 16, 64):
                         name = (
                             f"service_qps_c{conc}_"
                             f"{'cache' if cache_on else 'nocache'}"
@@ -1437,6 +1441,104 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- streaming under fan-in: 16 concurrent FETCH streams against
+    # one gateway. The async wire plane serves every stream from the
+    # loop (no reader/writer thread pairs), so first-part latency must
+    # hold up under fan-in instead of queueing behind 15 blocked
+    # threads. `median` is the worst client's TTLP (the e2e bar);
+    # first_part_s is the median client's TTFP. ----
+    try:
+        import threading as _st_threading
+
+        from blaze_tpu.config import get_config as _get_cfg16
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _St16Gateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _St16Service,
+            ServiceClient as _St16Client,
+        )
+
+        st16_conc = 16
+        prev_cfg16 = _get_cfg16()
+        set_config(EngineConfig(batch_size=stream_bs))
+        st16_svc = _St16Service(max_concurrency=16)
+        try:
+            with _St16Gateway(service=st16_svc) as st16_srv:
+                h16, p16 = st16_srv.address
+
+                def stream_client(out, i):
+                    try:
+                        with _St16Client(h16, p16) as cl:
+                            st = cl.submit(st_blob, use_cache=False)
+                            t0 = time.perf_counter()
+                            first = last = None
+                            for _rb in cl.fetch_stream(
+                                st["query_id"]
+                            ):
+                                now = time.perf_counter()
+                                if first is None:
+                                    first = now - t0
+                                last = now - t0
+                        out[i] = (first, last)
+                    except Exception as e:  # noqa: BLE001
+                        out[i] = e
+
+                def fanin_round():
+                    out = [None] * st16_conc
+                    ts = [
+                        _st_threading.Thread(
+                            target=stream_client, args=(out, i)
+                        )
+                        for i in range(st16_conc)
+                    ]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    for o in out:
+                        if isinstance(o, Exception):
+                            raise o
+                    firsts = sorted(o[0] for o in out)
+                    lasts = sorted(o[1] for o in out)
+                    return firsts[len(firsts) // 2], lasts[-1]
+
+                k16 = int(os.environ.get("BLAZE_BENCH_ITERS", 3))
+                fanin_round()  # warm-up
+                rounds = sorted(
+                    (fanin_round() for _ in range(k16)),
+                    key=lambda r: r[1],
+                )
+                ttfp16, ttlp16 = rounds[len(rounds) // 2]
+                worst = [r[1] for r in rounds]
+                detail["stream_first_byte_c16"] = {
+                    "median": round(ttlp16, 4),
+                    "spread": round(
+                        (worst[-1] - worst[0]) / ttlp16
+                        if ttlp16 else 0.0, 3,
+                    ),
+                    "k": k16,
+                    "first_part_s": round(ttfp16, 4),
+                    "ttfp_over_ttlp": (
+                        round(ttfp16 / ttlp16, 3) if ttlp16 else 0.0
+                    ),
+                    "concurrency": st16_conc,
+                }
+        finally:
+            st16_svc.close()
+            set_config(prev_cfg16)
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "stream_first_byte_c16", "backend": backend,
+                 **detail["stream_first_byte_c16"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["stream_first_byte_c16"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     # ---- replica router: a repeated-query mix through TWO replicas,
     # affinity vs random placement (ISSUE 5 satellite). Every round
     # submits `rt_conc` repeats of `rt_distinct` fresh plans (fresh
@@ -1576,6 +1678,99 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- router-fronted c64 (the tentpole's fan-in bar at the relay
+    # tier): 64 clients hammering ONE warm cached plan through the
+    # router front. Both hops (client->router, router->replica) ride
+    # the event-loop wire plane; the shape measures pure serving +
+    # relay overhead at a concurrency the thread-per-connection front
+    # could not hold without 64 parked reader threads. ----
+    try:
+        import threading as _rt64_threading
+
+        from blaze_tpu.router import (
+            Router as _Rt64Router,
+            RouterServer as _Rt64Server,
+        )
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _Rt64Gateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _Rt64Service,
+            ServiceClient as _Rt64Client,
+        )
+
+        rt64_conc = 64
+        rt64_per_client = 2
+        svcs64 = [
+            _Rt64Service(max_concurrency=16) for _ in range(2)
+        ]
+        srvs64 = [
+            _Rt64Gateway(service=s).start() for s in svcs64
+        ]
+        router64 = _Rt64Router(
+            ["%s:%d" % s.address for s in srvs64],
+            poll_interval_s=0.2,
+            start=True,
+        )
+        rs64 = _Rt64Server(router64).start()
+        try:
+            router64.registry.poll_now()
+            h64, p64 = rs64.address
+
+            def rt64_round():
+                errs = []
+
+                def client():
+                    try:
+                        with _Rt64Client(h64, p64) as cl:
+                            for _ in range(rt64_per_client):
+                                cl.run(svc_blob)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+
+                ts = [
+                    _rt64_threading.Thread(target=client)
+                    for _ in range(rt64_conc)
+                ]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errs:
+                    raise RuntimeError(errs[0])
+
+            rt64_round()  # warm-up: cache the plan fleet-wide
+            med, spread, k, _ = timed(rt64_round, iters=3)
+            detail["router_qps_c64"] = {
+                "median": round(med, 4),
+                "spread": round(spread, 3),
+                "k": k,
+                "qps": round(
+                    rt64_conc * rt64_per_client / med, 1
+                ),
+                "concurrency": rt64_conc,
+                "replicas": 2,
+                "rows_per_query": n_svc,
+            }
+        finally:
+            rs64.stop()
+            router64.close()
+            for s in srvs64:
+                s.stop()
+            for s in svcs64:
+                s.close()
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "router_qps_c64", "backend": backend,
+                 **detail["router_qps_c64"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["router_qps_c64"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     geomean = (
         math.exp(sum(math.log(r) for r in ratios) / len(ratios))
         if ratios else 0.0
@@ -1644,7 +1839,8 @@ def smoke():
             [sys.executable, "-u", os.path.abspath(__file__),
              "--child", str(rows)],
             # the battery + the two mesh_groupby_d{1,8} subprocesses
-            capture_output=True, text=True, timeout=420, env=env,
+            # + the c64 / fan-in serving shapes
+            capture_output=True, text=True, timeout=540, env=env,
         )
     except subprocess.TimeoutExpired as e:
         # a wedged child must fail the smoke as a PROBLEM with
@@ -1726,6 +1922,65 @@ def smoke():
         elif stq:
             problems.append(
                 f"stream_first_byte_8m failed: {stq.get('error')}"
+            )
+        # monotone-in-concurrency pin (async wire plane): cached qps
+        # must not DROP as clients pile on - c1 -> c4 -> c16
+        # non-decreasing, and c64 holds >= 0.8x of c16. Each step is
+        # spread-guarded: on a noisy host the qps drop must also
+        # exceed the two rounds' own noise band before it reddens the
+        # smoke. A violation here is the thread-per-connection
+        # collapse shape (parked readers starving the accept loop).
+        qshapes = {
+            c: (result.get("queries") or {}).get(
+                f"service_qps_c{c}_cache"
+            ) or {}
+            for c in (1, 4, 16, 64)
+        }
+        if all(q and "error" not in q for q in qshapes.values()):
+            def _qps(c):
+                return float(qshapes[c].get("qps", 0.0))
+
+            def _noise(a, b):
+                # qps noise band: spread is on round TIME; qps scales
+                # inversely, so the band is qps * spread of each side
+                return (
+                    _qps(a) * float(qshapes[a].get("spread", 0.0))
+                    + _qps(b) * float(qshapes[b].get("spread", 0.0))
+                )
+
+            for lo, hi in ((1, 4), (4, 16)):
+                if _qps(hi) < _qps(lo) \
+                        and (_qps(lo) - _qps(hi)) > _noise(lo, hi):
+                    problems.append(
+                        f"cached qps not monotone: c{hi} "
+                        f"{_qps(hi)} < c{lo} {_qps(lo)} beyond "
+                        "noise (concurrency collapse)"
+                    )
+            floor64 = 0.8 * _qps(16)
+            if _qps(64) < floor64 \
+                    and (floor64 - _qps(64)) > _noise(16, 64):
+                problems.append(
+                    f"c64 qps {_qps(64)} < 0.8x c16 "
+                    f"({round(floor64, 1)}) beyond noise "
+                    "(fan-in collapse at 64 connections)"
+                )
+        else:
+            for c, q in qshapes.items():
+                if q and "error" in q:
+                    problems.append(
+                        f"service_qps_c{c}_cache failed: "
+                        f"{q['error']}"
+                    )
+        # router-fronted fan-in (the tentpole's relay-tier bar): the
+        # shape records {"error": ...} instead of raising, so an
+        # erroring c64 relay (e.g. the cross-tier dispatch-pool
+        # deadlock) must be surfaced here, not silently skipped
+        rq64 = (result.get("queries") or {}).get("router_qps_c64") or {}
+        if not rq64:
+            problems.append("router_qps_c64 missing from artifact")
+        elif "error" in rq64:
+            problems.append(
+                f"router_qps_c64 failed: {rq64['error']}"
             )
         obs = (result.get("queries") or {}).get("obs_overhead") or {}
         if obs and "error" not in obs:

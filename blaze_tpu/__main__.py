@@ -154,7 +154,9 @@ def cmd_serve(args) -> int:
         sampler.start(hz=args.profile_hz)
     # serve_blocking (NOT start()): the main thread is the only
     # accept loop - see TaskGatewayServer.serve_blocking
-    srv = TaskGatewayServer(args.host, args.port, service=service)
+    srv = TaskGatewayServer(
+        args.host, args.port, service=service, wire=args.wire
+    )
     print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
     announcer = None
     if args.router:
@@ -292,6 +294,8 @@ def cmd_route(args) -> int:
         recover_timeout_s=args.recover_timeout,
         stream_window=args.stream_window,
         stream_stall_s=args.stream_stall_s,
+        stream_total_bytes=args.stream_total_bytes,
+        wire=args.wire,
     )
     return 0
 
@@ -742,6 +746,12 @@ def main(argv=None) -> int:
                          "thread-stack sampler at this Hz for the "
                          "process lifetime (0 = off; the PROFILE "
                          "verb can arm a live server without it)")
+    sv.add_argument("--wire", default=None,
+                    choices=("async", "threaded"),
+                    help="wire data plane: event-loop verb serving "
+                         "(async, the default) or the legacy thread-"
+                         "per-connection tier (threaded); default "
+                         "honors BLAZE_WIRE")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -811,10 +821,24 @@ def main(argv=None) -> int:
                          "relay aborted (downstream keeps the parts; "
                          "a re-FETCH resumes; never a breaker "
                          "strike; 0 disables)")
+    rr.add_argument("--stream-total-bytes", type=int,
+                    default=256 << 20,
+                    help="fleet-wide relay memory cap: total parked "
+                         "(read-from-replica, not-yet-delivered) "
+                         "bytes across ALL concurrent relay streams; "
+                         "an over-budget stream's reader waits "
+                         "(stream_total_waits counts them) until "
+                         "siblings drain (0 disables)")
     rr.add_argument("--profile-hz", type=float, default=0.0,
                     help="arm lock-wait accounting and run the "
                          "thread-stack sampler at this Hz for the "
                          "router's lifetime (0 = off)")
+    rr.add_argument("--wire", default=None,
+                    choices=("async", "threaded"),
+                    help="wire data plane: event-loop relay (async, "
+                         "the default) or the legacy thread-per-"
+                         "connection front (threaded); default "
+                         "honors BLAZE_WIRE")
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "

@@ -22,6 +22,7 @@ skipping what the client already received.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import itertools
 import logging
@@ -33,6 +34,7 @@ import socket
 import socketserver
 import threading
 import time
+from functools import partial
 from typing import Dict, Iterator, List, Optional
 
 from blaze_tpu.errors import ReplicaUnavailableError
@@ -166,6 +168,7 @@ class Router:
         fetch_block_s: float = 0.5,
         stream_window: int = 4,
         stream_stall_s: float = 30.0,
+        stream_total_bytes: int = 256 << 20,
         enable_trace: bool = True,
         conn_pool_size: int = 4,
         replicate_hot_k: int = 4,
@@ -190,6 +193,12 @@ class Router:
         # its backpressure pin downstream buffers fleet-wide
         self.stream_window = max(1, int(stream_window))
         self.stream_stall_s = float(stream_stall_s)
+        # fleet-wide relay-memory cap: total bytes parked across ALL
+        # concurrent relay windows (<= 0 disables). Over-budget
+        # streams wait before accounting a new part; a stream with
+        # nothing parked always admits one part (progress beats the
+        # bound - the StreamBuffer single-oversized-part rule)
+        self.stream_total_bytes = int(stream_total_bytes)
         self.recover_timeout_s = float(recover_timeout_s)
         self.registry = ReplicaRegistry(
             replicas,
@@ -229,6 +238,7 @@ class Router:
             "no_replica": 0,
             "stream_stalls": 0,
             "stream_window_waits": 0,
+            "stream_total_waits": 0,
         }
         # fleet-wide relay-window memory: bytes currently parked in
         # the bounded per-stream relay queues of _raw_fetch_windowed,
@@ -1733,6 +1743,46 @@ class Router:
                self._stream_buffered, "gauge")
 
     # -- FETCH passthrough -----------------------------------------------
+    def _splice_note(self, rq, i: int, payload: bytes) -> bool:
+        """Verify part `i` against (or extend) the canonical part
+        record: parts the client already received - from this stream
+        or a previous aborted one - must be byte-identical in a
+        re-executed result, or the client's count-based resume would
+        splice two different results into one corrupt table. Returns
+        True when the stream is splice-broken. Shared by the threaded
+        and event-loop relay paths."""
+        h = hashlib.blake2b(payload, digest_size=16).digest()
+        with rq.lock:
+            if i < len(rq.delivered_hashes):
+                if rq.delivered_hashes[i] != h:
+                    rq.splice_broken = True
+            else:
+                rq.delivered_hashes.append(h)
+        return rq.splice_broken
+
+    def _relay_admit(self, nbytes: int, pending: list) -> bool:
+        """Try to account `nbytes` of relay-parked payload against the
+        router-wide gauge AND the fleet-wide stream_total_bytes
+        budget. `pending` is this stream's share cell ([bytes]). A
+        stream with nothing parked always admits one part (progress
+        beats the bound); returns False when the caller must wait."""
+        with self._stream_buffered_mu:
+            if (
+                self.stream_total_bytes > 0
+                and pending[0] > 0
+                and self._stream_buffered + nbytes
+                > self.stream_total_bytes
+            ):
+                return False
+            pending[0] += nbytes
+            self._stream_buffered += nbytes
+            return True
+
+    def _relay_release(self, nbytes: int, pending: list) -> None:
+        with self._stream_buffered_mu:
+            pending[0] -= nbytes
+            self._stream_buffered -= nbytes
+
     def stream_parts(self, external_id: str,
                      timeout_ms: int = 0) -> Iterator[bytes]:
         """Yield the raw segmented-IPC part payloads for one query,
@@ -1765,22 +1815,7 @@ class Router:
                     for i, payload in enumerate(self._raw_fetch(
                         replica, rq.internal_id, timeout_ms
                     )):
-                        # verify against (or extend) the canonical part
-                        # record: parts the client already received - from
-                        # this stream or a previous aborted one - must be
-                        # byte-identical in a re-executed result, or the
-                        # client's count-based resume would splice two
-                        # different results into one corrupt table
-                        h = hashlib.blake2b(
-                            payload, digest_size=16
-                        ).digest()
-                        with rq.lock:
-                            if i < len(rq.delivered_hashes):
-                                if rq.delivered_hashes[i] != h:
-                                    rq.splice_broken = True
-                            else:
-                                rq.delivered_hashes.append(h)
-                        if rq.splice_broken:
+                        if self._splice_note(rq, i, payload):
                             raise ServiceError(_SPLICE_ERR)
                         if i < sent:
                             continue  # already delivered on this stream
@@ -1928,8 +1963,10 @@ class Router:
         full window parks the READER (the downstream replica's own
         stream buffer absorbs the backpressure and accounts it against
         the query's reservation); `stream_window_waits` counts parts
-        that had to park. Queue items: ("part", payload) in order,
-        then exactly one ("end", None) or ("err", exc)."""
+        that had to park, `stream_total_waits` parts held back by the
+        FLEET-WIDE stream_total_bytes budget across concurrent
+        streams. Queue items: ("part", payload) in order, then exactly
+        one ("end", None) or ("err", exc)."""
         from blaze_tpu.runtime.gateway import _FLAG_SERVICE
         from blaze_tpu.service.wire import ServiceClient
 
@@ -1941,17 +1978,20 @@ class Router:
         # abandoned stream cannot leak gauge weight
         pending = [0]
 
-        def _acct(delta: int) -> None:
-            with self._stream_buffered_mu:
-                pending[0] += delta
-                self._stream_buffered += delta
-
         def _put(item) -> bool:
             waited = False
             if item[0] == "part":
                 # account BEFORE parking so the gauge covers the
-                # window-full wait, not just settled parts
-                _acct(len(item[1]))
+                # window-full wait, not just settled parts - gated on
+                # the shared relay-memory budget first
+                total_waited = False
+                while not self._relay_admit(len(item[1]), pending):
+                    if not total_waited:
+                        total_waited = True
+                        with self._lock:
+                            self.counters["stream_total_waits"] += 1
+                    if stop.wait(0.05):
+                        return False
             while not stop.is_set():
                 try:
                     window.put(item, timeout=0.1)
@@ -1962,7 +2002,7 @@ class Router:
                         with self._lock:
                             self.counters["stream_window_waits"] += 1
             if item[0] == "part":
-                _acct(-len(item[1]))
+                self._relay_release(len(item[1]), pending)
             return False  # consumer gone: drop, reader exits
 
         def _reader() -> None:
@@ -2008,7 +2048,7 @@ class Router:
             while True:
                 kind, payload = window.get()
                 if kind == "part":
-                    _acct(-len(payload))
+                    self._relay_release(len(payload), pending)
                     yield payload
                 elif kind == "end":
                     return
@@ -2044,6 +2084,293 @@ class Router:
             try:
                 chunk = sock.recv(n - len(buf))
             except socket.timeout:
+                if not replica.routable():
+                    raise ConnectionError(
+                        f"replica {replica.replica_id} unroutable "
+                        "mid-FETCH"
+                    ) from None
+                if buf:
+                    stalled += 1
+                    if stalled > max_midframe:
+                        raise ConnectionError(
+                            "mid-frame stall from "
+                            f"{replica.replica_id}"
+                        ) from None
+                continue
+            if not chunk:
+                raise ConnectionError("EOF from replica mid-FETCH")
+            stalled = 0
+            buf += chunk
+        return bytes(buf)
+
+    # -- event-loop relay (service/wire_async.py data plane) -----------
+    async def stream_parts_async(self, external_id: str,
+                                 timeout_ms: int = 0):
+        """Coroutine twin of stream_parts: the same failover ladder,
+        splice verification, and tracer span, with the downstream
+        FETCH riding the wire loop (no reader thread per open
+        stream). Blocking failure-path helpers (reconcile, downstream
+        status, resubmit) run on the default executor - they are rare
+        and must not starve the bounded verb-dispatch pool."""
+        loop = asyncio.get_running_loop()
+        rq = self.get(external_id)
+        if rq.splice_broken:
+            raise ServiceError(_SPLICE_ERR)
+        await loop.run_in_executor(None, self._await_reconcile, rq)
+        sent = 0
+        cycles = 0
+        max_cycles = 3 + self.max_resubmits \
+            + len(self.registry.replicas)
+        stream_t0 = time.monotonic()
+        completed = False
+        try:
+            while True:
+                gen = rq.generation
+                replica = self.registry.get(rq.replica_id or "")
+                if replica is None:
+                    raise ServiceError(
+                        f"UNKNOWN: no replica for {external_id}"
+                    )
+                try:
+                    agen = self._raw_fetch_async(
+                        replica, rq.internal_id, timeout_ms
+                    )
+                    try:
+                        i = -1
+                        async for payload in agen:
+                            i += 1
+                            if self._splice_note(rq, i, payload):
+                                raise ServiceError(_SPLICE_ERR)
+                            if i < sent:
+                                continue  # delivered on this stream
+                            sent += 1
+                            yield payload
+                    finally:
+                        try:
+                            await agen.aclose()
+                        except Exception:  # noqa: BLE001 - teardown
+                            pass
+                    completed = True
+                    await loop.run_in_executor(
+                        None, self._finish, rq, "DONE"
+                    )
+                    return
+                except ServiceError as e:
+                    if rq.splice_broken:
+                        await loop.run_in_executor(
+                            None, self._finish, rq, "FAILED"
+                        )
+                        raise
+                    cycles += 1
+                    if cycles > max_cycles:
+                        raise
+                    if e.state == "FAILED":
+                        st = await loop.run_in_executor(
+                            None, self._downstream_status, rq
+                        )
+                        if st.get("state") == "FAILED" \
+                                and not rq.finished:
+                            st = await loop.run_in_executor(
+                                None, self._observe_failed, rq, st
+                            )
+                        if st.get("state") == "FAILED" or rq.finished:
+                            await loop.run_in_executor(
+                                None, self._finish, rq,
+                                st.get("state"),
+                            )
+                            raise
+                        continue  # re-routed or retrying: fetch again
+                    if e.state == "UNKNOWN":
+                        moved = await loop.run_in_executor(
+                            None,
+                            partial(self._resubmit, rq, gen,
+                                    same_replica=False, exclude=set(),
+                                    counter="failovers"),
+                        )
+                        if moved:
+                            continue
+                    raise
+                except (ConnectionError, OSError) as e:
+                    cycles += 1
+                    if cycles > max_cycles:
+                        raise
+                    if rq.generation != gen:
+                        continue  # death callback already moved it
+                    self.breaker.note_fatal(
+                        replica.replica_id, kind="transport"
+                    )
+                    if replica.routable():
+                        continue  # transient drop: re-FETCH same
+                    moved = await loop.run_in_executor(
+                        None,
+                        partial(self._resubmit, rq, gen,
+                                same_replica=False,
+                                exclude={replica.replica_id},
+                                counter="failovers"),
+                    )
+                    if not moved:
+                        raise ReplicaUnavailableError(
+                            f"replica {replica.replica_id} lost "
+                            f"mid-FETCH of {external_id}: {e!r}"
+                        ) from e
+        finally:
+            if rq.tracer is not None:
+                tags = {"parts": sent}
+                if cycles:
+                    tags["resumes"] = cycles
+                if not completed:
+                    tags["aborted"] = True
+                try:
+                    rq.tracer.record_span(
+                        "router_stream", stream_t0,
+                        time.monotonic(), **tags,
+                    )
+                except Exception:  # noqa: BLE001 - obs must not raise
+                    pass
+
+    async def _raw_fetch_async(self, replica: Replica,
+                               internal_id: str, timeout_ms: int):
+        """One downstream FETCH on the wire loop. The credit window is
+        an asyncio.Queue filled by a reader coroutine (the threaded
+        tier's reader THREAD, without the thread); window<=1 keeps the
+        strictly-serial path. Same budget gates, same counters."""
+        from blaze_tpu.runtime.gateway import _FLAG_SERVICE
+        from blaze_tpu.service.wire import ServiceClient
+
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(replica.host, replica.port),
+                timeout=min(self.downstream_timeout_s, 10.0),
+            )
+        except asyncio.TimeoutError as e:
+            raise ConnectionError(
+                f"connect to {replica.replica_id} timed out"
+            ) from e
+        pending = [0]
+        fill_task = None
+        try:
+            writer.write(
+                _U64.pack(_FLAG_SERVICE)
+                + ServiceClient._id_verb(
+                    VERB_FETCH, internal_id, timeout_ms
+                )
+            )
+            await writer.drain()
+            if self.stream_window <= 1:
+                while True:
+                    (length,) = _U64.unpack(
+                        await self._recv_checked_async(
+                            reader, _U64.size, replica
+                        )
+                    )
+                    if length == 0:
+                        return
+                    if length == _ERR:
+                        (mlen,) = _U32.unpack(
+                            await self._recv_checked_async(
+                                reader, _U32.size, replica
+                            )
+                        )
+                        raise ServiceError(
+                            (await self._recv_checked_async(
+                                reader, mlen, replica
+                            )).decode("utf-8")
+                        )
+                    yield await self._recv_checked_async(
+                        reader, length, replica
+                    )
+            window: asyncio.Queue = asyncio.Queue(
+                maxsize=self.stream_window
+            )
+
+            async def _fill():
+                try:
+                    while True:
+                        (length,) = _U64.unpack(
+                            await self._recv_checked_async(
+                                reader, _U64.size, replica
+                            )
+                        )
+                        if length == 0:
+                            await window.put(("end", None))
+                            return
+                        if length == _ERR:
+                            (mlen,) = _U32.unpack(
+                                await self._recv_checked_async(
+                                    reader, _U32.size, replica
+                                )
+                            )
+                            msg = (await self._recv_checked_async(
+                                reader, mlen, replica
+                            )).decode("utf-8")
+                            await window.put(
+                                ("err", ServiceError(msg))
+                            )
+                            return
+                        payload = await self._recv_checked_async(
+                            reader, length, replica
+                        )
+                        total_waited = False
+                        while not self._relay_admit(
+                            len(payload), pending
+                        ):
+                            if not total_waited:
+                                total_waited = True
+                                with self._lock:
+                                    self.counters[
+                                        "stream_total_waits"
+                                    ] += 1
+                            await asyncio.sleep(0.02)
+                        if window.full():
+                            with self._lock:
+                                self.counters[
+                                    "stream_window_waits"
+                                ] += 1
+                        await window.put(("part", payload))
+                except asyncio.CancelledError:
+                    raise
+                except BaseException as e:  # noqa: BLE001 - relayed
+                    await window.put(("err", e))
+
+            fill_task = asyncio.get_running_loop().create_task(
+                _fill()
+            )
+            while True:
+                kind, payload = await window.get()
+                if kind == "part":
+                    self._relay_release(len(payload), pending)
+                    yield payload
+                elif kind == "end":
+                    return
+                else:
+                    raise payload
+        finally:
+            if fill_task is not None:
+                fill_task.cancel()
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            # consumer done: whatever this stream still attributes to
+            # the gauge is residual - drop it
+            with self._stream_buffered_mu:
+                self._stream_buffered -= pending[0]
+                pending[0] = 0
+
+    async def _recv_checked_async(self, reader, n: int,
+                                  replica: Replica) -> bytes:
+        """Async twin of _recv_checked: fetch_block_s read slices,
+        aborting promptly when the replica goes unroutable mid-wait,
+        with the same mid-frame stall bound."""
+        buf = bytearray()
+        stalled = 0
+        max_midframe = max(4, int(60.0 / self.fetch_block_s))
+        while len(buf) < n:
+            try:
+                chunk = await asyncio.wait_for(
+                    reader.read(n - len(buf)), self.fetch_block_s
+                )
+            except asyncio.TimeoutError:
                 if not replica.routable():
                     raise ConnectionError(
                         f"replica {replica.replica_id} unroutable "
@@ -2179,6 +2506,65 @@ class RouterVerbBackend:
                 except OSError:
                     pass  # connection already torn down
 
+    async def fetch_async(self, writer, qid: str,
+                          timeout_ms: int) -> None:
+        """Event-loop relay FETCH: same ladder as fetch(), with the
+        slow-client stall enforced by a drain timeout (the coroutine
+        parks, not an OS thread)."""
+        router = self.router
+        stall_s = router.stream_stall_s
+        sent = 0
+        agen = router.stream_parts_async(qid, timeout_ms)
+        try:
+            try:
+                async for payload in agen:
+                    writer.write(_U64.pack(len(payload)) + payload)
+                    try:
+                        if stall_s > 0:
+                            await asyncio.wait_for(
+                                writer.drain(), stall_s
+                            )
+                        else:
+                            await writer.drain()
+                    except asyncio.TimeoutError as e:
+                        with router._lock:
+                            router.counters["stream_stalls"] += 1
+                        raise ConnectionError(
+                            f"relay send stalled past {stall_s}s "
+                            f"for {qid}"
+                        ) from e
+                    sent += 1
+                writer.write(_U64.pack(0))
+                await writer.drain()
+            except KeyError:
+                if sent:
+                    raise ConnectionError(
+                        "fetch aborted after parts sent"
+                    ) from None
+                from blaze_tpu.service.wire_async import _send_err \
+                    as _send_err_async
+
+                await _send_err_async(
+                    writer, f"UNKNOWN: no query {qid}"
+                )
+            except (ServiceError, ReplicaUnavailableError) as e:
+                if sent:
+                    raise ConnectionError(
+                        f"fetch stream aborted: {e!r}"
+                    ) from e
+                msg = str(e)
+                if isinstance(e, ReplicaUnavailableError):
+                    msg = f"REJECTED_OVERLOADED: {msg}"
+                from blaze_tpu.service.wire_async import _send_err \
+                    as _send_err_async
+
+                await _send_err_async(writer, msg)
+        finally:
+            try:
+                await agen.aclose()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
 
 def handle_router_connection(sock, router: Router) -> None:
     """Drive one client connection against the router through the
@@ -2218,29 +2604,72 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class RouterServer:
-    """TCP front for a Router: ServiceClient-compatible listener."""
+    """TCP front for a Router: ServiceClient-compatible listener.
+    `wire` picks the data plane exactly like TaskGatewayServer:
+    "async" (event-loop relay, the default) or "threaded" (the legacy
+    thread-per-connection front, the differential oracle). BLAZE_WIRE
+    overrides the default."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, wire: Optional[str] = None):
+        if wire is None:
+            wire = os.environ.get("BLAZE_WIRE", "async")
+        if wire not in ("async", "threaded"):
+            raise ValueError(f"unknown wire mode {wire!r}")
+        self.wire = wire
         self.router = router
-        self._srv = _Server((host, port), _RouterHandler)
-        self._srv.router = router
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True,
-            name="blaze-router-accept",
+        self._srv = None
+        self._async = None
+        self._thread = None
+        if wire == "threaded":
+            self._srv = _Server((host, port), _RouterHandler)
+            self._srv.router = router
+            self._thread = threading.Thread(
+                target=self._srv.serve_forever, daemon=True,
+                name="blaze-router-accept",
+            )
+        else:
+            from blaze_tpu.service import wire_async
+
+            self._async = wire_async.AsyncWireServer(
+                host, port, self._handle_async
+            )
+
+    async def _handle_async(self, conn):
+        from blaze_tpu.service import wire_async
+
+        router = self.router
+        await wire_async.handle_wire_connection(
+            conn,
+            backend_factory=lambda: RouterVerbBackend(router),
+            legacy=None,
         )
 
     @property
     def address(self):
+        if self._async is not None:
+            return self._async.address
         return self._srv.server_address
 
     def start(self) -> "RouterServer":
-        self._thread.start()
+        if self._async is not None:
+            self._async.start()
+        else:
+            self._thread.start()
         return self
 
+    def serve_blocking(self) -> None:
+        if self._async is not None:
+            self._async.serve_blocking()
+        else:
+            self._srv.serve_forever()
+
     def stop(self) -> None:
-        self._srv.shutdown()
-        self._srv.server_close()
+        if self._async is not None:
+            self._async.stop()
+        else:
+            self._srv.shutdown()
+            self._srv.server_close()
 
     def __enter__(self):
         return self.start()
@@ -2249,7 +2678,7 @@ class RouterServer:
         self.stop()
 
 
-def route_forever(host: str, port: int, replicas,
+def route_forever(host: str, port: int, replicas, wire=None,
                   **router_kw) -> None:  # pragma: no cover - CLI
     router = Router(replicas, **router_kw)
     try:
@@ -2258,13 +2687,13 @@ def route_forever(host: str, port: int, replicas,
             r.replica_id
             for r in router.registry.replicas.values() if r.alive
         ]
-        srv = RouterServer(router, host, port)
+        srv = RouterServer(router, host, port, wire=wire)
         print(
             f"blaze_tpu router listening on {srv.address} -> "
             f"{len(alive)}/{len(router.registry.replicas)} replicas "
             f"alive {alive}",
             flush=True,
         )
-        srv._srv.serve_forever()
+        srv.serve_blocking()
     finally:
         router.close()

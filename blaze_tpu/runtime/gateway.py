@@ -36,9 +36,11 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import os
 import socketserver
 import struct
 import threading
+from typing import Optional
 
 from blaze_tpu.runtime.transport import _recv_exact
 
@@ -78,95 +80,110 @@ def _manifest_resources(manifest: dict):
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
-        from blaze_tpu.io.ipc import encode_ipc_segment
-        from blaze_tpu.runtime.executor import ExecContext, execute_task
-
         sock = self.request
         try:
             (header,) = _U64.unpack(_recv_exact(sock, _U64.size))
-            if header & _FLAG_SERVICE:
-                # multi-query service connection (service/wire.py);
-                # requires a QueryService attached to the server
-                service = getattr(self.server, "service", None)
-                if service is None:
-                    msg = b"no query service attached"
+        except Exception:
+            return
+        if header & _FLAG_SERVICE:
+            # multi-query service connection (service/wire.py);
+            # requires a QueryService attached to the server
+            service = getattr(self.server, "service", None)
+            if service is None:
+                msg = b"no query service attached"
+                try:
                     sock.sendall(
                         _U64.pack(_ERR) + _U32.pack(len(msg)) + msg
                     )
-                    return
-                from blaze_tpu.service.wire import (
-                    handle_service_connection,
-                )
-
-                handle_service_connection(sock, service)
-                return
-            is_ref = bool(header & _FLAG_REF)
-            has_manifest = bool(header & _FLAG_MANIFEST)
-            blob_len = header & ~(
-                _FLAG_REF | _FLAG_MANIFEST | _FLAG_SERVICE
-            )
-            if blob_len > MAX_TASK_BYTES:
-                raise ValueError("task too large")
-            manifest_raw = None
-            if has_manifest:
-                (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
-                if mlen > MAX_TASK_BYTES:
-                    raise ValueError("manifest too large")
-                manifest_raw = _recv_exact(sock, mlen)
-            blob = _recv_exact(sock, blob_len)
-        except Exception:
-            return
-        batches = None
-        try:
-            # manifest SEMANTIC failures (bad JSON, missing keys) get
-            # the documented error frame - only framing failures above
-            # drop the connection
-            resources = (
-                _manifest_resources(json.loads(manifest_raw))
-                if manifest_raw is not None else {}
-            )
-            ctx = ExecContext()
-            ctx.resources.update(resources)
-            if is_ref:
-                from blaze_tpu.plan.refcompat import (
-                    execute_reference_task,
-                )
-
-                batches = execute_reference_task(blob, ctx=ctx)
-            else:
-                batches = execute_task(blob, ctx=ctx)
-            it = iter(batches)
-            while True:
-                rb = next(it, None)  # execution errors surface here
-                if rb is None:
-                    break
-                part = encode_ipc_segment(rb)
-                try:
-                    sock.sendall(part)  # already u64-LE length-prefixed
                 except OSError:
-                    # client hung up mid-stream: this is a CANCELLATION,
-                    # not an execution failure (the executor's
-                    # GeneratorExit pass-through, executor.py) - close
-                    # the task generator so operators unwind cleanly
-                    # and keep the engine unpoisoned; no error frame,
-                    # no task-failure logging
-                    it.close()
-                    log.info(
-                        "client disconnected mid-stream; task cancelled"
-                    )
-                    return
-            sock.sendall(_U64.pack(0))
-        except Exception as e:
-            msg = str(e).encode("utf-8")[:65536]
+                    pass
+                return
+            from blaze_tpu.service.wire import (
+                handle_service_connection,
+            )
+
+            handle_service_connection(sock, service)
+            return
+        handle_legacy_connection(sock, header)
+
+
+def handle_legacy_connection(sock, header: int) -> None:
+    """One-shot task exchange (the pre-service gateway protocol); the
+    hello u64 is already consumed. Shared by the threaded handler
+    above and the event-loop plane (service/wire_async.py), which
+    hands legacy connections to a daemon thread - task execution is
+    blocking, thread-shaped work."""
+    from blaze_tpu.io.ipc import encode_ipc_segment
+    from blaze_tpu.runtime.executor import ExecContext, execute_task
+
+    try:
+        is_ref = bool(header & _FLAG_REF)
+        has_manifest = bool(header & _FLAG_MANIFEST)
+        blob_len = header & ~(
+            _FLAG_REF | _FLAG_MANIFEST | _FLAG_SERVICE
+        )
+        if blob_len > MAX_TASK_BYTES:
+            raise ValueError("task too large")
+        manifest_raw = None
+        if has_manifest:
+            (mlen,) = _U32.unpack(_recv_exact(sock, _U32.size))
+            if mlen > MAX_TASK_BYTES:
+                raise ValueError("manifest too large")
+            manifest_raw = _recv_exact(sock, mlen)
+        blob = _recv_exact(sock, blob_len)
+    except Exception:
+        return
+    batches = None
+    try:
+        # manifest SEMANTIC failures (bad JSON, missing keys) get
+        # the documented error frame - only framing failures above
+        # drop the connection
+        resources = (
+            _manifest_resources(json.loads(manifest_raw))
+            if manifest_raw is not None else {}
+        )
+        ctx = ExecContext()
+        ctx.resources.update(resources)
+        if is_ref:
+            from blaze_tpu.plan.refcompat import (
+                execute_reference_task,
+            )
+
+            batches = execute_reference_task(blob, ctx=ctx)
+        else:
+            batches = execute_task(blob, ctx=ctx)
+        it = iter(batches)
+        while True:
+            rb = next(it, None)  # execution errors surface here
+            if rb is None:
+                break
+            part = encode_ipc_segment(rb)
             try:
-                sock.sendall(_U64.pack(_ERR) + _U32.pack(len(msg)) + msg)
+                sock.sendall(part)  # already u64-LE length-prefixed
             except OSError:
-                pass
-        finally:
-            if batches is not None:
-                close = getattr(batches, "close", None)
-                if close is not None:
-                    close()
+                # client hung up mid-stream: this is a CANCELLATION,
+                # not an execution failure (the executor's
+                # GeneratorExit pass-through, executor.py) - close
+                # the task generator so operators unwind cleanly
+                # and keep the engine unpoisoned; no error frame,
+                # no task-failure logging
+                it.close()
+                log.info(
+                    "client disconnected mid-stream; task cancelled"
+                )
+                return
+        sock.sendall(_U64.pack(0))
+    except Exception as e:
+        msg = str(e).encode("utf-8")[:65536]
+        try:
+            sock.sendall(_U64.pack(_ERR) + _U32.pack(len(msg)) + msg)
+        except OSError:
+            pass
+    finally:
+        if batches is not None:
+            close = getattr(batches, "close", None)
+            if close is not None:
+                close()
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -174,44 +191,94 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class TaskGatewayServer:
+    """Gateway listener. `wire` picks the data plane: "async" (the
+    default; event-loop verb serving, service/wire_async.py) or
+    "threaded" (the legacy thread-per-connection socketserver, kept as
+    the differential oracle for wire-parity tests). BLAZE_WIRE
+    overrides the default for whole-process flips."""
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 service=None):
-        self._srv = _Server(
-            (host, port), _Handler, bind_and_activate=True
-        )
-        self._srv.daemon_threads = True
-        # optional QueryService: enables service-protocol connections
-        # (_FLAG_SERVICE) on the same listener
-        self._srv.service = service
+                 service=None, wire: Optional[str] = None):
+        if wire is None:
+            wire = os.environ.get("BLAZE_WIRE", "async")
+        if wire not in ("async", "threaded"):
+            raise ValueError(f"unknown wire mode {wire!r}")
+        self.wire = wire
         self.service = service
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True
+        self._srv = None
+        self._async = None
+        self._thread = None
+        if wire == "threaded":
+            self._srv = _Server(
+                (host, port), _Handler, bind_and_activate=True
+            )
+            self._srv.daemon_threads = True
+            # optional QueryService: enables service-protocol
+            # connections (_FLAG_SERVICE) on the same listener
+            self._srv.service = service
+            self._thread = threading.Thread(
+                target=self._srv.serve_forever, daemon=True
+            )
+        else:
+            from blaze_tpu.service import wire_async
+
+            self._async = wire_async.AsyncWireServer(
+                host, port, self._handle_async
+            )
+
+    async def _handle_async(self, conn):
+        from blaze_tpu.service import wire_async
+        from blaze_tpu.service.wire import ServiceVerbBackend
+
+        service = self.service
+        await wire_async.handle_wire_connection(
+            conn,
+            backend_factory=(
+                (lambda: ServiceVerbBackend(service))
+                if service is not None else None
+            ),
+            legacy=handle_legacy_connection,
         )
 
     @property
     def address(self):
+        if self._async is not None:
+            return self._async.address
         return self._srv.server_address
 
     def start(self) -> "TaskGatewayServer":
-        self._thread.start()
+        if self._async is not None:
+            self._async.start()
+        else:
+            self._thread.start()
         return self
 
     def serve_blocking(self) -> None:
-        """Run the accept loop on the CALLING thread (the CLI shape).
-        Mutually exclusive with start(): two accept loops on one
-        listener race on every connection, and the loser blocks in
-        accept() forever. Returns after shutdown()."""
-        self._srv.serve_forever()
+        """Block the calling thread in the accept loop (the CLI
+        shape). On the threaded plane this IS the accept loop and is
+        mutually exclusive with start(); on the async plane accepting
+        always happens on the wire loop and this just parks until
+        shutdown(). Returns after shutdown()."""
+        if self._async is not None:
+            self._async.serve_blocking()
+        else:
+            self._srv.serve_forever()
 
     def shutdown(self) -> None:
         """Stop the accept loop (serve_blocking returns / the start()
         thread exits) without closing the listener; safe from any
         thread - the drain path calls it once the service is empty."""
-        self._srv.shutdown()
+        if self._async is not None:
+            self._async.shutdown()
+        else:
+            self._srv.shutdown()
 
     def stop(self) -> None:
-        self._srv.shutdown()
-        self._srv.server_close()
+        if self._async is not None:
+            self._async.stop()
+        else:
+            self._srv.shutdown()
+            self._srv.server_close()
 
     def __enter__(self):
         return self.start()
@@ -224,4 +291,4 @@ def serve_forever(host: str = "127.0.0.1", port: int = 8484,
                   service=None) -> None:  # pragma: no cover - CLI
     srv = TaskGatewayServer(host, port, service=service)
     print(f"blaze_tpu gateway listening on {srv.address}", flush=True)
-    srv._srv.serve_forever()
+    srv.serve_blocking()

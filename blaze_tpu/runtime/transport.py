@@ -234,10 +234,14 @@ def iter_remote_batches(seg: RemoteSegment):
 
 
 def _recv_exact(sock, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        b = sock.recv(n - len(buf))
-        if not b:
+    # recv_into a preallocated buffer: large frames (multi-MB result
+    # parts) would otherwise pay O(n^2) bytes-concat churn
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if not k:
             raise ConnectionError("socket closed mid-frame")
-        buf += b
-    return buf
+        got += k
+    return bytes(buf)

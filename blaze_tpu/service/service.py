@@ -195,6 +195,11 @@ class QueryService:
         # the load tests assert priority/FIFO semantics from this
         self.admission_log: List[str] = []
         self._stop = False
+        # dispatcher wakeup batching: N submit/release events between
+        # dispatcher passes collapse into ONE pending flag (and one CV
+        # round-trip) instead of N notify_all calls contending the
+        # service lock at high concurrency
+        self._kick_pending = False
         self._workers = cf.ThreadPoolExecutor(
             max_workers=max(1, max_concurrency),
             thread_name_prefix="blaze-query",
@@ -408,8 +413,7 @@ class QueryService:
             )
             q.transition(QueryState.REJECTED_OVERLOADED)
             return q
-        with self._cv:
-            self._cv.notify_all()
+        self._kick()
         return q
 
     def _register(self, q: Query) -> None:
@@ -445,8 +449,7 @@ class QueryService:
         q.request_cancel()
         if q.state is QueryState.QUEUED:
             q.try_transition(QueryState.CANCELLED)
-        with self._cv:
-            self._cv.notify_all()
+        self._kick()
         return q.status()
 
     def result(self, query_id: str, timeout: Optional[float] = None):
@@ -702,10 +705,24 @@ class QueryService:
         self.close()
 
     # -- dispatcher -----------------------------------------------------
+    def _kick(self) -> None:
+        """Request a dispatcher pass. Batched: if a kick is already
+        pending the dispatcher will see our event on the same pass, so
+        skip the lock round-trip entirely (the flag is monotone until
+        the dispatcher clears it - a stale read only costs one extra
+        notify, never a lost wakeup)."""
+        if self._kick_pending:
+            return
+        with self._cv:
+            self._kick_pending = True
+            self._cv.notify_all()
+
     def _dispatch_loop(self) -> None:
         while not self._stop:
             with self._cv:
-                self._cv.wait(timeout=0.05)
+                if not self._kick_pending:
+                    self._cv.wait(timeout=0.05)
+                self._kick_pending = False
             if self._stop:
                 return
             self._sweep_deadlines()
@@ -928,8 +945,7 @@ class QueryService:
             q.try_transition(QueryState.DONE)
         finally:
             self.admission.release(q)
-            with self._cv:
-                self._cv.notify_all()
+            self._kick()
 
     def _execute(self, q: Query) -> List:
         """Run (or reuse) every partition of the query's plan."""
@@ -1153,8 +1169,7 @@ class QueryService:
         nparts = (q.plan.partition_count
                   if q.plan is not None else 1)
         self.admission.release_bytes(q, share_of=max(1, nparts))
-        with self._cv:
-            self._cv.notify_all()
+        self._kick()
         log.warning(
             "query %s partition %d degraded to host engine after "
             "RESOURCE_EXHAUSTED: %s", q.query_id, partition, cause,

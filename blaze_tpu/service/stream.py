@@ -86,6 +86,12 @@ class StreamBuffer:
         self._on_pending = on_pending
         self._on_event = on_event
         self._cv = threading.Condition(TimedLock("stream_ring"))
+        # async-consumer bridge: the event-loop FETCH path
+        # (service/wire_async.py) cannot park in self._cv.wait - it
+        # registers a waker callback instead, fired on every state
+        # change alongside the CV notify. Callbacks must be cheap and
+        # thread-safe (loop.call_soon_threadsafe(ev.set)).
+        self._wakers: List[Callable[[], None]] = []
         self.parts: List = []  # produced pa.RecordBatch refs, in order
         self._nbytes: List[int] = []
         # producer cursor: == len(parts) normally; behind it while
@@ -123,6 +129,27 @@ class StreamBuffer:
             except Exception:  # noqa: BLE001 - obs must not raise
                 pass
 
+    def _wake_locked(self) -> None:
+        """Wake every waiter: threaded consumers via the CV, async
+        consumers via their registered wakers (caller holds _cv)."""
+        self._cv.notify_all()
+        for w in self._wakers:
+            try:
+                w()
+            except Exception:  # noqa: BLE001 - a dead loop must not
+                pass           # poison producer progress
+
+    def add_waker(self, waker: Callable[[], None]) -> None:
+        with self._cv:
+            self._wakers.append(waker)
+
+    def remove_waker(self, waker: Callable[[], None]) -> None:
+        with self._cv:
+            try:
+                self._wakers.remove(waker)
+            except ValueError:
+                pass
+
     # -- producer side --------------------------------------------------
     def position(self) -> int:
         with self._cv:
@@ -150,14 +177,14 @@ class StreamBuffer:
                 if not _batches_equal(prev, rb):
                     self.aborted = "SPLICE_BROKEN"
                     self._clear_locked()
-                    self._cv.notify_all()
+                    self._wake_locked()
                     raise StreamSpliceError(
                         "re-executed result diverged from parts "
                         "already delivered mid-stream; resubmit the "
                         "query"
                     )
                 self._pos += 1
-                self._cv.notify_all()
+                self._wake_locked()
                 return
             while (
                 self.consumers_seen > 0
@@ -185,7 +212,7 @@ class StreamBuffer:
                 self.high_water = self.pending_bytes
                 self._event("high_water", self.high_water)
             self._account_locked()
-            self._cv.notify_all()
+            self._wake_locked()
 
     def _stall_abort_locked(self, q, stalled_for: float) -> None:
         """The classified slow-consumer exit: cancel the query with the
@@ -202,7 +229,7 @@ class StreamBuffer:
         q.request_cancel(reason="stream_stalled")
         self.aborted = "STREAM_STALLED"
         self._clear_locked()
-        self._cv.notify_all()
+        self._wake_locked()
         raise StreamStalled(q.query_id)
 
     def rollback(self, to_pos: int) -> None:
@@ -222,12 +249,12 @@ class StreamBuffer:
                 self.pending_bytes -= freed
                 self._account_locked()
             self._pos = min(int(to_pos), len(self.parts))
-            self._cv.notify_all()
+            self._wake_locked()
 
     def finish(self) -> None:
         with self._cv:
             self.finished = True
-            self._cv.notify_all()
+            self._wake_locked()
 
     def abort(self, reason: str) -> None:
         """Terminal non-DONE exit: free the ring (retention keeps
@@ -238,7 +265,7 @@ class StreamBuffer:
             if self.aborted is None:
                 self.aborted = str(reason)
             self._clear_locked()
-            self._cv.notify_all()
+            self._wake_locked()
 
     def _clear_locked(self) -> None:
         self.parts.clear()
@@ -258,7 +285,7 @@ class StreamBuffer:
             self.consumers_seen += 1
             self._last_progress = time.monotonic()
             self._account_locked()
-            self._cv.notify_all()
+            self._wake_locked()
 
     def next_ready(self, i: int, timeout: float):
         """Wait up to `timeout` for part `i`. Returns one of
@@ -291,7 +318,7 @@ class StreamBuffer:
                 self.pending_bytes -= freed
                 self._account_locked()
             self._last_progress = time.monotonic()
-            self._cv.notify_all()
+            self._wake_locked()
 
     def total_parts(self) -> int:
         with self._cv:

@@ -479,6 +479,14 @@ class ServiceVerbBackend:
         if not q.done:
             self.service.cancel(qid)
 
+    async def fetch_async(self, writer, qid: str,
+                          timeout_ms: int) -> None:
+        """Event-loop FETCH (service/wire_async.py): same semantics as
+        fetch(), parts written drain-aware on the wire loop."""
+        from blaze_tpu.service.wire_async import service_fetch_async
+
+        await service_fetch_async(self, writer, qid, timeout_ms)
+
     def fetch(self, sock, qid: str, timeout_ms: int) -> None:
         try:
             q = self.service.get(qid)

@@ -152,7 +152,7 @@ def bench_smoke() -> bool:
     p = subprocess.run(
         [sys.executable, "bench.py", "--smoke"],
         cwd=REPO, env=_env(), capture_output=True, text=True,
-        timeout=600,
+        timeout=900,
     )
     smoke_ok = p.returncode == 0
     tail = p.stdout.strip().splitlines()
@@ -171,7 +171,8 @@ def service_smoke() -> bool:
     return run(
         "service smoke",
         ["tests/test_service.py", "tests/test_service_gateway.py",
-         "tests/test_gateway.py", "tests/test_scheduler.py"],
+         "tests/test_gateway.py", "tests/test_scheduler.py",
+         "tests/test_wire_async.py"],
     )
 
 
@@ -193,7 +194,7 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
          "tests/test_cluster_chaos.py", "tests/test_router.py",
          "tests/test_membership.py", "tests/test_churn.py",
          "tests/test_journal.py", "tests/test_stream.py",
-         "tests/test_contention.py",
+         "tests/test_contention.py", "tests/test_wire_async.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}

@@ -165,6 +165,11 @@ class _FakeSock:
         self._pos += len(chunk)
         return chunk
 
+    def recv_into(self, view, n: int = 0) -> int:
+        chunk = self.recv(n or len(view))
+        view[:len(chunk)] = chunk
+        return len(chunk)
+
     def sendall(self, data: bytes) -> None:
         if len(self.sent) >= self._ok_sends:
             raise BrokenPipeError("client went away")

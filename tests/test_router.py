@@ -706,7 +706,14 @@ def test_fetch_fleet_unavailable_err_carries_state_token(
                 f"replica 127.0.0.1:1 lost mid-FETCH of {qid}"
             )
 
+        async def unavailable_async(*a, **kw):
+            unavailable()
+            yield b""  # unreachable: makes this an async generator
+
         monkeypatch.setattr(fl.router, "stream_parts", unavailable)
+        monkeypatch.setattr(
+            fl.router, "stream_parts_async", unavailable_async
+        )
         with RouterServer(fl.router) as rs:
             with ServiceClient(*rs.address) as c:
                 with pytest.raises(ServiceError) as ei:
