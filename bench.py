@@ -1277,8 +1277,13 @@ def child(n_rows):
         from blaze_tpu.obs import contention as svc_contention
 
         for cache_on in (True, False):
+            # the cached pass rides the full zero-copy serve path
+            # (ISSUE 17): decoded-plan cache is on by default, and the
+            # arena serves every repeat FETCH scatter-gather - the
+            # c64 >= c16 smoke pin below is the "with arena" bar
             svc = QueryService(
-                max_concurrency=16, enable_cache=cache_on
+                max_concurrency=16, enable_cache=cache_on,
+                arena_bytes=(256 << 20) if cache_on else 0,
             )
             # lock-wait accounting rides the CACHED pass (the c16
             # collapse case, ISSUE 15): each concurrency entry
@@ -1316,6 +1321,7 @@ def child(n_rows):
                                 ),
                                 "concurrency": conc,
                                 "result_cache": cache_on,
+                                "arena": cache_on,
                                 "rows_per_query": n_svc,
                             }
                             if cache_on:
@@ -1536,6 +1542,242 @@ def child(n_rows):
         )
     except Exception as e:  # noqa: BLE001 - the battery must survive
         detail["stream_first_byte_c16"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
+    # ---- zero-copy serve path (ISSUE 17). Three repeat-plan shapes:
+    # repeat_plan_qps hammers ONE warm plan through the wire (result
+    # cache + decoded-plan cache + arena all hot: nothing decodes,
+    # nothing executes, FETCH serves mmap frames scatter-gather);
+    # decode_p50_repeat isolates the submit path (p50 submit_task wall
+    # time on repeats, plan cache on vs off - the >= 10x decode-skip
+    # acceptance bar); stream_first_byte_repeat re-FETCHes one DONE
+    # result with the arena on vs off (same connection, same bytes:
+    # the delta is pure re-encode cost the sg path skips). ----
+    try:
+        import threading as _zc_threading
+
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _ZcGateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _ZcService,
+            ServiceClient as _ZcClient,
+        )
+
+        zc_conc = 8
+        zc_per_client = 8
+        zc_svc = _ZcService(max_concurrency=16,
+                            arena_bytes=256 << 20)
+        try:
+            with _ZcGateway(service=zc_svc) as zc_srv:
+                zh, zp = zc_srv.address
+
+                def zc_round():
+                    errs = []
+
+                    def client():
+                        try:
+                            with _ZcClient(zh, zp) as cl:
+                                for _ in range(zc_per_client):
+                                    cl.run(svc_blob)
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(repr(e))
+
+                    ts = [
+                        _zc_threading.Thread(target=client)
+                        for _ in range(zc_conc)
+                    ]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    if errs:
+                        raise RuntimeError(errs[0])
+
+                zc_round()  # warm: decode once, cache + publish
+                med, spread, k, _ = timed(zc_round, iters=3)
+                zc_pc = zc_svc.stats().get("plan_cache") or {}
+                zc_ar = zc_svc.arena.stats() if zc_svc.arena else {}
+                detail["repeat_plan_qps"] = {
+                    "median": round(med, 4),
+                    "spread": round(spread, 3),
+                    "k": k,
+                    "qps": round(zc_conc * zc_per_client / med, 1),
+                    "concurrency": zc_conc,
+                    "rows_per_query": n_svc,
+                    "plan_cache_hits": zc_pc.get("hits", 0),
+                    "plan_cache_misses": zc_pc.get("misses", 0),
+                    "arena_sg_serves": zc_ar.get("sg_serves", 0),
+                    "fast_path_serves": zc_svc.obs_counters[
+                        "fast_path_serves"
+                    ],
+                }
+        finally:
+            zc_svc.close()
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "repeat_plan_qps", "backend": backend,
+                 **detail["repeat_plan_qps"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["repeat_plan_qps"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
+    try:
+        from blaze_tpu.service import (
+            QueryService as _ZdService,
+        )
+
+        zd_reps = 20
+        zd_p50 = {}       # plan_decode phase p50 per repeat
+        zd_submit50 = {}  # submit_task wall p50 per repeat
+        for zd_label, zd_entries in (("cache", 256), ("nocache", 0)):
+            zd_svc = _ZdService(max_concurrency=2,
+                                plan_cache_entries=zd_entries,
+                                enable_trace=True)
+            try:
+                q = zd_svc.submit_task(svc_blob)
+                if not q.wait(120.0):
+                    raise RuntimeError("decode-shape warm timed out")
+                zd_times = []
+                zd_decode = []
+                for _ in range(zd_reps):
+                    zd_t0 = time.perf_counter()
+                    q = zd_svc.submit_task(svc_blob)
+                    zd_times.append(time.perf_counter() - zd_t0)
+                    if not q.wait(120.0):
+                        raise RuntimeError(
+                            "decode-shape repeat timed out"
+                        )
+                    # the phase the plan cache exists to kill: sum of
+                    # this repeat's plan_decode spans (0.0 on a hit -
+                    # no protobuf walk happens at all)
+                    zd_decode.append(sum(
+                        (s["end_ns"] - s["start_ns"]) / 1e9
+                        for s in q.tracer.to_dicts()
+                        if s["name"] == "plan_decode"
+                    ) if q.tracer is not None else 0.0)
+                zd_times.sort()
+                zd_decode.sort()
+                zd_submit50[zd_label] = zd_times[len(zd_times) // 2]
+                zd_p50[zd_label] = zd_decode[len(zd_decode) // 2]
+            finally:
+                zd_svc.close()
+        detail["decode_p50_repeat"] = {
+            # median = the CACHED repeat's plan_decode p50 (0.0 when
+            # every repeat hits: the decode phase is GONE, which is
+            # the acceptance bar - not merely faster)
+            "median": round(zd_p50["cache"], 6),
+            "spread": 0.0,
+            "k": zd_reps,
+            "plan_decode_p50_cache_s": round(zd_p50["cache"], 6),
+            "plan_decode_p50_nocache_s": round(
+                zd_p50["nocache"], 6
+            ),
+            "submit_p50_cache_s": round(zd_submit50["cache"], 6),
+            "submit_p50_nocache_s": round(
+                zd_submit50["nocache"], 6
+            ),
+            "decode_skip_speedup": round(
+                zd_p50["nocache"] / max(zd_p50["cache"], 1e-9), 1
+            ),
+        }
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "decode_p50_repeat", "backend": backend,
+                 **detail["decode_p50_repeat"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["decode_p50_repeat"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
+    try:
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _ZsGateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _ZsService,
+            ServiceClient as _ZsClient,
+        )
+
+        zs_svc = _ZsService(max_concurrency=4,
+                            arena_bytes=256 << 20)
+        zs_saved_arena = zs_svc.arena
+        try:
+            with _ZsGateway(service=zs_svc) as zs_srv:
+                zs_h, zs_p = zs_srv.address
+                with _ZsClient(zs_h, zs_p) as zs_cl:
+                    zs_qid = zs_cl.submit(st_blob)["query_id"]
+                    for _rb in zs_cl.fetch_stream(zs_qid):
+                        pass
+                    zs_deadline = time.monotonic() + 10.0
+                    while (zs_svc.arena.stats()["segments"] == 0
+                           and time.monotonic() < zs_deadline):
+                        time.sleep(0.01)
+
+                    def zs_refetch():
+                        t0 = time.perf_counter()
+                        first = last = None
+                        for _rb in zs_cl.fetch_stream(zs_qid):
+                            now = time.perf_counter()
+                            if first is None:
+                                first = now - t0
+                            last = now - t0
+                        return first, last
+
+                    zs_k = int(
+                        os.environ.get("BLAZE_BENCH_ITERS", 3)
+                    )
+                    zs_out = {}
+                    for zs_mode in ("arena", "noarena"):
+                        zs_svc.arena = (
+                            zs_saved_arena if zs_mode == "arena"
+                            else None
+                        )
+                        zs_refetch()  # warm
+                        zs_samples = sorted(
+                            (zs_refetch() for _ in range(zs_k)),
+                            key=lambda s: s[1],
+                        )
+                        zs_out[zs_mode] = zs_samples[len(zs_samples)
+                                                     // 2]
+                on_first, on_last = zs_out["arena"]
+                off_first, off_last = zs_out["noarena"]
+                detail["stream_first_byte_repeat"] = {
+                    "median": round(on_last, 4),
+                    "spread": round(
+                        abs(off_last - on_last)
+                        / max(on_last, 1e-9), 3,
+                    ),
+                    "k": zs_k,
+                    "first_part_arena_s": round(on_first, 5),
+                    "first_part_noarena_s": round(off_first, 5),
+                    "last_part_arena_s": round(on_last, 5),
+                    "last_part_noarena_s": round(off_last, 5),
+                    "arena_sg_serves": (
+                        zs_saved_arena.stats()["sg_serves"]
+                    ),
+                }
+        finally:
+            zs_svc.arena = zs_saved_arena
+            zs_svc.close()
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": "stream_first_byte_repeat",
+                 "backend": backend,
+                 **detail["stream_first_byte_repeat"]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["stream_first_byte_repeat"] = {
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
@@ -1922,6 +2164,39 @@ def smoke():
         elif stq:
             problems.append(
                 f"stream_first_byte_8m failed: {stq.get('error')}"
+            )
+        # zero-copy serve path (ISSUE 17): the decode-skip acceptance
+        # bar - the plan_decode phase p50 on repeat submits must drop
+        # >= 10x with the decoded-plan cache (in practice to 0.0: a
+        # hit never walks the protobuf at all, so the phase vanishes)
+        zdq = (result.get("queries") or {}).get(
+            "decode_p50_repeat") or {}
+        if zdq and "error" not in zdq:
+            zd_cache = float(zdq.get("plan_decode_p50_cache_s", 1.0))
+            zd_nocache = float(
+                zdq.get("plan_decode_p50_nocache_s", 0.0)
+            )
+            if zd_cache > zd_nocache / 10.0:
+                problems.append(
+                    f"plan-cache decode skip insufficient: repeat "
+                    f"plan_decode p50 {zd_cache}s with cache vs "
+                    f"{zd_nocache}s without (want >= 10x drop)"
+                )
+        elif zdq:
+            problems.append(
+                f"decode_p50_repeat failed: {zdq.get('error')}"
+            )
+        zrq = (result.get("queries") or {}).get(
+            "repeat_plan_qps") or {}
+        if zrq and "error" in zrq:
+            problems.append(
+                f"repeat_plan_qps failed: {zrq['error']}"
+            )
+        zsq = (result.get("queries") or {}).get(
+            "stream_first_byte_repeat") or {}
+        if zsq and "error" in zsq:
+            problems.append(
+                f"stream_first_byte_repeat failed: {zsq['error']}"
             )
         # monotone-in-concurrency pin (async wire plane): cached qps
         # must not DROP as clients pile on - c1 -> c4 -> c16

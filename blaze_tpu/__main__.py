@@ -143,6 +143,9 @@ def cmd_serve(args) -> int:
         orphan_ttl_s=args.orphan_ttl,
         stream_buffer_bytes=args.stream_buffer_bytes,
         stream_stall_s=args.stream_stall_s,
+        plan_cache_entries=args.plan_cache_entries,
+        arena_bytes=(0 if args.no_arena else args.arena_bytes),
+        arena_dir=args.arena_dir,
     )
     if args.profile_hz > 0:
         # whole-lifetime profiling: contention accounting + stack
@@ -752,6 +755,22 @@ def main(argv=None) -> int:
                          "(async, the default) or the legacy thread-"
                          "per-connection tier (threaded); default "
                          "honors BLAZE_WIRE")
+    sv.add_argument("--plan-cache-entries", type=int, default=256,
+                    help="decoded-plan cache (zerocopy/): repeat "
+                         "SUBMITs of a byte-identical blob skip the "
+                         "protobuf decode entirely (0 disables)")
+    sv.add_argument("--arena-bytes", type=int, default=256 << 20,
+                    help="shared-memory Arrow arena budget: finalized "
+                         "results are published once as mmap'd wire "
+                         "frames and FETCHes are served zero-copy "
+                         "(scatter-gather or a leased handle for "
+                         "co-located clients)")
+    sv.add_argument("--no-arena", action="store_true",
+                    help="disable the arena: every FETCH re-encodes "
+                         "and streams over the socket byte path")
+    sv.add_argument("--arena-dir", default=None,
+                    help="arena segment directory (default: a "
+                         "private temp dir, removed at close)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")

@@ -62,6 +62,22 @@ class LocalStore(ObjectStore):
         return os.path.getsize(path)
 
     def open_input(self, path: str):
+        # mmap'd parquet page buffers (zero-copy serve path): pyarrow's
+        # reader slices column chunks straight out of the page cache
+        # instead of read()-copying them. BLAZE_PARQUET_MMAP=0 opts
+        # out; any failure (FS without mmap, chaos `zerocopy.map`
+        # seam) degrades to the buffered-read path.
+        if os.environ.get("BLAZE_PARQUET_MMAP", "1") != "0":
+            try:
+                import pyarrow as pa
+
+                from blaze_tpu.testing import chaos
+
+                if chaos.ACTIVE:
+                    chaos.fire("zerocopy.map", path=path)
+                return pa.memory_map(path, "r")
+            except Exception:  # noqa: BLE001 - degrade to read path
+                pass
         return open(path, "rb")
 
 

@@ -9,8 +9,9 @@ layer (obs/trace.py) already measures every phase of every query; this
 module is the aggregation that makes those measurements diffable:
 
   * `PhaseRollup` folds each FINISHED query into bounded per-phase
-    duration rings (queue_wait, admission, decode, h2d, dispatch,
-    execute, stream, router, e2e) keyed by *fingerprint class* - the
+    duration rings (queue_wait, admission, plan_decode, arrow_decode,
+    h2d, dispatch, execute, stream, router, e2e) keyed by
+    *fingerprint class* - the
     first 12 hex chars of the content-addressed plan fingerprint, the
     same identity the result cache and runtime history key on - plus
     the `_all` aggregate class that survives fingerprint drift across
@@ -46,7 +47,10 @@ from typing import Any, Dict, List, Optional
 PHASES = (
     "queue_wait",   # SUBMIT -> ADMITTED (admission queue)
     "admission",    # ADMITTED -> RUNNING (worker pickup)
-    "decode",       # parquet file-range decode (prefetch threads)
+    "plan_decode",  # SUBMIT protobuf -> decoded plan tree (skipped
+                    # entirely on a decoded-plan-cache hit)
+    "arrow_decode",  # parquet file-range decode (prefetch threads);
+                     # pre-split rollups called this "decode"
     "h2d",          # packed host->device staging
     "dispatch",     # compiled-kernel launches
     "join",         # fused join-probe kernel launches
@@ -63,7 +67,8 @@ PHASES = (
 SPAN_PHASE = {
     "queue_wait": "queue_wait",
     "admission": "admission",
-    "parquet_decode": "decode",
+    "plan_decode": "plan_decode",
+    "parquet_decode": "arrow_decode",
     "h2d": "h2d",
     "kernel_dispatch": "dispatch",
     "join_dispatch": "join",
@@ -285,6 +290,11 @@ PHASE_BANDS: Dict[str, tuple] = {
     # p50s with the same scheduler-load wobble as the hop phases
     "join": (2.0, 0.05),
     "group": (2.0, 0.05),
+    # plan_decode: protobuf-walk time, tens of microseconds to
+    # low-single-digit milliseconds - and ZERO on a decoded-plan-cache
+    # hit, so cross-round p50s swing with the cache hit mix, not with
+    # decoder speed
+    "plan_decode": (4.0, 0.02),
 }
 
 

@@ -42,6 +42,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from blaze_tpu.router.registry import Replica, ReplicaRegistry
+from blaze_tpu.zerocopy.plan_cache import plan_digest
 
 
 def rendezvous_rank(key: str, replica_id: str) -> int:
@@ -57,10 +58,12 @@ def rendezvous_rank(key: str, replica_id: str) -> int:
 def affinity_key(task_bytes: bytes, is_ref: bool) -> str:
     """Routing key for a raw SUBMIT blob: identical submissions digest
     identically, so repeats route together even before the true plan
-    fingerprint is learned from the first response."""
-    h = hashlib.blake2b(task_bytes, digest_size=16)
-    h.update(b"ref" if is_ref else b"native")
-    return h.hexdigest()
+    fingerprint is learned from the first response. One digest, two
+    caches: the same key addresses the service tier's decoded-plan
+    cache (zerocopy/plan_cache.py), so the router forwards it in the
+    SUBMIT meta and a routed repeat skips the replica's protobuf
+    decode."""
+    return plan_digest(task_bytes, is_ref)
 
 
 class AffinityMap:

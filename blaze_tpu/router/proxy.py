@@ -957,6 +957,11 @@ class Router:
                 replica = decision.replica
                 meta = dict(rq.meta)
                 meta["detach"] = True  # router owns session semantics
+                # the routing key IS the replica's decoded-plan-cache
+                # key (placement.affinity_key == zerocopy.plan_digest
+                # by construction): forward it so the replica skips
+                # re-hashing the blob before its cache probe
+                meta["plan_digest"] = rq.key
                 hop_cm = (
                     obs_trace.span(
                         "router_attempt", rec=rec,
@@ -1609,6 +1614,11 @@ class Router:
             "running": 0,
             "headroom_bytes": 0,
             "cache": {"hits": 0, "misses": 0, "coalesced": 0},
+            # zero-copy serve path aggregates (zerocopy/): how often
+            # the fleet skipped protobuf decode / served from arena
+            "plan_cache": {"hits": 0, "misses": 0, "evictions": 0},
+            "arena": {"segments": 0, "bytes": 0, "sg_serves": 0,
+                      "handle_hits": 0},
             "queries_by_state": {},
         }
         for r in self.registry.replicas.values():
@@ -1627,6 +1637,12 @@ class Router:
             c = r.stats.get("cache", {})
             for k in fleet["cache"]:
                 fleet["cache"][k] += int(c.get(k, 0))
+            pc = r.stats.get("plan_cache", {})
+            for k in fleet["plan_cache"]:
+                fleet["plan_cache"][k] += int(pc.get(k, 0))
+            ar = r.stats.get("arena", {})
+            for k in fleet["arena"]:
+                fleet["arena"][k] += int(ar.get(k, 0))
             for s, n in (
                 r.stats.get("queries", {}).get("by_state", {}).items()
             ):

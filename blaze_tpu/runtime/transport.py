@@ -245,3 +245,39 @@ def _recv_exact(sock, n: int) -> bytes:
             raise ConnectionError("socket closed mid-frame")
         got += k
     return bytes(buf)
+
+
+# sendmsg iovec bound: Linux caps a single sendmsg at IOV_MAX (1024)
+# buffers; stay comfortably under it
+_IOV_CHUNK = 512
+
+
+def sendmsg_all(sock, buffers) -> int:
+    """Scatter-gather send: write a buffer list (bytes / memoryview,
+    e.g. the arena's mmap-backed frame views) without concatenating a
+    reply - the writev-style half of the zero-copy serve path. Handles
+    partial sends and IOV_MAX chunking; falls back to sendall when the
+    socket has no sendmsg (test doubles). Returns bytes sent."""
+    views = [memoryview(b) for b in buffers if len(b)]
+    sendmsg = getattr(sock, "sendmsg", None)
+    total = 0
+    if sendmsg is None:
+        for v in views:
+            sock.sendall(v)
+            total += len(v)
+        return total
+    while views:
+        try:
+            sent = sendmsg(views[:_IOV_CHUNK])
+        except InterruptedError:
+            continue
+        total += sent
+        while sent and views:
+            head = views[0]
+            if sent >= len(head):
+                sent -= len(head)
+                views.pop(0)
+            else:
+                views[0] = head[sent:]
+                sent = 0
+    return total

@@ -178,6 +178,17 @@ class Query:
         self._decoded = None
         self._fingerprint: Optional[str] = None
         self._fingerprint_stable = False
+        # zero-copy plan cache (blaze_tpu/zerocopy/plan_cache.py),
+        # service-filled: the blob digest, the task's partition when
+        # known WITHOUT a decoded tuple (a plan-cache hit skips decode
+        # entirely), the cache entry whose tree this query borrowed,
+        # and whether the borrowed tree went through
+        # prepare_decoded_task (fusion mutates it in place - a
+        # consumed tree is never returned to the entry)
+        self._plan_key: Optional[str] = None
+        self._plan_partition: Optional[int] = None
+        self._plan_entry = None
+        self._tree_consumed = False
 
     # -- state machine --------------------------------------------------
     def transition(self, new: QueryState) -> None:

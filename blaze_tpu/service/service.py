@@ -81,6 +81,11 @@ class QueryService:
         orphan_ttl_s: Optional[float] = 900.0,
         stream_buffer_bytes: int = 32 << 20,
         stream_stall_s: float = 30.0,
+        plan_cache=None,
+        plan_cache_entries: int = 256,
+        arena=None,
+        arena_bytes: int = 0,
+        arena_dir: Optional[str] = None,
     ):
         self.admission = AdmissionController(
             device_tracker=device_tracker,
@@ -109,6 +114,31 @@ class QueryService:
             cache if cache is not None
             else (ResultCache() if enable_cache else None)
         )
+        # zero-copy serve path (blaze_tpu/zerocopy, docs/SERVICE.md):
+        # the decoded-plan cache makes a repeat SUBMIT skip protobuf
+        # decode entirely (keyed by the router's affinity digest over
+        # the raw blob); the Arrow arena holds finalized ENCODED part
+        # frames in mmap segments so FETCH serves them scatter-gather
+        # (or as a shm handle to a co-located client) instead of
+        # re-encoding per request. plan_cache_entries <= 0 /
+        # arena_bytes <= 0 disable each independently
+        if plan_cache is not None:
+            self.plan_cache = plan_cache
+        elif plan_cache_entries and plan_cache_entries > 0:
+            from blaze_tpu.zerocopy.plan_cache import DecodedPlanCache
+
+            self.plan_cache = DecodedPlanCache(plan_cache_entries)
+        else:
+            self.plan_cache = None
+        if arena is not None:
+            self.arena = arena
+        elif arena_bytes and arena_bytes > 0:
+            from blaze_tpu.zerocopy.arena import ArrowArena
+
+            self.arena = ArrowArena(directory=arena_dir,
+                                    max_bytes=arena_bytes)
+        else:
+            self.arena = None
         self.default_deadline_s = default_deadline_s
         # observability (blaze_tpu/obs): refcounted tracing for the
         # service lifetime, per-fingerprint runtime history (the
@@ -145,6 +175,11 @@ class QueryService:
             # per-query ring buffers by _note_stream_event
             "stream_stalls": 0,
             "stream_backpressure_waits": 0,
+            # admission fast path (zero-copy serve path): SUBMITs
+            # whose fingerprint the ResultCache fully covers bypass
+            # the byte-reservation queue and serve on the dedicated
+            # fast-path pool
+            "fast_path_serves": 0,
         }
         # end-to-end streaming (service/stream.py, docs/SERVICE.md):
         # per-query bounded result rings FETCH drains while RUNNING.
@@ -204,6 +239,15 @@ class QueryService:
             max_workers=max(1, max_concurrency),
             thread_name_prefix="blaze-query",
         )
+        # fast-path pool: cache-covered repeats run here, NOT inline
+        # on the submit thread (a cached result larger than the ring
+        # cap would deadlock submit against its own future FETCH) and
+        # NOT on _workers (a queued fleet must not starve cached
+        # repeats - the whole point of the bypass)
+        self._fast_pool = cf.ThreadPoolExecutor(
+            max_workers=max(2, max_concurrency),
+            thread_name_prefix="blaze-fastpath",
+        )
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="blaze-dispatch",
@@ -221,10 +265,17 @@ class QueryService:
         deadline_s: Optional[float] = None,
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
+        plan_digest: Optional[str] = None,
     ) -> Query:
         """Wire entry: one serialized TaskDefinition (engine-native or
         reference format), decoded eagerly so admission sees a cost
-        estimate and the cache sees a fingerprint."""
+        estimate and the cache sees a fingerprint - UNLESS the
+        decoded-plan cache already knows this blob (zero-copy serve
+        path): a hit reuses fingerprint/estimate/partition and defers
+        any re-decode to execution time, so a result-cache-covered
+        repeat never decodes at all. `plan_digest` is the router's
+        precomputed affinity digest over these exact bytes (submit
+        meta `plan_digest`); absent, the service hashes locally."""
         q = Query(
             task_bytes=task_bytes,
             is_ref=is_ref,
@@ -240,26 +291,34 @@ class QueryService:
         self._attach_obs(q)
         if self.draining:
             return self._reject_draining(q)
-        try:
-            if is_ref:
-                from blaze_tpu.plan.refcompat import (
-                    task_from_reference_proto,
-                )
+        pc = self.plan_cache
+        entry = None
+        if pc is not None:
+            from blaze_tpu.zerocopy.plan_cache import plan_digest as _pd
 
-                decoded = task_from_reference_proto(task_bytes)
-            else:
-                from blaze_tpu.plan.serde import task_from_proto
-
-                decoded = task_from_proto(task_bytes)
-        except Exception as e:  # noqa: BLE001 - reported via state
-            q.error = f"decode failed: {e!r}"
-            # undecodable bytes are a malformed plan by definition
-            q.error_class = ErrorClass.PLAN_INVALID.value
-            q.transition(QueryState.FAILED)
-            self._register(q)
-            return q
+            q._plan_key = plan_digest or _pd(task_bytes, is_ref)
+            entry = pc.get(q._plan_key)
+        if entry is not None:
+            # plan-cache hit: NO decode (and no plan_decode span). The
+            # decoded tree is loaned exclusively (prepare_decoded_task
+            # mutates it); when it is already out, metadata still
+            # serves and a cache-missing execution re-decodes lazily
+            q._plan_entry = entry
+            q._decoded = entry.borrow_tree()
+            if q._decoded is None:
+                pc.note_tree_unavailable()
+            q._plan_partition = entry.partition
+            if q.estimated_bytes is None:
+                q.estimated_bytes = entry.estimated_bytes
+            q._fingerprint = entry.fingerprint
+            q._fingerprint_stable = entry.fingerprint_stable
+            return self._enqueue(q)
+        decoded = self._decode_task(q)
+        if decoded is None:
+            return q  # decode failed: FAILED + registered
         q._decoded = decoded
         op = decoded[0]
+        q._plan_partition = decoded[1]
         if q.estimated_bytes is None:
             # a wire task executes ONE partition of its stage - cost
             # only that partition's leaves, or sibling tasks of a
@@ -269,7 +328,52 @@ class QueryService:
             )
         q._fingerprint = op.fingerprint()
         q._fingerprint_stable = op.fingerprint_is_stable()
+        if pc is not None:
+            from blaze_tpu.zerocopy.plan_cache import PlanEntry
+
+            # publish the metadata now; the TREE belongs to THIS query
+            # (it may fuse it in place) and returns pristine via the
+            # terminal hook only if it never executed
+            q._plan_entry = pc.put(q._plan_key, PlanEntry(
+                fingerprint=q._fingerprint,
+                fingerprint_stable=q._fingerprint_stable,
+                estimated_bytes=q.estimated_bytes,
+                partition=decoded[1],
+            ))
         return self._enqueue(q)
+
+    def _decode_bytes(self, q: Query):
+        """Decode q.task_bytes under a `plan_decode` span; raises on
+        malformed bytes. Submit-time AND the lazy re-decode a
+        plan-cache hit pays when it must execute but its entry's tree
+        is loaned out."""
+        t0 = time.monotonic()
+        if q.is_ref:
+            from blaze_tpu.plan.refcompat import (
+                task_from_reference_proto,
+            )
+
+            decoded = task_from_reference_proto(q.task_bytes)
+        else:
+            from blaze_tpu.plan.serde import task_from_proto
+
+            decoded = task_from_proto(q.task_bytes)
+        if q.tracer is not None:
+            q.tracer.record_span("plan_decode", t0, time.monotonic())
+        return decoded
+
+    def _decode_task(self, q: Query):
+        """Submit-time decode. On failure the query is FAILED +
+        registered and None returns."""
+        try:
+            return self._decode_bytes(q)
+        except Exception as e:  # noqa: BLE001 - reported via state
+            q.error = f"decode failed: {e!r}"
+            # undecodable bytes are a malformed plan by definition
+            q.error_class = ErrorClass.PLAN_INVALID.value
+            q.transition(QueryState.FAILED)
+            self._register(q)
+            return None
 
     def submit_plan(
         self,
@@ -405,6 +509,28 @@ class QueryService:
             self.admission.note_shed()
             q.error = "deadline unmeetable at admission (shed)"
             q.transition(QueryState.TIMED_OUT)
+            return q
+        if self._fast_path_eligible(q):
+            # admission fast path (zero-copy serve path): the result
+            # cache fully covers this fingerprint, so serving it
+            # dispatches nothing and reserves nothing - bypass the
+            # byte-reservation queue entirely. A queued fleet cannot
+            # starve cached repeats (and past c16 the reservation
+            # round-trip itself was the cached-qps wall). The rare
+            # eviction between this probe and the execution probe
+            # falls through to an unreserved execution - bounded by
+            # the fast pool, and the cache re-populates
+            if q.try_transition(QueryState.ADMITTED):
+                q.timings["admitted"] = time.monotonic()
+                if q.tracer is not None:
+                    q.tracer.record_span(
+                        "queue_wait", q.timings["submitted"],
+                        q.timings["admitted"], fast_path=True,
+                    )
+                with self._lock:
+                    self.admission_log.append(q.query_id)
+                    self.obs_counters["fast_path_serves"] += 1
+                self._fast_pool.submit(self._run_query, q)
             return q
         if not self.admission.offer(q):
             q.error = (
@@ -552,6 +678,14 @@ class QueryService:
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
+        # zero-copy serve path (blaze_tpu/zerocopy): decoded-plan
+        # cache hit/miss/eviction counters and arena segment/lease
+        # accounting - the replica surface the router's plan_cache
+        # rollup and the zerocopy tests read
+        if self.plan_cache is not None:
+            out["plan_cache"] = self.plan_cache.stats()
+        if self.arena is not None:
+            out["arena"] = self.arena.stats()
         # lock-wait accounting (obs/contention.py): empty dict when
         # the gate is off or nothing contended yet
         out["contention"] = obs_contention.snapshot()
@@ -592,6 +726,16 @@ class QueryService:
                 q.stream.finish()
             else:
                 q.stream.abort(q.state.value)
+        if q._plan_entry is not None and not q._tree_consumed \
+                and q._decoded is not None:
+            # zero-copy plan cache: this query borrowed the entry's
+            # decoded tree but never executed it (full cache hit /
+            # early terminal), so the tree is still pristine - return
+            # it for the next repeat. A consumed (fused) tree stays
+            # out forever
+            q._plan_entry.restore_tree(q._decoded)
+            q._decoded = None  # the entry owns it again
+        self._maybe_publish_arena(q)
         t = q.timings
         wall = t.get("finished", time.monotonic()) - t["submitted"]
         REGISTRY.inc("blaze_queries_total", state=q.state.value)
@@ -632,6 +776,32 @@ class QueryService:
                 log.exception("phase rollup fold failed for %s",
                               q.query_id)
 
+    def _maybe_publish_arena(self, q: Query) -> None:
+        """Zero-copy arena publish (terminal-hook time, never the hot
+        path): a clean DONE with a stable cacheable fingerprint gets
+        its result encoded ONCE into an mmap segment; every later
+        FETCH of the same fingerprint serves those frames scatter-
+        gather (or as a shm handle) instead of re-encoding. Idempotent
+        per fingerprint; the membership test keeps repeats free."""
+        arena = self.arena
+        if (
+            arena is None or q.state is not QueryState.DONE
+            or q._fingerprint is None or not q._fingerprint_stable
+            or not q.use_cache or q.degraded or not q.result
+        ):
+            return
+        if q._fingerprint in arena:
+            return
+        try:
+            from blaze_tpu.io.ipc import encode_ipc_segment
+
+            arena.publish(
+                q._fingerprint,
+                [encode_ipc_segment(rb) for rb in q.result],
+            )
+        except Exception:  # noqa: BLE001 - arena is best-effort
+            log.exception("arena publish failed for %s", q.query_id)
+
     def _collect_metrics(self):
         """Scrape-time samples for the process registry (METRICS verb):
         live admission/cache/history state as gauges, cumulative event
@@ -655,11 +825,31 @@ class QueryService:
             for k in ("entries", "bytes", "spilled_entries"):
                 yield (f"blaze_result_cache_{k}", sid,
                        c.get(k, 0), "gauge")
+        if self.plan_cache is not None:
+            pc = self.plan_cache.stats()
+            for k in ("hits", "misses", "evictions", "puts"):
+                yield ("blaze_plan_cache_events_total",
+                       {"event": k, **sid}, pc.get(k, 0), "counter")
+            yield ("blaze_plan_cache_entries", sid,
+                   pc.get("entries", 0), "gauge")
+        if self.arena is not None:
+            ar = self.arena.stats()
+            for k in ("published", "evictions", "handle_hits",
+                      "handle_misses", "sg_serves", "lease_releases",
+                      "lease_orphans_reaped", "map_failures",
+                      "lease_faults"):
+                yield ("blaze_arena_events_total",
+                       {"event": k, **sid}, ar.get(k, 0), "counter")
+            for k in ("segments", "bytes", "active_leases"):
+                yield (f"blaze_arena_{k}", sid, ar.get(k, 0), "gauge")
         with self._lock:
             orphans = self.obs_counters["orphans_reaped"]
             stalls = self.obs_counters["stream_stalls"]
             bp_waits = self.obs_counters["stream_backpressure_waits"]
             high_water = self._stream_high_water
+            fast_path = self.obs_counters["fast_path_serves"]
+        yield ("blaze_service_fast_path_serves_total",
+               sid, fast_path, "counter")
         yield ("blaze_service_orphans_reaped_total",
                sid, orphans, "counter")
         yield ("blaze_service_stream_stalls_total",
@@ -695,8 +885,11 @@ class QueryService:
             self._cv.notify_all()
         self._dispatcher.join(timeout=5)
         self._workers.shutdown(wait=True, cancel_futures=True)
+        self._fast_pool.shutdown(wait=True, cancel_futures=True)
         if self.cache is not None:
             self.cache.close()
+        if self.arena is not None:
+            self.arena.close()
 
     def __enter__(self):
         return self
@@ -792,6 +985,21 @@ class QueryService:
             f"{est:.3f}s exceeds remaining slack"
         )
 
+    def _fast_path_eligible(self, q: Query) -> bool:
+        """Admission-bypass guard: cache-covered stable repeats only,
+        and never while draining/closing (the drain path owns live
+        accounting) or after a pre-admission cancel."""
+        if (
+            self.cache is None or not q.use_cache or self.draining
+            or self._closed or q.cancel_requested
+            or q._fingerprint is None or not q._fingerprint_stable
+        ):
+            return False
+        try:
+            return self._cache_covers(q)
+        except Exception:  # noqa: BLE001 - fall back to the queue
+            return False
+
     def _cache_covers(self, q: Query) -> bool:
         """True when every partition the query would run is present
         (and fresh) in the result cache."""
@@ -799,6 +1007,10 @@ class QueryService:
             partitions = range(q.plan.partition_count)
         elif q._decoded is not None:
             partitions = [q._decoded[1]]
+        elif q._plan_partition is not None:
+            # plan-cache metadata hit without the decoded tree: the
+            # entry's recorded partition stands in for it
+            partitions = [q._plan_partition]
         else:
             return False
         return all(
@@ -981,13 +1193,25 @@ class QueryService:
             exec_op = op  # driver plans run as-built (run_plan parity)
         else:
             op = None
-            partitions = [q._decoded[1]]
+            partitions = [
+                q._decoded[1] if q._decoded is not None
+                else q._plan_partition
+            ]
             exec_op = None  # prepared lazily: a full cache hit must
             # not pay fusion/mesh lowering (and must dispatch nothing)
 
         def run_one(p):
             nonlocal exec_op
             if exec_op is None:
+                if q._decoded is None:
+                    # plan-cache metadata hit whose tree was loaned
+                    # out AND the result cache missed: the lazy
+                    # re-decode (still cheaper than the old world -
+                    # only cache-missing repeats pay it)
+                    q._decoded = self._decode_bytes(q)
+                # the tree is about to be fused/lowered IN PLACE:
+                # it can never go back into the plan cache
+                q._tree_consumed = True
                 prepared, _ = prepare_decoded_task(q._decoded, q.ctx)
                 if q.ctx.config.collect_metrics:
                     prepared = instrument(prepared, q.metrics_root)

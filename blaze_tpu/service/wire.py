@@ -34,7 +34,23 @@ Service framing (all integers LE):
                would desync the u64 framing); the client resumes by
                re-FETCHing and skipping delivered parts. Producer
                flow control + the slow-consumer stall budget:
-               service/stream.py, docs/SERVICE.md
+               service/stream.py, docs/SERVICE.md.
+               Bit 31 of timeout_ms opts INTO the shared-memory arena
+               (zerocopy/arena.py): when the finalized result lives in
+               an arena segment the server answers u64 ARENA | u32 len
+               | handle JSON {path, offsets, lengths, lease, ...}
+               INSTEAD of the part stream - the co-located client maps
+               the segment and reads the identical frames, then
+               RELEASEs the lease. A client that cannot map the path
+               (remote, stale lease, chaos) re-FETCHes with the bit
+               clear and gets plain bytes - degradation is always
+               client-invisible. Without the bit, an arena-resident
+               result still skips re-encoding: the frames go out as a
+               scatter-gather buffer list, byte-identical to the
+               per-batch encode path
+  RELEASE:  u32 len | lease-id utf8 | u32 0 -> JSON frame
+            {released: bool} - returns a shared-memory arena lease
+            (zerocopy/arena.py); an unreleased lease is TTL-reaped
   CANCEL:   u32 id_len | id   -> JSON frame
   REPORT:   u32 id_len | id | u32 flags -> JSON frame {report: text,
             trace?: Chrome-trace-event JSON, trace_spans?: [span
@@ -98,6 +114,13 @@ from blaze_tpu.testing import chaos
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
 _ERR = 0xFFFFFFFFFFFFFFFF
+# arena-handle escape (zero-copy serve path): like _ERR it can never
+# collide with a real part length (MAX_TASK_BYTES bounds frames)
+_ARENA = 0xFFFFFFFFFFFFFFFE
+# FETCH timeout_ms bit 31: client accepts a shared-memory arena handle
+# in place of the byte stream (real timeouts are millisecond values,
+# so the high bit is free)
+_FETCH_ARENA = 1 << 31
 
 VERB_SUBMIT = 1
 VERB_POLL = 2
@@ -108,12 +131,13 @@ VERB_STATS = 6
 VERB_METRICS = 7
 VERB_MEMBER = 8
 VERB_PROFILE = 9
+VERB_RELEASE = 10
 
 VERB_NAMES = {
     VERB_SUBMIT: "submit", VERB_POLL: "poll", VERB_FETCH: "fetch",
     VERB_CANCEL: "cancel", VERB_REPORT: "report", VERB_STATS: "stats",
     VERB_METRICS: "metrics", VERB_MEMBER: "member",
-    VERB_PROFILE: "profile",
+    VERB_PROFILE: "profile", VERB_RELEASE: "release",
 }
 
 MAX_META_BYTES = 1 << 20
@@ -160,11 +184,13 @@ def _is_draining_rejection(resp: dict) -> bool:
 #   abandon(qid)                   session teardown for one query
 
 
-# POLL/CANCEL/REPORT share one frame shape: u32 id_len | id | u32
+# POLL/CANCEL/REPORT/RELEASE share one frame shape: u32 id_len | id |
+# u32 (RELEASE carries the arena lease id in the string slot)
 _ID_VERBS = {
     VERB_POLL: lambda b, qid, flags: b.poll(qid),
     VERB_CANCEL: lambda b, qid, flags: b.cancel(qid),
     VERB_REPORT: lambda b, qid, flags: b.report_frame(qid, flags),
+    VERB_RELEASE: lambda b, qid, flags: b.release_lease(qid),
 }
 # STATS/METRICS share the bare u32-reserved frame
 _NOARG_VERBS = {
@@ -422,6 +448,10 @@ class ServiceVerbBackend:
             deadline_s=meta.get("deadline_s"),
             estimated_bytes=meta.get("estimated_bytes"),
             use_cache=bool(meta.get("use_cache", True)),
+            # plan-cache key forwarded by the router (the affinity
+            # digest it already computed over these exact bytes) so
+            # the replica never re-hashes the blob
+            plan_digest=meta.get("plan_digest"),
         )
         return q.status()
 
@@ -479,6 +509,15 @@ class ServiceVerbBackend:
         if not q.done:
             self.service.cancel(qid)
 
+    def release_lease(self, lease: str) -> dict:
+        arena = getattr(self.service, "arena", None)
+        if arena is None:
+            return {"released": False}
+        try:
+            return {"released": arena.release(int(lease))}
+        except (TypeError, ValueError):
+            return {"released": False}
+
     async def fetch_async(self, writer, qid: str,
                           timeout_ms: int) -> None:
         """Event-loop FETCH (service/wire_async.py): same semantics as
@@ -496,6 +535,9 @@ class ServiceVerbBackend:
             # never a hang
             _send_err(sock, f"UNKNOWN: no query {qid}")
             return
+        # bit 31 of timeout_ms: the client accepts an arena handle
+        arena_ok = bool(timeout_ms & _FETCH_ARENA)
+        timeout_ms &= _FETCH_ARENA - 1
         q.note_activity()  # a FETCH defers the orphan sweep
         # in-progress-fetch guard: the orphan sweep must not reap a
         # query mid-collection (a slow first part or a long DONE-wait
@@ -503,12 +545,15 @@ class ServiceVerbBackend:
         # finally below
         q.begin_fetch()
         try:
-            self._fetch_stream(sock, q, timeout_ms)
+            self._fetch_stream(sock, q, timeout_ms, arena_ok)
         finally:
             q.end_fetch()
             q.note_activity()
 
-    def _fetch_stream(self, sock, q, timeout_ms: int) -> None:
+    def _fetch_stream(self, sock, q, timeout_ms: int,
+                      arena_ok: bool = False) -> None:
+        if self._serve_arena(sock, q, arena_ok):
+            return
         sb = getattr(q, "stream", None)
         if sb is not None:
             # streaming service (the default): deliver parts as the
@@ -516,6 +561,79 @@ class ServiceVerbBackend:
             self._fetch_incremental(sock, q, sb, timeout_ms)
             return
         self._fetch_materialized(sock, q, timeout_ms)
+
+    def _serve_arena(self, sock, q, arena_ok: bool) -> bool:
+        """Zero-copy FETCH of a finalized result (zerocopy/arena.py).
+        When the query is DONE and its encoded part frames live in an
+        arena segment, either lease the segment to the client (arena
+        handle escape, `arena_ok`) or stream the frames as a
+        scatter-gather buffer list - no Arrow re-encode either way,
+        bytes identical to the per-batch path by construction. Returns
+        False (and sends NOTHING) whenever the arena does not cover
+        the query, so every fallback stays on the ordinary paths."""
+        from blaze_tpu.service.query import QueryState
+
+        arena = getattr(self.service, "arena", None)
+        if (
+            arena is None or not q.done
+            or q.state is not QueryState.DONE
+            or q._fingerprint is None or not q._fingerprint_stable
+            or not q.use_cache or q.degraded
+        ):
+            return False
+        key = q._fingerprint
+        stream_start = time.monotonic()
+        if arena_ok:
+            handle = arena.handle(key)
+            if handle is not None:
+                data = json.dumps(handle).encode("utf-8")
+                sock.sendall(
+                    _U64.pack(_ARENA) + _U32.pack(len(data)) + data
+                )
+                q.fetched = True
+                self._note_arena_stream(
+                    q, stream_start, len(handle["offsets"]),
+                    mode="handle",
+                )
+                return True
+        views = arena.buffers(key)
+        if views is None:
+            return False
+        from blaze_tpu.runtime.transport import sendmsg_all
+
+        if chaos.ACTIVE:
+            # mid-stream drop/stall seam: the whole buffer list goes
+            # out in one scatter-gather burst, so the seam fires once
+            # up front (a DROP aborts the stream before any bytes)
+            chaos.fire("gateway.stream", query_id=q.query_id,
+                       partition=0)
+        sendmsg_all(sock, [*views, _U64.pack(0)])
+        q.fetched = True
+        q.note_activity()
+        self._note_arena_stream(q, stream_start, len(views),
+                                mode="sg")
+        return True
+
+    def _note_arena_stream(self, q, stream_start: float, parts: int,
+                           mode: str) -> None:
+        stream_s = time.monotonic() - stream_start
+        q.timings["stream_ns"] = (
+            q.timings.get("stream_ns", 0) + int(stream_s * 1e9)
+        )
+        if getattr(self.service, "_fold_phases", True):
+            from blaze_tpu.obs import phases as obs_phases
+
+            obs_phases.ROLLUP.observe(
+                "stream", stream_s,
+                klass=obs_phases.class_key(
+                    q._fingerprint, q._fingerprint_stable
+                ),
+            )
+        if obs_trace.ACTIVE and getattr(q, "tracer", None) is not None:
+            q.tracer.record_span(
+                "result_stream", stream_start, time.monotonic(),
+                parts=parts, arena=mode,
+            )
 
     def _fetch_incremental(self, sock, q, sb, timeout_ms: int) -> None:
         """Stream-as-produced FETCH (service/stream.py): drain the
@@ -862,11 +980,17 @@ class ServiceClient:
 
     def __init__(self, host: str, port: int, timeout: float = 120.0,
                  reconnect_attempts: int = 4,
-                 reconnect_backoff_s: float = 0.05):
+                 reconnect_backoff_s: float = 0.05,
+                 use_arena: bool = False):
         self._addr = (host, port)
         self._timeout = timeout
         self._reconnect_attempts = int(reconnect_attempts)
         self._reconnect_backoff_s = float(reconnect_backoff_s)
+        # shared-memory FETCH opt-in (zerocopy/arena.py): only a
+        # client co-located with the server can map the segment paths
+        # a handle names, so the default stays the byte path; a failed
+        # map degrades back to bytes transparently either way
+        self._use_arena = bool(use_arena)
         self._sock = None
         self._connect()
 
@@ -1070,51 +1194,114 @@ class ServiceClient:
 
     def _fetch_parts(self, query_id: str, timeout_ms: int,
                      skip: int) -> Iterator:
-        import pyarrow as pa
-
-        from blaze_tpu.runtime import native
         from blaze_tpu.runtime.transport import _recv_exact
 
         self._parts_done = skip
-        if self._sock is None:
-            self._connect()
-        self._sock.sendall(
-            self._id_verb(VERB_FETCH, query_id, timeout_ms)
-        )
-        part = 0
+        arena_ok = self._use_arena
         while True:
-            (length,) = _U64.unpack(_recv_exact(self._sock, _U64.size))
-            if length == 0:
-                return
-            if length == _ERR:
-                (mlen,) = _U32.unpack(
-                    _recv_exact(self._sock, _U32.size)
+            if self._sock is None:
+                self._connect()
+            self._sock.sendall(
+                self._id_verb(
+                    VERB_FETCH, query_id,
+                    timeout_ms | (_FETCH_ARENA if arena_ok else 0),
                 )
-                msg = _recv_exact(self._sock, mlen).decode("utf-8")
-                raise ServiceError(msg)
-            payload = _recv_exact(self._sock, length)
-            if chaos.ACTIVE:
-                # chaos seam `stream.consume`: the CLIENT side of the
-                # pipe - STALL models a slow consumer (the server's
-                # backpressure/stall budget sees it), DROP a consumer
-                # whose connection dies mid-read (the reconnect +
-                # part-skip resume path covers it). Fired after the
-                # payload recv so `part` is the 0-based index of the
-                # part in hand
-                chaos.fire("stream.consume", query_id=query_id,
-                           partition=part)
-            part += 1
-            if part <= skip:
-                continue  # already delivered; drained, not decoded
-            raw = native.zstd_decompress(payload)
-            if not raw:
+            )
+            part = 0
+            resend = False
+            while True:
+                (length,) = _U64.unpack(
+                    _recv_exact(self._sock, _U64.size)
+                )
+                if length == 0:
+                    return
+                if length == _ERR:
+                    (mlen,) = _U32.unpack(
+                        _recv_exact(self._sock, _U32.size)
+                    )
+                    msg = _recv_exact(self._sock, mlen).decode("utf-8")
+                    raise ServiceError(msg)
+                if length == _ARENA:
+                    # shared-memory handoff: map the leased segment
+                    # and decode the identical frames locally. ANY
+                    # failure (not co-located, stale lease, chaos
+                    # seams) falls back to a byte-path re-FETCH on the
+                    # same connection - the handle replaced the whole
+                    # part stream, so the framing is still in sync
+                    frames = self._read_arena_handle()
+                    if frames is None:
+                        arena_ok = False
+                        resend = True
+                        break
+                    for frame in frames:
+                        part += 1
+                        if part <= skip:
+                            continue
+                        yield from self._decode_part(frame[8:])
+                        self._parts_done = part
+                    return
+                payload = _recv_exact(self._sock, length)
+                if chaos.ACTIVE:
+                    # chaos seam `stream.consume`: the CLIENT side of
+                    # the pipe - STALL models a slow consumer (the
+                    # server's backpressure/stall budget sees it),
+                    # DROP a consumer whose connection dies mid-read
+                    # (the reconnect + part-skip resume path covers
+                    # it). Fired after the payload recv so `part` is
+                    # the 0-based index of the part in hand
+                    chaos.fire("stream.consume", query_id=query_id,
+                               partition=part)
+                part += 1
+                if part <= skip:
+                    continue  # already delivered; drained, not decoded
+                yield from self._decode_part(payload)
                 self._parts_done = part
-                continue
-            with pa.ipc.open_stream(raw) as reader:
-                for rb in reader:
-                    if rb.num_rows > 0:
-                        yield rb
-            self._parts_done = part
+            if not resend:
+                return
+
+    def _decode_part(self, payload) -> Iterator:
+        import pyarrow as pa
+
+        from blaze_tpu.runtime import native
+
+        raw = native.zstd_decompress(bytes(payload))
+        if not raw:
+            return
+        with pa.ipc.open_stream(raw) as reader:
+            for rb in reader:
+                if rb.num_rows > 0:
+                    yield rb
+
+    def _read_arena_handle(self) -> Optional[list]:
+        """Consume the arena-handle JSON off the wire and try the shm
+        path: map the segment, copy the frames out, release the lease.
+        None means fall back to bytes (the caller re-FETCHes); the
+        lease is released (or TTL-reaped) either way."""
+        from blaze_tpu.runtime.transport import _recv_exact
+
+        (mlen,) = _U32.unpack(_recv_exact(self._sock, _U32.size))
+        if mlen > MAX_JSON_BYTES:
+            raise ValueError("oversized arena handle")
+        handle = json.loads(
+            _recv_exact(self._sock, mlen).decode("utf-8")
+        )
+        frames = None
+        try:
+            from blaze_tpu.zerocopy.arena import map_handle_frames
+
+            frames = map_handle_frames(handle)
+        except Exception:  # noqa: BLE001 - degrade to byte path
+            frames = None
+        finally:
+            lease = handle.get("lease")
+            if lease is not None:
+                try:
+                    self._roundtrip(
+                        self._id_verb(VERB_RELEASE, str(lease))
+                    )
+                except Exception:  # noqa: BLE001 - TTL reap covers it
+                    pass
+        return frames
 
     # -- helpers --------------------------------------------------------
     def run(self, task_bytes: bytes, **submit_kw) -> list:

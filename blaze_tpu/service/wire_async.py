@@ -331,14 +331,21 @@ async def serve_verb_connection_async(reader, writer, backend,
 
 async def service_fetch_async(backend, writer, qid: str,
                               timeout_ms: int) -> None:
+    from blaze_tpu.service import wire
+
     try:
         q = backend.service.get(qid)
     except KeyError:
         await _send_err(writer, f"UNKNOWN: no query {qid}")
         return
+    # bit 31 of timeout_ms: the client accepts an arena handle
+    arena_ok = bool(timeout_ms & wire._FETCH_ARENA)
+    timeout_ms &= wire._FETCH_ARENA - 1
     q.note_activity()
     q.begin_fetch()
     try:
+        if await _serve_arena_async(backend, writer, q, arena_ok):
+            return
         sb = getattr(q, "stream", None)
         if sb is not None:
             await _fetch_incremental_async(
@@ -351,6 +358,74 @@ async def service_fetch_async(backend, writer, qid: str,
     finally:
         q.end_fetch()
         q.note_activity()
+
+
+async def _serve_arena_async(backend, writer, q,
+                             arena_ok: bool) -> bool:
+    """Coroutine twin of ServiceVerbBackend._serve_arena: zero-copy
+    FETCH of a finalized result. Handle mode writes the arena escape
+    frame; scatter-gather mode writes the segment's mmap-backed frame
+    views straight into the transport (one drain at the end - the
+    frames already carry the wire framing, so no re-encode and no
+    per-part drain round trips). Returns False having sent NOTHING
+    whenever the arena does not cover the query."""
+    from blaze_tpu.service import wire
+    from blaze_tpu.service.query import QueryState
+
+    service = backend.service
+    arena = getattr(service, "arena", None)
+    if (
+        arena is None or not q.done
+        or q.state is not QueryState.DONE
+        or q._fingerprint is None or not q._fingerprint_stable
+        or not q.use_cache or q.degraded
+    ):
+        return False
+    key = q._fingerprint
+    loop = asyncio.get_running_loop()
+    pool = dispatch_pool(getattr(backend, "tier", "service"))
+    stream_start = time.monotonic()
+    if arena_ok:
+        # handle() reaps orphaned leases under the arena lock - keep
+        # it off the selector like every other lock-shaped call
+        handle = await loop.run_in_executor(
+            pool, partial(arena.handle, key)
+        )
+        if handle is not None:
+            data = json.dumps(handle).encode("utf-8")
+            writer.write(
+                _U64.pack(wire._ARENA) + _U32.pack(len(data)) + data
+            )
+            await writer.drain()
+            q.fetched = True
+            wire.ServiceVerbBackend._note_arena_stream(
+                backend, q, stream_start, len(handle["offsets"]),
+                mode="handle",
+            )
+            return True
+    views = arena.buffers(key)
+    if views is None:
+        return False
+    if chaos.ACTIVE:
+        # same contract as the threaded path: the whole buffer list
+        # goes out in one burst, so the seam fires once up front
+        await loop.run_in_executor(
+            pool, partial(chaos.fire, "gateway.stream",
+                          query_id=q.query_id, partition=0),
+        )
+    # write() either sends immediately or copies into the transport
+    # buffer before returning, so the views never outlive this call -
+    # safe against a concurrent eviction unmapping the segment
+    for v in views:
+        writer.write(v)
+    writer.write(_U64.pack(0))
+    await writer.drain()
+    q.fetched = True
+    q.note_activity()
+    wire.ServiceVerbBackend._note_arena_stream(
+        backend, q, stream_start, len(views), mode="sg"
+    )
+    return True
 
 
 async def _fetch_incremental_async(backend, writer, q, sb,

@@ -64,6 +64,17 @@ Injection sites (each named in docs/ROBUSTNESS.md):
                     the batched flusher, op=reconcile_poll DROP = a
                     recovery POLL that never reaches the journaled
                     replica (the pass retries next tick)
+  zerocopy.map      every mmap on the zero-copy serve path: arena
+                    segment publish (zerocopy/arena.py), client-side
+                    handle mapping (map_handle_frames), and the
+                    parquet page-buffer mmap (io/object_store.py).
+                    Any raise degrades that call to the socket/read
+                    byte path - zero client-visible failures
+  zerocopy.lease    arena lease grant (ArrowArena.handle) and the
+                    client's post-map staleness check: a raise makes
+                    the server answer bytes instead of a handle, or
+                    the client treat its handle as a stale lease and
+                    re-FETCH on the byte path
 
 Activation: programmatic `install()`/`active()` (tests), or the
 BLAZE_CHAOS environment variable carrying the plan as JSON - worker

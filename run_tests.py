@@ -195,11 +195,26 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
          "tests/test_membership.py", "tests/test_churn.py",
          "tests/test_journal.py", "tests/test_stream.py",
          "tests/test_contention.py", "tests/test_wire_async.py",
+         "tests/test_zerocopy.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}
             if seed_offset else None
         ),
+    )
+
+
+def zerocopy_smoke() -> bool:
+    """Zero-copy serve path suite (ISSUE 17): decoded-plan cache
+    (digest parity with router affinity, LRU/loan semantics, the
+    zero-plan_decode-spans repeat pin), the shared-memory Arrow arena
+    (scatter-gather byte-identity vs the socket path on BOTH wire
+    planes, handle leases + TTL orphan reap, mid-stream resume), the
+    admission fast path (queued fleet still serves cached repeats),
+    and the `zerocopy.map` / `zerocopy.lease` chaos degradations."""
+    return run(
+        "zerocopy suite",
+        ["tests/test_zerocopy.py"],
     )
 
 
@@ -480,6 +495,11 @@ def main():
                          "backpressure, slow-consumer stall aborts, "
                          "mid-stream resume, and the router's "
                          "windowed zero-copy relay")
+    ap.add_argument("--zerocopy", action="store_true",
+                    help="zero-copy serve path suite only: decoded-"
+                         "plan cache, shm Arrow arena (sg/handle "
+                         "byte-identity, lease reap), admission fast "
+                         "path, chaos degradations")
     ap.add_argument("--profile", action="store_true",
                     help="profiler smoke only: the `python -m "
                          "blaze_tpu profile` CLI at c1/c4 against an "
@@ -514,6 +534,12 @@ def main():
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1
 
+    if args.zerocopy:
+        ok &= zerocopy_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (zerocopy) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
     if args.profile:
         ok &= profile_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (profile) "
@@ -543,6 +569,7 @@ def main():
         ok &= chaos_smoke()
         ok &= chaos_smoke(seed_offset=1)
         ok &= stream_smoke()
+        ok &= zerocopy_smoke()
         ok &= churn_smoke()
         ok &= obs_smoke()
         ok &= profile_smoke()

@@ -41,12 +41,12 @@ from blaze_tpu.testing.chaos import Fault
 def test_rollup_percentiles_and_aggregate_class():
     r = PhaseRollup()
     for i in range(1, 11):
-        r.observe("decode", i / 100.0, klass="abc")
+        r.observe("arrow_decode", i / 100.0, klass="abc")
     snap = r.snapshot()
-    assert snap["abc"]["decode"]["n"] == 10
-    assert snap["abc"]["decode"]["p50"] == pytest.approx(0.05, rel=0.3)
+    assert snap["abc"]["arrow_decode"]["n"] == 10
+    assert snap["abc"]["arrow_decode"]["p50"] == pytest.approx(0.05, rel=0.3)
     # every observation also lands in the _all aggregate
-    assert snap[ALL_CLASS]["decode"]["n"] == 10
+    assert snap[ALL_CLASS]["arrow_decode"]["n"] == 10
 
 
 def test_rollup_bounded_rings_and_class_lru():
@@ -62,8 +62,8 @@ def test_rollup_bounded_rings_and_class_lru():
 
 def test_rollup_negative_and_unknown_phase_dropped():
     r = PhaseRollup()
-    r.observe("decode", -1.0)
-    r.fold_phases({"not_a_phase": 1.0, "decode": None})
+    r.observe("arrow_decode", -1.0)
+    r.fold_phases({"not_a_phase": 1.0, "arrow_decode": None})
     assert r.snapshot() == {}
 
 
@@ -86,7 +86,7 @@ def test_fold_span_dicts_sums_per_phase():
     ]
     out = fold_span_dicts(spans)
     assert out == {
-        "decode": pytest.approx(0.015),
+        "arrow_decode": pytest.approx(0.015),
         "dispatch": pytest.approx(0.002),
     }
 
@@ -101,30 +101,30 @@ def _cell(p50, n=5):
 
 
 def test_compare_flags_creep_beyond_band_only():
-    base = {"_all": {"decode": _cell(0.1), "e2e": _cell(1.0)}}
-    live = {"_all": {"decode": _cell(0.4), "e2e": _cell(1.1)}}
+    base = {"_all": {"arrow_decode": _cell(0.1), "e2e": _cell(1.0)}}
+    live = {"_all": {"arrow_decode": _cell(0.4), "e2e": _cell(1.1)}}
     regs = compare(live, base, rel_band=0.5, abs_floor_s=0.01)
-    assert [r["phase"] for r in regs] == ["decode"]
+    assert [r["phase"] for r in regs] == ["arrow_decode"]
     assert regs[0]["ratio"] == pytest.approx(4.0)
 
 
 def test_compare_min_samples_and_missing_cells():
-    base = {"_all": {"decode": _cell(0.1, n=2)},
+    base = {"_all": {"arrow_decode": _cell(0.1, n=2)},
             "only_base": {"e2e": _cell(0.1)}}
-    live = {"_all": {"decode": _cell(10.0, n=2)},
+    live = {"_all": {"arrow_decode": _cell(10.0, n=2)},
             "only_live": {"e2e": _cell(9.0)}}
     # too few samples -> ignored; classes present on one side -> ignored
     assert compare(live, base) == []
 
 
 def test_compare_per_phase_band_overrides():
-    base = {"_all": {"decode": _cell(0.1), "e2e": _cell(0.2)}}
-    live = {"_all": {"decode": _cell(0.25), "e2e": _cell(0.5)}}
+    base = {"_all": {"arrow_decode": _cell(0.1), "e2e": _cell(0.2)}}
+    live = {"_all": {"arrow_decode": _cell(0.25), "e2e": _cell(0.5)}}
     regs = compare(
         live, base, rel_band=0.3, abs_floor_s=0.01,
         bands={"e2e": (5.0, 0.5)},  # e2e explicitly slack
     )
-    assert [r["phase"] for r in regs] == ["decode"]
+    assert [r["phase"] for r in regs] == ["arrow_decode"]
 
 
 # ---------------------------------------------------------------------------
@@ -164,7 +164,7 @@ def test_terminal_hook_folds_phases_into_global_rollup(agg_blob):
         assert snap[ALL_CLASS]["e2e"]["n"] == 3
         # the keyed aggregate's kernel launches land in the fused
         # grouped-dispatch phase, not the generic dispatch bucket
-        for ph in ("queue_wait", "execute", "decode", "group"):
+        for ph in ("queue_wait", "execute", "arrow_decode", "group"):
             assert ph in snap[ALL_CLASS], snap[ALL_CLASS].keys()
         # the fingerprint class rode along (stable plan)
         fp_classes = [k for k in snap if k not in (ALL_CLASS,)]
@@ -185,7 +185,7 @@ def test_obs_off_service_still_folds_lifecycle_phases(agg_blob):
     # (timings-driven) still roll up
     assert "e2e" in snap[ALL_CLASS]
     assert "execute" in snap[ALL_CLASS]
-    assert "decode" not in snap[ALL_CLASS]
+    assert "arrow_decode" not in snap[ALL_CLASS]
 
 
 # ---------------------------------------------------------------------------
@@ -211,10 +211,10 @@ def test_regress_detects_stalled_decode_under_flat_e2e():
     regs = compare(live, baseline, rel_band=0.3, abs_floor_s=0.02,
                    bands=bands, min_samples=3)
     flagged = {r["phase"] for r in regs}
-    assert "decode" in flagged, (regs, live, baseline)
+    assert "arrow_decode" in flagged, (regs, live, baseline)
     assert "e2e" not in flagged, (regs, live, baseline)
     # the decode creep is a multiple, not jitter
-    dec = next(r for r in regs if r["phase"] == "decode"
+    dec = next(r for r in regs if r["phase"] == "arrow_decode"
                and r["class"] == ALL_CLASS)
     assert dec["ratio"] > 1.5
 
@@ -270,7 +270,7 @@ def test_regress_bench_artifact_diff(tmp_path, capsys):
 
     def artifact(path, decode_p50, wrap):
         snap = {ALL_CLASS: {
-            "decode": _cell(decode_p50),
+            "arrow_decode": _cell(decode_p50),
             "e2e": _cell(1.0),
         }}
         result = {"queries": {"phases": {"median": 1.0, "spread": 0.1,
@@ -289,7 +289,7 @@ def test_regress_bench_artifact_diff(tmp_path, capsys):
     captured = capsys.readouterr()
     assert rc == 1, captured
     report = json.loads(captured.out)
-    assert [r["phase"] for r in report["regressions"]] == ["decode"]
+    assert [r["phase"] for r in report["regressions"]] == ["arrow_decode"]
     # reversed direction is clean (improvements never fail CI)
     rc = cli_main(["regress", "--bench", new, old,
                    "--noise", "0.5", "--abs-floor", "0.01"])
@@ -422,5 +422,5 @@ def test_phase_totals_matches_fold_span_dicts():
     fast = rec.phase_totals(SPAN_PHASE)
     slow = fold_span_dicts(rec.to_dicts())
     assert fast == slow
-    assert fast["decode"] == pytest.approx(0.050, abs=1e-6)
+    assert fast["arrow_decode"] == pytest.approx(0.050, abs=1e-6)
     assert "h2d" not in fast and "attempt" not in fast

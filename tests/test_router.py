@@ -483,8 +483,13 @@ def test_overloaded_affinity_target_spills_to_idle_replica(dataset):
             assert wait_for(lambda: blocker.started.is_set())
             warm_svc.submit_plan(GatedScan(release))
             # affinity still points at the warm replica; its admission
-            # now rejects, and the router spills instead of bouncing
-            st2 = fl.router.submit({"use_cache": True}, blob)
+            # now rejects, and the router spills instead of bouncing.
+            # use_cache=False keeps the repeat off the admission fast
+            # path — a cache-covered repeat would be served from the
+            # saturated replica's ResultCache instead of rejected
+            # (pinned in test_zerocopy.py), which is not the ladder
+            # under test here.
+            st2 = fl.router.submit({"use_cache": False}, blob)
             assert st2["state"] != "REJECTED_OVERLOADED", st2
             p2 = wait_done(fl.router, st2["query_id"])
             assert p2["state"] == "DONE"

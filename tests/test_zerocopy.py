@@ -1,0 +1,618 @@
+"""Zero-copy serve path (ISSUE 17): decoded-plan cache, shared-memory
+Arrow arena, scatter-gather streaming.
+
+The differential oracle throughout: the arena paths (scatter-gather
+frames, leased handle) must be BYTE-IDENTICAL on the wire - and
+batch-identical after decode - to the socket byte path they replace,
+including mid-stream resume, and every arena failure (chaos seams
+`zerocopy.map` / `zerocopy.lease`, stale leases, missing segments)
+must degrade to the byte path with zero client-visible failures."""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import AggMode, FilterExec, HashAggregateExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.gateway import TaskGatewayServer, _FLAG_SERVICE
+from blaze_tpu.service import QueryService, ServiceClient
+from blaze_tpu.service import wire
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from blaze_tpu.zerocopy import (
+    ArrowArena,
+    DecodedPlanCache,
+    PlanEntry,
+    map_handle_frames,
+    plan_digest,
+)
+from tests.test_service import GatedScan, wait_for
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(29)
+    p = str(tmp_path / "zc.parquet")
+    pq.write_table(
+        pa.table({
+            "k": pa.array(rng.integers(0, 16, 4000), pa.int32()),
+            "v": pa.array(rng.random(4000), pa.float64()),
+        }),
+        p,
+    )
+    return p
+
+
+def agg_blob(path, threshold=0.5):
+    plan = HashAggregateExec(
+        FilterExec(ParquetScanExec([[FileRange(path)]]),
+                   Col("v") > threshold),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    return task_to_proto(plan, 0)
+
+
+def multipart_blob(path):
+    """A 2-partition filter plan: its result has one part per
+    partition, which the resume tests need."""
+    plan = FilterExec(
+        ParquetScanExec([[FileRange(path)], [FileRange(path)]]),
+        Col("v") > 0.5,
+    )
+    return task_to_proto(plan, 0)
+
+
+def table_of(batches):
+    return pa.Table.from_batches(list(batches)).sort_by(
+        [(c, "ascending") for c in batches[0].schema.names]
+    )
+
+
+# ---------------------------------------------------------------------------
+# plan digest + decoded-plan cache units
+# ---------------------------------------------------------------------------
+
+
+def test_plan_digest_is_the_router_affinity_key():
+    """One digest, two caches: the router's routing key and the
+    service's decoded-plan-cache key must stay the same function, or
+    the forwarded meta["plan_digest"] would miss every probe."""
+    from blaze_tpu.router.placement import affinity_key
+
+    blob = b"\x01\x02task-bytes"
+    assert affinity_key(blob, False) == plan_digest(blob, False)
+    assert affinity_key(blob, True) == plan_digest(blob, True)
+    assert plan_digest(blob, True) != plan_digest(blob, False)
+    assert plan_digest(blob, False) != plan_digest(blob + b"x", False)
+
+
+def test_plan_cache_lru_eviction_and_counters():
+    pc = DecodedPlanCache(max_entries=2)
+    for i in range(3):
+        pc.put(f"k{i}", PlanEntry(fingerprint=f"f{i}",
+                                  fingerprint_stable=True,
+                                  estimated_bytes=10, partition=0))
+    assert len(pc) == 2
+    st = pc.stats()
+    assert st["evictions"] == 1 and st["puts"] == 3
+    assert pc.get("k0") is None  # the LRU victim
+    assert pc.get("k2") is not None
+    st = pc.stats()
+    assert st["misses"] == 1 and st["hits"] == 1
+
+
+def test_plan_entry_tree_loan_is_exclusive():
+    """The decoded tree is mutated in place by plan preparation, so
+    the cache loans it to at most ONE borrower; a consumed tree never
+    returns and later hits re-decode lazily."""
+    e = PlanEntry(fingerprint="f", fingerprint_stable=True,
+                  estimated_bytes=1, partition=0)
+    tree = object()
+    e.restore_tree(tree)
+    assert e.borrow_tree() is tree
+    assert e.borrow_tree() is None  # loaned out: second borrower misses
+    other = object()
+    e.restore_tree(other)
+    assert e.borrow_tree() is other
+
+
+def test_plan_cache_put_first_writer_wins():
+    pc = DecodedPlanCache()
+    a = PlanEntry(fingerprint="fa", fingerprint_stable=True,
+                  estimated_bytes=1, partition=0)
+    b = PlanEntry(fingerprint="fb", fingerprint_stable=True,
+                  estimated_bytes=1, partition=0)
+    assert pc.put("k", a) is a
+    assert pc.put("k", b) is a  # racing writer adopts the winner
+
+
+# ---------------------------------------------------------------------------
+# arena units: publish / serve / evict / lease / reap
+# ---------------------------------------------------------------------------
+
+
+def _frames(n=3, size=100):
+    return [bytes([i]) * (size + i) for i in range(n)]
+
+
+def test_arena_publish_buffers_roundtrip(tmp_path):
+    ar = ArrowArena(directory=str(tmp_path / "a"), max_bytes=1 << 20)
+    frames = _frames()
+    assert ar.publish("key", frames)
+    views = ar.buffers("key")
+    assert [bytes(v) for v in views] == frames
+    assert ar.buffers("key", start_part=2) == [
+        memoryview(frames[2])
+    ]
+    assert ar.buffers("missing") is None
+    assert "key" in ar and "missing" not in ar
+    assert not ar.publish("key", frames)  # idempotent: first wins
+    assert ar.stats()["publish_skipped"] == 1
+    ar.close()
+
+
+def test_arena_lru_eviction_spares_leased_segments(tmp_path):
+    frames = [b"x" * 100]
+    ar = ArrowArena(directory=str(tmp_path / "a"), max_bytes=250)
+    assert ar.publish("k1", frames)
+    h = ar.handle("k1")
+    assert h is not None
+    assert ar.publish("k2", frames)
+    assert ar.publish("k3", frames)  # over budget: k2 (unleased) goes
+    assert "k1" in ar  # pinned by the lease
+    assert "k2" not in ar
+    assert ar.stats()["evictions"] == 1
+    ar.release(h["lease"])
+    assert ar.publish("k4", frames)  # now k1 is evictable
+    assert "k1" not in ar
+    ar.close()
+
+
+def test_arena_orphaned_lease_is_ttl_reaped(tmp_path):
+    """A client that crashed before RELEASE must not pin its segment
+    forever: the TTL reap expires the lease and the segment becomes
+    evictable again."""
+    ar = ArrowArena(directory=str(tmp_path / "a"), max_bytes=1 << 20,
+                    lease_ttl_s=0.05)
+    assert ar.publish("k", _frames())
+    h = ar.handle("k")
+    assert h is not None and ar.stats()["active_leases"] == 1
+    time.sleep(0.08)
+    assert ar.reap() == 1
+    st = ar.stats()
+    assert st["active_leases"] == 0
+    assert st["lease_orphans_reaped"] == 1
+    # the reaped lease id is dead: release answers False
+    assert not ar.release(h["lease"])
+    ar.close()
+
+
+def test_map_handle_frames_roundtrip_and_stale_lease(tmp_path):
+    ar = ArrowArena(directory=str(tmp_path / "a"))
+    frames = _frames()
+    assert ar.publish("k", frames)
+    h = ar.handle("k")
+    assert map_handle_frames(h) == frames
+    assert h["start_part"] == 0
+    # a skip handle carries only the remaining frames
+    h2 = ar.handle("k", start_part=1)
+    assert map_handle_frames(h2) == frames[1:]
+    # stale lease: segment file gone or truncated -> raise, never
+    # silently serve wrong bytes
+    with open(h["path"], "wb") as f:
+        f.write(b"tiny")
+    with pytest.raises(Exception):
+        map_handle_frames(h)
+    os.unlink(h["path"])
+    with pytest.raises(Exception):
+        map_handle_frames(h)
+    ar.close()
+
+
+def test_arena_close_removes_segment_files(tmp_path):
+    d = str(tmp_path / "a")
+    ar = ArrowArena(directory=d)
+    ar.publish("k", _frames())
+    paths = [s.path for s in ar._segments.values()]
+    ar.close()
+    assert ar.buffers("k") is None
+    for p in paths:
+        assert not os.path.exists(p)
+
+
+# ---------------------------------------------------------------------------
+# plan-cache service integration: exact decode-span counters
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cache_hit_zero_plan_decode_spans(dataset):
+    """The acceptance pin, dispatch-budget style: the FIRST submit of
+    a blob pays exactly one plan_decode span; a byte-identical repeat
+    pays exactly ZERO (no protobuf walk at all on the hit path)."""
+    blob = agg_blob(dataset)
+
+    def plan_decode_spans(q):
+        return sum(1 for s in q.tracer.to_dicts()
+                   if s["name"] == "plan_decode")
+
+    with QueryService(max_concurrency=1, enable_trace=True) as svc:
+        q1 = svc.submit_task(blob)
+        assert q1.wait(60.0) and q1.state.value == "DONE", q1.error
+        assert plan_decode_spans(q1) == 1
+        q2 = svc.submit_task(blob)
+        assert q2.wait(60.0) and q2.state.value == "DONE", q2.error
+        assert plan_decode_spans(q2) == 0
+        st = svc.stats()["plan_cache"]
+        assert st["misses"] == 1 and st["hits"] == 1
+        assert st["puts"] == 1 and st["entries"] == 1
+
+
+def test_plan_cache_repeat_executes_correctly_without_result_cache(
+    dataset,
+):
+    """With the ResultCache off, a plan-cache hit still EXECUTES - via
+    the loaned tree or a lazy re-decode - and must produce the same
+    result as the first run."""
+    blob = agg_blob(dataset)
+    with QueryService(max_concurrency=1, enable_cache=False) as svc:
+        q1 = svc.submit_task(blob, use_cache=False)
+        assert q1.wait(60.0) and q1.state.value == "DONE", q1.error
+        q2 = svc.submit_task(blob, use_cache=False)
+        assert q2.wait(60.0) and q2.state.value == "DONE", q2.error
+        assert table_of(q1.result).equals(table_of(q2.result))
+        st = svc.stats()["plan_cache"]
+        assert st["hits"] == 1 and st["misses"] == 1
+
+
+def test_plan_digest_forwarded_by_router(dataset):
+    """The router forwards its routing key as meta["plan_digest"]; the
+    replica's plan cache probes with it (hit on the repeat) without
+    re-hashing the blob."""
+    from blaze_tpu.router.proxy import Router
+
+    blob = agg_blob(dataset)
+    svc = QueryService(max_concurrency=2)
+    srv = TaskGatewayServer(service=svc).start()
+    router = Router(["%s:%d" % srv.address], poll_interval_s=0.1,
+                    start=False)
+    router.registry.poll_now()
+    try:
+        for _ in range(2):
+            resp = router.submit({"use_cache": True}, blob)
+            qid = resp["query_id"]
+            assert wait_for(
+                lambda: router.poll(qid)["state"] == "DONE", 60.0
+            ), router.poll(qid)
+        st = svc.stats()["plan_cache"]
+        assert st["hits"] == 1 and st["misses"] == 1
+    finally:
+        router.close()
+        srv.stop()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the differential oracle: arena wire bytes == socket wire bytes
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        b = sock.recv(n - len(buf))
+        if not b:
+            raise ConnectionError("eof")
+        buf += b
+    return buf
+
+
+def _raw_fetch(addr, qid, arena_bit=False):
+    """One FETCH over a raw socket, returning the exact byte stream
+    (every length-framed part + the terminator). Arena-handle escapes
+    fail the calling test - this helper is the BYTE path oracle."""
+    s = socket.create_connection(addr, timeout=30)
+    try:
+        s.sendall(_U64.pack(_FLAG_SERVICE))
+        q = qid.encode("utf-8")
+        t = wire._FETCH_ARENA if arena_bit else 0
+        s.sendall(bytes([wire.VERB_FETCH]) + _U32.pack(len(q)) + q
+                  + _U32.pack(t))
+        out = b""
+        while True:
+            head = _recv_exact(s, 8)
+            (ln,) = _U64.unpack(head)
+            out += head
+            if ln == 0:
+                return out
+            assert ln not in (wire._ERR, wire._ARENA), hex(ln)
+            out += _recv_exact(s, ln)
+    finally:
+        s.close()
+
+
+@pytest.mark.parametrize("wire_mode", ["threaded", "async"])
+def test_sg_fetch_byte_identical_to_socket_fetch(dataset, wire_mode):
+    """The scatter-gather arena path must put the EXACT same bytes on
+    the wire as the per-batch re-encode path it short-circuits - same
+    frames, same framing, same terminator - on both wire planes."""
+    blob = multipart_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc, wire=wire_mode) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            assert wait_for(
+                lambda: c.poll(qid)["state"] == "DONE", 60.0
+            )
+            # wait for the terminal hook's arena publish
+            assert wait_for(
+                lambda: svc.arena.stats()["segments"] > 0, 10.0
+            )
+        arena_stream = _raw_fetch(srv.address, qid)
+        assert svc.arena.stats()["sg_serves"] >= 1
+        saved, svc.arena = svc.arena, None
+        try:
+            byte_stream = _raw_fetch(srv.address, qid)
+        finally:
+            svc.arena = saved
+        assert arena_stream == byte_stream
+    svc.close()
+
+
+def test_handle_fetch_batches_identical_to_socket(dataset):
+    """The shm handle path decodes to exactly the batches the socket
+    path yields, and the lease is released after the map."""
+    blob = multipart_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            socket_batches = c.fetch(qid)
+        assert wait_for(
+            lambda: svc.arena.stats()["segments"] > 0, 10.0
+        )
+        with ServiceClient(*srv.address, use_arena=True) as c:
+            shm_batches = c.fetch(qid)
+        st = svc.arena.stats()
+        assert st["handle_hits"] >= 1
+        assert st["lease_releases"] >= 1 and st["active_leases"] == 0
+        assert table_of(socket_batches).equals(table_of(shm_batches))
+    svc.close()
+
+
+def test_handle_fetch_resumes_mid_stream(dataset):
+    """Count-based resume onto the handle path: the handle always
+    covers ALL parts; a client that already yielded k parts on the
+    byte path skips the first k frames itself."""
+    blob = multipart_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            full = c.fetch(qid)
+        assert wait_for(
+            lambda: svc.arena.stats()["segments"] > 0, 10.0
+        )
+        with ServiceClient(*srv.address, use_arena=True) as c:
+            resumed = list(c._fetch_parts(qid, 0, skip=1))
+        assert svc.arena.stats()["handle_hits"] >= 1
+        # part 0's batches are skipped, the rest byte-identical
+        n_skipped = len(full) - len(resumed)
+        assert n_skipped >= 1
+        for a, b in zip(full[n_skipped:], resumed):
+            assert a.equals(b)
+    svc.close()
+
+
+def test_client_map_failure_falls_back_to_byte_refetch(dataset):
+    """A handle the client cannot map (segment file vanished - the
+    not-co-located / stale-lease case) degrades to a byte-path
+    re-FETCH on the same connection: same batches, zero errors."""
+    blob = multipart_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            expect = c.fetch(qid)
+        assert wait_for(
+            lambda: svc.arena.stats()["segments"] > 0, 10.0
+        )
+        # yank the segment file out from under the client's mmap;
+        # the server's own mapping (already open) keeps serving sg
+        for seg in svc.arena._segments.values():
+            os.rename(seg.path, seg.path + ".gone")
+        try:
+            with ServiceClient(*srv.address, use_arena=True) as c:
+                got = c.fetch(qid)
+        finally:
+            for seg in svc.arena._segments.values():
+                os.rename(seg.path + ".gone", seg.path)
+        assert table_of(expect).equals(table_of(got))
+        # the failed lease was still released (no orphan left behind)
+        assert svc.arena.stats()["active_leases"] == 0
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# admission fast path: cached repeats bypass the byte-reservation queue
+# ---------------------------------------------------------------------------
+
+
+def test_queued_fleet_still_serves_cached_repeat(dataset):
+    """The acceptance pin: a fleet saturated with queued work (both
+    admission slots held, more queued behind them) still answers a
+    cached repeat immediately - the fast path bypasses the
+    byte-reservation queue entirely."""
+    blob = agg_blob(dataset)
+    release = threading.Event()
+    blocker = GatedScan(release)
+    with QueryService(max_concurrency=1) as svc:
+        # warm the result cache while the fleet is idle
+        q0 = svc.submit_task(blob)
+        assert q0.wait(60.0) and q0.state.value == "DONE", q0.error
+        # saturate: one RUNNING (gated), one QUEUED behind it
+        qb = svc.submit_plan(blocker, estimated_bytes=0,
+                             use_cache=False)
+        assert blocker.started.wait(10.0)
+        qq = svc.submit_plan(GatedScan(threading.Event()),
+                             estimated_bytes=0, use_cache=False)
+        try:
+            assert qq.state.value == "QUEUED"
+            q2 = svc.submit_task(blob)
+            # served from cache while the queue is wedged
+            assert q2.wait(10.0) and q2.state.value == "DONE", (
+                q2.state, q2.error
+            )
+            assert svc.obs_counters["fast_path_serves"] == 1
+            assert table_of(q0.result).equals(table_of(q2.result))
+            # the blocker is still running, the queue untouched
+            assert qb.state.value == "RUNNING"
+            assert qq.state.value == "QUEUED"
+        finally:
+            release.set()
+            svc.cancel(qq.query_id)
+            qb.wait(30.0)
+            qq.wait(30.0)
+
+
+def test_fast_path_skipped_when_cache_cannot_cover(dataset):
+    """A first-seen plan (no cached result) never takes the fast
+    path - it queues like any other submission."""
+    blob = agg_blob(dataset, threshold=0.123)
+    with QueryService(max_concurrency=1) as svc:
+        q = svc.submit_task(blob)
+        assert q.wait(60.0) and q.state.value == "DONE", q.error
+        assert svc.obs_counters["fast_path_serves"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: the zerocopy seams degrade to the byte path
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_map_fault_degrades_publish_to_byte_path(dataset):
+    """`zerocopy.map` firing at publish time means NO arena segment -
+    and the serve path silently stays on the socket byte path with
+    zero client-visible failures."""
+    blob = agg_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with chaos.active([
+        Fault(site="zerocopy.map", klass="TRANSIENT", times=0),
+    ], seed=17):
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address, use_arena=True) as c:
+                qid = c.submit(blob)["query_id"]
+                got = c.fetch(qid)
+            assert got
+    st = svc.arena.stats()
+    assert st["segments"] == 0
+    assert st["map_failures"] >= 1
+    svc.close()
+
+
+def test_chaos_lease_fault_degrades_handle_to_sg_bytes(dataset):
+    """`zerocopy.lease` firing at handle-grant time: the server
+    answers scatter-gather bytes instead of a handle - the client
+    (which asked for a handle) never notices."""
+    blob = agg_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            expect = c.fetch(qid)
+        assert wait_for(
+            lambda: svc.arena.stats()["segments"] > 0, 10.0
+        )
+        with chaos.active([
+            Fault(site="zerocopy.lease", klass="TRANSIENT", times=0),
+        ], seed=19):
+            with ServiceClient(*srv.address, use_arena=True) as c:
+                got = c.fetch(qid)
+        st = svc.arena.stats()
+        assert st["lease_faults"] >= 1
+        assert st["sg_serves"] >= 1
+        assert table_of(expect).equals(table_of(got))
+    svc.close()
+
+
+def test_parquet_mmap_falls_back_under_chaos(tmp_path):
+    """LocalStore.open_input serves an mmap'd parquet page buffer by
+    default; the `zerocopy.map` seam (or BLAZE_PARQUET_MMAP=0)
+    degrades it to the buffered-read path - both read identically."""
+    import pyarrow.lib as palib
+
+    from blaze_tpu.io.object_store import LocalStore
+
+    p = str(tmp_path / "m.parquet")
+    pq.write_table(pa.table({"a": list(range(64))}), p)
+    store = LocalStore()
+    f = store.open_input(p)
+    assert isinstance(f, palib.MemoryMappedFile)
+    assert pq.read_table(f).equals(pq.read_table(p))
+    with chaos.active([
+        Fault(site="zerocopy.map", klass="TRANSIENT", times=0),
+    ], seed=23):
+        f2 = store.open_input(p)
+    assert not isinstance(f2, palib.MemoryMappedFile)
+    with f2:
+        assert pq.read_table(f2).equals(pq.read_table(p))
+    os.environ["BLAZE_PARQUET_MMAP"] = "0"
+    try:
+        f3 = store.open_input(p)
+        assert not isinstance(f3, palib.MemoryMappedFile)
+        f3.close()
+    finally:
+        del os.environ["BLAZE_PARQUET_MMAP"]
+
+
+# ---------------------------------------------------------------------------
+# obs surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stats_and_metrics_carry_zerocopy_counters(dataset):
+    blob = agg_blob(dataset)
+    svc = QueryService(max_concurrency=1, arena_bytes=32 << 20)
+    with TaskGatewayServer(service=svc) as srv:
+        with ServiceClient(*srv.address) as c:
+            qid = c.submit(blob)["query_id"]
+            c.fetch(qid)
+            c.fetch(c.submit(blob)["query_id"])
+            st = c.stats()
+            assert st["plan_cache"]["hits"] == 1
+            assert st["arena"]["published"] >= 1
+            text = c.metrics()
+    assert "blaze_plan_cache_events_total" in text
+    assert "blaze_arena_events_total" in text
+    assert "blaze_service_fast_path_serves_total" in text
+    svc.close()
+
+
+def test_plan_decode_phase_rolls_up_split_from_arrow_decode(dataset):
+    """The decode phase split: plan_decode (protobuf walk) and
+    arrow_decode (parquet pages) roll up as SEPARATE phases."""
+    from blaze_tpu.obs import phases
+
+    blob = agg_blob(dataset, threshold=0.31)
+    phases.ROLLUP._reset_for_tests()
+    with QueryService(max_concurrency=1, enable_cache=False,
+                      enable_trace=True) as svc:
+        for _ in range(2):
+            q = svc.submit_task(blob, use_cache=False)
+            assert q.wait(60.0) and q.state.value == "DONE", q.error
+    snap = phases.ROLLUP.snapshot()[phases.ALL_CLASS]
+    assert "plan_decode" in snap and "arrow_decode" in snap
+    assert "decode" not in snap
