@@ -1350,6 +1350,128 @@ def child(n_rows):
             "error": f"{type(e).__name__}: {e}"[:300]
         }
 
+    # ---- multi-tenant fairness (ISSUE 18): two tenants through one
+    # gateway, one flooding far past its budget. `median` is the
+    # VICTIM tenant's per-query p50 while the flood runs; solo_median
+    # is the same client alone on the same service. degradation =
+    # median / solo_median is the smoke's <= 2x isolation bar: the
+    # flooder's over-budget submits must be rejected at admission
+    # (REJECTED_TENANT_BUDGET - the budget WORKING, not a failure),
+    # never queued ahead of the victim. Victim rejections must be 0. ----
+    try:
+        import threading as _tf_threading
+
+        from blaze_tpu.errors import (
+            TenantBudgetError as _TfBudgetError,
+        )
+        from blaze_tpu.runtime.gateway import (
+            TaskGatewayServer as _TfGateway,
+        )
+        from blaze_tpu.service import (
+            QueryService as _TfService,
+            ServiceClient as _TfClient,
+        )
+
+        tf_svc = _TfService(
+            max_concurrency=4, enable_cache=False,
+            tenant_config={
+                "flood": {"max_queued": 4, "max_running": 1},
+            },
+        )
+        tf_name = "tenant_fairness_qps"
+        try:
+            with _TfGateway(service=tf_svc) as tf_srv:
+                tf_host, tf_port = tf_srv.address
+                k_tf = int(os.environ.get("BLAZE_BENCH_ITERS", 3))
+                n_victim = max(3, k_tf)
+
+                def victim_p50():
+                    ts = []
+                    with _TfClient(tf_host, tf_port,
+                                   tenant="victim") as cl:
+                        for _ in range(n_victim):
+                            t0 = time.perf_counter()
+                            cl.run(svc_blob, use_cache=False)
+                            ts.append(time.perf_counter() - t0)
+                    ts.sort()
+                    return ts
+
+                victim_p50()  # warm-up: compile, excluded
+                solo = victim_p50()
+                solo_p50 = solo[len(solo) // 2]
+
+                stop = _tf_threading.Event()
+                flood_sent = [0]
+
+                def flooder():
+                    with _TfClient(tf_host, tf_port,
+                                   tenant="flood",
+                                   reconnect_attempts=1) as cl:
+                        while not stop.is_set():
+                            try:
+                                cl.submit(svc_blob,
+                                          use_cache=False)
+                                flood_sent[0] += 1
+                            except _TfBudgetError:
+                                continue  # budget doing its job
+                            except Exception:  # noqa: BLE001
+                                time.sleep(0.01)
+
+                floods = [
+                    _tf_threading.Thread(target=flooder,
+                                         daemon=True)
+                    for _ in range(4)
+                ]
+                for t in floods:
+                    t.start()
+                time.sleep(0.2)  # let the flood saturate its budget
+                try:
+                    flooded = victim_p50()
+                finally:
+                    stop.set()
+                    for t in floods:
+                        t.join(timeout=5)
+                fl_p50 = flooded[len(flooded) // 2]
+                tstats = (tf_svc.stats().get("tenants") or {})
+                detail[tf_name] = {
+                    "median": round(fl_p50, 4),
+                    "spread": round(
+                        (flooded[-1] - flooded[0]) / fl_p50
+                        if fl_p50 else 0.0, 3
+                    ),
+                    "k": n_victim,
+                    "qps": round(1.0 / fl_p50, 1) if fl_p50 else 0,
+                    "solo_median": round(solo_p50, 4),
+                    "degradation": round(
+                        fl_p50 / solo_p50 if solo_p50 else 0.0, 3
+                    ),
+                    "victim_rejections": int(
+                        (tstats.get("victim") or {})
+                        .get("rejected_budget", 0)
+                    ),
+                    "flood_rejections": int(
+                        (tstats.get("flood") or {})
+                        .get("rejected_budget", 0)
+                    ),
+                    "flood_submitted": int(
+                        (tstats.get("flood") or {})
+                        .get("submitted", 0)
+                    ),
+                }
+        finally:
+            tf_svc.close()
+        print(
+            "PARTIAL " + json.dumps(
+                {"query": tf_name, "backend": backend,
+                 **detail[tf_name]}
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - the battery must survive
+        detail["tenant_fairness_qps"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]
+        }
+
     # ---- streaming data plane (ISSUE 14): time-to-first-part vs
     # time-to-last-part through the gateway FETCH stream. A filter-
     # only plan over an 8-row-group parquet file keeps parts flowing
@@ -2256,6 +2378,36 @@ def smoke():
         elif "error" in rq64:
             problems.append(
                 f"router_qps_c64 failed: {rq64['error']}"
+            )
+        # multi-tenant isolation bar (ISSUE 18): a tenant flooding
+        # past its admission budget must not degrade the victim
+        # tenant's p50 beyond 2x its solo baseline, and the victim
+        # must see ZERO budget rejections - its traffic never
+        # competes with the flooder's over-budget backlog. Spread-
+        # guarded like the qps pins: the degradation must exceed the
+        # run's own noise band before it reddens the smoke.
+        tfq = (result.get("queries") or {}).get(
+            "tenant_fairness_qps") or {}
+        if tfq and "error" not in tfq:
+            deg = float(tfq.get("degradation", 0.0))
+            tf_noise = float(tfq.get("spread", 0.0))
+            if deg > 2.0 and (deg - 2.0) > tf_noise:
+                problems.append(
+                    f"tenant isolation broken: victim p50 degraded "
+                    f"{deg}x under flood (want <= 2x solo; "
+                    f"solo {tfq.get('solo_median')}s vs "
+                    f"flooded {tfq.get('median')}s)"
+                )
+            if int(tfq.get("victim_rejections", 0)) != 0:
+                problems.append(
+                    f"victim tenant saw "
+                    f"{tfq['victim_rejections']} budget rejections "
+                    "(flooder's backlog leaked into the victim's "
+                    "budget)"
+                )
+        elif tfq:
+            problems.append(
+                f"tenant_fairness_qps failed: {tfq.get('error')}"
             )
         obs = (result.get("queries") or {}).get("obs_overhead") or {}
         if obs and "error" not in obs:

@@ -146,6 +146,10 @@ def cmd_serve(args) -> int:
         plan_cache_entries=args.plan_cache_entries,
         arena_bytes=(0 if args.no_arena else args.arena_bytes),
         arena_dir=args.arena_dir,
+        tenant_config=(
+            json.loads(args.tenant_config)
+            if args.tenant_config else None
+        ),
     )
     if args.profile_hz > 0:
         # whole-lifetime profiling: contention accounting + stack
@@ -298,6 +302,14 @@ def cmd_route(args) -> int:
         stream_window=args.stream_window,
         stream_stall_s=args.stream_stall_s,
         stream_total_bytes=args.stream_total_bytes,
+        tenant_rate=args.tenant_rate,
+        tenant_burst=args.tenant_burst,
+        tenant_retry_budget=args.tenant_retry_budget,
+        tenant_retry_window_s=args.tenant_retry_window,
+        tenant_config=(
+            json.loads(args.tenant_config)
+            if args.tenant_config else None
+        ),
         wire=args.wire,
     )
     return 0
@@ -771,6 +783,14 @@ def main(argv=None) -> int:
     sv.add_argument("--arena-dir", default=None,
                     help="arena segment directory (default: a "
                          "private temp dir, removed at close)")
+    sv.add_argument("--tenant-config", default=None, metavar="JSON",
+                    help="per-tenant admission budgets, e.g. "
+                         '\'{"acme": {"max_queued": 8, '
+                         '"max_running": 1, "weight": 2.0}, '
+                         '"*": {"max_queued": 32}}\' - enables '
+                         "weighted-fair (DRR) ordering across "
+                         "tenants; omit for tenant-unaware admission "
+                         "(docs/SERVICE.md)")
     tr = sub.add_parser("trace")
     tr.add_argument("query_id")
     tr.add_argument("--host", default="127.0.0.1")
@@ -858,6 +878,29 @@ def main(argv=None) -> int:
                          "the default) or the legacy thread-per-"
                          "connection front (threaded); default "
                          "honors BLAZE_WIRE")
+    rr.add_argument("--tenant-rate", type=float, default=0.0,
+                    help="fleet-level per-tenant SUBMIT rate limit "
+                         "(queries/sec, token bucket); over-rate "
+                         "submits are rejected REJECTED_TENANT_BUDGET "
+                         "before journaling, zero breaker strikes "
+                         "(0 = off; docs/ROUTER.md)")
+    rr.add_argument("--tenant-burst", type=int, default=None,
+                    help="token-bucket burst size (default "
+                         "2x --tenant-rate, min 1)")
+    rr.add_argument("--tenant-retry-budget", type=int, default=0,
+                    help="per-tenant failover/retry re-submits "
+                         "allowed per trailing window; an exhausted "
+                         "budget surfaces the original classified "
+                         "error instead of re-submitting (0 = "
+                         "unlimited)")
+    rr.add_argument("--tenant-retry-window", type=float,
+                    default=30.0,
+                    help="trailing window seconds for "
+                         "--tenant-retry-budget")
+    rr.add_argument("--tenant-config", default=None, metavar="JSON",
+                    help="per-tenant overrides, e.g. "
+                         '\'{"acme": {"rate": 5, "burst": 10, '
+                         '"retry_budget": 4}, "*": {"rate": 50}}\'')
     md = sub.add_parser("mesh-dryrun")
     md.add_argument("--devices", type=int, default=8,
                     help="virtual device count for the forced host "

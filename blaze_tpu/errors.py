@@ -94,6 +94,18 @@ class ReplicaDrainingError(TransientError):
     the next replica with zero breaker strikes."""
 
 
+class TenantBudgetError(TransientError):
+    """The submitting tenant is over its configured budget (queued
+    entries, RUNNING slots, reserved bytes) or router-tier rate
+    limit. TRANSIENT by design - the budget frees as the tenant's own
+    in-flight work drains (or the rate-limit window refills), so a
+    bare client's correct reaction is the same retry-with-backoff it
+    applies to DRAINING; a router treats a replica-side budget
+    rejection as a placement miss and spills to the next replica with
+    zero breaker strikes (the replica is healthy - the TENANT is
+    over budget)."""
+
+
 # exception type names that mean "cooperative cancellation" - matched by
 # name to avoid importing the scheduler/service from this leaf module
 _CANCEL_NAMES = frozenset({"PlanCancelled", "QueryCancelled"})

@@ -83,20 +83,33 @@ ALL_CLASS = "_all"
 
 
 def class_key(fingerprint: Optional[str],
-              stable: bool = True) -> str:
+              stable: bool = True,
+              tenant: Optional[str] = None) -> str:
     """Fingerprint class: the rollup key. A short DIGEST of the
     content-addressed plan fingerprint (the fingerprint itself is a
     readable nested expression - its prefix is just the root
     operator's name and would fold every hash-aggregate into one
     class), or 'unstable' for plans without content identity. The
-    full fingerprint stays in obs/history."""
-    if not fingerprint or not stable:
-        return "unstable"
-    import hashlib
+    full fingerprint stays in obs/history.
 
-    return hashlib.blake2b(
-        str(fingerprint).encode("utf-8"), digest_size=6
-    ).hexdigest()
+    Tenancy (ROADMAP item 5 follow-up): a NON-default tenant gets its
+    own class dimension - `<digest>@<tenant>` - so one tenant's
+    phase-duration drift is attributable without polluting another's
+    rings. The default tenant's keys (and therefore
+    PHASE_BASELINE.json, the regress probe, and every zero-config
+    rollup) are unchanged, and the `_all` aggregate still folds every
+    query regardless of tenant."""
+    if not fingerprint or not stable:
+        base = "unstable"
+    else:
+        import hashlib
+
+        base = hashlib.blake2b(
+            str(fingerprint).encode("utf-8"), digest_size=6
+        ).hexdigest()
+    if tenant and tenant != "default":
+        return f"{base}@{tenant}"
+    return base
 
 
 class PhaseRollup:
@@ -179,7 +192,8 @@ class PhaseRollup:
                 durations.setdefault(phase, s)
         self.fold_phases(
             durations,
-            klass=class_key(q._fingerprint, q._fingerprint_stable),
+            klass=class_key(q._fingerprint, q._fingerprint_stable,
+                            tenant=getattr(q, "tenant", None)),
         )
 
     # -- read path -------------------------------------------------------

@@ -23,6 +23,7 @@ skipping what the client already received.
 from __future__ import annotations
 
 import asyncio
+import collections
 import hashlib
 import itertools
 import logging
@@ -64,6 +65,7 @@ from blaze_tpu.service.wire import (
     VERB_FETCH,
     ServiceError,
     _is_draining_rejection,
+    _is_tenant_budget_rejection,
     _send_err,
 )
 from blaze_tpu.testing import chaos
@@ -175,6 +177,11 @@ class Router:
         replicate_interval_s: float = 2.0,
         journal_path: Optional[str] = None,
         recover_timeout_s: float = 30.0,
+        tenant_rate: float = 0.0,
+        tenant_burst: Optional[int] = None,
+        tenant_retry_budget: int = 0,
+        tenant_retry_window_s: float = 30.0,
+        tenant_config: Optional[dict] = None,
         start: bool = True,
     ):
         if placement not in ("affinity", "random"):
@@ -239,7 +246,34 @@ class Router:
             "stream_stalls": 0,
             "stream_window_waits": 0,
             "stream_total_waits": 0,
+            "tenant_rate_limited": 0,
+            "tenant_budget_spills": 0,
+            "tenant_retry_budget_exhausted": 0,
         }
+        # ---- multi-tenant fleet protection --------------------------
+        # Two router-tier guards sit ABOVE the replicas' own admission
+        # budgets: a token-bucket rate limit on SUBMIT (checked before
+        # the query is journaled, so a flooding tenant never bloats the
+        # routing table or the journal), and a windowed retry budget
+        # that bounds how much failover/retry amplification one
+        # tenant's failing plans can inflict on the fleet. Both default
+        # OFF (rate <= 0, budget <= 0) - zero-config behavior is
+        # byte-identical to a tenant-unaware router. Per-tenant
+        # overrides come from tenant_config {tenant: {"rate": qps,
+        # "burst": n, "retry_budget": n}, "*": defaults}.
+        self.tenant_rate = float(tenant_rate)
+        self.tenant_burst = (
+            None if tenant_burst is None else max(1, int(tenant_burst))
+        )
+        self.tenant_retry_budget = int(tenant_retry_budget)
+        self.tenant_retry_window_s = float(tenant_retry_window_s)
+        self.tenant_config = dict(tenant_config or {})
+        self._tenant_mu = threading.Lock()
+        # token buckets: tenant -> [tokens, last_refill_monotonic]
+        self._tenant_buckets: Dict[str, list] = {}
+        # retry-budget windows: tenant -> deque of spend timestamps
+        self._tenant_retries: Dict[str, collections.deque] = {}
+        self._tenant_counters: Dict[str, Dict[str, int]] = {}
         # fleet-wide relay-window memory: bytes currently parked in
         # the bounded per-stream relay queues of _raw_fetch_windowed,
         # summed across concurrent streams (the
@@ -852,12 +886,112 @@ class Router:
             self._finish(rq, out.get("state"))
         return out
 
+    # -- multi-tenant fleet protection -----------------------------------
+    def _tenant_cfg(self, tenant: str, key: str, default):
+        """Per-tenant override from tenant_config, with "*" as the
+        config-level default tier and the constructor knob below it."""
+        for scope in (tenant, "*"):
+            ent = self.tenant_config.get(scope)
+            if isinstance(ent, dict) and key in ent:
+                return ent[key]
+        return default
+
+    def _tenant_count(self, tenant: str, key: str, n: int = 1) -> None:
+        with self._tenant_mu:
+            c = self._tenant_counters.setdefault(tenant, {
+                "submitted": 0,
+                "rate_limited": 0,
+                "budget_spills": 0,
+                "retry_budget_spent": 0,
+                "retry_budget_exhausted": 0,
+            })
+            c[key] = c.get(key, 0) + n
+
+    def _tenant_allow(self, tenant: str) -> bool:
+        """Token-bucket admission for one SUBMIT. rate <= 0 = no limit
+        for this tenant (the zero-config identity path)."""
+        rate = float(self._tenant_cfg(tenant, "rate", self.tenant_rate))
+        if rate <= 0:
+            return True
+        burst = self._tenant_cfg(tenant, "burst", self.tenant_burst)
+        burst = max(1.0, float(burst) if burst is not None
+                    else max(1.0, 2.0 * rate))
+        now = time.monotonic()
+        with self._tenant_mu:
+            tokens, last = self._tenant_buckets.get(tenant,
+                                                    (burst, now))
+            tokens = min(burst, tokens + (now - last) * rate)
+            if tokens >= 1.0:
+                self._tenant_buckets[tenant] = [tokens - 1.0, now]
+                return True
+            self._tenant_buckets[tenant] = [tokens, now]
+            return False
+
+    def _retry_spend(self, tenant: str) -> bool:
+        """Spend one unit of the tenant's windowed retry budget.
+        Returns False (and counts the exhaustion) when the budget for
+        the trailing window is gone: the caller must then surface the
+        ORIGINAL error instead of amplifying the failure with another
+        fleet-wide re-submit. budget <= 0 = unlimited (default).
+
+        Crash-recovery re-adoption paths deliberately do NOT call
+        this: a restarted router replaying its journal is recovering
+        in-flight work, not observing new tenant load, and must not
+        charge (or exhaust) anyone's budget for queries it merely
+        re-polls."""
+        budget = int(self._tenant_cfg(
+            tenant, "retry_budget", self.tenant_retry_budget
+        ))
+        if budget <= 0:
+            return True
+        now = time.monotonic()
+        with self._tenant_mu:
+            dq = self._tenant_retries.setdefault(
+                tenant, collections.deque()
+            )
+            while dq and now - dq[0] > self.tenant_retry_window_s:
+                dq.popleft()
+            if len(dq) >= budget:
+                self.counters["tenant_retry_budget_exhausted"] += 1
+                c = self._tenant_counters.setdefault(tenant, {})
+                c["retry_budget_exhausted"] = \
+                    c.get("retry_budget_exhausted", 0) + 1
+                return False
+            dq.append(now)
+        self._tenant_count(tenant, "retry_budget_spent")
+        REGISTRY.inc("blaze_tenant_retry_budget_spent_total",
+                     tenant=tenant)
+        return True
+
     # -- submit ----------------------------------------------------------
     def submit(self, meta: dict, task_bytes: bytes, *,
                is_ref: bool = False,
                manifest_bytes: Optional[bytes] = None) -> dict:
         with self._lock:
             self.counters["submitted"] += 1
+        tenant = str(meta.get("tenant") or "default")
+        self._tenant_count(tenant, "submitted")
+        if not self._tenant_allow(tenant):
+            # fleet-level rate limit: reject BEFORE journaling or
+            # registering anything - a flooding tenant must not bloat
+            # the routing table, the journal, or recovery replay. Same
+            # wire shape as a replica-side budget rejection (the
+            # REJECTED_TENANT_BUDGET marker), so ServiceClient
+            # classifies it TenantBudgetError and backs off; zero
+            # breaker involvement
+            with self._lock:
+                self.counters["tenant_rate_limited"] += 1
+            self._tenant_count(tenant, "rate_limited")
+            REGISTRY.inc("blaze_tenant_rate_limited_total",
+                         tenant=tenant)
+            return {
+                "state": "REJECTED_OVERLOADED",
+                "error": (
+                    f"REJECTED_TENANT_BUDGET: tenant {tenant!r} is "
+                    "over its router rate limit; retry with backoff"
+                ),
+                "error_class": "TRANSIENT",
+            }
         key = affinity_key(task_bytes, is_ref)
         rq = RoutedQuery(key, task_bytes, is_ref, manifest_bytes,
                          dict(meta))
@@ -915,6 +1049,7 @@ class Router:
         when nobody routable is left or everybody rejected."""
         attempts = len(self.registry.replicas) + 1
         rejected_err: Optional[str] = None
+        all_tenant_budget = True  # every rejection so far was tenant-budget
         rec = rq.tracer
         # one router_place span per placement pass (submit or
         # failover move): the ladder walk, every per-replica
@@ -1001,6 +1136,9 @@ class Router:
                         return resp
                     if resp.get("state") == "REJECTED_OVERLOADED":
                         draining = _is_draining_rejection(resp)
+                        tenant_budget = _is_tenant_budget_rejection(
+                            resp
+                        )
                         if draining:
                             # the replica announced a drain the next
                             # STATS poll has not delivered yet: stop
@@ -1014,13 +1152,33 @@ class Router:
                             )
                             with self._lock:
                                 self.counters["drain_spills"] += 1
+                        elif tenant_budget:
+                            # the TENANT is over budget on this
+                            # replica, not the replica over capacity:
+                            # spill (another replica may have budget
+                            # headroom for it), no draining mark, zero
+                            # breaker strikes
+                            with self._lock:
+                                self.counters[
+                                    "tenant_budget_spills"
+                                ] += 1
+                            self._tenant_count(
+                                str(rq.meta.get("tenant")
+                                    or "default"),
+                                "budget_spills",
+                            )
+                        if not tenant_budget:
+                            all_tenant_budget = False
                         log.info(
                             "replica %s rejected %s (%s); spilling",
                             replica.replica_id, rq.external_id,
-                            "draining" if draining else "overloaded",
+                            "draining" if draining
+                            else "tenant budget" if tenant_budget
+                            else "overloaded",
                         )
                         hop.tag(overflow_spill=True,
-                                draining=draining or None)
+                                draining=draining or None,
+                                tenant_budget=tenant_budget or None)
                         place_sp.event(
                             "overflow_spill",
                             replica=replica.replica_id,
@@ -1071,6 +1229,13 @@ class Router:
                     )
                 return resp
             if rejected_err is not None:
+                if all_tenant_budget:
+                    # every routable replica rejected on THIS tenant's
+                    # budget: keep the replica's marker as the message
+                    # prefix so the client classifies it
+                    # TenantBudgetError (not generic overload) through
+                    # the router's error passthrough
+                    raise ReplicaUnavailableError(rejected_err)
                 raise ReplicaUnavailableError(
                     "every routable replica rejected overloaded "
                     f"(last: {rejected_err})"
@@ -1354,6 +1519,15 @@ class Router:
         action = failover_action(status.get("error_class"))
         rid = rq.replica_id
         if action == "resubmit" and rq.resubmits < self.max_resubmits:
+            if not self._retry_spend(
+                str(rq.meta.get("tenant") or "default")
+            ):
+                # windowed retry budget exhausted: surface the
+                # ORIGINAL classified error instead of letting one
+                # tenant's persistently-failing plan amplify into
+                # fleet-wide retry storms. Other tenants' budgets are
+                # untouched
+                return status
             delay = self.resubmit_backoff_s * (2 ** rq.resubmits)
             time.sleep(random.uniform(delay * 0.5, delay))
             if self._resubmit(rq, rq.generation, same_replica=True,
@@ -1434,6 +1608,13 @@ class Router:
                 # this router: report it from the routing table - a
                 # status check must never resurrect a dead handle
                 return self._last_known_status(rq)
+            if not self._retry_spend(
+                str(rq.meta.get("tenant") or "default")
+            ):
+                raise ReplicaUnavailableError(
+                    f"replica {replica.replica_id} unreachable and "
+                    "tenant retry budget exhausted"
+                )
             if not self._resubmit(rq, gen, same_replica=False,
                                   exclude={replica.replica_id},
                                   counter="failovers"):
@@ -1446,9 +1627,12 @@ class Router:
             # replica lost the handle (restarted)
             if rq.finished and rq.last_state:
                 return self._last_known_status(rq)  # never re-run
-            # live query: re-route = fresh run
-            if self._resubmit(rq, gen, same_replica=False,
-                              exclude=set(), counter="failovers"):
+            # live query: re-route = fresh run (budget-gated: a lost
+            # handle re-run is a failover re-submit like any other)
+            if self._retry_spend(
+                str(rq.meta.get("tenant") or "default")
+            ) and self._resubmit(rq, gen, same_replica=False,
+                                 exclude=set(), counter="failovers"):
                 return self._downstream_status(rq, depth + 1)
         return st
 
@@ -1620,6 +1804,11 @@ class Router:
             "arena": {"segments": 0, "bytes": 0, "sg_serves": 0,
                       "handle_hits": 0},
             "queries_by_state": {},
+            # per-tenant admission state summed across replica STATS
+            # (queued/running/reserved_bytes + replica-side budget
+            # rejections); the router-tier guards (rate_limited,
+            # retry_budget_*) live under "router.tenants"
+            "tenants": {},
         }
         for r in self.registry.replicas.values():
             if r.alive:
@@ -1649,9 +1838,21 @@ class Router:
                 fleet["queries_by_state"][s] = (
                     fleet["queries_by_state"].get(s, 0) + int(n)
                 )
+            for t, ts in (r.stats.get("tenants") or {}).items():
+                agg = fleet["tenants"].setdefault(t, {
+                    "queued": 0, "running": 0, "reserved_bytes": 0,
+                    "submitted": 0, "admitted": 0,
+                    "rejected_budget": 0,
+                })
+                for k in agg:
+                    agg[k] += int(ts.get(k, 0))
         with self._lock:
             counters = dict(self.counters)
             retained = len(self._queries)
+        with self._tenant_mu:
+            tenant_counters = {
+                t: dict(c) for t, c in self._tenant_counters.items()
+            }
         return {
             "router": {
                 "placement": self.placement_mode,
@@ -1667,6 +1868,15 @@ class Router:
                 "streaming": {
                     "window": self.stream_window,
                     "stall_s": self.stream_stall_s,
+                },
+                # router-tier tenant guards: per-tenant counters plus
+                # the effective default knobs (per-tenant overrides
+                # come from tenant_config)
+                "tenants": tenant_counters,
+                "tenant_limits": {
+                    "rate": self.tenant_rate,
+                    "retry_budget": self.tenant_retry_budget,
+                    "retry_window_s": self.tenant_retry_window_s,
                 },
             },
             "replicas": self.registry.snapshot(),
@@ -1757,6 +1967,17 @@ class Router:
         # the observability precursor to a fleet-wide relay-memory cap
         yield ("blaze_router_stream_buffered_bytes", {},
                self._stream_buffered, "gauge")
+        # router-tier tenant guards (replica-side budget state comes
+        # from each replica's own blaze_tenant_* gauges)
+        with self._tenant_mu:
+            tenant_counters = {
+                t: dict(c) for t, c in self._tenant_counters.items()
+            }
+        for t, c in tenant_counters.items():
+            for k in ("rate_limited", "budget_spills",
+                      "retry_budget_spent"):
+                yield (f"blaze_tenant_{k}", {"tenant": t},
+                       c.get(k, 0), "counter")
 
     # -- FETCH passthrough -----------------------------------------------
     def _splice_note(self, rq, i: int, payload: bytes) -> bool:

@@ -106,10 +106,16 @@ class Query:
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
         query_id: Optional[str] = None,
+        tenant: str = "default",
     ):
         assert (task_bytes is None) != (plan is None), \
             "exactly one of task_bytes/plan"
         self.query_id = query_id or _new_query_id()
+        # multi-tenant identity (docs/SERVICE.md "Tenancy"): rides
+        # SUBMIT meta end to end - admission budgets, weighted-fair
+        # ordering, per-tenant metrics and the router's rate limits
+        # all key on it; "default" = untagged traffic
+        self.tenant = str(tenant or "default")
         self.task_bytes = task_bytes
         self.plan = plan
         self.is_ref = is_ref
@@ -342,6 +348,10 @@ class Query:
             "state": self.state.value,
             "priority": self.priority,
         }
+        if self.tenant != "default":
+            # zero-config payloads stay byte-identical: only tagged
+            # traffic carries the tenant field back
+            out["tenant"] = self.tenant
         if self.error:
             out["error"] = self.error
         if self.error_class:

@@ -86,11 +86,17 @@ class QueryService:
         arena=None,
         arena_bytes: int = 0,
         arena_dir: Optional[str] = None,
+        tenant_config: Optional[dict] = None,
     ):
+        # multi-tenant isolation (docs/SERVICE.md "Tenancy"):
+        # per-tenant admission budgets + weighted-fair ordering live
+        # in the AdmissionController; None keeps the zero-config
+        # single-heap behavior byte-identical
         self.admission = AdmissionController(
             device_tracker=device_tracker,
             max_concurrency=max_concurrency,
             max_queue_depth=max_queue_depth,
+            tenant_config=tenant_config,
         )
         # failure policy (blaze_tpu/errors.py taxonomy): TRANSIENT
         # partition failures retry up to max_task_attempts with
@@ -266,6 +272,7 @@ class QueryService:
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
         plan_digest: Optional[str] = None,
+        tenant: str = "default",
     ) -> Query:
         """Wire entry: one serialized TaskDefinition (engine-native or
         reference format), decoded eagerly so admission sees a cost
@@ -287,6 +294,7 @@ class QueryService:
             ),
             estimated_bytes=estimated_bytes,
             use_cache=use_cache,
+            tenant=tenant,
         )
         self._attach_obs(q)
         if self.draining:
@@ -383,6 +391,7 @@ class QueryService:
         deadline_s: Optional[float] = None,
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
+        tenant: str = "default",
     ) -> Query:
         """Driver entry: run every partition of an in-process plan."""
         q = Query(
@@ -397,6 +406,7 @@ class QueryService:
                 else estimate_plan_device_bytes(plan)
             ),
             use_cache=use_cache,
+            tenant=tenant,
         )
         self._attach_obs(q)
         if self.draining:
@@ -420,6 +430,26 @@ class QueryService:
         q.error_class = ErrorClass.TRANSIENT.value
         q.transition(QueryState.REJECTED_OVERLOADED)
         self._register(q)
+        return q
+
+    def _reject_tenant_budget(self, q: Query) -> Query:
+        """Tenant-budget rejection (the DRAINING pattern one tenant
+        over): classified TRANSIENT so a bare client retries with
+        backoff (the tenant's own in-flight work draining frees the
+        budget) and a fronting router treats it as a placement miss
+        (spill to the next replica, zero breaker strikes - the
+        replica is healthy, the TENANT is over budget). The
+        'REJECTED_TENANT_BUDGET:' error prefix is the wire marker
+        both consumers key on. The query is already registered by
+        _enqueue."""
+        q.error = (
+            f"REJECTED_TENANT_BUDGET: tenant {q.tenant!r} is over "
+            "its admission budget; retry with backoff as its own "
+            "work drains"
+        )
+        q.error_class = ErrorClass.TRANSIENT.value
+        q.transition(QueryState.REJECTED_OVERLOADED)
+        REGISTRY.inc("blaze_tenant_rejections_total", tenant=q.tenant)
         return q
 
     def drain(self, timeout_s: Optional[float] = None,
@@ -532,7 +562,20 @@ class QueryService:
                     self.obs_counters["fast_path_serves"] += 1
                 self._fast_pool.submit(self._run_query, q)
             return q
-        if not self.admission.offer(q):
+        if chaos.ACTIVE:
+            # DROP = the tenant budget check itself fails (fail
+            # CLOSED: a broken check must reject, never admit - the
+            # rejection is TRANSIENT and spillable, an admit would
+            # breach the budget); STALL = a slow budget path
+            try:
+                chaos.fire("service.tenant", tenant=q.tenant,
+                           query=q.query_id)
+            except ConnectionError:
+                return self._reject_tenant_budget(q)
+        verdict = self.admission.offer(q)
+        if verdict == "tenant_budget":
+            return self._reject_tenant_budget(q)
+        if verdict != "ok":
             q.error = (
                 f"queue full ({self.admission.max_queue_depth}); "
                 "retry with backoff"
@@ -676,6 +719,13 @@ class QueryService:
                 "buffer_high_water_bytes": self._stream_high_water,
             },
         }
+        tenants = self.admission.tenant_stats()
+        if tenants:
+            # per-tenant admission view (docs/SERVICE.md "Tenancy"):
+            # queued/running/reserved_bytes live gauges + lifetime
+            # submit/admit/reject counts; the router sums these
+            # fleet-wide. Empty (and absent) until a tenant submits.
+            out["tenants"] = tenants
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         # zero-copy serve path (blaze_tpu/zerocopy): decoded-plan
@@ -739,6 +789,11 @@ class QueryService:
         t = q.timings
         wall = t.get("finished", time.monotonic()) - t["submitted"]
         REGISTRY.inc("blaze_queries_total", state=q.state.value)
+        # per-tenant lifecycle counter: a NEW series (not a label on
+        # blaze_queries_total) so zero-config dashboards keep their
+        # exact pre-tenancy series shape
+        REGISTRY.inc("blaze_tenant_queries_total",
+                     tenant=q.tenant, state=q.state.value)
         REGISTRY.observe("blaze_query_wall_seconds", wall)
         retried = any(a.get("action") == "retry" for a in q.attempts)
         slow = 0 < self.slow_query_s < wall
@@ -816,6 +871,12 @@ class QueryService:
                    {"event": k, **sid}, a.get(k, 0), "counter")
         for k in ("queued", "running", "reserved_bytes", "headroom"):
             yield (f"blaze_admission_{k}", sid, a.get(k, 0), "gauge")
+        for t, ts in self.admission.tenant_stats().items():
+            tl = {"tenant": t, **sid}
+            for k in ("queued", "running", "reserved_bytes"):
+                yield (f"blaze_tenant_{k}", tl, ts.get(k, 0), "gauge")
+            yield ("blaze_tenant_rejections",
+                   tl, ts.get("rejected_budget", 0), "counter")
         if self.cache is not None:
             c = self.cache.stats()
             for k in ("hits", "misses", "evictions", "puts", "spills",

@@ -164,6 +164,19 @@ def _is_draining_rejection(resp: dict) -> bool:
     )
 
 
+def _is_tenant_budget_rejection(resp: dict) -> bool:
+    """True for a tenant-budget refusal (the 'REJECTED_TENANT_BUDGET:'
+    error prefix is the wire marker; service._reject_tenant_budget and
+    the router's rate limiter both emit it). Mirrors the DRAINING
+    contract: TRANSIENT, retried with the same bounded backoff, and a
+    router spills it with zero breaker strikes."""
+    return (
+        resp.get("state") == "REJECTED_OVERLOADED"
+        and str(resp.get("error", ""))
+        .startswith("REJECTED_TENANT_BUDGET")
+    )
+
+
 # ---------------------------------------------------------------------------
 # server side: ONE table-driven verb loop for both tiers
 # ---------------------------------------------------------------------------
@@ -452,6 +465,7 @@ class ServiceVerbBackend:
             # digest it already computed over these exact bytes) so
             # the replica never re-hashes the blob
             plan_digest=meta.get("plan_digest"),
+            tenant=str(meta.get("tenant") or "default"),
         )
         return q.status()
 
@@ -981,11 +995,16 @@ class ServiceClient:
     def __init__(self, host: str, port: int, timeout: float = 120.0,
                  reconnect_attempts: int = 4,
                  reconnect_backoff_s: float = 0.05,
-                 use_arena: bool = False):
+                 use_arena: bool = False,
+                 tenant: str = "default"):
         self._addr = (host, port)
         self._timeout = timeout
         self._reconnect_attempts = int(reconnect_attempts)
         self._reconnect_backoff_s = float(reconnect_backoff_s)
+        # client-level tenant identity: every submit() carries it in
+        # SUBMIT meta unless overridden per call (docs/SERVICE.md
+        # "Tenancy"); "default" = untagged traffic
+        self._tenant = str(tenant or "default")
         # shared-memory FETCH opt-in (zerocopy/arena.py): only a
         # client co-located with the server can map the segment paths
         # a handle names, so the default stays the byte path; a failed
@@ -1047,18 +1066,24 @@ class ServiceClient:
         estimated_bytes: Optional[int] = None,
         use_cache: bool = True,
         detach: bool = False,
+        tenant: Optional[str] = None,
     ) -> dict:
         """`detach=True` opts the query out of the server's
         cancel-on-disconnect session semantics, so the handle survives
         a connection drop and this client's reconnect can re-attach
-        by query_id.
+        by query_id. `tenant` overrides the client-level tenant for
+        this one submit.
 
         A DRAINING rejection (the replica is mid-rolling-restart) is
         retried with the same bounded backoff as a dropped connection
         - the replica, or its restarted replacement behind the same
         address, comes back - and surfaces as a classified TRANSIENT
         `ReplicaDrainingError` only once the budget is spent
-        (`reconnect_attempts=0` restores fail-fast)."""
+        (`reconnect_attempts=0` restores fail-fast). A tenant-budget
+        rejection (REJECTED_TENANT_BUDGET: this tenant is over its
+        admission budget or rate limit) follows the exact same
+        retry-then-classify contract, surfacing as
+        `TenantBudgetError`."""
         import random
 
         meta = {
@@ -1067,6 +1092,7 @@ class ServiceClient:
             "estimated_bytes": estimated_bytes,
             "use_cache": use_cache,
             "detach": detach,
+            "tenant": str(tenant or self._tenant),
         }
         manifest_bytes = (
             json.dumps(manifest).encode("utf-8")
@@ -1077,12 +1103,20 @@ class ServiceClient:
                 task_bytes, meta=meta, is_ref=is_ref,
                 manifest_bytes=manifest_bytes,
             )
-            if not _is_draining_rejection(resp):
+            if not (_is_draining_rejection(resp)
+                    or _is_tenant_budget_rejection(resp)):
                 return resp
             if attempt >= self._reconnect_attempts:
                 break
             delay = self._reconnect_backoff_s * (2 ** attempt)
             time.sleep(random.uniform(delay * 0.5, delay))
+        if _is_tenant_budget_rejection(resp):
+            from blaze_tpu.errors import TenantBudgetError
+
+            raise TenantBudgetError(
+                resp.get("error",
+                         "REJECTED_TENANT_BUDGET: over budget")
+            )
         from blaze_tpu.errors import ReplicaDrainingError
 
         raise ReplicaDrainingError(

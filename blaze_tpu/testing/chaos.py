@@ -75,6 +75,14 @@ Injection sites (each named in docs/ROBUSTNESS.md):
                     the server answer bytes instead of a handle, or
                     the client treat its handle as a stale lease and
                     re-FETCH on the byte path
+  service.tenant    the tenant budget check in QueryService._enqueue
+                    (ctx: tenant, query): DROP = the budget check
+                    itself fails and the submit is rejected
+                    REJECTED_TENANT_BUDGET (fail CLOSED - an
+                    isolation layer that fails open under stress
+                    protects nobody), STALL = a slow budget path
+                    widening the admission window (noisy-neighbor
+                    chaos in tests/test_tenancy.py)
 
 Activation: programmatic `install()`/`active()` (tests), or the
 BLAZE_CHAOS environment variable carrying the plan as JSON - worker

@@ -195,12 +195,27 @@ def chaos_smoke(seed_offset: int = 0) -> bool:
          "tests/test_membership.py", "tests/test_churn.py",
          "tests/test_journal.py", "tests/test_stream.py",
          "tests/test_contention.py", "tests/test_wire_async.py",
-         "tests/test_zerocopy.py",
+         "tests/test_zerocopy.py", "tests/test_tenancy.py",
          "-k", "not e2e"],
         extra_env=(
             {"BLAZE_CHAOS_SEED_OFFSET": str(seed_offset)}
             if seed_offset else None
         ),
+    )
+
+
+def tenancy_smoke() -> bool:
+    """Multi-tenant isolation suite (ISSUE 18): TenantBudgets config
+    merge + weighted-fair (DRR) admission units, the
+    REJECTED_TENANT_BUDGET surfacing contract (TRANSIENT, the
+    DRAINING pattern, TenantBudgetError at the client), the
+    noisy-neighbor pin on both wire planes (victim p50 bounded, zero
+    victim rejections), and the router-tier guards (token-bucket rate
+    limit with zero breaker strikes, budget spill-through, windowed
+    retry budget bounding failover amplification)."""
+    return run(
+        "tenancy suite",
+        ["tests/test_tenancy.py"],
     )
 
 
@@ -510,6 +525,12 @@ def main():
                          "membership, graceful drain, hot-result "
                          "replication, and the rolling-restart "
                          "subprocess e2e")
+    ap.add_argument("--tenancy", action="store_true",
+                    help="multi-tenant isolation suite only: "
+                         "weighted-fair admission, tenant budgets, "
+                         "the noisy-neighbor pin on both wire "
+                         "planes, and the router rate-limit / "
+                         "retry-budget guards")
     args = ap.parse_args()
     rows = 20_000 if args.fast else args.rows
 
@@ -552,6 +573,12 @@ def main():
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1
 
+    if args.tenancy:
+        ok &= tenancy_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (tenancy) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
     if args.chaos:
         for off in range(max(1, args.seeds)):
             ok &= chaos_smoke(seed_offset=off)
@@ -570,6 +597,7 @@ def main():
         ok &= chaos_smoke(seed_offset=1)
         ok &= stream_smoke()
         ok &= zerocopy_smoke()
+        ok &= tenancy_smoke()
         ok &= churn_smoke()
         ok &= obs_smoke()
         ok &= profile_smoke()

@@ -537,3 +537,57 @@ def test_journal_metrics_exposed(dataset, journal_path):
         s = fl.router.stats()["router"]
         assert s["journal"] is True
         assert s["recover_pending"] == 0
+
+
+def test_recovery_readopts_tenant_and_charges_no_budgets(
+    dataset, journal_path
+):
+    """Replay fidelity for tenant identity (ISSUE 18): recovered
+    queries re-adopt the tenant journaled in their S-record meta, and
+    a restarted router rebuilds its rate-limit / retry-budget state
+    COLD - in-flight recoveries are re-adopted work, not new tenant
+    load, and must not consume (or trip) anyone's budget."""
+    blob = dataset()
+    with chaos.active(
+        [Fault("task.execute", klass="STALL", stall_s=3.0, times=1)],
+        seed=13,
+    ):
+        with Fleet(router_kw={"journal_path": journal_path}) as fl:
+            st = fl.router.submit({"tenant": "acme"}, blob)
+            qid = st["query_id"]
+            assert fl.router.get(qid).internal_id is not None
+            # "SIGKILL" + restart with tight tenant guards armed:
+            # recovery must not be metered against them
+            r2 = _restart(fl.specs, journal_path,
+                          tenant_rate=1.0, tenant_burst=1,
+                          tenant_retry_budget=1)
+            try:
+                assert r2._recover_pending == [qid]
+                # the journaled tenant rode the S-record meta back
+                assert r2.get(qid).meta.get("tenant") == "acme"
+                r2._recover_deadline = time.monotonic() + 10
+                assert wait_for(
+                    lambda: r2._recover_tick() == 0, timeout=10
+                )
+                assert wait_done(r2, qid)["state"] == "DONE"
+                rst = r2.stats()["router"]
+                # cold guards: re-adoption charged nothing anywhere
+                assert rst["tenant_rate_limited"] == 0
+                assert rst["tenants"].get("acme", {}).get(
+                    "retry_budget_spent", 0) == 0
+                with r2._tenant_mu:
+                    assert not r2._tenant_retries.get("acme")
+                    assert "acme" not in r2._tenant_buckets
+                # ...but genuinely NEW post-restart load IS metered:
+                # burst 1 admits one submit, the immediate second one
+                # is rate-limited
+                ok = r2.submit({"tenant": "acme"}, blob)
+                assert "query_id" in ok
+                limited = r2.submit({"tenant": "acme"}, blob)
+                assert limited["state"] == "REJECTED_OVERLOADED"
+                assert limited["error"].startswith(
+                    "REJECTED_TENANT_BUDGET"
+                )
+                assert wait_done(r2, ok["query_id"])["state"] == "DONE"
+            finally:
+                r2.close()

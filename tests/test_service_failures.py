@@ -631,3 +631,60 @@ def test_cache_probe_mid_stream_misses_then_hits(parquet_blob):
     t2 = pa.Table.from_batches(again)
     assert t1.equals(t2)
     assert svc.cache.counters["hits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# tenant-budget rejection surfacing (ISSUE 18 satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_budget_rejection_classified_on_wire(parquet_blob):
+    """A budget rejection mirrors the DRAINING contract: TRANSIENT on
+    the wire, retried inside the client's reconnect/backoff budget,
+    then surfaced as a classified TenantBudgetError - never a bare
+    ServiceError, never a breaker-style failure."""
+    from blaze_tpu.errors import (
+        ErrorClass,
+        TenantBudgetError,
+        TransientError,
+        classify,
+    )
+
+    with QueryService(
+        max_concurrency=2,
+        tenant_config={"capped": {"max_queued": 0}},
+    ) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            with ServiceClient(*srv.address, tenant="capped",
+                               reconnect_attempts=1,
+                               reconnect_backoff_s=0.01) as c:
+                with pytest.raises(TenantBudgetError) as ei:
+                    c.submit(parquet_blob)
+    assert issubclass(TenantBudgetError, TransientError)
+    assert classify(ei.value) is ErrorClass.TRANSIENT
+    assert "REJECTED_TENANT_BUDGET" in str(ei.value)
+    # the raw rejection stayed in the routing table as a terminal
+    # REJECTED_OVERLOADED (the DRAINING shape - spillable upstream)
+    assert svc.admission.counters["rejected_tenant_budget"] > 0
+
+
+def test_tenant_budget_retry_honors_backoff_budget(parquet_blob):
+    """The retry loop is the existing bounded reconnect budget, not a
+    new unbounded spin: the number of raw submits the service sees is
+    reconnect_attempts + 1."""
+    with QueryService(
+        max_concurrency=2,
+        tenant_config={"capped": {"max_queued": 0}},
+    ) as svc:
+        with TaskGatewayServer(service=svc) as srv:
+            from blaze_tpu.errors import TenantBudgetError
+
+            with ServiceClient(*srv.address, tenant="capped",
+                               reconnect_attempts=2,
+                               reconnect_backoff_s=0.01) as c:
+                with pytest.raises(TenantBudgetError):
+                    c.submit(parquet_blob)
+            ts = svc.stats()["tenants"]
+            assert ts["capped"]["submitted"] == 3  # 1 + 2 retries
+            # other tenants' admission was never touched
+            assert set(ts) == {"capped"}

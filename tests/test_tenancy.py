@@ -1,0 +1,559 @@
+"""Multi-tenant isolation and overload control (ISSUE 18).
+
+Coverage map:
+  * unit tier: TenantBudgets config merge ("*" defaults, weights),
+    zero-config single-heap identity, per-tenant max_queued /
+    max_running / max_reserved_bytes caps, deficit-round-robin
+    weighted interleave, fair-mode flip on the second tenant
+  * service tier: REJECTED_TENANT_BUDGET surfacing (TRANSIENT, the
+    DRAINING pattern), tenant identity through SUBMIT meta -> Query ->
+    status/STATS, ServiceClient retry-then-classify into
+    TenantBudgetError, the service.tenant chaos seam failing CLOSED
+  * noisy neighbor (the acceptance pin): tenant A floods a replica at
+    many times its budget on BOTH wire planes - tenant B sees zero
+    rejections, zero failures, and a bounded p50; A's overflow is
+    rejected REJECTED_TENANT_BUDGET
+  * router tier: token-bucket rate limit (pre-journal, zero breaker
+    strikes), budget spill-through when every replica rejects one
+    tenant, and the windowed retry budget bounding failover
+    amplification (counter-verified, original error surfaced)
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.errors import ErrorClass, TenantBudgetError, classify
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.ops import AggMode, FilterExec, HashAggregateExec
+from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+from blaze_tpu.plan.serde import task_to_proto
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService, ServiceClient
+from blaze_tpu.service.admission import AdmissionController, TenantBudgets
+from blaze_tpu.service.query import Query
+from blaze_tpu.testing import chaos
+from blaze_tpu.testing.chaos import Fault
+from tests.test_router import Fleet, wait_done
+from tests.test_service import GatedScan, wait_for
+
+
+def _q(tenant="default", priority=0, est=None):
+    return Query(task_bytes=b"x", tenant=tenant, priority=priority,
+                 estimated_bytes=est)
+
+
+def _drain_order(ac):
+    out = []
+    while True:
+        got = ac.next_admissible()
+        if got is None:
+            return out
+        out.append(got)
+
+
+def _blob(path, threshold=0.5):
+    plan = HashAggregateExec(
+        FilterExec(ParquetScanExec([[FileRange(path)]]),
+                   Col("v") > threshold),
+        keys=[(Col("k"), "k")],
+        aggs=[(AggExpr(AggFn.SUM, Col("v")), "s")],
+        mode=AggMode.COMPLETE,
+    )
+    return task_to_proto(plan, 0)
+
+
+@pytest.fixture
+def parquet(tmp_path):
+    def make(name, rows=2000):
+        rng = np.random.default_rng(11)
+        p = str(tmp_path / name)
+        pq.write_table(
+            pa.table({
+                "k": pa.array(rng.integers(0, 9, rows), pa.int32()),
+                "v": pa.array(rng.random(rows), pa.float64()),
+            }),
+            p,
+        )
+        return p
+
+    return make
+
+
+# ---------------------------------------------------------------------------
+# unit tier: TenantBudgets + weighted-fair admission
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_budgets_star_defaults():
+    b = TenantBudgets({
+        "acme": {"max_queued": 2, "weight": 3.0},
+        "*": {"max_queued": 8, "max_running": 4},
+    })
+    assert b.configured
+    assert b.cap("acme", "max_queued") == 2
+    # "*" fills the keys the tenant entry leaves out, key by key
+    assert b.cap("acme", "max_running") == 4
+    assert b.cap("other", "max_queued") == 8
+    assert b.cap("other", "max_reserved_bytes") is None
+    assert b.weight("acme") == 3.0
+    assert b.weight("other") == 1.0
+    assert not TenantBudgets(None).configured
+
+
+def test_zero_config_ordering_identity():
+    """No tenant_config, untagged traffic: the original single-heap
+    path (fair mode never arms), priority then FIFO."""
+    ac = AdmissionController(max_concurrency=10, max_queue_depth=10)
+    qs = [_q(priority=0), _q(priority=5), _q(priority=0),
+          _q(priority=5)]
+    for q in qs:
+        assert ac.offer(q) == "ok"
+    order = _drain_order(ac)
+    assert [q.query_id for q in order] == [
+        qs[1].query_id, qs[3].query_id,  # priority 5, FIFO
+        qs[0].query_id, qs[2].query_id,  # priority 0, FIFO
+    ]
+    assert ac.stats()["fair"] is False
+
+
+def test_unconfigured_multi_tenant_keeps_priority_classes():
+    """Tagged traffic with NO budgets configured: fair mode arms
+    (weight 1 each) but EDF/priority classes still dominate - DRR
+    only orders within the top class."""
+    ac = AdmissionController(max_concurrency=10, max_queue_depth=10)
+    hi = _q("b", priority=5)
+    lo1, lo2 = _q("a", priority=0), _q("c", priority=0)
+    for q in (lo1, hi, lo2):
+        assert ac.offer(q) == "ok"
+    assert ac.stats()["fair"] is True
+    order = _drain_order(ac)
+    assert order[0] is hi  # priority class beats arrival order
+    assert set(order[1:]) == {lo1, lo2}
+
+
+def test_max_queued_caps_only_that_tenant():
+    ac = AdmissionController(
+        max_concurrency=10, max_queue_depth=100,
+        tenant_config={"noisy": {"max_queued": 2}},
+    )
+    assert ac.offer(_q("noisy")) == "ok"
+    assert ac.offer(_q("noisy")) == "ok"
+    assert ac.offer(_q("noisy")) == "tenant_budget"
+    # the victim is untouched by the noisy tenant's full budget
+    assert ac.offer(_q("victim")) == "ok"
+    assert ac.counters["rejected_tenant_budget"] == 1
+    ts = ac.tenant_stats()
+    assert ts["noisy"]["rejected_budget"] == 1
+    assert ts["victim"]["rejected_budget"] == 0
+
+
+def test_drr_weighted_interleave():
+    """Weight 2 vs 1: the heavy tenant serves 2 per round."""
+    ac = AdmissionController(
+        max_concurrency=100, max_queue_depth=100,
+        tenant_config={"a": {"weight": 2.0}},
+    )
+    for i in range(6):
+        assert ac.offer(_q("a" if i % 2 == 0 else "b")) == "ok"
+    order = [q.tenant for q in _drain_order(ac)]
+    assert order == ["a", "a", "b", "a", "b", "b"]
+
+
+def test_max_running_capped_tenant_invisible():
+    """A tenant at max_running is skipped by selection - its queue
+    position does NOT hold back other tenants - and becomes eligible
+    again when its own work releases."""
+    ac = AdmissionController(
+        max_concurrency=10, max_queue_depth=100,
+        tenant_config={"a": {"max_running": 1}},
+    )
+    a1, a2, b1 = _q("a"), _q("a"), _q("b")
+    for q in (a1, a2, b1):
+        assert ac.offer(q) == "ok"
+    assert ac.next_admissible() is a1
+    # a is capped at 1 running: b is served even though a2 is older
+    assert ac.next_admissible() is b1
+    assert ac.next_admissible() is None
+    assert ac.counters["tenant_budget_waits"] >= 1
+    ac.release(a1)
+    assert ac.next_admissible() is a2
+
+
+def test_max_reserved_bytes_cap_and_release():
+    ac = AdmissionController(
+        max_concurrency=10, max_queue_depth=100,
+        tenant_config={"a": {"max_reserved_bytes": 100}},
+    )
+    a1, a2, b1 = _q("a", est=80), _q("a", est=80), _q("b", est=80)
+    for q in (a1, a2, b1):
+        assert ac.offer(q) == "ok"
+    assert ac.next_admissible() is a1
+    # a2 would take tenant a to 160 reserved > 100: skipped, b runs
+    assert ac.next_admissible() is b1
+    assert ac.next_admissible() is None
+    ac.release(a1)
+    assert ac.next_admissible() is a2
+    ts = ac.tenant_stats()
+    assert ts["a"]["reserved_bytes"] == 80
+
+
+def test_fair_flip_on_second_tenant_preserves_entries():
+    """An unconfigured controller flips to fair ordering when a
+    second distinct tenant appears; nothing queued is lost."""
+    ac = AdmissionController(max_concurrency=100, max_queue_depth=100)
+    qs = [_q("default") for _ in range(3)]
+    for q in qs:
+        assert ac.offer(q) == "ok"
+    assert ac.stats()["fair"] is False
+    other = _q("newcomer")
+    assert ac.offer(other) == "ok"
+    assert ac.stats()["fair"] is True
+    drained = _drain_order(ac)
+    assert set(q.query_id for q in drained) == \
+        set(q.query_id for q in qs) | {other.query_id}
+
+
+# ---------------------------------------------------------------------------
+# service tier
+# ---------------------------------------------------------------------------
+
+
+def test_service_rejects_over_budget_tenant():
+    svc = QueryService(
+        max_concurrency=2,
+        tenant_config={"noisy": {"max_queued": 1, "max_running": 1}},
+    )
+    try:
+        release = threading.Event()
+        running = svc.submit_plan(GatedScan(release), tenant="noisy")
+        assert wait_for(lambda: svc.admission.tenant_stats()
+                        .get("noisy", {}).get("running") == 1)
+        queued = svc.submit_plan(GatedScan(release), tenant="noisy")
+        over = svc.submit_plan(GatedScan(release), tenant="noisy")
+        assert over.state.value == "REJECTED_OVERLOADED"
+        assert over.error.startswith("REJECTED_TENANT_BUDGET")
+        assert over.error_class == ErrorClass.TRANSIENT.value
+        # rejection is classified TRANSIENT end to end
+        assert classify(TenantBudgetError("x")) is ErrorClass.TRANSIENT
+        # the victim tenant is untouched
+        ok = svc.submit_plan(GatedScan(release), tenant="victim")
+        assert ok.state.value not in ("REJECTED_OVERLOADED", "FAILED")
+        st = svc.stats()
+        assert st["tenants"]["noisy"]["rejected_budget"] == 1
+        # the status payload carries the tenant tag (non-default only)
+        assert running.status()["tenant"] == "noisy"
+        release.set()
+        for q in (running, queued, ok):
+            wait_for(lambda: q.state.value in
+                     ("DONE", "FAILED", "CANCELLED"))
+    finally:
+        release.set()
+        svc.close()
+
+
+def test_wire_tenant_threading(parquet):
+    """tenant rides SUBMIT meta through the wire into the Query, the
+    status payload, and per-tenant STATS."""
+    path = parquet("t.parquet")
+    blob = _blob(path)
+    svc = QueryService(max_concurrency=2)
+    try:
+        with TaskGatewayServer(service=svc) as srv:
+            host, port = srv.address
+            with ServiceClient(host, port, tenant="acme") as cl:
+                st = cl.submit(blob)
+                done = cl.poll(st["query_id"])
+                deadline = time.monotonic() + 30
+                while done["state"] not in ("DONE", "FAILED") \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                    done = cl.poll(st["query_id"])
+                assert done["state"] == "DONE"
+                assert done["tenant"] == "acme"
+                # per-submit override beats the client-level tenant
+                # (a distinct plan: a result-cache hit would bypass
+                # admission and never register the tenant there)
+                st2 = cl.submit(_blob(path, threshold=0.3),
+                                tenant="other")
+                assert svc.get(st2["query_id"]).tenant == "other"
+        ts = svc.stats()["tenants"]
+        assert ts["acme"]["submitted"] == 1
+        assert ts["other"]["submitted"] == 1
+    finally:
+        svc.close()
+
+
+def test_client_raises_tenant_budget_error(parquet):
+    """Retry-then-classify: the client retries a budget rejection
+    with backoff (the DRAINING contract) and surfaces a classified
+    TenantBudgetError once the budget is spent."""
+    blob = _blob(parquet("t.parquet"))
+    svc = QueryService(
+        max_concurrency=2,
+        tenant_config={"noisy": {"max_queued": 0}},
+    )
+    try:
+        with TaskGatewayServer(service=svc) as srv:
+            host, port = srv.address
+            with ServiceClient(host, port, tenant="noisy",
+                               reconnect_attempts=1,
+                               reconnect_backoff_s=0.01) as cl:
+                with pytest.raises(TenantBudgetError):
+                    cl.submit(blob)
+    finally:
+        svc.close()
+
+
+def test_chaos_seam_fails_closed():
+    """DROP on service.tenant = the budget check itself failing: the
+    submit is rejected REJECTED_TENANT_BUDGET (fail CLOSED), never
+    admitted unchecked."""
+    svc = QueryService(max_concurrency=2)
+    try:
+        with chaos.active(
+            [Fault("service.tenant", klass="DROP", times=1,
+                   match="acme")]
+        ):
+            q = svc.submit_plan(GatedScan(threading.Event()),
+                                tenant="acme")
+            assert q.state.value == "REJECTED_OVERLOADED"
+            assert q.error.startswith("REJECTED_TENANT_BUDGET")
+        # chaos off: same submit admits normally
+        release = threading.Event()
+        release.set()
+        q2 = svc.submit_plan(GatedScan(release), tenant="acme")
+        assert wait_for(lambda: q2.state.value in ("DONE", "FAILED"))
+        assert q2.state.value == "DONE"
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# noisy neighbor: the acceptance pin, both wire planes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["threaded", "async"])
+def test_noisy_neighbor_single_replica(parquet, wire):
+    """Tenant A floods far past its budget; tenant B sees ZERO
+    rejections, zero failures, and a bounded p50. A's overflow is
+    rejected REJECTED_TENANT_BUDGET - the budget working."""
+    blob = _blob(parquet("t.parquet"))
+    svc = QueryService(
+        max_concurrency=2, enable_cache=False,
+        tenant_config={"flood": {"max_queued": 2, "max_running": 1}},
+    )
+    try:
+        with TaskGatewayServer(service=svc, wire=wire) as srv:
+            host, port = srv.address
+
+            def victim_p50(n=4):
+                ts = []
+                with ServiceClient(host, port,
+                                   tenant="victim") as cl:
+                    for _ in range(n):
+                        t0 = time.perf_counter()
+                        cl.run(blob, use_cache=False)
+                        ts.append(time.perf_counter() - t0)
+                ts.sort()
+                return ts[len(ts) // 2]
+
+            victim_p50(2)  # warm-up: compile
+            solo = victim_p50()
+
+            stop = threading.Event()
+
+            def flooder():
+                with ServiceClient(host, port, tenant="flood",
+                                   reconnect_attempts=1,
+                                   reconnect_backoff_s=0.01) as cl:
+                    while not stop.is_set():
+                        try:
+                            cl.submit(blob, use_cache=False)
+                        except TenantBudgetError:
+                            continue
+                        except Exception:  # noqa: BLE001
+                            time.sleep(0.01)
+
+            floods = [threading.Thread(target=flooder, daemon=True)
+                      for _ in range(4)]
+            for t in floods:
+                t.start()
+            assert wait_for(
+                lambda: svc.admission.counters[
+                    "rejected_tenant_budget"] > 0,
+                timeout=15,
+            ), "flood never hit the budget"
+            try:
+                flooded = victim_p50()
+            finally:
+                stop.set()
+                for t in floods:
+                    t.join(timeout=10)
+        ts = svc.stats()["tenants"]
+        # B: zero rejections, zero failures (victim_p50 would raise)
+        assert ts.get("victim", {}).get("rejected_budget", 0) == 0
+        # A's overflow was rejected at admission
+        assert ts["flood"]["rejected_budget"] > 0
+        # bounded degradation: <= 2x solo, with an absolute floor so
+        # sub-ms medians on a loaded host cannot flake the pin
+        assert flooded <= max(2 * solo, solo + 0.25), (
+            f"victim p50 {flooded:.4f}s vs solo {solo:.4f}s"
+        )
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# router tier
+# ---------------------------------------------------------------------------
+
+
+def test_router_rate_limit_zero_breaker(parquet):
+    """Over-rate submits are rejected BEFORE journaling/placement
+    with the REJECTED_TENANT_BUDGET marker; no breaker strikes, no
+    routing-table growth; other tenants unaffected."""
+    blob = _blob(parquet("t.parquet"))
+    with Fleet(router_kw={
+        "tenant_config": {"flood": {"rate": 2.0, "burst": 2}},
+    }) as f:
+        rejected = 0
+        for _ in range(20):
+            resp = f.router.submit({"tenant": "flood"}, blob)
+            if resp.get("state") == "REJECTED_OVERLOADED":
+                assert resp["error"].startswith(
+                    "REJECTED_TENANT_BUDGET"
+                )
+                assert resp["error_class"] == "TRANSIENT"
+                assert "query_id" not in resp
+                rejected += 1
+            else:
+                wait_done(f.router, resp["query_id"])
+        assert rejected > 0
+        st = f.router.stats()
+        rc = st["router"]
+        assert rc["tenant_rate_limited"] == rejected
+        assert rc["tenants"]["flood"]["rate_limited"] == rejected
+        # zero breaker involvement, zero failovers, fleet healthy
+        assert rc["failovers"] == 0
+        assert rc["no_replica"] == 0
+        assert st["fleet"]["alive"] == 2
+        # an untagged tenant is never rate limited
+        ok = f.router.submit({}, blob)
+        assert "query_id" in ok
+        wait_done(f.router, ok["query_id"])
+        assert rc["tenants"].get("default", {}).get(
+            "rate_limited", 0) == 0
+
+
+def test_router_spills_and_surfaces_tenant_budget(parquet):
+    """Every replica rejecting ONE tenant's budget spills (zero
+    breaker strikes) and surfaces with the REJECTED_TENANT_BUDGET
+    marker so the client classifies TenantBudgetError."""
+    blob = _blob(parquet("t.parquet"))
+    with Fleet(
+        svc_kw={"tenant_config": {"noisy": {"max_queued": 0}}},
+    ) as f:
+        resp = f.router.submit({"tenant": "noisy"}, blob)
+        assert resp["state"] == "REJECTED_OVERLOADED"
+        assert resp["error"].startswith("REJECTED_TENANT_BUDGET")
+        assert resp["error_class"] == "TRANSIENT"
+        st = f.router.stats()["router"]
+        assert st["tenant_budget_spills"] == 2  # both replicas
+        assert st["failovers"] == 0
+        # fleet-level per-tenant aggregation saw the rejections
+        f.router.registry.poll_now()  # refresh replica STATS
+        fleet_t = f.router.stats()["fleet"]["tenants"]
+        assert fleet_t["noisy"]["rejected_budget"] == 2
+        # a healthy tenant still lands
+        ok = f.router.submit({"tenant": "fine"}, blob)
+        assert wait_done(f.router, ok["query_id"])["state"] == "DONE"
+
+
+def test_retry_budget_bounds_failover_amplification(parquet, tmp_path):
+    """A persistently-TRANSIENT plan consumes at most its tenant's
+    windowed retry budget fleet-wide (counter-verified), then
+    surfaces the original classified error; other tenants' traffic
+    and budgets are untouched."""
+    flaky_blob = _blob(parquet("flaky_plan.parquet"))
+    steady_blob = _blob(parquet("steady.parquet"))
+    with Fleet(router_kw={
+        "tenant_config": {"flaky": {"retry_budget": 1}},
+        "tenant_retry_window_s": 300.0,
+        "max_resubmits": 2,
+    }) as f:
+        with chaos.active(
+            [Fault("parquet.decode", klass="TRANSIENT", times=0,
+                   match="flaky_plan")]
+        ):
+            for _ in range(3):
+                resp = f.router.submit({"tenant": "flaky"},
+                                       flaky_blob)
+                st = wait_done(f.router, resp["query_id"])
+                # surfaces the ORIGINAL classified error
+                assert st["state"] == "FAILED"
+                assert st["error_class"] == "TRANSIENT"
+            # the steady tenant rides the same fleet unharmed
+            ok = f.router.submit({"tenant": "steady"}, steady_blob)
+            assert wait_done(
+                f.router, ok["query_id"])["state"] == "DONE"
+        st = f.router.stats()["router"]
+        # fleet-wide retry spend bounded by the budget (1), NOT by
+        # 3 queries x max_resubmits
+        assert st["tenants"]["flaky"]["retry_budget_spent"] == 1
+        assert st["resubmits_transient"] == 1
+        assert st["tenants"]["flaky"]["retry_budget_exhausted"] >= 2
+        assert "steady" not in {
+            t for t, c in st["tenants"].items()
+            if c.get("retry_budget_exhausted")
+        }
+
+
+def test_router_noisy_neighbor(parquet):
+    """Router-fronted acceptance pin: tenant A floods at many times
+    its rate limit; B's queries all succeed with zero rejections and
+    zero failovers; A's overflow is rate-limited with zero breaker
+    strikes."""
+    blob = _blob(parquet("t.parquet"))
+    with Fleet(router_kw={
+        "tenant_config": {"flood": {"rate": 5.0, "burst": 2}},
+    }) as f:
+        stop = threading.Event()
+        flood_stats = {"sent": 0, "rejected": 0, "errors": 0}
+
+        def flooder():
+            while not stop.is_set():
+                try:
+                    resp = f.router.submit({"tenant": "flood"}, blob)
+                    if resp.get("state") == "REJECTED_OVERLOADED":
+                        flood_stats["rejected"] += 1
+                    else:
+                        flood_stats["sent"] += 1
+                except Exception:  # noqa: BLE001
+                    flood_stats["errors"] += 1
+                time.sleep(0.005)  # ~200/s offered vs rate 5
+
+        t = threading.Thread(target=flooder, daemon=True)
+        t.start()
+        try:
+            for _ in range(5):
+                resp = f.router.submit({"tenant": "victim"}, blob)
+                assert "query_id" in resp, resp
+                st = wait_done(f.router, resp["query_id"])
+                assert st["state"] == "DONE", st
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert flood_stats["rejected"] > 0
+        assert flood_stats["errors"] == 0
+        st = f.router.stats()
+        rc = st["router"]
+        assert rc["tenants"].get("victim", {}).get(
+            "rate_limited", 0) == 0
+        assert rc["failovers"] == 0
+        assert st["fleet"]["alive"] == 2  # zero breaker strikes
