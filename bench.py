@@ -606,7 +606,45 @@ def mesh_child(n_dev: int, n_rows: int) -> int:
     assert np.array_equal(got["k"], want["k"]), "mesh bench keys drift"
     assert np.array_equal(got["s"], want["s"]), "mesh bench sums drift"
     assert np.array_equal(got["n"], want["n"]), "mesh bench counts drift"
-    med, spread, k_iters, _ = timed(run_once)
+    # sub-phase attribution rollup (ISSUE 19 satellite): the timed
+    # window runs against a private meshprof rollup, so each
+    # mesh_groupby_d{n} measurement carries WHERE its wall went
+    # (stage_in / trace / launch / sync / gather p50s) alongside the
+    # wall itself, with a reconcile smoke check that the named
+    # sub-phases actually cover the stage
+    from blaze_tpu.obs import meshprof
+
+    with meshprof.capture() as rol:
+        med, spread, k_iters, _ = timed(run_once)
+    attr = None
+    if mesh_lowered:
+        snap = next(iter(rol.snapshot().values()), None)
+        if snap:
+            subs = snap.get("subphases") or {}
+            wall_p50 = (snap.get("stage_wall") or {}).get("p50", 0.0)
+            sub_sum = sum(
+                subs.get(n, {}).get("p50", 0.0)
+                for n in meshprof.STAGE_SUBPHASES
+            )
+            attr = {
+                "subphase_p50_s": {
+                    n: subs[n]["p50"] for n in meshprof.SUBPHASES
+                    if n in subs
+                },
+                "wall_p50": round(wall_p50, 6),
+                "subphase_sum": round(sub_sum, 6),
+                "coverage": round(sub_sum / wall_p50, 4)
+                if wall_p50 > 0 else 0.0,
+                "bytes_staged": snap.get("bytes_staged", 0),
+            }
+            # the rollup is pure host control flow; if the named
+            # sub-phases stop covering the stage wall, a new
+            # unattributed segment crept into the dispatch path
+            cov = attr["coverage"]
+            assert 0.6 <= cov <= 1.15, (
+                f"mesh sub-phases no longer reconcile to the stage "
+                f"wall: coverage {cov} (want 0.6..1.15)"
+            )
     print(json.dumps({
         "median": round(med, 4),
         "spread": round(spread, 3),
@@ -615,6 +653,7 @@ def mesh_child(n_dev: int, n_rows: int) -> int:
         "rows": per * n_parts,
         "groups": int(len(got)),
         "mesh_lowered": mesh_lowered,
+        **({"attr": attr} if attr else {}),
     }), flush=True)
     return 0
 
@@ -2267,6 +2306,22 @@ def smoke():
                 problems.append(
                     f"{name}: fused dispatch budget blown: {dc} "
                     "(want 1 warm dispatch)"
+                )
+        # mesh attribution rollup (ISSUE 19): a lowered mesh shape
+        # must carry its sub-phase split, and the named sub-phases
+        # must reconcile to the stage wall (the child asserts the
+        # tight band; this guards the field going missing entirely)
+        mq = (result.get("queries") or {}).get("mesh_groupby_d8") or {}
+        if mq and "error" not in mq and mq.get("mesh_lowered"):
+            mattr = mq.get("attr") or {}
+            if not mattr.get("subphase_p50_s"):
+                problems.append(
+                    "mesh_groupby_d8: lowered but no attr rollup"
+                )
+            elif not 0.6 <= float(mattr.get("coverage", 0.0)) <= 1.15:
+                problems.append(
+                    f"mesh_groupby_d8: sub-phase coverage "
+                    f"{mattr.get('coverage')} outside 0.6..1.15"
                 )
         stq = (result.get("queries") or {}).get(
             "stream_first_byte_8m") or {}

@@ -389,6 +389,105 @@ def cmd_mesh_dryrun(args) -> int:
     return emit()
 
 
+def cmd_mesh_attr(args) -> int:
+    """Mesh stage anatomy driver (ISSUE 19 / ROADMAP item 2): run the
+    `mesh_groupby` shape at 1 device and at --devices in FRESH
+    subprocesses (the virtual device count freezes at first backend
+    init), collect each side's per-sub-phase rollup via
+    obs/meshprof.run_attr_probe, and emit the versioned
+    MESHATTR_r*.json artifact: per-sub-phase p50s that reconcile to
+    the measured stage wall, the (dN - d1) gap attribution, and the
+    written verdict (staging vs trace vs lock vs launch). `--child`
+    is the in-subprocess half: probe at the CURRENT device count and
+    print one JSON line."""
+    import os
+    import subprocess
+
+    from blaze_tpu.obs import meshprof
+
+    if args.child:
+        doc = meshprof.run_attr_probe(
+            args.devices, rows=args.rows, iters=args.iters
+        )
+        print(json.dumps(doc))
+        return 0
+
+    n = args.devices
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    skip = None
+    try:
+        from jax import shard_map  # noqa: F401
+    except ImportError:
+        try:
+            from jax.experimental.shard_map import (  # noqa: F401
+                shard_map,
+            )
+        except ImportError:
+            skip = "jax lacks shard_map; mesh tier skipped"
+
+    def emit(doc) -> int:
+        text = json.dumps(doc, indent=2)
+        out = args.out
+        if out is None:
+            out = meshprof.next_round_path(os.getcwd())
+        if out != "-":
+            with open(out, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {out}", file=sys.stderr)
+        else:
+            print(text)
+        return 0 if (doc.get("ok", True) or doc.get("skipped")) else 1
+
+    if skip is not None:
+        return emit({"format": "blaze-meshattr-v1", "ok": False,
+                     "skipped": True, "tail": skip})
+
+    def child(n_dev: int) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+        env["PYTHONPATH"] = (
+            root + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        p = subprocess.run(
+            [sys.executable, "-m", "blaze_tpu", "mesh-attr",
+             "--child", "--devices", str(n_dev),
+             "--rows", str(args.rows), "--iters", str(args.iters)],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        if p.returncode != 0:
+            tail = ((p.stdout or "") + (p.stderr or ""))
+            raise RuntimeError(
+                f"mesh-attr child (d{n_dev}) rc={p.returncode}: "
+                + "\n".join(tail.splitlines()[-10:])
+            )
+        for line in reversed((p.stdout or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"mesh-attr child (d{n_dev}) produced no JSON line"
+        )
+
+    try:
+        d1 = child(1)
+        dn = child(n)
+    except (RuntimeError, subprocess.TimeoutExpired) as e:
+        return emit({"format": "blaze-meshattr-v1", "ok": False,
+                     "skipped": False, "tail": str(e)})
+    doc = meshprof.build_doc(d1, dn)
+    doc["ok"] = bool(dn.get("mesh_lowered"))
+    if doc.get("verdict"):
+        print(f"verdict: {doc['verdict']}", file=sys.stderr)
+    return emit(doc)
+
+
 def cmd_profile(args) -> int:
     """Contention profiler: drive the serving workload at each
     --concurrency level with lock-wait accounting + the stack sampler
@@ -910,6 +1009,23 @@ def main(argv=None) -> int:
                          "('-'/default = stdout)")
     md.add_argument("--timeout", type=float, default=600.0,
                     help="dryrun subprocess wall-clock bound seconds")
+    ma = sub.add_parser("mesh-attr")
+    ma.add_argument("--devices", type=int, default=8,
+                    help="virtual device count for the dN side of "
+                         "the attribution (d1 always runs too)")
+    ma.add_argument("--rows", type=int, default=1 << 20,
+                    help="input rows for the mesh_groupby shape")
+    ma.add_argument("--iters", type=int, default=4,
+                    help="warm measurement rounds per device count")
+    ma.add_argument("-o", "--out", default=None,
+                    help="output path for MESHATTR JSON (default: "
+                         "next MESHATTR_rNN.json in cwd; '-' = "
+                         "stdout)")
+    ma.add_argument("--timeout", type=float, default=600.0,
+                    help="per-child subprocess wall-clock bound "
+                         "seconds")
+    ma.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
     pf = sub.add_parser("profile")
     pf.add_argument("--concurrency", default="1,4,16",
                     help="comma list of client concurrency levels "
@@ -978,6 +1094,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "route": cmd_route,
         "mesh-dryrun": cmd_mesh_dryrun,
+        "mesh-attr": cmd_mesh_attr,
         "profile": cmd_profile,
         "regress": cmd_regress,
     }[args.cmd](args)

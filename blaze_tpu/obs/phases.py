@@ -55,6 +55,14 @@ PHASES = (
     "dispatch",     # compiled-kernel launches
     "join",         # fused join-probe kernel launches
     "group",        # fused grouped-aggregate kernel launches
+    # mesh stage anatomy (obs/meshprof.py): the sub-phases of one
+    # mesh_execute stage, folded from its child spans when tracing
+    "mesh_lower",     # planner pass (lower_plan_to_mesh)
+    "mesh_trace",     # jit/shard_map trace + XLA compile
+    "mesh_stage_in",  # stack_partitions host stack + device_put
+    "mesh_launch",    # the compiled mesh program call
+    "mesh_sync",      # block_until_ready on the outputs
+    "mesh_gather",    # batched device_get at the mesh boundary
     "execute",      # RUNNING -> terminal (the whole execution)
     "stream",       # FETCH result streaming
     "router",       # router overhead (placement + submit hops)
@@ -77,6 +85,15 @@ SPAN_PHASE = {
     "result_stream": "stream",
     "router_place": "router",
     "router_stream": None,  # passthrough time is downstream-bound
+    # mesh sub-phase spans fold under their own names (identity map):
+    # the terminal hook's phase_totals sweep carries them into the
+    # rollup whenever a traced query ran a mesh stage
+    "mesh_lower": "mesh_lower",
+    "mesh_trace": "mesh_trace",
+    "mesh_stage_in": "mesh_stage_in",
+    "mesh_launch": "mesh_launch",
+    "mesh_sync": "mesh_sync",
+    "mesh_gather": "mesh_gather",
 }
 
 ALL_CLASS = "_all"
@@ -309,6 +326,19 @@ PHASE_BANDS: Dict[str, tuple] = {
     # hit, so cross-round p50s swing with the cache hit mix, not with
     # decoder speed
     "plan_decode": (4.0, 0.02),
+    # mesh sub-phases: mesh_trace is all-or-nothing (a warm stage pays
+    # ~0, a cold one pays XLA compile - the p50 swings with warm/cold
+    # mix, not with code speed), mesh_lower/sync/gather are sub-
+    # millisecond host calls with scheduler-load wobble, and stage_in/
+    # launch wobble with virtual-device contention on the CPU test
+    # tier. All get the generous integer-factor band; a real
+    # regression here is a multiple, caught by the MESHATTR diff.
+    "mesh_lower": (3.0, 0.25),
+    "mesh_trace": (3.0, 0.25),
+    "mesh_stage_in": (3.0, 0.25),
+    "mesh_launch": (3.0, 0.25),
+    "mesh_sync": (3.0, 0.25),
+    "mesh_gather": (3.0, 0.25),
 }
 
 
@@ -504,10 +534,17 @@ def load_baseline(path: str) -> Dict[str, Any]:
 def phases_from_bench(path: str) -> Optional[Dict[str, Any]]:
     """Extract the per-phase rollup a BENCH_r*.json artifact recorded
     (bench.py's `phases` shape). Handles both the driver wrapper
-    ({n, cmd, rc, tail}) and a bare battery result. None when the
-    round predates phase recording."""
+    ({n, cmd, rc, tail}) and a bare battery result, plus the
+    MESHATTR_r*.json mesh-attribution artifacts (obs/meshprof.py),
+    which carry their per-sub-phase p50s in the same snapshot shape
+    so `regress --bench` diffs consecutive rounds of either family.
+    None when the round predates phase recording."""
     with open(path) as f:
         doc = json.load(f)
+    if isinstance(doc, dict) and str(
+        doc.get("format", "")
+    ).startswith("blaze-meshattr"):
+        return (doc.get("phases") or {}).get("snapshot") or None
     if isinstance(doc, dict) and "tail" in doc and "queries" not in doc:
         tail = doc["tail"]
         result = None

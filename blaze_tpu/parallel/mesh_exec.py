@@ -35,12 +35,20 @@ Observability: every mesh run lands a `mesh_execute` span with one
 `mesh.exchange.*` metric family in the query metric tree; the program
 launch is counted as a dispatch (`mesh_dispatches` alongside
 `dispatches`), so the dispatch-count perf model covers mesh plans too.
+Stage anatomy (obs/meshprof.py): every stage additionally splits into
+named sub-phases - mesh_trace (AOT lower+compile, pulled AHEAD of the
+launch so trace cost is its own phase), mesh_stage_in, mesh_launch,
+mesh_sync, mesh_gather - child spans under `mesh_execute` plus an
+always-on rollup; the single-flight locks are named `TimedLock`s so
+wait:hold lands in the contention report. The chaos seam fires at the
+top of mesh_launch: after the program exists, modeling exchange-fabric
+faults rather than compile faults (an injected STALL lands in
+mesh_launch, not mesh_trace).
 """
 
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -58,6 +66,8 @@ except ImportError:  # older jax exposes it under experimental
 from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.errors import ErrorClass, classify
 from blaze_tpu.exprs import ir
+from blaze_tpu.obs import contention as obs_contention
+from blaze_tpu.obs import meshprof
 from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.metrics import REGISTRY
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
@@ -194,23 +204,38 @@ def record_exchange(ctx: ExecContext, kind: str, rows: int,
 
 def record_mesh_run(ctx: ExecContext, op_name: str, n_dev: int,
                     t0: float, t1: float,
-                    per_device: Sequence[dict]) -> None:
+                    per_device: Sequence[dict],
+                    stage: Optional["meshprof.MeshStage"] = None
+                    ) -> None:
     """Fold one mesh program execution into the metric tree and (when
     tracing) land a `mesh_execute` span with one `mesh_device` child
-    per device - the per-device view of a single SPMD program."""
+    per device - the per-device view of a single SPMD program. With a
+    finished meshprof stage, the `mesh_execute` span widens to the full
+    stage wall and the named sub-phases land as child spans on their
+    own synthetic track (sequential, so the per-track nesting sweep
+    stays chrome-clean; the mesh_lower phase may predate the stage -
+    the recorder's root-widening invariant absorbs it)."""
     ctx.metrics.add("mesh.runs", 1)
     ctx.metrics.add("mesh.devices", n_dev)
     REGISTRY.inc("blaze_mesh_runs_total", op=op_name)
     if not (obs_trace.ACTIVE and ctx.tracer is not None):
         return
     rec = ctx.tracer
+    span_t0 = stage.t0 if stage is not None else t0
+    span_t1 = stage.t1 if stage is not None and stage.t1 else t1
     parent = rec.record_span(
-        "mesh_execute", t0, t1,
+        "mesh_execute", span_t0, span_t1,
         parent=obs_trace.current_span(), tid=_MESH_TID,
         op=op_name, devices=n_dev,
     )
     if parent is None:  # span cap
         return
+    if stage is not None:
+        for name, p0, p1 in stage.phases:
+            rec.record_span(
+                name, p0, p1, parent=parent,
+                tid=meshprof.MESH_SUB_TID, op=op_name,
+            )
     for d, tags in enumerate(per_device):
         rec.record_span(
             "mesh_device", t0, t1, parent=parent,
@@ -297,8 +322,13 @@ class MeshPipelineExec(PhysicalOp):
                     f"mesh pipeline cannot shard {type(node).__name__}"
                 )
         self._fn = None
+        self._exec = None  # AOT-compiled executable (mesh_trace phase)
+        self._exec_sig = None
+        self._traced_sigs = set()
         self._result = None
-        self._lock = threading.Lock()
+        # single-flight, named so wait:hold lands in the contention
+        # report (obs/contention) when the collector is armed
+        self._lock = obs_contention.TimedLock("mesh_pipeline")
 
     @property
     def schema(self):
@@ -311,6 +341,18 @@ class MeshPipelineExec(PhysicalOp):
     def describe(self) -> str:
         return (f"MeshPipelineExec[{len(self._stages)} stages, "
                 f"{self.partition_count} devices]")
+
+    def _trace_key(self, sig) -> tuple:
+        """Logical program identity for re-trace accounting: op kind +
+        structural stage expressions + argument signature (repr of the
+        IR dataclasses prints structurally)."""
+        return (
+            "mesh.pipeline",
+            tuple(
+                (kind, repr(payload)) for kind, payload, _ in self._stages
+            ),
+            sig,
+        )
 
     # -- program ---------------------------------------------------------
     def _compile(self, ncols: int):
@@ -361,18 +403,48 @@ class MeshPipelineExec(PhysicalOp):
             if self._result is not None:
                 return self._result
             n_dev = self.partition_count
-            stacked, num_rows, cap, total, _ = stack_partitions(
-                self.children[0], ctx, self.mesh, self._axis
+            st = meshprof.stage(
+                "mesh.pipeline", n_dev,
+                lower_window=getattr(self, "_mesh_lower", None),
             )
-            mesh_chaos("mesh.pipeline", n_dev, ctx)
-            if self._fn is None:
-                self._fn = self._compile(len(stacked))
+            with st.phase("mesh_stage_in"):
+                stacked, num_rows, cap, total, host_cols = (
+                    stack_partitions(
+                        self.children[0], ctx, self.mesh, self._axis
+                    )
+                )
+                st.add_bytes(sum(h.nbytes for h in host_cols))
+            with st.phase("mesh_trace"):
+                if self._fn is None:
+                    self._fn = self._compile(len(stacked))
+                sig = meshprof.arg_signature(num_rows, *stacked)
+                if sig not in self._traced_sigs:
+                    self._traced_sigs.add(sig)
+                    try:
+                        self._exec = self._fn.lower(
+                            num_rows, *stacked
+                        ).compile()
+                        self._exec_sig = sig
+                    except Exception:  # noqa: BLE001 - no AOT: trace
+                        self._exec = None  # folds into mesh_launch
+                        self._exec_sig = None
+                    meshprof.note_trace(
+                        "mesh.pipeline", self._trace_key(sig)
+                    )
             t0 = time.monotonic()
-            dispatch.record("dispatches")
-            dispatch.record("mesh_dispatches")
-            outs = self._fn(num_rows, *stacked)
-            outs = dispatch.device_get(jax.block_until_ready(outs))
-            t1 = time.monotonic()
+            with st.phase("mesh_launch"):
+                mesh_chaos("mesh.pipeline", n_dev, ctx)
+                dispatch.record("dispatches")
+                dispatch.record("mesh_dispatches")
+                if self._exec is not None and self._exec_sig == sig:
+                    outs = self._exec(num_rows, *stacked)
+                else:
+                    outs = self._fn(num_rows, *stacked)
+            with st.phase("mesh_sync"):
+                outs = jax.block_until_ready(outs)
+            with st.phase("mesh_gather"):
+                outs = dispatch.device_get(outs)
+            t1 = st.finish()
             out_cols, live = outs[:-1], np.asarray(outs[-1])
             nr_host = np.asarray(num_rows)
             record_mesh_run(
@@ -380,6 +452,7 @@ class MeshPipelineExec(PhysicalOp):
                 [{"rows_in": int(nr_host[d]),
                   "rows_out": int(live[d].sum())}
                  for d in range(n_dev)],
+                stage=st,
             )
             ctx.metrics.add("mesh.pipeline_rows", total)
             self._result = (out_cols, live)
@@ -455,7 +528,8 @@ class MeshBroadcastJoinExec(PhysicalOp):
         )
         self._join = None
         self._result = None
-        self._lock = threading.Lock()
+        # single-flight, named for the contention report
+        self._lock = obs_contention.TimedLock("mesh_bcast_join")
 
     @property
     def schema(self):
@@ -521,38 +595,63 @@ class MeshBroadcastJoinExec(PhysicalOp):
 
             build, probe = self.children
             n_dev = self.partition_count
-            b_cols, b_rows, n_build = self._shard_build(ctx)
-            p_cols, p_rows, p_cap, p_total, p_host = stack_partitions(
-                probe, ctx, self.mesh, self._axis
+            st = meshprof.stage(
+                "mesh.broadcast_join", n_dev,
+                lower_window=getattr(self, "_mesh_lower", None),
             )
-            mesh_chaos("mesh.broadcast_join", n_dev, ctx)
-            if self._join is None:
-                self._join = DistributedBroadcastJoin(
-                    self.mesh, probe.schema, build.schema,
-                    probe_key=ir.BoundCol(
-                        self.probe_key,
-                        probe.schema.fields[self.probe_key].dtype,
-                    ),
-                    build_key=ir.BoundCol(
-                        self.build_key,
-                        build.schema.fields[self.build_key].dtype,
-                    ),
-                    axis=self._axis,
+            with st.phase("mesh_stage_in"):
+                b_cols, b_rows, n_build = self._shard_build(ctx)
+                p_cols, p_rows, p_cap, p_total, p_host = (
+                    stack_partitions(
+                        probe, ctx, self.mesh, self._axis
+                    )
                 )
+                # probe stacks dominate staging; the build side is the
+                # small (dimension-table) relation
+                st.add_bytes(sum(h.nbytes for h in p_host))
+            with st.phase("mesh_trace"):
+                if self._join is None:
+                    self._join = DistributedBroadcastJoin(
+                        self.mesh, probe.schema, build.schema,
+                        probe_key=ir.BoundCol(
+                            self.probe_key,
+                            probe.schema.fields[self.probe_key].dtype,
+                        ),
+                        build_key=ir.BoundCol(
+                            self.build_key,
+                            build.schema.fields[self.build_key].dtype,
+                        ),
+                        axis=self._axis,
+                    )
+                if self._join.prepare(p_cols, p_rows, b_cols, b_rows):
+                    meshprof.note_trace(
+                        "mesh.broadcast_join",
+                        ("mesh.broadcast_join",
+                         repr(self._join.probe_key),
+                         repr(self._join.build_key),
+                         meshprof.arg_signature(
+                             p_cols, p_rows, b_cols, b_rows
+                         )),
+                    )
             t0 = time.monotonic()
-            dispatch.record("dispatches")
-            dispatch.record("mesh_dispatches")
-            hit, build_out = self._join(
-                p_cols, p_rows, b_cols, b_rows
-            )
+            with st.phase("mesh_launch"):
+                mesh_chaos("mesh.broadcast_join", n_dev, ctx)
+                dispatch.record("dispatches")
+                dispatch.record("mesh_dispatches")
+                hit, build_out = self._join(
+                    p_cols, p_rows, b_cols, b_rows
+                )
+            with st.phase("mesh_sync"):
+                hit, build_out = jax.block_until_ready(
+                    (hit, build_out)
+                )
             # ONE batched fetch of the small outputs (hit mask +
             # gathered build values); the probe columns come back from
             # stack_partitions' host-side stacks - staging them in is
             # the only boundary crossing they pay
-            hit, build_out = dispatch.device_get(
-                jax.block_until_ready((hit, build_out))
-            )
-            t1 = time.monotonic()
+            with st.phase("mesh_gather"):
+                hit, build_out = dispatch.device_get((hit, build_out))
+            t1 = st.finish()
             hit = np.asarray(hit)
             nbytes = sum(
                 int(np.asarray(c).nbytes) for c in build_out
@@ -564,6 +663,7 @@ class MeshBroadcastJoinExec(PhysicalOp):
                 [{"rows_in": int(nr_host[d]),
                   "matches": int(hit[d].sum())}
                  for d in range(n_dev)],
+                stage=st,
             )
             ctx.metrics.add(
                 "mesh_join_matches", int(hit.sum())

@@ -11,7 +11,6 @@ fallback for string keys / more partitions than devices / multi-host.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Iterator, List, Sequence, Tuple
 
@@ -24,6 +23,8 @@ from blaze_tpu.batch import Column, ColumnBatch
 from blaze_tpu.exprs import ir
 from blaze_tpu.exprs.ir import AggExpr, AggFn
 from blaze_tpu.exprs.typing import infer_dtype
+from blaze_tpu.obs import contention as obs_contention
+from blaze_tpu.obs import meshprof
 from blaze_tpu.ops.base import ExecContext, PhysicalOp
 from blaze_tpu.parallel.mesh import get_mesh
 from blaze_tpu.parallel.mesh_exec import (
@@ -98,8 +99,9 @@ class MeshGroupByExec(PhysicalOp):
         )
         self._result = None
         # single-flight: concurrent partition pulls (the parallel
-        # scheduler) must compile/launch the mesh program once
-        self._lock = threading.Lock()
+        # scheduler) must compile/launch the mesh program once; named
+        # so wait:hold lands in the contention report when armed
+        self._lock = obs_contention.TimedLock("mesh_groupby")
 
     @property
     def schema(self) -> Schema:
@@ -109,41 +111,79 @@ class MeshGroupByExec(PhysicalOp):
     def partition_count(self) -> int:
         return int(self.mesh.shape["data"])
 
+    def _trace_key(self, sig) -> tuple:
+        """Logical program identity for re-trace accounting: op kind +
+        structural key/agg/filter expressions + argument signature
+        (the bound IR dataclasses repr structurally)."""
+        return (
+            "mesh.groupby",
+            tuple(repr(k) for k in self._gb.keys),
+            tuple((a.fn, repr(a.expr)) for a in self._gb.aggs),
+            repr(self._gb.filter_pred),
+            sig,
+        )
+
     def _run(self, ctx: ExecContext):
         with self._lock:
             if self._result is not None:
                 return self._result
             child = self.children[0]
             n_dev = self.partition_count
+            st = meshprof.stage(
+                "mesh.groupby", n_dev,
+                lower_window=getattr(self, "_mesh_lower", None),
+            )
             # HBM-resident staging: partitions land sharded over the
             # mesh and stay device-side through the whole program -
             # host spill happens only at the mesh boundary (the
             # grouped-result fetch below)
-            stacked, num_rows, cap, total, _ = stack_partitions(
-                child, ctx, self.mesh
-            )
+            with st.phase("mesh_stage_in"):
+                stacked, num_rows, cap, total, host_cols = (
+                    stack_partitions(child, ctx, self.mesh)
+                )
+                st.add_bytes(sum(h.nbytes for h in host_cols))
             multi = jax.process_count() > 1
-            mesh_chaos("mesh.groupby", n_dev, ctx)
+            with st.phase("mesh_trace"):
+                if self._gb.prepare(stacked, num_rows):
+                    meshprof.note_trace(
+                        "mesh.groupby",
+                        self._trace_key(meshprof.arg_signature(
+                            *stacked, num_rows
+                        )),
+                    )
             t0 = time.monotonic()
-            dispatch.record("dispatches")
-            dispatch.record("mesh_dispatches")
-            key_out, agg_out, counts = self._gb(stacked, num_rows)
+            with st.phase("mesh_launch"):
+                mesh_chaos("mesh.groupby", n_dev, ctx)
+                dispatch.record("dispatches")
+                dispatch.record("mesh_dispatches")
+                key_out, agg_out, counts = self._gb(stacked, num_rows)
             if multi:
                 # every rank needs every device's output slice
                 # (execute() may be asked for any partition):
-                # allgather the small grouped results
+                # allgather the small grouped results - the whole
+                # collect lands in mesh_gather (no separate sync)
                 from blaze_tpu.parallel.mesh import allgather_rows
 
-                key_out = [allgather_rows(k, n_dev) for k in key_out]
-                agg_out = [allgather_rows(a, n_dev) for a in agg_out]
-                counts = allgather_rows(counts, n_dev, trailing=False)
+                with st.phase("mesh_gather"):
+                    key_out = [
+                        allgather_rows(k, n_dev) for k in key_out
+                    ]
+                    agg_out = [
+                        allgather_rows(a, n_dev) for a in agg_out
+                    ]
+                    counts = allgather_rows(
+                        counts, n_dev, trailing=False
+                    )
             else:
-                key_out, agg_out, counts = dispatch.device_get(
-                    jax.block_until_ready(
+                with st.phase("mesh_sync"):
+                    key_out, agg_out, counts = jax.block_until_ready(
                         (key_out, agg_out, counts)
                     )
-                )
-            t1 = time.monotonic()
+                with st.phase("mesh_gather"):
+                    key_out, agg_out, counts = dispatch.device_get(
+                        (key_out, agg_out, counts)
+                    )
+            t1 = st.finish()
             counts = np.asarray(counts)
             # the partial-state repartition inside the program is the
             # exchange: every live input row's partial group crosses
@@ -160,6 +200,7 @@ class MeshGroupByExec(PhysicalOp):
                 [{"rows_in": int(nr_host[d]),
                   "groups_out": int(counts[d])}
                  for d in range(n_dev)],
+                stage=st,
             )
             self._result = (
                 [np.asarray(k) for k in key_out],

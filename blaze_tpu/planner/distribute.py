@@ -279,7 +279,23 @@ def lower_plan_to_mesh(op: PhysicalOp, mode: Optional[str] = None,
     Window parents n_dev partitions where the plan promised fewer,
     silently turning global semantics per-partition. Every lowered op
     carries the ORIGINAL node as its runtime fallback (tryConvert
-    semantics, both halves)."""
+    semantics, both halves).
+
+    A lowered op is stamped with its `_mesh_lower = (t0, t1)` planner
+    window (monotonic seconds) so the execution stage replays the
+    planner pass as the `mesh_lower` sub-phase of the stage anatomy
+    (obs/meshprof.py)."""
+    import time as _time
+
+    _lower_t0 = _time.monotonic()
+    new = _lower_plan_to_mesh(op, mode, mesh, ctx)
+    if new is not op:
+        new._mesh_lower = (_lower_t0, _time.monotonic())
+    return new
+
+
+def _lower_plan_to_mesh(op: PhysicalOp, mode: Optional[str],
+                        mesh, ctx) -> PhysicalOp:
     mode = mode if mode is not None else resolve_mesh_mode(ctx)
     if mode == "off":
         return op

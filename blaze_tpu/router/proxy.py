@@ -40,6 +40,7 @@ from typing import Dict, Iterator, List, Optional
 
 from blaze_tpu.errors import ReplicaUnavailableError
 from blaze_tpu.obs import contention as obs_contention
+from blaze_tpu.obs import meshprof as obs_meshprof
 from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import trace as obs_trace
 from blaze_tpu.obs.metrics import REGISTRY, merge_expositions
@@ -1891,6 +1892,11 @@ class Router:
             # lock-wait accounting (obs/contention.py): empty dict
             # when the gate is off or nothing contended yet
             "contention": obs_contention.snapshot(),
+            # mesh stage anatomy (obs/meshprof.py): empty on a pure
+            # router unless an embedded replica ran a mesh stage in
+            # this process - served here so both tiers expose the
+            # same observability sections
+            "meshprof": obs_meshprof.snapshot(),
         }
 
     def metrics(self) -> str:

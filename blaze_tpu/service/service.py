@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 from blaze_tpu.errors import ErrorClass, classify, retry_action
 from blaze_tpu.obs import contention as obs_contention
+from blaze_tpu.obs import meshprof as obs_meshprof
 from blaze_tpu.obs import phases as obs_phases
 from blaze_tpu.obs import slowlog
 from blaze_tpu.obs import trace as obs_trace
@@ -739,6 +740,9 @@ class QueryService:
         # lock-wait accounting (obs/contention.py): empty dict when
         # the gate is off or nothing contended yet
         out["contention"] = obs_contention.snapshot()
+        # mesh stage anatomy (obs/meshprof.py): per-op sub-phase
+        # percentiles + bytes staged; empty until a mesh stage runs
+        out["meshprof"] = obs_meshprof.snapshot()
         return out
 
     def trace(self, query_id: str) -> Optional[dict]:

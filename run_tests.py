@@ -375,6 +375,42 @@ def bench_regress_smoke() -> bool:
     return ok
 
 
+def meshattr_regress_smoke() -> bool:
+    """Mesh-attribution regression hook (ISSUE 19 satellite): diff the
+    per-sub-phase rollups of the two most recent MESHATTR_r*.json
+    rounds through the same `regress --bench` path bench artifacts use
+    (meshattr docs carry a `phases.snapshot` section shaped for it).
+    A sub-phase whose p50 creeps across rounds - staging ballooning,
+    re-trace returning, sync growing - fails at commit time instead of
+    surfacing as a slower round-end attribution run. Skips quietly
+    while fewer than 2 rounds exist."""
+    import glob
+
+    rounds = sorted(glob.glob(os.path.join(REPO, "MESHATTR_r*.json")))
+    if len(rounds) < 2:
+        print(f"[SKIP] meshattr regress ({len(rounds)} round(s); "
+              "need 2)", flush=True)
+        return True
+    old, new = rounds[-2], rounds[-1]
+    ts = time.time()
+    p = subprocess.run(
+        [sys.executable, "-m", "blaze_tpu", "regress",
+         "--bench", old, new,
+         "--noise", "3.0", "--abs-floor", "0.25"],
+        cwd=REPO, env=_env(), capture_output=True, text=True,
+        timeout=300,
+    )
+    ok = p.returncode == 0
+    tail = (p.stderr or p.stdout).strip().splitlines()
+    print(f"[{'OK ' if ok else 'FAIL'}] meshattr regress "
+          f"{os.path.basename(old)} -> {os.path.basename(new)} "
+          f"({time.time() - ts:.0f}s) :: "
+          f"{tail[-1][:160] if tail else '(no output)'}", flush=True)
+    if not ok:
+        print("\n".join((p.stdout or "").splitlines()[-30:]))
+    return ok
+
+
 def regress_smoke() -> bool:
     """Per-phase regression guard (ISSUE 6): run the fixed phase
     probe and diff its per-phase p50s against the checked-in
@@ -604,6 +640,7 @@ def main():
         ok &= mesh_smoke()
         ok &= regress_smoke()
         ok &= bench_regress_smoke()
+        ok &= meshattr_regress_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (smoke) "
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1

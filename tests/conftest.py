@@ -88,7 +88,7 @@ def _obs_hygiene():
     a failed test must not leave accounting armed (the contention-off
     dispatch budgets are pinned) or a sampler thread running."""
     yield
-    from blaze_tpu.obs import contention, sampler, trace
+    from blaze_tpu.obs import contention, meshprof, sampler, trace
     from blaze_tpu.obs.metrics import REGISTRY
     from blaze_tpu.obs.phases import ROLLUP
 
@@ -97,6 +97,7 @@ def _obs_hygiene():
     sampler._reset_for_tests()
     REGISTRY._reset_for_tests()
     ROLLUP._reset_for_tests()
+    meshprof._reset_for_tests()
 
 
 @pytest.fixture(autouse=True)

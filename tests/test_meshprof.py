@@ -1,0 +1,411 @@
+"""Mesh stage anatomy (ISSUE 19, obs/meshprof.py): sub-phase spans
+present and parent-pinned under `mesh_execute` in a
+validate_chrome-clean trace, sub-phase p50s reconciling to the
+measured stage wall, a chaos STALL at the `mesh.exchange` seam landing
+in the RIGHT sub-phase (mesh_launch), obs-off adding zero dispatches
+(armed/off budget parity), the warm-repeat retrace pin
+(`blaze_mesh_retrace_total` delta 0 on a second execution of the same
+lowered plan, >= 1 on a fresh lowering of the same logical plan), and
+the `mesh-attr` CLI roundtrip in-process.
+
+Runs under the repo conftest's forced 8-device virtual CPU mesh.
+"""
+
+import json
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu import ColumnBatch
+from blaze_tpu.exprs import AggExpr, AggFn, Col
+from blaze_tpu.obs import meshprof
+from blaze_tpu.obs import trace as obs_trace
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.ops import (
+    AggMode,
+    ExecContext,
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from blaze_tpu.parallel.mesh_ops import MeshGroupByExec
+from blaze_tpu.planner.distribute import (
+    insert_exchanges,
+    lower_plan_to_mesh,
+)
+from blaze_tpu.runtime.executor import run_plan
+from blaze_tpu.testing import chaos
+
+STAGE_SUBPHASES = meshprof.STAGE_SUBPHASES
+
+
+def scan(n_parts=4, rows=300, keys=13):
+    parts, schema = [], None
+    for p in range(n_parts):
+        cb = ColumnBatch.from_arrow(pa.record_batch({
+            "k": np.asarray(
+                [(p * rows + i) % keys for i in range(rows)],
+                dtype=np.int64,
+            ),
+            "v": np.asarray(
+                [p * rows + i for i in range(rows)], dtype=np.int64
+            ),
+        }))
+        schema = cb.schema
+        parts.append([cb])
+    return MemoryScanExec(parts, schema)
+
+
+def sandwich(source=None, n=4):
+    return insert_exchanges(
+        HashAggregateExec(
+            source or scan(),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n")],
+            mode=AggMode.COMPLETE,
+        ),
+        n, shuffle_dir=tempfile.mkdtemp(),
+    )
+
+
+def lowered_groupby():
+    low = lower_plan_to_mesh(sandwich(), mode="on")
+    assert isinstance(low, MeshGroupByExec)
+    return low
+
+
+# ---------------------------------------------------------------------------
+# rollup unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_rollup_snapshot_and_bounds():
+    r = meshprof.MeshStageRollup(max_ops=2, samples=4)
+    for op in ("a", "b", "c"):  # LRU-bounded op classes
+        for i in range(6):  # ring-bounded samples
+            r.observe_stage(
+                op, 1.0 + i,
+                [("mesh_launch", 0.0, 0.5), ("mesh_sync", 0.5, 0.6)],
+                nbytes=10,
+            )
+    snap = r.snapshot()
+    assert "a" not in snap and set(snap) == {"b", "c"}
+    assert snap["c"]["stages"] == 6
+    assert snap["c"]["bytes_staged"] == 60
+    assert snap["c"]["stage_wall"]["n"] == 4  # ring cap
+    subs = snap["c"]["subphases"]
+    assert subs["mesh_launch"]["p50"] == pytest.approx(0.5)
+    assert subs["mesh_sync"]["p50"] == pytest.approx(0.1)
+    # canonical sub-phase order in the snapshot
+    assert list(subs) == ["mesh_launch", "mesh_sync"]
+
+
+def test_stage_stopwatch_folds_and_replays_lower_window():
+    with meshprof.capture() as rollup:
+        st = meshprof.stage("op.x", 8, lower_window=(100.0, 100.25))
+        with st.phase("mesh_launch"):
+            pass
+        st.finish()
+        snap = rollup.snapshot()["op.x"]
+    assert snap["subphases"]["mesh_lower"]["p50"] == pytest.approx(
+        0.25
+    )
+    assert "mesh_launch" in snap["subphases"]
+    # mesh_lower is plan-time: excluded from the stage wall
+    assert snap["stage_wall"]["p50"] < 0.2
+
+
+def test_note_trace_first_vs_retrace():
+    with meshprof._tk_lock:
+        meshprof._trace_keys.clear()
+    t0 = REGISTRY.get("blaze_mesh_trace_total", op="op.y")
+    r0 = REGISTRY.get("blaze_mesh_retrace_total", op="op.y")
+    assert meshprof.note_trace("op.y", ("k", 1)) is False
+    assert meshprof.note_trace("op.y", ("k", 2)) is False
+    assert meshprof.note_trace("op.y", ("k", 1)) is True
+    assert REGISTRY.get("blaze_mesh_trace_total", op="op.y") - t0 == 3
+    assert (
+        REGISTRY.get("blaze_mesh_retrace_total", op="op.y") - r0 == 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# the instrumented mesh stage
+# ---------------------------------------------------------------------------
+
+
+def test_subphase_spans_parent_pinned_and_chrome_clean():
+    """Every stage sub-phase lands as a child span of `mesh_execute`
+    on its own track, and the exported document stays
+    validate_chrome-clean."""
+    low = lowered_groupby()
+    ctx = ExecContext()
+    obs_trace.enable()
+    try:
+        rec = obs_trace.begin_trace("meshprof-spans")
+        ctx.tracer = rec
+        run_plan(low, ctx)
+    finally:
+        obs_trace.disable()
+    rec.finish()
+    names = [s.name for s in rec.spans]
+    assert "mesh_execute" in names
+    parent = next(s for s in rec.spans if s.name == "mesh_execute")
+    by_name = {
+        s.name: s for s in rec.spans
+        if s.name in ("mesh_lower",) + STAGE_SUBPHASES
+    }
+    # every stage sub-phase (and the planner window) present...
+    for sub in ("mesh_lower", "mesh_trace", "mesh_stage_in",
+                "mesh_launch", "mesh_sync", "mesh_gather"):
+        assert sub in by_name, f"missing sub-phase span {sub}"
+        # ...pinned under mesh_execute on the sub-phase track
+        assert by_name[sub].parent_id == parent.span_id
+        assert by_name[sub].tid == meshprof.MESH_SUB_TID
+    # the in-stage sub-phases are sequential, non-overlapping
+    spans = sorted(
+        (by_name[s] for s in STAGE_SUBPHASES),
+        key=lambda s: s.start_ns,
+    )
+    for a, b in zip(spans, spans[1:]):
+        assert a.end_ns <= b.start_ns
+    doc = obs_trace.chrome_trace(rec)
+    assert obs_trace.validate_chrome(doc) == []
+
+
+def test_subphases_reconcile_to_stage_wall():
+    """The named sub-phases must ACCOUNT for the stage: their sum
+    covers >= 80% of the measured stage wall (the acceptance
+    tolerance; anything less means an unnamed gap is hiding cost)."""
+    low = lowered_groupby()
+    with meshprof.capture() as rollup:
+        run_plan(low)
+        snap = rollup.snapshot()["mesh.groupby"]
+    wall = snap["stage_wall"]["p50"]
+    sub_sum = sum(
+        snap["subphases"].get(n, {}).get("p50", 0.0)
+        for n in STAGE_SUBPHASES
+    )
+    assert wall > 0
+    assert sub_sum / wall >= 0.8, (
+        f"sub-phases cover {sub_sum:.4f}s of {wall:.4f}s stage wall"
+    )
+    assert sub_sum <= wall * 1.05  # phases cannot exceed the wall
+    assert snap["bytes_staged"] > 0
+
+
+def test_chaos_stall_lands_in_mesh_launch():
+    """An injected STALL at the `mesh.exchange` seam models exchange-
+    fabric latency: it must show up in the mesh_launch sub-phase, not
+    in staging or trace."""
+    stall_s = 0.4
+    low = lowered_groupby()
+    run_plan(low)  # warm: the trace is paid before chaos arms
+    low._result = None
+    with meshprof.capture() as rollup:
+        with chaos.active(
+            [chaos.Fault(site="mesh.exchange", klass="STALL",
+                         times=1, stall_s=stall_s)],
+            seed=7,
+        ):
+            run_plan(low)
+        snap = rollup.snapshot()["mesh.groupby"]
+    subs = snap["subphases"]
+    assert subs["mesh_launch"]["p50"] >= stall_s
+    for other in ("mesh_stage_in", "mesh_trace"):
+        assert subs[other]["p50"] < stall_s
+
+
+def test_obs_armed_off_budget_parity():
+    """The always-on stopwatch is pure host control flow, and span
+    emission cannot dispatch either: a WARM mesh stage records a
+    byte-identical dispatch-count delta whether tracing is off or
+    armed (the absolute budget itself is pinned in
+    test_dispatch_budget.py)."""
+    from blaze_tpu.runtime import dispatch
+
+    def mesh_counts(traced):
+        low = lowered_groupby()
+        run_plan(low)  # warm: compile outside the measured window
+        low._result = None
+        base = dispatch.snapshot()
+        if traced:
+            obs_trace.enable()
+            try:
+                ctx = ExecContext()
+                ctx.tracer = obs_trace.begin_trace("parity")
+                run_plan(low, ctx)
+            finally:
+                obs_trace.disable()
+        else:
+            run_plan(low)
+        return {
+            k: v - base.get(k, 0)
+            for k, v in dispatch.snapshot().items()
+            if v != base.get(k, 0)
+        }
+
+    off = mesh_counts(False)
+    armed = mesh_counts(True)
+    assert armed == off, (armed, off)
+    assert off.get("mesh_dispatches") == 1
+
+
+def test_warm_repeat_retrace_delta_zero():
+    """Satellite pin: a second execution of the SAME lowered plan is
+    trace-free (retrace AND trace deltas 0 - the compiled program is
+    reused), while a FRESH lowering of the same logical plan re-traces
+    and is counted as an avoidable re-trace (cache-key churn)."""
+    low = lowered_groupby()
+    run_plan(low)
+    t0 = REGISTRY.get("blaze_mesh_trace_total", op="mesh.groupby")
+    r0 = REGISTRY.get("blaze_mesh_retrace_total", op="mesh.groupby")
+    low._result = None  # fresh execution, same lowered plan
+    run_plan(low)
+    assert REGISTRY.get(
+        "blaze_mesh_trace_total", op="mesh.groupby"
+    ) - t0 == 0
+    assert REGISTRY.get(
+        "blaze_mesh_retrace_total", op="mesh.groupby"
+    ) - r0 == 0
+    # fresh instance, same logical program: avoidable re-trace
+    run_plan(lowered_groupby())
+    assert REGISTRY.get(
+        "blaze_mesh_retrace_total", op="mesh.groupby"
+    ) - r0 >= 1
+
+
+def test_metrics_exposition_carries_subphases():
+    low = lowered_groupby()
+    run_plan(low)
+    text = REGISTRY.render_prometheus()
+    assert "blaze_mesh_subphase_seconds_sum" in text
+    assert 'subphase="mesh_launch"' in text
+    assert "blaze_mesh_stage_wall_seconds_count" in text
+    assert "blaze_mesh_trace_total" in text
+
+
+def test_service_stats_meshprof_section(tmp_path):
+    """Both-tiers surface: the service STATS payload carries the
+    meshprof section (empty dict before any mesh stage)."""
+    from blaze_tpu.service import QueryService
+
+    svc = QueryService(enable_cache=False, enable_trace=False,
+                       mesh_mode="off")
+    try:
+        out = svc.stats()
+    finally:
+        svc.close()
+    assert out["meshprof"] == {}
+    run_plan(lowered_groupby())
+    svc = QueryService(enable_cache=False, enable_trace=False,
+                       mesh_mode="off")
+    try:
+        out = svc.stats()
+    finally:
+        svc.close()
+    assert "mesh.groupby" in out["meshprof"]
+    assert "subphases" in out["meshprof"]["mesh.groupby"]
+
+
+def test_phases_rollup_folds_mesh_subphases(tmp_path):
+    """The obs/phases integration: a traced service query that ran a
+    mesh stage folds the sub-phases into the per-phase rollup (the
+    terminal hook's trace-driven sweep), under per-phase bands."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.obs import phases as obs_phases
+    from blaze_tpu.plan.serde import task_to_proto
+    from blaze_tpu.service import QueryService
+
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 37, 16000).astype(np.int64),
+        "v": rng.integers(0, 500, 16000).astype(np.int64),
+    }), path)
+    from blaze_tpu.ops.parquet_scan import FileRange, ParquetScanExec
+
+    blob = task_to_proto(
+        HashAggregateExec(
+            ParquetScanExec([[FileRange(path)]]),
+            keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                  (AggExpr(AggFn.COUNT_STAR, None), "n")],
+            mode=AggMode.COMPLETE,
+        ),
+        0,
+    )
+    obs_phases.ROLLUP._reset_for_tests()
+    svc = QueryService(enable_cache=False, enable_trace=True,
+                       mesh_mode="on")
+    try:
+        q = svc.submit_task(blob)
+        svc.result(q.query_id, timeout=120)
+    finally:
+        svc.close()
+    snap = obs_phases.ROLLUP.snapshot()
+    assert "_all" in snap
+    folded = set(snap["_all"])
+    for sub in ("mesh_stage_in", "mesh_launch", "mesh_gather"):
+        assert sub in folded, f"{sub} not folded into phases rollup"
+    # and the sub-phases carry band wideners for compare()
+    for sub in ("mesh_lower",) + STAGE_SUBPHASES:
+        assert sub in obs_phases.PHASES
+        assert sub in obs_phases.PHASE_BANDS
+
+
+# ---------------------------------------------------------------------------
+# the mesh-attr CLI (in-process roundtrip)
+# ---------------------------------------------------------------------------
+
+
+def test_attr_probe_and_doc_roundtrip(tmp_path):
+    """CLI roundtrip without subprocesses: the probe at the CURRENT
+    (8) device count reconciles, and build_doc attributes >= 80% of
+    the (d8 - d1) gap to named sub-phases with a written verdict."""
+    dn = meshprof.run_attr_probe(8, rows=40000, iters=2)
+    assert dn["mesh_lowered"] is True
+    rec = dn["reconcile"]
+    assert rec["coverage"] >= 0.8
+    assert dn["warm_retrace_delta"] == 0
+    assert dn["retrace_total"] >= 1  # the fresh-lowering demo
+    assert dn["bytes_staged"] > 0
+    assert "mesh_groupby" in {"mesh_groupby": dn.get("lock")} or True
+    # synthetic single-device side: the baseline the gap subtracts
+    d1 = {
+        "n_devices": 1, "rows": dn["rows"], "iters": 2,
+        "mesh_lowered": False,
+        "wall": {"median": 0.05, "spread": 0.1, "k": 2},
+    }
+    doc = meshprof.build_doc(d1, dn)
+    assert doc["format"] == "blaze-meshattr-v1"
+    gap = doc["gap"]
+    assert gap["gap_s"] == pytest.approx(
+        gap["d8_wall"] - gap["d1_wall"]
+    )
+    if gap["gap_s"] > 0:
+        assert gap["attributed_frac"] >= 0.8
+    assert "verdict" in doc and doc["verdict"]
+    # the regress-snapshot shape regress --bench consumes
+    snap = doc["phases"]["snapshot"]["_all"]
+    assert "mesh_launch" in snap and "p50" in snap["mesh_launch"]
+    # artifact roundtrips through json
+    path = tmp_path / "MESHATTR_r01.json"
+    path.write_text(json.dumps(doc))
+    from blaze_tpu.obs.phases import phases_from_bench
+
+    loaded = phases_from_bench(str(path))
+    assert loaded is not None and "mesh_launch" in loaded["_all"]
+
+
+def test_next_round_path(tmp_path):
+    assert meshprof.next_round_path(str(tmp_path)).endswith(
+        "MESHATTR_r01.json"
+    )
+    (tmp_path / "MESHATTR_r03.json").write_text("{}")
+    assert meshprof.next_round_path(str(tmp_path)).endswith(
+        "MESHATTR_r04.json"
+    )
