@@ -1,0 +1,29 @@
+"""Fleet mesh tier (ISSUE 20): hybrid ICI x DCN multi-host execution.
+
+One large query executes across N `serve` hosts forming a hybrid
+ICI x DCN mesh - each host runs whole per-host stages on its local
+device mesh (the PR 7 operators), stage boundaries move between hosts
+over the `MESH_EXCHANGE` wire verb as the same framed Arrow-IPC
+segments every other data path uses - while the router keeps treating
+each host as an independent replica for small queries.
+
+Modules (imported lazily to keep this package cheap for the many
+callers that only need one piece):
+
+  program_cache  fingerprint-keyed cache of lowered mesh programs
+                 (plan structure + mesh shape, NOT op identity) - a
+                 fresh QueryService re-lowering the same plan reuses
+                 the traced program instead of re-paying the ~10 s
+                 trace MESHATTR_r01 flagged
+  claims         FleetDeviceLedger: mesh queries reserve DEVICES
+                 across hosts (claim/release, per-tenant caps,
+                 DRAINING-shaped exhaustion) so fleet mesh composes
+                 with tenant budgets and DRR fairness
+  exchange       the serve-side MESH_EXCHANGE handler: remote stage
+                 specs in, framed Arrow-IPC segments out (the DCN
+                 exchange plane)
+  exec           FleetMeshExec - the coordinator op driving per-host
+                 ICI stages joined by DCN exchanges, with the
+                 `fleet.exchange` chaos seam and the degrade ladder
+                 (fleet -> single-host mesh -> single-device)
+"""

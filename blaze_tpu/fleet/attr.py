@@ -1,0 +1,147 @@
+"""Fleet stage anatomy probe: the `mesh-attr --fleet` child half.
+
+Runs the fleet grouped-agg shape against a REAL in-process peer (a
+second QueryService behind a wire listener - the same two-hosts-in-
+one-process emulation the fleet tests use) and attributes the stage
+wall across the sub-phases, `mesh_dcn` (the DCN exchange rounds)
+sitting next to the six single-host phases. The parent asserts the
+attribution covers >= 0.95 of the measured stage wall - the fleet
+tier earns its keep only if we can SAY where the DCN time goes.
+
+Expects the process device count to already match `n_dev` (the
+parent forces it via XLA_FLAGS before any backend init).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+from typing import Any, Dict
+
+OP_KEY = "fleet.groupby"
+
+
+def run_fleet_attr_probe(n_dev: int, rows: int = 1 << 18,
+                         iters: int = 4) -> Dict[str, Any]:
+    import numpy as np
+    import pyarrow as pa
+
+    import jax
+
+    from blaze_tpu.batch import ColumnBatch
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.fleet.exec import FleetContext, FleetMeshExec
+    from blaze_tpu.obs import meshprof
+    from blaze_tpu.ops import (
+        AggMode,
+        HashAggregateExec,
+        MemoryScanExec,
+    )
+    from blaze_tpu.planner.distribute import (
+        insert_exchanges,
+        lower_plan_to_fleet,
+    )
+    from blaze_tpu.runtime.executor import run_plan
+    from blaze_tpu.runtime.gateway import TaskGatewayServer
+    from blaze_tpu.service import QueryService
+
+    assert len(jax.devices()) == n_dev, (
+        f"expected {n_dev} devices, saw {len(jax.devices())} "
+        "(the device count freezes at first backend init - run the "
+        "probe in a fresh subprocess)"
+    )
+    n_parts = 8
+    per = max(1, rows // n_parts)
+    rng = np.random.default_rng(17)
+    parts, schema = [], None
+    for _ in range(n_parts):
+        k = rng.integers(0, 4096, per).astype(np.int64)
+        v = rng.integers(0, 1000, per).astype(np.int64)
+        cb = ColumnBatch.from_arrow(pa.record_batch({"k": k, "v": v}))
+        schema = cb.schema
+        parts.append([cb])
+    shuffle_dir = tempfile.mkdtemp(prefix="blaze_fleet_attr_")
+
+    def sandwich():
+        return insert_exchanges(
+            HashAggregateExec(
+                MemoryScanExec(parts, schema),
+                keys=[(Col("k"), "k")],
+                aggs=[(AggExpr(AggFn.SUM, Col("v")), "s"),
+                      (AggExpr(AggFn.COUNT_STAR, None), "n")],
+                mode=AggMode.COMPLETE,
+            ),
+            n_parts, shuffle_dir=shuffle_dir,
+        )
+
+    doc: Dict[str, Any] = {
+        "n_devices": n_dev, "rows": per * n_parts, "iters": iters,
+        "hosts": 2,
+    }
+    peer = QueryService(enable_cache=False, enable_trace=False,
+                        mesh_mode="on")
+    srv = TaskGatewayServer(service=peer)
+    srv.__enter__()
+    try:
+        host, port = srv.address
+        fleet = FleetContext([f"{host}:{port}"])
+        lowered = lower_plan_to_fleet(sandwich(), fleet, mode="on")
+        fleet_lowered = isinstance(lowered, FleetMeshExec)
+        doc["fleet_lowered"] = fleet_lowered
+        if not fleet_lowered:
+            return doc
+
+        def run_once():
+            lowered._result = None  # fresh execution, warm programs
+            return run_plan(lowered)
+
+        with meshprof.capture() as cold_rollup:
+            t0 = time.perf_counter()
+            run_once()  # cold: pays the peer's trace+compile too
+            cold_wall = time.perf_counter() - t0
+        assert not lowered._use_fallback, "fleet path degraded"
+        cold_snap = cold_rollup.snapshot().get(OP_KEY, {})
+        doc["cold"] = {
+            "wall": round(cold_wall, 4),
+            "subphases": {
+                name: st["p50"] for name, st in
+                (cold_snap.get("subphases") or {}).items()
+            },
+        }
+        walls = []
+        with meshprof.capture() as rol:
+            for _ in range(max(1, iters)):
+                t0 = time.perf_counter()
+                run_once()
+                walls.append(time.perf_counter() - t0)
+        assert not lowered._use_fallback, "fleet path degraded"
+    finally:
+        srv.__exit__(None, None, None)
+        peer.close()
+    walls.sort()
+    median = walls[len(walls) // 2]
+    doc["wall"] = {
+        "median": round(median, 4),
+        "spread": round(
+            (walls[-1] - walls[0]) / median, 3
+        ) if median > 0 else 0.0,
+        "k": len(walls),
+    }
+    snap = rol.snapshot().get(OP_KEY) or {}
+    doc["subphases"] = snap.get("subphases") or {}
+    doc["bytes_staged"] = snap.get("bytes_staged", 0)
+    wall_stat = snap.get("stage_wall") or {}
+    wall_p50 = wall_stat.get("p50", 0.0)
+    sub_sum = sum(
+        doc["subphases"].get(n, {}).get("p50", 0.0)
+        for n in meshprof.STAGE_SUBPHASES
+    )
+    doc["reconcile"] = {
+        "wall_p50": round(wall_p50, 6),
+        "subphase_sum": round(sub_sum, 6),
+        "coverage": round(sub_sum / wall_p50, 4)
+        if wall_p50 > 0 else 0.0,
+    }
+    dcn = doc["subphases"].get("mesh_dcn", {}).get("p50", 0.0)
+    doc["dcn_share"] = round(dcn / wall_p50, 4) if wall_p50 else 0.0
+    return doc

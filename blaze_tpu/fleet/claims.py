@@ -1,0 +1,144 @@
+"""Mesh-aware fleet admission: a device ledger for cross-host claims.
+
+A fleet-mesh query occupies devices on EVERY participating host for the
+length of a stage, so admission cannot stay per-replica: two coordinators
+each seeing "my local devices are free" would oversubscribe the shared
+peers. The ledger is the router-coordinated truth (one instance rides
+the router's membership state, claims arrive over MESH_EXCHANGE
+{"op": "claim"}); a serve host with no router configured runs a local
+ledger over its own devices so the single-host path needs no wire hop.
+
+Composes with the tenancy tier the same way queue admission does
+(service/admission.TenantBudgets): the `max_fleet_devices` cap key - a
+per-tenant ceiling on concurrently claimed fleet devices - merges
+through the same {"tenant": {...}, "*": {...}} config. A tenant-budget
+denial is REJECTED_TENANT_BUDGET-shaped and a capacity denial is
+DRAINING-shaped, so the existing client retry/spill contracts (bounded
+backoff, zero router breaker strikes) apply unchanged.
+
+Denial is never failure: the fleet executor degrades a denied claim to
+the single-host mesh tier - admission controls WHERE work runs, not
+whether it completes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class FleetClaimDenied(RuntimeError):
+    """Claim refusal; str(exc) carries the wire-shaped error prefix
+    (REJECTED_TENANT_BUDGET: / DRAINING:) so callers can forward it
+    in-band unchanged."""
+
+
+class FleetDeviceLedger:
+    """Counting ledger over a fleet's device pool.
+
+    claim() blocks up to `timeout_s` for capacity (a released claim
+    wakes waiters via the condition), but a tenant-budget violation
+    rejects immediately - waiting cannot fix a per-tenant ceiling the
+    tenant itself is holding."""
+
+    def __init__(self, total_devices: int,
+                 tenant_config: Optional[dict] = None):
+        from blaze_tpu.service.admission import TenantBudgets
+
+        self.total = max(0, int(total_devices))
+        self.budgets = TenantBudgets(tenant_config)
+        self._cond = threading.Condition()
+        self._seq = itertools.count(1)
+        # token -> (tenant, devices)
+        self._claims: Dict[str, Tuple[str, int]] = {}
+        self._used = 0
+        self._by_tenant: Dict[str, int] = {}
+        self.counters = {
+            "claims": 0,
+            "released": 0,
+            "denied_budget": 0,
+            "denied_capacity": 0,
+        }
+
+    def _tenant_cap(self, tenant: str) -> Optional[int]:
+        v = self.budgets.for_tenant(tenant).get("max_fleet_devices")
+        return int(v) if v is not None else None
+
+    def resize(self, total_devices: int) -> None:
+        """Membership changes move the pool size (join adds devices,
+        drain/death removes them); outstanding claims keep their
+        grants - the pool can run transiently oversubscribed until
+        they release."""
+        with self._cond:
+            self.total = max(0, int(total_devices))
+            self._cond.notify_all()
+
+    def claim(self, tenant: str, devices: int,
+              timeout_s: float = 0.0) -> str:
+        tenant = str(tenant or "default")
+        n = max(1, int(devices))
+        from blaze_tpu.obs.metrics import REGISTRY
+
+        cap = self._tenant_cap(tenant)
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._cond:
+            if cap is not None \
+                    and self._by_tenant.get(tenant, 0) + n > cap:
+                self.counters["denied_budget"] += 1
+                REGISTRY.inc("blaze_fleet_claims_denied_total",
+                             reason="tenant_budget")
+                raise FleetClaimDenied(
+                    "REJECTED_TENANT_BUDGET: tenant "
+                    f"{tenant!r} fleet-device cap {cap} "
+                    f"(holding {self._by_tenant.get(tenant, 0)}, "
+                    f"asked {n})"
+                )
+            while self._used + n > self.total:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or n > self.total:
+                    self.counters["denied_capacity"] += 1
+                    REGISTRY.inc("blaze_fleet_claims_denied_total",
+                                 reason="capacity")
+                    raise FleetClaimDenied(
+                        "DRAINING: fleet devices exhausted "
+                        f"({self._used}/{self.total} claimed, "
+                        f"asked {n})"
+                    )
+                self._cond.wait(timeout=remaining)
+            token = f"claim-{next(self._seq)}"
+            self._claims[token] = (tenant, n)
+            self._used += n
+            self._by_tenant[tenant] = (
+                self._by_tenant.get(tenant, 0) + n
+            )
+            self.counters["claims"] += 1
+            REGISTRY.inc("blaze_fleet_claims_total")
+            return token
+
+    def release(self, token: str) -> bool:
+        with self._cond:
+            entry = self._claims.pop(str(token), None)
+            if entry is None:
+                return False
+            tenant, n = entry
+            self._used -= n
+            left = self._by_tenant.get(tenant, 0) - n
+            if left > 0:
+                self._by_tenant[tenant] = left
+            else:
+                self._by_tenant.pop(tenant, None)
+            self.counters["released"] += 1
+            self._cond.notify_all()
+            return True
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "total_devices": self.total,
+                "claimed_devices": self._used,
+                "outstanding": len(self._claims),
+                "by_tenant": dict(self._by_tenant),
+                **self.counters,
+            }

@@ -1,0 +1,219 @@
+"""Fleet DCN exchange plane: the serve-side MESH_EXCHANGE handlers.
+
+The wire verb (service/wire.VERB_MESH_EXCHANGE) moves stage
+boundaries between fleet hosts as the SAME framed Arrow-IPC segments
+the shuffle tier and streamed FETCH already speak (io/ipc.py) - one
+control JSON plus u64-framed encoded parts each way. This module is
+the request side of that verb on a serve host:
+
+  * ``{"op": "ping"}``                liveness + advertised devices
+  * ``{"op": "run_stage", "stage"}``  run one fleet stage over the
+    shipped partitions and answer with the stage's output segments
+
+Two stage kinds mirror parallel/exchange.py's repartition-by-key
+semantics, lifted to hosts:
+
+  * ``partial_group`` - locally aggregate the shipped partitions (the
+    plan is rebuilt as the standard PARTIAL -> hash-exchange -> FINAL
+    sandwich and mesh-lowered, so each host's stage IS the ICI tier
+    with the file-shuffle fallback intact), then hash-partition the
+    grouped rows into ``n_buckets`` host buckets. Empty buckets
+    encode to zero parts, so the reply JSON carries ``bucket_parts``
+    (parts-per-bucket counts) to keep bucket boundaries unambiguous.
+  * ``final_merge`` - merge partial groups for the buckets this host
+    owns (COUNT partials merge by SUM, the rest by their own fn) in
+    one single-partition COMPLETE aggregate.
+
+Bucket routing uses `bucket_hash` - a plain deterministic numpy hash.
+Only determinism matters: the coordinator's local stage and every
+peer run this same code, so a group's rows always meet on one host.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.exprs import ir
+from blaze_tpu.exprs.ir import AggExpr, AggFn
+from blaze_tpu.io.ipc import decode_ipc_parts, encode_ipc_segment
+from blaze_tpu.ops import AggMode, HashAggregateExec, MemoryScanExec
+
+# partial-state merge: how a finalized partial aggregate combines with
+# its siblings from other hosts. AVG is deliberately absent - a naive
+# merge of finalized averages is WRONG (it loses the weights), so the
+# fleet planner never ships AVG (it stays on the single-host mesh).
+MERGE_FN = {
+    AggFn.SUM: AggFn.SUM,
+    AggFn.COUNT: AggFn.SUM,
+    AggFn.COUNT_STAR: AggFn.SUM,
+    AggFn.MIN: AggFn.MIN,
+    AggFn.MAX: AggFn.MAX,
+}
+
+
+def bucket_hash(columns: Sequence[np.ndarray]) -> np.ndarray:
+    """Deterministic per-row u64 hash over fixed-width key columns
+    (FNV-1a style with a finalizer mix). Both exchange ends run this
+    exact code - the only contract is determinism."""
+    n = len(columns[0])
+    h = np.full(n, 14695981039346656037, dtype=np.uint64)
+    for col in columns:
+        v = np.asarray(col)
+        if v.dtype.kind == "f":
+            b = v.astype(np.float64).view(np.uint64)
+        elif v.dtype.kind == "b":
+            b = v.astype(np.uint64)
+        else:
+            b = v.astype(np.int64).view(np.uint64)
+        h = (h ^ b) * np.uint64(1099511628211)
+        h = h ^ (h >> np.uint64(33))
+    return h
+
+
+def _decode_batches(parts: Sequence[bytes]) -> List[pa.RecordBatch]:
+    out: List[pa.RecordBatch] = []
+    for p in parts:
+        for rb in decode_ipc_parts(p):
+            if rb.num_rows:
+                out.append(rb)
+    return out
+
+
+def _encode_table(table: pa.Table) -> List[bytes]:
+    segs = []
+    for rb in table.combine_chunks().to_batches():
+        seg = encode_ipc_segment(rb)
+        if seg:
+            segs.append(seg)
+    return segs
+
+
+def _key_arrays(table: pa.Table, names: Sequence[str]
+                ) -> List[np.ndarray]:
+    return [
+        np.asarray(
+            table.column(n).combine_chunks()
+            .to_numpy(zero_copy_only=False)
+        )
+        for n in names
+    ]
+
+
+def _run_partial_group(spec: dict, parts: Sequence[bytes]
+                       ) -> Tuple[dict, List[bytes]]:
+    from blaze_tpu.planner.distribute import (
+        insert_exchanges,
+        lower_plan_to_mesh,
+    )
+    from blaze_tpu.runtime.executor import run_plan
+
+    n_buckets = max(1, int(spec.get("n_buckets", 1)))
+    batches = _decode_batches(parts)
+    if not batches:
+        return {"ok": True, "rows": 0,
+                "bucket_parts": [0] * n_buckets}, []
+    cbs = [ColumnBatch.from_arrow(rb) for rb in batches]
+    # one partition per shipped batch: partition grouping carries no
+    # meaning for a partial aggregation, and per-batch partitions are
+    # what the mesh stages over devices
+    scan = MemoryScanExec([[cb] for cb in cbs], cbs[0].schema)
+    keys = [
+        (ir.Col(scan.schema.fields[int(i)].name), str(n))
+        for i, n in spec["keys"]
+    ]
+    aggs = []
+    for fn, i, n in spec["aggs"]:
+        child = (
+            ir.Col(scan.schema.fields[int(i)].name)
+            if i is not None else None
+        )
+        aggs.append((AggExpr(AggFn(fn), child), str(n)))
+    plan = HashAggregateExec(
+        scan, keys=keys, aggs=aggs, mode=AggMode.COMPLETE
+    )
+    plan = insert_exchanges(
+        plan, min(8, max(2, len(cbs))),
+        shuffle_dir=tempfile.mkdtemp(prefix="blaze-fleet-"),
+    )
+    plan = lower_plan_to_mesh(
+        plan, mode=str(spec.get("mesh_mode") or "auto")
+    )
+    table = run_plan(plan)
+    if table.num_rows == 0:
+        return {"ok": True, "rows": 0,
+                "bucket_parts": [0] * n_buckets}, []
+    key_names = [str(n) for _, n in spec["keys"]]
+    bucket = bucket_hash(_key_arrays(table, key_names)) \
+        % np.uint64(n_buckets)
+    counts: List[int] = []
+    out_parts: List[bytes] = []
+    for b in range(n_buckets):
+        mask = bucket == np.uint64(b)
+        if not mask.any():
+            counts.append(0)
+            continue
+        segs = _encode_table(table.filter(pa.array(mask)))
+        counts.append(len(segs))
+        out_parts.extend(segs)
+    return {"ok": True, "rows": int(table.num_rows),
+            "bucket_parts": counts}, out_parts
+
+
+def _run_final_merge(spec: dict, parts: Sequence[bytes]
+                     ) -> Tuple[dict, List[bytes]]:
+    from blaze_tpu.runtime.executor import run_plan
+
+    batches = _decode_batches(parts)
+    if not batches:
+        return {"ok": True, "rows": 0, "bucket_parts": [0]}, []
+    cbs = [ColumnBatch.from_arrow(rb) for rb in batches]
+    # ONE partition: the merge must be global over every host's
+    # partials for the buckets this host owns (grouped rows are small
+    # - host-side COMPLETE is the right tier here)
+    scan = MemoryScanExec([cbs], cbs[0].schema)
+    keys = [(ir.Col(str(n)), str(n)) for n in spec["keys"]]
+    aggs = []
+    for fn, in_name, out_name in spec["aggs"]:
+        aggs.append((
+            AggExpr(AggFn(fn), ir.Col(str(in_name))),
+            str(out_name),
+        ))
+    plan = HashAggregateExec(
+        scan, keys=keys, aggs=aggs, mode=AggMode.COMPLETE
+    )
+    table = run_plan(plan)
+    segs = _encode_table(table)
+    return {"ok": True, "rows": int(table.num_rows),
+            "bucket_parts": [len(segs)]}, segs
+
+
+def run_stage(spec: dict, parts: Sequence[bytes]
+              ) -> Tuple[dict, List[bytes]]:
+    kind = spec.get("kind")
+    if kind == "partial_group":
+        return _run_partial_group(spec, parts)
+    if kind == "final_merge":
+        return _run_final_merge(spec, parts)
+    return {"error": f"mesh_exchange: unknown stage kind {kind!r}"}, []
+
+
+def handle_mesh_exchange(service, payload: dict,
+                         parts: Sequence[bytes]
+                         ) -> Tuple[dict, List[bytes]]:
+    """Serve-tier MESH_EXCHANGE dispatch (ServiceVerbBackend). Claim /
+    release ops belong to the router tier (router/proxy); a serve host
+    answers them with an in-band error the same way a serve host
+    answers MEMBER."""
+    op = str(payload.get("op", ""))
+    if op == "ping":
+        import jax
+
+        return {"ok": True, "devices": jax.local_device_count()}, []
+    if op == "run_stage":
+        return run_stage(dict(payload.get("stage") or {}), parts)
+    return {"error": f"mesh_exchange: unknown op {op!r}"}, []

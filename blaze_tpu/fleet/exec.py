@@ -1,0 +1,481 @@
+"""Fleet mesh coordinator: one query across N serve hosts.
+
+`FleetMeshExec` is the hybrid ICI x DCN tier's root operator: a
+grouped aggregation whose input partitions are split across the fleet,
+partially aggregated on each host's OWN device mesh (the existing ICI
+tier, lowered per host by fleet/exchange's stage handler), exchanged
+between hosts by key-hash bucket over the MESH_EXCHANGE wire verb (the
+DCN plane), and final-merged on the bucket owners. The coordinator is
+host 0: its stages run in-process (no wire hop for co-located data),
+peers are driven over ServiceClient.mesh_exchange on concurrent
+threads (star topology - the coordinator mediates both rounds).
+
+Failure policy - DELIBERATELY different from the single-host mesh
+ladder (parallel/mesh_exec.degrade_or_raise): a dead peer is not
+transient from this query's point of view (re-running the fleet stage
+against the same dead host cannot help), so ConnectionError/OSError
+from the DCN plane DEGRADES to the single-host fallback instead of
+propagating to the task-retry tier. Only cancellation propagates. The
+degradation target is the single-host mesh lowering of the same plan,
+which itself degrades device-ineligible inputs to single-device - the
+full ladder the ISSUE names: fleet -> single-host mesh ->
+single-device, zero client-visible failures.
+
+Chaos seam: `fleet.exchange` fires before every peer round trip
+(STALL under injected latency, degrade under injected faults), the
+fleet twin of `mesh.exchange`.
+
+Admission: the stage claims devices fleet-wide (fleet/claims, routed
+through the router when one is configured) before any work moves; a
+denied claim degrades exactly like a dead peer. `BLAZE_FLEET_TEST_
+DELAY_S` holds the coordinator between the claim and the first DCN
+round - the deterministic mid-stage window the SIGKILL test needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from blaze_tpu.batch import ColumnBatch
+from blaze_tpu.errors import ErrorClass, classify
+from blaze_tpu.fleet.claims import FleetClaimDenied, FleetDeviceLedger
+from blaze_tpu.io.ipc import decode_ipc_parts, encode_ipc_segment
+from blaze_tpu.obs import contention as obs_contention
+from blaze_tpu.obs import meshprof
+from blaze_tpu.obs import trace as obs_trace
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.ops.base import ExecContext, PhysicalOp
+from blaze_tpu.testing import chaos
+from blaze_tpu.types import Schema
+
+log = logging.getLogger("blaze.fleet")
+
+# COUNT partials merge by SUM; AVG never ships (fleet/exchange.MERGE_FN)
+_MERGE_FN_NAME = {
+    "sum": "sum", "count": "sum", "count_star": "sum",
+    "min": "min", "max": "max",
+}
+
+
+def _parse_addr(p) -> Tuple[str, int]:
+    if isinstance(p, (tuple, list)):
+        return str(p[0]), int(p[1])
+    host, _, port = str(p).rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+class FleetContext:
+    """The fleet a serve host sees: its peers (DCN-reachable serve
+    hosts), the claim authority (the router when configured, a local
+    ledger otherwise), and the wire budget for peer round trips."""
+
+    def __init__(self, peers: Sequence, devices: Optional[int] = None,
+                 router=None, tenant_config: Optional[dict] = None,
+                 timeout_s: float = 60.0,
+                 claim_timeout_s: float = 2.0):
+        self.peers = [_parse_addr(p) for p in (peers or [])]
+        self.router = _parse_addr(router) if router else None
+        self.timeout_s = float(timeout_s)
+        self.claim_timeout_s = float(claim_timeout_s)
+        self._devices = int(devices) if devices else None
+        self._tenant_config = tenant_config
+        self._ledger: Optional[FleetDeviceLedger] = None
+        self._ledger_lock = threading.Lock()
+
+    def width(self) -> int:
+        return 1 + len(self.peers)
+
+    def devices_per_host(self) -> int:
+        if self._devices is None:
+            import jax
+
+            self._devices = int(jax.local_device_count())
+        return self._devices
+
+    def total_devices(self) -> int:
+        return self.width() * self.devices_per_host()
+
+    @property
+    def ledger(self) -> FleetDeviceLedger:
+        with self._ledger_lock:
+            if self._ledger is None:
+                self._ledger = FleetDeviceLedger(
+                    self.total_devices(), self._tenant_config
+                )
+            return self._ledger
+
+    def claim(self, tenant: str,
+              devices: Optional[int] = None) -> str:
+        n = int(devices or self.total_devices())
+        if self.router is not None:
+            from blaze_tpu.service.wire import ServiceClient
+
+            host, port = self.router
+            with ServiceClient(
+                host, port, timeout=self.claim_timeout_s + 10.0,
+                reconnect_attempts=1,
+            ) as c:
+                resp, _ = c.mesh_exchange({
+                    "op": "claim", "tenant": str(tenant),
+                    "devices": n,
+                    "timeout_s": self.claim_timeout_s,
+                })
+            if resp.get("error"):
+                raise FleetClaimDenied(str(resp["error"]))
+            return str(resp.get("token", ""))
+        return self.ledger.claim(
+            tenant, n, timeout_s=self.claim_timeout_s
+        )
+
+    def release(self, token: str) -> None:
+        if not token:
+            return
+        if self.router is not None:
+            from blaze_tpu.service.wire import ServiceClient
+
+            host, port = self.router
+            try:
+                with ServiceClient(
+                    host, port, timeout=10.0, reconnect_attempts=0
+                ) as c:
+                    c.mesh_exchange(
+                        {"op": "release", "token": token}
+                    )
+            except Exception:  # noqa: BLE001 - release best-effort:
+                # the router's ledger self-heals on resize/restart
+                log.warning("fleet claim release failed", exc_info=True)
+            return
+        self.ledger.release(token)
+
+
+def fleet_chaos(peer: str, round_name: str, ctx: ExecContext) -> None:
+    """The `fleet.exchange` chaos seam: fires before every peer round
+    trip, the DCN twin of mesh_exec.mesh_chaos."""
+    if chaos.ACTIVE:
+        chaos.fire(
+            "fleet.exchange", peer=peer, round=round_name,
+            task_id=ctx.task_id,
+        )
+
+
+def fleet_degrade_or_raise(op: PhysicalOp, ctx: ExecContext,
+                           e: BaseException) -> None:
+    """The fleet failure ladder: everything except cancellation
+    degrades to the single-host fallback (see module docstring for
+    why TRANSIENT does not propagate here)."""
+    if getattr(op, "fallback", None) is None:
+        raise e
+    if not isinstance(
+        e, (NotImplementedError, AssertionError, FleetClaimDenied)
+    ):
+        if classify(e) is ErrorClass.CANCELLED:
+            raise e
+    op._use_fallback = True
+    op._result = None
+    ctx.metrics.add("fleet.degraded", 1)
+    # query-visible degradation flag: the service folds this into
+    # q.degraded at terminal accounting (a degraded fleet run is
+    # correct but did not measure the fleet plan)
+    ctx.fleet_degraded = True
+    REGISTRY.inc("blaze_fleet_degraded_total")
+    if obs_trace.ACTIVE:
+        obs_trace.event(
+            "fleet.degraded", op=type(op).__name__,
+            error=str(e)[:200],
+        )
+    log.warning(
+        "%s degrading to single-host fallback: %s",
+        type(op).__name__, e,
+    )
+
+
+class FleetMeshExec(PhysicalOp):
+    """Grouped aggregation across the fleet; one output partition per
+    host. Built only by planner/distribute.lower_plan_to_fleet, which
+    owns the eligibility gates (fleet-safe agg set, bindable keys,
+    cost guard) and supplies the single-host fallback."""
+
+    def __init__(self, child: PhysicalOp,
+                 kspec: Sequence[Tuple[int, str]],
+                 aspec: Sequence[Tuple[str, Optional[int], str]],
+                 fleet: FleetContext,
+                 schema: Schema,
+                 fallback: Optional[PhysicalOp] = None,
+                 mesh_mode: str = "auto"):
+        self.children = [child]
+        self.kspec = [(int(i), str(n)) for i, n in kspec]
+        self.aspec = [
+            (str(fn), None if i is None else int(i), str(n))
+            for fn, i, n in aspec
+        ]
+        self.fleet = fleet
+        self.fallback = fallback
+        self._use_fallback = False
+        self._schema = schema
+        self.mesh_mode = str(mesh_mode)
+        self._result: Optional[List[List]] = None
+        self._lock = obs_contention.TimedLock("fleet_mesh")
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return self.fleet.width()
+
+    # -- stage plumbing -------------------------------------------------
+
+    def _stage_in(self, ctx: ExecContext, H: int
+                  ) -> Tuple[List[List[bytes]], int]:
+        """Pull + encode the child's partitions, round-robin across
+        hosts. Host h's share ships over DCN; host 0's stays local."""
+        child = self.children[0]
+        host_parts: List[List[bytes]] = [[] for _ in range(H)]
+        nbytes = 0
+        for p in range(child.partition_count):
+            for cb in child.execute(p, ctx):
+                seg = encode_ipc_segment(cb.to_arrow())
+                if seg:
+                    host_parts[p % H].append(seg)
+                    nbytes += len(seg)
+        return host_parts, nbytes
+
+    def _peer_round(self, ctx: ExecContext, round_name: str,
+                    payloads: dict, parts_by_host: dict) -> dict:
+        """One DCN round: drive every peer concurrently, return
+        {host_index: (resp, out_parts)}. The chaos seam fires on the
+        coordinator thread (deterministic injection); peer errors are
+        re-raised here so the degrade ladder sees the first one."""
+        from blaze_tpu.service.wire import ServiceClient
+
+        results: dict = {}
+        errors: dict = {}
+
+        def drive(h: int) -> None:
+            host, port = self.fleet.peers[h - 1]
+            try:
+                with ServiceClient(
+                    host, port, timeout=self.fleet.timeout_s,
+                    reconnect_attempts=0,
+                ) as c:
+                    results[h] = c.mesh_exchange(
+                        payloads[h], parts_by_host[h]
+                    )
+            except Exception as e:  # noqa: BLE001 - collected below
+                errors[h] = e
+
+        threads = []
+        for h in payloads:
+            fleet_chaos(
+                f"{self.fleet.peers[h - 1][0]}:"
+                f"{self.fleet.peers[h - 1][1]}",
+                round_name, ctx,
+            )
+            th = threading.Thread(
+                target=drive, args=(h,), daemon=True,
+                name=f"blaze-fleet-dcn-{round_name}-{h}",
+            )
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        for h in sorted(errors):
+            raise errors[h]
+        for h, (resp, _) in sorted(results.items()):
+            if "error" in resp:
+                raise RuntimeError(
+                    f"fleet peer {h} {round_name}: {resp['error']}"
+                )
+        return results
+
+    @staticmethod
+    def _split_buckets(resp: dict, parts: List[bytes],
+                       H: int) -> List[List[bytes]]:
+        """Un-flatten a partial_group reply by its bucket_parts
+        counts (empty buckets ship zero parts, never empty frames)."""
+        counts = list(resp.get("bucket_parts") or [])
+        counts += [0] * (H - len(counts))
+        out: List[List[bytes]] = []
+        pos = 0
+        for b in range(H):
+            n = int(counts[b])
+            out.append(parts[pos:pos + n])
+            pos += n
+        return out
+
+    def _run(self, ctx: ExecContext) -> List[List]:
+        from blaze_tpu.fleet.exchange import run_stage
+        from blaze_tpu.runtime import dispatch
+
+        with self._lock:
+            if self._result is not None:
+                return self._result
+            H = self.fleet.width()
+            tenant = str(getattr(ctx, "tenant", None) or "default")
+            token = self.fleet.claim(tenant)
+            st = meshprof.stage(
+                "fleet.groupby", self.fleet.total_devices(),
+                lower_window=getattr(self, "_mesh_lower", None),
+            )
+            try:
+                with st.phase("mesh_stage_in"):
+                    host_parts, nbytes = self._stage_in(ctx, H)
+                    st.add_bytes(nbytes)
+                partial_spec = {
+                    "kind": "partial_group",
+                    "keys": [[i, n] for i, n in self.kspec],
+                    "aggs": [[fn, i, n] for fn, i, n in self.aspec],
+                    "n_buckets": H,
+                    "mesh_mode": self.mesh_mode,
+                }
+                merge_spec = {
+                    "kind": "final_merge",
+                    "keys": [n for _, n in self.kspec],
+                    "aggs": [
+                        [_MERGE_FN_NAME[fn], n, n]
+                        for fn, _, n in self.aspec
+                    ],
+                }
+                # deterministic mid-stage window for the SIGKILL
+                # failover test: hold between claim and first DCN call
+                delay = float(
+                    os.environ.get("BLAZE_FLEET_TEST_DELAY_S", "0")
+                    or 0.0
+                )
+                if delay > 0:
+                    time.sleep(delay)
+                dispatch.record("dispatches")
+                dispatch.record("fleet_dispatches")
+                r1_payload = {
+                    h: {"op": "run_stage", "stage": partial_spec}
+                    for h in range(1, H)
+                }
+                r1: dict = {}
+                r1_err: List[BaseException] = []
+
+                def _round1():
+                    try:
+                        r1.update(self._peer_round(
+                            ctx, "partial_group", r1_payload,
+                            {h: host_parts[h] for h in range(1, H)},
+                        ))
+                    except BaseException as e:  # noqa: BLE001
+                        r1_err.append(e)
+
+                r1_thread = None
+                if H > 1:
+                    # round 1 overlaps the local partial stage; the
+                    # join (and any peer error) lands in mesh_dcn
+                    r1_thread = threading.Thread(
+                        target=_round1, daemon=True,
+                        name="blaze-fleet-round1",
+                    )
+                    r1_thread.start()
+                with st.phase("mesh_launch"):
+                    local_resp, local_parts = run_stage(
+                        partial_spec, host_parts[0]
+                    )
+                with st.phase("mesh_dcn"):
+                    if r1_thread is not None:
+                        r1_thread.join()
+                        if r1_err:
+                            raise r1_err[0]
+                    buckets = {
+                        0: self._split_buckets(
+                            local_resp, local_parts, H
+                        ),
+                    }
+                    for h, (resp, parts) in r1.items():
+                        buckets[h] = self._split_buckets(
+                            resp, parts, H
+                        )
+                    dcn_bytes = sum(
+                        len(p)
+                        for h in range(1, H)
+                        for p in host_parts[h]
+                    ) + sum(
+                        len(p)
+                        for h, (_, parts) in r1.items()
+                        for p in parts
+                    )
+                    # bucket d's partials from every host -> host d
+                    dest_parts = {
+                        d: [
+                            p
+                            for h in range(H)
+                            for p in buckets[h][d]
+                        ]
+                        for d in range(H)
+                    }
+                    r2_payload = {
+                        h: {"op": "run_stage", "stage": merge_spec}
+                        for h in range(1, H)
+                    }
+                    dcn_bytes += sum(
+                        len(p)
+                        for h in range(1, H)
+                        for p in dest_parts[h]
+                    )
+                    merged: List[Optional[Tuple[dict, list]]] = (
+                        [None] * H
+                    )
+
+                    def _local_merge():
+                        merged[0] = run_stage(
+                            merge_spec, dest_parts[0]
+                        )
+
+                    lm = threading.Thread(
+                        target=_local_merge, daemon=True,
+                        name="blaze-fleet-merge0",
+                    )
+                    lm.start()
+                    if H > 1:
+                        r2 = self._peer_round(
+                            ctx, "final_merge", r2_payload,
+                            {h: dest_parts[h]
+                             for h in range(1, H)},
+                        )
+                        for h, res in r2.items():
+                            merged[h] = res
+                    lm.join()
+                with st.phase("mesh_gather"):
+                    result: List[List] = []
+                    for h in range(H):
+                        resp, parts = merged[h]
+                        result.append([
+                            rb
+                            for p in parts
+                            for rb in decode_ipc_parts(p)
+                            if rb.num_rows
+                        ])
+                st.finish()
+                ctx.metrics.add("fleet.exchange.dcn_bytes",
+                                dcn_bytes)
+                ctx.metrics.add("fleet.hosts", H)
+                REGISTRY.inc("blaze_fleet_stages_total")
+                REGISTRY.inc("blaze_fleet_dcn_bytes_total",
+                             n=dcn_bytes)
+                self._result = result
+                return result
+            finally:
+                self.fleet.release(token)
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        if self.fallback is not None and not self._use_fallback:
+            try:
+                self._run(ctx)
+            except Exception as e:  # noqa: BLE001 - fleet ladder
+                fleet_degrade_or_raise(self, ctx, e)
+        if self._use_fallback:
+            if partition < self.fallback.partition_count:
+                yield from self.fallback.execute(partition, ctx)
+            return
+        for rb in self._run(ctx)[partition]:
+            yield ColumnBatch.from_arrow(rb)

@@ -1,0 +1,109 @@
+"""Fingerprint-keyed mesh program cache (ISSUE 20 tentpole d).
+
+MESHATTR_r01 measured the problem this kills: a FRESH lowering of a
+plan already traced in the process re-pays the full trace+compile
+(~10 s at 8 devices) because the traced program lived on the mesh OP
+INSTANCE - object identity, not program identity. The mesh program
+holders (parallel/sharded.DistributedGroupBy / DistributedBroadcastJoin
+/ DistributedRepartition, and the pipeline/sort program bundles in
+parallel/mesh_exec) already carry their own signature-keyed trace state
+(`prepare()` returns True only when a trace actually ran); caching the
+HOLDER by structural program identity + mesh shape makes a re-lowered
+plan - a second QueryService in the same process, a repeat of the same
+plan after the op was discarded - hit the existing trace: `prepare()`
+sees a known signature, no retrace, `mesh_trace` ~ 0.
+
+Key = (kind, structural-key, mesh-key). The structural key is the same
+expression-repr material the ops already feed `meshprof.note_trace`
+(bound IR dataclasses repr structurally), WITHOUT the argument
+signature - argument shapes are the holder's own business. The mesh
+key pins device identity and axis layout: a program traced for one
+mesh must never run on another.
+
+Thread-safe bounded LRU. Entries are live program holders holding
+compiled executables; the bound is a safety valve, not a memory model
+(the jit cache underneath is the real residency).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Hashable, Tuple
+
+_DEFAULT_CAPACITY = 64
+
+
+def mesh_cache_key(mesh) -> Tuple:
+    """Device identity + axis layout: the part of program identity the
+    plan structure does not carry."""
+    return (
+        tuple(d.id for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+    )
+
+
+class ProgramCache:
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Hashable, object]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: Hashable,
+                     builder: Callable[[], object]) -> object:
+        """Return the cached holder for `key`, building (OUTSIDE the
+        lock - builders construct pjit programs) and inserting on a
+        miss. A racing double-build keeps the first-inserted holder so
+        every caller converges on one program."""
+        from blaze_tpu.obs.metrics import REGISTRY
+
+        with self._lock:
+            holder = self._entries.get(key)
+            if holder is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                REGISTRY.inc("blaze_mesh_program_cache_hits_total")
+                return holder
+        built = builder()
+        with self._lock:
+            holder = self._entries.get(key)
+            if holder is not None:
+                self.hits += 1
+                REGISTRY.inc("blaze_mesh_program_cache_hits_total")
+                return holder
+            self.misses += 1
+            REGISTRY.inc("blaze_mesh_program_cache_misses_total")
+            self._entries[key] = built
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return built
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "capacity": self.capacity,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# process-wide: program identity is structural, so sharing across
+# QueryService instances is the whole point (satellite: retrace delta 0
+# across two services in one process)
+PROGRAM_CACHE = ProgramCache()
+
+
+def _reset_for_tests() -> None:
+    PROGRAM_CACHE.clear()
+    PROGRAM_CACHE.hits = 0
+    PROGRAM_CACHE.misses = 0

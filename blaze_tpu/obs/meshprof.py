@@ -76,6 +76,7 @@ SUBPHASES = (
     "mesh_stage_in",
     "mesh_launch",
     "mesh_sync",
+    "mesh_dcn",       # fleet tier: DCN exchange round trips
     "mesh_gather",
 )
 
@@ -84,7 +85,7 @@ SUBPHASES = (
 # tolerance. mesh_lower happens at plan time, before the wall opens.
 STAGE_SUBPHASES = (
     "mesh_trace", "mesh_stage_in", "mesh_launch", "mesh_sync",
-    "mesh_gather",
+    "mesh_dcn", "mesh_gather",
 )
 
 _MAX_OPS = 16

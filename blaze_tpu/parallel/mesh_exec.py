@@ -278,6 +278,42 @@ def degrade_or_raise(op: PhysicalOp, ctx: ExecContext,
 # ---------------------------------------------------------------------------
 
 
+class _TracedProgram:
+    """Signature-keyed trace state for a mesh program that jits one
+    callable: the cacheable holder shape (fleet/program_cache) shared
+    by the pipeline and sort ops. `prepare()` returns True only when a
+    trace actually ran, so a cache hit re-lowered onto a fresh op
+    instance skips the trace AND the retrace accounting."""
+
+    def __init__(self, compile_fn):
+        self._compile = compile_fn
+        self._fn = None
+        self._exec = None  # AOT-compiled executable (mesh_trace phase)
+        self._exec_sig = None
+        self._traced_sigs = set()
+
+    def prepare(self, *args) -> bool:
+        if self._fn is None:
+            self._fn = self._compile(len(args))
+        sig = meshprof.arg_signature(*args)
+        if sig in self._traced_sigs:
+            return False
+        self._traced_sigs.add(sig)
+        try:
+            self._exec = self._fn.lower(*args).compile()
+            self._exec_sig = sig
+        except Exception:  # noqa: BLE001 - no AOT: trace at launch
+            self._exec = None
+            self._exec_sig = None
+        return True
+
+    def __call__(self, *args):
+        sig = meshprof.arg_signature(*args)
+        if self._exec is not None and self._exec_sig == sig:
+            return self._exec(*args)
+        return self._fn(*args)
+
+
 class MeshPipelineExec(PhysicalOp):
     """A filter/project chain over a multi-partition source, executed
     for ALL source partitions in one shard_map program (one partition
@@ -321,10 +357,28 @@ class MeshPipelineExec(PhysicalOp):
                 raise NotImplementedError(
                     f"mesh pipeline cannot shard {type(node).__name__}"
                 )
-        self._fn = None
-        self._exec = None  # AOT-compiled executable (mesh_trace phase)
-        self._exec_sig = None
-        self._traced_sigs = set()
+        # structurally-keyed program holder: a fresh lowering of the
+        # same chain on the same mesh reuses the traced program
+        from blaze_tpu.fleet.program_cache import (
+            PROGRAM_CACHE, mesh_cache_key,
+        )
+
+        src_schema = source.schema
+        cache_key = (
+            "mesh.pipeline",
+            tuple((f.name, repr(f.dtype), f.nullable)
+                  for f in src_schema.fields),
+            tuple((kind, repr(payload))
+                  for kind, payload, _ in self._stages),
+            self._axis,
+            mesh_cache_key(self.mesh),
+        )
+        self._prog = PROGRAM_CACHE.get_or_build(
+            cache_key,
+            lambda: _TracedProgram(
+                lambda nargs: self._compile(nargs - 1)
+            ),
+        )
         self._result = None
         # single-flight, named so wait:hold lands in the contention
         # report (obs/contention) when the collector is armed
@@ -415,31 +469,19 @@ class MeshPipelineExec(PhysicalOp):
                 )
                 st.add_bytes(sum(h.nbytes for h in host_cols))
             with st.phase("mesh_trace"):
-                if self._fn is None:
-                    self._fn = self._compile(len(stacked))
-                sig = meshprof.arg_signature(num_rows, *stacked)
-                if sig not in self._traced_sigs:
-                    self._traced_sigs.add(sig)
-                    try:
-                        self._exec = self._fn.lower(
-                            num_rows, *stacked
-                        ).compile()
-                        self._exec_sig = sig
-                    except Exception:  # noqa: BLE001 - no AOT: trace
-                        self._exec = None  # folds into mesh_launch
-                        self._exec_sig = None
+                if self._prog.prepare(num_rows, *stacked):
                     meshprof.note_trace(
-                        "mesh.pipeline", self._trace_key(sig)
+                        "mesh.pipeline",
+                        self._trace_key(meshprof.arg_signature(
+                            num_rows, *stacked
+                        )),
                     )
             t0 = time.monotonic()
             with st.phase("mesh_launch"):
                 mesh_chaos("mesh.pipeline", n_dev, ctx)
                 dispatch.record("dispatches")
                 dispatch.record("mesh_dispatches")
-                if self._exec is not None and self._exec_sig == sig:
-                    outs = self._exec(num_rows, *stacked)
-                else:
-                    outs = self._fn(num_rows, *stacked)
+                outs = self._prog(num_rows, *stacked)
             with st.phase("mesh_sync"):
                 outs = jax.block_until_ready(outs)
             with st.phase("mesh_gather"):
@@ -611,17 +653,37 @@ class MeshBroadcastJoinExec(PhysicalOp):
                 st.add_bytes(sum(h.nbytes for h in p_host))
             with st.phase("mesh_trace"):
                 if self._join is None:
-                    self._join = DistributedBroadcastJoin(
-                        self.mesh, probe.schema, build.schema,
-                        probe_key=ir.BoundCol(
-                            self.probe_key,
-                            probe.schema.fields[self.probe_key].dtype,
+                    from blaze_tpu.fleet.program_cache import (
+                        PROGRAM_CACHE, mesh_cache_key,
+                    )
+
+                    cache_key = (
+                        "mesh.broadcast_join",
+                        tuple((f.name, repr(f.dtype), f.nullable)
+                              for f in probe.schema.fields),
+                        tuple((f.name, repr(f.dtype), f.nullable)
+                              for f in build.schema.fields),
+                        self.probe_key, self.build_key, self._axis,
+                        mesh_cache_key(self.mesh),
+                    )
+                    self._join = PROGRAM_CACHE.get_or_build(
+                        cache_key,
+                        lambda: DistributedBroadcastJoin(
+                            self.mesh, probe.schema, build.schema,
+                            probe_key=ir.BoundCol(
+                                self.probe_key,
+                                probe.schema.fields[
+                                    self.probe_key
+                                ].dtype,
+                            ),
+                            build_key=ir.BoundCol(
+                                self.build_key,
+                                build.schema.fields[
+                                    self.build_key
+                                ].dtype,
+                            ),
+                            axis=self._axis,
                         ),
-                        build_key=ir.BoundCol(
-                            self.build_key,
-                            build.schema.fields[self.build_key].dtype,
-                        ),
-                        axis=self._axis,
                     )
                 if self._join.prepare(p_cols, p_rows, b_cols, b_rows):
                     meshprof.note_trace(
@@ -699,6 +761,367 @@ class MeshBroadcastJoinExec(PhysicalOp):
                 None, None,
             ))
         for arr, f in zip(probe_out, probe.schema.fields):
+            cols.append(Column(
+                f.dtype,
+                arr[partition][idx].astype(f.dtype.physical_dtype()),
+                None, None,
+            ))
+        yield ColumnBatch(self._schema, cols, len(idx))
+
+
+# ---------------------------------------------------------------------------
+# MeshSortExec: per-shard device sort, host run-merge (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class MeshSortExec(PhysicalOp):
+    """A global sort executed as N simultaneous per-shard device sorts
+    (one stable lexsort per device inside ONE shard_map program)
+    followed by a host k-way merge of the sorted runs - the expensive
+    O(n log n) comparisons happen on all devices at once, the host pays
+    only the linear merge. Single output partition (a sort is a global
+    ordering).
+
+    Gates (fall back otherwise): exactly one ascending key, a
+    non-nullable integer bound column, fixed-width non-nullable input
+    columns (stack_partitions' contract). Stability matches the
+    single-device oracle: ties keep earlier partitions first, and the
+    per-shard lexsort is stable within a partition.
+    """
+
+    def __init__(self, source: PhysicalOp, keys, fetch=None,
+                 mesh=None, fallback: Optional[PhysicalOp] = None):
+        self.fallback = fallback
+        self._use_fallback = False
+        self.children = [source]
+        self.mesh = mesh or get_mesh()
+        self._axis = "data"
+        self._schema = source.schema
+        self.fetch = fetch
+        if len(keys) != 1:
+            raise NotImplementedError(
+                "mesh sort takes exactly one key"
+            )
+        k = keys[0]
+        if not k.ascending or not isinstance(k.expr, ir.BoundCol):
+            raise NotImplementedError(
+                "mesh sort: single ascending bound column only"
+            )
+        f = source.schema.fields[k.expr.index]
+        if not f.dtype.is_integer:
+            raise NotImplementedError(
+                "mesh sort requires an integer key"
+            )
+        self.key_index = k.expr.index
+        from blaze_tpu.fleet.program_cache import (
+            PROGRAM_CACHE, mesh_cache_key,
+        )
+
+        cache_key = (
+            "mesh.sort",
+            tuple((fld.name, repr(fld.dtype), fld.nullable)
+                  for fld in self._schema.fields),
+            self.key_index, self._axis,
+            mesh_cache_key(self.mesh),
+        )
+        self._prog = PROGRAM_CACHE.get_or_build(
+            cache_key,
+            lambda: _TracedProgram(
+                lambda nargs: self._compile(nargs - 1)
+            ),
+        )
+        self._result = None
+        self._lock = obs_contention.TimedLock("mesh_sort")
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return (f"MeshSortExec[key={self.key_index}, "
+                f"{int(self.mesh.shape[self._axis])} devices]")
+
+    def _trace_key(self, sig) -> tuple:
+        return ("mesh.sort", self.key_index,
+                tuple(repr(f.dtype) for f in self._schema.fields), sig)
+
+    def _compile(self, ncols: int):
+        mesh, axis = self.mesh, self._axis
+        ki = self.key_index
+
+        def per_shard(num_rows_s, *cols_s):
+            cols = [c[0] for c in cols_s]
+            nr = num_rows_s[0]
+            cap = cols[0].shape[0]
+            dead = (jnp.arange(cap, dtype=jnp.int32) >= nr)
+            # stable: primary = liveness (dead rows sink), secondary =
+            # the key; ties keep input order within the shard
+            order = jnp.lexsort((cols[ki], dead))
+            return tuple(
+                jnp.take(c, order)[None] for c in cols
+            )
+
+        fn = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(axis),) + tuple(P(axis) for _ in range(ncols)),
+            out_specs=tuple([P(axis)] * ncols),
+        )
+        return jax.jit(fn)
+
+    @staticmethod
+    def _merge_runs(runs, key_index):
+        """Stable pairwise merge of per-shard sorted runs (earlier
+        shards win ties), vectorized with searchsorted."""
+        merged = None
+        for cols in runs:
+            if merged is None:
+                merged = [np.asarray(c) for c in cols]
+                continue
+            a_keys = merged[key_index]
+            b_keys = np.asarray(cols[key_index])
+            na, nb = len(a_keys), len(b_keys)
+            pos_a = np.arange(na) + np.searchsorted(
+                b_keys, a_keys, side="left"
+            )
+            pos_b = np.arange(nb) + np.searchsorted(
+                a_keys, b_keys, side="right"
+            )
+            out = []
+            for ac, bc in zip(merged, cols):
+                bc = np.asarray(bc)
+                m = np.empty(na + nb, dtype=ac.dtype)
+                m[pos_a] = ac
+                m[pos_b] = bc
+                out.append(m)
+            merged = out
+        return merged
+
+    def _run(self, ctx: ExecContext):
+        with self._lock:
+            if self._result is not None:
+                return self._result
+            source = self.children[0]
+            n_dev = int(self.mesh.shape[self._axis])
+            st = meshprof.stage(
+                "mesh.sort", n_dev,
+                lower_window=getattr(self, "_mesh_lower", None),
+            )
+            with st.phase("mesh_stage_in"):
+                stacked, num_rows, cap, total, host_cols = (
+                    stack_partitions(
+                        source, ctx, self.mesh, self._axis
+                    )
+                )
+                st.add_bytes(sum(h.nbytes for h in host_cols))
+            with st.phase("mesh_trace"):
+                if self._prog.prepare(num_rows, *stacked):
+                    meshprof.note_trace(
+                        "mesh.sort",
+                        self._trace_key(meshprof.arg_signature(
+                            num_rows, *stacked
+                        )),
+                    )
+            t0 = time.monotonic()
+            with st.phase("mesh_launch"):
+                mesh_chaos("mesh.sort", n_dev, ctx)
+                dispatch.record("dispatches")
+                dispatch.record("mesh_dispatches")
+                outs = self._prog(num_rows, *stacked)
+            with st.phase("mesh_sync"):
+                outs = jax.block_until_ready(outs)
+            with st.phase("mesh_gather"):
+                outs = dispatch.device_get(outs)
+                nr_host = np.asarray(num_rows)
+                runs = [
+                    [np.asarray(c)[d][: int(nr_host[d])]
+                     for c in outs]
+                    for d in range(n_dev)
+                    if int(nr_host[d]) > 0
+                ]
+                merged = (
+                    self._merge_runs(runs, self.key_index)
+                    if runs else None
+                )
+            t1 = st.finish()
+            record_mesh_run(
+                ctx, "mesh.sort", n_dev, t0, t1,
+                [{"rows_in": int(nr_host[d]),
+                  "rows_out": int(nr_host[d])}
+                 for d in range(n_dev)],
+                stage=st,
+            )
+            ctx.metrics.add("mesh.sort_rows", total)
+            self._result = (merged,)
+            return self._result
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        if self.fallback is not None and not self._use_fallback:
+            try:
+                self._run(ctx)
+            except Exception as e:  # noqa: BLE001 - ladder below
+                degrade_or_raise(self, ctx, e)
+        if self._use_fallback:
+            if partition < self.fallback.partition_count:
+                yield from self.fallback.execute(partition, ctx)
+            return
+        (merged,) = self._run(ctx)
+        if merged is None:
+            return
+        n = len(merged[0])
+        if self.fetch is not None:
+            n = min(n, int(self.fetch))
+        if n == 0:
+            return
+        cols: List[Column] = []
+        for arr, f in zip(merged, self._schema.fields):
+            cols.append(Column(
+                f.dtype, arr[:n].astype(f.dtype.physical_dtype()),
+                None, None,
+            ))
+        yield ColumnBatch(self._schema, cols, n)
+
+
+# ---------------------------------------------------------------------------
+# MeshRepartitionExec: hash repartition over ICI all_to_all (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class MeshRepartitionExec(PhysicalOp):
+    """The hash ShuffleExchange as one mesh program: every input
+    partition lands on a device, rows move to their key-hash owner with
+    one `lax.all_to_all` per column (parallel/sharded.
+    DistributedRepartition), and the mesh boundary yields one output
+    partition per device - key-disjoint, exactly the contract a
+    WindowExec's PARTITION BY needs. Schema passes through unchanged.
+    """
+
+    def __init__(self, child: PhysicalOp, keys, mesh=None,
+                 fallback: Optional[PhysicalOp] = None):
+        self.fallback = fallback
+        self._use_fallback = False
+        self.children = [child]
+        self.mesh = mesh or get_mesh()
+        self._axis = "data"
+        self._schema = child.schema
+        self.keys = list(keys)
+        from blaze_tpu.fleet.program_cache import (
+            PROGRAM_CACHE, mesh_cache_key,
+        )
+        from blaze_tpu.parallel.sharded import DistributedRepartition
+
+        cache_key = (
+            "mesh.repartition",
+            tuple((f.name, repr(f.dtype), f.nullable)
+                  for f in self._schema.fields),
+            tuple(repr(k) for k in self.keys),
+            self._axis,
+            mesh_cache_key(self.mesh),
+        )
+        self._rp = PROGRAM_CACHE.get_or_build(
+            cache_key,
+            lambda: DistributedRepartition(
+                self.mesh, self._schema, self.keys, axis=self._axis
+            ),
+        )
+        self._result = None
+        self._lock = obs_contention.TimedLock("mesh_repartition")
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def partition_count(self) -> int:
+        return int(self.mesh.shape[self._axis])
+
+    def describe(self) -> str:
+        return (f"MeshRepartitionExec[{len(self.keys)} keys, "
+                f"{self.partition_count} devices]")
+
+    def _trace_key(self, sig) -> tuple:
+        return ("mesh.repartition",
+                tuple(repr(k) for k in self._rp.keys), sig)
+
+    def _run(self, ctx: ExecContext):
+        with self._lock:
+            if self._result is not None:
+                return self._result
+            child = self.children[0]
+            n_dev = self.partition_count
+            st = meshprof.stage(
+                "mesh.repartition", n_dev,
+                lower_window=getattr(self, "_mesh_lower", None),
+            )
+            with st.phase("mesh_stage_in"):
+                stacked, num_rows, cap, total, host_cols = (
+                    stack_partitions(
+                        child, ctx, self.mesh, self._axis
+                    )
+                )
+                st.add_bytes(sum(h.nbytes for h in host_cols))
+            with st.phase("mesh_trace"):
+                if self._rp.prepare(stacked, num_rows):
+                    meshprof.note_trace(
+                        "mesh.repartition",
+                        self._trace_key(meshprof.arg_signature(
+                            *stacked, num_rows
+                        )),
+                    )
+            t0 = time.monotonic()
+            with st.phase("mesh_launch"):
+                mesh_chaos("mesh.repartition", n_dev, ctx)
+                dispatch.record("dispatches")
+                dispatch.record("mesh_dispatches")
+                out_cols, live = self._rp(stacked, num_rows)
+            with st.phase("mesh_sync"):
+                out_cols, live = jax.block_until_ready(
+                    (out_cols, live)
+                )
+            with st.phase("mesh_gather"):
+                out_cols, live = dispatch.device_get((out_cols, live))
+            t1 = st.finish()
+            live = np.asarray(live)
+            nbytes = total * sum(
+                np.dtype(f.dtype.physical_dtype()).itemsize
+                for f in self._schema.fields
+            )
+            record_exchange(ctx, "all_to_all", total, nbytes)
+            nr_host = np.asarray(num_rows)
+            record_mesh_run(
+                ctx, "mesh.repartition", n_dev, t0, t1,
+                [{"rows_in": int(nr_host[d]),
+                  "rows_out": int(live[d].sum())}
+                 for d in range(n_dev)],
+                stage=st,
+            )
+            ctx.metrics.add("mesh.repartition_rows", total)
+            self._result = (
+                [np.asarray(c) for c in out_cols], live
+            )
+            return self._result
+
+    def execute(self, partition: int, ctx: ExecContext
+                ) -> Iterator[ColumnBatch]:
+        if self.fallback is not None and not self._use_fallback:
+            try:
+                self._run(ctx)
+            except Exception as e:  # noqa: BLE001 - ladder below
+                degrade_or_raise(self, ctx, e)
+        if self._use_fallback:
+            if partition < self.fallback.partition_count:
+                yield from self.fallback.execute(partition, ctx)
+            return
+        out_cols, live = self._run(ctx)
+        idx = np.nonzero(live[partition])[0]
+        if len(idx) == 0:
+            return
+        cols: List[Column] = []
+        for arr, f in zip(out_cols, self._schema.fields):
             cols.append(Column(
                 f.dtype,
                 arr[partition][idx].astype(f.dtype.physical_dtype()),

@@ -91,11 +91,31 @@ class MeshGroupByExec(PhysicalOp):
                     )
                 )
         self._schema = Schema(key_fields + agg_fields)
-        self._gb = DistributedGroupBy(
-            self.mesh, in_schema,
-            keys=[e for e, _ in keys],
-            aggs=[DistAgg(a.fn, a.child) for a, _ in aggs],
-            filter_pred=filter_pred,
+        # program identity is structural (fleet/program_cache): a fresh
+        # lowering of the same plan shape on the same mesh reuses the
+        # already-traced DistributedGroupBy instead of re-paying the
+        # trace (prepare() sees a known signature -> no retrace)
+        from blaze_tpu.fleet.program_cache import (
+            PROGRAM_CACHE, mesh_cache_key,
+        )
+
+        cache_key = (
+            "mesh.groupby",
+            tuple((f.name, repr(f.dtype), f.nullable)
+                  for f in in_schema.fields),
+            tuple(repr(e) for e, _ in keys),
+            tuple((a.fn, repr(a.child)) for a, _ in aggs),
+            repr(filter_pred),
+            mesh_cache_key(self.mesh),
+        )
+        self._gb = PROGRAM_CACHE.get_or_build(
+            cache_key,
+            lambda: DistributedGroupBy(
+                self.mesh, in_schema,
+                keys=[e for e, _ in keys],
+                aggs=[DistAgg(a.fn, a.child) for a, _ in aggs],
+                filter_pred=filter_pred,
+            ),
         )
         self._result = None
         # single-flight: concurrent partition pulls (the parallel

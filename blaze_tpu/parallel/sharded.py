@@ -511,3 +511,121 @@ def _key_dtype(e: ir.Expr, schema: Schema) -> DataType:
             "string group keys use the file-shuffle tier"
         )
     return dt
+
+
+class DistributedRepartition:
+    """Hash repartition of whole rows over ICI: every live row moves to
+    the device its key hash owns with one `lax.all_to_all` per column -
+    the mesh-native form of the hash ShuffleExchange (what Spark plants
+    under a window's PARTITION BY), carrying the FULL row instead of
+    partial aggregate states. Same program-holder shape as
+    DistributedGroupBy (prepare() returns True only on a real trace),
+    so it plugs into the fingerprint-keyed program cache.
+
+    Output shards are [n_dev * cap] column stacks plus a live mask per
+    shard; the caller compacts live rows host-side at the mesh
+    boundary. Skew bound: a device receiving more than `cap` rows from
+    any single sender overflows its fixed bucket; callers size cap from
+    the stacked input (every sender holds <= cap live rows), which is
+    always sufficient because a sender contributes at most its own cap
+    to any one destination."""
+
+    def __init__(self, mesh: Mesh, schema: Schema,
+                 keys: Sequence[ir.Expr], axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.schema = schema
+        self.keys = [bind_opt(k, schema) for k in keys]
+        for k in self.keys:
+            _key_dtype(k, schema)  # raises for non-device-hashable keys
+        self._fn = None
+        self._exec = None
+        self._exec_sig = None
+        self._traced_sigs = set()
+
+    def _sig(self, stacked_cols, num_rows) -> Tuple:
+        return (
+            tuple((tuple(c.shape), str(c.dtype)) for c in stacked_cols),
+            (tuple(num_rows.shape), str(num_rows.dtype)),
+        )
+
+    def prepare(self, stacked_cols: Sequence[jax.Array],
+                num_rows: jax.Array) -> bool:
+        sig = self._sig(stacked_cols, num_rows)
+        if self._fn is None:
+            self._fn = self._compile()
+        if sig in self._traced_sigs:
+            return False
+        self._traced_sigs.add(sig)
+        try:
+            self._exec = self._fn.lower(
+                *stacked_cols, num_rows
+            ).compile()
+            self._exec_sig = sig
+        except Exception:  # noqa: BLE001 - AOT unsupported: trace at launch
+            self._exec = None
+            self._exec_sig = None
+        return True
+
+    def __call__(self, stacked_cols: Sequence[jax.Array],
+                 num_rows: jax.Array):
+        """stacked_cols: [n_dev, cap] per column; num_rows: [n_dev].
+        Returns (out_cols, live): out_cols are [n_dev, n_dev * cap]
+        stacks, live the matching row mask."""
+        if self._fn is None:
+            self._fn = self._compile()
+        if (self._exec is not None
+                and self._exec_sig == self._sig(stacked_cols, num_rows)):
+            return self._exec(*stacked_cols, num_rows)
+        return self._fn(*stacked_cols, num_rows)
+
+    def _compile(self):
+        mesh, axis = self.mesh, self.axis
+        n_dev = mesh.shape[axis]
+        schema = self.schema
+        keys = self.keys
+        n_cols = len(schema.fields)
+
+        def per_shard(num_rows_s, *cols_s):
+            cols = [c[0] for c in cols_s]
+            nr = num_rows_s[0]
+            cap = cols[0].shape[0]
+            live = jnp.arange(cap, dtype=jnp.int32) < nr
+            ev = DeviceEvaluator(
+                schema, [(c, None) for c in cols], cap
+            )
+            key_vals = [ev.evaluate(k)[0] for k in keys]
+            kcols = [
+                (v, None, _key_dtype(keys[i], schema))
+                for i, v in enumerate(key_vals)
+            ]
+            target = pmod(hash_columns_device(kcols, cap), n_dev)
+            exchanged = []
+            for arr in cols:
+                b = _bucketize(arr, target, live, n_dev, cap)
+                ex = lax.all_to_all(
+                    b[None], axis, split_axis=1, concat_axis=0
+                )
+                exchanged.append(ex.reshape(n_dev * cap))
+            lv = _bucket_live(target, live, n_dev, cap)
+            lx = lax.all_to_all(
+                lv[None], axis, split_axis=1, concat_axis=0
+            ).reshape(n_dev * cap)
+            return (
+                tuple(c[None, :] for c in exchanged) + (lx[None, :],)
+            )
+
+        fn = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(axis),) + tuple(P(axis) for _ in range(n_cols)),
+            out_specs=tuple([P(axis)] * (n_cols + 1)),
+        )
+
+        @jax.jit
+        def run(*args):
+            num_rows = args[-1]
+            cols = args[:-1]
+            outs = fn(num_rows, *cols)
+            return list(outs[:-1]), outs[-1]
+
+        return run

@@ -327,6 +327,12 @@ def _lower_plan_to_mesh(op: PhysicalOp, mode: Optional[str],
     new = _try_mesh_broadcast_join(op, mesh, min_rows)
     if new is not op:
         return new
+    new = _try_mesh_sort(op, mesh, min_rows)
+    if new is not op:
+        return new
+    new = _try_mesh_window(op, mesh, min_rows)
+    if new is not op:
+        return new
     return _try_mesh_pipeline(op, mesh, min_rows)
 
 
@@ -409,6 +415,77 @@ def _try_mesh_pipeline(node: PhysicalOp, mesh,
 
         return MeshPipelineExec(node, chain, source, mesh=m,
                                 fallback=node)
+    except (NotImplementedError, AssertionError):
+        return node
+
+
+def _try_mesh_sort(node: PhysicalOp, mesh,
+                   min_rows: int) -> PhysicalOp:
+    """A root SortExec (a GLOBAL ordering - insert_exchanges plants a
+    CoalescePartitions under it) runs as N simultaneous per-shard
+    device sorts + a host run-merge: single ascending integer bound-
+    column key, multi-partition source."""
+    if not isinstance(node, SortExec):
+        return node
+    if len(node.keys) != 1:
+        return node
+    k = node.keys[0]
+    if not k.ascending or not isinstance(k.expr, ir.BoundCol):
+        return node
+    source = node.children[0]
+    if isinstance(source, CoalescePartitionsExec):
+        source = source.children[0]
+    if source.partition_count < 2:
+        return node
+    if not source.schema.fields[k.expr.index].dtype.is_integer:
+        return node
+    if estimate_rows(source) < min_rows:
+        return node
+    m = _pick_mesh(source.partition_count, mesh)
+    if m is None or source.partition_count > int(m.shape["data"]):
+        return node
+    try:
+        from blaze_tpu.parallel.mesh_exec import MeshSortExec
+
+        return MeshSortExec(source, node.keys, fetch=node.fetch,
+                            mesh=m, fallback=node)
+    except (NotImplementedError, AssertionError):
+        return node
+
+
+def _try_mesh_window(node: PhysicalOp, mesh,
+                     min_rows: int) -> PhysicalOp:
+    """A root WindowExec over the hash exchange insert_exchanges
+    plants on its PARTITION BY keeps its (device-based) frame
+    computation and swaps the exchange for a mesh hash repartition:
+    rows reach their key-hash owner over ICI all_to_all instead of the
+    file fabric, and the window computes each key-disjoint partition
+    whole. Root-only: the partition-count change is safe at the true
+    root."""
+    if not isinstance(node, WindowExec):
+        return node
+    ex = node.children[0]
+    if not isinstance(ex, ShuffleExchangeExec) or ex.mode != "hash":
+        return node
+    if not ex.keys or not all(
+        isinstance(e, ir.BoundCol) for e in ex.keys
+    ):
+        return node
+    source = ex.children[0]
+    if source.partition_count < 2:
+        return node
+    if estimate_rows(source) < min_rows:
+        return node
+    m = _pick_mesh(source.partition_count, mesh)
+    if m is None or source.partition_count > int(m.shape["data"]):
+        return node
+    try:
+        from blaze_tpu.parallel.mesh_exec import MeshRepartitionExec
+
+        node.children[0] = MeshRepartitionExec(
+            source, ex.keys, mesh=m, fallback=ex,
+        )
+        return node
     except (NotImplementedError, AssertionError):
         return node
 
@@ -556,3 +633,95 @@ def _fix_global_limit(root: PhysicalOp) -> PhysicalOp:
         if root.children[0].partition_count > 1:
             root.children[0] = CoalescePartitionsExec(root.children[0])
     return root
+
+
+# ---------------------------------------------------------------------------
+# fleet tier (ISSUE 20): hybrid ICI x DCN lowering
+# ---------------------------------------------------------------------------
+
+
+def _bound_index(e, schema) -> Optional[int]:
+    if isinstance(e, ir.BoundCol):
+        return int(e.index)
+    if isinstance(e, ir.Col):
+        try:
+            return int(schema.index_of(e.name))
+        except (KeyError, ValueError):
+            return None
+    return None
+
+
+def lower_plan_to_fleet(op: PhysicalOp, fleet, mode: Optional[str] = None,
+                        mesh=None, ctx=None) -> PhysicalOp:
+    """The fleet mesh tier's planner pass: split an eligible grouped
+    aggregate across the fleet's hosts (fleet/exec.FleetMeshExec) -
+    per-host ICI partial stages joined by DCN key-hash exchanges -
+    falling through to the single-host mesh pass for everything else.
+
+    Eligibility is STRICTER than the single-host mesh tier: the
+    partial states cross hosts finalized, so only aggregates whose
+    finalized form merges losslessly ship (SUM/COUNT/COUNT_STAR by
+    SUM, MIN/MAX by themselves). AVG stays single-host - a merge of
+    finalized averages loses the weights. Keys must be plain columns
+    (the DCN bucket hash runs host-side over fixed-width arrays) and
+    the same COMPLETE-over-multi-partition semantics guard as
+    _try_mesh_groupby applies.
+
+    The fallback chain IS the failure ladder: the FleetMeshExec's
+    fallback is this same plan's single-host mesh lowering (coalesced
+    when wider than the fleet, so a degraded run loses no partitions),
+    which itself falls back to single-device."""
+    import time as _time
+
+    from blaze_tpu.exprs.ir import AggFn
+
+    mode = mode if mode is not None else resolve_mesh_mode(ctx)
+
+    def single() -> PhysicalOp:
+        return lower_plan_to_mesh(op, mode, mesh=mesh, ctx=ctx)
+
+    if mode == "off" or fleet is None or fleet.width() < 2:
+        return single()
+    _t0 = _time.monotonic()
+    shapes = _match_agg_shape(op)
+    if shapes is None:
+        return single()
+    child, keys, aggs = shapes
+    if op.mode is AggMode.COMPLETE and child.partition_count > 1:
+        # per-partition grouping semantics (see _try_mesh_groupby)
+        return single()
+    fleet_fns = {AggFn.SUM, AggFn.COUNT, AggFn.COUNT_STAR,
+                 AggFn.MIN, AggFn.MAX}
+    if any(a.fn not in fleet_fns for a, _ in aggs):
+        return single()
+    min_rows = _mesh_min_rows(mode)
+    if min_rows and estimate_rows(child) < min_rows:
+        return single()  # cost guard: two DCN rounds would dominate
+    kspec = []
+    for e, name in keys:
+        idx = _bound_index(e, child.schema)
+        if idx is None \
+                or child.schema.fields[idx].dtype.is_string_like:
+            return single()
+        kspec.append((idx, name))
+    aspec = []
+    for a, name in aggs:
+        if a.child is None:
+            aspec.append((a.fn.value, None, name))
+            continue
+        idx = _bound_index(a.child, child.schema)
+        if idx is None:
+            return single()
+        aspec.append((a.fn.value, idx, name))
+    fb = single()
+    if fb.partition_count > fleet.width():
+        # consumers pull fleet.width() partitions; a wider fallback
+        # would silently lose the partitions past the fleet width
+        fb = CoalescePartitionsExec(fb)
+    from blaze_tpu.fleet.exec import FleetMeshExec
+
+    new = FleetMeshExec(child, kspec, aspec, fleet=fleet,
+                        schema=op.schema, fallback=fb,
+                        mesh_mode=mode if mode else "auto")
+    new._mesh_lower = (_t0, _time.monotonic())
+    return new

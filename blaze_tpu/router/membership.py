@@ -55,12 +55,16 @@ class MembershipAnnouncer:
         advertise,
         interval_s: float = 2.0,
         timeout_s: float = 5.0,
+        devices: Optional[int] = None,
     ):
         self.router_host, self.router_port = parse_replica(router_spec)
         self.host, self.port = parse_replica(advertise)
         self.replica_id = f"{self.host}:{self.port}"
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
+        # advertised accelerator count: sizes this replica's share of
+        # the router's fleet-mesh device ledger (None = advertise 1)
+        self.devices = max(1, int(devices)) if devices else 1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._left = False
@@ -115,6 +119,7 @@ class MembershipAnnouncer:
         try:
             resp = self._member({
                 "op": "join", "host": self.host, "port": self.port,
+                "devices": self.devices,
             })
         except Exception as e:  # noqa: BLE001 - the loop is the retry
             self.join_failures += 1

@@ -275,6 +275,17 @@ class Router:
         # retry-budget windows: tenant -> deque of spend timestamps
         self._tenant_retries: Dict[str, collections.deque] = {}
         self._tenant_counters: Dict[str, Dict[str, int]] = {}
+        # ---- fleet mesh device ledger -------------------------------
+        # the claim authority for cross-host mesh stages (ISSUE 20):
+        # a fleet query reserves devices ACROSS hosts before its first
+        # DCN round, composing with the same tenant_config the
+        # admission budgets read (`max_fleet_devices` cap key). The
+        # pool size rides membership: JOINs advertise device counts,
+        # joins/leaves resize. Claims arrive over MESH_EXCHANGE.
+        from blaze_tpu.fleet.claims import FleetDeviceLedger
+
+        self._fleet_ledger = FleetDeviceLedger(0, self.tenant_config)
+        self._fleet_resize()  # static fleets never JOIN
         # fleet-wide relay-window memory: bytes currently parked in
         # the bounded per-stream relay queues of _raw_fetch_windowed,
         # summed across concurrent streams (the
@@ -1376,15 +1387,66 @@ class Router:
             # retries next tick); STALL = a slow membership authority
             chaos.fire("router.membership", op=op, replica=rid)
         if op == "join":
-            return self._member_join(host, port)
+            return self._member_join(
+                host, port, devices=payload.get("devices")
+            )
         if op == "leave":
             return self._member_leave(
                 rid, str(payload.get("reason") or "leave")
             )
         return {"error": f"membership: unknown op {op!r}"}
 
-    def _member_join(self, host: str, port: int) -> dict:
+    def _fleet_resize(self) -> None:
+        """Re-derive the fleet device pool from live membership (the
+        ledger's total rides JOIN/LEAVE; outstanding claims keep
+        their grants across a shrink)."""
+        self._fleet_ledger.resize(sum(
+            getattr(r, "devices", 1)
+            for r in self.registry.replicas.values()
+            if not r.departed
+        ))
+
+    def mesh_exchange(self, payload: dict) -> dict:
+        """Router-tier MESH_EXCHANGE ops: the fleet device claim plane
+        (fleet/claims). Stage shipping (`run_stage`) is serve-tier
+        only - hosts exchange stage data peer-to-peer, the router only
+        arbitrates devices. Denials reuse the admission wire shapes
+        (REJECTED_TENANT_BUDGET / DRAINING under REJECTED_OVERLOADED)
+        and never touch the breaker."""
+        from blaze_tpu.fleet.claims import FleetClaimDenied
+
+        op = str(payload.get("op", ""))
+        if op == "claim":
+            try:
+                token = self._fleet_ledger.claim(
+                    str(payload.get("tenant") or "default"),
+                    int(payload.get("devices", 1)),
+                    timeout_s=float(payload.get("timeout_s", 0.0)),
+                )
+            except FleetClaimDenied as e:
+                return {"error": str(e),
+                        "state": "REJECTED_OVERLOADED"}
+            return {"ok": True, "token": token}
+        if op == "release":
+            return {
+                "ok": True,
+                "released": self._fleet_ledger.release(
+                    str(payload.get("token", ""))
+                ),
+            }
+        if op == "stats":
+            return {"ok": True, "fleet": self._fleet_ledger.stats()}
+        return {"error": f"mesh_exchange: unknown router op {op!r}"}
+
+    def _member_join(self, host: str, port: int,
+                     devices=None) -> dict:
         r, created = self.registry.add((host, port))
+        if devices is not None:
+            try:
+                r.devices = max(1, int(devices))
+            except (TypeError, ValueError):
+                pass
+        self._fleet_resize()
         rid = r.replica_id
         self._client_cv.setdefault(
             rid,
@@ -1416,6 +1478,7 @@ class Router:
             # LEAVE of an unknown (or already-left) replica: ack -
             # the desired end state already holds
             return {"ok": True, "replica": rid, "known": False}
+        self._fleet_resize()
         self._evict_and_promote(rid)
         # drop the pooled verb clients: the address may be reused by
         # a restarted replica that must start on fresh connections
@@ -2677,6 +2740,11 @@ class RouterVerbBackend:
 
     def member_frame(self, payload: dict) -> dict:
         return self.router.membership(payload)
+
+    def mesh_exchange_frame(self, payload: dict, parts: list):
+        # claim plane only: the router never carries stage data (the
+        # input parts were drained by the wire layer and are ignored)
+        return self.router.mesh_exchange(payload), []
 
     def profile_frame(self, payload: dict) -> dict:
         from blaze_tpu.service.wire import handle_profile_frame

@@ -63,6 +63,9 @@ class Replica:
         self.quarantine_reason: Optional[str] = None
         self.poll_failures = 0      # consecutive
         self.in_flight = 0          # router-tracked live routed queries
+        # advertised accelerator count (JOIN payload "devices"): the
+        # fleet mesh ledger sizes the claimable device pool from these
+        self.devices = 1
         # DRAINING (rolling restart): announced through the replica's
         # STATS `service.draining` flag, or observed directly from a
         # DRAINING submit rejection - either way NEW placements stop

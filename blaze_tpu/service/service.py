@@ -88,6 +88,9 @@ class QueryService:
         arena_bytes: int = 0,
         arena_dir: Optional[str] = None,
         tenant_config: Optional[dict] = None,
+        fleet_peers: Optional[list] = None,
+        fleet_router=None,
+        fleet_devices: Optional[int] = None,
     ):
         # multi-tenant isolation (docs/SERVICE.md "Tenancy"):
         # per-tenant admission budgets + weighted-fair ordering live
@@ -117,6 +120,21 @@ class QueryService:
                 f"mesh_mode must be auto|on|off, got {mesh_mode!r}"
             )
         self.mesh_mode = mesh_mode
+        # fleet mesh tier (fleet/, docs/MESH.md "Fleet tier"): with
+        # peers configured, eligible driver plans lower across the
+        # fleet (per-host ICI stages joined by DCN exchanges) instead
+        # of this host's mesh alone. Claims route through fleet_router
+        # when set (the membership/claim authority), else a local
+        # ledger over this host's share. None = single-host behavior
+        # byte-identical.
+        self._fleet = None
+        if fleet_peers:
+            from blaze_tpu.fleet.exec import FleetContext
+
+            self._fleet = FleetContext(
+                fleet_peers, devices=fleet_devices,
+                router=fleet_router, tenant_config=tenant_config,
+            )
         self.cache = (
             cache if cache is not None
             else (ResultCache() if enable_cache else None)
@@ -504,6 +522,9 @@ class QueryService:
             q.ctx.tracer = q.tracer
         if self.mesh_mode is not None:
             q.ctx.mesh_mode = self.mesh_mode
+        # fleet claims are per-tenant (fleet/claims): the coordinator
+        # reads the identity off the ExecContext
+        q.ctx.tenant = q.tenant
         if self.stream_buffer_bytes > 0:
             from blaze_tpu.service.stream import StreamBuffer
 
@@ -1250,10 +1271,19 @@ class QueryService:
                 # partition) over the LOWERED geometry, and the mode
                 # is fixed for the process lifetime
                 from blaze_tpu.planner.distribute import (
+                    lower_plan_to_fleet,
                     lower_plan_to_mesh,
                 )
 
-                op = lower_plan_to_mesh(op, mode=self.mesh_mode)
+                if self._fleet is not None:
+                    # fleet tier first: eligible grouped aggregates
+                    # span the whole fleet; everything else falls
+                    # through to the single-host pass inside
+                    op = lower_plan_to_fleet(
+                        op, self._fleet, mode=self.mesh_mode
+                    )
+                else:
+                    op = lower_plan_to_mesh(op, mode=self.mesh_mode)
             partitions = list(range(op.partition_count))
             exec_op = op  # driver plans run as-built (run_plan parity)
         else:
@@ -1349,6 +1379,11 @@ class QueryService:
                     ev.set()
                 out.extend(part_batches)
                 break
+        if getattr(q.ctx, "fleet_degraded", False):
+            # the fleet coordinator fell down its ladder (dead peer,
+            # denied claim, injected fault): the answer is correct
+            # but single-host-produced - q.degraded must say so
+            q.degraded = True
         return out
 
     def _run_partition(self, q: Query, op, partition: int):

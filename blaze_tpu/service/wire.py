@@ -132,18 +132,24 @@ VERB_METRICS = 7
 VERB_MEMBER = 8
 VERB_PROFILE = 9
 VERB_RELEASE = 10
+VERB_MESH_EXCHANGE = 11
 
 VERB_NAMES = {
     VERB_SUBMIT: "submit", VERB_POLL: "poll", VERB_FETCH: "fetch",
     VERB_CANCEL: "cancel", VERB_REPORT: "report", VERB_STATS: "stats",
     VERB_METRICS: "metrics", VERB_MEMBER: "member",
     VERB_PROFILE: "profile", VERB_RELEASE: "release",
+    VERB_MESH_EXCHANGE: "mesh_exchange",
 }
 
 MAX_META_BYTES = 1 << 20
 # response JSON frames may carry a whole trace document (REPORT);
 # request-side frames keep the tighter MAX_META_BYTES bound
 MAX_JSON_BYTES = 8 << 20
+# MESH_EXCHANGE part frames carry whole stage boundaries (encoded
+# Arrow-IPC segments); bound each frame the same way MAX_TASK_BYTES
+# bounds a submitted plan
+MAX_EXCHANGE_PART_BYTES = 256 << 20
 
 
 class ServiceError(RuntimeError):
@@ -320,6 +326,35 @@ def serve_verb_connection(sock, backend) -> None:
                     resp = backend.profile_frame(payload)
                     t2 = time.perf_counter()
                     _send_json(sock, resp)
+                elif verb == VERB_MESH_EXCHANGE:
+                    # fleet DCN plane: u32 JSON control frame + u64
+                    # framed Arrow-IPC parts, zero-terminated. The
+                    # parts are drained BEFORE dispatch no matter
+                    # what the op is, so a handler error leaves the
+                    # connection in sync (in-band error JSON, no
+                    # part stream follows it)
+                    payload = json.loads(_read_str(sock) or "{}")
+                    parts: List[bytes] = []
+                    while True:
+                        (plen,) = _U64.unpack(
+                            _recv_exact(sock, _U64.size)
+                        )
+                        if plen == 0:
+                            break
+                        if plen > MAX_EXCHANGE_PART_BYTES:
+                            raise ValueError(
+                                "oversized exchange part"
+                            )
+                        parts.append(_recv_exact(sock, plen))
+                    t1 = time.perf_counter()
+                    resp, out_parts = backend.mesh_exchange_frame(
+                        payload, parts
+                    )
+                    t2 = time.perf_counter()
+                    _send_json(sock, resp)
+                    for p in out_parts:
+                        sock.sendall(_U64.pack(len(p)) + p)
+                    sock.sendall(_U64.pack(0))
                 elif verb in _NOARG_VERBS:
                     _read_u32(sock)
                     t1 = time.perf_counter()
@@ -511,6 +546,14 @@ class ServiceVerbBackend:
         # a single serve instance is not a membership authority - the
         # router tier (router/proxy.RouterVerbBackend) owns the fleet
         return {"error": "membership: this endpoint is not a router"}
+
+    def mesh_exchange_frame(self, payload: dict, parts: list):
+        """Fleet mesh DCN plane (fleet/exchange.py): a peer host's
+        stage request - run a mesh stage over shipped partitions,
+        answer with the stage's output segments."""
+        from blaze_tpu.fleet.exchange import handle_mesh_exchange
+
+        return handle_mesh_exchange(self.service, payload, parts)
 
     def profile_frame(self, payload: dict) -> dict:
         return handle_profile_frame(self.tier, payload)
@@ -1187,6 +1230,38 @@ class ServiceClient:
         return self._roundtrip(
             bytes([VERB_MEMBER]) + _U32.pack(len(data)) + data
         )
+
+    def mesh_exchange(self, payload: dict, parts=()) -> tuple:
+        """One MESH_EXCHANGE round trip (the fleet tier's DCN plane):
+        a JSON control frame plus u64-framed encoded Arrow-IPC parts
+        each way. Returns (response_dict, out_parts). An in-band
+        error response carries NO part stream (the server drained our
+        parts before dispatch, so the connection stays in sync). The
+        send + JSON read ride the standard one-reconnect retry; a
+        drop mid part-stream propagates to the caller (the fleet
+        executor's degrade ladder owns that)."""
+        from blaze_tpu.runtime.transport import _recv_exact
+
+        data = json.dumps(payload).encode("utf-8")
+        buf = bytearray(
+            bytes([VERB_MESH_EXCHANGE]) + _U32.pack(len(data)) + data
+        )
+        for p in parts:
+            buf += _U64.pack(len(p))
+            buf += p
+        buf += _U64.pack(0)
+        resp = self._roundtrip(bytes(buf))
+        if "error" in resp:
+            return resp, []
+        out: List[bytes] = []
+        while True:
+            (n,) = _U64.unpack(_recv_exact(self._sock, _U64.size))
+            if n == 0:
+                break
+            if n > MAX_EXCHANGE_PART_BYTES:
+                raise ValueError("oversized exchange part")
+            out.append(_recv_exact(self._sock, n))
+        return resp, out
 
     def profile(self, payload: Optional[dict] = None) -> dict:
         """One PROFILE round trip: {"op": "start"|"stop"|"snapshot"|

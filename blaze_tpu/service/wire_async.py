@@ -266,6 +266,37 @@ async def serve_verb_connection_async(reader, writer, backend,
                     )
                     t2 = time.perf_counter()
                     await _send_json(writer, resp)
+                elif verb == wire.VERB_MESH_EXCHANGE:
+                    # fleet DCN plane: mirror of the blocking branch -
+                    # drain the framed input parts BEFORE dispatch so
+                    # a handler error leaves the connection in sync
+                    payload = json.loads(
+                        await _read_str(reader) or "{}"
+                    )
+                    parts: List[bytes] = []
+                    while True:
+                        (plen,) = _U64.unpack(
+                            await _read_exact(reader, _U64.size)
+                        )
+                        if plen == 0:
+                            break
+                        if plen > wire.MAX_EXCHANGE_PART_BYTES:
+                            raise ValueError(
+                                "oversized exchange part"
+                            )
+                        parts.append(await _read_exact(reader, plen))
+                    t1 = time.perf_counter()
+                    resp, out_parts = await loop.run_in_executor(
+                        pool, partial(backend.mesh_exchange_frame,
+                                      payload, parts)
+                    )
+                    t2 = time.perf_counter()
+                    await _send_json(writer, resp)
+                    for p in out_parts:
+                        writer.write(_U64.pack(len(p)) + p)
+                        await writer.drain()
+                    writer.write(_U64.pack(0))
+                    await writer.drain()
                 elif verb in wire._NOARG_VERBS:
                     await _read_u32(reader)
                     t1 = time.perf_counter()

@@ -307,6 +307,36 @@ def mesh_smoke() -> bool:
     )
 
 
+def fleet_smoke() -> bool:
+    """Fleet mesh tier suite (ISSUE 20): the 2-emulated-host
+    differential battery, the `fleet.exchange` chaos degrade ladder,
+    the SIGKILL-mid-stage failover, and the device-claim plane
+    (tenant budgets / DRAINING-shaped capacity denials / waiter
+    wake). Same 8-device forcing and shard_map skip as the mesh
+    suite."""
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "try:\n"
+         "    from jax import shard_map\n"
+         "except ImportError:\n"
+         "    from jax.experimental.shard_map import shard_map\n"],
+        capture_output=True, text=True, env=_env(),
+    )
+    if probe.returncode != 0:
+        print("[SKIP] fleet suite (jax lacks shard_map)", flush=True)
+        return True
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return run(
+        "fleet suite",
+        ["tests/test_fleet_mesh.py"],
+        extra_env={"XLA_FLAGS": flags},
+    )
+
+
 def _bench_phase_rounds():
     """BENCH_r*.json artifacts (round order) that carry a per-phase
     rollup snapshot - the inline mirror of obs/phases.phases_from_bench
@@ -561,6 +591,11 @@ def main():
                          "membership, graceful drain, hot-result "
                          "replication, and the rolling-restart "
                          "subprocess e2e")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mesh tier suite only: 2-emulated-"
+                         "host differentials, fleet.exchange chaos "
+                         "ladder, SIGKILL failover, and the device-"
+                         "claim plane")
     ap.add_argument("--tenancy", action="store_true",
                     help="multi-tenant isolation suite only: "
                          "weighted-fair admission, tenant budgets, "
@@ -609,6 +644,12 @@ def main():
               f"in {time.time() - t0:.0f}s", flush=True)
         return 0 if ok else 1
 
+    if args.fleet:
+        ok &= fleet_smoke()
+        print(f"\n{'PASS' if ok else 'FAIL'} (fleet) "
+              f"in {time.time() - t0:.0f}s", flush=True)
+        return 0 if ok else 1
+
     if args.tenancy:
         ok &= tenancy_smoke()
         print(f"\n{'PASS' if ok else 'FAIL'} (tenancy) "
@@ -638,6 +679,7 @@ def main():
         ok &= obs_smoke()
         ok &= profile_smoke()
         ok &= mesh_smoke()
+        ok &= fleet_smoke()
         ok &= regress_smoke()
         ok &= bench_regress_smoke()
         ok &= meshattr_regress_smoke()

@@ -1,0 +1,367 @@
+"""Fleet mesh tier (ISSUE 20): hybrid ICI x DCN multi-host execution.
+
+Two emulated hosts (two QueryService instances in one process, the
+peer behind a real TaskGatewayServer wire listener) run a grouped-agg
+sandwich fleet-wide; the result must be Arrow-byte-equal (after
+canonical ordering) to the single-host mesh and mesh-off oracles.
+The `fleet.exchange` chaos seam degrades fleet -> single-host mesh
+with zero client-visible failures and `q.degraded` accurate; a
+SIGKILLed peer mid-stage takes the same ladder. The device-claim
+plane (fleet/claims + the router arbiter) is pinned separately:
+per-tenant budgets, DRAINING-shaped capacity denials that never touch
+the breaker, and released claims waking waiters.
+
+Runs under the repo conftest's forced 8-device virtual CPU mesh.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.fleet.claims import FleetClaimDenied, FleetDeviceLedger
+from blaze_tpu.fleet.exec import FleetContext, FleetMeshExec
+from blaze_tpu.obs.metrics import REGISTRY
+from blaze_tpu.planner.distribute import (
+    lower_plan_to_fleet,
+    lower_plan_to_mesh,
+)
+from blaze_tpu.runtime.executor import run_plan
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+from blaze_tpu.service import QueryService
+from blaze_tpu.testing import chaos
+from tests.test_mesh_exec import REPO, agg_plan, sandwich, scan
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _canonical_bytes(table: pa.Table) -> bytes:
+    df = table.to_pandas().sort_values("k").reset_index(drop=True)
+    tbl = pa.Table.from_pandas(df, preserve_index=False) \
+        .combine_chunks()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, tbl.schema) as w:
+        w.write_table(tbl)
+    return sink.getvalue().to_pybytes()
+
+
+def _fleet_pair(**coord_kw):
+    """(peer service, gateway, coordinator-with-fleet) context tuple.
+    Caller closes in reverse order."""
+    peer = QueryService(enable_cache=False, enable_trace=False,
+                       mesh_mode="on")
+    srv = TaskGatewayServer(service=peer)
+    srv.__enter__()
+    host, port = srv.address
+    coord = QueryService(enable_cache=False, enable_trace=False,
+                         mesh_mode="on",
+                         fleet_peers=[f"{host}:{port}"], **coord_kw)
+    return peer, srv, coord
+
+
+def _close_pair(peer, srv, coord):
+    coord.close()
+    srv.__exit__(None, None, None)
+    peer.close()
+
+
+def _run_query(svc, plan, **kw):
+    q = svc.submit_plan(plan, **kw)
+    batches = svc.result(q.query_id, timeout=120)
+    return q, pa.Table.from_batches(batches)
+
+
+# ---------------------------------------------------------------------------
+# planner pass
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_lowering_requires_width():
+    """No fleet / single-host fleet -> the plan takes the ordinary
+    single-host mesh path, not the DCN tier."""
+    sw = sandwich(scan())
+    got = lower_plan_to_fleet(sw, None, mode="on")
+    assert not isinstance(got, FleetMeshExec)
+    one = FleetContext([])  # width 1: just this host
+    got = lower_plan_to_fleet(sandwich(scan()), one, mode="on")
+    assert not isinstance(got, FleetMeshExec)
+
+
+def test_fleet_lowering_two_hosts():
+    fleet = FleetContext([("127.0.0.1", 1)])  # never dialed
+    got = lower_plan_to_fleet(sandwich(scan()), fleet, mode="on")
+    assert isinstance(got, FleetMeshExec)
+    assert got.partition_count == fleet.width() == 2
+    # degrade safety: the fallback can never be wider than the fleet
+    # (the service pre-computes partitions from the PRE-degrade count)
+    assert got.fallback.partition_count <= fleet.width()
+
+
+def test_fleet_lowering_avg_stays_single_host():
+    """AVG merge of finalized per-host averages loses weights; the
+    fleet pass must refuse and leave it to the single-host mesh."""
+    from blaze_tpu.exprs import AggExpr, AggFn, Col
+    from blaze_tpu.ops import AggMode, HashAggregateExec
+    from blaze_tpu.planner.distribute import insert_exchanges
+    import tempfile
+
+    plan = insert_exchanges(
+        HashAggregateExec(
+            scan(), keys=[(Col("k"), "k")],
+            aggs=[(AggExpr(AggFn.AVG, Col("v")), "a")],
+            mode=AggMode.COMPLETE,
+        ), 4, shuffle_dir=tempfile.mkdtemp())
+    fleet = FleetContext([("127.0.0.1", 1)])
+    got = lower_plan_to_fleet(plan, fleet, mode="on")
+    assert not isinstance(got, FleetMeshExec)
+
+
+def test_fleet_lowering_off_mode_untouched():
+    sw = sandwich(scan())
+    fleet = FleetContext([("127.0.0.1", 1)])
+    assert lower_plan_to_fleet(sw, fleet, mode="off") is sw
+
+
+# ---------------------------------------------------------------------------
+# two emulated hosts: differential battery
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_two_host_groupby_byte_equal_to_oracles():
+    """The acceptance differential: grouped-agg executed fleet-wide
+    across 2 emulated hosts is Arrow-byte-equal (canonical order) to
+    BOTH the single-host mesh result and the mesh-off oracle."""
+    oracle_off = run_plan(sandwich(scan()))
+    oracle_mesh = run_plan(lower_plan_to_mesh(sandwich(scan()),
+                                              mode="on"))
+    peer, srv, coord = _fleet_pair()
+    try:
+        q, got = _run_query(coord, sandwich(scan()))
+        assert q.error is None
+        assert not q.degraded
+        m = q.ctx.metrics.counters
+        assert m.get("fleet.hosts") == 2
+        assert m.get("fleet.exchange.dcn_bytes", 0) > 0
+        assert m.get("dispatch.fleet_dispatches") == 1
+        assert _canonical_bytes(got) == _canonical_bytes(oracle_off)
+        assert _canonical_bytes(got) == _canonical_bytes(oracle_mesh)
+    finally:
+        _close_pair(peer, srv, coord)
+
+
+def test_fleet_two_host_empty_partitions():
+    """Empty source partitions survive the DCN round trip (empty
+    segments never ship; bucket boundaries ride the reply JSON)."""
+    oracle = run_plan(sandwich(scan(empty=(0, 2))))
+    peer, srv, coord = _fleet_pair()
+    try:
+        q, got = _run_query(coord, sandwich(scan(empty=(0, 2))))
+        assert not q.degraded
+        assert _canonical_bytes(got) == _canonical_bytes(oracle)
+    finally:
+        _close_pair(peer, srv, coord)
+
+
+def test_fleet_chaos_exchange_degrades_with_zero_client_failures():
+    """A DCN fault at the `fleet.exchange` seam walks the ladder:
+    fleet -> single-host mesh, zero client-visible failures, and
+    `q.degraded` reports it."""
+    oracle = run_plan(sandwich(scan()))
+    base = REGISTRY.get("blaze_fleet_degraded_total")
+    peer, srv, coord = _fleet_pair()
+    try:
+        with chaos.active(
+            [chaos.Fault(site="fleet.exchange", klass="DROP",
+                         times=1)],
+            seed=7,
+        ):
+            q, got = _run_query(coord, sandwich(scan()))
+        assert q.error is None          # zero client-visible failures
+        assert q.degraded               # ...but the degrade is visible
+        assert q.ctx.metrics.counters.get("fleet.degraded") == 1
+        assert REGISTRY.get("blaze_fleet_degraded_total") == base + 1
+        assert _canonical_bytes(got) == _canonical_bytes(oracle)
+    finally:
+        _close_pair(peer, srv, coord)
+
+
+_PEER_SCRIPT = r"""
+import sys, time
+from blaze_tpu.service import QueryService
+from blaze_tpu.runtime.gateway import TaskGatewayServer
+
+svc = QueryService(enable_cache=False, enable_trace=False,
+                   mesh_mode="on")
+srv = TaskGatewayServer(service=svc).__enter__()
+print("PORT %d" % srv.address[1], flush=True)
+time.sleep(600)
+"""
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_peer_mid_stage_completes(monkeypatch):
+    """SIGKILL one host mid-mesh-stage (after the device claim,
+    before the DCN round): the query completes through failover with
+    the full result delivered and `q.degraded` accurate."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PEER_SCRIPT], env=env,
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        oracle = run_plan(sandwich(scan()))
+        # hold the coordinator between claim and first DCN call so
+        # the SIGKILL lands deterministically mid-stage
+        monkeypatch.setenv("BLAZE_FLEET_TEST_DELAY_S", "1.0")
+        with QueryService(enable_cache=False, enable_trace=False,
+                          mesh_mode="on",
+                          fleet_peers=[f"127.0.0.1:{port}"]) as coord:
+            killer = threading.Timer(
+                0.3, lambda: proc.send_signal(signal.SIGKILL))
+            killer.start()
+            try:
+                q, got = _run_query(coord, sandwich(scan()))
+            finally:
+                killer.cancel()
+        assert q.error is None
+        assert q.degraded
+        assert _canonical_bytes(got) == _canonical_bytes(oracle)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# device-claim plane
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_tenant_budget_denial_immediate():
+    led = FleetDeviceLedger(
+        8, {"acme": {"max_fleet_devices": 4}})
+    t = led.claim("acme", 4)
+    with pytest.raises(FleetClaimDenied) as ei:
+        led.claim("acme", 1)
+    assert str(ei.value).startswith("REJECTED_TENANT_BUDGET:")
+    # another tenant is unaffected by acme's cap
+    t2 = led.claim("other", 4)
+    led.release(t)
+    led.release(t2)
+    assert led.stats()["claimed_devices"] == 0
+    assert led.stats()["denied_budget"] == 1
+
+
+def test_ledger_capacity_denial_is_draining_shaped():
+    led = FleetDeviceLedger(4, None)
+    led.claim("a", 4)
+    with pytest.raises(FleetClaimDenied) as ei:
+        led.claim("b", 2, timeout_s=0.05)
+    assert str(ei.value).startswith("DRAINING:")
+    assert led.stats()["denied_capacity"] == 1
+
+
+def test_ledger_release_wakes_waiter():
+    led = FleetDeviceLedger(4, None)
+    t1 = led.claim("a", 4)
+    got = []
+
+    def waiter():
+        got.append(led.claim("b", 2, timeout_s=5.0))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not got          # still blocked on capacity
+    led.release(t1)
+    th.join(timeout=5)
+    assert got              # woken by the release
+    led.release(got[0])
+
+
+def test_router_claim_plane_denials_never_touch_breaker():
+    """The router arbitrates fleet devices over MESH_EXCHANGE; both
+    denial shapes reuse the admission wire markers and leave the
+    breaker alone (the replica is healthy, the CLAIM was denied)."""
+    from blaze_tpu.router.proxy import Router
+
+    r = Router([], start=False,
+               tenant_config={"acme": {"max_fleet_devices": 2}})
+    try:
+        r._member_join("127.0.0.1", 7001, devices=8)
+        assert r._fleet_ledger.total == 8
+        ok = r.mesh_exchange(
+            {"op": "claim", "tenant": "acme", "devices": 2})
+        assert ok.get("token")
+        # over the tenant cap: immediate budget denial
+        d1 = r.mesh_exchange(
+            {"op": "claim", "tenant": "acme", "devices": 1})
+        assert d1["state"] == "REJECTED_OVERLOADED"
+        assert d1["error"].startswith("REJECTED_TENANT_BUDGET:")
+        # over fleet capacity: DRAINING-shaped
+        d2 = r.mesh_exchange(
+            {"op": "claim", "tenant": "other", "devices": 7,
+             "timeout_s": 0.05})
+        assert d2["state"] == "REJECTED_OVERLOADED"
+        assert d2["error"].startswith("DRAINING:")
+        assert r.breaker._strikes == {}   # zero breaker strikes
+        rel = r.mesh_exchange(
+            {"op": "release", "token": ok["token"]})
+        assert rel["released"]
+        st = r.mesh_exchange({"op": "stats"})
+        assert st["fleet"]["claimed_devices"] == 0
+    finally:
+        r.close()
+
+
+def test_router_fleet_pool_rides_membership():
+    """JOIN grows the device pool by the replica's advertised count;
+    LEAVE shrinks it; outstanding claims keep their grants across a
+    shrink (transient oversubscription, never a revoke)."""
+    from blaze_tpu.router.proxy import Router
+
+    r = Router([], start=False)
+    try:
+        r._member_join("127.0.0.1", 7001, devices=8)
+        r._member_join("127.0.0.1", 7002, devices=8)
+        assert r._fleet_ledger.total == 16
+        tok = r.mesh_exchange(
+            {"op": "claim", "tenant": "t", "devices": 12})["token"]
+        r._member_leave("127.0.0.1:7002", "drained")
+        assert r._fleet_ledger.total == 8
+        st = r.mesh_exchange({"op": "stats"})["fleet"]
+        assert st["claimed_devices"] == 12        # grant survives the shrink
+        r.mesh_exchange({"op": "release", "token": tok})
+        assert r.mesh_exchange(
+            {"op": "stats"})["fleet"]["claimed_devices"] == 0
+    finally:
+        r.close()
+
+
+def test_coordinator_over_budget_claim_degrades_not_fails():
+    """A coordinator whose tenant is over its fleet-device cap
+    degrades to single-host mesh (needs no fleet devices) instead of
+    failing the query."""
+    oracle = run_plan(sandwich(scan()))
+    peer, srv, coord = _fleet_pair(
+        tenant_config={"acme": {"max_fleet_devices": 1}})
+    try:
+        q, got = _run_query(coord, sandwich(scan()), tenant="acme")
+        assert q.error is None
+        assert q.degraded
+        assert q.ctx.metrics.counters.get("fleet.degraded") == 1
+        assert _canonical_bytes(got) == _canonical_bytes(oracle)
+    finally:
+        _close_pair(peer, srv, coord)

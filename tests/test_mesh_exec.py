@@ -666,3 +666,133 @@ def test_mesh_relational_fused_kernels_byte_equal_and_pin():
     with dispatch.counting() as c:
         run_join()
     assert c.counts.get("mesh_dispatches", 0) == 1, c.counts
+
+
+# ---------------------------------------------------------------------------
+# mesh sort / window shapes (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_sort_differential_byte_equal():
+    """Global sort lowered to the mesh (per-shard device lexsorts +
+    host run-merge) is row-for-row equal to the single-device oracle,
+    unique keys so the total order is fully determined."""
+    from blaze_tpu.ops.sort import SortExec, SortKey
+    from blaze_tpu.parallel.mesh_exec import MeshSortExec
+
+    def mk():
+        return insert_exchanges(
+            SortExec(scan(), [SortKey(Col("v"))]),
+            4, shuffle_dir=tempfile.mkdtemp(),
+        )
+
+    want = run_plan(mk()).to_pandas()
+    low = lower_plan_to_mesh(mk(), mode="on")
+    assert isinstance(low, MeshSortExec)
+    got = run_plan(low).to_pandas()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_mesh_sort_ties_keep_oracle_order():
+    """Stability pin: duplicate keys keep earlier partitions first,
+    matching the single-device stable sort."""
+    from blaze_tpu.ops.sort import SortExec, SortKey
+    from blaze_tpu.parallel.mesh_exec import MeshSortExec
+
+    def mk(fetch=None):
+        return insert_exchanges(
+            SortExec(scan(), [SortKey(Col("k"))], fetch=fetch),
+            4, shuffle_dir=tempfile.mkdtemp(),
+        )
+
+    want = run_plan(mk()).to_pandas()
+    low = lower_plan_to_mesh(mk(), mode="on")
+    assert isinstance(low, MeshSortExec)
+    got = run_plan(low).to_pandas()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+    # top-n: fetch applies after the merge
+    wantn = run_plan(mk(fetch=17)).to_pandas()
+    lown = lower_plan_to_mesh(mk(fetch=17), mode="on")
+    assert isinstance(lown, MeshSortExec)
+    gotn = run_plan(lown).to_pandas()
+    assert len(gotn) == 17
+    pd.testing.assert_frame_equal(gotn, wantn, check_dtype=False)
+
+
+def test_mesh_window_repartition_differential():
+    """A partitioned window's hash exchange swaps for the mesh
+    all_to_all repartition; the frames compute whole and the result
+    matches the file-shuffle oracle after canonical order."""
+    from blaze_tpu.ops.sort import SortKey
+    from blaze_tpu.ops.window import WindowExec, WindowFn
+    from blaze_tpu.parallel.mesh_exec import MeshRepartitionExec
+
+    def mk():
+        return insert_exchanges(
+            WindowExec(
+                scan(),
+                partition_by=[Col("k")],
+                order_by=[SortKey(Col("v"))],
+                functions=[
+                    WindowFn("row_number", None, "rn"),
+                    WindowFn("sum", Col("v"), "run",
+                             frame=("rows", None, 0)),
+                ],
+            ),
+            4, shuffle_dir=tempfile.mkdtemp(),
+        )
+
+    def canon(t):
+        return (t.to_pandas().sort_values(["k", "v"])
+                .reset_index(drop=True))
+
+    want = canon(run_plan(mk()))
+    low = lower_plan_to_mesh(mk(), mode="on")
+    assert isinstance(low.children[0], MeshRepartitionExec)
+    got = canon(run_plan(low))
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint-keyed program cache (ISSUE 20 satellite): a SECOND
+# QueryService in the same process reuses the first one's traced mesh
+# programs - zero fresh traces, zero retraces, mesh_trace p50 ~ 0
+# ---------------------------------------------------------------------------
+
+
+def test_program_cache_kills_cross_service_retrace():
+    from blaze_tpu.obs import meshprof
+    from blaze_tpu.obs.metrics import REGISTRY
+    from blaze_tpu.service import QueryService
+
+    def run_once():
+        with QueryService(enable_cache=False, enable_trace=False,
+                          mesh_mode="on") as svc:
+            q = svc.submit_plan(
+                lower_plan_to_mesh(sandwich(scan()), mode="on")
+            )
+            return pa.Table.from_batches(
+                svc.result(q.query_id, timeout=120)
+            )
+
+    t1 = run_once()  # may trace (cold in THIS process order)
+    trace0 = REGISTRY.get("blaze_mesh_trace_total", op="mesh.groupby")
+    retrace0 = REGISTRY.get("blaze_mesh_retrace_total",
+                            op="mesh.groupby")
+
+    t2 = run_once()  # FRESH QueryService, fresh op instances
+
+    assert REGISTRY.get("blaze_mesh_retrace_total",
+                        op="mesh.groupby") == retrace0
+    # stronger than retrace delta 0: the warm service never traced at
+    # all - the fingerprint-keyed program cache handed it the compiled
+    # executable
+    assert REGISTRY.get("blaze_mesh_trace_total",
+                        op="mesh.groupby") == trace0
+    # the warm stage's mesh_trace sub-phase is ~0 (no trace ran)
+    warm_trace_s = meshprof.ROLLUP._ops["mesh.groupby"]["sub"][
+        "mesh_trace"][-1]
+    assert warm_trace_s < 0.05, warm_trace_s
+    g1 = t1.to_pandas().sort_values("k").reset_index(drop=True)
+    g2 = t2.to_pandas().sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(g1, g2, check_dtype=False)

@@ -557,3 +557,84 @@ def test_router_noisy_neighbor(parquet):
             "rate_limited", 0) == 0
         assert rc["failovers"] == 0
         assert st["fleet"]["alive"] == 2  # zero breaker strikes
+
+
+# ---------------------------------------------------------------------------
+# fleet device claims (ISSUE 20): the mesh tier's device reservations
+# compose with the same per-tenant budgets as admission
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_claims_respect_tenant_budgets():
+    """max_fleet_devices caps one tenant's device holdings across
+    outstanding claims; denial is immediate (no capacity wait), the
+    REJECTED_TENANT_BUDGET wire marker classifies TRANSIENT, and
+    other tenants are untouched."""
+    from blaze_tpu.fleet.claims import (
+        FleetClaimDenied,
+        FleetDeviceLedger,
+    )
+
+    led = FleetDeviceLedger(16, {
+        "acme": {"max_fleet_devices": 4},
+        "*": {"max_fleet_devices": 12},
+    })
+    a = led.claim("acme", 4)
+    t0 = time.monotonic()
+    with pytest.raises(FleetClaimDenied) as ei:
+        led.claim("acme", 1, timeout_s=5.0)
+    assert time.monotonic() - t0 < 1.0   # immediate, not a wait
+    assert str(ei.value).startswith("REJECTED_TENANT_BUDGET:")
+    # "*" default applies to unconfigured tenants
+    with pytest.raises(FleetClaimDenied):
+        led.claim("other", 13)
+    b = led.claim("other", 12)
+    led.release(a)
+    led.release(b)
+    assert led.stats()["claimed_devices"] == 0
+    assert led.stats()["denied_budget"] == 2
+
+
+def test_fleet_overclaim_rejects_draining_shaped_zero_strikes():
+    """Capacity exhaustion (not tenant misbehavior) denies with the
+    DRAINING wire shape through the router claim plane - spill
+    semantics, zero breaker strikes."""
+    from blaze_tpu.router.proxy import Router
+
+    r = Router([], start=False)
+    try:
+        r._member_join("127.0.0.1", 7101, devices=4)
+        tok = r.mesh_exchange(
+            {"op": "claim", "tenant": "a", "devices": 4})["token"]
+        d = r.mesh_exchange(
+            {"op": "claim", "tenant": "b", "devices": 2,
+             "timeout_s": 0.05})
+        assert d["state"] == "REJECTED_OVERLOADED"
+        assert d["error"].startswith("DRAINING:")
+        assert r.breaker._strikes == {}
+        r.mesh_exchange({"op": "release", "token": tok})
+    finally:
+        r.close()
+
+
+def test_fleet_released_claim_wakes_waiter():
+    """A capacity-blocked claim parks on the ledger condition and is
+    granted the moment a release frees enough devices."""
+    from blaze_tpu.fleet.claims import FleetDeviceLedger
+
+    led = FleetDeviceLedger(8, None)
+    t1 = led.claim("a", 8)
+    granted = []
+
+    def waiter():
+        granted.append(led.claim("b", 4, timeout_s=10.0))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    assert not granted
+    led.release(t1)
+    th.join(timeout=10)
+    assert granted
+    assert led.stats()["by_tenant"] == {"b": 4}
+    led.release(granted[0])
